@@ -1,0 +1,70 @@
+"""incubator_mxnet_tpu — a TPU-native deep learning framework with the
+capabilities of Apache MXNet (incubating) v1.5, built on JAX/XLA/Pallas.
+
+Conventional import: ``import incubator_mxnet_tpu as mx``.
+
+Layer map (TPU-first redesign of the reference; see SURVEY.md):
+  * ``mx.nd``       — eager NDArray API on-device (tape autograd)
+  * ``mx.autograd`` — record/backward/grad scopes
+  * ``mx.gluon``    — Block/HybridBlock (hybridize => XLA compile), Trainer
+  * ``mx.sym``      — symbolic graph layer (JSON import/export)
+  * ``mx.kvstore``  — parameter sync: in-jit ICI collectives + PS fallback
+  * ``mx.parallel`` — Mesh/pjit sharding: dp/tp/sp/pp (net-new superset)
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import ops
+from .ops import random as _ops_random
+
+
+class random:
+    """mx.random namespace (reference: python/mxnet/random.py)."""
+    seed = staticmethod(_ops_random.seed)
+
+    @staticmethod
+    def uniform(*args, **kwargs):
+        return nd.random.uniform(*args, **kwargs)
+
+    @staticmethod
+    def normal(*args, **kwargs):
+        return nd.random.normal(*args, **kwargs)
+
+
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import gluon
+from . import io
+from . import kvstore as kv
+from . import kvstore
+from . import symbol
+from . import symbol as sym
+from . import parallel
+from . import models
+from . import runtime
+from . import profiler
+from . import recordio
+from .recordio import MXRecordIO, MXIndexedRecordIO
+from . import image
+from .utils import test_utils
+from . import callback
+from . import monitor
+from .engine import Engine
+from . import engine
+from . import visualization
+from . import visualization as viz
+from .executor import CachedOp
+from . import module as mod
+from . import module
+from .model import save_checkpoint, load_checkpoint
+from . import model

@@ -1,0 +1,339 @@
+"""Tape-based autograd for the imperative (eager) frontend.
+
+Reference parity: python/mxnet/autograd.py + src/imperative/imperative.cc
+(RecordOp/MarkVariables/Backward; SURVEY §2.2, call stack §3.2): scoped
+``record()/pause()/train_mode()/predict_mode()``, ``mark_variables``,
+``backward(heads, head_grads, retain_graph, create_graph)``, functional
+``grad()``, and a user-defined ``Function`` (custom VJP) class.
+
+TPU-first: instead of re-building an NNVM graph and running a symbolic
+gradient pass, every recorded eager op captures its VJP closure via
+``jax.vjp`` at execution time; ``backward`` is a reverse topological walk
+calling those closures. The compiled path (HybridBlock.hybridize) bypasses
+this tape entirely — there, ``jax.grad`` differentiates the whole traced
+program, which is the reference's CachedOp-backward equivalent.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(flag):
+    st = _st()
+    old, st.recording = st.recording, flag
+    return old
+
+
+def set_training(flag):
+    st = _st()
+    old, st.training = st.training, flag
+    return old
+
+
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._old
+        return False
+
+
+def record(train_mode=True):  # noqa: D401
+    """Scope that records eager ops onto the tape."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Scope that suspends recording."""
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape structure
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: parents + the vjp closure produced by jax.vjp."""
+
+    __slots__ = ("parents", "vjp_fn", "n_outputs", "out_templates", "op_name")
+
+    def __init__(self, parents, vjp_fn, n_outputs, out_templates, op_name=""):
+        self.parents = parents          # list of NDArray inputs (diff'able slots)
+        self.vjp_fn = vjp_fn            # cotangents(outs) -> cotangents(parents)
+        self.n_outputs = n_outputs
+        self.out_templates = out_templates  # list of (shape, dtype) per output
+        self.op_name = op_name
+
+
+def record_op(fn, arrays, op_name=""):
+    """Execute ``fn(*vals)`` (vals = unwrapped jax arrays), recording a tape
+    node if recording is active. Returns (outputs_tuple, node_or_None).
+    ``fn`` must be a jax-traceable closure over any static attributes."""
+    vals = [a._data for a in arrays]
+    # while recording, every op with array inputs joins the tape (reference:
+    # Imperative::RecordOp tags all outputs) — grads later flow only into
+    # marked leaves, but autograd.grad() may target any recorded array.
+    if not is_recording() or not arrays:
+        out = fn(*vals)
+        return (out if isinstance(out, tuple) else (out,)), None
+    out, vjp_fn = jax.vjp(fn, *vals)
+    outs = out if isinstance(out, tuple) else (out,)
+    templates = [(o.shape, o.dtype) for o in outs]
+    node = TapeNode(list(arrays), vjp_fn, len(outs), templates, op_name)
+    return outs, node
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    """Attach gradient buffers to arrays, making them autograd leaves."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients] if gradients is not None else None
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for i, v in enumerate(variables):
+        g = gradients[i] if gradients is not None else None
+        v._mark_variable(g, grad_reqs[i])
+
+
+def _topo_order(head_arrays):
+    """Reverse-reachable tape nodes in topological order (parents first)."""
+    order, seen = [], set()
+    stack = []
+    for h in head_arrays:
+        if h._node is not None and id(h._node) not in seen:
+            stack.append((h._node, False))
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            if p._node is not None and id(p._node) not in seen:
+                stack.append((p._node, False))
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
+    """Run backward from ``heads``, accumulating into leaf ``.grad`` buffers."""
+    from .ndarray import NDArray, array as _nd_array
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    return _backward_impl(heads, head_grads, retain_graph, create_graph,
+                          accumulate_to_leaves=True)
+
+
+def _backward_impl(heads, head_grads, retain_graph, create_graph,
+                   accumulate_to_leaves=True, variables=None):
+    from .ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager autograd) is not supported "
+            "yet; use jax.grad composition via hybridize() for higher-order.")
+    want = set(id(v) for v in variables) if variables is not None else None
+    order = _topo_order(heads)
+
+    # cotangent buffers: per node, one slot per output; plus per leaf array
+    node_ct = {}     # id(node) -> [ct or None] * n_outputs
+    leaf_ct = {}     # id(array) -> ct (jax array)
+    leaf_map = {}    # id(array) -> array
+
+    def add_ct(store, key, ct, slot=None):
+        if slot is None:
+            cur = store.get(key)
+            store[key] = ct if cur is None else cur + ct
+        else:
+            lst = store[key]
+            lst[slot] = ct if lst[slot] is None else lst[slot] + ct
+
+    for i, h in enumerate(heads):
+        hg = None
+        if head_grads is not None and head_grads[i] is not None:
+            hg = head_grads[i]._data if isinstance(head_grads[i], NDArray) else jnp.asarray(head_grads[i])
+        else:
+            hg = jnp.ones(h.shape, h.dtype)
+        if h._node is not None:
+            node_ct.setdefault(id(h._node), [None] * h._node.n_outputs)
+            add_ct(node_ct, id(h._node), hg, slot=h._out_index)
+        elif h._requires_tape():
+            add_ct(leaf_ct, id(h), hg)
+            leaf_map[id(h)] = h
+
+    for node in reversed(order):
+        cts = node_ct.get(id(node))
+        if cts is None:
+            continue
+        full = [c if c is not None else jnp.zeros(shape, dtype)
+                for c, (shape, dtype) in zip(cts, node.out_templates)]
+        arg = tuple(full) if node.n_outputs > 1 else full[0]
+        in_cts = node.vjp_fn(arg)
+        for parent, ict in zip(node.parents, in_cts):
+            if ict is None or (hasattr(ict, "dtype") and ict.dtype == jax.dtypes.float0):
+                continue
+            if parent._node is not None:
+                node_ct.setdefault(id(parent._node), [None] * parent._node.n_outputs)
+                add_ct(node_ct, id(parent._node), ict, slot=parent._out_index)
+            is_leaf = (parent._grad_req is not None and parent._grad_req != "null"
+                       and parent._node is None)
+            if is_leaf or (want is not None and id(parent) in want):
+                add_ct(leaf_ct, id(parent), ict)
+                leaf_map[id(parent)] = parent
+        node_ct[id(node)] = None  # free cotangent memory as we go
+
+    if not retain_graph:
+        for node in order:  # invalidate: a second backward must fail loudly
+            node.vjp_fn = None
+            node.parents = []
+        for h in heads:
+            h._node = None
+
+    if accumulate_to_leaves:
+        for key, ct in leaf_ct.items():
+            leaf_map[key]._accumulate_grad(ct)
+        return None
+
+    results = []
+    for v in variables:
+        ct = leaf_ct.get(id(v))
+        results.append(ct if ct is not None else jnp.zeros(v.shape, v.dtype))
+    return results
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient: returns grads of heads w.r.t. variables without
+    touching ``.grad`` buffers (reference: autograd.grad)."""
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+    saved_reqs = [(v, v._grad_req) for v in variables]
+    try:
+        for v in variables:
+            if v._grad_req is None or v._grad_req == "null":
+                v._grad_req = "write"  # temporarily treat as leaf
+        raw = _backward_impl(heads, head_grads, retain_graph, create_graph,
+                             accumulate_to_leaves=False, variables=variables)
+    finally:
+        for v, req in saved_reqs:
+            v._grad_req = req
+    outs = [NDArray(r) for r in raw]
+    return outs[0] if single else outs
+
+
+# ---------------------------------------------------------------------------
+# user-defined differentiable Function (reference: autograd.Function)
+# ---------------------------------------------------------------------------
+
+class Function:
+    """Customized differentiable function with user forward/backward.
+
+    Subclass and override ``forward`` and ``backward`` (both operate on
+    NDArrays); call the instance. Mirrors python/mxnet/autograd.py:Function.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, tuple)
+        outs = (outputs,) if single else outputs
+
+        if is_recording() and any(x._requires_tape() for x in inputs
+                                  if isinstance(x, NDArray)):
+            func = self
+            arrays = [x for x in inputs if isinstance(x, NDArray)]
+
+            def vjp_fn(out_cts):
+                cts = (out_cts,) if func_n_out == 1 else out_cts
+                with pause():
+                    in_grads = func.backward(*[NDArray(c) for c in cts])
+                if not isinstance(in_grads, tuple):
+                    in_grads = (in_grads,)
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in in_grads)
+
+            func_n_out = len(outs)
+            node = TapeNode(arrays, vjp_fn, len(outs),
+                            [(o.shape, o.dtype) for o in outs],
+                            op_name=type(self).__name__)
+            for i, o in enumerate(outs):
+                o._node = node
+                o._out_index = i
+        return outputs

@@ -1,0 +1,58 @@
+"""Shared basics: dtype registry, errors, small helpers.
+
+Reference parity: python/mxnet/base.py's dtype/name plumbing, MXNetError.
+"""
+
+import numpy as _np
+import jax.numpy as jnp
+
+__all__ = ["MXNetError", "TPUFrameworkError", "numeric_types", "integer_types",
+           "string_types", "dtype_np", "dtype_name", "default_dtype"]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: MXNetError from c_api errors)."""
+
+
+# new-name alias; both are exported
+TPUFrameworkError = MXNetError
+
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+string_types = (str,)
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "uint8": jnp.uint8, "int8": jnp.int8,
+    "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+    "uint16": jnp.uint16, "uint32": jnp.uint32, "uint64": jnp.uint64,
+    "int16": jnp.int16,
+}
+
+
+def dtype_np(dtype):
+    """Normalize a dtype-ish (str/np.dtype/jnp type/None) to a numpy dtype."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return jnp.bfloat16  # numpy has no bfloat16; return the ml_dtypes scalar type
+        return _np.dtype(dtype)
+    return _np.dtype(dtype) if not _is_bf16(dtype) else dtype
+
+
+def _is_bf16(dtype):
+    return getattr(dtype, "__name__", str(dtype)) == "bfloat16" or str(dtype) == "bfloat16"
+
+
+def dtype_name(dtype):
+    """Canonical string name of a dtype."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        return dtype
+    return str(jnp.dtype(dtype))
+
+
+def default_dtype():
+    return _np.float32

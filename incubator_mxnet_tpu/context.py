@@ -1,0 +1,146 @@
+"""Device contexts for the TPU-native framework.
+
+Capability parity with the reference's ``Context`` (include/mxnet/base.h:102-225
+in the reference tree): a device descriptor with a ``(device_type, device_id)``
+pair, a thread-local "current context" stack usable as a ``with`` block, and
+convenience constructors ``cpu()`` / ``tpu()`` / ``gpu()``.
+
+TPU-first design: a Context wraps a concrete ``jax.Device``. Placement is done
+with ``jax.device_put`` rather than per-op stream dispatch; inside ``jit`` the
+compiler owns placement, so Context only matters for eager arrays and I/O.
+"""
+
+import threading
+
+import jax
+
+__all__ = ["Context", "cpu", "tpu", "gpu", "cpu_pinned", "current_context", "num_gpus", "num_tpus"]
+
+
+class Context:
+    """A device context: where eager NDArray data lives.
+
+    Parameters
+    ----------
+    device_type : str
+        'cpu', 'tpu' or 'gpu' ('gpu' aliases the accelerator platform when
+        present so reference scripts written against gpu contexts run).
+    device_id : int
+        Index into ``jax.devices(platform)``.
+    """
+
+    # mirror of the reference's enum (kCPU=1, kGPU=2, kCPUPinned=3, kCPUShared=5)
+    devtype2num = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+    devnum2type = {v: k for k, v in devtype2num.items()}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_type, self.device_id = device_type.device_type, device_type.device_id
+        else:
+            if device_type not in self.devtype2num:
+                raise ValueError("unknown device type %r" % (device_type,))
+            self.device_type = device_type
+            self.device_id = device_id
+        self._old_ctx = None
+
+    # -- jax interop ---------------------------------------------------------
+    @property
+    def jax_device(self):
+        """The concrete jax.Device backing this context."""
+        plat = self._platform()
+        devs = jax.devices(plat)
+        if self.device_id >= len(devs):
+            raise ValueError("%s: device_id %d out of range (%d %s devices)"
+                             % (self, self.device_id, len(devs), plat))
+        return devs[self.device_id]
+
+    def _platform(self):
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            return "cpu"
+        # 'tpu' and 'gpu' both resolve to the accelerator platform present.
+        backend = jax.default_backend()
+        if self.device_type == "tpu":
+            return backend if backend != "cpu" else "cpu"
+        if self.device_type == "gpu":
+            # alias: let reference scripts using mx.gpu() run on the accelerator
+            return backend if backend != "cpu" else "cpu"
+        return "cpu"
+
+    # -- context-manager / stack --------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *args):
+        Context._default_ctx.value = self._old_ctx
+        return False
+
+    # -- misc ---------------------------------------------------------------
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    @property
+    def device_typeid(self):
+        return self.devtype2num[self.device_type]
+
+    def empty_cache(self):
+        """Release cached device memory (reference: Storage pool release)."""
+        # XLA owns the allocator; live buffers are freed by GC. Nothing to do
+        # beyond forcing a GC cycle here.
+        import gc
+        gc.collect()
+
+
+def cpu(device_id=0):
+    """Return a CPU context."""
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    """Pinned-memory CPU context (alias of cpu on TPU hosts)."""
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id=0):
+    """Return a TPU context."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator alias so reference scripts using ``mx.gpu()`` run unchanged."""
+    return Context("gpu", device_id)
+
+
+def num_gpus():
+    backend = jax.default_backend()
+    return len(jax.devices(backend)) if backend not in ("cpu",) else 0
+
+
+def num_tpus():
+    backend = jax.default_backend()
+    return len(jax.devices(backend)) if backend not in ("cpu",) else 0
+
+
+def current_context():
+    """The context at the top of the thread-local stack (default: accelerator
+    if present, else cpu — eager arrays land where compute is fastest)."""
+    if not hasattr(Context._default_ctx, "value"):
+        backend = jax.default_backend()
+        Context._default_ctx.value = Context("tpu" if backend != "cpu" else "cpu", 0)
+    return Context._default_ctx.value

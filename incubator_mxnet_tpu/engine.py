@@ -1,0 +1,121 @@
+"""Engine shim — execution ordering services.
+
+Reference parity: the dependency engine (src/engine/*, SURVEY §2.1) is the
+reference's central runtime. On TPU, XLA program order + async dispatch
+subsume var-queue scheduling (SURVEY §7 step 2): ops launched through jax
+execute asynchronously in issue order per device, and data dependencies are
+explicit in the traced program. What remains meaningful — and is provided
+here — is the *API*: bulk scoping, WaitAll, and a var/read-write interface
+for host-side ops (IO, PS RPC) that need ordering relative to device work,
+backed by a thread pool.
+
+See also native/engine.cc (C++ threadpool used by the PS fallback and IO).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor, Future
+
+import jax
+
+__all__ = ["Engine", "bulk", "set_bulk_size", "current"]
+
+_bulk_size = 15
+
+
+class _Var:
+    """Ordering token (reference: engine Var). Tracks the last write future
+    and pending reads so host-side ops can declare read/write sets."""
+
+    __slots__ = ("_last_write", "_reads", "_lock")
+
+    def __init__(self):
+        self._last_write = None
+        self._reads = []
+        self._lock = threading.Lock()
+
+
+class Engine:
+    """NaiveEngine-equivalent scheduler for host-side functions."""
+
+    _instance = None
+
+    def __init__(self, num_workers=4):
+        self._pool = ThreadPoolExecutor(max_workers=num_workers,
+                                        thread_name_prefix="mxtpu-engine")
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def new_variable(self):
+        return _Var()
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        """Schedule fn after its dependencies; returns a Future."""
+        deps = []
+        for v in const_vars:
+            with v._lock:
+                if v._last_write is not None:
+                    deps.append(v._last_write)
+        for v in mutable_vars:
+            with v._lock:
+                if v._last_write is not None:
+                    deps.append(v._last_write)
+                deps.extend(v._reads)
+
+        def run():
+            for d in deps:
+                d.result()
+            return fn()
+
+        fut = self._pool.submit(run)
+        for v in const_vars:
+            with v._lock:
+                v._reads.append(fut)
+        for v in mutable_vars:
+            with v._lock:
+                v._last_write = fut
+                v._reads = []
+        return fut
+
+    def wait_for_var(self, var):
+        with var._lock:
+            fut = var._last_write
+        if fut is not None:
+            fut.result()
+
+    def wait_for_all(self):
+        jax.effects_barrier()
+        self._pool.shutdown(wait=True)
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix="mxtpu-engine")
+
+
+def current():
+    return Engine.get()
+
+
+def set_bulk_size(size):
+    """reference: mx.engine.set_bulk_size — XLA fuses whole programs, so
+    bulking is inherent; value kept for API parity."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = size
+    return prev
+
+
+class bulk:
+    """Scope marking a bulk region (reference: engine.bulk ctx manager)."""
+
+    def __init__(self, size):
+        self._size = size
+
+    def __enter__(self):
+        self._old = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, *args):
+        set_bulk_size(self._old)
+        return False

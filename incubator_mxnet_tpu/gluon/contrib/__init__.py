@@ -1,0 +1,6 @@
+"""gluon.contrib (reference: python/mxnet/gluon/contrib) — experimental
+layers: Concurrent/HybridConcurrent/Identity, conv-RNN cells (subset),
+VariationalDropoutCell (subset)."""
+
+from . import nn
+from . import rnn

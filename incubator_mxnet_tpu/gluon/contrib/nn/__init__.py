@@ -1,0 +1,61 @@
+"""gluon.contrib.nn (reference: contrib/nn/basic_layers.py)."""
+
+from ...block import HybridBlock, Block
+from ... import nn as _nn
+from ...model_zoo.vision.squeezenet import HybridConcurrent
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle2D"]
+
+
+class Concurrent(Block):
+    """Parallel branches concatenated (dynamic-graph version)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, block):
+        self.register_child(block)
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.Concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Embedding with row-sparse gradient intent (reference:
+    contrib.nn.SparseEmbedding). On TPU the gather/scatter pattern is already
+    sparse-efficient under XLA; grad_stype tracked for KVStore row_sparse."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype, weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """Cross-device BatchNorm (reference: contrib SyncBatchNorm /
+    sync_batch_norm op). Inside a pjit-ed step the batch axis is globally
+    sharded, so plain BatchNorm statistics ARE the synchronized statistics —
+    XLA inserts the cross-chip psum for the mean/var reductions."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        return F.depth_to_space(x, self._factor)

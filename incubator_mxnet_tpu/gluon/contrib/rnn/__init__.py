@@ -1,0 +1,104 @@
+"""gluon.contrib.rnn (reference: contrib/rnn) — Conv RNN cells and
+VariationalDropoutCell."""
+
+from ...rnn.rnn_cell import ModifierCell, HybridRecurrentCell
+from ...nn.basic_layers import _train_flag, _maybe_key
+
+__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Same dropout mask reused across time steps (reference:
+    contrib.rnn.VariationalDropoutCell)."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0., drop_outputs=0.):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def _mask(self, p, like, cached):
+        if not _train_flag() or p <= 0:
+            return None
+        if cached is not None:
+            return cached
+        import jax
+        from ....ops import random as _rnd
+        key = _maybe_key() or _rnd.next_key()
+        shape = like.shape
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        if hasattr(like, "_data"):
+            from ....ndarray import NDArray
+            import jax.numpy as jnp
+            return NDArray(keep.astype(like._data.dtype) / (1 - p))
+        return keep.astype(like.dtype) / (1 - p)
+
+    def hybrid_forward(self, F, inputs, states):
+        m = self._mask(self.drop_inputs, inputs, self._input_mask)
+        if m is not None:
+            self._input_mask = m
+            inputs = inputs * m
+        out, next_states = self.base_cell(inputs, states)
+        mo = self._mask(self.drop_outputs, out, self._output_mask)
+        if mo is not None:
+            self._output_mask = mo
+            out = out * mo
+        return out, next_states
+
+
+class Conv2DLSTMCell(HybridRecurrentCell):
+    """Convolutional LSTM cell (reference: contrib.rnn.Conv2DLSTMCell)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=(0, 0), **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)
+        k = i2h_kernel if isinstance(i2h_kernel, tuple) else (i2h_kernel, i2h_kernel)
+        hk = h2h_kernel if isinstance(h2h_kernel, tuple) else (h2h_kernel, h2h_kernel)
+        pad = i2h_pad if isinstance(i2h_pad, tuple) else (i2h_pad, i2h_pad)
+        self._i2h_kernel, self._h2h_kernel, self._i2h_pad = k, hk, pad
+        in_c = input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_channels, in_c) + k)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_channels, hidden_channels) + hk)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_channels,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_channels,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._input_shape[1:]
+        return [{"shape": shape, "__layout__": "NCHW"},
+                {"shape": shape, "__layout__": "NCHW"}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h, prev_c = states
+        hpad = (self._h2h_kernel[0] // 2, self._h2h_kernel[1] // 2)
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=4 * self._hidden_channels)
+        h2h = F.Convolution(prev_h, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=hpad,
+                            num_filter=4 * self._hidden_channels)
+        gates = i2h + h2h
+        i, f, g, o = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.Activation(i, act_type="sigmoid")
+        f = F.Activation(f, act_type="sigmoid")
+        g = F.Activation(g, act_type="tanh")
+        o = F.Activation(o, act_type="sigmoid")
+        next_c = f * prev_c + i * g
+        next_h = o * F.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]

@@ -1,0 +1,131 @@
+"""DataLoader with multiprocess workers.
+
+Reference parity: python/mxnet/gluon/data/dataloader.py:26-98 (worker pool
+passing NDArrays via shared memory, default/batchify collate). TPU-first:
+workers produce host numpy batches (the device transfer happens once per
+batch on the main process — TPU HBM is not shareable across processes, so
+the reference's POSIX-shm NDArray rebuild maps to shm-backed numpy here).
+"""
+
+import multiprocessing as mp
+
+import numpy as _np
+
+from ...ndarray import array as nd_array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch NDArray (recursive on tuples)."""
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    if hasattr(data[0], "asnumpy"):
+        data = [d.asnumpy() for d in data]
+    arr = _np.asarray(data)
+    return nd_array(arr)
+
+
+def default_mp_batchify_fn(data):
+    """Worker-side collate: keep numpy (shared-memory friendly)."""
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    if hasattr(data[0], "asnumpy"):
+        data = [d.asnumpy() for d in data]
+    return _np.asarray(data)
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn):
+    batch = batchify_fn([_worker_dataset[i] for i in samples])
+    return batch
+
+
+def _to_device(batch):
+    if isinstance(batch, (list, tuple)):
+        return [_to_device(b) for b in batch]
+    if isinstance(batch, _np.ndarray):
+        return nd_array(batch)
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or last_batch:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = default_mp_batchify_fn if self._num_workers > 0 \
+                else default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            self._pool = mp.get_context("fork").Pool(
+                self._num_workers, initializer=_worker_initializer,
+                initargs=(dataset,))
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch in self._batch_sampler:
+                out = self._batchify_fn([self._dataset[i] for i in batch])
+                yield _to_device(out) if isinstance(out, _np.ndarray) or (
+                    isinstance(out, list) and out and isinstance(out[0], _np.ndarray)) else out
+            return
+
+        # async prefetch pipeline through the worker pool
+        pending = []
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                samples = next(it)
+            except StopIteration:
+                return False
+            pending.append(self._pool.apply_async(
+                _worker_fn, (samples, self._batchify_fn)))
+            return True
+
+        for _ in range(self._prefetch):
+            if not submit():
+                break
+        while pending:
+            result = pending.pop(0)
+            batch = result.get(self._timeout)
+            submit()
+            yield _to_device(batch)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

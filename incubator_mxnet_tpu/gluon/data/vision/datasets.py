@@ -1,0 +1,193 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST/CIFAR read the standard binary formats from a local root
+(zero-egress environment: no auto-download; pass the directory containing the
+raw files). ImageRecordDataset reads RecordIO packed by im2rec.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from ..dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from the standard idx-ubyte files (optionally .gz)."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_name, lbl_name = self._train_files if self._train else self._test_files
+        data_file = self._find(img_name)
+        label_file = self._find(lbl_name)
+        with self._open(label_file) as f:
+            struct.unpack(">II", f.read(8))
+            label = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+        with self._open(data_file) as f:
+            _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = _np.frombuffer(f.read(), dtype=_np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = data
+        self._label = label
+
+    def _find(self, base):
+        for cand in (base, base + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise IOError(
+            "MNIST file %s not found under %s (no auto-download in this "
+            "environment; place the idx-ubyte files there)" % (base, self._root))
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"), train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python-pickle batches directory."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        import pickle
+        files = ["data_batch_%d" % i for i in range(1, 6)] if self._train \
+            else ["test_batch"]
+        data, labels = [], []
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        for fname in files:
+            p = os.path.join(base, fname)
+            if not os.path.exists(p):
+                raise IOError("CIFAR batch %s not found under %s" % (fname, base))
+            with open(p, "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            data.append(batch["data"])
+            labels.extend(batch["labels"])
+        data = _np.concatenate(data).reshape(-1, 3, 32, 32)
+        self._data = data.transpose(0, 2, 3, 1)  # HWC like the reference
+        self._label = _np.asarray(labels, dtype=_np.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=True, train=True, transform=None):
+        self._train = train
+        self._fine = fine_label
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        import pickle
+        fname = "train" if self._train else "test"
+        base = self._root
+        sub = os.path.join(base, "cifar-100-python")
+        if os.path.isdir(sub):
+            base = sub
+        p = os.path.join(base, fname)
+        if not os.path.exists(p):
+            raise IOError("CIFAR-100 file %s not found under %s" % (fname, base))
+        with open(p, "rb") as f:
+            batch = pickle.load(f, encoding="latin1")
+        data = _np.asarray(batch["data"]).reshape(-1, 3, 32, 32)
+        self._data = data.transpose(0, 2, 3, 1)
+        key = "fine_labels" if self._fine else "coarse_labels"
+        self._label = _np.asarray(batch[key], dtype=_np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels from a RecordIO file (reference:
+    ImageRecordDataset over IRHeader-packed records)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ...recordio import unpack_img
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """Images arranged as root/category/*.jpg (reference:
+    ImageFolderDataset). Decoding via mx.image.imread."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".bmp", ".npy"]
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        path, label = self.items[idx]
+        if path.endswith(".npy"):
+            img = _np.load(path)
+        else:
+            img = imread(path, self._flag).asnumpy()
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,188 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/transforms.py:
+Compose, Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, color jitter family). Backed by the image ops."""
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ...block import Block, HybridBlock
+from ....ndarray import NDArray, array as nd_array
+from ....ndarray.ndarray import _invoke_op
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomColorJitter", "CropResize"]
+
+
+def _as_nd(x):
+    return x if isinstance(x, NDArray) else nd_array(x)
+
+
+class Compose(Block):
+    def __init__(self, transforms):
+        super().__init__(prefix="", params=None)
+        self._transforms = transforms
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__(prefix="", params=None)
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(Block):
+    """(H,W,C) uint8 [0..255] -> (C,H,W) float32 [0..1]."""
+
+    def forward(self, x):
+        return _invoke_op("image_to_tensor", (_as_nd(x),), {})
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__(prefix="", params=None)
+        self._mean = _np.asarray(mean, dtype="float32")
+        self._std = _np.asarray(std, dtype="float32")
+
+    def forward(self, x):
+        return _invoke_op("image_normalize", (_as_nd(x),),
+                          {"mean": self._mean, "std": self._std})
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation="bilinear"):
+        super().__init__(prefix="", params=None)
+        self._size = size
+        self._interp = interpolation if isinstance(interpolation, str) else "bilinear"
+
+    def forward(self, x):
+        return _invoke_op("image_resize", (_as_nd(x),),
+                          {"size": self._size, "interp": self._interp})
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation="bilinear"):
+        super().__init__(prefix="", params=None)
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        x = _as_nd(x)
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        return _invoke_op("image_crop", (x,),
+                          {"x": x0, "y": y0, "width": w, "height": h})
+
+
+class CropResize(Block):
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__(prefix="", params=None)
+        self._args = (x, y, width, height)
+        self._size = size
+
+    def forward(self, data):
+        x0, y0, w, h = self._args
+        out = _invoke_op("image_crop", (_as_nd(data),),
+                         {"x": x0, "y": y0, "width": w, "height": h})
+        if self._size:
+            out = _invoke_op("image_resize", (out,), {"size": self._size})
+        return out
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation="bilinear"):
+        super().__init__(prefix="", params=None)
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        x = _as_nd(x)
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = _pyrandom.uniform(*self._scale) * area
+            aspect = _pyrandom.uniform(*self._ratio)
+            w = int(round((target_area * aspect) ** 0.5))
+            h = int(round((target_area / aspect) ** 0.5))
+            if w <= W and h <= H:
+                x0 = _pyrandom.randint(0, W - w)
+                y0 = _pyrandom.randint(0, H - h)
+                out = _invoke_op("image_crop", (x,),
+                                 {"x": x0, "y": y0, "width": w, "height": h})
+                return _invoke_op("image_resize", (out,), {"size": self._size})
+        return _invoke_op("image_resize", (x,), {"size": self._size})
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _pyrandom.random() < 0.5:
+            return _invoke_op("image_flip_left_right", (_as_nd(x),), {})
+        return _as_nd(x)
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _pyrandom.random() < 0.5:
+            return _invoke_op("image_flip_top_bottom", (_as_nd(x),), {})
+        return _as_nd(x)
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__(prefix="", params=None)
+        self._args = (max(0, 1 - brightness), 1 + brightness)
+
+    def forward(self, x):
+        return _invoke_op("image_random_brightness", (_as_nd(x),),
+                          {"min_factor": self._args[0], "max_factor": self._args[1]})
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__(prefix="", params=None)
+        self._args = (max(0, 1 - contrast), 1 + contrast)
+
+    def forward(self, x):
+        return _invoke_op("image_random_contrast", (_as_nd(x),),
+                          {"min_factor": self._args[0], "max_factor": self._args[1]})
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__(prefix="", params=None)
+        self._args = (max(0, 1 - saturation), 1 + saturation)
+
+    def forward(self, x):
+        return _invoke_op("image_random_saturation", (_as_nd(x),),
+                          {"min_factor": self._args[0], "max_factor": self._args[1]})
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__(prefix="", params=None)
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        ts = list(self._transforms)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x

@@ -1,0 +1,321 @@
+"""Parameter / ParameterDict.
+
+Reference parity: python/mxnet/gluon/parameter.py (deferred-shape Parameter
+with grad_req/lr_mult/wd_mult, sparse stype hooks, save/load; prefix-scoped
+ParameterDict with sharing) per SURVEY §2.6.
+
+TPU-first: a Parameter holds ONE logical NDArray (jax.Array) — per-device
+replicas are the job of jax.sharding (mx.parallel), not of hand-copied
+per-context lists like the reference's _ctx_data.
+"""
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ..ndarray import NDArray, array as _nd_array
+from .. import initializer as init
+from ..base import MXNetError
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter used before its deferred shape was known."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data = None          # NDArray
+        self._deferred_init = None  # (initializer, default_init)
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    def _shape_known(self):
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape, self.dtype)
+
+    # ------------------------------------------------------------------ init
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            from .. import initializer as _i
+            default_init = _i.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if not self._shape_known():
+            if not self._allow_deferred_init:
+                raise ValueError(
+                    "Cannot initialize Parameter %s because it has invalid "
+                    "shape %s and deferred init is not allowed." % (self.name, self.shape))
+            self._deferred_init = (init, default_init)
+            return
+        self._finish_init(init, default_init)
+
+    def _finish_init(self, initializer, default_init):
+        data = NDArray(jnp.zeros(self.shape, _dtype(self.dtype)))
+        desc = init.InitDesc(self.name, {"__init__": ""})
+        actual = initializer if initializer is not None else (self.init or default_init)
+        actual(desc, data)
+        data._data = data._data.astype(_dtype(self.dtype))
+        self._set_data_arr(data)
+
+    def _set_data_arr(self, data):
+        self._data = data
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self, in_shape_hint=None):
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized" % self.name)
+        initializer, default_init = self._deferred_init
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                "Parameter %s shape still unknown: %s" % (self.name, self.shape))
+        self._finish_init(initializer, default_init)
+
+    def shape_inferred(self, shape):
+        """Fill deferred (0/None) dims from an observed input."""
+        if self.shape is None:
+            self.shape = tuple(shape)
+        else:
+            new = []
+            for s_old, s_new in zip(self.shape, shape):
+                if s_old in (0, None, -1):
+                    new.append(s_new)
+                elif s_new in (0, None, -1) or s_old == s_new:
+                    new.append(s_old)
+                else:
+                    raise ValueError(
+                        "Inferred shape %s incompatible with Parameter %s "
+                        "declared shape %s" % (shape, self.name, self.shape))
+            self.shape = tuple(new)
+        if self._deferred_init is not None and self._shape_known():
+            self._finish_deferred_init()
+
+    # ------------------------------------------------------------------ data
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    "Parameter %s deferred-init pending; run a forward pass "
+                    "or provide full shape." % self.name)
+            raise RuntimeError(
+                "Parameter %s has not been initialized. Call initialize() first."
+                % self.name)
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def list_ctx(self):
+        return [self.data().context] if self._data is not None else []
+
+    def set_data(self, data):
+        if self._data is None:
+            if self.shape is None:
+                self.shape = tuple(data.shape)
+            self._set_data_arr(data if isinstance(data, NDArray) else _nd_array(data))
+        else:
+            src = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+            self._data._data = src.astype(self._data._data.dtype)
+
+    def grad(self, ctx=None):
+        if self._data is None or self._data._grad is None:
+            raise RuntimeError("Parameter %s has no gradient (grad_req=%s)"
+                               % (self.name, self._grad_req))
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            g = self._data._grad
+            g._data = jnp.zeros_like(g._data)
+
+    def reset_ctx(self, ctx):
+        pass  # placement is sharding-driven; kept for API parity
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._data = self._data._data.astype(_dtype(dtype))
+            if self._data._grad is not None:
+                self._data._grad._data = self._data._grad._data.astype(_dtype(dtype))
+
+    def var(self):
+        from ..symbol import var
+        return var(self.name, shape=self.shape, dtype=self.dtype)
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _np.ndarray):
+            value = _np.asarray(value)
+        self.value = value
+
+        class CInit(init.Initializer):
+            def _init_weight(self2, _, arr):
+                arr._data = jnp.asarray(value, dtype=arr._data.dtype)
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype), init=CInit())
+
+
+def _dtype(dtype):
+    if dtype == "bfloat16":
+        return jnp.bfloat16
+    return jnp.dtype(dtype or "float32")
+
+
+class ParameterDict:
+    """Prefix-scoped dict of Parameters with sharing (reference:
+    parameter.py ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        return "ParameterDict(%s)" % ", ".join(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve ``prefix + name``."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None and param.shape is not None:
+                    continue
+                if getattr(param, k, None) in (None, 0) and v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise ValueError("No constant named %s" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError("Cannot update self with other because they "
+                                 "have different Parameters with the same name %s" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for _, v in self.items():
+            v.initialize(init=None, ctx=ctx, default_init=init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError("Prefix %s is to be striped before saving, but "
+                                 "Parameter %s does not start with it" %
+                                 (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        from ..ndarray import save as nd_save
+        nd_save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import load as nd_load
+        arg_dict = {restore_prefix + k: v for k, v in nd_load(filename).items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise IOError("Parameter %s missing in file %s" % (name, filename))
+        for name, v in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError("Parameter %s loaded from file %s is not present in this dict"
+                                  % (name, filename))
+                continue
+            self._params[name].set_data(v)
+

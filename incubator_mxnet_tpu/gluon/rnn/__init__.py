@@ -1,0 +1,6 @@
+"""gluon.rnn (reference: python/mxnet/gluon/rnn)."""
+
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
+                       GRUCell, SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell, ResidualCell, BidirectionalCell)

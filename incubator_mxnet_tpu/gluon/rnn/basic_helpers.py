@@ -1,0 +1,30 @@
+"""Shared helpers for RNN cells (sequence formatting/masking)."""
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Normalize inputs to a list of per-step arrays; returns (F, steps, batch)."""
+    from ...ndarray import NDArray
+    from ... import ndarray as nd
+    from ... import ops as _ops
+    from ..block import current_trace
+
+    F = nd if current_trace() is None else _ops
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, (list, tuple)):
+        batch = inputs[0].shape[batch_axis if batch_axis < axis else batch_axis - 1] \
+            if inputs[0].ndim > 1 else inputs[0].shape[0]
+        return F, list(inputs), inputs[0].shape[0]
+    batch = inputs.shape[batch_axis]
+    steps = [F.squeeze(F.slice_axis(inputs, axis, i, i + 1), axis=axis)
+             for i in range(length)]
+    return F, steps, batch
+
+
+def _mask_sequence_variable_length(F, outputs, length, valid_length, time_axis,
+                                   merge):
+    stacked = F.stack(*outputs, axis=0)
+    masked = F.SequenceMask(stacked, sequence_length=valid_length,
+                            use_sequence_length=True, axis=0)
+    return [F.squeeze(F.slice_axis(masked, 0, i, i + 1), axis=0)
+            for i in range(length)]

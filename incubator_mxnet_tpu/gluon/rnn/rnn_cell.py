@@ -1,0 +1,457 @@
+"""Recurrent cells + wrappers.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_cell.py (RNNCell, LSTMCell,
+GRUCell, SequentialRNNCell, HybridSequentialRNNCell, DropoutCell,
+ZoneoutCell, ResidualCell, BidirectionalCell; unroll/begin_state API).
+"""
+
+from ..block import Block, HybridBlock, current_trace
+from .basic_helpers import _format_sequence, _mask_sequence_variable_length
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(Block):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if hasattr(cell, "reset"):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called directly."
+        from ...ndarray import zeros as nd_zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            if func is None:
+                states.append(nd_zeros(info["shape"]))
+            else:
+                kw = dict(kwargs)
+                states.append(func(shape=info["shape"], **kw))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell over ``length`` steps (reference: rnn_cell.unroll)."""
+        self.reset()
+        F, inputs, batch_size = _format_sequence(length, inputs, layout, False)
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch_size)
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [_select_by_length(F, all_states, valid_length, j)
+                      for j in range(len(states))]
+            outputs = _mask_sequence_variable_length(F, outputs, length,
+                                                     valid_length, 0, True)
+        if merge_outputs is None or merge_outputs:
+            outputs = F.stack(*outputs, axis=0 if layout == "TNC" else 1)
+        return outputs, states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return super().__call__(inputs, states)
+
+
+def _select_by_length(F, all_states, valid_length, j):
+    # gather per-example final state at t = valid_length-1
+    stacked = F.stack(*[s[j] for s in all_states], axis=0)  # (T, ...)
+    idx = valid_length - 1
+    return F.SequenceLast(stacked, sequence_length=valid_length,
+                          use_sequence_length=True, axis=0)
+
+
+class HybridRecurrentCell(RecurrentCell, HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        RecurrentCell.__init__(self, prefix=prefix, params=params)
+
+    def forward(self, inputs, states):
+        if current_trace() is not None or not self._active:
+            return HybridBlock.forward(self, inputs, states)
+        return HybridBlock.forward(self, inputs, states)
+
+    def hybrid_forward(self, F, x, states, **kwargs):
+        raise NotImplementedError
+
+
+class RNNCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,), init=_i(i2h_bias_initializer),
+                allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,), init=_i(h2h_bias_initializer),
+                allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _shape_hook(self, x, *args):
+        self.i2h_weight.shape_inferred((self._hidden_size, x.shape[-1]))
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev = states[0] if isinstance(states, (list, tuple)) else states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        if self._activation in ("tanh", "relu", "sigmoid", "softrelu"):
+            output = F.Activation(i2h + h2h, act_type=self._activation)
+        else:
+            output = F.LeakyReLU(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """Gate order i, f, g, o (reference: rnn_cell.LSTMCell / cuDNN)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=_i(i2h_bias_initializer), allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=_i(h2h_bias_initializer), allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _shape_hook(self, x, *args):
+        self.i2h_weight.shape_inferred((4 * self._hidden_size, x.shape[-1]))
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h, prev_c = states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slice_gates = F.SliceChannel(gates, num_outputs=4, axis=-1)
+        in_gate = F.Activation(slice_gates[0], act_type="sigmoid")
+        forget_gate = F.Activation(slice_gates[1], act_type="sigmoid")
+        in_transform = F.Activation(slice_gates[2], act_type="tanh")
+        out_gate = F.Activation(slice_gates[3], act_type="sigmoid")
+        next_c = forget_gate * prev_c + in_gate * in_transform
+        next_h = out_gate * F.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """Gate order r, z, n (reference: rnn_cell.GRUCell / cuDNN)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=_i(i2h_bias_initializer), allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=_i(h2h_bias_initializer), allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _shape_hook(self, x, *args):
+        self.i2h_weight.shape_inferred((3 * self._hidden_size, x.shape[-1]))
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h = states[0] if isinstance(states, (list, tuple)) else states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = F.SliceChannel(i2h, num_outputs=3, axis=-1)
+        h2h_r, h2h_z, h2h_n = F.SliceChannel(h2h, num_outputs=3, axis=-1)
+        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = F.Activation(i2h_n + reset_gate * h2h_n, act_type="tanh")
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells applied in sequence each step."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return _cells_begin_state(self._children.values(), batch_size, func, **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def forward(self, *args):
+        raise NotImplementedError("use __call__")
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class HybridSequentialRNNCell(HybridRecurrentCell):
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return _cells_begin_state(self._children.values(), batch_size, func, **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(HybridRecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        from ..nn.basic_layers import _train_flag, _maybe_key
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes,
+                               training=_train_flag(), key=_maybe_key())
+        return inputs, states
+
+
+class ModifierCell(HybridRecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + "modifier_")
+        base_cell._modified = True
+        self.base_cell = base_cell
+        self.register_child(base_cell, "base_cell")
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        from ..nn.basic_layers import _train_flag, _maybe_key
+        next_output, next_states = self.base_cell(inputs, states)
+        if not _train_flag():
+            return next_output, next_states
+        import jax
+
+        def mask(p, like):
+            from ...ops import random as _rnd
+            key = _maybe_key()
+            if key is None:
+                key = _rnd.next_key()
+            shape = like.shape
+            import jax.numpy as jnp
+            keep = jax.random.bernoulli(key, 1 - p, shape)
+            if hasattr(like, "_data"):
+                from ...ndarray import NDArray
+                return NDArray(keep.astype(like._data.dtype))
+            return keep.astype(like.dtype)
+
+        prev_output = self._prev_output if self._prev_output is not None \
+            else next_output * 0
+        if self.zoneout_outputs > 0:
+            m = mask(self.zoneout_outputs, next_output)
+            output = F.where(m, next_output, prev_output)
+        else:
+            output = next_output
+        if self.zoneout_states > 0:
+            states = [F.where(mask(self.zoneout_states, ns), ns, s)
+                      for ns, s in zip(next_states, states)]
+        else:
+            states = next_states
+        self._prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return _cells_begin_state(self._children.values(), batch_size, func, **kwargs)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        F, inputs, batch_size = _format_sequence(length, inputs, layout, False)
+        begin_state = begin_state if begin_state is not None else \
+            self.begin_state(batch_size)
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        n_l = len(l_cell.state_info(batch_size))
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs, states[:n_l], layout, merge_outputs=False,
+            valid_length=valid_length)
+        rev_inputs = list(reversed(inputs))
+        r_outputs, r_states = r_cell.unroll(
+            length, rev_inputs, states[n_l:], layout, merge_outputs=False,
+            valid_length=valid_length)
+        r_outputs = list(reversed(r_outputs))
+        outputs = [F.Concat(l_o, r_o, dim=1)
+                   for l_o, r_o in zip(l_outputs, r_outputs)]
+        if merge_outputs is None or merge_outputs:
+            outputs = F.stack(*outputs, axis=0 if layout == "TNC" else 1)
+        return outputs, l_states + r_states
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, batch_size, func, **kwargs):
+    return sum([c.begin_state(batch_size, func, **kwargs) for c in cells], [])
+
+
+def _i(name_or_init):
+    if isinstance(name_or_init, str):
+        from ... import initializer as _init
+        return _init.create(name_or_init)
+    return name_or_init

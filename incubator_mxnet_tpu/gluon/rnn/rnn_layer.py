@@ -1,0 +1,259 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_layer.py:283-511 (cuDNN-fused
+RNN op with unfused fallback) per SURVEY §2.6. Parameter naming matches the
+reference ({l,r}{layer}_{i2h,h2h}_{weight,bias}) so checkpoints map 1:1.
+
+TPU-first: the "fused kernel" is ops.rnn.rnn_forward — a lax.scan whose
+input projections are hoisted into one big MXU matmul per layer (the
+reference's cuDNN descriptor path maps to XLA compiling the whole scan).
+"""
+
+import jax.numpy as jnp
+
+from ... import autograd as _ag
+from ...ndarray import NDArray
+from ...ndarray.ndarray import _invoke_simple
+from ...ops import rnn as _rnn_ops
+from ..block import HybridBlock, current_trace
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    self._register_param("%s%d_i2h_weight" % (j, i),
+                                         (ng * nh, ni), i2h_weight_initializer)
+                    self._register_param("%s%d_h2h_weight" % (j, i),
+                                         (ng * nh, nh), h2h_weight_initializer)
+                    self._register_param("%s%d_i2h_bias" % (j, i),
+                                         (ng * nh,), i2h_bias_initializer)
+                    self._register_param("%s%d_h2h_bias" % (j, i),
+                                         (ng * nh,), h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init, allow_deferred_init=True)
+        self._reg_params[name] = p
+        setattr(self, name, p)
+
+    def _shape_hook(self, inputs, *args):
+        ni = inputs.shape[2] if self._layout == "TNC" else inputs.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._reg_params["%s%d_i2h_weight" % (j, i)].shape_inferred(
+                    (ng * nh, ni))
+            ni = nh * self._dir
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ...ndarray import zeros as nd_zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(nd_zeros(info["shape"]) if func is None
+                          else func(shape=info["shape"], **kwargs))
+        return states
+
+    def _collect_layer_params(self, getter):
+        layers = []
+        for i in range(self._num_layers):
+            dirs = []
+            for j in ["l", "r"][:self._dir]:
+                dirs.append({
+                    "wx": getter("%s%d_i2h_weight" % (j, i)),
+                    "wh": getter("%s%d_h2h_weight" % (j, i)),
+                    "bx": getter("%s%d_i2h_bias" % (j, i)),
+                    "bh": getter("%s%d_h2h_bias" % (j, i)),
+                })
+            layers.append(dirs)
+        return layers
+
+    def forward(self, inputs, *state_args):
+        # accept forward(x), forward(x, [h, c]) or forward(x, h, c)
+        if len(state_args) == 1 and isinstance(state_args[0], (list, tuple)):
+            states = list(state_args[0])
+            packed = True
+        elif state_args:
+            states = list(state_args)
+            packed = False
+        else:
+            states, packed = None, True
+        ctx = current_trace()
+        skip_states = states is None
+        if ctx is not None:
+            return self._forward_traced(ctx, inputs, states, skip_states)
+        if self._active:
+            if skip_states:
+                return self._call_compiled(inputs)
+            out, new_states = self._call_compiled(inputs, *states)
+            return out, new_states if packed else tuple(new_states)
+        return self._forward_eager(inputs, states, skip_states)
+
+    # -- traced path (inside an XLA trace) -----------------------------------
+    def _forward_traced(self, ctx, inputs, states, skip_states):
+        layer_params = self._collect_layer_params(
+            lambda n: ctx.param_map[self._reg_params[n].name])
+        x = inputs
+        if self._layout == "NTC":
+            x = jnp.swapaxes(x, 0, 1)
+        batch = x.shape[1]
+        if skip_states:
+            states = self._zero_states_vals(batch, jnp)
+        out, h_n, c_n = _rnn_ops.rnn_forward(
+            x, layer_params,
+            states[0] if isinstance(states, (list, tuple)) else states,
+            states[1] if (self._mode == "lstm" and isinstance(states, (list, tuple))
+                          and len(states) > 1) else None,
+            mode=self._mode, bidirectional=self._dir == 2, p=self._dropout,
+            training=ctx.training,
+            key=ctx.take_key() if self._dropout > 0 else None)
+        if self._layout == "NTC":
+            out = jnp.swapaxes(out, 0, 1)
+        if skip_states:
+            return out
+        new_states = [h_n] + ([c_n] if self._mode == "lstm" else [])
+        return out, new_states
+
+    # -- eager path ----------------------------------------------------------
+    def _forward_eager(self, inputs, states, skip_states):
+        self._shape_hook(inputs)
+        batch = inputs.shape[1] if self._layout == "TNC" else inputs.shape[0]
+        if skip_states:
+            states = self.begin_state(batch)
+        if isinstance(states, NDArray):
+            states = [states]
+        names = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                names += ["%s%d_i2h_weight" % (j, i), "%s%d_h2h_weight" % (j, i),
+                          "%s%d_i2h_bias" % (j, i), "%s%d_h2h_bias" % (j, i)]
+        weight_arrays = [self._reg_params[n].data() for n in names]
+        n_states = len(states)
+        mode, layout, dirs, dropout = self._mode, self._layout, self._dir, self._dropout
+        training = _ag.is_training()
+        num_layers = self._num_layers
+
+        def fn(*vals):
+            x = vals[0]
+            sts = vals[1:1 + n_states]
+            ws = vals[1 + n_states:]
+            layers = []
+            k = 0
+            for _ in range(num_layers):
+                dd = []
+                for _ in range(dirs):
+                    dd.append({"wx": ws[k], "wh": ws[k + 1],
+                               "bx": ws[k + 2], "bh": ws[k + 3]})
+                    k += 4
+                layers.append(dd)
+            if layout == "NTC":
+                x = jnp.swapaxes(x, 0, 1)
+            out, h_n, c_n = _rnn_ops.rnn_forward(
+                x, layers, sts[0], sts[1] if mode == "lstm" and n_states > 1 else None,
+                mode=mode, bidirectional=dirs == 2, p=dropout, training=training)
+            if layout == "NTC":
+                out = jnp.swapaxes(out, 0, 1)
+            outs = (out, h_n)
+            if c_n is not None:
+                outs = outs + (c_n,)
+            return outs
+
+        result = _invoke_simple(fn, inputs, *states, *weight_arrays,
+                                op_name="RNN(%s)" % self._mode)
+        out = result[0]
+        if skip_states:
+            return out
+        new_states = list(result[1:])
+        return out, new_states
+
+    def _zero_states_vals(self, batch, xp):
+        shape = (self._num_layers * self._dir, batch, self._hidden_size)
+        if self._mode == "lstm":
+            return [xp.zeros(shape), xp.zeros(shape)]
+        return [xp.zeros(shape)]
+
+    def __repr__(self):
+        return "%s(%s, %s layers, hidden=%s%s)" % (
+            type(self).__name__, self._mode, self._num_layers,
+            self._hidden_size, ", bidirectional" if self._dir == 2 else "")
+
+
+class RNN(_RNNLayer):
+    """Vanilla (Elman) multi-layer RNN with relu/tanh activation."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, _i(i2h_bias_initializer),
+                         _i(h2h_bias_initializer), "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, _i(i2h_bias_initializer),
+                         _i(h2h_bias_initializer), "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, _i(i2h_bias_initializer),
+                         _i(h2h_bias_initializer), "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+def _i(name_or_init):
+    if isinstance(name_or_init, str):
+        from ... import initializer as _init
+        return _init.create(name_or_init)
+    return name_or_init

@@ -1,0 +1,82 @@
+"""gluon.utils (reference: python/mxnet/gluon/utils.py): batch splitting,
+global-norm clipping, download helper."""
+
+import hashlib
+import os
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d" % (str(data.shape), num_slice, batch_axis))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end)
+                      if isinstance(data, NDArray)
+                      else data[begin:end])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        from ..ndarray import array
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the joint 2-norm is <= max_norm."""
+    assert len(arrays) > 0
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data)) for a in arrays))
+    total_f = float(total)
+    if check_isfinite and not _np.isfinite(total_f):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._data = arr._data * scale
+    return total_f if check_isfinite else NDArray(total)
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file (reference: gluon.utils.download). Zero-egress
+    environments will raise; kept for API parity."""
+    fname = path if path and not os.path.isdir(path) else os.path.join(
+        path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    import urllib.request
+    os.makedirs(os.path.dirname(os.path.abspath(fname)), exist_ok=True)
+    urllib.request.urlretrieve(url, fname)
+    return fname

@@ -1,0 +1,111 @@
+"""mx.image — imperative image API.
+
+Reference parity: python/mxnet/image/image.py (imdecode/imread/imresize,
+fixed/random croppers, color normalize, ImageIter) per SURVEY §2.5.
+Decoding uses cv2 when present; .npy arrays always work (zero-egress env).
+"""
+
+import os
+
+import numpy as _np
+
+from ..ndarray import NDArray, array as nd_array
+from ..ndarray.ndarray import _invoke_op
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "ImageIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    try:
+        import cv2
+        img = cv2.imdecode(_np.frombuffer(bytes(buf), dtype=_np.uint8), flag)
+        if to_rgb and img is not None and img.ndim == 3:
+            img = img[:, :, ::-1]
+        return nd_array(_np.ascontiguousarray(img))
+    except ImportError:
+        raise ImportError("cv2 is required to decode compressed images; "
+                          "use .npy inputs in this environment")
+
+
+def imread(filename, flag=1, to_rgb=True):
+    if filename.endswith(".npy"):
+        return nd_array(_np.load(filename))
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    return _invoke_op("image_resize", (src if isinstance(src, NDArray) else nd_array(src),),
+                      {"size": (w, h)})
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = _invoke_op("image_crop", (src,), {"x": x0, "y": y0, "width": w, "height": h})
+    if size is not None:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size if isinstance(size, (list, tuple)) else (size, size)
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h)), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size if isinstance(size, (list, tuple)) else (size, size)
+    x0 = _np.random.randint(0, max(w - new_w, 0) + 1)
+    y0 = _np.random.randint(0, max(h - new_h, 0) + 1)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h)), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class ImageIter:
+    """Python-side image iterator over .rec or .lst (reference: image.py
+    ImageIter). Thin wrapper over io.ImageRecordIter here."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None, **kwargs):
+        from ..io import ImageRecordIter
+        if path_imgrec is None:
+            raise ValueError("ImageIter requires path_imgrec in this build")
+        self._inner = ImageRecordIter(path_imgrec=path_imgrec,
+                                      data_shape=data_shape,
+                                      batch_size=batch_size, **kwargs)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._inner.next()
+
+    next = __next__
+
+    def reset(self):
+        self._inner.reset()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label

@@ -1,0 +1,16 @@
+"""mx.io — data iterators.
+
+Reference parity: python/mxnet/io/io.py (DataDesc, DataBatch, DataIter,
+NDArrayIter, ResizeIter, PrefetchingIter) + the C++ iterators MNISTIter/
+CSVIter/ImageRecordIter (src/io/*) per SURVEY §2.5. The C++-backed iterators
+are exposed as Python classes over the same file formats; decode+augment
+threads of the reference's ImageRecordIter map to DataLoader workers.
+"""
+
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter,
+                 LibSVMIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]

@@ -1,0 +1,413 @@
+"""Data iterators (see package docstring)."""
+
+import os
+import threading
+import queue as _queue
+
+import numpy as _np
+
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]
+
+
+class DataDesc:
+    def __init__(self, name, shape, dtype=_np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (reference: io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py NDArrayIter; supports
+    shuffle, pad/discard/roll_over last batch)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        self.cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        if self.cursor + self.batch_size <= self.num_data:
+            sel = self.idx[self.cursor:self.cursor + self.batch_size]
+        else:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            pad = self.batch_size - (self.num_data - self.cursor)
+            sel = _np.concatenate([self.idx[self.cursor:],
+                                   self.idx[:pad]])
+        return [nd_array(v[sel]) for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        assert allow_empty
+        return []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {default_name if len(data) == 1 else "_%d_%s" % (i, default_name): d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        v = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+        out.append((k, v))
+    return out
+
+
+class ResizeIter(DataIter):
+    """Resize another iterator to a fixed number of batches per epoch."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference: PrefetchingIter; the engine-
+    independent double-buffer thread of the C++ prefetcher)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        self.iters = iters
+        super().__init__(iters[0].batch_size)
+        self._queue = _queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batches = [i.next() for i in self.iters]
+                    self._queue.put(("ok", batches))
+                except StopIteration:
+                    self._queue.put(("stop", None))
+                    return
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    def reset(self):
+        self._stop.set()
+        try:
+            self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for i in self.iters:
+            i.reset()
+        self._stop.clear()
+        self._queue = _queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        status, batches = self._queue.get()
+        if status == "stop":
+            raise StopIteration
+        if len(batches) == 1:
+            return batches[0]
+        return DataBatch(data=sum([(b.data or []) for b in batches], []),
+                         label=sum([(b.label or []) for b in batches], []),
+                         pad=max(b.pad or 0 for b in batches))
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (reference: src/io/iter_csv.cc:218)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size,
+                         last_batch_handle="pad" if round_batch else "discard")
+
+
+class LibSVMIter(DataIter):
+    """LibSVM sparse format iterator (reference: iter_libsvm.cc:200).
+    Yields dense batches (CSR kept host-side)."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=(1,), batch_size=1,
+                 **kwargs):
+        super().__init__(batch_size)
+        n_col = int(_np.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = _np.zeros(n_col, dtype=_np.float32)
+                for tok in parts[1:]:
+                    k, v = tok.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        self._inner = NDArrayIter(_np.asarray(rows).reshape((-1,) + tuple(data_shape)),
+                                  _np.asarray(labels), batch_size)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-ubyte iterator (reference: src/io/iter_mnist.cc:260)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=None, input_shape=None, **kwargs):
+        import gzip
+        import struct as _struct
+
+        def _open(p):
+            return gzip.open(p, "rb") if p.endswith(".gz") else open(p, "rb")
+
+        with _open(label) as f:
+            _struct.unpack(">II", f.read(8))
+            lbl = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.float32)
+        with _open(image) as f:
+            _, num, rows, cols = _struct.unpack(">IIII", f.read(16))
+            img = _np.frombuffer(f.read(), dtype=_np.uint8)
+            img = img.reshape(num, 1, rows, cols).astype(_np.float32) / 255.0
+        if flat:
+            img = img.reshape(num, rows * cols)
+        super().__init__(img, lbl, batch_size, shuffle=shuffle)
+
+
+class ImageRecordIter(DataIter):
+    """Image RecordIO iterator with decode+augment in worker threads
+    (reference: src/io/iter_image_recordio_2.cc ImageRecordIter)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 rand_crop=False, rand_mirror=False, preprocess_threads=4,
+                 round_batch=True, label_width=1, **kwargs):
+        super().__init__(batch_size)
+        from ..gluon.data.vision.datasets import ImageRecordDataset
+        from ..gluon.data import DataLoader
+        self._data_shape = tuple(data_shape)
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
+        self._std = _np.array([std_r, std_g, std_b], dtype=_np.float32)
+        dataset = ImageRecordDataset(path_imgrec)
+        c, h, w = self._data_shape
+
+        def transform(img, label):
+            img = _np.asarray(img, dtype=_np.float32)
+            if img.ndim == 2:
+                img = img[:, :, None]
+            H, W = img.shape[:2]
+            if self._rand_crop and H > h and W > w:
+                y0 = _np.random.randint(0, H - h + 1)
+                x0 = _np.random.randint(0, W - w + 1)
+            else:
+                y0, x0 = max((H - h) // 2, 0), max((W - w) // 2, 0)
+            img = img[y0:y0 + h, x0:x0 + w]
+            if self._rand_mirror and _np.random.rand() < 0.5:
+                img = img[:, ::-1]
+            img = (img - self._mean) / self._std
+            return _np.ascontiguousarray(img.transpose(2, 0, 1)), _np.float32(label)
+
+        self._loader = DataLoader(dataset.transform(transform), batch_size,
+                                  shuffle=shuffle, num_workers=0,
+                                  last_batch="discard" if not round_batch else "rollover")
+        self._it = iter(self._loader)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._it = iter(self._loader)
+
+    def next(self):
+        try:
+            data, label = next(self._it)
+        except StopIteration:
+            raise
+        return DataBatch(data=[data], label=[label], pad=0)

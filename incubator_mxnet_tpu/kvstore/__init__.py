@@ -1,0 +1,5 @@
+from .kvstore import KVStore, KVStoreLocal, KVStoreDevice, create
+from .compression import GradientCompression
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDevice", "create",
+           "GradientCompression"]

@@ -1,0 +1,161 @@
+"""KVStore — key/tensor parameter synchronization.
+
+Reference parity: include/mxnet/kvstore.h + src/kvstore/kvstore_local.h /
+comm.h (types 'local'/'device': single-process multi-device reduce +
+broadcast; user Updater run store-side; string or int keys; row_sparse pull;
+gradient compression) per SURVEY §2.4.
+
+TPU-first: a single process drives all local chips through the XLA client,
+so 'local'/'device' reduce is a jitted sum (XLA emits one fused reduction;
+cross-device all-reduce inside pjit-ed steps is the mx.parallel path and
+needs no kvstore at all). The 'dist_*' parameter-server modes over gRPC/DCN
+keep this same interface (kvstore/dist.py).
+"""
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from .. import optimizer as opt
+from .compression import GradientCompression
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDevice", "create"]
+
+
+def create(name="local"):
+    """Factory (reference: kvstore.cc:40-72)."""
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu"):
+        return KVStoreLocal("local")
+    if name in ("device", "local_allreduce_device", "nccl"):
+        return KVStoreDevice("device")
+    if name.startswith("dist"):
+        from .dist import create_dist
+        return create_dist(name)
+    raise ValueError("unknown kvstore type %r" % name)
+
+
+class KVStore:
+    """Single-process store; base of local/device."""
+
+    def __init__(self, name="local"):
+        self._type = name
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._opt_updater = None
+        self._compression = None
+        self._str_keys = {}
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def is_dist(self):
+        return False
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    # -- config --------------------------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        self._compression = GradientCompression(**compression_params)
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._opt_updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    # -- data plane ----------------------------------------------------------
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        self._store[key] = NDArray(value._data)
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        if isinstance(value, (list, tuple)):
+            agg = value[0]._data
+            for v in value[1:]:
+                agg = agg + v._data
+        else:
+            agg = value._data
+        if self._compression is not None:
+            agg = self._compression.compress(key, agg)
+        if self._optimizer is not None:
+            # server-side update: stored value is the weight
+            weight = self._store[key]
+            self._opt_updater(key, NDArray(agg), weight)
+        elif self._updater is not None:
+            if key not in self._store:
+                self._store[key] = NDArray(jnp.zeros_like(agg))
+            self._updater(key, NDArray(agg), self._store[key])
+        else:
+            self._store[key] = NDArray(agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, out=o, priority=priority)
+            return
+        value = self._store[key]
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                o._data = value._data
+            return
+        return NDArray(value._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out=out if out is not None else value, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference: PullRowSparse)."""
+        value = self._store[key]
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        rids = row_ids.asnumpy().astype("int32") if hasattr(row_ids, "asnumpy") else row_ids
+        rows = value._data[jnp.asarray(rids)]
+        full = jnp.zeros_like(value._data).at[jnp.asarray(rids)].set(rows)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._data = full
+        return out
+
+    # -- persistence ---------------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._opt_updater is None:
+            raise ValueError("Cannot save states for distributed training")
+        with open(fname, "wb") as f:
+            f.write(self._opt_updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._opt_updater.set_states(f.read())
+
+
+class KVStoreLocal(KVStore):
+    """CPU-reduce variant (reference: CommCPU). Same XLA path here."""
+
+
+class KVStoreDevice(KVStore):
+    """Device-reduce variant (reference: CommDevice P2P / NCCL). With one XLA
+    client the reduce already runs on-device; multi-chip in-step all-reduce is
+    mx.parallel's pjit path."""

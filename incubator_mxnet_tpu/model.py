@@ -1,0 +1,33 @@
+"""Checkpoint helpers (reference: python/mxnet/model.py —
+save_checkpoint/load_checkpoint writing -symbol.json + -%04d.params)."""
+
+from .ndarray import save as nd_save, load as nd_load
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    import os
+    from .symbol import load as sym_load
+    symbol = None
+    if os.path.exists("%s-symbol.json" % prefix):
+        symbol = sym_load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1) if ":" in k else ("arg", k)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

@@ -1,0 +1,8 @@
+"""Model families (BASELINE configs + model-zoo re-exports)."""
+
+from .lenet import lenet5, mlp
+from .lstm_lm import RNNModel, lstm_lm_ptb
+from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
+                   bert_sharding_rules, MultiHeadAttention,
+                   TransformerEncoderLayer, BERTEncoder)
+from ..gluon.model_zoo.vision import get_model  # noqa: F401

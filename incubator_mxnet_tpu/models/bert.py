@@ -1,0 +1,187 @@
+"""BERT — the flagship transformer (BASELINE config 4: "BERT-base from
+GluonNLP (HybridBlock -> XLA, multi-host KVStore)").
+
+Built from this framework's gluon layers; hybridizes to one XLA program.
+TPU-first: attention runs in bfloat16-friendly einsum form on the MXU;
+sequence-parallel long-context uses mx.parallel.ring_attention; tensor
+parallelism comes from ShardedTrainer rules (bert_sharding_rules below).
+"""
+
+import math
+
+from ..gluon.block import HybridBlock, current_trace
+from ..gluon import nn
+
+__all__ = ["BERTModel", "BERTEncoder", "TransformerEncoderLayer",
+           "MultiHeadAttention", "bert_base", "bert_large",
+           "bert_sharding_rules", "BERTForPretrain"]
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        with self.name_scope():
+            self.query = nn.Dense(units, flatten=False, prefix="query_")
+            self.key = nn.Dense(units, flatten=False, prefix="key_")
+            self.value = nn.Dense(units, flatten=False, prefix="value_")
+            self.proj = nn.Dense(units, flatten=False, prefix="proj_")
+            self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        B = x.shape[0]
+        T = x.shape[1]
+        H = self._num_heads
+        D = self._units // H
+        q = F.reshape(self.query(x), shape=(B, T, H, D))
+        k = F.reshape(self.key(x), shape=(B, T, H, D))
+        v = F.reshape(self.value(x), shape=(B, T, H, D))
+        q = F.transpose(q, axes=(0, 2, 1, 3))   # (B,H,T,D)
+        k = F.transpose(k, axes=(0, 2, 1, 3))
+        v = F.transpose(v, axes=(0, 2, 1, 3))
+        scores = F.batch_dot(q, k, transpose_b=True) * (1.0 / math.sqrt(D))
+        if mask is not None:
+            # mask: (B, T) with 1 for valid tokens
+            neg = (1.0 - F.reshape(mask, shape=(B, 1, 1, T))) * -1e30
+            scores = scores + neg
+        attn = F.softmax(scores, axis=-1)
+        attn = self.dropout(attn)
+        out = F.batch_dot(attn, v)              # (B,H,T,D)
+        out = F.transpose(out, axes=(0, 2, 1, 3))
+        out = F.reshape(out, shape=(B, T, self._units))
+        return self.proj(out)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = nn.Dense(hidden_size, flatten=False, prefix="ffn1_")
+            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
+            self.dropout = nn.Dropout(dropout)
+            self._activation = activation
+
+    def hybrid_forward(self, F, x):
+        h = self.ffn_1(x)
+        h = F.LeakyReLU(h, act_type="gelu") if self._activation == "gelu" \
+            else F.Activation(h, act_type=self._activation)
+        return self.dropout(self.ffn_2(h))
+
+
+class TransformerEncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads, dropout,
+                                                prefix="attn_")
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout, prefix="ffn_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+            self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        h = self.ln1(x + self.dropout(self.attention(x, mask)))
+        return self.ln2(h + self.ffn(h))
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="layers_")
+            for i in range(num_layers):
+                self.layers.add(TransformerEncoderLayer(
+                    units, hidden_size, num_heads, dropout,
+                    prefix="layer%d_" % i))
+
+    def hybrid_forward(self, F, x, mask=None):
+        for layer in self.layers._children.values():
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Token+segment+position embeddings -> encoder -> (sequence, pooled)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 token_type_vocab=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units, prefix="word_")
+            self.token_type_embed = nn.Embedding(token_type_vocab, units,
+                                                 prefix="type_")
+            self.position_embed = nn.Embedding(max_length, units, prefix="pos_")
+            self.embed_ln = nn.LayerNorm(prefix="embln_")
+            self.embed_dropout = nn.Dropout(dropout)
+            self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                       num_heads, dropout, prefix="enc_")
+            self.pooler = nn.Dense(units, activation="tanh", flatten=False,
+                                   prefix="pooler_")
+
+    def hybrid_forward(self, F, token_ids, token_types=None, valid_mask=None):
+        T = token_ids.shape[-1]
+        positions = F.arange(0, T, dtype="int32")
+        x = self.word_embed(token_ids)
+        x = x + self.position_embed(positions)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = self.embed_dropout(self.embed_ln(x))
+        seq = self.encoder(x, valid_mask)
+        pooled = self.pooler(F.slice_axis(seq, axis=1, begin=0, end=1)
+                             .reshape((token_ids.shape[0], self._units)))
+        return seq, pooled
+
+
+class BERTForPretrain(HybridBlock):
+    """MLM + NSP heads over BERTModel (the benchmarked training config)."""
+
+    def __init__(self, bert=None, vocab_size=30522, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.bert = bert or BERTModel(vocab_size=vocab_size, **{})
+            self.mlm_dense = nn.Dense(self.bert._units, activation="tanh",
+                                      flatten=False, prefix="mlmd_")
+            self.mlm_ln = nn.LayerNorm(prefix="mlmln_")
+            self.mlm_decoder = nn.Dense(vocab_size, flatten=False,
+                                        prefix="decoder_")
+            self.nsp = nn.Dense(2, prefix="nsp_")
+
+    def hybrid_forward(self, F, token_ids, token_types=None, valid_mask=None):
+        seq, pooled = self.bert(token_ids, token_types, valid_mask)
+        mlm = self.mlm_decoder(self.mlm_ln(self.mlm_dense(seq)))
+        nsp = self.nsp(pooled)
+        return mlm, nsp
+
+
+def bert_base(vocab_size=30522, dropout=0.1, **kwargs):
+    return BERTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
+                     num_layers=12, num_heads=12, dropout=dropout, **kwargs)
+
+
+def bert_large(vocab_size=30522, dropout=0.1, **kwargs):
+    return BERTModel(vocab_size=vocab_size, units=1024, hidden_size=4096,
+                     num_layers=24, num_heads=16, dropout=dropout, **kwargs)
+
+
+def bert_sharding_rules(tp_axis="tp"):
+    """Megatron-style tensor-parallel PartitionSpecs for ShardedTrainer:
+    QKV/ffn1 column-parallel (shard output dim), proj/ffn2 row-parallel
+    (shard input dim), embeddings sharded on vocab/hidden."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"(query|key|value)_weight$", P(tp_axis, None)),
+        (r"ffn1_weight$", P(tp_axis, None)),
+        (r"proj_weight$", P(None, tp_axis)),
+        (r"ffn2_weight$", P(None, tp_axis)),
+        (r"(query|key|value)_bias$", P(tp_axis)),
+        (r"ffn1_bias$", P(tp_axis)),
+        (r"word_weight$", P(tp_axis, None)),
+        (r"decoder_weight$", P(tp_axis, None)),
+        (r"decoder_bias$", P(tp_axis)),
+    ]

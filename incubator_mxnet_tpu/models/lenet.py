@@ -1,0 +1,28 @@
+"""LeNet-5 and a small MLP (BASELINE config 1: LeNet-5 on MNIST via Gluon)."""
+
+from ..gluon import nn
+
+__all__ = ["lenet5", "mlp"]
+
+
+def lenet5(classes=10, **kwargs):
+    net = nn.HybridSequential(**kwargs)
+    with net.name_scope():
+        net.add(nn.Conv2D(channels=6, kernel_size=5, padding=2, activation="tanh"))
+        net.add(nn.AvgPool2D(pool_size=2, strides=2))
+        net.add(nn.Conv2D(channels=16, kernel_size=5, activation="tanh"))
+        net.add(nn.AvgPool2D(pool_size=2, strides=2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(120, activation="tanh"))
+        net.add(nn.Dense(84, activation="tanh"))
+        net.add(nn.Dense(classes))
+    return net
+
+
+def mlp(classes=10, hidden=(128, 64), **kwargs):
+    net = nn.HybridSequential(**kwargs)
+    with net.name_scope():
+        for h in hidden:
+            net.add(nn.Dense(h, activation="relu"))
+        net.add(nn.Dense(classes))
+    return net

@@ -1,0 +1,59 @@
+"""Word-level LSTM language model (BASELINE config 3: PTB LSTM,
+reference example/rnn/word_lm — 650 hidden, tied embedding, dropout 0.5,
+target test perplexity 44.26)."""
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn, rnn
+
+__all__ = ["RNNModel", "lstm_lm_ptb"]
+
+
+class RNNModel(HybridBlock):
+    def __init__(self, mode="lstm", vocab_size=10000, num_embed=650,
+                 num_hidden=650, num_layers=2, dropout=0.5, tie_weights=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._num_hidden = num_hidden
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, num_embed,
+                                        weight_initializer=None,
+                                        prefix="embed_")
+            if mode == "lstm":
+                self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                    input_size=num_embed, prefix="rnn_")
+            elif mode == "gru":
+                self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed, prefix="rnn_")
+            else:
+                self.rnn = rnn.RNN(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed, prefix="rnn_")
+            if tie_weights:
+                assert num_embed == num_hidden, \
+                    "tied embedding requires num_embed == num_hidden"
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        params=self.encoder.params,
+                                        prefix="embed_")
+            else:
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        prefix="decoder_")
+
+    def begin_state(self, batch_size):
+        return self.rnn.begin_state(batch_size)
+
+    def forward(self, inputs, states):
+        """inputs: (T, N) int tokens; returns (logits (T,N,V), states)."""
+        emb = self.drop(self.encoder(inputs))
+        output, states = self.rnn(emb, states)
+        output = self.drop(output)
+        decoded = self.decoder(output)
+        return decoded, states
+
+    def hybrid_forward(self, F, inputs, *states):
+        return self.forward(inputs, list(states))
+
+
+def lstm_lm_ptb(**kwargs):
+    return RNNModel(mode="lstm", vocab_size=10000, num_embed=650,
+                    num_hidden=650, num_layers=2, dropout=0.5,
+                    tie_weights=True, **kwargs)

@@ -1,0 +1,113 @@
+"""BucketingModule — variable-length sequences via per-bucket executors.
+
+Reference parity: python/mxnet/module/bucketing_module.py (per-bucket
+Modules sharing parameters; default_bucket_key; switch per batch). On TPU
+each bucket is its own XLA-compiled program (shape specialization), and
+parameters are shared through the same NDArray buffers.
+"""
+
+import logging
+
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._init_args = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    def _gen_module(self, bucket_key):
+        if bucket_key in self._buckets:
+            return self._buckets[bucket_key]
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        module = Module(sym, data_names, label_names, self.logger,
+                        self._context, **self._kwargs)
+        self._buckets[bucket_key] = module
+        return module
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        self.for_training = for_training
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, force_rebind, None, grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        module = self._gen_module(bucket_key)
+        if not module.binded:
+            module.bind(data_shapes, label_shapes, self.for_training)
+            if self.params_initialized:
+                arg, aux = self._buckets[self._default_bucket_key].get_params()
+                module.init_params(arg_params=arg, aux_params=aux,
+                                   force_init=True, allow_missing=False)
+            if self._buckets[self._default_bucket_key].optimizer_initialized:
+                base = self._buckets[self._default_bucket_key]
+                module._optimizer = base._optimizer
+                module._updater = base._updater
+                module.optimizer_initialized = True
+        else:
+            # share latest parameters
+            arg, aux = self._curr_module.get_params()
+            module.init_params(arg_params=arg, aux_params=aux,
+                               force_init=True, allow_missing=False)
+        self._curr_module = module
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, **kwargs):
+        assert self.binded
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        assert self.binded and self.params_initialized
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = getattr(data_batch, "bucket_key", None) or \
+            self._default_bucket_key
+        if bucket_key != self._curr_bucket_key:
+            self.switch_bucket(bucket_key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        # propagate updated params to default bucket view (shared buffers)
+        if self._curr_bucket_key != self._default_bucket_key:
+            arg, aux = self._curr_module.get_params()
+            self._buckets[self._default_bucket_key].init_params(
+                arg_params=arg, aux_params=aux, force_init=True)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)

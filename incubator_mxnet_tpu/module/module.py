@@ -1,0 +1,184 @@
+"""Module — single-symbol trainable module.
+
+Reference parity: python/mxnet/module/module.py (bind/init_params/
+init_optimizer/forward/backward/update/get_outputs, save/load_checkpoint
+interplay) per SURVEY §2.6.
+"""
+
+import logging
+
+from .base_module import BaseModule
+from ..ndarray import NDArray, zeros as nd_zeros
+from .. import optimizer as opt
+from .. import initializer as _initmod
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self.symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._context = context
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._arg_params = None
+        self._aux_params = None
+        self._grad_req = "write"
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self._grad_req = grad_req
+        shape_feed = {}
+        for desc in data_shapes:
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") else desc
+            shape_feed[name] = shape
+        if label_shapes:
+            for desc in label_shapes:
+                name, shape = (desc.name, desc.shape) if hasattr(desc, "name") else desc
+                shape_feed[name] = shape
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_with_partial(**shape_feed) \
+            if hasattr(self._symbol, "infer_shape_with_partial") else \
+            self._symbol.infer_shape(**{k: v for k, v in shape_feed.items()
+                                        if k in arg_names})
+        if arg_shapes is None:
+            raise ValueError("shape inference failed; provide full input shapes")
+        args, grads = [], []
+        shape_of = dict(zip(arg_names, arg_shapes))
+        for name in arg_names:
+            if name in shape_feed:
+                shape_of[name] = shape_feed[name]
+            arr = nd_zeros(shape_of[name])
+            args.append(arr)
+            is_input = name in self._data_names or name in self._label_names
+            req = "null" if (is_input or name in self._fixed_param_names) \
+                else grad_req
+            grads.append(nd_zeros(shape_of[name]) if req != "null" else None)
+        aux = [nd_zeros(s) for s in aux_shapes]
+        self._exec = self._symbol.bind(None, dict(zip(arg_names, args)),
+                                       dict(zip(arg_names, grads)),
+                                       {n: ("null" if (n in self._data_names
+                                                       or n in self._label_names
+                                                       or n in self._fixed_param_names)
+                                            else grad_req) for n in arg_names},
+                                       aux)
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        initializer = initializer or _initmod.Uniform(0.01)
+        for name, arr in self._exec.arg_dict.items():
+            if name in self._data_names or name in self._label_names:
+                continue
+            if arg_params is not None and name in arg_params:
+                arr._data = arg_params[name]._data
+            else:
+                initializer(_initmod.InitDesc(name), arr)
+        for name, arr in self._exec.aux_dict.items():
+            if aux_params is not None and name in aux_params:
+                arr._data = aux_params[name]._data
+            else:
+                initializer(_initmod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        assert self.binded and self.params_initialized
+        if isinstance(optimizer, str):
+            optimizer = opt.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        for i, name in enumerate(self._symbol.list_arguments()):
+            if name in self._data_names or name in self._label_names or \
+                    name in self._fixed_param_names:
+                continue
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def get_params(self):
+        arg = {n: a for n, a in self._exec.arg_dict.items()
+               if n not in self._data_names and n not in self._label_names}
+        aux = dict(self._exec.aux_dict)
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(None, arg_params, aux_params, allow_missing,
+                         force_init=True)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self._exec.outputs)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (args, auxs)
+        return mod

@@ -1,0 +1,71 @@
+"""mx.monitor.Monitor — tap intermediate outputs for NaN hunting / stats.
+
+Reference parity: python/mxnet/monitor.py (Monitor installing an executor
+output callback; stat_func defaults to |x|/size). Here it hooks Gluon blocks
+via forward hooks (the executor-monitor path of the reference maps to block
+hooks, since XLA owns the compiled graph internals).
+"""
+
+import logging
+import re
+
+import numpy as _np
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return _np.abs(x).sum() / x.size
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self._handles = []
+
+    def install(self, block):
+        """Attach to a gluon Block tree (monitor every child output)."""
+        def hook(blk, inputs, output):
+            if not self.activated:
+                return
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                if hasattr(o, "asnumpy") and self.re_prog.match(blk.name):
+                    self.queue.append((self.step, "%s_output%d" % (blk.name, i),
+                                       self.stat_func(o.asnumpy())))
+
+        def walk(b):
+            b.register_forward_hook(hook)
+            for c in b._children.values():
+                walk(c)
+        walk(block)
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = list(self.queue)
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v_list in res:
+            logging.info("Batch: %7d %30s %s", n, k, str(v_list))
+        return res

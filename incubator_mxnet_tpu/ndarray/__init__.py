@@ -1,0 +1,27 @@
+"""Imperative NDArray frontend (the ``mx.nd`` namespace).
+
+Reference parity: python/mxnet/ndarray/ndarray.py (4.1k LoC NDArray class,
+indexing, dunders, asnumpy/astype/copyto, attach_grad/backward) plus the
+auto-generated per-op functions (python/mxnet/ndarray/register.py) per
+SURVEY §2.6. Here op functions are generated from the ops registry instead of
+querying a C ABI; eager execution is jax on-device with tape autograd.
+"""
+
+from .ndarray import (NDArray, array, empty, zeros, ones, full, arange, eye,
+                      concatenate, save, load, waitall, imperative_invoke,
+                      from_jax, onehot_encode)
+from . import random  # noqa: F401
+from . import sparse  # noqa: F401
+from .register import _init_op_functions
+
+_init_op_functions(globals())
+
+
+def __getattr__(name):
+    # late lookup so newly registered ops (custom ops) resolve too
+    from .register import make_op_func
+    from ..ops.registry import get_op
+    try:
+        return make_op_func(get_op(name))
+    except KeyError:
+        raise AttributeError("mx.nd has no op %r" % name)

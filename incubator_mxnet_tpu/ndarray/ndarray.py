@@ -1,0 +1,607 @@
+"""The NDArray class: eager on-device tensor with tape autograd.
+
+Reference parity: include/mxnet/ndarray.h + python/mxnet/ndarray/ndarray.py.
+TPU-first: wraps a ``jax.Array`` — storage, async dispatch and device order
+come from the XLA runtime (the reference's dependency engine + storage pool
+are subsumed; ``wait_to_read`` maps to ``block_until_ready``).
+"""
+
+import builtins
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..context import Context, current_context
+from .. import autograd as _ag
+from ..ops.registry import get_op
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "eye", "concatenate", "save", "load", "waitall", "from_jax",
+           "imperative_invoke", "onehot_encode"]
+
+
+def _ctx_of(data):
+    try:
+        dev = list(data.devices())[0]
+    except Exception:
+        return current_context()
+    plat = dev.platform
+    return Context("cpu" if plat == "cpu" else "tpu", dev.id)
+
+
+def _to_device(val, ctx):
+    if ctx is None:
+        return val
+    return jax.device_put(val, ctx.jax_device)
+
+
+class NDArray:
+    """An n-dimensional on-device array with lazy (async) execution."""
+
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = _to_device(data, ctx) if ctx is not None else data
+        self._node = None        # TapeNode that produced this array
+        self._out_index = 0      # which output slot of that node
+        self._grad = None        # NDArray gradient buffer (leaf only)
+        self._grad_req = None
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 \
+            else self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return _ctx_of(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return transpose_helper(self)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            _np.asarray(self._data),
+            "x".join(str(s) for s in self.shape), self.context)
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous.")
+        return bool(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # ---------------------------------------------------------------- export
+    def asnumpy(self):
+        """Block and copy to a numpy array (reference: WaitToRead + copy)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def astype(self, dtype, copy=True):
+        return _invoke_simple(lambda x: x.astype(jnp.dtype(dtype) if dtype != "bfloat16"
+                                                 else jnp.bfloat16), self)
+
+    def copy(self):
+        return NDArray(self._data)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other._data.devices().pop())
+            return other
+        if isinstance(other, Context):
+            return NDArray(self._data, ctx=other)
+        raise TypeError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        return NDArray(self._data, ctx=context)
+
+    as_in_ctx = as_in_context
+
+    def to_dlpack_for_read(self):
+        return jax.dlpack.to_dlpack(self._data)
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # ------------------------------------------------------------- autograd
+    def _requires_tape(self):
+        return self._node is not None or (self._grad_req not in (None, "null"))
+
+    def attach_grad(self, grad_req="write", stype=None):
+        """Mark as autograd leaf with a zero-initialized gradient buffer."""
+        self._mark_variable(None, grad_req)
+
+    def _mark_variable(self, grad, grad_req):
+        self._node = None
+        self._grad_req = grad_req
+        if grad_req == "null":
+            self._grad = None
+        else:
+            self._grad = grad if grad is not None else NDArray(jnp.zeros(self.shape, self._data.dtype))
+
+    def _accumulate_grad(self, ct):
+        if self._grad_req == "add":
+            self._grad._data = self._grad._data + ct.astype(self._grad._data.dtype)
+        else:
+            self._grad._data = ct.astype(self._grad._data.dtype)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _ag.backward([self], [out_grad] if out_grad is not None else None,
+                     retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        return NDArray(self._data)
+
+    # ------------------------------------------------------------- indexing
+    def _index_vals(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        jkey = self._index_vals(key)
+        if isinstance(jkey, jax.Array) and jkey.dtype != jnp.bool_ and \
+                not jnp.issubdtype(jkey.dtype, jnp.integer):
+            jkey = jkey.astype(jnp.int32)
+        return _invoke_simple(lambda x: x[jkey], self, op_name="getitem")
+
+    def __setitem__(self, key, value):
+        jkey = self._index_vals(key)
+        if isinstance(jkey, jax.Array) and not (
+                jkey.dtype == jnp.bool_ or jnp.issubdtype(jkey.dtype, jnp.integer)):
+            jkey = jkey.astype(jnp.int32)
+        if isinstance(value, NDArray):
+            value = value._data
+        self._data = self._data.at[jkey].set(value)
+
+    # ------------------------------------------------------------ arithmetic
+    def _binary(self, other, fn, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke_simple(fn, a, b)
+        scalar = other
+        if reverse:
+            return _invoke_simple(lambda x: fn(scalar, x), self)
+        return _invoke_simple(lambda x: fn(x, scalar), self)
+
+    def __add__(self, other):
+        return self._binary(other, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, jnp.subtract)
+
+    def __rsub__(self, other):
+        return self._binary(other, jnp.subtract, reverse=True)
+
+    def __mul__(self, other):
+        return self._binary(other, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, jnp.divide)
+
+    def __rtruediv__(self, other):
+        return self._binary(other, jnp.divide, reverse=True)
+
+    __div__, __rdiv__ = __truediv__, __rtruediv__
+
+    def __mod__(self, other):
+        return self._binary(other, jnp.mod)
+
+    def __rmod__(self, other):
+        return self._binary(other, jnp.mod, reverse=True)
+
+    def __pow__(self, other):
+        return self._binary(other, jnp.power)
+
+    def __rpow__(self, other):
+        return self._binary(other, jnp.power, reverse=True)
+
+    def __neg__(self):
+        return _invoke_simple(jnp.negative, self)
+
+    def __abs__(self):
+        return _invoke_simple(jnp.abs, self)
+
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._data, self._node, self._out_index = out._data, out._node, out._out_index
+        return self
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._data, self._node, self._out_index = out._data, out._node, out._out_index
+        return self
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._data, self._node, self._out_index = out._data, out._node, out._out_index
+        return self
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._data, self._node, self._out_index = out._data, out._node, out._out_index
+        return self
+
+    def _cmp(self, other, fn):
+        other_v = other._data if isinstance(other, NDArray) else other
+        return NDArray(fn(self._data, other_v).astype(self._data.dtype))
+
+    def __eq__(self, other):
+        return self._cmp(other, lambda a, b: a == b)
+
+    def __ne__(self, other):
+        return self._cmp(other, lambda a, b: a != b)
+
+    def __gt__(self, other):
+        return self._cmp(other, lambda a, b: a > b)
+
+    def __ge__(self, other):
+        return self._cmp(other, lambda a, b: a >= b)
+
+    def __lt__(self, other):
+        return self._cmp(other, lambda a, b: a < b)
+
+    def __le__(self, other):
+        return self._cmp(other, lambda a, b: a <= b)
+
+    # --------------------------------------------------- method-style op API
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return _invoke_op("Reshape", (self,), {"shape": shape, **kwargs})
+
+    def reshape_like(self, other):
+        return _invoke_simple(lambda x, o: x.reshape(o.shape), self, other)
+
+    def broadcast_to(self, shape):
+        return _invoke_op("broadcast_to", (self,), {"shape": shape})
+
+    def broadcast_like(self, other):
+        return _invoke_op("broadcast_to", (self,), {"shape": other.shape})
+
+    def expand_dims(self, axis):
+        return _invoke_op("expand_dims", (self,), {"axis": axis})
+
+    def flatten(self):
+        return _invoke_op("Flatten", (self,), {})
+
+    def transpose(self, axes=None):
+        return _invoke_op("transpose", (self,), {"axes": axes})
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke_op("swapaxes", (self,), {"dim1": dim1, "dim2": dim2})
+
+    def flip(self, axis):
+        return _invoke_op("flip", (self,), {"axis": axis})
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke_op("slice_axis", (self,), {"axis": axis, "begin": begin, "end": end})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke_op("SliceChannel", (self,),
+                          {"num_outputs": num_outputs, "axis": axis,
+                           "squeeze_axis": squeeze_axis})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke_op("take", (self, indices), {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return _invoke_op("one_hot", (self,), {"depth": depth, **kw})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return _invoke_op("pick", (self, index), {"axis": axis, "keepdims": keepdims})
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _invoke_op("sum", (self,), {"axis": axis, "keepdims": keepdims, **kw})
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _invoke_op("mean", (self,), {"axis": axis, "keepdims": keepdims, **kw})
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke_op("prod", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke_op("max", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke_op("min", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, **kw):
+        return _invoke_op("norm", (self,), kw)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke_op("argmax", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke_op("argmin", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke_op("argsort", (self,), {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, **kw):
+        return _invoke_op("topk", (self,), kw)
+
+    def clip(self, a_min, a_max):
+        return _invoke_op("clip", (self,), {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return _invoke_op("abs", (self,), {})
+
+    def sign(self):
+        return _invoke_op("sign", (self,), {})
+
+    def sqrt(self):
+        return _invoke_op("sqrt", (self,), {})
+
+    def square(self):
+        return _invoke_op("square", (self,), {})
+
+    def exp(self):
+        return _invoke_op("exp", (self,), {})
+
+    def log(self):
+        return _invoke_op("log", (self,), {})
+
+    def tanh(self):
+        return _invoke_op("tanh", (self,), {})
+
+    def sigmoid(self):
+        return _invoke_op("sigmoid", (self,), {})
+
+    def relu(self):
+        return _invoke_op("relu", (self,), {})
+
+    def softmax(self, axis=-1):
+        return _invoke_op("softmax", (self,), {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return _invoke_op("log_softmax", (self,), {"axis": axis})
+
+    def dot(self, other, **kw):
+        return _invoke_op("dot", (self, other), kw)
+
+    def squeeze(self, axis=None):
+        return _invoke_op("squeeze", (self,), {"axis": axis})
+
+    def tile(self, reps):
+        return _invoke_op("tile", (self,), {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return _invoke_op("repeat", (self,), {"repeats": repeats, "axis": axis})
+
+    def pad(self, **kw):
+        return _invoke_op("pad", (self,), kw)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+
+def transpose_helper(arr):
+    return _invoke_simple(lambda x: x.T, arr)
+
+
+# ---------------------------------------------------------------------------
+# op invocation (record on tape when autograd is active)
+# ---------------------------------------------------------------------------
+
+def _wrap_outputs(outs, node):
+    wrapped = []
+    for i, o in enumerate(outs):
+        a = NDArray(o)
+        if node is not None:
+            a._node = node
+            a._out_index = i
+        wrapped.append(a)
+    return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+
+def _invoke_simple(fn, *arrays, op_name=None):
+    """Invoke a jax-traceable fn over NDArray args (all positional arrays)."""
+    outs, node = _ag.record_op(fn, list(arrays), op_name or getattr(fn, "__name__", "op"))
+    return _wrap_outputs(outs, node)
+
+
+def _invoke_op(name, args, kwargs):
+    """Invoke a registered op, splitting NDArray vs static arguments."""
+    info = get_op(name)
+    fn = info.fn
+    out_arg = kwargs.pop("out", None)  # in-place target, never an op input
+    arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    arr_kw = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+    arrays = [args[i] for i in arr_pos] + [kwargs[k] for k in arr_kw]
+    static_args = list(args)
+    static_kw = {k: v for k, v in kwargs.items() if k not in arr_kw}
+
+    def closure(*vals):
+        vi = 0
+        new_args = list(static_args)
+        for i in arr_pos:
+            new_args[i] = vals[vi]
+            vi += 1
+        new_kw = dict(static_kw)
+        for k in arr_kw:
+            new_kw[k] = vals[vi]
+            vi += 1
+        return fn(*new_args, **new_kw)
+
+    outs, node = _ag.record_op(closure, arrays, info.name)
+    result = _wrap_outputs(outs, node)
+    if out_arg is not None:
+        if isinstance(result, tuple):
+            for dst, src in zip(out_arg, result):
+                dst._data = src._data
+        else:
+            out_arg._data = result._data
+            result = out_arg
+    return result
+
+
+def imperative_invoke(op_name, *args, **kwargs):
+    """By-name op invocation (reference: MXImperativeInvokeEx)."""
+    return _invoke_op(op_name, args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# creation / io
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    from_python = isinstance(source_array, (list, tuple, int, float))
+    if isinstance(source_array, NDArray):
+        source_array = source_array._data
+    data = jnp.asarray(source_array, dtype=jnp.dtype(dtype) if dtype is not None else None)
+    if dtype is None:
+        # reference semantics: python lists/scalars default to float32;
+        # numpy inputs keep their dtype (64-bit narrowed for TPU).
+        if from_python and not jnp.issubdtype(data.dtype, jnp.floating):
+            data = data.astype(jnp.float32)
+        elif data.dtype == jnp.float64:
+            data = data.astype(jnp.float32)
+        elif data.dtype == jnp.int64:
+            data = data.astype(jnp.int32)
+    return NDArray(data, ctx=ctx or current_context())
+
+
+def from_jax(x):
+    return NDArray(x)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **_kw):
+    return NDArray(jnp.zeros(shape if hasattr(shape, "__len__") else (shape,),
+                             jnp.dtype(dtype or "float32")), ctx=ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype=None, **_kw):
+    return NDArray(jnp.ones(shape if hasattr(shape, "__len__") else (shape,),
+                            jnp.dtype(dtype or "float32")), ctx=ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype=None):
+    return NDArray(jnp.full(shape if hasattr(shape, "__len__") else (shape,),
+                            val, jnp.dtype(dtype or "float32")), ctx=ctx or current_context())
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    out = jnp.arange(start, stop, step, jnp.dtype(dtype or "float32"))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx or current_context())
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    return NDArray(jnp.eye(N, M or None, k=k, dtype=jnp.dtype(dtype or "float32")),
+                   ctx=ctx or current_context())
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _invoke_simple(lambda *xs: jnp.concatenate(xs, axis=axis), *arrays)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = _invoke_op("one_hot", (indices,), {"depth": depth})
+    out._data = res._data
+    return out
+
+
+def waitall():
+    """Block until all launched work completes (reference: MXNDArrayWaitAll)."""
+    jax.effects_barrier()
+
+
+def save(fname, data):
+    """Save NDArrays (list or dict) — reference: mx.nd.save binary format
+    (here: npz container, same capability)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        arrays = {k: _np.asarray(v._data) for k, v in data.items()}
+        _np.savez(fname, __mxtpu_format__="dict", **arrays)
+    else:
+        arrays = {"arr_%d" % i: _np.asarray(v._data) for i, v in enumerate(data)}
+        _np.savez(fname, __mxtpu_format__="list", **arrays)
+    import os
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname):
+    f = _np.load(fname, allow_pickle=False)
+    fmt = str(f["__mxtpu_format__"]) if "__mxtpu_format__" in f else "dict"
+    keys = [k for k in f.files if k != "__mxtpu_format__"]
+    if fmt == "list":
+        keys.sort(key=lambda k: int(k.split("_")[1]))
+        return [array(f[k]) for k in keys]
+    return {k: array(f[k]) for k in keys}

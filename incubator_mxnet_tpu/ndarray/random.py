@@ -1,0 +1,59 @@
+"""mx.nd.random namespace (reference: python/mxnet/ndarray/random.py)."""
+
+from ..ops.registry import get_op
+from .ndarray import _invoke_op, NDArray
+
+
+def _call(name, kwargs):
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    arrays = ()
+    return _invoke_op(name, arrays, kwargs)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _call("random_uniform", dict(low=low, high=high, shape=shape,
+                                        dtype=dtype, out=out))
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _call("random_normal", dict(loc=loc, scale=scale, shape=shape,
+                                       dtype=dtype, out=out))
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _call("random_gamma", dict(alpha=alpha, beta=beta, shape=shape,
+                                      dtype=dtype, out=out))
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _call("random_exponential", dict(lam=1.0 / scale, shape=shape,
+                                            dtype=dtype, out=out))
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _call("random_poisson", dict(lam=lam, shape=shape, dtype=dtype, out=out))
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _call("random_negative_binomial", dict(k=k, p=p, shape=shape,
+                                                  dtype=dtype, out=out))
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32",
+                                  ctx=None, out=None, **kw):
+    return _call("random_generalized_negative_binomial",
+                 dict(mu=mu, alpha=alpha, shape=shape, dtype=dtype, out=out))
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None, **kw):
+    return _call("random_randint", dict(low=low, high=high, shape=shape,
+                                        dtype=dtype, out=out))
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    return _invoke_op("sample_multinomial", (data,),
+                      dict(shape=shape, get_prob=get_prob, dtype=dtype))
+
+
+def shuffle(data, **kw):
+    return _invoke_op("shuffle", (data,), {})

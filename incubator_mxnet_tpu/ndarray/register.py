@@ -1,0 +1,30 @@
+"""Auto-generation of mx.nd.<op> functions from the op registry.
+
+Reference parity: python/mxnet/ndarray/register.py:_generate_ndarray_function_code
+— there, codegen against the C ABI op registry; here, thin wrappers over the
+pure-jax op registry with tape recording.
+"""
+
+import functools
+
+from ..ops.registry import _OP_REGISTRY
+from .ndarray import NDArray, _invoke_op
+
+
+def make_op_func(info):
+    @functools.wraps(info.fn)
+    def op_func(*args, **kwargs):
+        return _invoke_op(info.name, args, kwargs)
+    op_func.__name__ = info.name
+    return op_func
+
+
+def _init_op_functions(namespace):
+    """Install one function per registered op name/alias into ``namespace``."""
+    for name, info in list(_OP_REGISTRY.items()):
+        if name.startswith("_image_"):
+            continue
+        py_name = name
+        if py_name in namespace:  # don't clobber hand-written functions
+            continue
+        namespace[py_name] = make_op_func(info)

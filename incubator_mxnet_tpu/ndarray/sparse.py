@@ -1,0 +1,171 @@
+"""Sparse NDArray formats: CSR and row-sparse.
+
+Reference parity: include/mxnet/ndarray.h storage types kCSRStorage /
+kRowSparseStorage + python/mxnet/ndarray/sparse.py (CSRNDArray,
+RowSparseNDArray, cast_storage, retain, sparse dot) per SURVEY §2.1/2.6.
+
+TPU-first: XLA has no native sparse storage, so both formats are explicit
+structure-of-arrays over dense jax buffers with static nnz; compute lowers to
+gather/scatter/segment-sum which XLA maps onto the VPU. Dense fallback always
+exists (reference: storage-fallback densification, imperative_utils.h:280).
+"""
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
+           "cast_storage", "retain", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    pass
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix: (data, indices, indptr)."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._sp_indptr = jnp.asarray(indptr, dtype=jnp.int32)
+        self._sp_shape = tuple(shape)
+        super().__init__(self._to_dense_val())
+
+    def _to_dense_val(self):
+        n_rows = self._sp_shape[0]
+        counts = self._sp_indptr[1:] - self._sp_indptr[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self._sp_data.shape[0])
+        dense = jnp.zeros(self._sp_shape, self._sp_data.dtype)
+        return dense.at[rows, self._sp_indices].add(self._sp_data)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return NDArray(self._sp_data)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_indices)
+
+    @property
+    def indptr(self):
+        return NDArray(self._sp_indptr)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        return cast_storage(NDArray(self._data), stype)
+
+    def asscipy(self):
+        import scipy.sparse as sps
+        return sps.csr_matrix((_np.asarray(self._sp_data),
+                               _np.asarray(self._sp_indices),
+                               _np.asarray(self._sp_indptr)), shape=self._sp_shape)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: (data (nnz_rows, *row_shape), indices (nnz_rows,))."""
+
+    def __init__(self, data, indices, shape):
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._sp_shape = tuple(shape)
+        dense = jnp.zeros(self._sp_shape, self._sp_data.dtype)
+        super().__init__(dense.at[self._sp_indices].set(self._sp_data))
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return NDArray(self._sp_data)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_indices)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        return cast_storage(NDArray(self._data), stype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create CSR from (data, indices, indptr) tuple, dense, or scipy csr."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) else _np.asarray(indices)
+        indptr = indptr.asnumpy() if isinstance(indptr, NDArray) else _np.asarray(indptr)
+        return CSRNDArray(data.astype(dtype or "float32"), indices, indptr, shape)
+    if hasattr(arg1, "tocsr"):  # scipy
+        m = arg1.tocsr()
+        return CSRNDArray(m.data.astype(dtype or "float32"), m.indices, m.indptr, m.shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    import scipy.sparse as sps
+    m = sps.csr_matrix(dense)
+    return CSRNDArray(m.data.astype(dtype or dense.dtype), m.indices, m.indptr, dense.shape)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) else _np.asarray(indices)
+        return RowSparseNDArray(data.astype(dtype or "float32"), indices, shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    nz = _np.where(_np.abs(dense).reshape(dense.shape[0], -1).sum(axis=1) > 0)[0]
+    return RowSparseNDArray(dense[nz].astype(dtype or dense.dtype), nz, dense.shape)
+
+
+def cast_storage(arr, stype):
+    """reference: cast_storage op (cast_storage-inl.h)."""
+    if stype == "default":
+        return NDArray(arr._data)
+    if stype == "csr":
+        return csr_matrix(arr)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def retain(arr, indices):
+    """Keep only the given rows of a row_sparse array (reference: sparse_retain)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise TypeError("retain expects RowSparseNDArray")
+    idx = indices.asnumpy().astype(_np.int32) if isinstance(indices, NDArray) \
+        else _np.asarray(indices, dtype=_np.int32)
+    dense = _np.asarray(arr._data)
+    return RowSparseNDArray(dense[idx], idx, arr._sp_shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot; densifies (XLA fuses the gather) — capability parity
+    with the reference's dot(csr, dense)."""
+    from . import dot as _dense_dot
+    return _dense_dot(NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs,
+                      NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs,
+                      transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,) + tuple(shape[1:]), dtype or "float32"),
+                                _np.zeros((0,), _np.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dtype or "float32"),
+                          _np.zeros((0,), _np.int32),
+                          _np.zeros((shape[0] + 1,), _np.int32), shape)
+    from .ndarray import zeros as _z
+    return _z(shape, ctx=ctx, dtype=dtype)

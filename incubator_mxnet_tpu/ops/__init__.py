@@ -1,0 +1,20 @@
+"""Functional operator library (pure jax functions + registry).
+
+Every op here is traceable under jit and usable three ways: eagerly through
+the NDArray frontend (with tape autograd), inside hybridized blocks (compiled
+to one XLA program), and by name through the Symbol/JSON layer.
+"""
+
+from .registry import register, get_op, list_ops, alias, OpInfo
+from . import tensor, nn, random, rnn, image, contrib  # noqa: F401 - populate registry
+from .tensor import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .rnn import rnn_forward, unpack_rnn_params, rnn_param_size  # noqa: F401
+
+
+def __getattr__(name):
+    """Resolve any registered op (including aliases) as an attribute."""
+    try:
+        return get_op(name)
+    except KeyError:
+        raise AttributeError(name)

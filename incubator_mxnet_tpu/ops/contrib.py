@@ -1,0 +1,197 @@
+"""Contrib operators (detection/vision helpers, AdamW-style updates).
+
+Reference parity (subset, growing): src/operator/contrib/* — BilinearResize2D,
+AdaptiveAvgPooling2D, bounding-box ops (box_iou, box_nms), MultiBoxPrior,
+ROIAlign per SURVEY §2.3. All static-shape: NMS returns the reference's
+"-1-padded, score-sorted" format instead of dynamic shapes so it jits.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",))
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, like=None, mode="size"):
+    b, c, h, w = data.shape
+    if like is not None:
+        height, width = like.shape[2], like.shape[3]
+    if height is None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    return jax.image.resize(data, (b, c, height, width), method="bilinear")
+
+
+@register("AdaptiveAvgPooling2D", aliases=("_contrib_AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, output_size=1):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    b, c, h, w = data.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(b, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (b, c, oh, ow), method="bilinear")
+
+
+@register("box_iou", aliases=("_contrib_box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    """lhs: (..., N, 4), rhs: (..., M, 4) -> (..., N, M)."""
+    if format == "center":
+        def to_corner(b):
+            cx, cy, w, h = jnp.split(b, 4, axis=-1)
+            return jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    l = jnp.expand_dims(lhs, -2)   # (..., N, 1, 4)
+    r = jnp.expand_dims(rhs, -3)   # (..., 1, M, 4)
+    tl = jnp.maximum(l[..., :2], r[..., :2])
+    br = jnp.minimum(l[..., 2:], r[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = (l[..., 2] - l[..., 0]) * (l[..., 3] - l[..., 1])
+    area_r = (r[..., 2] - r[..., 0]) * (r[..., 3] - r[..., 1])
+    return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+
+@register("box_nms", aliases=("_contrib_box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """(B, N, K) rows [id, score, x1,y1,x2,y2, ...]. Static-shape greedy NMS:
+    suppressed rows get score/id -1, output sorted by score desc."""
+    single = data.ndim == 2
+    if single:
+        data = data[None]
+    B, N, K = data.shape
+
+    def one(batch):
+        scores = batch[:, score_index]
+        ids = batch[:, id_index] if id_index >= 0 else jnp.zeros(N)
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= ids != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        boxes = batch[order, coord_start:coord_start + 4]
+        svalid = valid[order]
+        sids = ids[order]
+        iou = box_iou(boxes, boxes, format=in_format)
+        if not force_suppress and id_index >= 0:
+            same = sids[:, None] == sids[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & keep[i] & svalid[i]
+            sup = sup.at[i].set(False)
+            keep = keep & ~(sup & (jnp.arange(N) > i))
+            return keep
+
+        keep = jnp.ones(N, bool)
+        keep = jax.lax.fori_loop(0, N if topk < 0 else min(topk, N), body, keep)
+        keep &= svalid
+        out = batch[order]
+        out = out.at[:, score_index].set(jnp.where(keep, out[:, score_index], -1.0))
+        if id_index >= 0:
+            out = out.at[:, id_index].set(jnp.where(keep, out[:, id_index], -1.0))
+        return out
+
+    res = jax.vmap(one)(data)
+    return res[0] if single else res
+
+
+@register("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generation. data: (B, C, H, W) -> (1, H*W*(S+R-1), 4)."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (H,W,2)
+    whs = []
+    for s in sizes:
+        whs.append((s, s))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * (r ** 0.5), sizes[0] / (r ** 0.5)))
+    anchors = []
+    for (bw, bh) in whs:
+        half = jnp.asarray([bw / 2, bh / 2])
+        centers = jnp.concatenate([cyx[..., ::-1] - half, cyx[..., ::-1] + half], axis=-1)
+        anchors.append(centers)
+    out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("ROIAlign", aliases=("_contrib_ROIAlign",))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2):
+    """data: (B,C,H,W); rois: (R,5) [batch_idx, x1,y1,x2,y2]."""
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    ph, pw = pooled_size
+    B, C, H, W = data.shape
+    sr = max(sample_ratio, 1)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        iy = (jnp.arange(ph * sr) + 0.5) / sr
+        ix = (jnp.arange(pw * sr) + 0.5) / sr
+        ys = y1 + iy * bin_h
+        xs = x1 + ix * bin_w
+        img = data[bidx]  # (C,H,W)
+
+        def bilinear(c):
+            y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+            y1c = jnp.clip(y0 + 1, 0, H - 1)
+            x1c = jnp.clip(x0 + 1, 0, W - 1)
+            wy = ys - y0
+            wx = xs - x0
+            y0i, y1i = y0.astype(jnp.int32), y1c.astype(jnp.int32)
+            x0i, x1i = x0.astype(jnp.int32), x1c.astype(jnp.int32)
+            v00 = c[jnp.ix_(y0i, x0i)]
+            v01 = c[jnp.ix_(y0i, x1i)]
+            v10 = c[jnp.ix_(y1i, x0i)]
+            v11 = c[jnp.ix_(y1i, x1i)]
+            top = v00 * (1 - wx)[None, :] + v01 * wx[None, :]
+            bot = v10 * (1 - wx)[None, :] + v11 * wx[None, :]
+            return top * (1 - wy)[:, None] + bot * wy[:, None]
+
+        sampled = jax.vmap(bilinear)(img)  # (C, ph*sr, pw*sr)
+        return sampled.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+
+    return jax.vmap(one)(rois)
+
+
+@register("gradient_multiplier", aliases=("_contrib_gradientmultiplier",))
+def gradient_multiplier(data, scalar=1.0):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("quadratic", aliases=("_contrib_quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    return a * data * data + b * data + c
+
+
+@register("index_copy", aliases=("_contrib_index_copy",))
+def index_copy(old_tensor, index_vector, new_tensor):
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)

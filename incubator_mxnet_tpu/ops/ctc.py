@@ -1,0 +1,90 @@
+"""CTC loss — log-domain forward dynamic program as a lax.scan.
+
+Reference parity: the CTCLoss op (reference: src/operator/nn/ctc_loss.cc via
+3rdparty warp-ctc headers). Blank label = 0 (the reference's default).
+XLA compiles the per-timestep recursion into one fused scan; gradients come
+from autodiff of the DP (warp-ctc computes them analytically — same math).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _logsumexp3(a, b, c):
+    m = jnp.maximum(jnp.maximum(a, b), c)
+    m_safe = jnp.where(m == NEG_INF, 0.0, m)
+    out = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe) +
+                           jnp.exp(c - m_safe))
+    return jnp.where(m == NEG_INF, NEG_INF, out)
+
+
+def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
+             layout="NTC", label_layout="NT", blank=0):
+    """pred: (N, T, C) logits (pre-softmax, as in gluon CTCLoss); label:
+    (N, L) int labels (0 reserved for blank; gluon convention adds nothing —
+    labels are expected >=1 in reference gluon usage where blank=last? The
+    reference gluon.loss.CTCLoss uses blank at index 0... keep blank=0).
+    Returns (N,) negative log likelihood."""
+    if layout == "TNC":
+        pred = jnp.swapaxes(pred, 0, 1)
+    if label_layout == "TN":
+        label = jnp.swapaxes(label, 0, 1)
+    N, T, C = pred.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(pred, axis=-1)
+
+    if pred_lengths is None:
+        pred_lengths = jnp.full((N,), T, jnp.int32)
+    else:
+        pred_lengths = pred_lengths.astype(jnp.int32)
+    if label_lengths is None:
+        # padding convention: entries equal to blank (or negative) are padding
+        label_lengths = jnp.sum((label != blank) & (label >= 0), axis=1).astype(jnp.int32)
+    else:
+        label_lengths = label_lengths.astype(jnp.int32)
+
+    # extended label sequence with interleaved blanks: length S = 2L+1
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label.astype(jnp.int32))
+    # allow transition s-2 -> s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    # init: alpha[0] at s=0 (blank) and s=1 (first label)
+    alpha0 = jnp.full((N, S), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = ext[:, 1] if S > 1 else jnp.full((N,), blank, jnp.int32)
+    if S > 1:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(logp[:, 0, :], first_lab[:, None], axis=1)[:, 0])
+
+    def step(alpha, t):
+        lp_t = logp[:, t, :]                       # (N, C)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)  # (N, S)
+        a_prev1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=NEG_INF)
+        a_prev2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=NEG_INF)
+        a_prev2 = jnp.where(can_skip, a_prev2, NEG_INF)
+        new = _logsumexp3(alpha, a_prev1, a_prev2) + emit
+        # freeze alpha past each sequence's length
+        new = jnp.where((t < pred_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+
+    # final: sum of last two states of the extended path per sequence
+    sl = label_lengths
+    last = 2 * sl        # index of final blank
+    last_lab = 2 * sl - 1
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_lab = jnp.where(sl > 0,
+                      jnp.take_along_axis(alpha, jnp.maximum(last_lab, 0)[:, None],
+                                          axis=1)[:, 0],
+                      NEG_INF)
+    m = jnp.maximum(a_last, a_lab)
+    m_safe = jnp.where(m == NEG_INF, 0.0, m)
+    ll = m_safe + jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_lab - m_safe))
+    return -ll

@@ -1,0 +1,88 @@
+"""Image operators backing gluon vision transforms.
+
+Reference parity: src/operator/image/* (to_tensor, normalize, flips, crop,
+resize, random color/brightness/contrast/saturation jitter) per SURVEY §2.3.
+Layout: HWC uint8/float in, CHW float out for to_tensor (as in the reference).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from . import random as _rnd
+
+
+@register("image_to_tensor", aliases=("_image_to_tensor",))
+def to_tensor(data):
+    """(H,W,C) or (N,H,W,C) uint8 [0,255] -> (C,H,W) float32 [0,1]."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("image_normalize", aliases=("_image_normalize",))
+def normalize(data, mean=0.0, std=1.0):
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    if mean.ndim == 1:
+        mean = mean.reshape((-1,) + (1, 1))
+        std = std.reshape((-1,) + (1, 1))
+    return (data - mean) / std
+
+
+@register("image_flip_left_right", aliases=("_image_flip_left_right",))
+def flip_left_right(data):
+    return jnp.flip(data, axis=-2 if data.ndim == 3 else -2)
+
+
+@register("image_flip_top_bottom", aliases=("_image_flip_top_bottom",))
+def flip_top_bottom(data):
+    return jnp.flip(data, axis=-3)
+
+
+@register("image_resize", aliases=("_image_resize",))
+def resize(data, size, interp="bilinear"):
+    """HWC resize. size: (w, h) or int."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size
+    method = {"bilinear": "bilinear", "nearest": "nearest", "bicubic": "cubic"}[interp]
+    if data.ndim == 3:
+        out_shape = (h, w, data.shape[2])
+    else:
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    return jax.image.resize(data.astype(jnp.float32), out_shape, method=method).astype(data.dtype)
+
+
+@register("image_crop", aliases=("_image_crop",))
+def crop(data, x, y, width, height):
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width]
+    return data[:, y:y + height, x:x + width]
+
+
+@register("image_random_brightness")
+def random_brightness(data, min_factor, max_factor, key=None):
+    key = key if key is not None else _rnd.next_key()
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return data * f
+
+
+@register("image_random_contrast")
+def random_contrast(data, min_factor, max_factor, key=None):
+    key = key if key is not None else _rnd.next_key()
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    axis = -1 if data.shape[-1] == 3 else None
+    gray = jnp.mean((data * coef).sum(axis=-1) if axis else data)
+    return data * f + gray * (1 - f)
+
+
+@register("image_random_saturation")
+def random_saturation(data, min_factor, max_factor, key=None):
+    key = key if key is not None else _rnd.next_key()
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    gray = (data * coef).sum(axis=-1, keepdims=True)
+    return data * f + gray * (1 - f)

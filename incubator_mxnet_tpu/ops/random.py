@@ -1,0 +1,123 @@
+"""Random sampling operators + global PRNG state.
+
+Reference parity: src/operator/random/* (sample_uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial,
+multinomial, randint, shuffle) and the seeded per-device generator state
+(include/mxnet/random_generator.h) per SURVEY §2.1/2.3.
+
+TPU-first: JAX threefry counter-based keys. Eager ops draw from a global
+seeded key chain (mx.random.seed); traced code should thread keys explicitly
+(gluon layers do).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_state = threading.local()
+
+
+def seed(seed_value):
+    """Seed the global generator (reference: mx.random.seed)."""
+    _state.key = jax.random.PRNGKey(seed_value)
+
+
+def next_key():
+    """Split one fresh key off the global chain."""
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return tuple(shape) if hasattr(shape, "__len__") else (shape,)
+
+
+@register("random_uniform", aliases=("_random_uniform", "uniform", "_sample_uniform"))
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", key=None):
+    key = key if key is not None else next_key()
+    return jax.random.uniform(key, _shape(shape), jnp.dtype(dtype), low, high)
+
+
+@register("random_normal", aliases=("_random_normal", "normal", "_sample_normal"))
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", key=None):
+    key = key if key is not None else next_key()
+    return loc + scale * jax.random.normal(key, _shape(shape), jnp.dtype(dtype))
+
+
+@register("random_gamma", aliases=("_random_gamma", "gamma_sample"))
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", key=None):
+    key = key if key is not None else next_key()
+    return jax.random.gamma(key, alpha, _shape(shape), jnp.dtype(dtype)) * beta
+
+
+@register("random_exponential", aliases=("_random_exponential",))
+def random_exponential(lam=1.0, shape=None, dtype="float32", key=None):
+    key = key if key is not None else next_key()
+    return jax.random.exponential(key, _shape(shape), jnp.dtype(dtype)) / lam
+
+
+@register("random_poisson", aliases=("_random_poisson",))
+def random_poisson(lam=1.0, shape=None, dtype="float32", key=None):
+    key = key if key is not None else next_key()
+    return jax.random.poisson(key, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("random_negative_binomial", aliases=("_random_negative_binomial",))
+def random_negative_binomial(k=1, p=0.5, shape=None, dtype="float32", key=None):
+    key = key if key is not None else next_key()
+    k1, k2 = jax.random.split(key)
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(k1, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(jnp.dtype(dtype))
+
+
+@register("random_generalized_negative_binomial",
+          aliases=("_random_generalized_negative_binomial",))
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                         dtype="float32", key=None):
+    key = key if key is not None else next_key()
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(jnp.dtype(dtype))
+
+
+@register("random_randint", aliases=("_random_randint", "randint"))
+def random_randint(low=0, high=100, shape=None, dtype="int32", key=None):
+    key = key if key is not None else next_key()
+    return jax.random.randint(key, _shape(shape), low, high, jnp.dtype(dtype))
+
+
+@register("sample_multinomial", aliases=("_sample_multinomial", "multinomial"))
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32", key=None):
+    """data: (..., k) probabilities. Returns draws of given shape per row."""
+    key = key if key is not None else next_key()
+    n = 1
+    out_shape = _shape(shape)
+    for s in out_shape:
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    draws = jax.random.categorical(key, logits, axis=-1,
+                                   shape=(n,) + logits.shape[:-1])
+    draws = jnp.moveaxis(draws, 0, -1)          # (..., n)
+    draws = draws.reshape(logits.shape[:-1] + out_shape) if out_shape else draws[..., 0]
+    draws = draws.astype(jnp.dtype(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            draws.reshape(logits.shape[:-1] + (-1,)).astype(jnp.int32), axis=-1)
+        return draws, logp.reshape(draws.shape)
+    return draws
+
+
+register("bernoulli")(lambda p=0.5, shape=None, dtype="float32", key=None:
+                      jax.random.bernoulli(key if key is not None else next_key(),
+                                           p, _shape(shape)).astype(jnp.dtype(dtype)))

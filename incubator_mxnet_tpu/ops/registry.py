@@ -1,0 +1,63 @@
+"""Operator registry.
+
+Reference parity: NNVM's ``Op`` registry + ``NNVM_REGISTER_OP`` pattern
+(reference: src/operator/**, include/nnvm usage described in SURVEY §2.2).
+TPU-first redesign: an op is a *pure traceable function* over jax arrays plus
+declarative attributes. There is no FCompute<cpu>/<gpu> split — XLA owns
+lowering — and no dependency-engine var sets; attributes that matter here are
+the ones the symbolic executor and docs need (num inputs/outputs, aliases).
+
+Every registered op is visible to:
+  * the ``nd`` namespace (eager NDArray API, tape-recorded under autograd),
+  * hybridized blocks (traced into one XLA program),
+  * the Symbol/JSON import layer (name -> callable lookup).
+"""
+
+__all__ = ["OpInfo", "register", "get_op", "list_ops", "alias"]
+
+_OP_REGISTRY = {}
+
+
+class OpInfo:
+    """Metadata for one registered operator."""
+
+    def __init__(self, name, fn, num_outputs=1, aliases=(), attrs=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.aliases = tuple(aliases)
+        self.attrs = dict(attrs or {})
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __repr__(self):
+        return "OpInfo(%s)" % self.name
+
+
+def register(name=None, num_outputs=1, aliases=(), **attrs):
+    """Decorator registering a pure function as a framework operator."""
+    def deco(fn):
+        opname = name or fn.__name__
+        info = OpInfo(opname, fn, num_outputs=num_outputs, aliases=aliases, attrs=attrs)
+        _OP_REGISTRY[opname] = info
+        for a in aliases:
+            _OP_REGISTRY[a] = info
+        return fn
+    return deco
+
+
+def alias(existing, *names):
+    """Register additional names for an already-registered op."""
+    info = _OP_REGISTRY[existing]
+    for n in names:
+        _OP_REGISTRY[n] = info
+
+
+def get_op(name):
+    """Look up an op by (possibly aliased) name; raises KeyError if absent."""
+    return _OP_REGISTRY[name]
+
+
+def list_ops():
+    return sorted(set(info.name for info in _OP_REGISTRY.values()))

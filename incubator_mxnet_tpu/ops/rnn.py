@@ -1,0 +1,179 @@
+"""Fused multi-layer RNN (vanilla/LSTM/GRU) as a single traced scan.
+
+Reference parity: the RNN op (reference: src/operator/rnn-inl.h:383 RNNOp —
+cuDNN fused descriptors on GPU, src/operator/rnn_impl.h CPU loops). Supports
+mode rnn_relu/rnn_tanh/lstm/gru, multi-layer, bidirectional, inter-layer
+dropout, (T, N, C) layout, and the reference's packed flat parameter vector.
+
+TPU-first: one ``lax.scan`` over time per layer/direction — XLA compiles the
+whole stack into a single program; the (gates·H, C)·(C, N) matmuls land on the
+MXU. Gate order i,f,g,o (LSTM) and r,z,n (GRU) matching the reference/cuDNN.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell_step(mode):
+    if mode == "lstm":
+        def step(carry, xw, wh, bh):
+            h, c = carry
+            gates = xw + jnp.matmul(h, wh.T) + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        return step
+    if mode == "gru":
+        def step(carry, xw, wh, bh):
+            (h,) = carry
+            hw = jnp.matmul(h, wh.T) + bh
+            xr, xz, xn = jnp.split(xw, 3, axis=-1)
+            hr, hz, hn = jnp.split(hw, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+        return step
+    act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+    def step(carry, xw, wh, bh):
+        (h,) = carry
+        h_new = act(xw + jnp.matmul(h, wh.T) + bh)
+        return (h_new,), h_new
+    return step
+
+
+def _run_layer(x, wx, wh, bx, bh, h0, c0, mode, reverse=False):
+    """x: (T, N, C). Returns (out (T,N,H), h_T, c_T or None)."""
+    step = _cell_step(mode)
+    # hoist the input projection out of the scan: one big (T*N, C) matmul
+    xw = jnp.einsum("tnc,gc->tng", x, wx) + bx
+    if reverse:
+        xw = jnp.flip(xw, axis=0)
+    carry = (h0, c0) if mode == "lstm" else (h0,)
+
+    def body(carry, xw_t):
+        return step(carry, xw_t, wh, bh)
+
+    carry, ys = lax.scan(body, carry, xw)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    if mode == "lstm":
+        return ys, carry[0], carry[1]
+    return ys, carry[0], None
+
+
+def rnn_forward(data, layer_params, init_h, init_c=None, mode="lstm",
+                bidirectional=False, p=0.0, training=False, key=None):
+    """Structured-weight fused RNN.
+
+    data: (T, N, C). layer_params: list over layers of lists over directions of
+    dicts {wx, wh, bx, bh}. init_h/init_c: (num_layers*dirs, N, H).
+    Returns (out, h_n, c_n|None).
+    """
+    dirs = 2 if bidirectional else 1
+    x = data
+    hs, cs = [], []
+    for li, dir_params in enumerate(layer_params):
+        outs = []
+        for d in range(dirs):
+            pr = dir_params[d]
+            idx = li * dirs + d
+            h0 = init_h[idx]
+            c0 = init_c[idx] if init_c is not None else None
+            out, hT, cT = _run_layer(x, pr["wx"], pr["wh"], pr["bx"], pr["bh"],
+                                     h0, c0, mode, reverse=(d == 1))
+            outs.append(out)
+            hs.append(hT)
+            if cT is not None:
+                cs.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and training and li < len(layer_params) - 1 and key is not None:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    h_n = jnp.stack(hs, axis=0)
+    c_n = jnp.stack(cs, axis=0) if cs else None
+    return x, h_n, c_n
+
+
+def unpack_rnn_params(parameters, input_size, state_size, num_layers, mode,
+                      bidirectional=False, projection_size=None):
+    """Unpack the reference's flat parameter vector (all weights for every
+    layer/direction first, then all biases; reference rnn-inl.h layout)."""
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    H = state_size
+    layers = []
+    off = 0
+    shapes = []
+    for li in range(num_layers):
+        in_sz = input_size if li == 0 else H * dirs
+        for _ in range(dirs):
+            shapes.append(("wx", (g * H, in_sz)))
+            shapes.append(("wh", (g * H, H)))
+    for li in range(num_layers):
+        for _ in range(dirs):
+            shapes.append(("bx", (g * H,)))
+            shapes.append(("bh", (g * H,)))
+    vals = []
+    for name, shp in shapes:
+        n = 1
+        for s in shp:
+            n *= s
+        vals.append((name, parameters[off:off + n].reshape(shp)))
+        off += n
+    # stitch into per-layer/direction dicts
+    n_ld = num_layers * dirs
+    layers = []
+    for li in range(num_layers):
+        dir_list = []
+        for d in range(dirs):
+            k = (li * dirs + d) * 2
+            wx = vals[k][1]
+            wh = vals[k + 1][1]
+            bx = vals[2 * n_ld + k][1]
+            bh = vals[2 * n_ld + k + 1][1]
+            dir_list.append({"wx": wx, "wh": wh, "bx": bx, "bh": bh})
+        layers.append(dir_list)
+    return layers
+
+
+def rnn_param_size(input_size, state_size, num_layers, mode, bidirectional=False):
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    H = state_size
+    total = 0
+    for li in range(num_layers):
+        in_sz = input_size if li == 0 else H * dirs
+        total += dirs * (g * H * in_sz + g * H * H + 2 * g * H)
+    return total
+
+
+@register("RNN")
+def rnn(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=True, training=False, key=None, **_ignored):
+    """Packed-parameter fused RNN op matching the reference's ``RNN`` symbol.
+
+    data: (T, N, C); state: (L*dirs, N, H); lstm also takes state_cell.
+    Returns out or (out, h_n[, c_n]) depending on state_outputs.
+    """
+    layer_params = unpack_rnn_params(parameters, data.shape[2], state_size,
+                                     num_layers, mode, bidirectional)
+    out, h_n, c_n = rnn_forward(data, layer_params, state, state_cell, mode,
+                                bidirectional, p, training, key)
+    if not state_outputs:
+        return out
+    if mode == "lstm":
+        return out, h_n, c_n
+    return out, h_n

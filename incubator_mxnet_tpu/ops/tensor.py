@@ -1,0 +1,586 @@
+"""Tensor/"numpy layer" operators.
+
+Reference parity: src/operator/tensor/* (~31k LoC in the reference —
+elemwise unary/binary/broadcast/scalar families, dot/batch_dot, reductions,
+indexing ops take/gather_nd/scatter_nd/one_hot, init ops, shape manipulation,
+sorting/topk, control-flow helpers, diag, linalg) per SURVEY §2.3.
+
+TPU-first: every op is a pure jnp/lax function — XLA fuses the elementwise
+zoo into surrounding matmuls, so there is no hand-written kernel launcher
+(the reference's mxnet_op::Kernel<OP,xpu>::Launch maps to "just trace it").
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# elementwise unary (reference: src/operator/tensor/elemwise_unary_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "square": jnp.square, "sqrt": jnp.sqrt, "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt, "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(_fn)
+
+identity = register("identity", aliases=("_copy", "stop_gradient_off"))(lambda x: x)
+register("BlockGrad", aliases=("stop_gradient",))(lax.stop_gradient)
+register("make_loss")(lambda x: x)
+register("zeros_like")(jnp.zeros_like)
+register("ones_like")(jnp.ones_like)
+register("shape_array")(lambda x: jnp.asarray(x.shape, dtype=jnp.int64))
+register("size_array")(lambda x: jnp.asarray(x.size, dtype=jnp.int64))
+
+
+@register("clip")
+def clip(data, a_min, a_max):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, dtype):
+    return data.astype(jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary + broadcast families
+# (reference: elemwise_binary_op*.cc, elemwise_binary_broadcast_op*.cc)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "hypot": jnp.hypot, "arctan2": jnp.arctan2,
+    "equal": lambda a, b: (a == b), "not_equal": lambda a, b: (a != b),
+    "greater": lambda a, b: (a > b), "greater_equal": lambda a, b: (a >= b),
+    "lesser": lambda a, b: (a < b), "lesser_equal": lambda a, b: (a <= b),
+    "logical_and": lambda a, b: jnp.logical_and(a != 0, b != 0),
+    "logical_or": lambda a, b: jnp.logical_or(a != 0, b != 0),
+    "logical_xor": lambda a, b: jnp.logical_xor(a != 0, b != 0),
+}
+
+
+def _as_out_dtype(fn, a, b):
+    out = fn(a, b)
+    if out.dtype == jnp.bool_:
+        ref = a if hasattr(a, "dtype") else b
+        out = out.astype(ref.dtype)
+    return out
+
+
+_MX_ALIASES = {  # the reference's short names (broadcast_mul etc.)
+    "add": ("broadcast_plus", "broadcast_add_alias", "elemwise_plus"),
+    "subtract": ("broadcast_sub", "broadcast_minus", "elemwise_sub"),
+    "multiply": ("broadcast_mul", "elemwise_mul"),
+    "divide": ("broadcast_div", "elemwise_div"),
+}
+
+for _name, _fn in _BINARY.items():
+    # elemwise_* requires same shape; broadcast_* broadcasts. On XLA both
+    # lower identically, so a single broadcasting impl serves both names.
+    register("broadcast_" + _name,
+             aliases=("elemwise_" + _name, "_" + _name) + _MX_ALIASES.get(_name, ()))(
+        (lambda f: lambda lhs, rhs: _as_out_dtype(f, lhs, rhs))(_fn))
+
+# scalar variants (reference: *_scalar ops) — same functions; scalars broadcast.
+register("_plus_scalar")(lambda data, scalar: data + scalar)
+register("_minus_scalar")(lambda data, scalar: data - scalar)
+register("_rminus_scalar")(lambda data, scalar: scalar - data)
+register("_mul_scalar")(lambda data, scalar: data * scalar)
+register("_div_scalar")(lambda data, scalar: data / scalar)
+register("_rdiv_scalar")(lambda data, scalar: scalar / data)
+register("_power_scalar")(lambda data, scalar: data ** scalar)
+register("_rpower_scalar")(lambda data, scalar: scalar ** data)
+register("_mod_scalar")(lambda data, scalar: data % scalar)
+register("_maximum_scalar")(lambda data, scalar: jnp.maximum(data, scalar))
+register("_minimum_scalar")(lambda data, scalar: jnp.minimum(data, scalar))
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc etc.)
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(fn, data, axis=None, keepdims=False, exclude=False):
+    axis = _norm_axis(axis)
+    if exclude and axis is not None:
+        ax = (axis,) if isinstance(axis, int) else axis
+        axis = tuple(i for i in range(data.ndim) if i not in ax and (i - data.ndim) not in ax)
+    return fn(data, axis=axis, keepdims=keepdims)
+
+
+for _name, _fn in [("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+                   ("max", jnp.max), ("min", jnp.min)]:
+    register(_name)((lambda f: lambda data, axis=None, keepdims=False, exclude=False:
+                     _reduce(f, data, axis, keepdims, exclude))(_fn))
+
+register("nansum")(lambda data, axis=None, keepdims=False, exclude=False:
+                   _reduce(jnp.nansum, data, axis, keepdims, exclude))
+register("nanprod")(lambda data, axis=None, keepdims=False, exclude=False:
+                    _reduce(jnp.nanprod, data, axis, keepdims, exclude))
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    axis = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (reference: dot-inl.h, la_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # reference dot: reduce last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+register("linalg_gemm2")(lambda A, B, transpose_a=False, transpose_b=False, alpha=1.0:
+                         alpha * batch_dot(A, B, transpose_a, transpose_b))
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    return alpha * batch_dot(A, B, transpose_a, transpose_b) + beta * C
+
+
+register("linalg_potrf")(lambda A: jnp.linalg.cholesky(A))
+register("linalg_syrk")(lambda A, transpose=False, alpha=1.0:
+                        alpha * (jnp.matmul(jnp.swapaxes(A, -1, -2), A) if transpose
+                                 else jnp.matmul(A, jnp.swapaxes(A, -1, -2))))
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = lower != transpose
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2), lower=not low)
+        return jnp.swapaxes(x, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=low)
+
+
+register("linalg_sumlogdiag")(lambda A: jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation (reference: matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, shape=None, reverse=False, **_ignored):
+    if shape is None:
+        return data
+    shape = tuple(shape)
+    if not any(s in (0, -2, -3, -4) for s in shape):
+        return jnp.reshape(data, shape)
+    # MXNet special codes: 0 copy dim, -1 infer, -2 copy rest, -3 merge two,
+    # -4 split (next two dims). Implemented on static shapes only.
+    src = list(data.shape)[::-1] if reverse else list(data.shape)
+    tgt = list(shape)[::-1] if reverse else list(shape)
+    out, i = [], 0
+    k = 0
+    while k < len(tgt):
+        s = tgt[k]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = tgt[k + 1], tgt[k + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; k += 2
+        else:
+            out.append(s); i += 1
+        k += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+register("Flatten", aliases=("flatten",))(lambda data: jnp.reshape(data, (data.shape[0], -1)))
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    return jnp.transpose(data, axes=tuple(axes) if axes else None)
+
+
+register("expand_dims")(lambda data, axis: jnp.expand_dims(data, axis))
+register("squeeze")(lambda data, axis=None: jnp.squeeze(data, axis=axis))
+register("swapaxes", aliases=("SwapAxis",))(lambda data, dim1=0, dim2=0: jnp.swapaxes(data, dim1, dim2))
+register("flip", aliases=("reverse",))(lambda data, axis: jnp.flip(data, axis=axis))
+register("tile")(lambda data, reps: jnp.tile(data, tuple(reps)))
+register("repeat")(lambda data, repeats, axis=None: jnp.repeat(data, repeats, axis=axis))
+register("broadcast_to")(lambda data, shape: jnp.broadcast_to(
+    data, tuple(d if s == 0 else s for s, d in zip(shape, data.shape))))
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("Concat", aliases=("concat",))
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), num_outputs="num_outputs")
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=("crop",))
+def slice(data, begin, end, step=None):  # noqa: A001 - mirrors reference name
+    import builtins
+    nd = data.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = (tuple(step) + (None,) * (nd - len(step))) if step else (None,) * nd
+    idx = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, axis, begin, end):
+    import builtins
+    idx = [builtins.slice(None)] * data.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    import builtins
+    idx = [builtins.slice(None)] * data.ndim
+    axes = axes or range(min(data.ndim, shape_like.ndim))
+    for a in axes:
+        idx[a] = builtins.slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError("unknown pad mode %r" % mode)
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, bs, bs, c // (bs * bs), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+# ---------------------------------------------------------------------------
+# indexing ops (reference: indexing_op.cc — take/gather_nd/scatter_nd/one_hot,
+# embedding; batch_take)
+# ---------------------------------------------------------------------------
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    indices = indices.astype(jnp.int32)
+    if mode == "raise":
+        # XLA cannot raise data-dependent errors inside a trace; validate
+        # eagerly when the indices are concrete (reference raises at runtime).
+        try:
+            import numpy as _onp
+            idx_np = _onp.asarray(indices)
+            n = a.shape[axis]
+            if idx_np.size and (idx_np.min() < -n or idx_np.max() >= n):
+                raise IndexError(
+                    "take: index out of range for axis %d with size %d"
+                    % (axis, n))
+            mode = "clip"
+        except jax.errors.TracerArrayConversionError:
+            mode = "clip"  # traced: fall back to clip (documented)
+    m = {"clip": "clip", "wrap": "wrap"}[mode]
+    return jnp.take(a, indices, axis=axis, mode=m)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    flat = a.reshape(-1)
+    offs = jnp.arange(a.shape[0]) * a.shape[1]
+    return jnp.take(flat, indices.astype(jnp.int32).reshape(-1) + offs).reshape(indices.shape)
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot")
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return jax.nn.one_hot(indices.astype(jnp.int32), depth).astype(jnp.dtype(dtype)) \
+        * (on_value - off_value) + off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    indices = indices.astype(jnp.int32)
+    m = indices.shape[0]
+    idx = tuple(indices[i] for i in range(m))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape):
+    indices = indices.astype(jnp.int32)
+    m = indices.shape[0]
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices[i] for i in range(m))
+    return out.at[idx].set(data)
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition != 0, x, y)
+
+
+@register("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    # dynamic-shape op: under jit we keep static shape by moving masked rows
+    # to the front and zero-padding (reference runs it un-jitted; eager here
+    # returns the compacted result).
+    mask = index != 0
+    try:
+        idx = jnp.nonzero(mask)[0]
+        return jnp.take(data, idx, axis=axis)
+    except jax.errors.ConcretizationTypeError:
+        order = jnp.argsort(~mask)
+        return jnp.take(data, order, axis=axis) * jnp.sort(mask)[::-1].reshape(
+            (-1,) + (1,) * (data.ndim - 1)).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# init ops (reference: init_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("zeros")
+def zeros(shape, dtype="float32"):
+    return jnp.zeros(tuple(shape) if hasattr(shape, "__len__") else (shape,), jnp.dtype(dtype))
+
+
+@register("ones")
+def ones(shape, dtype="float32"):
+    return jnp.ones(tuple(shape) if hasattr(shape, "__len__") else (shape,), jnp.dtype(dtype))
+
+
+@register("full")
+def full(shape, val, dtype="float32"):
+    return jnp.full(tuple(shape) if hasattr(shape, "__len__") else (shape,), val, jnp.dtype(dtype))
+
+
+@register("arange")
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+register("eye")(lambda N, M=0, k=0, dtype="float32":
+                jnp.eye(N, M or None, k=k, dtype=jnp.dtype(dtype)))
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: ordering_op.cc — topk/sort/argsort)
+# ---------------------------------------------------------------------------
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    x = data if not is_ascend else -data
+    x = jnp.moveaxis(x, axis, -1)
+    vals, idxs = lax.top_k(x, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    return idxs
+
+
+register("sort")(lambda data, axis=-1, is_ascend=True:
+                 jnp.sort(data, axis=axis) if is_ascend else -jnp.sort(-data, axis=axis))
+register("argsort")(lambda data, axis=-1, is_ascend=True, dtype="float32":
+                    (jnp.argsort(data, axis=axis) if is_ascend
+                     else jnp.argsort(-data, axis=axis)).astype(jnp.dtype(dtype)))
+
+
+@register("shuffle", aliases=("_shuffle",))
+def shuffle(data, key=None):
+    from . import random as _rnd
+    key = key if key is not None else _rnd.next_key()
+    return jax.random.permutation(key, data, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference: sequence_mask/last/reverse — padding utilities)
+# ---------------------------------------------------------------------------
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :]  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length - 1).astype(jnp.int32)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    T = moved.shape[0]
+    steps = jnp.arange(T)[:, None]                      # (T,1)
+    lens = sequence_length[None, :].astype(jnp.int32)   # (1,B)
+    src = jnp.where(steps < lens, lens - 1 - steps, steps)  # (T,B)
+    out = jnp.take_along_axis(moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)

@@ -1,0 +1,8 @@
+from .optimizer import (Optimizer, Updater, get_updater, create, register,
+                        SGD, NAG, Signum, SGLD, Adam, AdamW, AdaGrad, RMSProp,
+                        AdaDelta, Adamax, Nadam, Ftrl, FTML, DCASGD, LBSGD)
+
+__all__ = ["Optimizer", "Updater", "get_updater", "create", "register",
+           "SGD", "NAG", "Signum", "SGLD", "Adam", "AdamW", "AdaGrad",
+           "RMSProp", "AdaDelta", "Adamax", "Nadam", "Ftrl", "FTML",
+           "DCASGD", "LBSGD"]

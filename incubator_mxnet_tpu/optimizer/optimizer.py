@@ -1,0 +1,729 @@
+"""Optimizers with fused jitted update rules.
+
+Reference parity: python/mxnet/optimizer/optimizer.py (registry, per-param
+lr/wd multipliers, create_state, num_update tracking) + the fused C++ update
+ops in src/operator/optimizer_op.cc (sgd_update, sgd_mom_update, adam_update,
+ftrl_update, rmsprop_update, signsgd_update, nag_update...) per SURVEY §2.6.
+
+TPU-first: each update rule is one jit-compiled XLA program per (shape,
+dtype) — the analogue of the reference's fused multi-tensor optimizer
+kernels; hybridized training steps instead inline these rules into the one
+compiled step via gluon.Trainer.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["Optimizer", "register", "create", "Updater", "get_updater"]
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _OPT_REGISTRY[name.lower()](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:46 Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self._index_update_count = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult, self.wd_mult = {}, {}
+
+    # -- state ---------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        # fp32 master copy for low-precision weights (reference: mp_sgd_update)
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master = NDArray(weight._data.astype(jnp.float32))
+            return (master, self.create_state(index, NDArray(master._data)))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master, inner = state
+            g32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, master, g32, inner)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- hyperparams ---------------------------------------------------------
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _prep(self, grad_val):
+        g = grad_val * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+# ---------------------------------------------------------------------------
+# jitted update kernels (analogue of optimizer_op.cc fused ops)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _sgd_update(w, g, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    return w - lr * (g + wd * w)
+
+
+@jax.jit
+def _sgd_mom_update(w, g, mom, lr, wd, momentum, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    mom = momentum * mom - lr * (g + wd * w)
+    return w + mom, mom
+
+
+@jax.jit
+def _nag_update(w, g, mom, lr, wd, momentum, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    mom = momentum * mom + g
+    return w - lr * (momentum * mom + g), mom
+
+
+@jax.jit
+def _adam_update(w, g, m, v, lr, wd, b1, b2, eps, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    coef = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+    return w - coef * m / (jnp.sqrt(v) + eps), m, v
+
+
+@jax.jit
+def _adamw_update(w, g, m, v, lr, wd, eta, b1, b2, eps, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** t)
+    vhat = v / (1 - b2 ** t)
+    return w - eta * (lr * mhat / (jnp.sqrt(vhat) + eps) + wd * w), m, v
+
+
+@jax.jit
+def _adagrad_update(w, g, h, lr, wd, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    h = h + g * g
+    return w - lr * g / (jnp.sqrt(h) + eps), h
+
+
+@jax.jit
+def _rmsprop_update(w, g, n, lr, wd, rho, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    n = rho * n + (1 - rho) * g * g
+    return w - lr * g / (jnp.sqrt(n + eps)), n
+
+
+@jax.jit
+def _rmspropalex_update(w, g, n, gavg, delta, lr, wd, rho, momentum, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    n = rho * n + (1 - rho) * g * g
+    gavg = rho * gavg + (1 - rho) * g
+    delta = momentum * delta - lr * g / jnp.sqrt(n - gavg * gavg + eps)
+    return w + delta, n, gavg, delta
+
+
+@jax.jit
+def _adadelta_update(w, g, acc_g, acc_d, wd, rho, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    acc_g = rho * acc_g + (1 - rho) * g * g
+    d = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+    acc_d = rho * acc_d + (1 - rho) * d * d
+    return w - d, acc_g, acc_d
+
+
+@jax.jit
+def _adamax_update(w, g, m, u, lr, wd, b1, b2, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    m = b1 * m + (1 - b1) * g
+    u = jnp.maximum(b2 * u, jnp.abs(g))
+    return w - (lr / (1 - b1 ** t)) * m / (u + 1e-8), m, u
+
+
+@jax.jit
+def _nadam_update(w, g, m, v, lr, wd, b1, b2, eps, t, m_schedule, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    mt = b1 * (1 - 0.5 * 0.96 ** (t * 0.004))
+    mt1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * 0.004))
+    m_schedule_new = m_schedule * mt
+    m_schedule_next = m_schedule_new * mt1
+    gp = g / (1 - m_schedule_new)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mp = m / (1 - m_schedule_next)
+    vp = v / (1 - b2 ** t)
+    mbar = (1 - mt) * gp + mt1 * mp
+    return w - lr * mbar / (jnp.sqrt(vp) + eps), m, v, m_schedule_new
+
+
+@jax.jit
+def _ftrl_update(w, g, z, n, lr, wd, lamda1, beta, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    n_new = n + g * g
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * w
+    n = n_new
+    w = jnp.where(jnp.abs(z) > lamda1,
+                  -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n)) / lr + wd),
+                  0.0)
+    return w, z, n
+
+
+@jax.jit
+def _signsgd_update(w, g, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    return w - lr * (jnp.sign(g) + wd * w)
+
+
+@jax.jit
+def _signum_update(w, g, mom, lr, wd, momentum, wd_lh, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    mom = momentum * mom - (1 - momentum) * (g + wd * w)
+    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom), mom
+
+
+@jax.jit
+def _ftml_update(w, g, d, sig, z, v, lr, wd, b1, b2, eps, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    v = b2 * v + (1 - b2) * g * g
+    d_new = (1 - b1 ** t) / lr * (jnp.sqrt(v / (1 - b2 ** t)) + eps)
+    sig_new = d_new - b1 * d
+    z_new = b1 * z + (1 - b1) * g - sig_new * w
+    return -z_new / d_new, d_new, sig_new, z_new, v
+
+
+@jax.jit
+def _sgld_update(w, g, lr, wd, noise, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    return w - lr / 2 * g + jnp.sqrt(lr) * noise
+
+
+# ---------------------------------------------------------------------------
+# optimizer classes
+# ---------------------------------------------------------------------------
+
+def _c(x):
+    """Pack possibly-None clip as a jax scalar (<=0 means no clipping)."""
+    return jnp.float32(x if x is not None else -1.0)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision (reference: sgd_update /
+    sgd_mom_update / mp_sgd_update in optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            weight._data = _sgd_update(weight._data, grad._data,
+                                       jnp.float32(lr), jnp.float32(wd),
+                                       jnp.float32(self.rescale_grad),
+                                       _c(self.clip_gradient))
+        else:
+            weight._data, state._data = _sgd_mom_update(
+                weight._data, grad._data, state._data, jnp.float32(lr),
+                jnp.float32(wd), jnp.float32(self.momentum),
+                jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@jax.jit
+def _lars_sgd_mom_update(w, g, mom, lr, wd, momentum, rescale, clip):
+    """LARS-scaled momentum SGD, fully on-device (no host sync)."""
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    wnorm = jnp.linalg.norm(w)
+    gnorm = jnp.linalg.norm(g)
+    lars = wnorm / (gnorm + wd * wnorm + 1e-9)
+    lars = jnp.where((wnorm > 0) & (gnorm > 0), jnp.minimum(lars, 100.0), 1.0)
+    eff_lr = lr * lars
+    mom = momentum * mom - eff_lr * (g + wd * w)
+    return w + mom, mom
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD: LARS layer-wise rate scaling + linear/power warmup
+    (reference: optimizer.py LBSGD). The per-layer norms stay on-device
+    inside one jitted kernel — no host round-trips."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.warmup_updates = int(warmup_epochs * updates_per_epoch)
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def _warmed_lr(self, index):
+        lr = self._get_lr(index)
+        t = self._index_update_count.get(index, self.begin_num_update)
+        if self.warmup_updates > 0 and t < self.warmup_updates:
+            frac = t / float(self.warmup_updates)
+            if self.warmup_strategy == "linear":
+                lr = lr * (1.0 / self.batch_scale +
+                           (1 - 1.0 / self.batch_scale) * frac)
+            elif self.warmup_strategy == "power2":
+                lr = lr * (1.0 / self.batch_scale +
+                           (1 - 1.0 / self.batch_scale) * frac * frac)
+            # 'sqrt'/none: keep base lr
+        return lr
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight._data, state._data = _lars_sgd_mom_update(
+            weight._data, grad._data, state._data,
+            jnp.float32(self._warmed_lr(index)),
+            jnp.float32(self._get_wd(index)), jnp.float32(self.momentum),
+            jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight._data, state._data = _nag_update(
+            weight._data, grad._data, state._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.momentum), jnp.float32(self.rescale_grad),
+            _c(self.clip_gradient))
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        m, v = state
+        weight._data, m._data, v._data = _adam_update(
+            weight._data, grad._data, m._data, v._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.beta1), jnp.float32(self.beta2),
+            jnp.float32(self.epsilon), jnp.float32(t),
+            jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference: contrib adamw_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        m, v = state
+        weight._data, m._data, v._data = _adamw_update(
+            weight._data, grad._data, m._data, v._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.eta), jnp.float32(self.beta1),
+            jnp.float32(self.beta2), jnp.float32(self.epsilon), jnp.float32(t),
+            jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight._data, state._data = _adagrad_update(
+            weight._data, grad._data, state._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.float_stable_eps),
+            jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon, self.centered = epsilon, centered
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        if self.centered:
+            return (NDArray(z), NDArray(z), NDArray(z))
+        return NDArray(z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index))
+        if self.centered:
+            n, gavg, delta = state
+            weight._data, n._data, gavg._data, delta._data = _rmspropalex_update(
+                weight._data, grad._data, n._data, gavg._data, delta._data,
+                lr, wd, jnp.float32(self.gamma1), jnp.float32(self.gamma2),
+                jnp.float32(self.epsilon), jnp.float32(self.rescale_grad),
+                _c(self.clip_gradient))
+        else:
+            weight._data, state._data = _rmsprop_update(
+                weight._data, grad._data, state._data, lr, wd,
+                jnp.float32(self.gamma1), jnp.float32(self.epsilon),
+                jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        acc_g, acc_d = state
+        weight._data, acc_g._data, acc_d._data = _adadelta_update(
+            weight._data, grad._data, acc_g._data, acc_d._data,
+            jnp.float32(self._get_wd(index)), jnp.float32(self.rho),
+            jnp.float32(self.epsilon), jnp.float32(self.rescale_grad),
+            _c(self.clip_gradient))
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        m, u = state
+        weight._data, m._data, u._data = _adamax_update(
+            weight._data, grad._data, m._data, u._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.beta1), jnp.float32(self.beta2), jnp.float32(t),
+            jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        m, v = state
+        w, m_, v_, msched = _nadam_update(
+            weight._data, grad._data, m._data, v._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.beta1), jnp.float32(self.beta2),
+            jnp.float32(self.epsilon), jnp.float32(t),
+            jnp.float32(self.m_schedule), jnp.float32(self.rescale_grad),
+            _c(self.clip_gradient))
+        weight._data, m._data, v._data = w, m_, v_
+        self.m_schedule = float(msched)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        weight._data, z._data, n._data = _ftrl_update(
+            weight._data, grad._data, z._data, n._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.lamda1), jnp.float32(self.beta),
+            jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (reference: signsgd_update, signum_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index))
+        if state is None:
+            weight._data = _signsgd_update(
+                weight._data, grad._data, lr, wd,
+                jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+        else:
+            weight._data, state._data = _signum_update(
+                weight._data, grad._data, state._data, lr, wd,
+                jnp.float32(self.momentum), jnp.float32(self.wd_lh),
+                jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z), NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        d, sigma, z, v = state
+        weight._data, d._data, sigma._data, z._data, v._data = _ftml_update(
+            weight._data, grad._data, d._data, sigma._data, z._data, v._data,
+            jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
+            jnp.float32(self.beta1), jnp.float32(self.beta2),
+            jnp.float32(self.epsilon), jnp.float32(t),
+            jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z) if self.momentum != 0 else None,
+                NDArray(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        mom, prev = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        comp = g + wd * weight._data + self.lamda * g * g * (weight._data - prev._data)
+        if mom is None:
+            delta = -lr * comp
+        else:
+            mom._data = self.momentum * mom._data - lr * comp
+            delta = mom._data
+        prev._data = weight._data
+        weight._data = weight._data + delta
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ops import random as _rnd
+        noise = jax.random.normal(_rnd.next_key(), weight.shape,
+                                  weight._data.dtype)
+        weight._data = _sgld_update(weight._data, grad._data, jnp.float32(lr),
+                                    jnp.float32(wd), noise,
+                                    jnp.float32(self.rescale_grad),
+                                    _c(self.clip_gradient))
+
+
+# ---------------------------------------------------------------------------
+# Updater (kvstore-side optimizer application; reference: optimizer.py:1621)
+# ---------------------------------------------------------------------------
+
+class Updater:
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        return pickle.dumps({k: _state_numpy(v) for k, v in self.states.items()})
+
+    def set_states(self, states):
+        import pickle
+        raw = pickle.loads(states)
+        self.states = {k: _state_from_numpy(v) for k, v in raw.items()}
+
+
+def _state_numpy(state):
+    import numpy as np
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(_state_numpy(s) for s in state)
+    return np.asarray(state._data)
+
+
+def _state_from_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_from_numpy(s) for s in state)
+    return NDArray(jnp.asarray(state))
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,23 @@
+"""mx.parallel — sharding-based parallelism over a TPU device mesh.
+
+This is the TPU-native superset of the reference's parallelism (SURVEY §2.4:
+data parallelism via KVStore + manual ctx-group model parallelism). One
+`jax.sharding.Mesh` + per-parameter PartitionSpec rules give dp/tp/sp/pp/ep;
+XLA inserts the collectives (psum/all-gather/reduce-scatter) over ICI — the
+role NCCL/ps-lite play in the reference.
+
+Components:
+  * make_mesh / named axes helpers
+  * ShardedTrainer — compile a gluon HybridBlock's FULL train step
+    (fwd+bwd+optimizer) as one pjit program with sharded params
+  * ring_attention — sequence-parallel attention via shard_map + ppermute
+  * collectives — thin wrappers (all_reduce/all_gather/...)
+"""
+
+from .mesh import make_mesh, replicate, shard_like, P
+from .trainer import ShardedTrainer, sharding_rules
+from .ring_attention import ring_attention, local_attention
+from . import collectives
+
+__all__ = ["make_mesh", "replicate", "shard_like", "P", "ShardedTrainer",
+           "sharding_rules", "ring_attention", "local_attention", "collectives"]

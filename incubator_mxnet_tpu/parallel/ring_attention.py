@@ -1,0 +1,90 @@
+"""Ring attention — sequence/context parallelism over the mesh.
+
+Net-new capability vs the reference (SURVEY §5: long-context/SP absent
+there); required for long sequences on TPU. Implements blockwise ring
+attention: Q stays local per sequence shard, K/V blocks rotate around the
+ring via ppermute while running log-sum-exp-stable partial softmax
+accumulation. Use inside shard_map with the sequence axis sharded.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "local_attention", "make_ring_attention"]
+
+
+def local_attention(q, k, v, scale=None, causal=False, q_offset=0, kv_offset=0):
+    """Plain attention on local blocks. q: (B, H, Tq, D), k/v: (B, H, Tk, D).
+    Returns (out, logsumexp-stats) pieces: (num, denom, max)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[2])[:, None]
+        ki = kv_offset + jnp.arange(k.shape[2])[None, :]
+        scores = jnp.where(qi >= ki, scores, -1e30)
+    m = jnp.max(scores, axis=-1, keepdims=True)           # (B,H,Tq,1)
+    p = jnp.exp(scores - m)
+    num = jnp.einsum("bhqk,bhkd->bhqd", p, v)             # (B,H,Tq,D)
+    denom = jnp.sum(p, axis=-1, keepdims=True)            # (B,H,Tq,1)
+    return num, denom, m
+
+
+def _merge(acc_num, acc_den, acc_max, num, den, m):
+    new_max = jnp.maximum(acc_max, m)
+    a = jnp.exp(acc_max - new_max)
+    b = jnp.exp(m - new_max)
+    return acc_num * a + num * b, acc_den * a + den * b, new_max
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Blockwise ring attention inside shard_map; sequence axis sharded on
+    ``axis_name``. q/k/v: (B, H, T_local, D) per shard."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    q_offset = idx * t_local
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, _):
+        k_blk, v_blk, blk_idx, acc_num, acc_den, acc_max = carry
+        kv_offset = blk_idx * t_local
+        num, den, m = local_attention(q, k_blk, v_blk, scale=scale,
+                                      causal=causal, q_offset=q_offset,
+                                      kv_offset=kv_offset)
+        acc_num, acc_den, acc_max = _merge(acc_num, acc_den, acc_max,
+                                           num, den, m)
+        # rotate K/V to the next ring position (overlaps with next compute
+        # in XLA's async collective scheduling)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        idx_next = lax.ppermute(blk_idx, axis_name, perm)
+        return (k_next, v_next, idx_next, acc_num, acc_den, acc_max), None
+
+    acc_num = jnp.zeros_like(q)
+    acc_den = jnp.zeros(q.shape[:-1] + (1,), q.dtype)
+    acc_max = jnp.full(q.shape[:-1] + (1,), -1e30, q.dtype)
+    carry = (k, v, idx, acc_num, acc_den, acc_max)
+    carry, _ = lax.scan(body, carry, None, length=n)
+    _, _, _, acc_num, acc_den, acc_max = carry
+    return acc_num / jnp.maximum(acc_den, 1e-30)
+
+
+def make_ring_attention(mesh, seq_axis="sp", causal=False):
+    """Return a jit-able attention fn over globally-sharded (B,H,T,D) arrays:
+    shard_map'ing ring_attention over the sequence axis."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, seq_axis, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, seq_axis, causal=causal)
+
+    return fn

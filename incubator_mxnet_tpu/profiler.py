@@ -1,0 +1,181 @@
+"""Profiler — scoped config + chrome-trace dump + aggregate stats.
+
+Reference parity: src/profiler/profiler.h + python/mxnet/profiler.py
+(set_config(profile_all, aggregate_stats, filename), start/stop scopes,
+custom Task/Frame/Event/Counter/Marker, dumps()) per SURVEY §5.
+
+TPU-first: wraps jax.profiler (XPlane -> TensorBoard/perfetto trace) for
+device timelines, plus a host-side event recorder that emits the same
+chrome://tracing JSON the reference writes, and an aggregate table.
+"""
+
+import atexit
+import json
+import threading
+import time
+
+import jax
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
+           "Task", "Frame", "Event", "Counter", "Marker", "scope"]
+
+_config = {"filename": "profile.json", "aggregate_stats": False,
+           "profile_all": False, "profile_symbolic": True,
+           "profile_imperative": True, "profile_memory": False,
+           "profile_api": False, "continuous_dump": False}
+_state = {"running": False, "jax_trace_dir": None}
+_events = []
+_lock = threading.Lock()
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def start(profile_process="worker"):
+    _state["running"] = True
+    _events.clear()
+    if _config.get("use_xplane"):
+        _state["jax_trace_dir"] = _config.get("xplane_dir", "/tmp/jax-trace")
+        jax.profiler.start_trace(_state["jax_trace_dir"])
+    _record("profiler", "start")
+
+
+def stop(profile_process="worker"):
+    _record("profiler", "stop")
+    _state["running"] = False
+    if _state.get("jax_trace_dir"):
+        jax.profiler.stop_trace()
+        _state["jax_trace_dir"] = None
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+def _record(category, name, ph="i", ts=None, dur=None, args=None):
+    if not _state["running"] and name not in ("start", "stop"):
+        return
+    ev = {"cat": category, "name": name, "ph": ph, "pid": 0,
+          "tid": threading.get_ident() % 100000,
+          "ts": (ts if ts is not None else time.time() * 1e6)}
+    if dur is not None:
+        ev["dur"] = dur
+        ev["ph"] = "X"
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
+def dump(finished=True, profile_process="worker"):
+    with _lock:
+        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(data, f)
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats table (reference: aggregate_stats.cc dump)."""
+    with _lock:
+        evs = [e for e in _events if e.get("ph") == "X"]
+    agg = {}
+    for e in evs:
+        name = e["name"]
+        st = agg.setdefault(name, {"count": 0, "total": 0.0, "min": 1e30, "max": 0.0})
+        st["count"] += 1
+        st["total"] += e["dur"]
+        st["min"] = min(st["min"], e["dur"])
+        st["max"] = max(st["max"], e["dur"])
+    lines = ["%-40s %8s %12s %12s %12s %12s" % ("Name", "Count",
+                                                "Total(us)", "Min(us)",
+                                                "Max(us)", "Avg(us)")]
+    for name, st in sorted(agg.items(), key=lambda kv: -kv[1]["total"]):
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f" % (
+            name, st["count"], st["total"], st["min"], st["max"],
+            st["total"] / st["count"]))
+    if reset:
+        with _lock:
+            _events.clear()
+    return "\n".join(lines)
+
+
+class _Scoped:
+    _category = "event"
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.time() * 1e6
+
+    def stop(self):
+        if self._t0 is not None:
+            _record(self._category, self.name, ts=self._t0,
+                    dur=time.time() * 1e6 - self._t0)
+            self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scoped):
+    _category = "task"
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Frame(_Scoped):
+    _category = "frame"
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Event(_Scoped):
+    _category = "event"
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+        _record("counter", self.name, ph="C", args={"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    __iadd__ = lambda self, d: (self.increment(d), self)[1]
+    __isub__ = lambda self, d: (self.decrement(d), self)[1]
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _record("marker", self.name, ph="i")
+
+
+def scope(name):
+    """Annotate device work with a named trace scope (jax TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+atexit.register(lambda: dump() if _events and _config.get("continuous_dump") else None)

@@ -1,0 +1,70 @@
+"""Runtime feature detection.
+
+Reference parity: include/mxnet/libinfo.h:131-190 + python/mxnet/runtime.py
+(mx.runtime.Features). Features reflect this build's actual capabilities.
+"""
+
+import jax
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "[%s: %s]" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    backend = jax.default_backend()
+    feats = {
+        "TPU": backend not in ("cpu",),
+        "XLA": True,
+        "PALLAS": True,
+        "CUDA": False, "CUDNN": False, "NCCL": False, "TENSORRT": False,
+        "MKLDNN": False,
+        "BLAS_OPEN": True,
+        "LAPACK": True,
+        "OPENMP": False,
+        "SSE": False, "F16C": True,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": False,
+        "DEBUG": False,
+        "DIST_KVSTORE": True,
+        "ICI_COLLECTIVES": True,
+        "GRAD_COMPRESSION_2BIT": True,
+        "OPENCV": _has_cv2(),
+        "JPEG_TURBO": _has_cv2(),
+        "SPARSE": True,
+        "PROFILER": True,
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+def _has_cv2():
+    try:
+        import cv2  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature '%s' is unknown" % feature_name)
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

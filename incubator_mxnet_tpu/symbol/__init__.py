@@ -1,0 +1,437 @@
+"""mx.sym — declarative graph building + JSON serialization.
+
+Reference parity: python/mxnet/symbol/symbol.py (compose ops into a DAG,
+infer_shape/infer_type, tojson/load, Group, simple_bind/bind/eval) per
+SURVEY §2.6, over NNVM Graph (§2.2).
+
+TPU-first: a Symbol is a lightweight Python DAG over the same registered
+pure ops the eager/hybrid paths use; "binding" produces an Executor whose
+forward is evaluated through the NDArray frontend (so autograd works) and
+can be jit-compiled as one XLA program. JSON import/export gives checkpoint
+interchange and SymbolBlock support.
+"""
+
+import json
+
+import numpy as _np
+
+from ..ops.registry import get_op
+from ..ndarray import NDArray
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "executor_eval", "block_to_json"]
+
+
+class Symbol:
+    """A node (or multi-output view) in the symbolic graph."""
+
+    def __init__(self, op, name, inputs, attrs=None, num_outputs=1, out_index=None):
+        self._op = op                 # None for variables, "_group" for groups
+        self._name = name
+        self._inputs = inputs         # list[Symbol]
+        self._attrs = dict(attrs or {})
+        self._num_outputs = num_outputs
+        self._out_index = out_index   # not None => single-output view
+
+    # ------------------------------------------------------------- identity
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def list_attr(self):
+        return dict(self._attrs)
+
+    def __repr__(self):
+        return "<Symbol %s>" % self._name
+
+    def __iter__(self):
+        if self._op == "_group":
+            return iter(self._inputs)
+        return iter([self[i] for i in range(self._num_outputs)])
+
+    def __getitem__(self, index):
+        if self._op == "_group":
+            return self._inputs[index]
+        if isinstance(index, int):
+            if self._num_outputs == 1 and index == 0:
+                return self
+            return Symbol(self._op, self._name, self._inputs, self._attrs,
+                          self._num_outputs, out_index=index)
+        raise TypeError("index must be int")
+
+    # ------------------------------------------------------------ arithmetic
+    def _binop(self, other, opname, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _make_apply(opname, [a, b], {})
+        scalar_op = {"broadcast_add": "_plus_scalar",
+                     "broadcast_subtract": "_minus_scalar" if not reverse else "_rminus_scalar",
+                     "broadcast_multiply": "_mul_scalar",
+                     "broadcast_divide": "_div_scalar" if not reverse else "_rdiv_scalar",
+                     "broadcast_power": "_power_scalar" if not reverse else "_rpower_scalar"}[opname]
+        return _make_apply(scalar_op, [self], {"scalar": other})
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_subtract")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_subtract", reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_divide")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_divide", reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power")
+
+    def __neg__(self):
+        return _make_apply("negative", [self], {})
+
+    # ------------------------------------------------------------ structure
+    def get_internals(self):
+        nodes = self._topo()
+        return Group([Symbol(n._op, n._name, n._inputs, n._attrs, n._num_outputs)
+                      if n._op else n for n in nodes])
+
+    def list_arguments(self):
+        return [n._name for n in self._topo() if n._op is None
+                and not n._attrs.get("__aux__")]
+
+    def list_auxiliary_states(self):
+        return [n._name for n in self._topo() if n._op is None
+                and n._attrs.get("__aux__")]
+
+    def list_outputs(self):
+        if self._op == "_group":
+            return [s._name + "_output" for s in self._inputs]
+        return ["%s_output%d" % (self._name, i) if self._num_outputs > 1
+                else self._name + "_output" for i in range(self._num_outputs)]
+
+    def list_inputs(self):
+        return [n._name for n in self._topo() if n._op is None]
+
+    def _topo(self):
+        """Topological order of base nodes (views collapsed to their base)."""
+        order, seen = [], set()
+
+        def visit(s):
+            base = s
+            key = (id(base._op), base._name, id(base))
+            if id(base) in seen:
+                return
+            seen.add(id(base))
+            for inp in base._inputs:
+                visit(inp)
+            order.append(base)
+        visit(self)
+        return order
+
+    # --------------------------------------------------------------- shapes
+    def infer_shape(self, **kwargs):
+        import jax
+
+        arg_names = self.list_arguments() + self.list_auxiliary_states()
+        shapes = dict(kwargs)
+        missing = [n for n in arg_names if n not in shapes]
+        if missing:
+            return None, None, None  # partial inference unsupported without hints
+
+        def fn(feed):
+            outs = _eval_symbol(self, {k: v for k, v in feed.items()}, wrap=False)
+            return outs
+
+        feed = {n: jax.ShapeDtypeStruct(tuple(shapes[n]), _np.float32)
+                for n in arg_names}
+        out = jax.eval_shape(fn, feed)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        arg_shapes = [tuple(shapes[n]) for n in self.list_arguments()]
+        aux_shapes = [tuple(shapes[n]) for n in self.list_auxiliary_states()]
+        return arg_shapes, [tuple(o.shape) for o in outs], aux_shapes
+
+    def infer_shape_partial(self, **kwargs):
+        try:
+            return self.infer_shape(**kwargs)
+        except Exception:
+            return None, None, None
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        return ([_np.float32] * len(args), [_np.float32], [])
+
+    # ----------------------------------------------------------------- eval
+    def eval(self, ctx=None, **kwargs):
+        outs = _eval_symbol(self, kwargs, wrap=True)
+        return outs if isinstance(outs, list) else [outs]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from ..executor import Executor
+        from ..ndarray import zeros as nd_zeros
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        args = [nd_zeros(s) for s in arg_shapes]
+        aux = [nd_zeros(s) for s in aux_shapes]
+        grad_arrays = None
+        if grad_req != "null":
+            grad_arrays = [nd_zeros(s) for s in arg_shapes]
+        return Executor(self, ctx, args, grad_arrays, grad_req, aux)
+
+    # ----------------------------------------------------------------- json
+    def tojson(self):
+        nodes = self._topo()
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n._op is None else n._op,
+                "name": n._name,
+                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                          for k, v in n._attrs.items() if not k.startswith("__")},
+                "inputs": [[idx[id(i)], getattr(i, "_out_index", 0) or 0, 0]
+                           for i in n._inputs],
+            })
+        if self._op == "_group":
+            heads = [[idx[id(s)], s._out_index or 0, 0] for s in self._inputs]
+        else:
+            heads = [[idx[id(self)], self._out_index or 0, 0]]
+        arg_nodes = [i for i, n in enumerate(nodes) if n._op is None]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"framework": "incubator_mxnet_tpu",
+                                     "mxnet_version": ["int", 10500]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_list_nodes(self):
+        nodes = self._topo()
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        return [{"name": n._name, "op": n._op or "null",
+                 "inputs": [i._name for i in n._inputs]} for n in nodes]
+
+
+_name_counter = {}
+
+
+def _auto_name(hint):
+    c = _name_counter.get(hint, 0)
+    _name_counter[hint] = c + 1
+    return "%s%d" % (hint, c)
+
+
+def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
+        **kwargs):
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    attrs.update(kwargs)
+    return Symbol(None, name, [], attrs)
+
+
+Variable = var
+
+
+def Group(symbols):
+    return Symbol("_group", _auto_name("group"), list(symbols))
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _make_apply("zeros", [], {"shape": shape, "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _make_apply("ones", [], {"shape": shape, "dtype": dtype})
+
+
+def _make_apply(opname, input_syms, attrs, name=None):
+    info = get_op(opname)
+    nout = info.num_outputs if isinstance(info.num_outputs, int) else \
+        int(attrs.get(info.num_outputs, 1))
+    return Symbol(info.name, name or _auto_name(opname.lower().strip("_")),
+                  list(input_syms), attrs, num_outputs=nout)
+
+
+def __getattr__(opname):
+    """mx.sym.<Op>(...) — symbol-building function for any registered op."""
+    try:
+        info = get_op(opname)
+    except KeyError:
+        raise AttributeError(opname)
+
+    def sym_fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        input_syms = [a for a in args if isinstance(a, Symbol)]
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                input_syms.append(v)
+                attrs.setdefault("__kwarg_inputs__", []).append(
+                    (k, len(input_syms) - 1))
+        return _make_apply(opname, input_syms, attrs, name)
+
+    sym_fn.__name__ = opname
+    return sym_fn
+
+
+# ---------------------------------------------------------------------------
+# evaluation (the GraphExecutor's RunOps; SURVEY §3.4 — here: topo walk
+# through the same registered ops, jit-compilable as one program)
+# ---------------------------------------------------------------------------
+
+def _eval_symbol(sym, feed, wrap=True):
+    """Evaluate a Symbol given name->NDArray (wrap=True) or name->jax value."""
+    from .. import ndarray as nd
+
+    results = {}  # id(node) -> tuple of outputs
+
+    nodes = sym._topo()
+    for n in nodes:
+        if n._op is None:
+            if n._name not in feed:
+                raise ValueError("Missing input %r for symbolic evaluation" % n._name)
+            results[id(n)] = (feed[n._name],)
+        elif n._op == "_group":
+            continue
+        else:
+            attrs = {k: v for k, v in n._attrs.items() if not k.startswith("__")}
+            kw_inputs = n._attrs.get("__kwarg_inputs__", [])
+            in_vals = [results[id(i)][i._out_index or 0] for i in n._inputs]
+            kw = {}
+            for (k, pos) in kw_inputs:
+                kw[k] = in_vals[pos]
+            pos_vals = [v for j, v in enumerate(in_vals)
+                        if j not in [p for _, p in kw_inputs]]
+            if wrap:
+                from ..ndarray.ndarray import _invoke_op
+                out = _invoke_op(n._op, tuple(pos_vals), {**attrs, **kw})
+            else:
+                out = get_op(n._op).fn(*pos_vals, **{**attrs, **kw})
+            results[id(n)] = out if isinstance(out, tuple) else (out,)
+
+    if sym._op == "_group":
+        return [results[id(s)][s._out_index or 0] for s in sym._inputs]
+    outs = results[id(nodes[-1])]
+    if sym._out_index is not None:
+        return outs[sym._out_index]
+    if len(outs) == 1:
+        return outs[0]
+    return list(outs)
+
+
+def executor_eval(sym, feed):
+    return _eval_symbol(sym, feed, wrap=True)
+
+
+# ---------------------------------------------------------------------------
+# JSON load (reference: legacy_json_util upgrade path not needed — we parse
+# both our own exports and simple reference-style graphs)
+# ---------------------------------------------------------------------------
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = data["nodes"]
+    built = []
+    for n in nodes:
+        attrs = {}
+        for k, v in (n.get("attrs") or n.get("param") or {}).items():
+            attrs[k] = _parse_attr(v)
+        inputs = [built[i[0]][i[1]] if i[1] else built[i[0]]
+                  for i in n.get("inputs", [])]
+        if n["op"] == "null":
+            built.append(var(n["name"], attr=attrs))
+        else:
+            info = get_op(n["op"])
+            nout = info.num_outputs if isinstance(info.num_outputs, int) else \
+                int(attrs.get(info.num_outputs, 1))
+            built.append(Symbol(info.name, n["name"], inputs, attrs,
+                                num_outputs=nout))
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    if len(heads) == 1:
+        h = heads[0]
+        node = built[h[0]]
+        return node[h[1]] if h[1] else node
+    return Group([built[h[0]][h[1]] if h[1] else built[h[0]] for h in heads])
+
+
+def _parse_attr(v):
+    if not isinstance(v, str):
+        return v
+    try:
+        return json.loads(v)
+    except (ValueError, TypeError):
+        low = v.strip()
+        if low in ("True", "False"):
+            return low == "True"
+        try:
+            return int(low)
+        except ValueError:
+            pass
+        try:
+            return float(low)
+        except ValueError:
+            pass
+        if low.startswith("(") and low.endswith(")"):
+            try:
+                return tuple(int(x) for x in low[1:-1].split(",") if x.strip())
+            except ValueError:
+                pass
+        return v
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock -> Symbol export
+# ---------------------------------------------------------------------------
+
+def block_to_json(block, input_names=("data",)):
+    """Trace a HybridBlock symbolically and return graph JSON
+    (reference: HybridBlock.export writes -symbol.json)."""
+    import threading
+    from ..gluon.block import _trace_state, _TraceCtx
+    import incubator_mxnet_tpu.symbol as sym_mod
+
+    params = {p.name: p for p in block.collect_params().values()}
+    param_map = {}
+    for name, p in params.items():
+        v = var(name)
+        if p.grad_req == "null":
+            v._attrs["__aux__"] = True
+        param_map[name] = v
+    inputs = [var(n) for n in input_names]
+    ctx = _TraceCtx(param_map, None, training=False)
+    ctx.F = sym_mod
+    prev = getattr(_trace_state, "ctx", None)
+    _trace_state.ctx = ctx
+    try:
+        out = block.forward(*inputs)
+    finally:
+        _trace_state.ctx = prev
+    if isinstance(out, (list, tuple)):
+        out = Group([o for o in out if isinstance(o, Symbol)])
+    return out.tojson()

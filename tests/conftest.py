@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax loads.
+
+Mirrors the reference's test strategy (SURVEY §4): deterministic seeds, CPU
+as the reference backend, multi-device tests without real hardware (the
+reference tests model parallelism on cpu contexts the same way).
+"""
+
+import os
+
+# The sandbox preloads jax at interpreter start (sitecustomize registers the
+# TPU tunnel backend), so env vars alone are too late; XLA_FLAGS must be set
+# before FIRST BACKEND INIT and the platform forced via jax.config.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as _np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Deterministic per-test seeding (reference: with_seed decorator)."""
+    _np.random.seed(0)
+    import incubator_mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,183 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+
+def test_multi_path_accumulation():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 3 * x  # dy/dx = 2x + 3 = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [7.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x  # dz/dx = y = 4
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * x) + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros(2)
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [2.0, 4.0])
+    assert x.grad is g
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    with autograd.record():
+        y = (x * x).sum()
+    grads = autograd.grad(y, x)
+    np.testing.assert_allclose(grads.asnumpy(), 2 * x.asnumpy())
+    assert x.grad is None or np.all(x.grad.asnumpy() == 0)
+
+
+def test_chained_ops_backward():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.tanh(x)).sum()
+    y.backward()
+    xn = x.asnumpy()
+    expected = np.exp(np.tanh(xn)) * (1 - np.tanh(xn) ** 2)
+    np.testing.assert_allclose(x.grad.asnumpy(), expected, rtol=1e-5)
+
+
+def test_multi_output_partial_use():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, 3, axis=1)
+        loss = (parts[0] * 5).sum()
+    loss.backward()
+    expected = np.zeros((2, 3), np.float32)
+    expected[:, 0] = 5
+    np.testing.assert_allclose(x.grad.asnumpy(), expected)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.random.rand(4).astype(np.float32))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    xn = x.asnumpy()
+    s = 1 / (1 + np.exp(-xn))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_no_record_no_grad():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    assert y._node is None
+
+
+def test_softmax_output_grad():
+    data = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype="float32")
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = out.asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(data.grad.asnumpy(), p - onehot, rtol=1e-5,
+                               atol=1e-6)

@@ -1,0 +1,184 @@
+"""NDArray frontend tests (reference: tests/python/unittest/test_ndarray.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    z = nd.zeros((3, 4))
+    assert np.all(z.asnumpy() == 0)
+    o = nd.ones((2, 2), dtype="int32")
+    assert o.asnumpy().dtype == np.int32
+    f = nd.full((2, 2), 7.5)
+    assert np.all(f.asnumpy() == 7.5)
+    r = nd.arange(0, 10, 2)
+    np.testing.assert_array_equal(r.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[6, 8], [10, 12]])
+    np.testing.assert_allclose((a - b).asnumpy(), [[-4, -4], [-4, -4]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[5, 12], [21, 32]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[5, 3], [7 / 3, 2]], rtol=1e-6)
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((2 - a).asnumpy(), [[1, 0], [-1, -2]])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    np.testing.assert_allclose(abs(nd.array([-1.0, 2.0])).asnumpy(), [1, 2])
+
+
+def test_inplace_ops():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), [2, 3])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [4, 6])
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal((a <= b).asnumpy(), [1, 1, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(4, 6))
+    np.testing.assert_array_equal(a[1].asnumpy(), np.arange(6) + 6)
+    np.testing.assert_array_equal(a[1:3].asnumpy(),
+                                  np.arange(24).reshape(4, 6)[1:3])
+    np.testing.assert_array_equal(a[1, 2].asnumpy(), 8)
+    idx = nd.array([0, 2], dtype="int32")
+    np.testing.assert_array_equal(a[idx].asnumpy(),
+                                  np.arange(24).reshape(4, 6)[[0, 2]])
+    a[0] = 0.0
+    assert np.all(a.asnumpy()[0] == 0)
+    a[1, 1] = 99.0
+    assert a.asnumpy()[1, 1] == 99
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert a.reshape(4, 3).shape == (4, 3)
+    assert a.reshape((2, 6)).shape == (2, 6)
+    assert a.reshape(-1).shape == (12,)
+    assert a.T.shape == (4, 3)
+    assert a.transpose().shape == (4, 3)
+    assert nd.reshape(a, shape=(0, -1)).shape == (3, 4)
+    assert a.expand_dims(0).shape == (1, 3, 4)
+    assert nd.squeeze(a.expand_dims(0)).shape == (3, 4)
+
+
+def test_mxnet_special_reshape():
+    a = nd.zeros((2, 3, 4))
+    assert nd.reshape(a, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(a, shape=(0, -3)).shape == (2, 12)
+    assert nd.reshape(a, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(a.sum(axis=1).asnumpy(), x.sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(a.mean(axis=(0, 2)).asnumpy(),
+                               x.mean(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(a.max(axis=0).asnumpy(), x.max(axis=0))
+    np.testing.assert_allclose(
+        nd.sum(a, axis=1, exclude=True).asnumpy(), x.sum(axis=(0, 2)), rtol=1e-4)
+    np.testing.assert_allclose(a.norm().asnumpy(),
+                               np.sqrt((x ** 2).sum()), rtol=1e-5)
+
+
+def test_dot():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(x), nd.array(y)).asnumpy(),
+                               x @ y, rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True).asnumpy(),
+        x @ y, rtol=1e-4)
+    bx = np.random.rand(2, 3, 4).astype(np.float32)
+    by = np.random.rand(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(),
+                               bx @ by, rtol=1e-4)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    c2 = nd.Concat(a, b, dim=1)
+    assert c2.shape == (2, 6)
+    parts = nd.split(nd.array(np.arange(12).reshape(4, 3)), 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.npz")
+    a = nd.array([1.0, 2.0])
+    b = nd.array([[3.0]])
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    np.testing.assert_array_equal(loaded["a"].asnumpy(), a.asnumpy())
+    np.testing.assert_array_equal(loaded["b"].asnumpy(), b.asnumpy())
+    fname2 = str(tmp_path / "list.npz")
+    nd.save(fname2, [a, b])
+    loaded2 = nd.load(fname2)
+    assert isinstance(loaded2, list) and len(loaded2) == 2
+
+
+def test_astype_copy_context():
+    a = nd.array([1.5, 2.5])
+    assert a.astype("int32").asnumpy().dtype == np.int32
+    b = a.copy()
+    b[0] = 9.0
+    assert a.asnumpy()[0] == 1.5
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type == "cpu"
+    assert float(a[0].asscalar()) == 1.5
+
+
+def test_take_pick_onehot():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_array_equal(
+        nd.take(a, nd.array([0, 2])).asnumpy(),
+        np.arange(12).reshape(3, 4)[[0, 2]])
+    picked = nd.pick(a, nd.array([0, 1, 2]), axis=1)
+    np.testing.assert_array_equal(picked.asnumpy(), [0, 5, 10])
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    np.testing.assert_array_equal(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype=np.float32)
+    a = nd.array(x)
+    idx = nd.topk(a, k=2)
+    np.testing.assert_array_equal(idx.asnumpy(), [[0, 2], [1, 2]])
+    vals = nd.topk(a, k=1, ret_typ="value")
+    np.testing.assert_array_equal(vals.asnumpy(), [[3], [5]])
+    np.testing.assert_array_equal(nd.sort(a).asnumpy(), np.sort(x, axis=-1))
+    np.testing.assert_array_equal(nd.argsort(a).asnumpy(),
+                                  np.argsort(x, axis=-1))
+
+
+def test_waitall_and_wait_to_read():
+    a = nd.ones((10, 10))
+    b = a * 2
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 2
