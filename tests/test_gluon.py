@@ -1,0 +1,333 @@
+"""Gluon API tests (reference: tests/python/unittest/test_gluon.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def test_parameter_basics():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    assert p.data().shape == (3, 4)
+    assert np.all(p.data().asnumpy() == 1)
+    assert p.grad().shape == (3, 4)
+    p.set_data(nd.zeros((3, 4)))
+    assert np.all(p.data().asnumpy() == 0)
+    p.grad_req = "null"
+    assert p.data()._grad is None
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter("w", shape=(5, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape_inferred((5, 7))
+    assert p.data().shape == (5, 7)
+
+
+def test_dense_forward_and_repr():
+    layer = nn.Dense(4, in_units=3, use_bias=True)
+    layer.initialize(mx.init.One())
+    x = nd.ones((2, 3))
+    out = layer(x)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 4), 3.0))
+    assert "Dense" in repr(layer)
+
+
+def test_dense_deferred_in_units():
+    layer = nn.Dense(4)
+    layer.initialize()
+    out = layer(nd.ones((2, 7)))
+    assert out.shape == (2, 4)
+    assert layer.weight.shape == (4, 7)
+
+
+def test_sequential_and_getitem():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((2, 5)))
+    assert out.shape == (2, 4)
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_name_scopes_unique():
+    net1 = nn.Dense(2)
+    net2 = nn.Dense(2)
+    assert net1.prefix != net2.prefix
+    seq = nn.HybridSequential(prefix="model_")
+    with seq.name_scope():
+        d = nn.Dense(2)
+    assert d.prefix.startswith("model_")
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Dense(2, in_units=2), nn.BatchNorm(in_channels=2))
+    net.initialize()
+    all_params = net.collect_params()
+    assert len(all_params._params) == 6
+    only_weight = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in only_weight.keys())
+
+
+def test_batchnorm_layer_updates_stats():
+    layer = nn.BatchNorm(in_channels=3, momentum=0.5)
+    layer.initialize()
+    x = nd.array(np.random.rand(8, 3, 4, 4).astype(np.float32) + 5.0)
+    before = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        layer(x)
+    after = layer.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+    # inference doesn't update stats
+    before2 = layer.running_mean.data().asnumpy().copy()
+    layer(x)
+    np.testing.assert_allclose(layer.running_mean.data().asnumpy(), before2)
+
+
+def test_batchnorm_stats_update_hybridized():
+    layer = nn.BatchNorm(in_channels=3, momentum=0.5)
+    layer.initialize()
+    layer.hybridize()
+    x = nd.array(np.random.rand(8, 3, 2, 2).astype(np.float32) + 1.0)
+    before = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        layer(x)
+    after = layer.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_hybridize_consistency_mixed_net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.MaxPool2D(),
+                nn.Flatten(), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_step_updates_params():
+    net = nn.Dense(1, in_units=2)
+    net.initialize(mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    assert not np.allclose(w_before, w_after)
+    assert trainer.learning_rate == 0.1
+    trainer.set_learning_rate(0.01)
+    assert trainer.learning_rate == 0.01
+
+
+def test_training_reduces_loss_mlp():
+    np.random.seed(0)
+    X = np.random.rand(128, 10).astype(np.float32)
+    w_true = np.random.rand(10, 1).astype(np.float32)
+    Y = X @ w_true
+    net = nn.Dense(1)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(60):
+        with autograd.record():
+            out = net(nd.array(X))
+            loss = loss_fn(out, nd.array(Y))
+        loss.backward()
+        trainer.step(X.shape[0])
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+    x = nd.ones((1, 3))
+    expected = net(x).asnumpy()
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(fname)
+    np.testing.assert_allclose(net2(x).asnumpy(), expected, rtol=1e-6)
+
+
+def test_load_missing_raises(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    fname = str(tmp_path / "d.params")
+    net.save_parameters(fname)
+    bigger = nn.HybridSequential()
+    with bigger.name_scope():
+        bigger.add(nn.Dense(2, in_units=2), nn.Dense(3, in_units=2))
+    with pytest.raises(IOError):
+        bigger.load_parameters(fname)
+    bigger.load_parameters(fname, allow_missing=True, ignore_extra=True)
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "const", np.array([[2.0, 2.0]], dtype=np.float32))
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = Net()
+    net.initialize()
+    out = net(nd.ones((1, 2)))
+    np.testing.assert_allclose(out.asnumpy(), [[2, 2]])
+    x = nd.ones((1, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[2, 2]])
+
+
+def test_shared_params():
+    d1 = nn.Dense(4, in_units=4)
+    d2 = nn.Dense(4, in_units=4, params=d1.params)
+    d1.initialize()
+    x = nd.array(np.random.rand(2, 4).astype(np.float32))
+    np.testing.assert_allclose(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_zoneout_and_dropout_cells_exist():
+    cell = gluon.rnn.LSTMCell(4, input_size=3)
+    cell.initialize()
+    x = nd.ones((2, 3))
+    states = cell.begin_state(2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4)
+    assert len(new_states) == 2
+
+
+def test_rnn_cell_unroll():
+    cell = gluon.rnn.GRUCell(5, input_size=3)
+    cell.initialize()
+    x = nd.array(np.random.rand(2, 4, 3).astype(np.float32))  # NTC
+    outputs, states = cell.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 4, 5)
+    assert states[0].shape == (2, 5)
+
+
+def test_sequential_rnn_cell():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(4, input_size=3))
+    stack.add(gluon.rnn.LSTMCell(4, input_size=4))
+    stack.initialize()
+    x = nd.ones((2, 3))
+    states = stack.begin_state(2)
+    assert len(states) == 4
+    out, new_states = stack(x, states)
+    assert out.shape == (2, 4)
+
+
+def test_rnn_layer_forward_and_state():
+    layer = gluon.rnn.LSTM(6, num_layers=2, input_size=4)
+    layer.initialize()
+    x = nd.array(np.random.rand(5, 3, 4).astype(np.float32))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 6)
+    states = layer.begin_state(3)
+    out2, new_states = layer(x, states)
+    assert out2.shape == (5, 3, 6)
+    assert new_states[0].shape == (2, 3, 6)
+    assert new_states[1].shape == (2, 3, 6)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-5)
+
+
+def test_rnn_layer_grad_flows():
+    layer = gluon.rnn.GRU(4, input_size=3)
+    layer.initialize()
+    x = nd.array(np.random.rand(4, 2, 3).astype(np.float32))
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_bidirectional_rnn_layer():
+    layer = gluon.rnn.LSTM(4, num_layers=1, bidirectional=True, input_size=3)
+    layer.initialize()
+    x = nd.array(np.random.rand(5, 2, 3).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (5, 2, 8)
+
+
+def test_block_cast():
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16
+    net.cast("float32")
+    out = net(nd.ones((1, 2)))
+    assert out.dtype == np.float32
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([1, 2], dtype="int32"))
+    assert out.shape == (2, 4)
+    with autograd.record():
+        loss = emb(nd.array([1, 1], dtype="int32")).sum()
+    loss.backward()
+    g = emb.weight.grad().asnumpy()
+    assert np.abs(g[1]).sum() > 0
+    assert np.abs(g[2]).sum() == 0
+
+
+def test_lambda_blocks():
+    lam = nn.Lambda(lambda x: x * 2)
+    np.testing.assert_allclose(lam(nd.ones((2,))).asnumpy(), [2, 2])
+    hlam = nn.HybridLambda("relu")
+    np.testing.assert_allclose(hlam(nd.array([-1.0, 1.0])).asnumpy(), [0, 1])
+
+
+def test_model_zoo_builds():
+    for name in ["resnet18_v1", "resnet18_v2", "mobilenet0.25", "squeezenet1.1"]:
+        net = gluon.model_zoo.vision.get_model(name, classes=10)
+        net.initialize(mx.init.Xavier())
+        out = net(nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32)))
+        assert out.shape == (1, 10), name
+
+
+def test_summary_runs(capsys):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.summary(nd.ones((1, 3)))
+    captured = capsys.readouterr()
+    assert "Total params" in captured.out

@@ -1,0 +1,371 @@
+"""Operator correctness vs numpy (reference: tests/python/unittest/
+test_operator.py — numpy oracle + finite-difference gradient checks)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.utils.test_utils import (assert_almost_equal,
+                                                  check_numeric_gradient)
+
+
+def test_unary_ops_vs_numpy():
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "abs": np.abs, "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+        "tanh": np.tanh, "sin": np.sin, "cos": np.cos,
+        "sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+        "relu": lambda v: np.maximum(v, 0),
+        "reciprocal": lambda v: 1 / v, "rsqrt": lambda v: 1 / np.sqrt(v),
+        "log1p": np.log1p, "expm1": np.expm1, "arctan": np.arctan,
+    }
+    for name, ref in cases.items():
+        out = getattr(nd, name)(a)
+        assert_almost_equal(out, ref(x), rtol=1e-4, atol=1e-5,
+                            names=(name, "np_" + name))
+
+
+def test_broadcast_binary():
+    x = np.random.rand(3, 1, 4).astype(np.float32)
+    y = np.random.rand(1, 5, 4).astype(np.float32)
+    a, b = nd.array(x), nd.array(y)
+    assert_almost_equal(nd.broadcast_add(a, b), x + y)
+    assert_almost_equal(nd.broadcast_mul(a, b), x * y)
+    assert_almost_equal(nd.broadcast_sub(a, b), x - y)
+    assert_almost_equal(nd.broadcast_div(a, b), x / y, rtol=1e-5)
+    assert_almost_equal(nd.broadcast_maximum(a, b), np.maximum(x, y))
+    assert_almost_equal(nd.broadcast_power(nd.array(np.abs(x) + 1), b),
+                        (np.abs(x) + 1) ** y, rtol=1e-4)
+    assert_almost_equal(nd.broadcast_greater(a, b), (x > y).astype(np.float32))
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 7).astype(np.float32)
+    w = np.random.rand(5, 7).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=5)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4)
+    out_nb = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=5,
+                               no_bias=True)
+    assert_almost_equal(out_nb, x @ w.T, rtol=1e-4)
+    # flatten semantics for >2D
+    x4 = np.random.rand(2, 3, 2, 2).astype(np.float32)
+    w4 = np.random.rand(5, 12).astype(np.float32)
+    out4 = nd.FullyConnected(nd.array(x4), nd.array(w4), num_hidden=5,
+                             no_bias=True)
+    assert_almost_equal(out4, x4.reshape(2, -1) @ w4.T, rtol=1e-4)
+
+
+def _np_conv2d(x, w, stride, pad):
+    from numpy.lib.stride_tricks import sliding_window_view
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    win = sliding_window_view(xp, w.shape[2:], axis=(2, 3))
+    win = win[:, :, ::stride, ::stride]
+    return np.einsum("nchwij,fcij->nfhw", win, w)
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1)])
+def test_convolution_vs_numpy(stride, pad):
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(3, 3), stride=(stride, stride),
+                         pad=(pad, pad), num_filter=4)
+    ref = _np_conv2d(x, w, stride, pad)
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_grouped_and_1d_3d_conv():
+    x = np.random.rand(2, 4, 8).astype(np.float32)
+    w = np.random.rand(6, 2, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True, kernel=(3,),
+                         num_filter=6, num_group=2)
+    assert out.shape == (2, 6, 6)
+    x3 = np.random.rand(1, 2, 4, 4, 4).astype(np.float32)
+    w3 = np.random.rand(3, 2, 2, 2, 2).astype(np.float32)
+    out3 = nd.Convolution(nd.array(x3), nd.array(w3), no_bias=True,
+                          kernel=(2, 2, 2), num_filter=3)
+    assert out3.shape == (1, 3, 3, 3, 3)
+
+
+def _np_deconv2d(x, w, stride):
+    """Naive transposed conv, NCHW; w: (C_in, C_out, kh, kw), pad 0."""
+    n, cin, h, wdt = x.shape
+    _, cout, kh, kw = w.shape
+    oh = (h - 1) * stride + kh
+    ow = (wdt - 1) * stride + kw
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    for b in range(n):
+        for ci in range(cin):
+            for i in range(h):
+                for j in range(wdt):
+                    out[b, :, i * stride:i * stride + kh,
+                        j * stride:j * stride + kw] += x[b, ci, i, j] * w[ci]
+    return out
+
+
+def test_deconvolution_vs_numpy():
+    x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+    w = np.random.rand(2, 3, 3, 3).astype(np.float32)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), no_bias=True,
+                           kernel=(3, 3), stride=(2, 2), num_filter=3)
+    assert out.shape == (1, 3, 9, 9)
+    ref = _np_deconv2d(x, w, 2)
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_pooling():
+    x = np.random.rand(2, 3, 6, 6).astype(np.float32)
+    a = nd.array(x)
+    mx_max = nd.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    assert_almost_equal(mx_max, ref)
+    mx_avg = nd.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    ref_avg = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+    assert_almost_equal(mx_avg, ref_avg, rtol=1e-5)
+    gp = nd.Pooling(a, global_pool=True, pool_type="avg")
+    assert_almost_equal(gp, x.mean(axis=(2, 3), keepdims=True), rtol=1e-5)
+    s = nd.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="sum")
+    assert_almost_equal(s, ref_avg * 4, rtol=1e-5)
+
+
+def test_pooling_backward():
+    check_numeric_gradient(
+        lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg"),
+        [np.random.rand(1, 1, 4, 4)], rtol=2e-2, atol=1e-3)
+
+
+def test_batch_norm():
+    x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32)
+    beta = np.random.rand(3).astype(np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    out, new_mean, new_var = nd.BatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mean),
+        nd.array(var), fix_gamma=False, training=True, eps=1e-5)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    ref = (x - bm[None, :, None, None]) / np.sqrt(bv[None, :, None, None] + 1e-5) \
+        * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(new_mean, 0.9 * mean + 0.1 * bm, rtol=1e-4)
+    # inference mode uses moving stats
+    out_inf, _, _ = nd.BatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mean),
+        nd.array(var), fix_gamma=False, training=False, eps=1e-5)
+    ref_inf = x * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert_almost_equal(out_inf, ref_inf, rtol=1e-3, atol=1e-4)
+
+
+def test_layer_norm():
+    x = np.random.rand(4, 6).astype(np.float32)
+    g = np.random.rand(6).astype(np.float32)
+    b = np.random.rand(6).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5)
+    mu = x.mean(-1, keepdims=True)
+    sig = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(sig + 1e-5) * g + b
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_family():
+    x = np.random.rand(3, 5).astype(np.float32) * 5
+    a = nd.array(x)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    ref = e / e.sum(-1, keepdims=True)
+    assert_almost_equal(nd.softmax(a), ref, rtol=1e-5)
+    assert_almost_equal(nd.log_softmax(a), np.log(ref), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.softmax(a, temperature=2.0),
+                        (lambda z: z / z.sum(-1, keepdims=True))(
+                            np.exp(x / 2 - (x / 2).max(-1, keepdims=True))),
+                        rtol=1e-5)
+    # masked softmax by length
+    ln = nd.array([2, 3, 5], dtype="int32")
+    masked = nd.softmax(a, axis=-1, length=ln, use_length=True).asnumpy()
+    assert np.allclose(masked[0, 2:], 0)
+    assert abs(masked[0, :2].sum() - 1) < 1e-5
+
+
+def test_activation_zoo():
+    x = np.random.randn(4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.Activation(a, act_type="softrelu"),
+                        np.log1p(np.exp(x)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="elu", slope=1.0),
+                        np.where(x > 0, x, np.expm1(x)), rtol=1e-4, atol=1e-6)
+    ref_selu = 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * np.expm1(x))
+    assert_almost_equal(nd.LeakyReLU(a, act_type="selu"), ref_selu,
+                        rtol=1e-4, atol=1e-6)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    # not training: identity
+    out = nd.Dropout(x, p=0.5, training=False)
+    assert_almost_equal(out, np.ones((100, 100)))
+    out_t = nd.Dropout(x, p=0.5, training=True).asnumpy()
+    kept = (out_t != 0)
+    assert 0.3 < kept.mean() < 0.7
+    np.testing.assert_allclose(out_t[kept], 2.0, rtol=1e-6)
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = nd.array([1, 3, 5], dtype="int32")
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[[1, 3, 5]])
+
+
+def test_gather_scatter_nd():
+    data = np.random.rand(3, 4).astype(np.float32)
+    indices = nd.array([[0, 2], [1, 3]], dtype="int32")
+    out = nd.gather_nd(nd.array(data), indices)
+    assert_almost_equal(out, data[[0, 2], [1, 3]])
+    sc = nd.scatter_nd(nd.array([5.0, 6.0]), indices, shape=(3, 4))
+    ref = np.zeros((3, 4), np.float32)
+    ref[0, 1], ref[2, 3] = 5, 6
+    assert_almost_equal(sc, ref)
+
+
+def test_where_clip():
+    cond = nd.array([1, 0, 1], dtype="float32")
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    assert_almost_equal(nd.where(cond, x, y), [1, 20, 3])
+    assert_almost_equal(nd.clip(nd.array([-2.0, 0.5, 9.0]), 0.0, 1.0),
+                        [0, 0.5, 1])
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)  # (T, B, C)
+    lens = nd.array([2, 4], dtype="int32")
+    masked = nd.SequenceMask(nd.array(x), sequence_length=lens,
+                             use_sequence_length=True, value=-1.0).asnumpy()
+    assert np.allclose(masked[2:, 0], -1.0)
+    assert np.allclose(masked[:, 1], x[:, 1])
+    last = nd.SequenceLast(nd.array(x), sequence_length=lens,
+                           use_sequence_length=True).asnumpy()
+    assert np.allclose(last[0], x[1, 0])
+    assert np.allclose(last[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), sequence_length=lens,
+                             use_sequence_length=True).asnumpy()
+    assert np.allclose(rev[0, 0], x[1, 0])
+    assert np.allclose(rev[1, 0], x[0, 0])
+    assert np.allclose(rev[2:, 0], x[2:, 0])
+    assert np.allclose(rev[:, 1], x[::-1, 1])
+
+
+def test_rnn_op_shapes():
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    T, N, C, H, L = 5, 3, 4, 6, 2
+    x = nd.array(np.random.rand(T, N, C).astype(np.float32))
+    psize = rnn_param_size(C, H, L, "lstm")
+    params = nd.array(np.random.uniform(-0.1, 0.1, (psize,)).astype(np.float32))
+    h0 = nd.zeros((L, N, H))
+    c0 = nd.zeros((L, N, H))
+    out, hn, cn = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L,
+                         mode="lstm")
+    assert out.shape == (T, N, H)
+    assert hn.shape == (L, N, H)
+    assert cn.shape == (L, N, H)
+    # bidirectional
+    psize_bi = rnn_param_size(C, H, L, "gru", bidirectional=True)
+    params_bi = nd.array(np.random.uniform(-0.1, 0.1, (psize_bi,)).astype(np.float32))
+    h0_bi = nd.zeros((2 * L, N, H))
+    out_bi, hn_bi = nd.RNN(x, params_bi, h0_bi, state_size=H, num_layers=L,
+                           mode="gru", bidirectional=True)
+    assert out_bi.shape == (T, N, 2 * H)
+
+
+def test_lstm_cell_matches_manual():
+    """Single-layer single-step LSTM vs hand-rolled gates (i,f,g,o order)."""
+    N, C, H = 2, 3, 4
+    from incubator_mxnet_tpu.ops import rnn as rops
+    wx = np.random.uniform(-0.5, 0.5, (4 * H, C)).astype(np.float32)
+    wh = np.random.uniform(-0.5, 0.5, (4 * H, H)).astype(np.float32)
+    bx = np.random.uniform(-0.5, 0.5, (4 * H,)).astype(np.float32)
+    bh = np.random.uniform(-0.5, 0.5, (4 * H,)).astype(np.float32)
+    x = np.random.rand(1, N, C).astype(np.float32)
+    h0 = np.random.rand(N, H).astype(np.float32)
+    c0 = np.random.rand(N, H).astype(np.float32)
+    import jax.numpy as jnp
+    out, hn, cn = rops.rnn_forward(
+        jnp.asarray(x), [[{"wx": jnp.asarray(wx), "wh": jnp.asarray(wh),
+                           "bx": jnp.asarray(bx), "bh": jnp.asarray(bh)}]],
+        jnp.asarray(h0)[None], jnp.asarray(c0)[None], mode="lstm")
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    gates = x[0] @ wx.T + bx + h0 @ wh.T + bh
+    i, f, g, o = np.split(gates, 4, axis=-1)
+    c_ref = sig(f) * c0 + sig(i) * np.tanh(g)
+    h_ref = sig(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(np.asarray(out[0]), h_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cn[0]), c_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_checks_core_ops():
+    check_numeric_gradient(lambda x: nd.tanh(x), [np.random.rand(3, 3)],
+                           rtol=2e-2, atol=1e-3)
+    check_numeric_gradient(
+        lambda x, w: nd.FullyConnected(x, w, num_hidden=4, no_bias=True),
+        [np.random.rand(2, 3), np.random.rand(4, 3)], rtol=2e-2, atol=1e-3)
+    check_numeric_gradient(lambda x: nd.softmax(x),
+                           [np.random.rand(2, 4)], rtol=5e-2, atol=1e-3)
+    check_numeric_gradient(lambda x: nd.LayerNorm(
+        x, nd.array(np.ones(4, np.float32)), nd.array(np.zeros(4, np.float32))),
+        [np.random.rand(3, 4)], rtol=5e-2, atol=2e-3)
+
+
+def test_ctc_loss():
+    from incubator_mxnet_tpu.ops.ctc import ctc_loss
+    import jax.numpy as jnp
+    # single sequence, T=2, vocab {blank, a}: P(label="a")
+    logits = np.log(np.array([[[0.6, 0.4], [0.3, 0.7]]], dtype=np.float32))
+    label = np.array([[1]], dtype=np.int32)
+    loss = ctc_loss(jnp.asarray(logits), jnp.asarray(label))
+    # paths for "a": (a,blank),(blank,a),(a,a) = .4*.3 + .6*.7 + .4*.7 = .82
+    np.testing.assert_allclose(np.asarray(loss), [-np.log(0.82)], rtol=1e-4)
+
+
+def test_topk_both_and_linalg():
+    x = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+    vals, idxs = nd.topk(nd.array(x), k=2, ret_typ="both")
+    assert vals.shape == (4, 2) and idxs.shape == (4, 2)
+    spd = x @ x.T + 4 * np.eye(4, dtype=np.float32)
+    chol = nd.linalg_potrf(nd.array(spd))
+    np.testing.assert_allclose(chol.asnumpy() @ chol.asnumpy().T, spd, rtol=1e-3)
+
+
+def test_contrib_ops():
+    boxes = nd.array([[0.0, 0.0, 1.0, 1.0], [0.0, 0.0, 0.5, 0.5]])
+    iou = nd.box_iou(boxes, boxes).asnumpy()
+    np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 1], 0.25, rtol=1e-5)
+    x = nd.array(np.random.rand(1, 2, 4, 4).astype(np.float32))
+    up = nd.BilinearResize2D(x, height=8, width=8)
+    assert up.shape == (1, 2, 8, 8)
+    ap = nd.AdaptiveAvgPooling2D(x, output_size=2)
+    assert ap.shape == (1, 2, 2, 2)
+    q = nd.quadratic(nd.array([1.0, 2.0]), a=1, b=2, c=3)
+    np.testing.assert_allclose(q.asnumpy(), [6, 11])
+
+
+def test_box_nms():
+    # rows: [id, score, x1,y1,x2,y2]
+    dets = nd.array([[0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                     [0, 0.8, 0.01, 0.01, 1.0, 1.0],
+                     [0, 0.7, 2.0, 2.0, 3.0, 3.0]])
+    out = nd.box_nms(dets, overlap_thresh=0.5, id_index=0).asnumpy()
+    # second box suppressed (score -> -1), third kept
+    scores = sorted(out[:, 1].tolist(), reverse=True)
+    assert scores[0] == pytest.approx(0.9)
+    assert scores[1] == pytest.approx(0.7)
+    assert scores[2] == pytest.approx(-1.0)
