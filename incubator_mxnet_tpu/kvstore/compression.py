@@ -35,7 +35,10 @@ def _pack_2bit(q, threshold):
     return jnp.sum(codes << shifts, axis=1).astype(jnp.int32)
 
 
-@jax.jit
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnums=(2,))
 def _unpack_2bit(packed, threshold, n):
     shifts = jnp.arange(16, dtype=jnp.int32) * 2
     codes = (packed[:, None] >> shifts) & 3
@@ -67,4 +70,4 @@ class GradientCompression:
         return _pack_2bit(q_val.reshape(-1), jnp.float32(self.threshold))
 
     def unpack(self, packed, n, shape):
-        return _unpack_2bit(packed, jnp.float32(self.threshold), n).reshape(shape)
+        return _unpack_2bit(packed, jnp.float32(self.threshold), int(n)).reshape(shape)

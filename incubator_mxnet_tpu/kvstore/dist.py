@@ -1,0 +1,208 @@
+"""KVStoreDist — worker-side client of the parameter-server group.
+
+Reference parity: src/kvstore/kvstore_dist.h (key sharding across servers:
+round-robin for small keys, split-by-MXNET_KVSTORE_BIGARRAY_BOUND for large;
+dense + row-sparse push/pull; 2-bit compressed push; SendCommandToServers;
+rank/num_workers/barrier; server-side optimizer from worker 0) per SURVEY
+§2.4 / call stack §3.5. Bootstrap env mirrors the reference's dmlc vars:
+DMLC_ROLE, DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER,
+DMLC_NUM_SERVER.
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from .kvstore import KVStore
+from .rpc import Connection
+from .dist_server import SchedulerClient
+from ..ndarray import NDArray
+
+__all__ = ["KVStoreDist", "create_dist"]
+
+_BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
+
+
+def create_dist(name):
+    sync_mode = "async" not in name
+    return KVStoreDist(name, sync_mode=sync_mode)
+
+
+class KVStoreDist(KVStore):
+    def __init__(self, name="dist_sync", sync_mode=True):
+        super().__init__(name)
+        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        self._sync_mode = sync_mode
+        self._sched = SchedulerClient((uri, port))
+        self._rank = self._sched.register("worker", ("127.0.0.1", 0))
+        nodes = self._sched.get_nodes()
+        self._servers = [Connection(tuple(a)) for _, a in
+                         sorted(nodes["servers"].items())]
+        self._key_shard = {}
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def is_dist(self):
+        return True
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def barrier(self):
+        self._sched.barrier("worker")
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        return self._sched.num_dead_nodes(timeout)
+
+    # -- key -> server placement (reference: EncodeDefaultKey) ---------------
+    def _shards_for(self, key, shape):
+        if key in self._key_shard:
+            return self._key_shard[key]
+        size = int(np.prod(shape)) if shape else 1
+        n = len(self._servers)
+        if size < _BIGARRAY_BOUND or n == 1 or not shape:
+            sid = (key if isinstance(key, int) else abs(hash(key))) % n
+            shards = [(sid, 0, shape[0] if shape else 1)]
+        else:
+            # split along axis 0 across all servers
+            rows = shape[0]
+            per = -(-rows // n)
+            shards = []
+            for i in range(n):
+                lo, hi = i * per, min((i + 1) * per, rows)
+                if lo < hi:
+                    shards.append((i, lo, hi))
+        self._key_shard[key] = shards
+        return shards
+
+    # -- data plane ----------------------------------------------------------
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        arr = np.asarray(value.asnumpy(), dtype=np.float32)
+        for sid, lo, hi in self._shards_for(key, arr.shape):
+            part = arr[lo:hi] if arr.ndim else arr
+            self._servers[sid].call(
+                {"op": "init", "key": self._part_key(key, lo),
+                 "shape": part.shape, "dtype": str(part.dtype)},
+                np.ascontiguousarray(part).tobytes())
+        # mirror shape for pulls
+        self._store[key] = NDArray(value._data)
+
+    @staticmethod
+    def _part_key(key, lo):
+        return "%s@%d" % (key, lo)
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        if isinstance(value, (list, tuple)):  # local pre-aggregation
+            agg = value[0]._data
+            for v in value[1:]:
+                agg = agg + v._data
+            arr = np.asarray(agg, dtype=np.float32)
+        else:
+            arr = np.asarray(value._data, dtype=np.float32)
+        compressed = self._compression is not None
+        for sid, lo, hi in self._shards_for(key, arr.shape):
+            part = arr[lo:hi] if arr.ndim else arr
+            if compressed:
+                import jax.numpy as jnp
+                q = self._compression.compress(self._part_key(key, lo),
+                                               jnp.asarray(part))
+                packed = np.asarray(self._compression.pack(q), dtype=np.int32)
+                self._servers[sid].call(
+                    {"op": "push", "key": self._part_key(key, lo),
+                     "shape": part.shape, "dtype": "float32",
+                     "compressed": True}, packed.tobytes())
+            else:
+                self._servers[sid].call(
+                    {"op": "push", "key": self._part_key(key, lo),
+                     "shape": part.shape, "dtype": str(part.dtype)},
+                    np.ascontiguousarray(part).tobytes())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, out=o, priority=priority)
+            return
+        ref = out if not isinstance(out, (list, tuple)) else out[0]
+        shape = tuple(ref.shape)
+        parts = []
+        for sid, lo, hi in self._shards_for(key, shape):
+            meta, payload = self._servers[sid].call(
+                {"op": "pull", "key": self._part_key(key, lo)})
+            parts.append(np.frombuffer(payload, dtype=meta["dtype"])
+                         .reshape(meta["shape"]))
+        full = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+        import jax.numpy as jnp
+        val = jnp.asarray(full)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._data = val.astype(o._data.dtype)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        rids = np.asarray(row_ids.asnumpy() if hasattr(row_ids, "asnumpy")
+                          else row_ids, dtype=np.int64)
+        ref = out if not isinstance(out, (list, tuple)) else out[0]
+        shape = tuple(ref.shape)
+        shards = self._shards_for(key, shape)
+        rows_acc = np.zeros((len(rids),) + shape[1:], dtype=np.float32)
+        for sid, lo, hi in shards:
+            mask = (rids >= lo) & (rids < hi)
+            if not mask.any():
+                continue
+            local = rids[mask] - lo
+            meta, payload = self._servers[sid].call(
+                {"op": "pull", "key": self._part_key(key, lo),
+                 "rows": local.tolist()})
+            rows_acc[mask] = np.frombuffer(payload, dtype=meta["dtype"]) \
+                .reshape(meta["shape"])
+        import jax.numpy as jnp
+        full = jnp.zeros(shape, jnp.float32).at[jnp.asarray(rids)].set(
+            jnp.asarray(rows_acc))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._data = full.astype(o._data.dtype)
+
+    # -- control -------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the servers (worker 0 only, reference:
+        kvstore.py set_optimizer via SendCommandToServers)."""
+        self._optimizer = optimizer
+        if self._rank == 0:
+            blob = pickle.dumps(optimizer)
+            for conn in self._servers:
+                conn.call({"op": "set_optimizer"}, blob)
+        self.barrier()
+
+    def set_gradient_compression(self, compression_params):
+        super().set_gradient_compression(compression_params)
+        if self._rank == 0:
+            for conn in self._servers:
+                conn.call({"op": "set_compression",
+                           "params": dict(compression_params)})
+        self.barrier()
+
+    def send_command_to_servers(self, head, body):
+        for conn in self._servers:
+            conn.call({"op": "command", "head": head, "body": body})
+
+    def close(self):
+        for conn in self._servers:
+            conn.close()

@@ -1,0 +1,263 @@
+"""Parameter-server server + scheduler processes.
+
+Reference parity: src/kvstore/kvstore_dist_server.h (sync aggregation with
+ApplyUpdates + server-side optimizer shipped from worker 0; async update-on-
+arrival; 2-bit decompress-before-aggregate) and ps-lite's scheduler
+rendezvous (rank assignment, barrier, liveness) per SURVEY §2.4/§3.5.
+"""
+
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+
+from .rpc import Server, request
+from .compression import GradientCompression
+
+__all__ = ["run_scheduler", "run_server", "SchedulerClient"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler: rendezvous + barrier + liveness
+# ---------------------------------------------------------------------------
+
+class _SchedulerState:
+    def __init__(self, num_workers, num_servers):
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self.servers = {}   # rank -> addr
+        self.workers = {}   # rank -> addr
+        self.lock = threading.Lock()
+        self.barrier_count = {}
+        self.barrier_gen = {}
+        self.cv = threading.Condition(self.lock)
+        self.heartbeats = {}
+        self.done = threading.Event()
+
+
+def run_scheduler(port, num_workers, num_servers, ready_event=None):
+    """Blocking scheduler loop (run in its own process)."""
+    state = _SchedulerState(num_workers, num_servers)
+
+    def handler(meta, payload):
+        op = meta["op"]
+        if op == "register":
+            role = meta["role"]
+            with state.cv:
+                table = state.servers if role == "server" else state.workers
+                rank = meta.get("rank")
+                if rank is None:
+                    rank = len(table)
+                table[rank] = tuple(meta["addr"])
+                state.cv.notify_all()
+            return {"rank": rank}, b""
+        if op == "get_nodes":
+            deadline = time.time() + meta.get("timeout", 60)
+            with state.cv:
+                while (len(state.servers) < state.num_servers or
+                       len(state.workers) < state.num_workers):
+                    if not state.cv.wait(timeout=max(deadline - time.time(), 0.01)):
+                        break
+                return {"servers": dict(state.servers),
+                        "workers": dict(state.workers)}, b""
+        if op == "barrier":
+            group = meta.get("group", "worker")
+            n = state.num_workers if group == "worker" else state.num_servers
+            with state.cv:
+                gen = state.barrier_gen.setdefault(group, 0)
+                state.barrier_count[group] = state.barrier_count.get(group, 0) + 1
+                if state.barrier_count[group] == n:
+                    state.barrier_count[group] = 0
+                    state.barrier_gen[group] = gen + 1
+                    state.cv.notify_all()
+                else:
+                    while state.barrier_gen[group] == gen:
+                        state.cv.wait(timeout=120)
+            return {"ok": True}, b""
+        if op == "heartbeat":
+            with state.lock:
+                state.heartbeats[(meta["role"], meta["rank"])] = time.time()
+            return {"ok": True}, b""
+        if op == "num_dead":
+            timeout = meta.get("timeout", 60)
+            now = time.time()
+            with state.lock:
+                dead = sum(1 for t in state.heartbeats.values()
+                           if now - t > timeout)
+            return {"num_dead": dead}, b""
+        if op == "shutdown":
+            state.done.set()
+            return {"ok": True}, b""
+        return {"error": "unknown op %s" % op}, b""
+
+    srv = Server(handler, port=port).start()
+    if ready_event is not None:
+        ready_event.set()
+    state.done.wait()
+    time.sleep(0.2)
+    srv.stop()
+
+
+class SchedulerClient:
+    def __init__(self, addr):
+        self.addr = addr
+
+    def register(self, role, my_addr, rank=None):
+        meta, _ = request(self.addr, {"op": "register", "role": role,
+                                      "addr": list(my_addr), "rank": rank})
+        return meta["rank"]
+
+    def get_nodes(self, timeout=60):
+        meta, _ = request(self.addr, {"op": "get_nodes", "timeout": timeout},
+                          timeout=timeout + 10)
+        return meta
+
+    def barrier(self, group="worker"):
+        request(self.addr, {"op": "barrier", "group": group}, timeout=300)
+
+    def heartbeat(self, role, rank):
+        request(self.addr, {"op": "heartbeat", "role": role, "rank": rank})
+
+    def num_dead_nodes(self, timeout=60):
+        meta, _ = request(self.addr, {"op": "num_dead", "timeout": timeout})
+        return meta["num_dead"]
+
+    def shutdown(self):
+        try:
+            request(self.addr, {"op": "shutdown"}, timeout=5)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# server process
+# ---------------------------------------------------------------------------
+
+class _ServerState:
+    def __init__(self, num_workers, sync_mode):
+        self.store = {}          # key -> np.ndarray (the weights)
+        self.accum = {}          # key -> (np.ndarray sum, count) for sync mode
+        self.num_workers = num_workers
+        self.sync_mode = sync_mode
+        self.optimizer = None
+        self.updater = None
+        self.compression = None
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.push_gen = {}       # key -> generation (sync rounds)
+        self.done = threading.Event()
+
+
+def _decode(meta, payload):
+    arr = np.frombuffer(payload, dtype=meta["dtype"]).reshape(meta["shape"])
+    return arr
+
+
+def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
+               port=0):
+    """Blocking server loop (own process). Registers with the scheduler."""
+    state = _ServerState(num_workers, sync_mode)
+
+    def apply_update(key, agg):
+        """Run the server-side optimizer or plain assignment."""
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+        if state.updater is not None:
+            w = NDArray(jnp.asarray(state.store[key]))
+            g = NDArray(jnp.asarray(agg))
+            state.updater(key, g, w)
+            state.store[key] = np.asarray(w._data)
+        else:
+            state.store[key] = agg.copy()
+
+    def handler(meta, payload):
+        op = meta["op"]
+        if op == "init":
+            with state.lock:
+                state.store[meta["key"]] = _decode(meta, payload).copy()
+            return {"ok": True}, b""
+        if op == "push":
+            key = meta["key"]
+            if meta.get("compressed") and state.compression is not None:
+                import jax.numpy as jnp
+                packed = jnp.asarray(np.frombuffer(payload, dtype=np.int32))
+                arr = np.asarray(state.compression.unpack(
+                    packed, int(np.prod(meta["shape"])), tuple(meta["shape"])))
+            else:
+                arr = _decode(meta, payload)
+            with state.cv:
+                if state.sync_mode:
+                    acc, cnt = state.accum.get(key, (None, 0))
+                    acc = arr.astype(np.float32).copy() if acc is None \
+                        else acc + arr
+                    cnt += 1
+                    if cnt == state.num_workers:
+                        apply_update(key, acc)
+                        state.accum[key] = (None, 0)
+                        state.push_gen[key] = state.push_gen.get(key, 0) + 1
+                        state.cv.notify_all()
+                    else:
+                        state.accum[key] = (acc, cnt)
+                        gen = state.push_gen.get(key, 0)
+                        while state.push_gen.get(key, 0) == gen:
+                            if not state.cv.wait(timeout=120):
+                                break
+                else:
+                    apply_update(key, arr.astype(np.float32))
+            return {"ok": True}, b""
+        if op == "pull":
+            with state.lock:
+                arr = state.store[meta["key"]]
+            rows = meta.get("rows")
+            if rows is not None:
+                arr = arr[np.asarray(rows, dtype=np.int64)]
+            return ({"shape": arr.shape, "dtype": str(arr.dtype)},
+                    np.ascontiguousarray(arr).tobytes())
+        if op == "set_optimizer":
+            opt = pickle.loads(payload)
+            from .. import optimizer as optmod
+            state.optimizer = opt
+            state.updater = optmod.get_updater(opt)
+            return {"ok": True}, b""
+        if op == "set_compression":
+            state.compression = GradientCompression(**meta["params"])
+            return {"ok": True}, b""
+        if op == "command":
+            return {"ok": True}, b""
+        if op == "shutdown":
+            state.done.set()
+            return {"ok": True}, b""
+        return {"error": "unknown op %s" % op}, b""
+
+    srv = Server(handler, port=port).start()
+    sched = SchedulerClient(tuple(scheduler_addr))
+    rank = sched.register("server", srv.addr)
+    if ready_event is not None:
+        ready_event.set()
+    state.done.wait()
+    time.sleep(0.2)
+    srv.stop()
+    return rank
+
+
+def role_main():
+    """Entry used by tools/launch.py: role from DMLC_ROLE (reference: ps-lite
+    env bootstrap — DMLC_ROLE/DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/...)."""
+    role = os.environ["DMLC_ROLE"]
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    ns = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+    if role == "scheduler":
+        run_scheduler(port, nw, ns)
+    elif role == "server":
+        sync = os.environ.get("MXNET_KVSTORE_MODE", "dist_sync") != "dist_async"
+        run_server((uri, port), nw, sync_mode=sync)
+    else:
+        raise SystemExit("worker role runs user code, not role_main")
+
+
+if __name__ == "__main__":
+    role_main()

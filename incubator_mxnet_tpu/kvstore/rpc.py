@@ -1,0 +1,129 @@
+"""Tiny TCP message layer for the parameter-server processes.
+
+Reference parity: the role ps-lite's zmq van/customer plays (SURVEY §2.4) —
+length-prefixed request/response messages between scheduler/servers/workers.
+stdlib-only (sockets + pickle for metadata, raw buffers for tensor payloads);
+the DCN path of a real pod would swap this transport for gRPC without
+touching the KVStore semantics layered above.
+"""
+
+import pickle
+import socket
+import struct
+import threading
+
+_HDR = struct.Struct("<I")
+
+
+def send_msg(sock, obj, payload=b""):
+    """obj: picklable metadata; payload: raw bytes (tensor data)."""
+    meta = pickle.dumps(obj, protocol=4)
+    sock.sendall(_HDR.pack(len(meta)) + _HDR.pack(len(payload)) + meta + payload)
+
+
+def recv_msg(sock):
+    hdr = _recv_exact(sock, 8)
+    if hdr is None:
+        return None, None
+    meta_len, payload_len = _HDR.unpack(hdr[:4])[0], _HDR.unpack(hdr[4:])[0]
+    meta = _recv_exact(sock, meta_len)
+    payload = _recv_exact(sock, payload_len) if payload_len else b""
+    return pickle.loads(meta), payload
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None if not buf else bytes(buf)
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def request(addr, obj, payload=b"", timeout=60.0):
+    """One-shot request/response."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_msg(s, obj, payload)
+        return recv_msg(s)
+
+
+class Connection:
+    """Persistent connection with per-call locking."""
+
+    def __init__(self, addr, timeout=120.0):
+        self._addr = addr
+        self._timeout = timeout
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(self._addr, timeout=self._timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def call(self, obj, payload=b""):
+        with self._lock:
+            self._ensure()
+            send_msg(self._sock, obj, payload)
+            return recv_msg(self._sock)
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+
+class Server:
+    """Threaded request server: handler(meta, payload) -> (meta, payload)."""
+
+    def __init__(self, handler, host="127.0.0.1", port=0):
+        self._handler = handler
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.addr = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._srv.settimeout(0.5)
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                meta, payload = recv_msg(conn)
+                if meta is None:
+                    return
+                out_meta, out_payload = self._handler(meta, payload)
+                send_msg(conn, out_meta, out_payload)
+        except (OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass

@@ -225,7 +225,7 @@ class MSE(EvalMetric):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _as_numpy(label), _as_numpy(pred)
-            if label.ndim == 1 and pred.ndim != 1:
+            if label.ndim == 1 and pred.ndim != 1 and label.size == pred.size:
                 label = label.reshape(pred.shape)
             self.sum_metric += ((label - pred) ** 2).mean()
             self.num_inst += 1

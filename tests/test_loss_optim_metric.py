@@ -1,0 +1,295 @@
+"""Losses, optimizers, metrics, lr schedulers, initializers
+(reference: test_loss.py, test_optimizer.py, test_metric.py)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+from incubator_mxnet_tpu.ndarray import NDArray
+
+
+# ---------------------------------------------------------------------- loss
+
+def test_l2_l1_loss():
+    pred = nd.array([[1.0, 2.0]])
+    label = nd.array([[2.0, 4.0]])
+    l2 = gluon.loss.L2Loss()(pred, label)
+    np.testing.assert_allclose(l2.asnumpy(), [(1 + 4) / 2 / 2], rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, label)
+    np.testing.assert_allclose(l1.asnumpy(), [1.5], rtol=1e-5)
+
+
+def test_softmax_ce_loss():
+    pred = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype="float32")
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    p = pred.asnumpy()
+    logp = p - p.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    ref = -logp[np.arange(4), [0, 1, 2, 3]]
+    np.testing.assert_allclose(loss.asnumpy(), ref, rtol=1e-4)
+    # dense label
+    onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    loss_d = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        pred, nd.array(onehot))
+    np.testing.assert_allclose(loss_d.asnumpy(), ref, rtol=1e-4)
+
+
+def test_sigmoid_bce_loss():
+    pred = nd.array(np.random.randn(3, 4).astype(np.float32))
+    label = nd.array((np.random.rand(3, 4) > 0.5).astype(np.float32))
+    loss = gluon.loss.SigmoidBCELoss()(pred, label)
+    x, y = pred.asnumpy(), label.asnumpy()
+    ref = (np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x)))).mean(-1)
+    np.testing.assert_allclose(loss.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_huber_hinge_losses():
+    pred = nd.array([[0.5, -2.0]])
+    label = nd.array([[1.0, 1.0]])
+    h = gluon.loss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    ref = np.mean([0.5 * 0.25, 3.0 - 0.5])
+    np.testing.assert_allclose(h, [ref], rtol=1e-5)
+    hinge = gluon.loss.HingeLoss()(pred, label).asnumpy()
+    np.testing.assert_allclose(hinge, [np.mean([0.5, 3.0])], rtol=1e-5)
+
+
+def test_kl_and_cosine_loss():
+    p = np.random.rand(2, 4).astype(np.float32)
+    p = p / p.sum(-1, keepdims=True)
+    logits = np.random.rand(2, 4).astype(np.float32)
+    kl = gluon.loss.KLDivLoss(from_logits=False)(nd.array(logits), nd.array(p))
+    lq = logits - logits.max(-1, keepdims=True)
+    lq = lq - np.log(np.exp(lq).sum(-1, keepdims=True))
+    ref = (p * (np.log(p + 1e-12) - lq)).mean(-1)
+    np.testing.assert_allclose(kl.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_gluon():
+    pred = nd.array(np.random.rand(2, 5, 4).astype(np.float32))  # (N,T,C)
+    label = nd.array([[1, 2], [1, 0]], dtype="float32")
+    loss = gluon.loss.CTCLoss()(pred, label)
+    assert loss.shape == (2,)
+    assert np.all(np.isfinite(loss.asnumpy()))
+    # grad flows
+    p = nd.array(np.random.rand(1, 5, 4).astype(np.float32))
+    p.attach_grad()
+    with autograd.record():
+        l = gluon.loss.CTCLoss()(p, nd.array([[1]], dtype="float32")).sum()
+    l.backward()
+    assert np.abs(p.grad.asnumpy()).sum() > 0
+
+
+def test_triplet_loss():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    p = nd.array(np.random.rand(3, 4).astype(np.float32))
+    n = nd.array(np.random.rand(3, 4).astype(np.float32))
+    loss = gluon.loss.TripletLoss()(a, p, n)
+    ref = np.maximum(((p.asnumpy() - a.asnumpy()) ** 2
+                      - (n.asnumpy() - a.asnumpy()) ** 2).sum(-1) + 1, 0)
+    np.testing.assert_allclose(loss.asnumpy(), ref, rtol=1e-4)
+
+
+# ----------------------------------------------------------------- optimizer
+
+def _run_opt(name, kwargs, steps=3):
+    w = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    opt = mx.optimizer.create(name, **kwargs)
+    state = opt.create_state(0, w)
+    for _ in range(steps):
+        g = nd.array(np.array([0.1, -0.2, 0.3], np.float32))
+        opt.update(0, w, g, state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_reference_formula():
+    out = _run_opt("sgd", {"learning_rate": 0.1}, steps=1)
+    np.testing.assert_allclose(out, [1 - 0.01, -2 + 0.02, 3 - 0.03], rtol=1e-5)
+
+
+def test_sgd_momentum():
+    w = np.array([1.0], np.float32)
+    mom = 0.0
+    for _ in range(3):
+        mom = 0.9 * mom - 0.1 * 0.5
+        w = w + mom
+    out = _run_opt("sgd", {"learning_rate": 0.1, "momentum": 0.9}, 0)
+    wa = nd.array(np.array([1.0], np.float32))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    st = opt.create_state(0, wa)
+    for _ in range(3):
+        opt.update(0, wa, nd.array(np.array([0.5], np.float32)), st)
+    np.testing.assert_allclose(wa.asnumpy(), w, rtol=1e-5)
+
+
+def test_adam_first_step():
+    wa = nd.array(np.array([1.0], np.float32))
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    st = opt.create_state(0, wa)
+    opt.update(0, wa, nd.array(np.array([0.5], np.float32)), st)
+    # t=1: m=0.05, v=0.00025, coef=lr*sqrt(1-b2)/(1-b1)
+    m, v = 0.05, 0.1 ** 2 * 0.5 ** 2 * 0.001 / 0.001
+    v = (1 - 0.999) * 0.25
+    coef = 0.1 * math.sqrt(1 - 0.999) / (1 - 0.9)
+    ref = 1.0 - coef * m / (math.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(wa.asnumpy(), [ref], rtol=1e-5)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("adagrad", {}), ("rmsprop", {}), ("rmsprop", {"centered": True}),
+    ("adadelta", {}), ("adamax", {}), ("nadam", {}), ("ftrl", {}),
+    ("signum", {}), ("ftml", {}), ("dcasgd", {}), ("nag", {"momentum": 0.9}),
+    ("sgld", {}), ("adamw", {}), ("lbsgd", {}),
+])
+def test_optimizers_run_and_change_weights(name, kwargs):
+    out = _run_opt(name, kwargs)
+    assert np.all(np.isfinite(out))
+    assert not np.allclose(out, [1.0, -2.0, 3.0])
+
+
+def test_multi_precision():
+    import jax.numpy as jnp
+    w = NDArray(jnp.asarray([1.0, 2.0], jnp.float16))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              multi_precision=True)
+    state = opt.create_state_multi_precision(0, w)
+    master, _ = state
+    assert master._data.dtype == jnp.float32
+    opt.update_multi_precision(0, w, NDArray(jnp.asarray([0.5, 0.5], jnp.float16)),
+                               state)
+    assert w._data.dtype == jnp.float16
+
+
+def test_lr_mult_and_scheduler():
+    opt = mx.optimizer.create("sgd", learning_rate=1.0)
+    opt.set_lr_mult({0: 0.1})
+    assert opt._get_lr(0) == pytest.approx(0.1)
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt2 = mx.optimizer.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    w = nd.array([1.0])
+    st = opt2.create_state(0, w)
+    for _ in range(6):
+        opt2.update(0, w, nd.array([0.0]), st)
+    assert sched.base_lr < 1.0
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.MultiFactorScheduler([3, 6], factor=0.1, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(4) == pytest.approx(0.1)
+    assert s(7) == pytest.approx(0.01)
+    c = mx.lr_scheduler.CosineScheduler(10, base_lr=1.0, final_lr=0.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(10) == pytest.approx(0.0, abs=1e-6)
+    p = mx.lr_scheduler.PolyScheduler(10, base_lr=1.0, pwr=2)
+    assert p(0) == pytest.approx(1.0)
+    w = mx.lr_scheduler.FactorScheduler(10, 1.0, base_lr=1.0, warmup_steps=5,
+                                        warmup_begin_lr=0.0)
+    assert w(1) == pytest.approx(0.2)
+
+
+# -------------------------------------------------------------------- metric
+
+def test_accuracy_topk():
+    acc = mx.metric.Accuracy()
+    acc.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.3, 0.7], [0.6, 0.4]]))
+    assert acc.get()[1] == pytest.approx(2.0 / 3)
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update(nd.array([2]), nd.array([[0.1, 0.5, 0.4]]))
+    assert topk.get()[1] == 1.0
+
+
+def test_mse_mae_rmse():
+    mse = mx.metric.MSE()
+    mse.update(nd.array([1.0, 2.0]), nd.array([2.0, 4.0]))
+    assert mse.get()[1] == pytest.approx((1 + 4) / 2)
+    rmse = mx.metric.RMSE()
+    rmse.update(nd.array([1.0]), nd.array([3.0]))
+    assert rmse.get()[1] == pytest.approx(2.0)
+
+
+def test_perplexity_and_composite():
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0])
+    ppl = mx.metric.Perplexity()
+    ppl.update(label, pred)
+    ref = math.exp(-(math.log(0.5) + math.log(0.9)) / 2)
+    assert ppl.get()[1] == pytest.approx(ref, rel=1e-4)
+    comp = mx.metric.create(["acc", "ce"])
+    comp.update(nd.array([0]), nd.array([[0.9, 0.1]]))
+    names, values = comp.get()
+    assert "accuracy" in names[0]
+
+
+def test_custom_metric_and_np():
+    m = mx.metric.np(lambda label, pred: float(np.abs(label - pred).sum()),
+                     name="sad")
+    m.update(nd.array([1.0]), nd.array([3.0]))
+    assert m.get()[1] == pytest.approx(2.0)
+
+
+def test_f1_macro_vs_micro():
+    mac = mx.metric.F1(average="macro")
+    mic = mx.metric.F1(average="micro")
+    for m in (mac, mic):
+        m.update(nd.array([1, 0]), nd.array([[0.2, 0.8], [0.9, 0.1]]))
+        m.update(nd.array([1, 1]), nd.array([[0.2, 0.8], [0.9, 0.1]]))
+    assert 0 < mac.get()[1] <= 1
+    assert 0 < mic.get()[1] <= 1
+
+
+# --------------------------------------------------------------- initializer
+
+def test_initializers():
+    for init, check in [
+        (mx.init.Zero(), lambda a: np.all(a == 0)),
+        (mx.init.One(), lambda a: np.all(a == 1)),
+        (mx.init.Constant(3.5), lambda a: np.all(a == 3.5)),
+        (mx.init.Uniform(0.1), lambda a: np.all(np.abs(a) <= 0.1)),
+        (mx.init.Normal(0.01), lambda a: np.abs(a).max() < 0.1),
+        (mx.init.Xavier(), lambda a: np.all(np.isfinite(a))),
+        (mx.init.MSRAPrelu(), lambda a: np.all(np.isfinite(a))),
+        (mx.init.Orthogonal(), lambda a: np.all(np.isfinite(a))),
+    ]:
+        arr = nd.zeros((8, 8))
+        init(mx.init.InitDesc("test_weight"), arr)
+        assert check(arr.asnumpy()), type(init).__name__
+
+
+def test_orthogonal_is_orthogonal():
+    arr = nd.zeros((6, 6))
+    mx.init.Orthogonal(scale=1.0)(mx.init.InitDesc("w_weight"), arr)
+    a = arr.asnumpy()
+    np.testing.assert_allclose(a @ a.T, np.eye(6), atol=1e-4)
+
+
+def test_init_name_dispatch():
+    init = mx.init.Uniform(5.0)
+    bias = nd.ones((3,))
+    init(mx.init.InitDesc("fc_bias"), bias)
+    np.testing.assert_allclose(bias.asnumpy(), 0)
+    gamma = nd.zeros((3,))
+    init(mx.init.InitDesc("bn_gamma"), gamma)
+    np.testing.assert_allclose(gamma.asnumpy(), 1)
+
+
+def test_lstm_bias_init():
+    arr = nd.zeros((8,))  # 4 gates x 2 hidden
+    mx.init.LSTMBias(forget_bias=1.0)(mx.init.InitDesc("l0_bias"), arr)
+    a = arr.asnumpy()
+    np.testing.assert_allclose(a[2:4], 1.0)
+    np.testing.assert_allclose(a[:2], 0.0)
+
+
+def test_mixed_initializer():
+    mixed = mx.init.Mixed([".*bias", ".*"], [mx.init.Constant(1.0),
+                                             mx.init.Constant(2.0)])
+    b = nd.zeros((2,))
+    w = nd.zeros((2,))
+    mixed("fc_bias", b)
+    mixed("fc_weight", w)
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+    np.testing.assert_allclose(w.asnumpy(), 2.0)

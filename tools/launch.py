@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Distributed job launcher.
+
+Reference parity: tools/launch.py (spawns scheduler + servers + workers with
+DMLC_* env via dmlc-tracker; local/ssh launchers) per SURVEY §2.4. This
+build implements the local launcher (hermetic multi-process on one host —
+the pattern the reference's nightly distributed tests use) and an ssh
+launcher that runs the same commands remotely.
+
+Usage:
+  python tools/launch.py -n 2 -s 2 --launcher local python train.py ...
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Launch a distributed job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=None)
+    parser.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="hostfile for ssh launcher")
+    parser.add_argument("--sync-dst-dir", default=None)
+    parser.add_argument("--mode", choices=["dist_sync", "dist_async"],
+                        default="dist_sync")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if args.num_servers is None:
+        args.num_servers = args.num_workers
+    if not args.command:
+        parser.error("no command given")
+
+    port = _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "MXNET_KVSTORE_MODE": args.mode,
+    })
+
+    procs = []
+    role_cmd = [sys.executable, "-m", "incubator_mxnet_tpu.kvstore.dist_server"]
+
+    def spawn(role, extra_env=None):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = role
+        if extra_env:
+            env.update(extra_env)
+        cmd = role_cmd if role in ("scheduler", "server") else args.command
+        if args.launcher == "ssh" and role == "worker" and args.hostfile:
+            hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+            host = hosts[len([p for p in procs]) % len(hosts)]
+            envs = " ".join("%s=%s" % (k, v) for k, v in env.items()
+                            if k.startswith(("DMLC_", "MXNET_")))
+            cmd = ["ssh", host, envs + " " + " ".join(cmd)]
+        p = subprocess.Popen(cmd, env=env)
+        procs.append(p)
+        return p
+
+    spawn("scheduler")
+    for _ in range(args.num_servers):
+        spawn("server")
+    workers = [spawn("worker") for _ in range(args.num_workers)]
+
+    def terminate(*_a):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, terminate)
+    signal.signal(signal.SIGTERM, terminate)
+
+    code = 0
+    for w in workers:
+        code = max(code, w.wait())
+    # workers done: shut the group down
+    from incubator_mxnet_tpu.kvstore.dist_server import SchedulerClient
+    try:
+        SchedulerClient(("127.0.0.1", port)).shutdown()
+    except Exception:
+        pass
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()
