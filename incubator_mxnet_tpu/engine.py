@@ -35,7 +35,9 @@ class _Var:
 
 
 class Engine:
-    """NaiveEngine-equivalent scheduler for host-side functions."""
+    """Var-ordered scheduler for host-side functions. Backed by the C++
+    threadpool engine (native/src/engine.cc) when built; this Python
+    implementation is the NaiveEngine-equivalent fallback."""
 
     _instance = None
 
@@ -46,6 +48,15 @@ class Engine:
     @classmethod
     def get(cls):
         if cls._instance is None:
+            engine_type = __import__("os").environ.get("MXNET_ENGINE_TYPE", "")
+            if engine_type != "NaiveEngine":
+                try:
+                    from .native import NativeEngine, available
+                    if available():
+                        cls._instance = NativeEngine(4)
+                        return cls._instance
+                except Exception:
+                    pass
             cls._instance = cls()
         return cls._instance
 

@@ -35,14 +35,31 @@ class MXRecordIO:
             self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
+            # fast path: native C++ parser (native/src/recordio.cc)
+            try:
+                from .native import NativeRecordReader, available
+                if available():
+                    self._native = NativeRecordReader(self.uri)
+                    self.handle = True  # sentinel: open
+                    self.writable = False
+                    return
+            except Exception:
+                pass
+            self._native = None
             self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
 
     def close(self):
-        if self.handle is not None:
+        if getattr(self, "_native", None) is not None:
+            self._native.close()
+            self._native = None
+            self.handle = None
+        elif self.handle is not None and self.handle is not True:
             self.handle.close()
+            self.handle = None
+        else:
             self.handle = None
 
     def reset(self):
@@ -74,6 +91,8 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if getattr(self, "_native", None) is not None:
+            return self._native.read()
         header = self.handle.read(8)
         if len(header) < 8:
             return None
@@ -88,6 +107,8 @@ class MXRecordIO:
         return buf
 
     def tell(self):
+        if getattr(self, "_native", None) is not None:
+            return self._native.tell()
         return self.handle.tell()
 
 
@@ -124,7 +145,10 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.handle.seek(self.idx[idx])
+        if getattr(self, "_native", None) is not None:
+            self._native.seek(self.idx[idx])
+        else:
+            self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,218 @@
+// Dependency-engine threadpool (C ABI).
+//
+// Reference parity: the reference's threaded dependency engine
+// (src/engine/threaded_engine*.cc — vars with read/write sets, ops run when
+// dependencies resolve, WaitForVar/WaitForAll). On TPU the XLA runtime owns
+// device-side ordering, so this engine schedules the HOST side: IO decode,
+// PS RPC, checkpoint writes — anything that must overlap with device steps
+// while respecting read/write ordering on shared buffers (SURVEY §7 step 2).
+//
+// Design: each Var holds a version counter + queue of pending ops (the
+// reference's VersionedVarBlock chain); an OprBlock carries an atomic
+// wait-count and fires into the pool when it hits zero.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef void (*mxtpu_fn_t)(void* arg);
+}
+
+namespace {
+
+struct Opr;
+
+struct Var {
+  std::mutex mu;
+  // ops waiting on this var, in program order; each entry is (opr, is_write)
+  std::deque<std::pair<Opr*, bool>> pending;
+  int active_readers = 0;
+  bool active_writer = false;
+};
+
+struct Opr {
+  mxtpu_fn_t fn;
+  void* arg;
+  std::vector<Var*> reads;
+  std::vector<Var*> writes;
+  std::atomic<int> wait{0};
+  int priority = 0;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) : stop_(false), inflight_(0) {
+    for (int i = 0; i < num_workers; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    {
+      std::unique_lock<std::mutex> lk(qmu_);
+      stop_ = true;
+    }
+    qcv_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (auto* v : vars_) delete v;
+  }
+
+  Var* NewVar() {
+    std::unique_lock<std::mutex> lk(vars_mu_);
+    Var* v = new Var();
+    vars_.push_back(v);
+    return v;
+  }
+
+  void Push(mxtpu_fn_t fn, void* arg, Var** reads, int n_reads, Var** writes,
+            int n_writes, int priority) {
+    Opr* op = new Opr();
+    op->fn = fn;
+    op->arg = arg;
+    op->priority = priority;
+    op->reads.assign(reads, reads + n_reads);
+    op->writes.assign(writes, writes + n_writes);
+    // dependency registration: the op must wait for every var whose current
+    // state conflicts (RAW/WAR/WAW). We enqueue on each var; a var releases
+    // ops in order, allowing concurrent readers between writers.
+    int waits = 0;
+    {
+      std::unique_lock<std::mutex> lk(sched_mu_);
+      inflight_.fetch_add(1);
+      for (Var* v : op->reads) {
+        std::unique_lock<std::mutex> vlk(v->mu);
+        if (v->active_writer || !v->pending.empty()) {
+          v->pending.emplace_back(op, false);
+          ++waits;
+        } else {
+          ++v->active_readers;
+        }
+      }
+      for (Var* v : op->writes) {
+        std::unique_lock<std::mutex> vlk(v->mu);
+        if (v->active_writer || v->active_readers > 0 || !v->pending.empty()) {
+          v->pending.emplace_back(op, true);
+          ++waits;
+        } else {
+          v->active_writer = true;
+        }
+      }
+      op->wait.store(waits + 1);
+    }
+    DecrWait(op);  // remove the +1 guard; enqueue if ready
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(done_mu_);
+    done_cv_.wait(lk, [this] { return inflight_.load() == 0; });
+  }
+
+ private:
+  void DecrWait(Opr* op) {
+    if (op->wait.fetch_sub(1) == 1) {
+      std::unique_lock<std::mutex> lk(qmu_);
+      ready_.push_back(op);
+      qcv_.notify_one();
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(qmu_);
+        qcv_.wait(lk, [this] { return stop_ || !ready_.empty(); });
+        if (stop_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop_front();
+      }
+      op->fn(op->arg);
+      Complete(op);
+    }
+  }
+
+  void Complete(Opr* op) {
+    std::vector<Opr*> to_release;
+    {
+      std::unique_lock<std::mutex> lk(sched_mu_);
+      for (Var* v : op->reads) {
+        std::unique_lock<std::mutex> vlk(v->mu);
+        --v->active_readers;
+        ReleaseFront(v, &to_release);
+      }
+      for (Var* v : op->writes) {
+        std::unique_lock<std::mutex> vlk(v->mu);
+        v->active_writer = false;
+        ReleaseFront(v, &to_release);
+      }
+    }
+    for (Opr* r : to_release) DecrWait(r);
+    delete op;
+    if (inflight_.fetch_sub(1) == 1) {
+      std::unique_lock<std::mutex> lk(done_mu_);
+      done_cv_.notify_all();
+    }
+  }
+
+  // pop runnable ops off a var's pending queue (readers run together;
+  // a writer runs alone) — the VersionedVarBlock release rule.
+  void ReleaseFront(Var* v, std::vector<Opr*>* out) {
+    while (!v->pending.empty()) {
+      auto [op, is_write] = v->pending.front();
+      if (is_write) {
+        if (v->active_readers == 0 && !v->active_writer) {
+          v->active_writer = true;
+          v->pending.pop_front();
+          out->push_back(op);
+        }
+        break;
+      } else {
+        if (v->active_writer) break;
+        ++v->active_readers;
+        v->pending.pop_front();
+        out->push_back(op);
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::deque<Opr*> ready_;
+  std::mutex qmu_, sched_mu_, vars_mu_, done_mu_;
+  std::condition_variable qcv_, done_cv_;
+  bool stop_;
+  std::atomic<int> inflight_;
+  std::vector<Var*> vars_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_engine_create(int num_workers) { return new Engine(num_workers); }
+
+void mxtpu_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+void* mxtpu_engine_new_var(void* e) {
+  return static_cast<Engine*>(e)->NewVar();
+}
+
+void mxtpu_engine_push(void* e, mxtpu_fn_t fn, void* arg, void** reads,
+                       int n_reads, void** writes, int n_writes,
+                       int priority) {
+  static_cast<Engine*>(e)->Push(fn, arg, reinterpret_cast<Var**>(reads),
+                                n_reads, reinterpret_cast<Var**>(writes),
+                                n_writes, priority);
+}
+
+void mxtpu_engine_wait_all(void* e) { static_cast<Engine*>(e)->WaitAll(); }
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+// RecordIO reader/writer + index scanner (C ABI).
+//
+// Reference parity: dmlc-core RecordIO (magic 0xced7230a, length word with
+// 3-bit cflag, 4-byte alignment) used by src/io/iter_image_recordio*.cc and
+// python/mxnet/recordio.py. Byte-compatible with the reference's .rec files.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> buf;
+};
+
+struct Writer {
+  FILE* f = nullptr;
+};
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_recordio_open_reader(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// returns pointer to record bytes valid until next call; len in *out_len;
+// nullptr at EOF / error.
+const uint8_t* mxtpu_recordio_read_next(void* h, int64_t* out_len) {
+  Reader* r = static_cast<Reader*>(h);
+  uint32_t header[2];
+  if (std::fread(header, 4, 2, r->f) != 2) return nullptr;
+  if (header[0] != kMagic) return nullptr;
+  uint32_t len = header[1] & kLenMask;
+  uint32_t padded = (len + 3u) & ~3u;
+  r->buf.resize(padded);
+  if (len > 0 && std::fread(r->buf.data(), 1, padded, r->f) != padded) {
+    return nullptr;
+  }
+  *out_len = len;
+  return r->buf.data();
+}
+
+int mxtpu_recordio_seek(void* h, int64_t pos) {
+  Reader* r = static_cast<Reader*>(h);
+  return std::fseek(r->f, static_cast<long>(pos), SEEK_SET);
+}
+
+int64_t mxtpu_recordio_tell(void* h) {
+  return std::ftell(static_cast<Reader*>(h)->f);
+}
+
+void mxtpu_recordio_close_reader(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  if (r->f) std::fclose(r->f);
+  delete r;
+}
+
+// Scan the whole file, returning record offsets (for .idx rebuild).
+// Caller provides capacity; returns count written, or -1 - needed on
+// insufficient capacity.
+int64_t mxtpu_recordio_scan_index(const char* path, int64_t* offsets,
+                                  int64_t capacity) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t count = 0;
+  for (;;) {
+    long pos = std::ftell(f);
+    uint32_t header[2];
+    if (std::fread(header, 4, 2, f) != 2) break;
+    if (header[0] != kMagic) break;
+    uint32_t len = header[1] & kLenMask;
+    uint32_t padded = (len + 3u) & ~3u;
+    if (std::fseek(f, padded, SEEK_CUR) != 0) break;
+    if (count < capacity) offsets[count] = pos;
+    ++count;
+  }
+  std::fclose(f);
+  return count;
+}
+
+void* mxtpu_recordio_open_writer(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int64_t mxtpu_recordio_write(void* h, const uint8_t* data, int64_t len) {
+  Writer* w = static_cast<Writer*>(h);
+  long pos = std::ftell(w->f);
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(len) & kLenMask};
+  std::fwrite(header, 4, 2, w->f);
+  std::fwrite(data, 1, len, w->f);
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  uint32_t pad = (4 - (len & 3)) & 3;
+  if (pad) std::fwrite(zeros, 1, pad, w->f);
+  return pos;
+}
+
+void mxtpu_recordio_close_writer(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  if (w->f) std::fclose(w->f);
+  delete w;
+}
+
+}  // extern "C"

@@ -1,0 +1,152 @@
+// Pooled host-buffer allocator + PS aggregation kernels (C ABI).
+//
+// Reference parity: src/storage/pooled_storage_manager.h (size-bucketed
+// free-list pool with env-tunable rounding) — here for HOST staging buffers
+// (IO batches, PS wire buffers); XLA owns HBM. Plus the hot server-side
+// kernels the reference runs in C++ (comm.h CommCPU reduce: vector sum /
+// axpy / 2-bit quantize-dequantize for the PS path).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::mutex mu;
+  // bucket: ceil to pow2; freelist per bucket
+  std::unordered_map<uint64_t, std::vector<void*>> free_list;
+  std::atomic<int64_t> used{0}, pooled{0};
+
+  static uint64_t Bucket(uint64_t n) {
+    uint64_t b = 1;
+    while (b < n) b <<= 1;
+    return b;
+  }
+
+  void* Alloc(uint64_t size) {
+    uint64_t b = Bucket(size);
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      auto it = free_list.find(b);
+      if (it != free_list.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled.fetch_sub(b);
+        used.fetch_add(b);
+        return p;
+      }
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, 64, b) != 0) return nullptr;
+    used.fetch_add(b);
+    return p;
+  }
+
+  void Free(void* p, uint64_t size) {
+    uint64_t b = Bucket(size);
+    std::unique_lock<std::mutex> lk(mu);
+    free_list[b].push_back(p);
+    used.fetch_sub(b);
+    pooled.fetch_add(b);
+  }
+
+  void Release() {
+    std::unique_lock<std::mutex> lk(mu);
+    for (auto& kv : free_list)
+      for (void* p : kv.second) std::free(p);
+    free_list.clear();
+    pooled.store(0);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_pool_create() { return new Pool(); }
+
+void mxtpu_pool_destroy(void* h) {
+  Pool* p = static_cast<Pool*>(h);
+  p->Release();
+  delete p;
+}
+
+void* mxtpu_pool_alloc(void* h, uint64_t size) {
+  return static_cast<Pool*>(h)->Alloc(size);
+}
+
+void mxtpu_pool_free(void* h, void* ptr, uint64_t size) {
+  static_cast<Pool*>(h)->Free(ptr, size);
+}
+
+void mxtpu_pool_release_all(void* h) { static_cast<Pool*>(h)->Release(); }
+
+int64_t mxtpu_pool_used_bytes(void* h) {
+  return static_cast<Pool*>(h)->used.load();
+}
+
+int64_t mxtpu_pool_pooled_bytes(void* h) {
+  return static_cast<Pool*>(h)->pooled.load();
+}
+
+// ---- aggregation kernels (PS server hot path) -----------------------------
+
+void mxtpu_f32_add_inplace(float* dst, const float* src, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+void mxtpu_f32_axpy(float* dst, const float* src, float alpha, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] += alpha * src[i];
+}
+
+void mxtpu_f32_scale(float* dst, float alpha, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] *= alpha;
+}
+
+// 2-bit quantize with residual (reference: gradient_compression.cc).
+// grad/residual length n; packed output length ceil(n/16) int32.
+void mxtpu_quantize_2bit(const float* grad, float* residual, int32_t* packed,
+                         float threshold, int64_t n) {
+  int64_t words = (n + 15) / 16;
+  for (int64_t w = 0; w < words; ++w) {
+    int32_t word = 0;
+    for (int64_t j = 0; j < 16; ++j) {
+      int64_t i = w * 16 + j;
+      if (i >= n) break;
+      float r = residual[i] + grad[i];
+      int32_t code = 0;
+      if (r >= threshold) {
+        code = 1;
+        residual[i] = r - threshold;
+      } else if (r <= -threshold) {
+        code = 2;
+        residual[i] = r + threshold;
+      } else {
+        residual[i] = r;
+      }
+      word |= code << (2 * j);
+    }
+    packed[w] = word;
+  }
+}
+
+void mxtpu_dequantize_2bit(const int32_t* packed, float* out, float threshold,
+                           int64_t n) {
+  int64_t words = (n + 15) / 16;
+  for (int64_t w = 0; w < words; ++w) {
+    int32_t word = packed[w];
+    for (int64_t j = 0; j < 16; ++j) {
+      int64_t i = w * 16 + j;
+      if (i >= n) break;
+      int32_t code = (word >> (2 * j)) & 3;
+      out[i] = code == 1 ? threshold : (code == 2 ? -threshold : 0.0f);
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,138 @@
+"""Native C++ runtime tests (engine, recordio, pool, 2-bit kernels)."""
+
+import functools
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_engine_write_ordering():
+    eng = native.NativeEngine(4)
+    v = eng.new_variable()
+    acc = []
+    for i in range(100):
+        eng.push(functools.partial(acc.append, i), mutable_vars=[v])
+    eng.wait_for_all()
+    assert acc == list(range(100))
+
+
+def test_engine_read_write_dependency():
+    eng = native.NativeEngine(4)
+    v = eng.new_variable()
+    log = []
+    lock = threading.Lock()
+
+    def write(tag):
+        with lock:
+            log.append(("w", tag))
+
+    def read(tag):
+        with lock:
+            log.append(("r", tag))
+
+    eng.push(functools.partial(write, 0), mutable_vars=[v])
+    for i in range(5):
+        eng.push(functools.partial(read, i), const_vars=[v])
+    eng.push(functools.partial(write, 1), mutable_vars=[v])
+    eng.wait_for_all()
+    # writes at the ends, all reads between them
+    assert log[0] == ("w", 0)
+    assert log[-1] == ("w", 1)
+    assert sorted(t for op, t in log[1:-1] if op == "r") == list(range(5))
+
+
+def test_engine_error_propagates():
+    eng = native.NativeEngine(2)
+    v = eng.new_variable()
+
+    def boom():
+        raise ValueError("boom")
+
+    eng.push(boom, mutable_vars=[v])
+    with pytest.raises(ValueError):
+        eng.wait_for_all()
+
+
+def test_native_recordio_roundtrip(tmp_path):
+    from incubator_mxnet_tpu.recordio import MXRecordIO
+    p = str(tmp_path / "t.rec")
+    w = MXRecordIO(p, "w")
+    recs = [os.urandom(i * 7 + 1) for i in range(25)]
+    for r in recs:
+        w.write(r)
+    w.close()
+    rd = native.NativeRecordReader(p)
+    got = []
+    while True:
+        r = rd.read()
+        if r is None:
+            break
+        got.append(r)
+    assert got == recs
+    offs = native.scan_record_index(p)
+    assert len(offs) == 25
+    rd.seek(int(offs[10]))
+    assert rd.read() == recs[10]
+
+
+def test_recordio_uses_native_reader(tmp_path):
+    from incubator_mxnet_tpu.recordio import MXRecordIO
+    p = str(tmp_path / "n.rec")
+    w = MXRecordIO(p, "w")
+    w.write(b"hello")
+    w.close()
+    r = MXRecordIO(p, "r")
+    assert getattr(r, "_native", None) is not None
+    assert r.read() == b"hello"
+    r.close()
+
+
+def test_pool_alloc_reuse():
+    lib = native.get_lib()
+    import ctypes
+    pool = lib.mxtpu_pool_create()
+    p1 = lib.mxtpu_pool_alloc(pool, 1000)
+    assert p1
+    lib.mxtpu_pool_free(pool, p1, 1000)
+    assert lib.mxtpu_pool_pooled_bytes(pool) == 1024
+    p2 = lib.mxtpu_pool_alloc(pool, 900)  # same bucket -> reused
+    assert p2 == p1
+    assert lib.mxtpu_pool_pooled_bytes(pool) == 0
+    lib.mxtpu_pool_free(pool, p2, 900)
+    lib.mxtpu_pool_release_all(pool)
+    assert lib.mxtpu_pool_pooled_bytes(pool) == 0
+    lib.mxtpu_pool_destroy(pool)
+
+
+def test_native_2bit_matches_jax():
+    from incubator_mxnet_tpu.kvstore.compression import GradientCompression
+    import jax.numpy as jnp
+    g = np.random.randn(77).astype(np.float32)
+    res = np.zeros(77, np.float32)
+    packed = native.quantize_2bit_native(g, res, 0.3)
+    out = native.dequantize_2bit_native(packed, 77, 0.3)
+    gc = GradientCompression(threshold=0.3)
+    ref = np.asarray(gc.compress("k", jnp.asarray(g)))
+    np.testing.assert_allclose(out, ref)
+    # residuals also match
+    ref_res = np.asarray(gc._residuals["k"])
+    np.testing.assert_allclose(res, ref_res, rtol=1e-6)
+
+
+def test_f32_kernels():
+    lib = native.get_lib()
+    a = np.arange(10, dtype=np.float32)
+    b = np.ones(10, dtype=np.float32)
+    lib.mxtpu_f32_add_inplace(a, b, 10)
+    np.testing.assert_allclose(a, np.arange(10) + 1)
+    lib.mxtpu_f32_axpy(a, b, 2.0, 10)
+    np.testing.assert_allclose(a, np.arange(10) + 3)
+    lib.mxtpu_f32_scale(a, 0.5, 10)
+    np.testing.assert_allclose(a, (np.arange(10) + 3) / 2)

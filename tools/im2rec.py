@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Pack an image directory/list into RecordIO (reference: tools/im2rec.py).
+
+Usage:
+  python tools/im2rec.py --list prefix root      # generate prefix.lst
+  python tools/im2rec.py prefix root             # pack prefix.rec + .idx
+"""
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_images(root, recursive=True, exts=(".jpg", ".jpeg", ".png", ".npy")):
+    cat = {}
+    items = []
+    i = 0
+    for path, dirs, files in os.walk(root, followlinks=True):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                label_dir = os.path.relpath(path, root)
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                items.append((i, os.path.relpath(fpath, root), cat[label_dir]))
+                i += 1
+        if not recursive:
+            break
+    return items
+
+
+def write_list(path_out, items):
+    with open(path_out, "w") as fout:
+        for idx, relpath, label in items:
+            fout.write("%d\t%f\t%s\n" % (idx, label, relpath))
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), float(parts[1]), parts[2]
+
+
+def pack(prefix, root, lst_path=None, quality=95, resize=0):
+    import numpy as np
+    from incubator_mxnet_tpu.recordio import (MXIndexedRecordIO, IRHeader,
+                                              pack_img)
+    lst_path = lst_path or prefix + ".lst"
+    record = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, label, relpath in read_list(lst_path):
+        fpath = os.path.join(root, relpath)
+        if fpath.endswith(".npy"):
+            img = np.load(fpath)
+        else:
+            try:
+                import cv2
+                img = cv2.imread(fpath)
+                if resize:
+                    h, w = img.shape[:2]
+                    scale = resize / min(h, w)
+                    img = cv2.resize(img, (int(w * scale), int(h * scale)))
+            except ImportError:
+                raise SystemExit("cv2 required to pack compressed images; "
+                                 "use .npy inputs instead")
+        header = IRHeader(0, label, idx, 0)
+        record.write_idx(idx, pack_img(header, img, quality=quality))
+        count += 1
+    record.close()
+    print("packed %d records into %s.rec" % (count, prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prefix")
+    parser.add_argument("root")
+    parser.add_argument("--list", action="store_true",
+                        help="generate the .lst only")
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--resize", type=int, default=0)
+    args = parser.parse_args()
+    if args.list:
+        items = list_images(args.root)
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(items)
+        write_list(args.prefix + ".lst", items)
+        print("wrote %d entries to %s.lst" % (len(items), args.prefix))
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            items = list_images(args.root)
+            if args.shuffle:
+                random.seed(100)
+                random.shuffle(items)
+            write_list(args.prefix + ".lst", items)
+        pack(args.prefix, args.root, quality=args.quality, resize=args.resize)
+
+
+if __name__ == "__main__":
+    main()
