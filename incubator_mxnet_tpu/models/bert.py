@@ -41,17 +41,26 @@ class MultiHeadAttention(HybridBlock):
         q = F.transpose(q, axes=(0, 2, 1, 3))   # (B,H,T,D)
         k = F.transpose(k, axes=(0, 2, 1, 3))
         v = F.transpose(v, axes=(0, 2, 1, 3))
+        out = self._attend(F, q, k, v, mask, B, T, D)
+        out = F.transpose(out, axes=(0, 2, 1, 3))
+        out = F.reshape(out, shape=(B, T, self._units))
+        return self.proj(out)
+
+    def _attend(self, F, q, k, v, mask, B, T, D):
+        # Pallas flash-attention fast path (O(T) memory on the MXU) when on
+        # TPU inside a trace with no mask/attention-dropout; einsum otherwise.
+        from ..ops.pallas import flash_attention, flash_attention_available
+        in_trace = current_trace() is not None
+        if (in_trace and mask is None and self.dropout._rate == 0
+                and T % 128 == 0 and flash_attention_available()):
+            return flash_attention(q, k, v, scale=1.0 / math.sqrt(D))
         scores = F.batch_dot(q, k, transpose_b=True) * (1.0 / math.sqrt(D))
         if mask is not None:
-            # mask: (B, T) with 1 for valid tokens
             neg = (1.0 - F.reshape(mask, shape=(B, 1, 1, T))) * -1e30
             scores = scores + neg
         attn = F.softmax(scores, axis=-1)
         attn = self.dropout(attn)
-        out = F.batch_dot(attn, v)              # (B,H,T,D)
-        out = F.transpose(out, axes=(0, 2, 1, 3))
-        out = F.reshape(out, shape=(B, T, self._units))
-        return self.proj(out)
+        return F.batch_dot(attn, v)             # (B,H,T,D)
 
 
 class PositionwiseFFN(HybridBlock):

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for the hot ops (BASELINE north star: FullyConnected,
+Conv, BatchNorm, Softmax, RNN cells as Pallas/XLA custom calls — XLA already
+emits near-peak MXU code for matmul/conv, so kernels here target what XLA
+does NOT fuse well: flash attention (O(T) memory softmax-attention)."""
+
+from .flash_attention import flash_attention, flash_attention_available

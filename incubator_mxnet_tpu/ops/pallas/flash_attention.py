@@ -1,0 +1,165 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+The O(T)-memory attention kernel (net-new vs the reference, which predates
+flash attention; justified by the BERT/long-context BASELINE configs).
+
+Forward: grid (batch*heads, q_blocks, kv_blocks); K/V stream through VMEM
+one block at a time (constant VMEM footprint at any sequence length), with
+the online-softmax accumulator held in VMEM scratch across the innermost
+grid dimension. QK^T and PV ride the MXU; the rescale runs on the VPU.
+Backward: standard flash backward recomputation in jnp (XLA-fused); a
+Pallas backward kernel is a later optimization.
+
+Falls back transparently on CPU (no Mosaic) — callers check
+``flash_attention_available()``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+_NEG_INF = -1e30
+
+
+def flash_attention_available():
+    return _PALLAS_OK and jax.default_backend() == "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                block_q, block_k, scale, causal):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: skip blocks strictly above the diagonal
+    if causal:
+        run = qi * block_q + block_q - 1 >= kj * block_k
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)                  # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = l_prev * alpha + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _fwd_call(q, k, v, scale, causal, block_q, block_k):
+    bh, T, d = q.shape
+    grid = (bh, T // block_q, T // block_k)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, T, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+def _bq(q):
+    return min(q.shape[1], 128)
+
+
+def _bk(q):
+    return min(q.shape[1], 128)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, scale, causal):
+    return _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q))
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    out = _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q))
+    return out, (q, k, v, out)
+
+
+def _flash_bwd(scale, causal, res, g):
+    """Standard flash backward; jnp/XLA-fused (lse recomputed — backward
+    materializes s anyway; the Pallas bwd kernel is a later optimization)."""
+    q, k, v, out = res
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    s = jnp.einsum("btd,bsd->bts", qf, kf)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None], s, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - lse)                                # (B,T,S)
+    dv = jnp.einsum("bts,btd->bsd", p, gf)
+    dp = jnp.einsum("btd,bsd->bts", gf, vf)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bts,bsd->btd", ds, kf) * scale
+    dk = jnp.einsum("bts,btd->bsd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, scale=None, causal=False):
+    """q/k/v: (B, H, T, D). Returns (B, H, T, D). Requires T % 128 == 0 or
+    T <= 128; callers fall back to the einsum path otherwise."""
+    B, H, T, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    bq = min(T, 128)
+    if T % bq != 0:
+        raise ValueError("flash_attention requires seq_len %% %d == 0" % bq)
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    out = _flash_core(qf, kf, vf, float(scale), bool(causal))
+    return out.reshape(B, H, T, D)

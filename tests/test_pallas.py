@@ -1,0 +1,59 @@
+"""Pallas kernel tests. On the CPU test mesh only availability/fallback is
+checked; numerical checks run when a TPU is attached (they are also
+exercised by bench/driver runs on device)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.ops.pallas import (flash_attention,
+                                            flash_attention_available)
+from incubator_mxnet_tpu.parallel.ring_attention import local_attention
+
+
+def test_available_flag_consistent():
+    avail = flash_attention_available()
+    assert avail == (jax.default_backend() == "tpu")
+
+
+def test_seq_len_validation():
+    if not flash_attention_available():
+        pytest.skip("needs TPU")
+    q = jnp.zeros((1, 1, 100, 64))
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q)
+
+
+@pytest.mark.skipif(not flash_attention_available(), reason="needs TPU")
+def test_flash_matches_reference():
+    np.random.seed(0)
+    B, H, T, D = 2, 4, 256, 64
+    q = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    out = flash_attention(q, k, v)
+    num, den, _ = local_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(num / den),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.skipif(not flash_attention_available(), reason="needs TPU")
+def test_flash_causal_and_grads():
+    np.random.seed(1)
+    B, H, T, D = 1, 2, 128, 64
+    q = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    outc = flash_attention(q, k, v, causal=True)
+    num, den, _ = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(outc), np.asarray(num / den),
+                               rtol=2e-3, atol=2e-3)
+    gf = jax.grad(lambda a, b, c: flash_attention(a, b, c).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: (lambda n, d, m: (n / d).sum())(
+        *local_attention(a, b, c)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2,
+                                   atol=1e-2)

@@ -1,0 +1,161 @@
+"""Sharding/parallelism tests on the 8-device virtual CPU mesh
+(reference analogue: multi-device tests without a cluster, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.parallel import (make_mesh, ShardedTrainer,
+                                          ring_attention, local_attention,
+                                          sharding_rules)
+from incubator_mxnet_tpu.parallel.ring_attention import make_ring_attention
+
+
+def test_make_mesh_infer():
+    mesh = make_mesh({"dp": 2, "tp": -1})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3})
+
+
+def test_ring_attention_matches_local():
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, H, T, D = 2, 2, 16, 8
+    np.random.seed(0)
+    q = jnp.asarray(np.random.rand(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.rand(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.rand(B, H, T, D).astype(np.float32))
+
+    num, den, m = local_attention(q, k, v)
+    ref = num / den
+
+    fn = make_ring_attention(mesh, seq_axis="sp", causal=False)
+    out = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_causal():
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, H, T, D = 1, 1, 8, 4
+    np.random.seed(1)
+    q = jnp.asarray(np.random.rand(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.rand(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.rand(B, H, T, D).astype(np.float32))
+    num, den, m = local_attention(q, k, v, causal=True)
+    ref = num / den
+    fn = make_ring_attention(mesh, seq_axis="sp", causal=True)
+    out = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def _make_mlp(seed=0):
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+                gluon.nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _loss_fn(out, label):
+    logp = jax.nn.log_softmax(out, axis=-1)
+    return -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None],
+                                axis=-1).mean()
+
+
+def test_sharded_trainer_dp_matches_single_device():
+    np.random.seed(0)
+    X = np.random.rand(16, 8).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.int32)
+
+    # single device
+    net1 = _make_mlp(0)
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr1 = ShardedTrainer(net1, _loss_fn, mesh1, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+    # 4-way data parallel with identical init
+    net2 = _make_mlp(0)
+    mesh2 = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    tr2 = ShardedTrainer(net2, _loss_fn, mesh2, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+
+    for _ in range(3):
+        l1 = tr1.step(nd.array(X), nd.array(y))
+        l2 = tr2.step(nd.array(X), nd.array(y))
+    np.testing.assert_allclose(float(jax.device_get(l1)),
+                               float(jax.device_get(l2)), rtol=1e-4)
+    p1 = tr1.param_values
+    p2 = tr2.param_values
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(jax.device_get(p1[k])),
+                                   np.asarray(jax.device_get(p2[k])),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_sharded_trainer_tp_matches_replicated():
+    np.random.seed(0)
+    X = np.random.rand(8, 8).astype(np.float32)
+    y = np.random.randint(0, 4, (8,)).astype(np.int32)
+    net1 = _make_mlp(0)
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr1 = ShardedTrainer(net1, _loss_fn, mesh1, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+    net2 = _make_mlp(0)
+    mesh2 = make_mesh({"dp": 2, "tp": 2}, devices=jax.devices()[:4])
+    rules = [(r"mlp_dense0_weight$", P("tp", None)),
+             (r"mlp_dense0_bias$", P("tp")),
+             (r"mlp_dense1_weight$", P(None, "tp"))]
+    tr2 = ShardedTrainer(net2, _loss_fn, mesh2, rules=rules, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+    for _ in range(2):
+        l1 = tr2.step(nd.array(X), nd.array(y))
+        l0 = tr1.step(nd.array(X), nd.array(y))
+    np.testing.assert_allclose(float(jax.device_get(l0)),
+                               float(jax.device_get(l1)), rtol=1e-4)
+
+
+def test_sharded_trainer_sync_to_block():
+    net = _make_mlp(0)
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    tr = ShardedTrainer(net, _loss_fn, mesh, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.5})
+    before = net.collect_params()["mlp_dense0_weight"] \
+        .data().asnumpy().copy()
+    X = np.random.rand(4, 8).astype(np.float32)
+    y = np.zeros(4, np.int32)
+    tr.step(nd.array(X), nd.array(y))
+    tr.sync_to_block()
+    after = net.collect_params()["mlp_dense0_weight"] \
+        .data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_collectives_in_shard_map():
+    from jax.experimental.shard_map import shard_map
+    from incubator_mxnet_tpu.parallel import collectives as C
+    import functools
+    mesh = make_mesh({"x": 8})
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                       check_rep=False)
+    def f(v):
+        s = C.all_reduce(v, "x")
+        return v * 0 + s
+
+    x = jnp.arange(8.0)
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_sharding_rules_matcher():
+    match = sharding_rules([(r"weight$", P("tp", None))])
+    assert match("layer0_weight") == P("tp", None)
+    assert match("layer0_bias") == P()
