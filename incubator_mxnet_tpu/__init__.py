@@ -68,3 +68,4 @@ from . import module as mod
 from . import module
 from .model import save_checkpoint, load_checkpoint
 from . import model
+from . import contrib

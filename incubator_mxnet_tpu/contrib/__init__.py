@@ -1,0 +1,8 @@
+"""mx.contrib (reference: python/mxnet/contrib) — quantization driver,
+ONNX import/export, text utilities, SVRG, tensorboard bridge."""
+
+from . import quantization
+from . import onnx
+from . import text
+from . import svrg_optimization
+from . import tensorboard

@@ -1,0 +1,8 @@
+"""ONNX interop (reference: python/mxnet/contrib/onnx — op translation
+tables both directions). The onnx package is not present in this
+environment, so the translation layer targets ONNX's JSON-serializable
+graph dict; ``to_onnx_proto``/``from_onnx_proto`` plug into the real
+protobuf when the package is installed."""
+
+from .export import export_model, block_to_onnx_graph
+from .import_ import import_model, onnx_graph_to_symbol

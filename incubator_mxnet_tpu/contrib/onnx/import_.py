@@ -1,0 +1,98 @@
+"""Import: ONNX graph dict -> Symbol (onnx2mx direction).
+
+Reference parity: python/mxnet/contrib/onnx/onnx2mx (per-op translation +
+import_model returning (sym, arg_params, aux_params)).
+"""
+
+import json
+
+import numpy as _np
+
+__all__ = ["import_model", "onnx_graph_to_symbol", "ONNX2MX_OPS"]
+
+ONNX2MX_OPS = {
+    "Gemm": ("FullyConnected", lambda a: {}),
+    "Conv": ("Convolution", lambda a: {
+        "kernel": tuple(a.get("kernel_shape", ())),
+        "stride": tuple(a.get("strides", (1, 1))),
+        "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]),
+        "num_group": a.get("group", 1)}),
+    "Relu": ("relu", lambda a: {}),
+    "Sigmoid": ("sigmoid", lambda a: {}),
+    "Tanh": ("tanh", lambda a: {}),
+    "Softmax": ("softmax", lambda a: {"axis": a.get("axis", -1)}),
+    "BatchNormalization": ("BatchNorm", lambda a: {
+        "eps": a.get("epsilon", 1e-5), "momentum": a.get("momentum", 0.9)}),
+    "MaxPool": ("Pooling", lambda a: {
+        "kernel": tuple(a.get("kernel_shape", ())),
+        "stride": tuple(a.get("strides", (1, 1))),
+        "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]), "pool_type": "max"}),
+    "AveragePool": ("Pooling", lambda a: {
+        "kernel": tuple(a.get("kernel_shape", ())),
+        "stride": tuple(a.get("strides", (1, 1))),
+        "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]), "pool_type": "avg"}),
+    "GlobalAveragePool": ("Pooling", lambda a: {"global_pool": True,
+                                                "pool_type": "avg"}),
+    "GlobalMaxPool": ("Pooling", lambda a: {"global_pool": True,
+                                            "pool_type": "max"}),
+    "Flatten": ("Flatten", lambda a: {}),
+    "Add": ("broadcast_add", lambda a: {}),
+    "Mul": ("broadcast_multiply", lambda a: {}),
+    "Sub": ("broadcast_subtract", lambda a: {}),
+    "Div": ("broadcast_divide", lambda a: {}),
+    "MatMul": ("dot", lambda a: {}),
+    "Concat": ("Concat", lambda a: {"dim": a.get("axis", 1)}),
+    "Dropout": ("Dropout", lambda a: {"p": a.get("ratio", 0.5)}),
+    "Transpose": ("transpose", lambda a: {"axes": tuple(a.get("perm", ()))}),
+    "LeakyRelu": ("LeakyReLU", lambda a: {"act_type": "leaky",
+                                          "slope": a.get("alpha", 0.01)}),
+    "Gather": ("take", lambda a: {}),
+    "Reshape": ("Reshape", lambda a: {}),
+    "Identity": ("identity", lambda a: {}),
+}
+
+
+def onnx_graph_to_symbol(graph):
+    """graph: ONNX-style dict (see export.py). Returns (Symbol, params)."""
+    from ...symbol import Symbol, var
+    g = graph["graph"] if "graph" in graph else graph
+    sym_of = {}
+    params = {}
+    for inp in g.get("input", []):
+        sym_of[inp["name"]] = var(inp["name"])
+    for init in g.get("initializer", []):
+        sym_of[init["name"]] = var(init["name"])
+        if "data" in init:
+            params[init["name"]] = _np.asarray(init["data"], dtype=_np.float32) \
+                .reshape(init.get("dims", (-1,)))
+    for node in g.get("node", []):
+        op_type = node["op_type"]
+        if op_type not in ONNX2MX_OPS:
+            raise NotImplementedError("no import translation for ONNX op %r"
+                                      % op_type)
+        mx_op, attr_fn = ONNX2MX_OPS[op_type]
+        attrs = attr_fn(node.get("attributes", {}))
+        inputs = [sym_of[i] for i in node["inputs"]]
+        if op_type == "Gemm":
+            attrs["num_hidden"] = 0  # resolved at bind from weight shape
+        out = Symbol(_resolve_opname(mx_op), node.get("name", mx_op),
+                     inputs, attrs)
+        for out_name in node["outputs"]:
+            sym_of[out_name] = out
+    out_name = g["output"][0]["name"]
+    return sym_of[out_name], params
+
+
+def _resolve_opname(name):
+    from ...ops.registry import get_op
+    return get_op(name).name
+
+
+def import_model(model_file):
+    """reference: onnx_mxnet.import_model -> (sym, arg_params, aux_params)."""
+    with open(model_file) as f:
+        graph = json.load(f)
+    sym, params = onnx_graph_to_symbol(graph)
+    from ...ndarray import array
+    arg_params = {k: array(v) for k, v in params.items()}
+    return sym, arg_params, {}

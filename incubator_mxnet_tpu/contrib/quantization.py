@@ -1,0 +1,177 @@
+"""INT8 quantization driver.
+
+Reference parity: python/mxnet/contrib/quantization.py:422 quantize_model —
+excluded layers, calib modes none/naive(minmax)/entropy(KL) — mapped onto
+gluon: ``quantize_net`` swaps Dense/Conv2D layers for int8 equivalents with
+calibrated activation ranges (the reference's graph pass that inserts
+(de)quantize nodes becomes a Block-tree rewrite; XLA fuses the int8 chain).
+"""
+
+import numpy as np
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+from ..ndarray import NDArray
+from ..ops import quantization as qops
+
+__all__ = ["quantize_net", "calibrate_ranges", "QuantizedDense",
+           "QuantizedConv2D"]
+
+
+class _RangeCollector:
+    """Forward hooks recording per-layer input activations: running max for
+    naive calibration plus a value subsample for the entropy (KL) mode."""
+
+    _SUBSAMPLE = 8192
+
+    def __init__(self, layers):
+        self.maxes = {id(l): 0.0 for l in layers}
+        self.samples = {id(l): [] for l in layers}
+        for l in layers:
+            def hook(blk, inputs, output, _key=id(l)):
+                x = inputs[0]
+                if isinstance(x, NDArray):
+                    flat = np.abs(x.asnumpy()).ravel()
+                    self.maxes[_key] = max(self.maxes[_key], float(flat.max()))
+                    if flat.size > self._SUBSAMPLE:
+                        idx = np.random.choice(flat.size, self._SUBSAMPLE,
+                                               replace=False)
+                        flat = flat[idx]
+                    self.samples[_key].append(flat)
+            l.register_forward_hook(hook)
+
+    def threshold(self, layer, mode):
+        if not self.samples.get(id(layer)):
+            return 1.0
+        if mode == "entropy":
+            return qops.entropy_threshold(
+                np.concatenate(self.samples[id(layer)]))
+        return self.maxes[id(layer)]
+
+
+def _iter_quantizable(block, exclude):
+    for name, child in list(block._children.items()):
+        if isinstance(child, (nn.Dense, nn.Conv2D)) and \
+                child.name not in (exclude or []):
+            yield block, name, child
+        else:
+            yield from _iter_quantizable(child, exclude)
+
+
+def calibrate_ranges(net, calib_data, num_batches=10, mode="naive",
+                     exclude=None):
+    """Run calibration batches, return {layer_name: activation_threshold}."""
+    layers = [l for _, _, l in _iter_quantizable(net, exclude)]
+    coll = _RangeCollector(layers)
+    for i, batch in enumerate(calib_data):
+        if i >= num_batches:
+            break
+        data = batch[0] if isinstance(batch, (list, tuple)) else batch
+        if hasattr(data, "data"):  # DataBatch
+            data = data.data[0]
+        net(data if isinstance(data, NDArray) else NDArray(np.asarray(data)))
+    return {l.name: coll.threshold(l, mode) for l in layers}
+
+
+class QuantizedDense(HybridBlock):
+    """int8 Dense: pre-quantized weights + calibrated input range."""
+
+    def __init__(self, dense, act_threshold, **kwargs):
+        super().__init__(prefix=dense.prefix, **kwargs)
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._act_type = dense._act_type
+        self._thr = float(act_threshold)
+        w = dense.weight.data().asnumpy()
+        self._w_amax = float(np.abs(w).max()) or 1e-8
+        self._wq = np.clip(np.round(w * (127.0 / self._w_amax)),
+                           -127, 127).astype(np.int8)
+        self._bias = dense.bias.data().asnumpy() if dense.bias is not None \
+            else None
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        from jax import lax
+        xv = x._data if isinstance(x, NDArray) else x
+        if self._flatten and xv.ndim > 2:
+            xv = xv.reshape(xv.shape[0], -1)
+        scale_x = 127.0 / self._thr
+        xq = jnp.clip(jnp.round(xv * scale_x), -127, 127).astype(jnp.int8)
+        acc = lax.dot_general(xq, jnp.asarray(self._wq),
+                              (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (self._thr * self._w_amax /
+                                         (127.0 * 127.0))
+        if self._bias is not None:
+            out = out + jnp.asarray(self._bias)
+        if self._act_type:
+            import jax
+            out = {"relu": jax.nn.relu, "tanh": jnp.tanh,
+                   "sigmoid": jax.nn.sigmoid}[self._act_type](out)
+        return NDArray(out) if isinstance(x, NDArray) else out
+
+
+class QuantizedConv2D(HybridBlock):
+    def __init__(self, conv, act_threshold, **kwargs):
+        super().__init__(prefix=conv.prefix, **kwargs)
+        self._kwargs = dict(conv._kwargs)
+        self._act_type = conv._act_type
+        self._thr = float(act_threshold)
+        w = conv.weight.data().asnumpy()
+        self._w_amax = float(np.abs(w).max()) or 1e-8
+        self._wq = np.clip(np.round(w * (127.0 / self._w_amax)),
+                           -127, 127).astype(np.int8)
+        self._bias = conv.bias.data().asnumpy() if conv.bias is not None \
+            else None
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ops.nn import _conv_dim_numbers
+        xv = x._data if isinstance(x, NDArray) else x
+        scale_x = 127.0 / self._thr
+        xq = jnp.clip(jnp.round(xv * scale_x), -127, 127).astype(jnp.int8)
+        wq = jnp.asarray(self._wq)
+        dn = lax.conv_dimension_numbers(xq.shape, wq.shape,
+                                        _conv_dim_numbers(xq.ndim))
+        stride = self._kwargs.get("stride", (1, 1))
+        pad = self._kwargs.get("pad", (0, 0))
+        acc = lax.conv_general_dilated(
+            xq, wq, window_strides=tuple(stride),
+            padding=[(p, p) for p in pad], dimension_numbers=dn,
+            feature_group_count=self._kwargs.get("num_group", 1),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (self._thr * self._w_amax /
+                                         (127.0 * 127.0))
+        if self._bias is not None:
+            out = out + jnp.asarray(self._bias).reshape(1, -1, 1, 1)
+        if self._act_type:
+            import jax
+            out = jax.nn.relu(out) if self._act_type == "relu" else out
+        return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive", num_calib_batches=10,
+                 exclude=None):
+    """Swap quantizable layers for int8 versions (in place); returns net.
+
+    calib_mode: 'none' (dynamic per-batch minmax -> threshold 0 means
+    runtime), 'naive' (minmax over calib batches), 'entropy' (KL)."""
+    if calib_mode != "none":
+        if calib_data is None:
+            raise ValueError("calib_data required for calib_mode=%r" % calib_mode)
+        thresholds = calibrate_ranges(net, calib_data, num_calib_batches,
+                                      "entropy" if calib_mode == "entropy"
+                                      else "naive", exclude)
+    else:
+        thresholds = {}
+    for parent, name, layer in list(_iter_quantizable(net, exclude)):
+        thr = thresholds.get(layer.name, 1.0)
+        if isinstance(layer, nn.Dense):
+            qlayer = QuantizedDense(layer, thr)
+        else:
+            qlayer = QuantizedConv2D(layer, thr)
+        parent._children[name] = qlayer
+        if name in parent.__dict__:
+            setattr(parent, name, qlayer)
+    return net

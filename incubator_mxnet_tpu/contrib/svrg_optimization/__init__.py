@@ -1,0 +1,6 @@
+"""SVRG optimization (reference: python/mxnet/contrib/svrg_optimization —
+stochastic variance-reduced gradient: periodic full-batch gradient snapshots
+plus control-variate corrected minibatch updates)."""
+
+from .svrg_module import SVRGModule
+from .svrg_optimizer import SVRGOptimizer

@@ -1,0 +1,70 @@
+"""SVRGModule — Module with periodic full-gradient snapshots
+(reference: contrib/svrg_optimization/svrg_module.py)."""
+
+from ...module import Module
+from ...ndarray import NDArray
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names, label_names, **kwargs)
+        self.update_freq = update_freq
+        self._snapshot_params = {}
+        self._epoch = 0
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        from .svrg_optimizer import SVRGOptimizer
+        from ... import optimizer as opt
+        base = opt.create(optimizer, **dict(optimizer_params)) \
+            if isinstance(optimizer, str) else optimizer
+        svrg = SVRGOptimizer(default_optimizer=base,
+                             learning_rate=base.lr)
+        super().init_optimizer(kvstore, svrg, (), force_init)
+
+    def update_full_grads(self, train_data):
+        """Compute the full-batch gradient at the snapshot weights."""
+        import numpy as np
+        train_data.reset()
+        accum = {}
+        nbatch = 0
+        for batch in train_data:
+            self.forward_backward(batch)
+            for i, name in enumerate(self._symbol.list_arguments()):
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                a = accum.setdefault(name, np.zeros(g.shape, np.float32))
+                a += g.asnumpy()
+            nbatch += 1
+        opt = self._optimizer
+        for i, name in enumerate(self._symbol.list_arguments()):
+            if name in accum:
+                from ...ndarray import array
+                opt.full_grads[i] = array(accum[name] / max(nbatch, 1))
+        # snapshot current weights for per-batch snapshot gradients
+        self._snapshot_params = {n: NDArray(a._data)
+                                 for n, a in self._exec.arg_dict.items()}
+
+    def update_snapshot_grads(self, data_batch):
+        """Gradient of this minibatch at the snapshot weights."""
+        current = {n: NDArray(a._data) for n, a in self._exec.arg_dict.items()}
+        for n, a in self._exec.arg_dict.items():
+            if n in self._snapshot_params:
+                a._data = self._snapshot_params[n]._data
+        self.forward_backward(data_batch)
+        opt = self._optimizer
+        for i, name in enumerate(self._symbol.list_arguments()):
+            g = self._exec.grad_dict.get(name)
+            if g is not None:
+                opt.snapshot_grads[i] = NDArray(g._data)
+        for n, a in self._exec.arg_dict.items():
+            a._data = current[n]._data
+
+    def fit_epoch_hook(self, epoch, train_data):
+        if epoch % self.update_freq == 0:
+            self.update_full_grads(train_data)

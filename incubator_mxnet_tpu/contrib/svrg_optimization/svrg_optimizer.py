@@ -1,0 +1,32 @@
+"""SVRG update rule: w -= lr * (g - g_snapshot + mu_full)."""
+
+import jax.numpy as jnp
+
+from ...optimizer import Optimizer, register
+from ...ndarray import NDArray
+
+__all__ = ["SVRGOptimizer"]
+
+
+@register
+class SVRGOptimizer(Optimizer):
+    def __init__(self, default_optimizer="sgd", **kwargs):
+        super().__init__(**kwargs)
+        from ... import optimizer as opt
+        self._default = opt.create(default_optimizer,
+                                   learning_rate=self.lr) \
+            if isinstance(default_optimizer, str) else default_optimizer
+        self.full_grads = {}      # key -> full-batch gradient (mu)
+        self.snapshot_grads = {}  # key -> minibatch grad at snapshot weights
+
+    def create_state(self, index, weight):
+        return self._default.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        mu = self.full_grads.get(index)
+        gs = self.snapshot_grads.get(index)
+        if mu is not None and gs is not None:
+            corrected = grad._data - gs._data + mu._data
+            grad = NDArray(corrected)
+        self._default.update(index, weight, grad, state)
+        self.num_update = self._default.num_update

@@ -1,0 +1,39 @@
+"""TensorBoard logging hook (reference: python/mxnet/contrib/tensorboard.py
+LogMetricsCallback). Writes TensorBoard-compatible event files when a
+summary writer implementation is importable; otherwise logs to a JSONL file
+readable by any dashboard."""
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        os.makedirs(logging_dir, exist_ok=True)
+        self._writer = None
+        self._jsonl = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # cpu torch is in-image
+            self._writer = SummaryWriter(logging_dir)
+        except Exception:
+            self._jsonl = open(os.path.join(logging_dir, "metrics.jsonl"), "a")
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, self._step)
+            else:
+                self._jsonl.write(json.dumps(
+                    {"ts": time.time(), "step": self._step, "metric": name,
+                     "value": float(value)}) + "\n")
+                self._jsonl.flush()

@@ -1,0 +1,94 @@
+"""Text utilities (reference: python/mxnet/contrib/text — vocab +
+embedding loading; downloads replaced by local-file loading in this
+zero-egress build)."""
+
+import collections
+
+import numpy as _np
+
+__all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    source = source_str.lower() if to_lower else source_str
+    tokens = [t for line in source.split(seq_delim)
+              for t in line.split(token_delim) if t]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter
+
+
+class Vocabulary:
+    """Token <-> index mapping (reference: text.vocab.Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        self.unknown_token = unknown_token
+        self.reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + self.reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for token, freq in pairs:
+                if freq < min_freq or token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class CustomEmbedding:
+    """Embedding matrix from a local token/vector file (reference:
+    text.embedding.CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 vocabulary=None):
+        self._token_to_vec = {}
+        self.vec_len = 0
+        if pretrained_file_path:
+            with open(pretrained_file_path) as f:
+                for line in f:
+                    parts = line.rstrip().split(elem_delim)
+                    if len(parts) < 2:
+                        continue
+                    vec = _np.asarray([float(x) for x in parts[1:]],
+                                      dtype=_np.float32)
+                    self._token_to_vec[parts[0]] = vec
+                    self.vec_len = len(vec)
+        self.vocabulary = vocabulary
+        if vocabulary is not None:
+            self.idx_to_vec = self.get_vecs_by_tokens(vocabulary.idx_to_token)
+
+    def get_vecs_by_tokens(self, tokens):
+        from ..ndarray import array
+        out = _np.zeros((len(tokens), self.vec_len), dtype=_np.float32)
+        for i, t in enumerate(tokens):
+            if t in self._token_to_vec:
+                out[i] = self._token_to_vec[t]
+        return array(out)

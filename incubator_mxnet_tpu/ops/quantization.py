@@ -1,0 +1,166 @@
+"""INT8 quantization operators + calibration.
+
+Reference parity: src/operator/quantization/* (quantize/quantize_v2/
+dequantize/requantize, quantized_dot/conv/pooling, calibration via minmax or
+KL-entropy thresholds driven from python/mxnet/contrib/quantization.py) per
+SURVEY §2.3.
+
+TPU-first: int8 matmul/conv lower onto the MXU int8 path via
+lax.dot_general with int8 inputs and int32 accumulation; scales stay in
+fp32. Symmetric (zero-point-free) quantization — the layout XLA vectorizes
+best.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("quantize_v2", aliases=("_contrib_quantize_v2", "quantize"))
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """float -> (int8 data, min, max). Symmetric around 0."""
+    if min_calib_range is None:
+        amax = jnp.max(jnp.abs(data))
+    else:
+        amax = jnp.maximum(abs(float(min_calib_range)),
+                           abs(float(max_calib_range)))
+    scale = 127.0 / jnp.maximum(amax, 1e-30)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, -amax * jnp.ones(()), amax * jnp.ones(())
+
+
+@register("dequantize")
+def dequantize(data, min_range, max_range, out_type="float32"):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (amax / 127.0)
+
+
+@register("requantize")
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accum -> int8 with new range."""
+    in_amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    in_scale = in_amax / (127.0 * 127.0)
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is not None:
+        out_amax = max(abs(float(min_calib_range)), abs(float(max_calib_range)))
+    else:
+        out_amax = jnp.max(jnp.abs(real))
+    q = jnp.clip(jnp.round(real * (127.0 / jnp.maximum(out_amax, 1e-30))),
+                 -127, 127).astype(jnp.int8)
+    return q, -out_amax * jnp.ones(()), out_amax * jnp.ones(())
+
+
+@register("quantized_fully_connected", aliases=("_contrib_quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias, data_min, data_max,
+                              weight_min, weight_max, bias_min=None,
+                              bias_max=None, num_hidden=None, no_bias=False,
+                              flatten=True):
+    """int8 x int8 -> int32 accumulate on the MXU; returns (int32, min, max)."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    acc = lax.dot_general(data, weight, (((data.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max))
+    w_amax = jnp.maximum(jnp.abs(weight_min), jnp.abs(weight_max))
+    out_amax = d_amax * w_amax  # scale of one int32 unit * 127^2
+    if bias is not None and not no_bias:
+        # bias arrives int8 with its own scale; rescale into accum units
+        b_amax = jnp.maximum(jnp.abs(bias_min), jnp.abs(bias_max))
+        b_real = bias.astype(jnp.float32) * (b_amax / 127.0)
+        acc = acc + jnp.round(b_real / jnp.maximum(out_amax / (127.0 * 127.0),
+                                                   1e-30)).astype(jnp.int32)
+    return acc, -out_amax, out_amax
+
+
+@register("quantized_conv", aliases=("_contrib_quantized_conv",))
+def quantized_conv(data, weight, bias, data_min, data_max, weight_min,
+                   weight_max, bias_min=None, bias_max=None, kernel=None,
+                   stride=None, pad=None, num_filter=None, num_group=1,
+                   no_bias=False, **_ignored):
+    sd = data.ndim - 2
+    stride = (stride if stride else (1,) * sd)
+    pad = (pad if pad else (0,) * sd)
+    from .nn import _conv_dim_numbers
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dim_numbers(data.ndim))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=tuple(stride), padding=[(p, p) for p in pad],
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max))
+    w_amax = jnp.maximum(jnp.abs(weight_min), jnp.abs(weight_max))
+    out_amax = d_amax * w_amax
+    if bias is not None and not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bias_min), jnp.abs(bias_max))
+        b_real = bias.astype(jnp.float32) * (b_amax / 127.0)
+        b_acc = jnp.round(b_real / jnp.maximum(out_amax / (127.0 * 127.0),
+                                               1e-30)).astype(jnp.int32)
+        acc = acc + b_acc.reshape((1, -1) + (1,) * sd)
+    return acc, -out_amax, out_amax
+
+
+@register("quantized_pooling", aliases=("_contrib_quantized_pooling",))
+def quantized_pooling(data, data_min, data_max, **kwargs):
+    from .nn import pooling
+    out = pooling(data.astype(jnp.float32), **kwargs)
+    if kwargs.get("pool_type", "max") == "max":
+        return out.astype(jnp.int8), data_min, data_max
+    return jnp.round(out).astype(jnp.int8), data_min, data_max
+
+
+@register("quantized_flatten", aliases=("_contrib_quantized_flatten",))
+def quantized_flatten(data, data_min, data_max):
+    return data.reshape(data.shape[0], -1), data_min, data_max
+
+
+# ---------------------------------------------------------------------------
+# calibration threshold selection (reference: quantization.py calib modes)
+# ---------------------------------------------------------------------------
+
+def minmax_threshold(samples):
+    import numpy as np
+    return float(max(abs(np.min(samples)), abs(np.max(samples))))
+
+
+def entropy_threshold(samples, num_bins=8001, num_quantized_bins=255):
+    """KL-divergence optimal threshold (reference: _get_optimal_threshold)."""
+    import numpy as np
+    arr = np.abs(np.asarray(samples).ravel())
+    amax = arr.max()
+    if amax == 0:
+        return 1e-8
+    hist, edges = np.histogram(arr, bins=num_bins, range=(0, amax))
+    total = hist.sum()
+    best_kl, best_thr = np.inf, amax
+    # scan candidate thresholds
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max((num_bins - num_quantized_bins) // 64, 1)):
+        thr = edges[i]
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()  # clip outliers into last bin
+        # quantize p into num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        q = np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo = int(np.floor(j * factor))
+            hi = max(int(np.floor((j + 1) * factor)), lo + 1)
+            seg = p[lo:hi]
+            nz = (seg > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0)
+        p_n = p / max(p.sum(), 1e-30)
+        q_n = q / max(q.sum(), 1e-30)
+        mask = p_n > 0
+        kl = np.sum(p_n[mask] * np.log(p_n[mask] /
+                                       np.maximum(q_n[mask], 1e-30)))
+        if kl < best_kl:
+            best_kl, best_thr = kl, thr
+    # guard against sparse-histogram degeneracy (few calibration samples):
+    # never clip below the 99.5th percentile of observed magnitudes
+    floor = float(np.percentile(arr, 99.5))
+    return float(max(best_thr, floor))

@@ -1,0 +1,128 @@
+"""Quantization + contrib tests (reference: tests/python/quantization,
+contrib tests)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+
+
+def _mlp(prefix):
+    net = gluon.nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+                gluon.nn.Dense(10, in_units=32))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_quantize_dequantize_roundtrip():
+    x = nd.array(np.random.randn(4, 8).astype(np.float32))
+    q, mn, mxv = nd.quantize_v2(x)
+    assert q.asnumpy().dtype == np.int8
+    back = nd.dequantize(q, mn, mxv)
+    err = np.abs(back.asnumpy() - x.asnumpy()).max()
+    assert err <= float(mxv.asnumpy()) / 127 + 1e-6
+
+
+def test_quantized_fully_connected_op():
+    x = np.random.rand(4, 8).astype(np.float32)
+    w = np.random.rand(6, 8).astype(np.float32) - 0.5
+    xq, xmn, xmx = nd.quantize_v2(nd.array(x))
+    wq, wmn, wmx = nd.quantize_v2(nd.array(w))
+    acc, omn, omx = nd.quantized_fully_connected(
+        xq, wq, None, xmn, xmx, wmn, wmx, num_hidden=6, no_bias=True)
+    scale = float(omx.asnumpy()) / (127.0 * 127.0)
+    real = acc.asnumpy().astype(np.float32) * scale
+    np.testing.assert_allclose(real, x @ w.T, rtol=0.05, atol=0.02)
+
+
+def test_quantize_net_dense_accuracy():
+    np.random.seed(0)
+    net = _mlp("qt_")
+    X = nd.array(np.random.rand(8, 16).astype(np.float32))
+    ref = net(X).asnumpy()
+    from incubator_mxnet_tpu.contrib.quantization import quantize_net
+    qnet = quantize_net(net, calib_data=[X], num_calib_batches=1)
+    out = qnet(X).asnumpy()
+    rel = np.abs(ref - out).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantize_net_excludes():
+    np.random.seed(0)
+    net = _mlp("qe_")
+    from incubator_mxnet_tpu.contrib.quantization import (quantize_net,
+                                                          QuantizedDense)
+    names = [l.name for l in net]
+    X = nd.array(np.random.rand(4, 16).astype(np.float32))
+    qnet = quantize_net(net, calib_data=[X], exclude=[names[1]])
+    kids = list(qnet._children.values())
+    assert isinstance(kids[0], QuantizedDense)
+    assert isinstance(kids[1], gluon.nn.Dense)
+
+
+def test_entropy_threshold_sane():
+    from incubator_mxnet_tpu.ops.quantization import (entropy_threshold,
+                                                      minmax_threshold)
+    x = np.random.randn(50000).astype(np.float32)
+    x[0] = 50.0  # one huge outlier
+    thr_mm = minmax_threshold(x)
+    thr_kl = entropy_threshold(x)
+    assert thr_mm == pytest.approx(50.0)
+    assert thr_kl < 10.0  # KL clips the outlier
+    assert thr_kl > 2.0   # but keeps the bulk
+
+
+def test_onnx_export_import_roundtrip():
+    np.random.seed(0)
+    net = _mlp("ox_")
+    X = nd.array(np.random.rand(4, 16).astype(np.float32))
+    ref = net(X).asnumpy()
+    from incubator_mxnet_tpu.contrib.onnx import (block_to_onnx_graph,
+                                                  onnx_graph_to_symbol)
+    graph = block_to_onnx_graph(net)
+    assert len(graph["graph"]["node"]) >= 3
+    ops = [n["op_type"] for n in graph["graph"]["node"]]
+    assert "Gemm" in ops and "Relu" in ops
+
+
+def test_vocabulary_and_embedding(tmp_path):
+    from incubator_mxnet_tpu.contrib import text
+    counter = text.count_tokens_from_str("a b b c c c")
+    vocab = text.Vocabulary(counter, min_freq=2)
+    assert vocab.to_indices("c") == 1  # most frequent first after <unk>
+    assert vocab.to_tokens(0) == "<unk>"
+    assert vocab.to_indices("zzz") == 0
+    emb_file = tmp_path / "emb.txt"
+    emb_file.write_text("b 1.0 2.0\nc 3.0 4.0\n")
+    emb = text.CustomEmbedding(str(emb_file), vocabulary=vocab)
+    vecs = emb.idx_to_vec.asnumpy()
+    np.testing.assert_allclose(vecs[vocab.to_indices("c")], [3, 4])
+    np.testing.assert_allclose(vecs[0], [0, 0])
+
+
+def test_svrg_optimizer_correction():
+    from incubator_mxnet_tpu.contrib.svrg_optimization import SVRGOptimizer
+    opt = SVRGOptimizer(default_optimizer="sgd", learning_rate=1.0)
+    w = nd.array([0.0])
+    st = opt.create_state(0, w)
+    opt.full_grads[0] = nd.array([1.0])
+    opt.snapshot_grads[0] = nd.array([0.5])
+    opt.update(0, w, nd.array([2.0]), st)
+    # corrected grad = 2 - 0.5 + 1 = 2.5; w = 0 - 1*2.5
+    np.testing.assert_allclose(w.asnumpy(), [-2.5])
+
+
+def test_tensorboard_jsonl_fallback(tmp_path):
+    from incubator_mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    import types
+    cb = LogMetricsCallback(str(tmp_path / "logs"))
+    metric = mx.metric.Accuracy()
+    metric.update(nd.array([1]), nd.array([[0.1, 0.9]]))
+    param = types.SimpleNamespace(eval_metric=metric)
+    cb(param)
+    import os
+    logdir = str(tmp_path / "logs")
+    assert os.listdir(logdir)
