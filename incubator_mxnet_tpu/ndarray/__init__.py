@@ -12,6 +12,7 @@ from .ndarray import (NDArray, array, empty, zeros, ones, full, arange, eye,
                       from_jax, onehot_encode)
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+from . import contrib  # noqa: F401
 from .register import _init_op_functions
 
 _init_op_functions(globals())

@@ -1,0 +1,193 @@
+"""``nd.contrib`` — control flow + dynamic-shape helpers on NDArrays.
+
+Reference parity: python/mxnet/ndarray/contrib.py (foreach/while_loop/cond
+imperative wrappers over src/operator/control_flow.cc) and
+contrib ops boolean_mask / index_copy (SURVEY §2.3 contrib table).
+
+Dual execution, mirroring the reference's imperative-vs-subgraph split:
+  * eager NDArrays -> plain Python loop / branch, every inner op recorded on
+    the autograd tape (so gradients flow into closure-captured parameters,
+    exactly like the reference's imperative fallback);
+  * traced NDArrays (inside ``hybridize``/``jit``) -> the structured XLA
+    primitives in ``ops/control_flow.py`` (``lax.scan``/``lax.cond``), which
+    is the reference's "single subgraph op" compiled path.
+"""
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .. import autograd as _ag
+from ..ops import control_flow as _cf
+from .ndarray import NDArray, _invoke_simple, _invoke_op
+
+__all__ = ["foreach", "while_loop", "cond", "boolean_mask", "index_copy",
+           "arange_like"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _is_traced(arrays):
+    for a in arrays:
+        v = a._data if isinstance(a, NDArray) else a
+        if isinstance(v, jax.core.Tracer):
+            return True
+    return False
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return [_unwrap(v) for v in x]
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return [_wrap(v) for v in x]
+    if isinstance(x, jax.Array):
+        return NDArray(x)
+    return x
+
+
+def _stack_slot(slot_vals):
+    """Stack a list of per-step NDArrays along a new axis 0 (tape-recorded)."""
+    return _invoke_simple(lambda *xs: jnp.stack(xs, axis=0), *slot_vals,
+                          op_name="stack")
+
+
+def foreach(body, data, init_states):
+    """``body(data_i, states) -> (outputs, states)`` scanned over axis 0."""
+    data_list = _as_list(data)
+    multi_data = isinstance(data, (list, tuple))
+
+    if _is_traced(data_list + _as_list(init_states)):
+        # traced (hybridize/jit): values are raw tracers per the framework's
+        # trace convention — lower to lax.scan, one structured XLA op.
+        with _ag.pause():
+            def jbody(x, st):
+                out, new_st = body(x, st)
+                return _unwrap(out), _unwrap(new_st)
+            outs, fin = _cf.foreach(jbody, _unwrap(data), _unwrap(init_states))
+        return outs, fin
+
+    # eager: reference imperative fallback — python loop, tape-recorded ops
+    states = init_states
+    per_slot, multi_out = None, False
+    length = data_list[0].shape[0]
+    for i in range(length):
+        x = [d[i] for d in data_list] if multi_data else data_list[0][i]
+        out, states = body(x, states)
+        multi_out = isinstance(out, (list, tuple))
+        out_list = _as_list(out)
+        if per_slot is None:
+            per_slot = [[] for _ in out_list]
+        for s, o in zip(per_slot, out_list):
+            s.append(o)
+    stacked = [_stack_slot(s) for s in (per_slot or [])]
+    # preserve the body's output structure so eager == hybridized
+    outputs = stacked if multi_out else (stacked[0] if stacked else [])
+    return outputs, states
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """Bounded while loop; outputs stacked & zero-padded to max_iterations."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations")
+    loop_vars = _as_list(loop_vars)
+
+    if _is_traced(loop_vars):
+        with _ag.pause():
+            def jcond(*vs):
+                return _unwrap(cond_fn(*vs))
+
+            def jfunc(*vs):
+                out, new_vs = func(*vs)
+                return _unwrap(out), _unwrap(new_vs)
+            outs, fin = _cf.while_loop(jcond, jfunc, _unwrap(loop_vars),
+                                       max_iterations)
+        return outs, fin
+
+    vars_ = list(loop_vars)
+    per_slot, steps, multi_out = None, 0, False
+    while steps < max_iterations and bool(
+            _np.asarray(_unwrap(cond_fn(*vars_)))):
+        out, new_vars = func(*vars_)
+        vars_ = _as_list(new_vars)
+        multi_out = isinstance(out, (list, tuple))
+        out_list = _as_list(out)
+        if per_slot is None:
+            per_slot = [[] for _ in out_list]
+        for s, o in zip(per_slot, out_list):
+            s.append(o)
+        steps += 1
+    if per_slot is None:  # zero iterations: shapes from an abstract trace
+        out_shape = jax.eval_shape(
+            lambda vs: _unwrap(func(*[_wrap(v) for v in vs])[0]),
+            tuple(v._data for v in vars_))
+        multi_out = isinstance(out_shape, (list, tuple))
+        leaves = jax.tree_util.tree_leaves(out_shape)
+        stacked = [NDArray(jnp.zeros((max_iterations,) + tuple(o.shape),
+                                     o.dtype)) for o in leaves]
+    else:
+        stacked = []
+        for s in per_slot:
+            arr = _stack_slot(s)
+            pad = max_iterations - len(s)
+            if pad:
+                arr = _invoke_simple(
+                    lambda a: jnp.concatenate(
+                        [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0),
+                    arr, op_name="pad_outputs")
+            stacked.append(arr)
+    outputs = stacked if multi_out else stacked[0]
+    return outputs, vars_
+
+
+def cond(pred, then_func, else_func):
+    """Branch; eager runs only the taken branch (reference imperative mode)."""
+    pred_val = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+    if _is_traced([pred_val]):
+        with _ag.pause():
+            return _cf.cond(pred_val,
+                            lambda: _unwrap(then_func()),
+                            lambda: _unwrap(else_func()))
+    return then_func() if bool(_np.asarray(pred_val).reshape(-1)[0]) \
+        else else_func()
+
+
+def boolean_mask(data, index, axis=0):
+    """Select rows where ``index`` is nonzero (dynamic output shape).
+
+    Reference: src/operator/contrib/boolean_mask.cc — a dynamic-shape op the
+    reference runs only through the interpreter; likewise eager-only here
+    (XLA needs static shapes — use ``where``-style masking inside jit).
+    """
+    if _is_traced([data, index]):
+        raise RuntimeError("boolean_mask has a data-dependent output shape "
+                           "and cannot run inside jit; use masking (e.g. "
+                           "nd.where) in hybridized code")
+    mask = _np.asarray(_unwrap(index)).astype(bool)
+    return _invoke_simple(lambda d: jnp.compress(mask, d, axis=axis), data,
+                          op_name="boolean_mask")
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy rows of ``new_tensor`` into ``old_tensor`` at ``index_vector``."""
+    return _invoke_op("index_copy", (old_tensor, index_vector, new_tensor), {})
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """arange matching ``data``'s shape (or one axis of it)."""
+    def f(d):
+        if axis is None:
+            n = d.size
+            out = (start + step * jnp.arange(n, dtype=jnp.float32))
+            return jnp.repeat(out, repeat)[:n].reshape(d.shape) if repeat > 1 \
+                else out.reshape(d.shape)
+        n = d.shape[axis]
+        return start + step * jnp.arange(n, dtype=jnp.float32)
+    return _invoke_simple(f, data, op_name="arange_like")

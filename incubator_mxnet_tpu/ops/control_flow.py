@@ -1,0 +1,107 @@
+"""Functional control-flow operators: foreach / while_loop / cond.
+
+Reference parity: src/operator/control_flow.cc (``_foreach``:1255,
+``_while_loop``:1316, ``_cond``:1378) + the Python wrappers
+python/mxnet/ndarray/contrib.py and python/mxnet/symbol/contrib.py:751.
+
+TPU-first redesign: the reference interprets a cut-out NNVM subgraph per
+iteration and hand-builds the backward subgraph. Here each construct lowers
+to the matching XLA structured-control-flow primitive — ``lax.scan`` for
+``foreach``, a masked bounded ``lax.scan`` for ``while_loop`` (so the op has
+a static output shape and stays reverse-differentiable, which a raw
+``lax.while_loop`` is not), ``lax.cond`` for ``cond`` — and autograd comes
+from XLA's native differentiation of those primitives: the whole construct
+is ONE node on the eager tape, exactly like the reference's single
+``_foreach`` tape node.
+
+All functions here operate on jax arrays / pytrees; the NDArray front-end
+lives in ``ndarray/contrib.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+# NOTE: not entered in the op registry — the registry's calling convention is
+# "arrays in, arrays out" (auto-exposed through nd.*/sym.* and JSON import),
+# which cannot supply the Python callables these constructs take.
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Run ``body(data_slice, states) -> (outputs, new_states)`` over axis 0.
+
+    ``data``: array or list of arrays, sliced along their first axis.
+    ``init_states``: array or list of arrays carried between iterations.
+    Returns ``(outputs, final_states)`` with outputs stacked along axis 0.
+    """
+    data_list = _as_list(data)
+    multi_data = isinstance(data, (list, tuple))
+    multi_state = isinstance(init_states, (list, tuple))
+    states = _as_list(init_states)
+
+    def step(carry, xs):
+        x_in = list(xs) if multi_data else xs[0]
+        s_in = list(carry) if multi_state else carry[0]
+        out, new_s = body(x_in, s_in)
+        return tuple(_as_list(new_s)), out
+
+    final, outs = lax.scan(step, tuple(states), tuple(data_list))
+    final = list(final) if multi_state else final[0]
+    return outs, final
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations):
+    """Bounded while loop with stacked per-step outputs.
+
+    ``cond_fn(*loop_vars) -> bool scalar``; ``func(*loop_vars) ->
+    (step_outputs, new_loop_vars)``. Runs until ``cond_fn`` is false or
+    ``max_iterations`` steps. Returns ``(outputs, final_loop_vars)`` where
+    each output has leading dim ``max_iterations`` (rows past the actual
+    iteration count are zero — the reference documents them as undefined).
+
+    TPU note: a fixed trip count + per-step ``lax.cond`` keeps shapes static
+    (jit-able) and the loop reverse-differentiable; XLA unrolls nothing.
+    """
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static shapes)")
+    loop_vars = _as_list(loop_vars)
+
+    # trace once to learn the step-output structure for the inactive branch
+    out_shape = jax.eval_shape(lambda vs: func(*vs)[0], tuple(loop_vars))
+
+    def step(carry, _):
+        active, vars_ = carry
+        pred = jnp.logical_and(active, jnp.asarray(cond_fn(*vars_), jnp.bool_).reshape(()))
+
+        def run(vs):
+            outs, new_vs = func(*vs)
+            return _as_list(outs), tuple(_as_list(new_vs))
+
+        def skip(vs):
+            zeros = [jnp.zeros(o.shape, o.dtype) for o in jax.tree_util.tree_leaves(out_shape)]
+            return zeros, vs
+
+        outs, new_vars = lax.cond(pred, run, skip, vars_)
+        return (pred, new_vars), outs
+
+    (_, final), stacked = lax.scan(
+        step, (jnp.asarray(True), tuple(loop_vars)), None, length=int(max_iterations))
+    if not isinstance(out_shape, (list, tuple)):
+        stacked = stacked[0]
+    return stacked, list(final)
+
+
+def cond(pred, then_func, else_func):
+    """``then_func()`` if ``pred`` else ``else_func()`` — both traced, one run.
+
+    Both branches must produce the same output structure/shapes (XLA
+    requirement; the reference enforces the same via subgraph output checks).
+    """
+    p = jnp.asarray(pred).reshape(()).astype(jnp.bool_)
+    return lax.cond(p, lambda _: then_func(), lambda _: else_func(), None)

@@ -1,0 +1,195 @@
+"""Control-flow op tests (reference: tests/python/unittest/test_contrib_control_flow.py
+— foreach/while_loop/cond forward + gradient, eager vs hybridized parity)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.ops import control_flow as cf
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------- pure (jax)
+
+def test_foreach_scan_matches_loop():
+    data = np.random.rand(5, 3).astype(np.float32)
+    init = np.zeros((3,), np.float32)
+
+    def body(x, s):
+        new_s = s + x
+        return new_s * 2, new_s
+
+    outs, fin = cf.foreach(body, jnp.asarray(data), jnp.asarray(init))
+    s = init.copy()
+    exp = []
+    for i in range(5):
+        s = s + data[i]
+        exp.append(s * 2)
+    assert_almost_equal(np.asarray(outs), np.stack(exp))
+    assert_almost_equal(np.asarray(fin), s)
+
+
+def test_foreach_multi_data_multi_state():
+    a = np.random.rand(4, 2).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+
+    def body(xs, states):
+        x, y = xs
+        s1, s2 = states
+        return x + y + s1, [s1 + x, s2 * 1.0]
+
+    outs, fin = cf.foreach(body, [jnp.asarray(a), jnp.asarray(b)],
+                           [jnp.zeros((2,)), jnp.ones((2,))])
+    assert outs.shape == (4, 2)
+    assert len(fin) == 2
+    assert_almost_equal(np.asarray(fin[0]), a.sum(axis=0))
+
+
+def test_while_loop_pure():
+    # sum integers until total >= 10, max 20 iterations
+    def cond_fn(i, total):
+        return total < 10
+
+    def func(i, total):
+        return i, (i + 1, total + i)
+
+    outs, fin = cf.while_loop(cond_fn, func,
+                              [jnp.asarray(0.0), jnp.asarray(0.0)], 20)
+    assert outs.shape == (20,)
+    # 0+1+2+3+4 = 10 -> stops after i=4 (5 steps)
+    assert float(fin[1]) == 10.0
+    assert_almost_equal(np.asarray(outs[:5]), np.arange(5, dtype=np.float32))
+    assert float(jnp.abs(outs[5:]).sum()) == 0.0
+
+
+def test_while_loop_grad_through_scan():
+    # d(sum of outputs)/d(x): differentiable bounded while
+    def f(x):
+        def cond_fn(i, acc):
+            return i < 3
+
+        def func(i, acc):
+            return acc * x, (i + 1, acc * x)
+
+        outs, _ = cf.while_loop(cond_fn, func,
+                                (jnp.asarray(0.0), jnp.asarray(1.0)), 5)
+        return outs.sum()
+
+    g = jax.grad(f)(2.0)
+    # outputs: x, x^2, x^3 -> d/dx = 1 + 2x + 3x^2 = 17 at x=2
+    assert abs(float(g) - 17.0) < 1e-5
+
+
+def test_cond_pure():
+    out = cf.cond(jnp.asarray(True), lambda: jnp.asarray(1.0) * 2,
+                  lambda: jnp.asarray(3.0))
+    assert float(out) == 2.0
+    out = cf.cond(jnp.asarray(0), lambda: jnp.asarray(1.0),
+                  lambda: jnp.asarray(3.0))
+    assert float(out) == 3.0
+
+
+# ------------------------------------------------------------- eager NDArray
+
+def test_nd_foreach_eager_and_grad():
+    data = nd.array(np.random.rand(4, 3).astype(np.float32))
+    w = nd.array(np.random.rand(3).astype(np.float32))
+    w.attach_grad()
+    init = nd.zeros((3,))
+
+    with autograd.record():
+        def body(x, s):
+            return x * w, s + x * w   # closure-captured parameter
+        outs, fin = nd.contrib.foreach(body, data, init)
+        loss = (fin * fin).sum()
+    loss.backward()
+
+    d = np.asarray(data._data)
+    wv = np.asarray(w._data)
+    fin_np = (d * wv).sum(axis=0)
+    expected_grad = 2 * fin_np * d.sum(axis=0)
+    assert_almost_equal(w.grad, expected_grad, rtol=1e-4)
+    assert_almost_equal(fin, fin_np, rtol=1e-5)
+    assert outs.shape == (4, 3)
+
+
+def test_foreach_list_output_structure_parity():
+    # a body returning a 1-element LIST must keep the list in both modes
+    data = nd.array(np.random.rand(3, 2).astype(np.float32))
+    out_eager, _ = nd.contrib.foreach(lambda x, s: ([x + s], s), data,
+                                      nd.zeros((2,)))
+    assert isinstance(out_eager, list) and len(out_eager) == 1
+    out_traced, _ = cf.foreach(lambda x, s: ([x + s], s),
+                               jnp.asarray(np.asarray(data._data)),
+                               jnp.zeros((2,)))
+    assert isinstance(out_traced, list) and len(out_traced) == 1
+    assert_almost_equal(out_eager[0], np.asarray(out_traced[0]))
+
+
+def test_nd_while_loop_eager():
+    def cond_fn(i, total):
+        return i < 3
+
+    def func(i, total):
+        return total + 1, (i + 1, total + 1)
+
+    outs, fin = nd.contrib.while_loop(cond_fn, func,
+                                      [nd.zeros(()), nd.zeros(())],
+                                      max_iterations=6)
+    assert outs.shape == (6,)
+    assert float(fin[1]._data) == 3.0
+    assert_almost_equal(outs, np.array([1, 2, 3, 0, 0, 0], np.float32))
+
+
+def test_nd_cond_eager():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.contrib.cond(x.sum() > 1,
+                              lambda: x * 3,
+                              lambda: x * 5)
+        out.backward()
+    assert float(out._data[0]) == 6.0
+    assert float(x.grad._data[0]) == 3.0
+
+
+def test_nd_boolean_mask_and_index_copy():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([1, 0, 1, 0], np.float32))
+    out = nd.contrib.boolean_mask(data, idx)
+    assert out.shape == (2, 3)
+    assert_almost_equal(out, np.asarray(data._data)[[0, 2]])
+
+    old = nd.zeros((4, 3))
+    new = nd.ones((2, 3))
+    out = nd.contrib.index_copy(old, nd.array(np.array([0, 2], np.float32)), new)
+    assert float(out._data[0, 0]) == 1.0 and float(out._data[1, 0]) == 0.0
+
+
+# ----------------------------------------------------------- hybridized path
+
+def test_foreach_in_hybridized_block():
+    class ScanNet(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.dense = mx.gluon.nn.Dense(4, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            # x: (T, B, C); accumulate dense outputs across time
+            def body(xt, s):
+                h = self.dense(xt)
+                return h, s + h
+            outs, fin = nd.contrib.foreach(
+                body, x, nd.zeros((x.shape[1], 4)))
+            return fin
+
+    net = ScanNet()
+    net.initialize()
+    x = nd.array(np.random.rand(5, 2, 3).astype(np.float32))
+    eager_out = net(x)
+    net.hybridize()
+    jit_out = net(x)
+    assert_almost_equal(jit_out, np.asarray(eager_out._data), rtol=1e-5)
