@@ -19,6 +19,7 @@ from .base import MXNetError
 from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import ndarray
 from . import ndarray as nd
+from . import operator
 from . import autograd
 from . import ops
 from .ops import random as _ops_random

@@ -182,12 +182,12 @@ def index_copy(old_tensor, index_vector, new_tensor):
 
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     """arange matching ``data``'s shape (or one axis of it)."""
+    def seq(n):
+        vals = start + step * jnp.arange(n, dtype=jnp.float32)
+        return jnp.repeat(vals, repeat)[:n] if repeat > 1 else vals
+
     def f(d):
         if axis is None:
-            n = d.size
-            out = (start + step * jnp.arange(n, dtype=jnp.float32))
-            return jnp.repeat(out, repeat)[:n].reshape(d.shape) if repeat > 1 \
-                else out.reshape(d.shape)
-        n = d.shape[axis]
-        return start + step * jnp.arange(n, dtype=jnp.float32)
+            return seq(d.size).reshape(d.shape)
+        return seq(d.shape[axis])
     return _invoke_simple(f, data, op_name="arange_like")

@@ -1,0 +1,216 @@
+"""Custom operators written in Python (the "custom op host").
+
+Reference parity: python/mxnet/operator.py (``CustomOp``:426,
+``CustomOpProp``:472, ``register``:692) + src/operator/custom/custom-inl.h —
+there, user Python callbacks run on a dedicated worker pool *outside* engine
+threads and re-enter the engine with their results.
+
+TPU-first redesign: eager calls run the Python callback directly on NDArrays
+(no engine to protect — XLA async dispatch is unaffected by the GIL); under
+``jit``/``hybridize`` the callback is staged as a ``jax.pure_callback`` —
+XLA's host-callback channel is this design's "outside the engine" worker —
+wrapped in ``jax.custom_vjp`` so the user's ``backward`` drives gradients on
+the compiled path too. Both paths share one tape semantics: the whole custom
+op is a single autograd node, like the reference's CustomOperator.
+"""
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from . import autograd as _ag
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_CUSTOM_OP_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops: override ``forward`` and ``backward``."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the write/add/null request."""
+        from .ndarray.ndarray import NDArray
+        if req == "null":
+            return
+        val = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+        val = val.astype(dst._data.dtype).reshape(dst.shape)
+        if req == "add":
+            dst._data = dst._data + val
+        else:  # write / inplace
+            dst._data = val
+
+
+class CustomOpProp:
+    """Declares a custom op's signature: arguments, outputs, shapes, types.
+
+    ``need_top_grad=False`` marks loss-style ops whose backward ignores
+    upstream gradients (reference: CustomOpProp.__init__).
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def infer_storage_type(self, in_stype):
+        return in_stype, ["default"] * len(self.list_outputs()), \
+            ["default"] * len(self.list_auxiliary_states())
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        return out_grad + in_data + out_data
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Class decorator entering a ``CustomOpProp`` subclass in the registry
+    (reference: mx.operator.register). The op becomes callable as
+    ``nd.Custom(*data, op_type=reg_name, **kwargs)``."""
+    def deco(prop_cls):
+        _CUSTOM_OP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_all_registered_operators():
+    return sorted(_CUSTOM_OP_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# invocation
+# ---------------------------------------------------------------------------
+
+def _resolve(op_type, kwargs, in_shapes, in_dtypes):
+    """Build (prop, op, out_shapes, out_dtypes) for one invocation."""
+    prop = _CUSTOM_OP_REGISTRY[op_type](**kwargs)
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
+    op = prop.create_operator(None, in_shapes, in_dtypes)
+    return prop, op, [tuple(s) for s in out_shapes], out_dtypes
+
+
+def _run_forward_numpy(op, is_train, n_out, out_shapes, out_dtypes, in_np):
+    """Host-side forward over numpy buffers (pure_callback target)."""
+    from .ndarray.ndarray import NDArray
+    in_data = [NDArray(jnp.asarray(a)) for a in in_np]
+    out_data = [NDArray(jnp.zeros(s, d)) for s, d in zip(out_shapes, out_dtypes)]
+    with _ag.pause():
+        op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+    return tuple(_np.asarray(o._data) for o in out_data)
+
+
+def _run_backward_numpy(op, n_in, in_dtypes, in_shapes, grads_np, in_np, out_np):
+    from .ndarray.ndarray import NDArray
+    in_data = [NDArray(jnp.asarray(a)) for a in in_np]
+    out_data = [NDArray(jnp.asarray(a)) for a in out_np]
+    out_grad = [NDArray(jnp.asarray(g)) for g in grads_np]
+    in_grad = [NDArray(jnp.zeros(s, d)) for s, d in zip(in_shapes, in_dtypes)]
+    with _ag.pause():
+        op.backward(["write"] * n_in, out_grad, in_data, out_data, in_grad, [])
+    return tuple(_np.asarray(g._data) for g in in_grad)
+
+
+def invoke(op_type, inputs, kwargs):
+    """Run a registered custom op. ``inputs``: NDArrays (eager) or raw jax
+    values (inside a trace). Reference flow: MXCustomOpRegister ->
+    CustomOperator::Push; here the two paths below."""
+    from .ndarray.ndarray import NDArray
+
+    traced = any(isinstance(x._data if isinstance(x, NDArray) else x,
+                            jax.core.Tracer) for x in inputs)
+    in_vals = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+               for x in inputs]
+    in_shapes = [tuple(v.shape) for v in in_vals]
+    in_dtypes = [v.dtype for v in in_vals]
+    prop, op, out_shapes, out_dtypes = _resolve(op_type, kwargs, in_shapes,
+                                                in_dtypes)
+    n_out = len(prop.list_outputs())
+    n_in = len(in_vals)
+    result_spec = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d))
+                       for s, d in zip(out_shapes, out_dtypes))
+
+    if traced:
+        # compiled path: host callback + custom vjp
+        is_train = _ag.is_training()
+
+        @jax.custom_vjp
+        def custom_fn(*ins):
+            return jax.pure_callback(
+                functools.partial(_run_forward_numpy, op, is_train, n_out,
+                                  out_shapes, out_dtypes),
+                result_spec, ins)
+
+        def fwd(*ins):
+            outs = custom_fn(*ins)
+            return outs, (ins, outs)
+
+        def bwd(res, cts):
+            ins, outs = res
+            in_spec = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d))
+                            for s, d in zip(in_shapes, in_dtypes))
+            gin = jax.pure_callback(
+                functools.partial(_run_backward_numpy, op, n_in, in_dtypes,
+                                  in_shapes),
+                in_spec, cts, ins, outs)
+            return tuple(gin)
+
+        custom_fn.defvjp(fwd, bwd)
+        outs = custom_fn(*in_vals)
+        return outs[0] if n_out == 1 else list(outs)
+
+    # eager path: direct callback on NDArrays, one tape node
+    in_nd = [x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+             for x in inputs]
+    out_nd = [NDArray(jnp.zeros(s, d)) for s, d in zip(out_shapes, out_dtypes)]
+    with _ag.pause():
+        op.forward(_ag.is_training(), ["write"] * n_out, in_nd, out_nd, [])
+
+    if _ag.is_recording():
+        def vjp_fn(cts):
+            cts = (cts,) if n_out == 1 else tuple(cts)
+            out_grad = [NDArray(c) for c in cts]
+            in_grad = [NDArray(jnp.zeros(s, d))
+                       for s, d in zip(in_shapes, in_dtypes)]
+            with _ag.pause():
+                op.backward(["write"] * n_in, out_grad, in_nd, out_nd,
+                            in_grad, [])
+            return tuple(g._data for g in in_grad)
+
+        node = _ag.TapeNode(in_nd, vjp_fn, n_out,
+                            [(o.shape, o.dtype) for o in out_nd],
+                            op_name="Custom(%s)" % op_type)
+        for i, o in enumerate(out_nd):
+            o._node = node
+            o._out_index = i
+    return out_nd[0] if n_out == 1 else out_nd
+
+
+def Custom(*data, op_type=None, **kwargs):
+    """``nd.Custom`` entry point (reference: the auto-generated Custom op)."""
+    if op_type is None:
+        raise ValueError("op_type is required")
+    return invoke(op_type, list(data), kwargs)

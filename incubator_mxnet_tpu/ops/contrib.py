@@ -195,3 +195,50 @@ def quadratic(data, a=0.0, b=0.0, c=0.0):
 @register("index_copy", aliases=("_contrib_index_copy",))
 def index_copy(old_tensor, index_vector, new_tensor):
     return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+@register("fft", aliases=("_contrib_fft",))
+def fft(data, compute_size=128):
+    """FFT along the last axis, real->interleaved [re, im] doubling the last
+    dim (reference: src/operator/contrib/fft.cc output layout)."""
+    out = jnp.fft.fft(data, axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register("ifft", aliases=("_contrib_ifft",))
+def ifft(data, compute_size=128):
+    """Inverse of ``fft``: interleaved [re, im] input, real output with the
+    last dim halved. NOTE: matches the reference's unnormalized cuFFT ifft
+    (scaled by n compared to numpy)."""
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    cplx = pairs[..., 0] + 1j * pairs[..., 1]
+    return (jnp.fft.ifft(cplx, axis=-1).real * n).astype(data.dtype)
+
+
+@register("count_sketch", aliases=("_contrib_count_sketch",))
+def count_sketch(data, h, s, out_dim):
+    """Count-sketch projection (reference: contrib/count_sketch.cc):
+    out[:, h[i]] += s[i] * data[:, i]; h in [0, out_dim), s in {+1, -1}."""
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    flat = data.reshape(-1, data.shape[-1])
+    out = jnp.zeros((flat.shape[0], int(out_dim)), data.dtype)
+    out = out.at[:, idx].add(flat * sign[None, :])
+    return out.reshape(data.shape[:-1] + (int(out_dim),))
+
+
+@register("khatri_rao", aliases=("_contrib_khatri_rao",))
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference: contrib/krprod.cc)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("allclose", aliases=("_contrib_allclose",))
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=True):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan) \
+        .astype(jnp.float32).reshape(())

@@ -10,6 +10,8 @@ zoo into surrounding matmuls, so there is no hand-written kernel launcher
 (the reference's mxnet_op::Kernel<OP,xpu>::Launch maps to "just trace it").
 """
 
+import math as _math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -233,6 +235,87 @@ def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
 
 
 register("linalg_sumlogdiag")(lambda A: jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1))
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply (reference: la_op.cc trmm)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("linalg_potri")
+def linalg_potri(A):
+    """Inverse from a Cholesky factor: (A A^T)^-1 (reference: la_op potri)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("linalg_gelqf", num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (reference: gelqf)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    # fix sign so L has a non-negative diagonal (LAPACK convention varies)
+    d = jnp.sign(jnp.diagonal(r, axis1=-2, axis2=-1))
+    d = jnp.where(d == 0, 1.0, d).astype(A.dtype)
+    q = q * d[..., None, :]
+    r = r * d[..., :, None]
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd", num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition; returns (eigenvectors-rows, values)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+register("linalg_inverse", aliases=("inverse",))(lambda A: jnp.linalg.inv(A))
+register("linalg_det", aliases=("det",))(lambda A: jnp.linalg.det(A))
+
+
+@register("linalg_slogdet", num_outputs=2, aliases=("slogdet",))
+def linalg_slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register("linalg_makediag")
+def linalg_makediag(A, offset=0):
+    return jax.vmap(lambda d: jnp.diag(d, k=offset), in_axes=0)(
+        A.reshape((-1, A.shape[-1]))).reshape(
+        A.shape[:-1] + (A.shape[-1] + abs(offset),) * 2) \
+        if A.ndim > 1 else jnp.diag(A, k=offset)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(A, offset=0, lower=True):
+    """Pack a flat vector of triangular entries into a (batched) matrix."""
+    k = A.shape[-1]
+    n = int(round((_math.sqrt(8 * k + 1) - 1) / 2)) + abs(offset)
+    idx = (jnp.tril_indices(n, k=offset) if lower
+           else jnp.triu_indices(n, k=offset))
+    flat = A.reshape((-1, k))
+    out = jnp.zeros((flat.shape[0], n, n), A.dtype)
+    out = out.at[:, idx[0], idx[1]].set(flat)
+    return out.reshape(A.shape[:-1] + (n, n))
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    idx = (jnp.tril_indices(n, k=offset) if lower
+           else jnp.triu_indices(n, k=offset))
+    flat = A.reshape((-1, n, n))
+    return flat[:, idx[0], idx[1]].reshape(A.shape[:-2] + (len(idx[0]),))
 
 
 # ---------------------------------------------------------------------------

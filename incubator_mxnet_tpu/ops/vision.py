@@ -1,0 +1,401 @@
+"""Vision / detection operators (SSD + Faster-RCNN + legacy spatial ops).
+
+Reference parity: src/operator/contrib/{multibox_target,multibox_detection,
+proposal,deformable_convolution}.cc and the legacy flat ops
+src/operator/{roi_pooling,bilinear_sampler,grid_generator,
+spatial_transformer,correlation}.cc (SURVEY §2.3).
+
+TPU-first: everything is static-shape (fixed top-k, -1-padded outputs like
+the reference's own NMS format), gather/one-hot based matching instead of
+serial argmax loops, and batched via ``vmap`` so XLA tiles it onto the MXU
+where matmul-shaped (correlation, deformable conv im2col).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .contrib import box_iou, box_nms
+
+__all__ = ["multibox_target", "multibox_detection", "proposal",
+           "deformable_convolution", "roi_pooling", "bilinear_sampler",
+           "grid_generator", "spatial_transformer", "correlation"]
+
+
+# ---------------------------------------------------------------------------
+# SSD: target assignment + detection decode
+# ---------------------------------------------------------------------------
+
+def _encode_box(anchor, gt, variances):
+    """Corner anchors + corner gt -> (dx,dy,dw,dh) regression target."""
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) / 2
+    ay = (anchor[..., 1] + anchor[..., 3]) / 2
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-8)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-8)
+    gx = (gt[..., 0] + gt[..., 2]) / 2
+    gy = (gt[..., 1] + gt[..., 3]) / 2
+    dx = (gx - ax) / jnp.maximum(aw, 1e-8) / variances[0]
+    dy = (gy - ay) / jnp.maximum(ah, 1e-8) / variances[1]
+    dw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+    dh = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+@register("MultiBoxTarget", num_outputs=3,
+          aliases=("_contrib_MultiBoxTarget", "multibox_target"))
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets.
+
+    anchor (1, N, 4) corners; label (B, M, 5) rows [cls, x0, y0, x1, y1]
+    padded with -1; cls_pred (B, num_cls+1, N) (used for hard-negative
+    mining). Returns (box_target (B, N*4), box_mask (B, N*4),
+    cls_target (B, N)) — cls_target: 0 = background, k+1 = class k.
+    """
+    anchors = anchor.reshape(-1, 4)
+    n_anchor = anchors.shape[0]
+
+    def one(lab, scores):
+        valid = lab[:, 0] >= 0                       # (M,)
+        iou = box_iou(anchors, lab[:, 1:5])          # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)            # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # every valid gt claims its own best anchor (bipartite stage);
+        # padded gt rows scatter out-of-bounds and are dropped, so they can
+        # never clobber a real gt's forced match
+        best_anchor = jnp.argmax(iou, axis=0)        # (M,)
+        scatter_idx = jnp.where(valid, best_anchor, n_anchor)
+        forced = jnp.zeros((n_anchor,), bool).at[scatter_idx].set(
+            True, mode="drop")
+        forced_gt = jnp.zeros((n_anchor,), jnp.int32).at[scatter_idx].set(
+            jnp.arange(lab.shape[0]), mode="drop")
+        matched = forced | (best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, forced_gt, best_gt)
+        gt_rows = lab[gt_idx]                        # (N, 5)
+        cls_target = jnp.where(matched, gt_rows[:, 0] + 1.0, 0.0)
+        box_t = _encode_box(anchors, gt_rows[:, 1:5], variances)
+        mask = matched.astype(anchors.dtype)[:, None]
+        box_target = (box_t * mask).reshape(-1)
+        box_mask = jnp.broadcast_to(mask, (n_anchor, 4)).reshape(-1)
+        if negative_mining_ratio > 0:
+            # hard negatives: highest non-background confidence first
+            neg_conf = jnp.where(matched, -jnp.inf,
+                                 jnp.max(scores[1:, :], axis=0))
+            n_pos = jnp.sum(matched)
+            n_neg = jnp.maximum(
+                (negative_mining_ratio * n_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            order = jnp.argsort(-neg_conf)
+            rank = jnp.zeros((n_anchor,), jnp.int32).at[order].set(
+                jnp.arange(n_anchor, dtype=jnp.int32))
+            keep_neg = (~matched) & (rank < n_neg)
+            cls_target = jnp.where(matched, cls_target,
+                                   jnp.where(keep_neg, 0.0,
+                                             float(ignore_label)))
+        return box_target, box_mask, cls_target
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt, bm, ct
+
+
+@register("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection", "multibox_detection"))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode SSD predictions to (B, N, 6) rows [cls_id, score, x0,y0,x1,y1]
+    with suppressed/invalid rows set to -1 (reference output format)."""
+    anchors = anchor.reshape(-1, 4)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    loc = loc_pred.reshape(loc_pred.shape[0], -1, 4)         # (B, N, 4)
+    cx = loc[..., 0] * variances[0] * aw + ax
+    cy = loc[..., 1] * variances[1] * ah + ay
+    w = jnp.exp(loc[..., 2] * variances[2]) * aw
+    h = jnp.exp(loc[..., 3] * variances[3]) * ah
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                      axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # class with max prob excluding background
+    fg = jnp.concatenate([cls_prob[:, :background_id],
+                          cls_prob[:, background_id + 1:]], axis=1)
+    cls_id = jnp.argmax(fg, axis=1).astype(boxes.dtype)     # (B, N)
+    score = jnp.max(fg, axis=1)
+    keep = score > threshold
+    cls_id = jnp.where(keep, cls_id, -1.0)
+    score = jnp.where(keep, score, -1.0)
+    det = jnp.concatenate([cls_id[..., None], score[..., None], boxes],
+                          axis=-1)                           # (B, N, 6)
+    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN proposal
+# ---------------------------------------------------------------------------
+
+@register("Proposal", aliases=("_contrib_Proposal", "proposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16):
+    """RPN proposals (B, post_nms, 5) rows [batch_idx, x0, y0, x1, y1].
+
+    Static top-k + padded NMS replace the reference's dynamic CUDA path.
+    """
+    n_anchor = len(scales) * len(ratios)
+    b, _, h, w = cls_prob.shape
+    base = []
+    cx = cy = (feature_stride - 1) / 2.0
+    for r in ratios:
+        size = feature_stride * feature_stride
+        ws = jnp.sqrt(size / r)
+        hs = ws * r
+        for s in scales:
+            base.append([cx - ws * s / 2, cy - hs * s / 2,
+                         cx + ws * s / 2, cy + hs * s / 2])
+    base = jnp.asarray(base)                              # (A, 4)
+    sx = jnp.arange(w) * feature_stride
+    sy = jnp.arange(h) * feature_stride
+    shift = jnp.stack(jnp.meshgrid(sx, sy, indexing="xy"), axis=-1)
+    shift = jnp.concatenate([shift, shift], axis=-1).reshape(-1, 4)
+    anchors = (base[None] + shift[:, None]).reshape(-1, 4)  # (H*W*A, 4)
+
+    def one(probs, deltas, info):
+        score = probs[n_anchor:].reshape(n_anchor, h, w)     # fg scores
+        score = score.transpose(1, 2, 0).reshape(-1)
+        d = deltas.reshape(n_anchor, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        ax = anchors[:, 0] + aw / 2
+        ay = anchors[:, 1] + ah / 2
+        px = d[:, 0] * aw + ax
+        py = d[:, 1] * ah + ay
+        pw = jnp.exp(d[:, 2]) * aw
+        ph = jnp.exp(d[:, 3]) * ah
+        boxes = jnp.stack([px - pw / 2, py - ph / 2,
+                           px + pw / 2 - 1, py + ph / 2 - 1], axis=-1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        min_size = rpn_min_size * info[2]
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size) &
+              (boxes[:, 3] - boxes[:, 1] + 1 >= min_size))
+        score_m = jnp.where(ok, score, -1.0)
+        k = min(rpn_pre_nms_top_n, score_m.shape[0])
+        top_s, top_i = lax.top_k(score_m, k)
+        det = jnp.concatenate([jnp.zeros((k, 1)), top_s[:, None],
+                               boxes[top_i]], axis=-1)
+        kept = box_nms(det[None], overlap_thresh=threshold, valid_thresh=0.0,
+                       topk=rpn_post_nms_top_n, coord_start=2, score_index=1,
+                       id_index=0)[0]
+        pad = rpn_post_nms_top_n - kept.shape[0]
+        if pad > 0:  # fewer anchors than post_nms_top_n: -1-pad (invalid)
+            kept = jnp.concatenate(
+                [kept, jnp.full((pad, kept.shape[1]), -1.0, kept.dtype)],
+                axis=0)
+        return kept[:rpn_post_nms_top_n, 2:6]
+
+    rois = jax.vmap(one)(cls_prob, bbox_pred, im_info)       # (B, P, 4)
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(b, dtype=rois.dtype)[:, None, None],
+        (b, rpn_post_nms_top_n, 1))
+    return jnp.concatenate([batch_idx, rois], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling family (STN) + deformable conv
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, x, y):
+    """Sample img (C, H, W) at float pixel coords x, y (...,) with zero pad."""
+    c, h, w = img.shape
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def at(xi, yi):
+        inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        v = img[:, yi_c, xi_c]                     # (C, ...)
+        return jnp.where(inb, v, 0.0)
+
+    v00 = at(x0, y0)
+    v01 = at(x0 + 1, y0)
+    v10 = at(x0, y0 + 1)
+    v11 = at(x0 + 1, y0 + 1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid):
+    """data (B,C,H,W), grid (B,2,Ho,Wo) in [-1,1] -> (B,C,Ho,Wo).
+
+    Reference: src/operator/bilinear_sampler.cc (same grid convention)."""
+    _, _, h, w = data.shape
+
+    def one(img, g):
+        x = (g[0] + 1) * (w - 1) / 2
+        y = (g[1] + 1) * (h - 1) / 2
+        return _bilinear_gather(img, x, y)
+
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (B, 6) -> sampling grid (B, 2, H, W) in [-1, 1];
+    warp: data (B, 2, H, W) flow field -> normalized grid."""
+    if transform_type == "affine":
+        h, w = target_shape
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gx, gy = jnp.meshgrid(xs, ys, indexing="xy")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, HW)
+
+        def one(theta):
+            out = theta.reshape(2, 3) @ coords                     # (2, HW)
+            return out.reshape(2, h, w)
+
+        return jax.vmap(one)(data)
+    # warp: flow offsets in pixels added to identity grid
+    b, _, h, w = data.shape
+    xs = jnp.arange(w, dtype=data.dtype)
+    ys = jnp.arange(h, dtype=data.dtype)
+    gx, gy = jnp.meshgrid(xs, ys, indexing="xy")
+    x = (gx[None] + data[:, 0]) * 2 / jnp.maximum(w - 1, 1) - 1
+    y = (gy[None] + data[:, 1]) * 2 / jnp.maximum(h - 1, 1) - 1
+    return jnp.stack([x, y], axis=1)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear"):
+    """STN = GridGenerator(affine) + BilinearSampler (reference:
+    src/operator/spatial_transformer.cc)."""
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool each ROI into a fixed grid. data (B,C,H,W); rois (R,5)
+    rows [batch_idx, x0, y0, x1, y1] in image coords."""
+    ph, pw = pooled_size
+    _, c, h, w = data.shape
+
+    def one(roi):
+        img = data[roi[0].astype(jnp.int32)]
+        x0 = jnp.round(roi[1] * spatial_scale)
+        y0 = jnp.round(roi[2] * spatial_scale)
+        x1 = jnp.round(roi[3] * spatial_scale)
+        y1 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x1 - x0 + 1, 1.0)
+        rh = jnp.maximum(y1 - y0 + 1, 1.0)
+        # sample a dense SxS grid per bin and max over it (static shapes)
+        s = 4
+        iy = jnp.arange(ph * s) / s
+        ix = jnp.arange(pw * s) / s
+        yy = jnp.clip(y0 + iy * rh / ph, 0, h - 1)
+        xx = jnp.clip(x0 + ix * rw / pw, 0, w - 1)
+        gx, gy = jnp.meshgrid(xx, yy, indexing="xy")
+        vals = _bilinear_gather(img, gx, gy)          # (C, ph*s, pw*s)
+        vals = vals.reshape(c, ph, s, pw, s)
+        return vals.max(axis=(2, 4))
+
+    return jax.vmap(one)(rois)
+
+
+@register("DeformableConvolution",
+          aliases=("_contrib_DeformableConvolution", "deformable_convolution"))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter=0, num_deformable_group=1,
+                           no_bias=False):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc).
+
+    offset: (B, 2*KH*KW*G, Ho, Wo) per-position sampling offsets. Lowered to
+    "deformed im2col" (bilinear gathers) + one big matmul for the MXU.
+    """
+    kh, kw = kernel
+    b, cin, h, w = data.shape
+    cout = weight.shape[0]
+    ho = (h + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    wo = (w + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    g = num_deformable_group
+    cpg = cin // g
+
+    oy = jnp.arange(ho) * stride[0] - pad[0]
+    ox = jnp.arange(wo) * stride[1] - pad[1]
+    ky = jnp.arange(kh) * dilate[0]
+    kx = jnp.arange(kw) * dilate[1]
+    # base sampling positions (KH, KW, Ho, Wo)
+    base_y = oy[None, None, :, None] + ky[:, None, None, None]
+    base_x = ox[None, None, None, :] + kx[None, :, None, None]
+
+    def one(img, off):
+        # off (2*KH*KW*G, Ho, Wo) ordered [g][kh][kw][y,x] like the reference
+        off = off.reshape(g, kh, kw, 2, ho, wo)
+        cols = []
+        for gi in range(g):
+            y = base_y + off[gi, :, :, 0]
+            x = base_x + off[gi, :, :, 1]
+            sub = img[gi * cpg:(gi + 1) * cpg]
+            vals = _bilinear_gather(sub, x, y)   # (cpg, KH, KW, Ho, Wo)
+            cols.append(vals)
+        col = jnp.concatenate(cols, axis=0)       # (cin, KH, KW, Ho, Wo)
+        col = col.reshape(cin * kh * kw, ho * wo)
+        out = weight.reshape(cout, -1) @ col      # MXU matmul
+        return out.reshape(cout, ho, wo)
+
+    out = jax.vmap(one)(data, offset)
+    if bias is not None and not no_bias:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+@register("Correlation", num_outputs=1, aliases=("correlation",))
+def correlation(data1, data2, kernel_size=1, max_displacement=4, stride1=1,
+                stride2=1, pad_size=4, is_multiply=True):
+    """Cost volume between two feature maps (reference:
+    src/operator/correlation.cc, FlowNet-style), patch dot-products over a
+    displacement window."""
+    b, c, h, w = data1.shape
+    d = max_displacement
+    k = kernel_size
+    pads = [(0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)]
+    p1 = jnp.pad(data1, pads)
+    p2 = jnp.pad(data2, pads)
+    sumelems = k * k * c
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            shifted = jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+            if is_multiply:
+                prod = (p1 * shifted).sum(axis=1)
+            else:
+                prod = jnp.abs(p1 - shifted).sum(axis=1)
+            if k > 1:  # patch correlation: window-sum over the k x k kernel
+                prod = lax.reduce_window(
+                    prod, 0.0, lax.add, (1, k, k), (1, 1, 1), "SAME")
+            prod = prod / sumelems
+            outs.append(prod[:, pad_size:pad_size + h:stride1,
+                             pad_size:pad_size + w:stride1])
+    return jnp.stack(outs, axis=1)

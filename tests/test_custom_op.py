@@ -1,0 +1,118 @@
+"""Custom-op host tests (reference: tests/python/unittest/test_operator.py
+test_custom_op — forward/backward parity, eager and jitted)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        y = 1.0 / (1.0 + nd.exp(-x))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+def test_custom_op_eager_forward_backward():
+    x_np = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    sig = 1 / (1 + np.exp(-x_np))
+    assert_almost_equal(y, sig, rtol=1e-5)
+    assert_almost_equal(x.grad, sig * (1 - sig), rtol=1e-5)
+
+
+def test_custom_op_in_jit():
+    x_np = np.random.uniform(-2, 2, (2, 3)).astype(np.float32)
+
+    def f(v):
+        out = nd.Custom(v, op_type="test_sigmoid")
+        return out.sum()
+
+    val, grad = jax.value_and_grad(f)(jnp.asarray(x_np))
+    sig = 1 / (1 + np.exp(-x_np))
+    assert abs(float(val) - sig.sum()) < 1e-4
+    assert_almost_equal(np.asarray(grad), sig * (1 - sig), rtol=1e-4)
+
+
+def test_custom_op_registry_listing():
+    assert "test_sigmoid" in mx.operator.get_all_registered_operators()
+
+
+@mx.operator.register("test_add_mul")
+class AddMulProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "prod"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return AddMul(self.scale)
+
+
+class AddMul(mx.operator.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data
+        self.assign(out_data[0], req[0], (a + b) * self.scale)
+        self.assign(out_data[1], req[1], a * b)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        a, b = in_data
+        g0, g1 = out_grad
+        self.assign(in_grad[0], req[0], g0 * self.scale + g1 * b)
+        self.assign(in_grad[1], req[1], g0 * self.scale + g1 * a)
+
+
+def test_custom_op_multi_output_kwargs():
+    a = nd.array(np.array([[1.0, 2.0]], np.float32))
+    b = nd.array(np.array([[3.0, 4.0]], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        s, p = nd.Custom(a, b, op_type="test_add_mul", scale="2.0")
+        (s.sum() + p.sum()).backward()
+    assert_almost_equal(s, np.array([[8.0, 12.0]], np.float32))
+    assert_almost_equal(p, np.array([[3.0, 8.0]], np.float32))
+    assert_almost_equal(a.grad, 2.0 + np.array([[3.0, 4.0]]))
+    assert_almost_equal(b.grad, 2.0 + np.array([[1.0, 2.0]]))

@@ -1,0 +1,254 @@
+"""Vision/detection + linalg op tests vs numpy oracles (reference:
+tests/python/unittest/test_operator.py la_op & contrib op sections)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from incubator_mxnet_tpu import nd
+import incubator_mxnet_tpu.ops as T  # registry-backed namespace
+V = C = T
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+
+# ------------------------------------------------------------------- linalg
+
+def _spd(n):
+    a = np.random.rand(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_potrf_potri():
+    A = _spd(4)
+    L = np.asarray(T.linalg_potrf(jnp.asarray(A)))
+    assert_almost_equal(L @ L.T, A, rtol=1e-4, atol=1e-4)
+    Ainv = np.asarray(T.linalg_potri(jnp.asarray(L)))
+    assert_almost_equal(Ainv, np.linalg.inv(A), rtol=1e-3, atol=1e-3)
+
+
+def test_trmm():
+    A = np.random.rand(3, 3).astype(np.float32)
+    B = np.random.rand(3, 3).astype(np.float32)
+    out = np.asarray(T.linalg_trmm(jnp.asarray(A), jnp.asarray(B), alpha=2.0))
+    assert_almost_equal(out, 2.0 * np.tril(A) @ B, rtol=1e-5)
+
+
+def test_gelqf():
+    A = np.random.rand(3, 5).astype(np.float32)
+    L, Q = T.linalg_gelqf(jnp.asarray(A))
+    L, Q = np.asarray(L), np.asarray(Q)
+    assert_almost_equal(L @ Q, A, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(Q @ Q.T, np.eye(3), rtol=1e-4, atol=1e-5)
+    assert (np.diag(L) >= 0).all()
+
+
+def test_syevd_det_slogdet_inverse():
+    A = _spd(4)
+    U, w = T.linalg_syevd(jnp.asarray(A))
+    U, w = np.asarray(U), np.asarray(w)
+    assert_almost_equal(U.T @ np.diag(w) @ U, A, rtol=1e-3, atol=1e-3)
+    assert abs(float(np.asarray(T.linalg_det(jnp.asarray(A)))) -
+               np.linalg.det(A)) / np.linalg.det(A) < 1e-3
+    sign, logabs = T.linalg_slogdet(jnp.asarray(A))
+    assert float(sign) == 1.0
+    assert abs(float(logabs) - np.linalg.slogdet(A)[1]) < 1e-3
+    assert_almost_equal(np.asarray(T.linalg_inverse(jnp.asarray(A))),
+                        np.linalg.inv(A), rtol=1e-3, atol=1e-3)
+
+
+def test_diag_trian_roundtrip():
+    d = np.random.rand(2, 3).astype(np.float32)
+    M = np.asarray(T.linalg_makediag(jnp.asarray(d)))
+    assert M.shape == (2, 3, 3)
+    back = np.asarray(T.linalg_extractdiag(jnp.asarray(M)))
+    assert_almost_equal(back, d)
+
+    A = np.random.rand(3, 3).astype(np.float32)
+    tri = np.asarray(T.linalg_extracttrian(jnp.asarray(A)))
+    assert tri.shape == (6,)
+    M2 = np.asarray(T.linalg_maketrian(jnp.asarray(tri)))
+    assert_almost_equal(M2, np.tril(A), rtol=1e-6)
+
+
+# ----------------------------------------------------------------- contrib
+
+def test_fft_ifft_roundtrip():
+    x = np.random.rand(2, 8).astype(np.float32)
+    f = C.fft(jnp.asarray(x))
+    assert f.shape == (2, 16)
+    back = np.asarray(C.ifft(f)) / 8.0  # reference ifft is unnormalized
+    assert_almost_equal(back, x, rtol=1e-4, atol=1e-5)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([0, 1, 0], np.float32)
+    s = np.array([1, -1, 1], np.float32)
+    out = np.asarray(C.count_sketch(jnp.asarray(x), jnp.asarray(h),
+                                    jnp.asarray(s), 2))
+    assert_almost_equal(out, np.array([[4.0, -2.0]], np.float32))
+
+
+def test_khatri_rao():
+    A = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    B = np.array([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]], np.float32)
+    out = np.asarray(C.khatri_rao(jnp.asarray(A), jnp.asarray(B)))
+    assert out.shape == (6, 2)
+    expected = np.stack([np.kron(A[:, i], B[:, i]).reshape(-1)
+                         for i in range(2)], axis=1)
+    assert_almost_equal(out, expected)
+
+
+# ------------------------------------------------------------------ vision
+
+def test_multibox_target_basic():
+    # one anchor right on the gt, one far away
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]],
+                       np.float32)
+    label = np.array([[[1.0, 0.1, 0.1, 0.4, 0.4],
+                       [-1.0, -1.0, -1.0, -1.0, -1.0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    bt, bm, ct = V.multibox_target(jnp.asarray(anchors), jnp.asarray(label),
+                                   jnp.asarray(cls_pred))
+    ct = np.asarray(ct)
+    assert ct[0, 0] == 2.0  # class 1 -> target 2 (0 is background)
+    assert ct[0, 1] == 0.0
+    bm = np.asarray(bm).reshape(1, 2, 4)
+    assert bm[0, 0].sum() == 4.0 and bm[0, 1].sum() == 0.0
+    # perfectly matched anchor -> zero regression target
+    assert np.abs(np.asarray(bt).reshape(1, 2, 4)[0, 0]).max() < 1e-4
+
+
+def test_multibox_detection_decodes():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                       np.float32)
+    # class 1 confident on anchor 0; background on anchor 1
+    cls_prob = np.array([[[0.1, 0.9], [0.8, 0.05], [0.1, 0.05]]], np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)
+    out = np.asarray(V.multibox_detection(jnp.asarray(cls_prob),
+                                          jnp.asarray(loc_pred),
+                                          jnp.asarray(anchors)))
+    assert out.shape == (1, 2, 6)
+    best = out[0, 0]
+    assert best[0] == 0.0 and abs(best[1] - 0.8) < 1e-5
+    assert_almost_equal(best[2:6], anchors[0, 0], rtol=1e-4)
+
+
+def test_roi_pooling():
+    data = np.arange(1 * 1 * 8 * 8, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = np.asarray(V.roi_pooling(jnp.asarray(data), jnp.asarray(rois),
+                                   pooled_size=(2, 2), spatial_scale=1.0))
+    assert out.shape == (1, 1, 2, 2)
+    assert out.max() == data.max()
+
+
+def test_bilinear_sampler_identity():
+    data = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    ys = np.linspace(-1, 1, 5)
+    xs = np.linspace(-1, 1, 5)
+    gx, gy = np.meshgrid(xs, ys, indexing="xy")
+    grid = np.stack([gx, gy], axis=0)[None].astype(np.float32)
+    out = np.asarray(V.bilinear_sampler(jnp.asarray(data), jnp.asarray(grid)))
+    assert_almost_equal(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    data = np.random.rand(2, 1, 4, 4).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = np.asarray(V.spatial_transformer(jnp.asarray(data),
+                                           jnp.asarray(theta),
+                                           target_shape=(4, 4)))
+    assert_almost_equal(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    data = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    weight = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 3, 3), np.float32)
+    out = np.asarray(V.deformable_convolution(
+        jnp.asarray(data), jnp.asarray(offset), jnp.asarray(weight),
+        kernel=(3, 3), num_filter=3))
+    import jax
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(data), jnp.asarray(weight), (1, 1), "VALID")
+    assert_almost_equal(out, np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_correlation_self_zero_disp():
+    x = np.random.rand(1, 4, 6, 6).astype(np.float32)
+    out = np.asarray(V.correlation(jnp.asarray(x), jnp.asarray(x),
+                                   max_displacement=1, pad_size=1))
+    assert out.shape == (1, 9, 6, 6)
+    # center displacement channel == mean over channels of x*x
+    assert_almost_equal(out[:, 4], (x * x).mean(axis=1), rtol=1e-4)
+
+
+def test_proposal_shapes():
+    b, a, h, w = 1, 6, 4, 4  # 2 scales x 3 ratios
+    cls_prob = np.random.rand(b, 2 * a, h, w).astype(np.float32)
+    bbox = (np.random.rand(b, 4 * a, h, w).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    out = np.asarray(V.proposal(jnp.asarray(cls_prob), jnp.asarray(bbox),
+                                jnp.asarray(im_info), rpn_pre_nms_top_n=50,
+                                rpn_post_nms_top_n=10, scales=(4, 8),
+                                ratios=(0.5, 1, 2)))
+    assert out.shape == (1, 10, 5)
+    valid = out[0][out[0, :, 3] > 0]
+    assert (valid[:, 1] >= 0).all() and (valid[:, 3] <= 63).all()
+
+
+def test_multibox_target_forced_match_survives_padding():
+    # gt's best anchor has IoU < threshold -> only the forced bipartite
+    # match assigns it; a -1 padding row must not clobber that match
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3], [0.5, 0.5, 0.9, 0.9]]],
+                       np.float32)
+    label = np.array([[[0.0, 0.0, 0.0, 0.45, 0.45],
+                       [-1.0, -1.0, -1.0, -1.0, -1.0]]], np.float32)
+    cls_pred = np.zeros((1, 2, 2), np.float32)
+    _, _, ct = T.multibox_target(jnp.asarray(anchors), jnp.asarray(label),
+                                 jnp.asarray(cls_pred))
+    assert np.asarray(ct)[0, 0] == 1.0  # class 0 -> target 1, kept
+
+
+def test_proposal_small_feature_map_and_batch_index():
+    b, h, w = 2, 2, 2
+    a = 6
+    cls_prob = np.random.rand(b, 2 * a, h, w).astype(np.float32)
+    bbox = np.zeros((b, 4 * a, h, w), np.float32)
+    im_info = np.tile(np.array([[64, 64, 1.0]], np.float32), (b, 1))
+    out = np.asarray(T.proposal(jnp.asarray(cls_prob), jnp.asarray(bbox),
+                                jnp.asarray(im_info), rpn_pre_nms_top_n=20,
+                                rpn_post_nms_top_n=50, scales=(4, 8),
+                                ratios=(0.5, 1, 2)))
+    assert out.shape == (2, 50, 5)          # padded past the 24 anchors
+    assert (out[0, :, 0] == 0).all() and (out[1, :, 0] == 1).all()
+
+
+def test_correlation_kernel_size_patch_sum():
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    out = np.asarray(T.correlation(jnp.asarray(x), jnp.asarray(x),
+                                   kernel_size=3, max_displacement=0,
+                                   pad_size=0))
+    # center pixel: sum of 3x3 patch of per-pixel self-products / (9*C)
+    prod = (x * x).sum(axis=1)[0]
+    expected = prod[1:4, 1:4].sum() / (9 * 2)
+    assert abs(out[0, 0, 2, 2] - expected) < 1e-4
+
+
+def test_multibox_detection_batched():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                       np.float32)
+    cls_prob = np.random.rand(3, 3, 2).astype(np.float32)
+    loc_pred = np.zeros((3, 8), np.float32)
+    out = np.asarray(T.multibox_detection(jnp.asarray(cls_prob),
+                                          jnp.asarray(loc_pred),
+                                          jnp.asarray(anchors)))
+    assert out.shape == (3, 2, 6)
+
+
+def test_arange_like_repeat_with_axis():
+    from incubator_mxnet_tpu import nd as _nd
+    data = _nd.zeros((6, 3))
+    out = np.asarray(_nd.contrib.arange_like(data, axis=0, repeat=2)._data)
+    assert_almost_equal(out, np.array([0, 0, 1, 1, 2, 2], np.float32))
