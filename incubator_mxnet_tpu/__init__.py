@@ -50,6 +50,8 @@ from . import kvstore as kv
 from . import kvstore
 from . import symbol
 from . import symbol as sym
+from . import subgraph
+from . import rtc
 from . import parallel
 from . import models
 from . import runtime

@@ -47,9 +47,20 @@ def set_recording(flag):
     return old
 
 
+# last-set training mode across ALL threads: XLA host callbacks (custom ops
+# under jit) execute on runtime threads where the thread-local is unset, so
+# they consult this instead (single-trainer processes — the common case)
+_GLOBAL_TRAINING = [False]
+
+
+def global_training():
+    return _GLOBAL_TRAINING[0]
+
+
 def set_training(flag):
     st = _st()
     old, st.training = st.training, flag
+    _GLOBAL_TRAINING[0] = flag
     return old
 
 
@@ -64,11 +75,13 @@ class _RecordingScope:
             st.recording = self._rec
         if self._train is not None:
             st.training = self._train
+            _GLOBAL_TRAINING[0] = self._train
         return self
 
     def __exit__(self, *a):
         st = _st()
         st.recording, st.training = self._old
+        _GLOBAL_TRAINING[0] = st.training
         return False
 
 

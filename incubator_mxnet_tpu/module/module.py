@@ -34,6 +34,7 @@ class Module(BaseModule):
         self._arg_params = None
         self._aux_params = None
         self._grad_req = "write"
+        self._output_shapes = None
 
     @property
     def data_names(self):
@@ -46,6 +47,10 @@ class Module(BaseModule):
     @property
     def output_names(self):
         return self._symbol.list_outputs()
+
+    @property
+    def output_shapes(self):
+        return list(zip(self.output_names, self._output_shapes or []))
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -64,10 +69,10 @@ class Module(BaseModule):
                 shape_feed[name] = shape
         arg_names = self._symbol.list_arguments()
         aux_names = self._symbol.list_auxiliary_states()
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape_with_partial(**shape_feed) \
-            if hasattr(self._symbol, "infer_shape_with_partial") else \
+        arg_shapes, out_shapes, aux_shapes = \
             self._symbol.infer_shape(**{k: v for k, v in shape_feed.items()
                                         if k in arg_names})
+        self._output_shapes = out_shapes
         if arg_shapes is None:
             raise ValueError("shape inference failed; provide full input shapes")
         args, grads = [], []

@@ -1,0 +1,112 @@
+"""PythonModule — modules implemented directly in Python.
+
+Reference parity: python/mxnet/module/python_module.py (``PythonModule``
+base + ``PythonLossModule``) per SURVEY §2.6: plug arbitrary Python compute
+(e.g. a hand-written loss and its gradient) into a Module pipeline, usually
+as the tail of a SequentialModule.
+"""
+
+import logging
+
+import numpy as _np
+
+from .base_module import BaseModule
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["PythonModule", "PythonLossModule"]
+
+
+class PythonModule(BaseModule):
+    """A module whose compute is plain Python. Parameterless by default
+    (the reference's PythonModule also fixes get_params to empty)."""
+
+    def __init__(self, data_names, label_names, output_names, logger=logging):
+        super().__init__(logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes or []
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self._data_shapes = [(d.name, d.shape) if hasattr(d, "name") else d
+                             for d in data_shapes]
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+        self.params_initialized = True
+
+    def _compute_output_shapes(self):
+        """Default: one output shaped like the first data input."""
+        return [(self._output_names[0], self._data_shapes[0][1])]
+
+    def init_params(self, *args, **kwargs):
+        self.params_initialized = True
+
+    def init_optimizer(self, *args, **kwargs):
+        self.optimizer_initialized = True
+
+    def get_params(self):
+        return {}, {}
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        pass
+
+
+class PythonLossModule(PythonModule):
+    """A Python-defined loss: forward stores predictions, backward emits
+    ``grad_func(pred, label)`` (reference: PythonLossModule with its
+    symbolic-or-python grad options)."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names, label_names, [name + "_output"], logger)
+        self._name = name
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        self._grad_func = grad_func
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if data_batch.label is not None and len(data_batch.label):
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, "loss module is the graph head"
+        if self._grad_func is not None:
+            grad = self._grad_func(self._scores, self._labels)
+            if not isinstance(grad, NDArray):
+                grad = nd_array(_np.asarray(grad))
+            self._scores_grad = grad
+        else:
+            # default: d/dx of L2 loss |scores - labels|^2 / 2
+            self._scores_grad = self._scores - self._labels
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._scores_grad]

@@ -1,0 +1,130 @@
+"""SequentialModule — chain of Modules executed in order.
+
+Reference parity: python/mxnet/module/sequential_module.py (add() with
+take_labels/auto_wiring meta, chained bind/forward/backward) per SURVEY §2.6.
+"""
+
+import logging
+
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    """Container chaining modules: outputs of module i feed module i+1."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        assert self._modules, "add() at least one module before bind()"
+        self.for_training = for_training
+        self._label_shapes = label_shapes
+        cur_shapes = data_shapes
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            labels = label_shapes if meta.get(self.META_TAKE_LABELS) else None
+            mod.bind(cur_shapes, labels, for_training=for_training,
+                     inputs_need_grad=(inputs_need_grad or i > 0),
+                     force_rebind=force_rebind, grad_req=grad_req)
+            # next module's data = this module's outputs (shape-inferred,
+            # no execution — params are not initialized yet at bind time)
+            if meta.get(self.META_AUTO_WIRING, True) and i + 1 < len(self._modules):
+                out_shapes = [s for _, s in mod.output_shapes]
+                next_names = self._modules[i + 1].data_names
+                cur_shapes = list(zip(next_names, out_shapes))
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        for mod in self._modules:
+            mod.init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params, allow_missing=True,
+                            force_init=force_init, allow_extra=True)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        for mod in self._modules:
+            mod.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = data_batch
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            mod.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                break
+            out = mod.get_outputs()
+            batch = _Batch(out, data_batch.label
+                           if self._metas[i + 1].get(self.META_TAKE_LABELS)
+                           else None)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        grads = out_grads
+        for mod in reversed(self._modules):
+            mod.backward(grads)
+            grads = mod.get_input_grads()
+
+    def update(self):
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for mod in self._modules:
+            a, x = mod.get_params()
+            arg.update(a)
+            aux.update(x)
+        return arg, aux
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for mod, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS):
+                mod.update_metric(eval_metric, labels, pre_sliced)
+                return
+        self._modules[-1].update_metric(eval_metric, labels, pre_sliced)
+
+
+class _Batch:
+    def __init__(self, data, label):
+        self.data = data
+        self.label = label
+        self.pad = 0

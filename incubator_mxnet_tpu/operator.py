@@ -114,8 +114,15 @@ def _resolve(op_type, kwargs, in_shapes, in_dtypes):
 
 
 def _run_forward_numpy(op, is_train, n_out, out_shapes, out_dtypes, in_np):
-    """Host-side forward over numpy buffers (pure_callback target)."""
+    """Host-side forward over numpy buffers (pure_callback target).
+
+    ``is_train=None`` means "read the autograd mode at execution time" —
+    host callbacks run on XLA runtime threads AFTER tracing, so a value
+    captured at trace time would go stale when the same compiled function
+    is reused under a different train/predict mode."""
     from .ndarray.ndarray import NDArray
+    if is_train is None:
+        is_train = _ag.global_training()
     in_data = [NDArray(jnp.asarray(a)) for a in in_np]
     out_data = [NDArray(jnp.zeros(s, d)) for s, d in zip(out_shapes, out_dtypes)]
     with _ag.pause():
@@ -154,13 +161,12 @@ def invoke(op_type, inputs, kwargs):
                        for s, d in zip(out_shapes, out_dtypes))
 
     if traced:
-        # compiled path: host callback + custom vjp
-        is_train = _ag.is_training()
-
+        # compiled path: host callback + custom vjp; is_train resolved at
+        # callback runtime (None sentinel), not baked in at trace time
         @jax.custom_vjp
         def custom_fn(*ins):
             return jax.pure_callback(
-                functools.partial(_run_forward_numpy, op, is_train, n_out,
+                functools.partial(_run_forward_numpy, op, None, n_out,
                                   out_shapes, out_dtypes),
                 result_spec, ins)
 

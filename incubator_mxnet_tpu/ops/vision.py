@@ -201,6 +201,15 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         kept = box_nms(det[None], overlap_thresh=threshold, valid_thresh=0.0,
                        topk=rpn_post_nms_top_n, coord_start=2, score_index=1,
                        id_index=0)[0]
+        # NMS marks suppressed rows by score=-1 but keeps their coords:
+        # compact survivors to the front and -1-fill suppressed coords so
+        # they can't masquerade as valid ROIs downstream
+        order = jnp.argsort(-kept[:, 1])
+        kept = kept[order]
+        valid = kept[:, 1] >= 0
+        kept = jnp.concatenate(
+            [kept[:, :2], jnp.where(valid[:, None], kept[:, 2:6], -1.0)],
+            axis=1)
         pad = rpn_post_nms_top_n - kept.shape[0]
         if pad > 0:  # fewer anchors than post_nms_top_n: -1-pad (invalid)
             kept = jnp.concatenate(

@@ -1,0 +1,102 @@
+"""mx.rtc — runtime kernel compilation.
+
+Reference parity: python/mxnet/rtc.py (``CudaModule``/``CudaKernel``: user
+kernel source compiled at runtime via NVRTC, src/common/rtc.cc) per
+SURVEY §2.6.
+
+TPU-first redesign: the runtime-compiled kernel language on TPU is
+**Pallas**, not CUDA C. ``PallasModule`` takes Python source defining Pallas
+kernel functions (``pl``/``jnp`` are in scope), compiles them on first
+launch via ``pl.pallas_call`` (Mosaic on TPU, Triton on GPU, interpreter on
+CPU), and exposes the same get_kernel/launch flow as the reference. A
+``CudaModule`` alias raises a clear error pointing here.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PallasModule", "PallasKernel", "CudaModule"]
+
+
+class PallasKernel:
+    """One launchable kernel (reference: CudaKernel.launch)."""
+
+    def __init__(self, fn, name, interpret):
+        self._fn = fn
+        self._name = name
+        self._interpret = interpret
+        self._compiled = {}
+
+    def launch(self, args, out_shape, grid=None, in_specs=None,
+               out_specs=None):
+        """Run the kernel. ``args``: input arrays (NDArray or jax);
+        ``out_shape``: (shape, dtype) or list thereof; ``grid``/specs:
+        standard pallas_call grid/BlockSpecs (optional for whole-array
+        kernels)."""
+        from jax.experimental import pallas as pl
+        from .ndarray.ndarray import NDArray
+
+        vals = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in args]
+        # normalize out_shape to a list of (shape, dtype) pairs
+        if (isinstance(out_shape, (list, tuple)) and len(out_shape) == 2
+                and isinstance(out_shape[0], (list, tuple))
+                and not isinstance(out_shape[1], (list, tuple))):
+            out_shape = [tuple(out_shape)]
+        shapes = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                  for s, d in out_shape]
+        kwargs = {}
+        if grid is not None:
+            kwargs["grid"] = grid
+        if in_specs is not None:
+            kwargs["in_specs"] = in_specs
+        if out_specs is not None:
+            kwargs["out_specs"] = out_specs
+        key = (tuple((v.shape, str(v.dtype)) for v in vals),
+               tuple((tuple(s), str(d)) for s, d in out_shape), grid)
+        call = self._compiled.get(key)
+        if call is None:
+            call = jax.jit(pl.pallas_call(
+                self._fn,
+                out_shape=shapes[0] if len(shapes) == 1 else shapes,
+                interpret=self._interpret, **kwargs))
+            self._compiled[key] = call
+        out = call(*vals)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        wrapped = [NDArray(o) for o in outs]
+        return wrapped[0] if len(wrapped) == 1 else wrapped
+
+
+class PallasModule:
+    """Compile Pallas kernel source at runtime (reference: CudaModule).
+
+    ``source`` is Python code defining kernel functions of the standard
+    Pallas form ``def my_kernel(x_ref, ..., o_ref): ...``; names listed in
+    ``exports`` become retrievable via ``get_kernel``.
+    """
+
+    def __init__(self, source, options=(), exports=()):
+        self._exports = list(exports)
+        from jax.experimental import pallas as pl
+        ns = {"pl": pl, "jnp": jnp, "jax": jax}
+        exec(compile(source, "<rtc>", "exec"), ns)  # user-authored kernels
+        self._ns = ns
+        # TPU/GPU compile through Mosaic/Triton; CPU runs the interpreter
+        self._interpret = jax.default_backend() == "cpu"
+
+    def get_kernel(self, name, signature=None):
+        """signature accepted for reference-API compatibility; Pallas infers
+        types from the launch arguments."""
+        if self._exports and name not in self._exports:
+            raise ValueError("kernel %r not exported" % name)
+        fn = self._ns.get(name)
+        if fn is None:
+            raise ValueError("kernel %r not defined in module source" % name)
+        return PallasKernel(fn, name, self._interpret)
+
+
+def CudaModule(*a, **kw):
+    raise NotImplementedError(
+        "CUDA RTC is not available in the TPU-native framework; use "
+        "mx.rtc.PallasModule — the same runtime-compilation flow with "
+        "Pallas kernel source (see ops/pallas for examples)")

@@ -1,0 +1,350 @@
+"""Subgraph framework: pluggable graph partitioning & pattern rewriting.
+
+Reference parity: src/operator/subgraph/subgraph_property.h:77,111
+(``SubgraphSelector``/``SubgraphProperty``), the partitioner
+``build_subgraph.cc``, and the MKLDNN conv+bn fusion property
+(src/operator/subgraph/mkldnn/) per SURVEY §2.3.
+
+TPU-first redesign: XLA already fuses elementwise chains, so a TPU subgraph
+property is NOT about fusion-for-bandwidth — it is for *semantic* rewrites
+the compiler can't do: folding BatchNorm statistics into Convolution weights
+for inference, swapping a matched pattern for a Pallas kernel, or isolating
+a region to jit as one unit. Partitions are replaced by a dynamically
+registered op that evaluates the captured subgraph, so partitioned symbols
+run through the normal executor/JSON machinery unchanged.
+"""
+
+from .ops.registry import register, get_op
+from .symbol import Symbol, _eval_symbol, _make_apply, var
+
+__all__ = ["SubgraphSelector", "SubgraphProperty", "DefaultSubgraphProperty",
+           "ConvBNFoldProperty", "register_subgraph_property",
+           "get_subgraph_property", "partition", "list_subgraph_properties"]
+
+_PROPERTY_REGISTRY = {}
+
+
+class SubgraphSelector:
+    """Decides which nodes join a subgraph (reference: SubgraphSelector).
+
+    The partitioner calls ``select(node)`` to seed a subgraph, then
+    ``select_input``/``select_output`` as it grows along edges. Stateless
+    base accepts nothing.
+    """
+
+    def select(self, node):
+        return False
+
+    def select_input(self, node, input_node):
+        return False
+
+    def select_output(self, node, output_node):
+        return False
+
+    def reset(self):
+        """Called before each new seed (selectors may carry per-seed state)."""
+
+
+class OpListSelector(SubgraphSelector):
+    """Selects connected regions whose ops are all in ``op_names``."""
+
+    def __init__(self, op_names):
+        self.op_names = frozenset(op_names)
+
+    def _ok(self, node):
+        return node._op is not None and node._op != "_group" \
+            and node._op in self.op_names
+
+    def select(self, node):
+        return self._ok(node)
+
+    def select_input(self, node, input_node):
+        return self._ok(input_node)
+
+    def select_output(self, node, output_node):
+        return self._ok(output_node)
+
+
+class SubgraphProperty:
+    """Creates selectors and builds the replacement node for each partition."""
+
+    name = "subgraph"
+
+    def create_selector(self):
+        raise NotImplementedError
+
+    def create_subgraph_node(self, subgraph_sym, inputs, idx):
+        """Default: wrap the captured subgraph as one dynamically registered
+        op (reference: default property wraps partitions as stateful
+        subgraph ops). Contract: ``subgraph_sym``'s free variables are named
+        ``in0..inN`` matching the order of ``inputs`` (see _fused_output)."""
+        op_name = "_subgraph_%s_%d" % (self.name, idx)
+
+        def fused(*vals, **_ignored):
+            feed = {"in%d" % i: v for i, v in enumerate(vals)}
+            out = _eval_symbol(subgraph_sym, feed, wrap=False)
+            return tuple(out) if isinstance(out, list) else out
+
+        n_out = len(subgraph_sym.list_outputs())
+        register(op_name, num_outputs=n_out)(fused)
+        return _make_apply(op_name, inputs, {}, name=op_name)
+
+
+def subgraph_sym_free_vars(sym):
+    return [n for n in sym._topo() if n._op is None]
+
+
+class DefaultSubgraphProperty(SubgraphProperty):
+    """Partition by op-name list: ``DefaultSubgraphProperty(["Convolution",
+    "Activation"])`` groups maximal connected conv/act regions."""
+
+    def __init__(self, op_names, name="default"):
+        self.op_names = list(op_names)
+        self.name = name
+
+    def create_selector(self):
+        return OpListSelector(self.op_names)
+
+
+class ConvBNFoldProperty(SubgraphProperty):
+    """Fold inference BatchNorm into the preceding Convolution
+    (reference: MKLDNN conv+bn fusion, subgraph/mkldnn/).
+
+    Rewrites Conv(w, b) -> BN(gamma, beta, mean, var) into a single
+    Convolution with w' = w * s, b' = (b - mean) * s + beta where
+    s = gamma / sqrt(var + eps). The scaling is emitted as graph ops on the
+    parameter inputs; XLA constant-folds them at compile time, so inference
+    runs one conv with no BN math at all.
+    """
+
+    name = "conv_bn_fold"
+
+    class _Selector(SubgraphSelector):
+        def select(self, node):
+            return node._op == "Convolution"
+
+        def select_output(self, node, output_node):
+            return node._op == "Convolution" and output_node._op == "BatchNorm" \
+                and not output_node._attrs.get("training", False)
+
+    def create_selector(self):
+        return self._Selector()
+
+    def create_subgraph_node(self, subgraph_sym, inputs, idx):
+        nodes = [n for n in subgraph_sym._topo() if n._op is not None]
+        ops = {n._op: n for n in nodes}
+        if set(ops) != {"Convolution", "BatchNorm"}:
+            # bare conv seed with no BN behind it: keep as-is
+            return DefaultSubgraphProperty([], self.name) \
+                .create_subgraph_node(subgraph_sym, inputs, idx)
+        conv, bn = ops["Convolution"], ops["BatchNorm"]
+        eps = bn._attrs.get("eps", 1e-3)
+        fix_gamma = bn._attrs.get("fix_gamma", True)
+        # free vars are named in0..inN matching the inputs order (contract)
+        ext = {"in%d" % i: s for i, s in enumerate(inputs)}
+
+        data = ext[conv._inputs[0]._name]
+        w = ext[conv._inputs[1]._name]
+        has_bias = len(conv._inputs) > 2 and not conv._attrs.get("no_bias", False)
+        gamma = ext[bn._inputs[1]._name]
+        beta = ext[bn._inputs[2]._name]
+        mean = ext[bn._inputs[3]._name]
+        variance = ext[bn._inputs[4]._name]
+
+        if fix_gamma:
+            s = (variance + eps) ** -0.5
+        else:
+            s = gamma * (variance + eps) ** -0.5
+        # w' = w * s  (broadcast s (C,) over (C, cin/g, kh, kw))
+        s_w = _make_apply("reshape", [s], {"shape": (-1, 1, 1, 1)})
+        w_f = _make_apply("broadcast_multiply", [w, s_w], {})
+        if has_bias:
+            b = ext[conv._inputs[2]._name]
+            b_f = (b - mean) * s + beta
+        else:
+            b_f = beta - mean * s
+        attrs = {k: v for k, v in conv._attrs.items()
+                 if not k.startswith("__")}
+        attrs["no_bias"] = False
+        return _make_apply("Convolution", [data, w_f, b_f], attrs,
+                           name="%s_fused%d" % (self.name, idx))
+
+
+def register_subgraph_property(prop):
+    _PROPERTY_REGISTRY[prop.name] = prop
+    return prop
+
+
+def get_subgraph_property(name):
+    return _PROPERTY_REGISTRY[name]
+
+
+def list_subgraph_properties():
+    return sorted(_PROPERTY_REGISTRY)
+
+
+register_subgraph_property(ConvBNFoldProperty())
+
+
+# ---------------------------------------------------------------------------
+# partitioner (reference: build_subgraph.cc)
+# ---------------------------------------------------------------------------
+
+def _consumers(nodes):
+    out = {id(n): [] for n in nodes}
+    for n in nodes:
+        for i in n._inputs:
+            if id(i) in out:
+                out[id(i)].append(n)
+    return out
+
+def _is_convex(members, nodes):
+    """No path from a member through an external node back into a member
+    (otherwise the fused node would create a dependency cycle)."""
+    member_ids = {id(m) for m in members}
+    consumers = _consumers(nodes)
+    # taint = reachable-from-subgraph through at least one external node
+    tainted = set()
+    for n in nodes:  # topo order
+        feeds_taint = any(id(i) in tainted for i in n._inputs)
+        feeds_member = any(id(i) in member_ids for i in n._inputs)
+        if id(n) in member_ids:
+            if feeds_taint:
+                return False
+        elif feeds_taint or feeds_member:
+            tainted.add(id(n))
+    return True
+
+
+def partition(sym, prop):
+    """Partition ``sym`` with ``prop`` and return the rewritten Symbol
+    (reference: MXBuildSubgraphByOpNames / SubgraphProperty pipeline)."""
+    if isinstance(prop, str):
+        prop = get_subgraph_property(prop)
+    nodes = sym._topo()
+    consumers = _consumers(nodes)
+    claimed = set()
+    groups = []
+    for seed in nodes:
+        if seed._op in (None, "_group") or id(seed) in claimed:
+            continue
+        selector = prop.create_selector()
+        selector.reset()
+        if not selector.select(seed):
+            continue
+        members = [seed]
+        member_ids = {id(seed)}
+        frontier = [seed]
+        while frontier:
+            cur = frontier.pop()
+            for i in cur._inputs:
+                if id(i) not in member_ids and id(i) not in claimed \
+                        and i._op not in (None, "_group") \
+                        and selector.select_input(cur, i):
+                    members.append(i)
+                    member_ids.add(id(i))
+                    frontier.append(i)
+            for c in consumers.get(id(cur), []):
+                if id(c) not in member_ids and id(c) not in claimed \
+                        and selector.select_output(cur, c):
+                    members.append(c)
+                    member_ids.add(id(c))
+                    frontier.append(c)
+        if not _is_convex(members, nodes):
+            continue
+        claimed |= member_ids
+        groups.append(member_ids)
+
+    if not groups:
+        return sym
+
+    # rebuild the graph bottom-up, replacing each group with its fused node
+    group_of = {}
+    for gi, g in enumerate(groups):
+        for nid in g:
+            group_of[nid] = gi
+    rebuilt = {}          # id(old node) -> new Symbol (base node)
+    fused_built = {}      # group idx -> fused Symbol
+
+    def rebuilt_input(i):
+        base = rebuilt[id(i)]
+        oi = i._out_index or 0
+        return base[oi] if oi else base
+
+    for n in nodes:
+        if id(n) in group_of:
+            continue  # handled when the group's sink is reached (below)
+        if n._op is None or n._op == "_group":
+            rebuilt[id(n)] = n
+        else:
+            new_inputs = []
+            for i in n._inputs:
+                if id(i) in group_of:
+                    new_inputs.append(_fused_output(i, group_of, groups,
+                                                    fused_built, nodes,
+                                                    rebuilt, prop))
+                else:
+                    new_inputs.append(rebuilt_input(i))
+            rebuilt[id(n)] = Symbol(n._op, n._name, new_inputs, n._attrs,
+                                    n._num_outputs)
+
+    def resolve(s):
+        if id(s) in group_of:
+            return _fused_output(s, group_of, groups, fused_built, nodes,
+                                 rebuilt, prop)
+        return rebuilt_input(s)
+
+    if sym._op == "_group":
+        from .symbol import Group
+        return Group([resolve(s) for s in sym._inputs])
+    return resolve(sym)
+
+
+def _fused_output(old_node, group_of, groups, fused_built, nodes, rebuilt,
+                  prop):
+    """Get (building if needed) the fused node output replacing old_node."""
+    gi = group_of[id(old_node)]
+    if gi not in fused_built:
+        g = groups[gi]
+        members = [n for n in nodes if id(n) in g]
+        member_ids = set(g)
+        # subgraph sinks = members consumed outside (or graph heads)
+        consumers = _consumers(nodes)
+        sinks = [m for m in members
+                 if any(id(c) not in member_ids for c in consumers[id(m)])
+                 or not consumers[id(m)]]
+        # build an isolated copy of the subgraph over fresh input vars
+        ext_inputs = []     # original input Symbols (outside the group)
+        var_map = {}
+        copies = {}
+        for m in members:
+            new_ins = []
+            for i in m._inputs:
+                if id(i) in member_ids:
+                    base = copies[id(i)]
+                    oi = i._out_index or 0
+                    new_ins.append(base[oi] if oi else base)
+                else:
+                    key = (id(i), i._out_index or 0)
+                    if key not in var_map:
+                        var_map[key] = var("in%d" % len(ext_inputs))
+                        ext_inputs.append(i)
+                    new_ins.append(var_map[key])
+            copies[id(m)] = Symbol(m._op, m._name, new_ins, m._attrs,
+                                   m._num_outputs)
+        from .symbol import Group
+        sink_syms = [copies[id(s)] for s in sinks]
+        sub_sym = sink_syms[0] if len(sink_syms) == 1 else Group(sink_syms)
+        # external inputs, rebuilt in the outer graph
+        outer_inputs = []
+        for i in ext_inputs:
+            base = rebuilt.get(id(i), i)
+            oi = i._out_index or 0
+            outer_inputs.append(base[oi] if oi else base)
+        fused = prop.create_subgraph_node(sub_sym, outer_inputs, gi)
+        fused_built[gi] = (fused, [id(s) for s in sinks])
+    fused, sink_ids = fused_built[gi]
+    # map old_node to the right output slot of the fused node
+    if id(old_node) in sink_ids and len(sink_ids) > 1:
+        return fused[sink_ids.index(id(old_node))]
+    return fused

@@ -143,25 +143,64 @@ class Symbol:
 
     # --------------------------------------------------------------- shapes
     def infer_shape(self, **kwargs):
+        """Node-by-node abstract-shape walk. Parameter shapes missing from
+        ``kwargs`` are filled by per-op backward rules (the reference's
+        FInferShape bidirectional inference for weight/bias/gamma slots)."""
         import jax
 
-        arg_names = self.list_arguments() + self.list_auxiliary_states()
-        shapes = dict(kwargs)
-        missing = [n for n in arg_names if n not in shapes]
-        if missing:
-            return None, None, None  # partial inference unsupported without hints
+        known = {k: tuple(v) for k, v in kwargs.items()}
+        nodes = self._topo()
+        out_shapes = {}   # id(node) -> tuple of output shapes
 
-        def fn(feed):
-            outs = _eval_symbol(self, {k: v for k, v in feed.items()}, wrap=False)
-            return outs
+        for n in nodes:
+            if n._op is None:
+                s = known.get(n._name)
+                if s is None:  # () is a valid scalar shape — explicit check
+                    s = n._attrs.get("__shape__")
+                out_shapes[id(n)] = (tuple(s),) if s is not None else (None,)
+                continue
+            if n._op == "_group":
+                continue
+            in_shapes = [out_shapes[id(i)][i._out_index or 0]
+                         for i in n._inputs]
+            if any(s is None for s in in_shapes):
+                rule = _PARAM_SHAPE_RULES.get(n._op)
+                if rule is None:
+                    return None, None, None
+                filled = rule(in_shapes, n._attrs)
+                if filled is None or any(s is None for s in filled):
+                    return None, None, None
+                for i, s in zip(n._inputs, filled):
+                    if i._op is None and known.get(i._name) is None:
+                        known[i._name] = tuple(s)
+                        out_shapes[id(i)] = (tuple(s),)
+                in_shapes = [tuple(s) for s in filled]
+            attrs = {k: v for k, v in n._attrs.items() if not k.startswith("__")}
+            kw_inputs = n._attrs.get("__kwarg_inputs__", [])
+            kw_pos = {p for _, p in kw_inputs}
+            feed = [jax.ShapeDtypeStruct(s, _np.float32) for s in in_shapes]
+            kw = {k: feed[p] for k, p in kw_inputs}
+            pos = [v for j, v in enumerate(feed) if j not in kw_pos]
+            try:
+                out = jax.eval_shape(
+                    lambda *a, **k: get_op(n._op).fn(*a, **{**attrs, **k}),
+                    *pos, **kw)
+            except Exception:
+                return None, None, None
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            out_shapes[id(n)] = tuple(tuple(o.shape) for o in outs)
 
-        feed = {n: jax.ShapeDtypeStruct(tuple(shapes[n]), _np.float32)
-                for n in arg_names}
-        out = jax.eval_shape(fn, feed)
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        arg_shapes = [tuple(shapes[n]) for n in self.list_arguments()]
-        aux_shapes = [tuple(shapes[n]) for n in self.list_auxiliary_states()]
-        return arg_shapes, [tuple(o.shape) for o in outs], aux_shapes
+        arg_shapes = [known.get(nm) for nm in self.list_arguments()]
+        aux_shapes = [known.get(nm) for nm in self.list_auxiliary_states()]
+        if any(s is None for s in arg_shapes + aux_shapes):
+            return None, None, None
+        if self._op == "_group":
+            outs = [out_shapes[id(s)][s._out_index or 0] for s in self._inputs]
+        else:
+            sink = out_shapes[id(nodes[-1])]
+            outs = [sink[self._out_index]] if self._out_index is not None \
+                else list(sink)
+        return arg_shapes, outs, aux_shapes
 
     def infer_shape_partial(self, **kwargs):
         try:
@@ -228,6 +267,90 @@ class Symbol:
         idx = {id(n): i for i, n in enumerate(nodes)}
         return [{"name": n._name, "op": n._op or "null",
                  "inputs": [i._name for i in n._inputs]} for n in nodes]
+
+
+# ---------------------------------------------------------------------------
+# backward parameter-shape rules (reference: per-op FInferShape filling
+# weight/bias/gamma slots from the data shape, e.g. fully_connected.cc:40-80)
+# ---------------------------------------------------------------------------
+
+def _prod(t):
+    out = 1
+    for v in t:
+        out *= v
+    return out
+
+
+def _fc_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return None
+    nh = attrs.get("num_hidden")
+    in_units = _prod(data[1:]) if attrs.get("flatten", True) else data[-1]
+    out = [data, (nh, in_units)]
+    if len(ins) > 2:
+        out.append((nh,))
+    return out
+
+
+def _conv_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return None
+    nf = attrs.get("num_filter")
+    kernel = tuple(attrs.get("kernel"))
+    g = attrs.get("num_group", 1)
+    out = [data, (nf, data[1] // g) + kernel]
+    if len(ins) > 2:
+        out.append((nf,))
+    return out
+
+
+def _deconv_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return None
+    nf = attrs.get("num_filter")
+    kernel = tuple(attrs.get("kernel"))
+    g = attrs.get("num_group", 1)
+    out = [data, (data[1], nf // g) + kernel]
+    if len(ins) > 2:
+        out.append((nf,))
+    return out
+
+
+def _bn_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return None
+    c = data[attrs.get("axis", 1)]
+    return [data] + [(c,)] * (len(ins) - 1)
+
+
+def _ln_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return None
+    c = data[attrs.get("axis", -1)]
+    return [data] + [(c,)] * (len(ins) - 1)
+
+
+def _embed_shapes(ins, attrs):
+    data = ins[0]
+    if data is None:
+        return None
+    return [data, (attrs.get("input_dim"), attrs.get("output_dim"))]
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_shapes,
+    "Convolution": _conv_shapes,
+    "Deconvolution": _deconv_shapes,
+    "BatchNorm": _bn_shapes,
+    "LayerNorm": _ln_shapes,
+    "InstanceNorm": _ln_shapes,
+    "Embedding": _embed_shapes,
+}
 
 
 _name_counter = {}

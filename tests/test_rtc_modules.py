@@ -1,0 +1,103 @@
+"""rtc (PallasModule) + SequentialModule/PythonModule tests
+(reference: test_rtc.py pattern; tests/python/unittest/test_module.py
+SequentialModule/PythonLossModule sections)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu.module import (Module, SequentialModule,
+                                        PythonLossModule)
+from incubator_mxnet_tpu.io import NDArrayIter, DataBatch
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+
+def test_pallas_module_axpy():
+    src = """
+def axpy_kernel(x_ref, y_ref, o_ref):
+    o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+"""
+    mod = mx.rtc.PallasModule(src, exports=["axpy_kernel"])
+    k = mod.get_kernel("axpy_kernel")
+    x = nd.array(np.random.rand(8, 128).astype(np.float32))
+    y = nd.array(np.random.rand(8, 128).astype(np.float32))
+    out = k.launch([x, y], out_shape=((8, 128), "float32"))
+    assert_almost_equal(out, 2 * np.asarray(x._data) + np.asarray(y._data),
+                        rtol=1e-6)
+
+
+def test_pallas_module_unknown_kernel():
+    mod = mx.rtc.PallasModule("def k(x_ref, o_ref):\n    o_ref[...] = x_ref[...]\n",
+                              exports=["k"])
+    with pytest.raises(ValueError):
+        mod.get_kernel("nope")
+
+
+def test_cuda_module_redirects():
+    with pytest.raises(NotImplementedError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def _linear_symbol():
+    data = sym.var("data")
+    w = sym.var("fc_weight")
+    b = sym.var("fc_bias")
+    return sym.FullyConnected(data, w, b, num_hidden=2, name="fc")
+
+
+def test_sequential_module_forward_backward_update():
+    np.random.seed(0)
+    net = SequentialModule()
+    net.add(Module(_linear_symbol(), data_names=("data",), label_names=()))
+    net.add(PythonLossModule(data_names=("fc_output",), label_names=()),
+            take_labels=True)
+    net.bind(data_shapes=[("data", (4, 3))], label_shapes=[("sl", (4, 2))])
+    net.init_params(initializer=mx.init.Xavier())
+    net.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+
+    X = np.random.rand(4, 3).astype(np.float32)
+    W = np.array([[1.0, 0.0, 1.0], [0.0, 1.0, 0.0]], np.float32)
+    Y = X @ W.T
+    first_loss = last_loss = None
+    for i in range(25):
+        batch = DataBatch(data=[nd.array(X)], label=[nd.array(Y)])
+        net.forward(batch, is_train=True)
+        out = np.asarray(net.get_outputs()[0]._data)
+        loss = ((out - Y) ** 2).mean()
+        if first_loss is None:
+            first_loss = loss
+        last_loss = loss
+        net.backward()
+        net.update()
+    assert last_loss < first_loss * 0.2, (first_loss, last_loss)
+
+
+def test_python_loss_module_custom_grad():
+    calls = {}
+
+    def gfunc(pred, label):
+        calls["n"] = calls.get("n", 0) + 1
+        return pred - label
+
+    m = PythonLossModule(grad_func=gfunc)
+    m.bind(data_shapes=[("data", (2, 2))])
+    p = nd.array(np.ones((2, 2), np.float32))
+    l = nd.array(np.zeros((2, 2), np.float32))
+    m.forward(DataBatch(data=[p], label=[l]), is_train=True)
+    m.backward()
+    g = m.get_input_grads()[0]
+    assert calls["n"] == 1
+    assert_almost_equal(g, np.ones((2, 2), np.float32))
+
+
+def test_module_output_shapes_before_bind():
+    m = Module(_linear_symbol(), data_names=("data",), label_names=())
+    assert m.output_shapes == []
+
+
+def test_symbol_scalar_shape_inference():
+    s = sym.var("x") + 1.0
+    arg, out, aux = s.infer_shape(x=())
+    assert arg == [()] and out == [()]

@@ -1,0 +1,124 @@
+"""Subgraph framework tests (reference: tests/python/unittest/
+test_subgraph_op.py — partition correctness: same outputs pre/post)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym, subgraph
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+
+def _feed(symbols, shapes):
+    rng = np.random.RandomState(0)
+    return {name: nd.array(rng.rand(*shape).astype(np.float32) * 0.5 + 0.1)
+            for name, shape in shapes.items()}
+
+
+def test_default_partition_preserves_semantics():
+    x = sym.var("x")
+    y = sym.exp(x)
+    z = sym.sqrt(y)
+    w = sym.relu(z)
+    out = w + x
+
+    prop = subgraph.DefaultSubgraphProperty(["exp", "sqrt", "relu"],
+                                            name="chain")
+    cut = subgraph.partition(out, prop)
+    feed = _feed(out, {"x": (3, 4)})
+    ref = out.eval(**feed)[0]
+    got = cut.eval(**feed)[0]
+    assert_almost_equal(got, np.asarray(ref._data), rtol=1e-6)
+    # the three elementwise ops collapsed into one fused node
+    ops = [n["op"] for n in cut.debug_list_nodes()]
+    assert sum(o.startswith("_subgraph_chain") for o in ops) == 1
+    assert "exp" not in ops and "sqrt" not in ops
+
+
+def test_partition_rejects_cycle():
+    # diamond: b=exp(x); c=negative(b) NOT selected; d=add(b, c) selected.
+    # grouping {b, d} would put external c on a path between two members
+    # (fused node would depend on c which depends on the fused node) =>
+    # the convexity check must reject that group
+    x = sym.var("x")
+    b = sym.exp(x)
+    c = sym.negative(b)
+    d = sym.broadcast_add(b, c)
+
+    prop = subgraph.DefaultSubgraphProperty(["exp", "broadcast_add"],
+                                            name="cyc")
+    cut = subgraph.partition(d, prop)
+    feed = _feed(d, {"x": (2, 2)})
+    assert_almost_equal(cut.eval(**feed)[0],
+                        np.asarray(d.eval(**feed)[0]._data), rtol=1e-6)
+    # b+d must NOT have been fused together (c sits between them)
+    ops = [n["op"] for n in cut.debug_list_nodes()]
+    assert not any(o.startswith("_subgraph_cyc") and
+                   ops.count("negative") == 0 for o in ops)
+    assert "negative" in ops
+
+
+def test_conv_bn_fold_inference():
+    data = sym.var("data")
+    weight = sym.var("conv_w")
+    bias = sym.var("conv_b")
+    gamma = sym.var("bn_g")
+    beta = sym.var("bn_b")
+    mean = sym.var("bn_mean")
+    variance = sym.var("bn_var")
+    conv = sym.Convolution(data, weight, bias, kernel=(3, 3), num_filter=4,
+                           pad=(1, 1), name="conv0")
+    bn = sym.BatchNorm(conv, gamma, beta, mean, variance, fix_gamma=False,
+                       eps=1e-3, name="bn0")
+    out = bn[0]
+
+    folded = subgraph.partition(out, "conv_bn_fold")
+    ops = [n["op"] for n in folded.debug_list_nodes()]
+    assert "BatchNorm" not in ops
+    assert ops.count("Convolution") == 1
+
+    rng = np.random.RandomState(1)
+    feed = {
+        "data": nd.array(rng.rand(2, 3, 8, 8).astype(np.float32)),
+        "conv_w": nd.array(rng.rand(4, 3, 3, 3).astype(np.float32) - 0.5),
+        "conv_b": nd.array(rng.rand(4).astype(np.float32)),
+        "bn_g": nd.array(rng.rand(4).astype(np.float32) + 0.5),
+        "bn_b": nd.array(rng.rand(4).astype(np.float32)),
+        "bn_mean": nd.array(rng.rand(4).astype(np.float32)),
+        "bn_var": nd.array(rng.rand(4).astype(np.float32) + 0.5),
+    }
+    ref = out.eval(**feed)[0]
+    got = folded.eval(**feed)[0]
+    assert_almost_equal(got, np.asarray(ref._data), rtol=1e-4, atol=1e-5)
+
+
+def test_conv_bn_fold_no_bias():
+    data = sym.var("data")
+    weight = sym.var("w")
+    gamma = sym.var("g")
+    beta = sym.var("b")
+    mean = sym.var("m")
+    variance = sym.var("v")
+    conv = sym.Convolution(data, weight, kernel=(1, 1), num_filter=2,
+                           no_bias=True, name="conv0")
+    out = sym.BatchNorm(conv, gamma, beta, mean, variance, fix_gamma=True,
+                        name="bn0")[0]
+    folded = subgraph.partition(out, "conv_bn_fold")
+    assert "BatchNorm" not in [n["op"] for n in folded.debug_list_nodes()]
+
+    rng = np.random.RandomState(2)
+    feed = {
+        "data": nd.array(rng.rand(1, 3, 4, 4).astype(np.float32)),
+        "w": nd.array(rng.rand(2, 3, 1, 1).astype(np.float32)),
+        "g": nd.array(rng.rand(2).astype(np.float32) + 0.5),
+        "b": nd.array(rng.rand(2).astype(np.float32)),
+        "m": nd.array(rng.rand(2).astype(np.float32)),
+        "v": nd.array(rng.rand(2).astype(np.float32) + 0.5),
+    }
+    assert_almost_equal(folded.eval(**feed)[0],
+                        np.asarray(out.eval(**feed)[0]._data),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_property_registry():
+    assert "conv_bn_fold" in subgraph.list_subgraph_properties()

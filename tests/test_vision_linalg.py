@@ -252,3 +252,18 @@ def test_arange_like_repeat_with_axis():
     data = _nd.zeros((6, 3))
     out = np.asarray(_nd.contrib.arange_like(data, axis=0, repeat=2)._data)
     assert_almost_equal(out, np.array([0, 0, 1, 1, 2, 2], np.float32))
+
+
+def test_proposal_suppressed_rows_invalidated():
+    # two identical anchor predictions: NMS must keep one, and the
+    # suppressed duplicate must come back as -1 rows, not a live ROI
+    b, h, w = 1, 1, 1
+    cls_prob = np.array([[[[0.1]], [[0.2]], [[0.9]], [[0.8]]]], np.float32)
+    bbox = np.zeros((b, 8, h, w), np.float32)
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    out = np.asarray(T.proposal(jnp.asarray(cls_prob), jnp.asarray(bbox),
+                                jnp.asarray(im_info), rpn_pre_nms_top_n=2,
+                                rpn_post_nms_top_n=4, scales=(4,),
+                                ratios=(1, 1), feature_stride=16))
+    valid = out[0][out[0, :, 1] >= 0]
+    assert len(valid) == 1, out
