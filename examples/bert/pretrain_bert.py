@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""BERT-base pretraining step over a tp x dp mesh (the reference has no
+in-repo BERT — GluonNLP was external — so this sets the framework's own
+baseline per SURVEY §6; flash attention + GSPMD sharding are the TPU-native
+long-sequence answer).
+
+On one chip use --dp 1 --tp 1; on a pod slice the same script shards
+embeddings/FFN over tp and the batch over dp."""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    vocab = 30522
+    net = mx.models.bert_base(num_layers=args.layers, vocab_size=vocab)
+    net.initialize(mx.init.Normal(0.02))
+
+    def mlm_loss(out, labels):
+        # out: (B, T, vocab) prediction scores; labels: (B, T) with -1 = pad
+        logp = jax.nn.log_softmax(out, axis=-1)
+        lab = labels.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.maximum(lab, 0)[..., None],
+                                     axis=-1)[..., 0]
+        mask = (lab >= 0).astype(logp.dtype)
+        return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    mesh = make_mesh({"dp": args.dp, "tp": args.tp})
+    trainer = ShardedTrainer(net, mlm_loss, mesh, optimizer="adam",
+                             optimizer_params={"learning_rate": 1e-4},
+                             data_specs=P("dp"), label_spec=P("dp"))
+
+    rng = np.random.RandomState(0)
+    tokens = mx.nd.array(rng.randint(0, vocab,
+                                     (args.batch_size, args.seq_len))
+                         .astype(np.float32))
+    labels = rng.randint(0, vocab, (args.batch_size, args.seq_len))
+    labels[rng.rand(*labels.shape) > 0.15] = -1  # MLM: 15% positions
+    labels = mx.nd.array(labels.astype(np.float32))
+    net(tokens[0:1])  # materialize shapes
+
+    loss = trainer.step(tokens, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = trainer.step(tokens, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tps = args.batch_size * args.seq_len * args.steps / dt
+    print("dp=%d tp=%d  %.0f tokens/sec  loss=%.4f" %
+          (args.dp, args.tp, tps, float(loss)))
+
+
+if __name__ == "__main__":
+    main()

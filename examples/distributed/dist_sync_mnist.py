@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Parameter-server data-parallel training (reference:
+example/image-classification + docs/faq/distributed_training.md).
+
+Launch (hermetic multi-process on one host, like the reference's nightly
+dist tests):
+
+  python tools/launch.py -n 2 -s 1 --launcher local \
+      python examples/distributed/dist_sync_mnist.py
+"""
+
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    print("worker rank %d / %d" % (kv.rank, kv.num_workers))
+
+    net = mx.models.lenet5()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(kv.rank)  # each worker its own shard
+    X = rng.rand(512, 1, 28, 28).astype(np.float32)
+    Y = rng.randint(0, 10, (512,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
+
+    for epoch in range(2):
+        it.reset()
+        total, n = 0.0, 0
+        for batch in it:
+            with autograd.record():
+                out = net(batch.data[0])
+                loss = loss_fn(out, batch.label[0])
+            loss.backward()
+            trainer.step(32)
+            total += float(loss.mean()._data)
+            n += 1
+        print("rank %d epoch %d loss %.4f" % (kv.rank, epoch, total / n))
+    kv.barrier()
+
+
+if __name__ == "__main__":
+    main()

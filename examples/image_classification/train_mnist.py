@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""LeNet-5 training loop (reference: example/image-classification/train_mnist.py).
+
+Synthetic MNIST-shaped data by default; --mnist-dir for real idx/npy data.
+"""
+
+import argparse
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+
+
+def get_data(args):
+    if args.mnist_dir:
+        import os
+        X = np.load(os.path.join(args.mnist_dir, "train_images.npy"))
+        Y = np.load(os.path.join(args.mnist_dir, "train_labels.npy"))
+        X = X.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    else:
+        rng = np.random.RandomState(0)
+        X = rng.rand(2048, 1, 28, 28).astype(np.float32)
+        Y = rng.randint(0, 10, (2048,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--mnist-dir", default=None)
+    args = ap.parse_args()
+
+    net = mx.models.lenet5()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()  # one XLA program per (fwd, bwd) step
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    train_iter = get_data(args)
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric.reset()
+        total_loss, n = 0.0, 0
+        for batch in train_iter:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            total_loss += float(loss.mean()._data)
+            n += 1
+        print("epoch %d loss %.4f %s" %
+              (epoch, total_loss / n, metric.get()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""ResNet-50 with the sharded (multi-chip) training path
+(reference: example/image-classification/train_imagenet.py; the dist table
+in its README is the BASELINE this framework benches against).
+
+The mesh spec maps the reference's KVStore device sync onto XLA psum over
+ICI: dp axis = data parallel replicas. On one chip, dp=1 still runs the
+same compiled program.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--dp", type=int, default=0, help="data-parallel size "
+                    "(0 = all visible devices)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    dp = args.dp or len(jax.devices())
+    net = mx.gluon.model_zoo.vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+
+    def loss_fn(out, lab):
+        logp = jax.nn.log_softmax(out, axis=-1)
+        picked = jnp.take_along_axis(logp, lab.astype(jnp.int32)[:, None],
+                                     axis=-1)
+        return -picked.mean()
+
+    mesh = make_mesh({"dp": dp})
+    trainer = ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1,
+                                               "momentum": 0.9},
+                             data_specs=P("dp"), label_spec=P("dp"))
+
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.rand(args.batch_size, 3, 224, 224)
+                       .astype(np.float32))
+    label = mx.nd.array(rng.randint(0, 1000, (args.batch_size,))
+                        .astype(np.float32))
+    net(data[0:1])  # materialize deferred shapes
+
+    import time
+    loss = trainer.step(data, label)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = trainer.step(data, label)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    print("dp=%d  %.1f imgs/sec  last_loss=%.4f" %
+          (dp, args.batch_size * args.steps / dt, float(loss)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""LSTM word language model (reference: example/rnn/word_lm/train.py;
+its PTB test-perplexity table is the quality bar). Synthetic corpus by
+default; --data for a tokenized .npy corpus."""
+
+import argparse
+import math
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+
+
+def batchify(tokens, batch_size, bptt):
+    n = len(tokens) // batch_size * batch_size
+    data = tokens[:n].reshape(batch_size, -1).T  # (T_total, B)
+    for i in range(0, data.shape[0] - 1 - bptt, bptt):
+        yield data[i:i + bptt], data[i + 1:i + 1 + bptt]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--bptt", type=int, default=35)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--data", default=None)
+    args = ap.parse_args()
+
+    tokens = (np.load(args.data) if args.data else
+              np.random.RandomState(0).randint(
+                  0, args.vocab, (80000,))).astype(np.float32)
+
+    model = mx.models.lstm_lm_ptb(vocab_size=args.vocab, num_embed=200,
+                                  num_hidden=200, num_layers=2, dropout=0.2)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        states = model.begin_state(args.batch_size)
+        total, n = 0.0, 0
+        for data, target in batchify(tokens, args.batch_size, args.bptt):
+            x = nd.array(data)
+            y = nd.array(target)
+            with autograd.record():
+                out, states = model(x, states)
+                # detach carried state so BPTT stops at the segment boundary
+                states = [s.detach() for s in states]
+                loss = loss_fn(out.reshape((-1, args.vocab)), y.reshape((-1,)))
+            loss.backward()
+            trainer.step(args.batch_size * args.bptt)
+            total += float(loss.mean()._data)
+            n += 1
+            if n % 20 == 0:
+                print("epoch %d batch %d ppl %.1f" %
+                      (epoch, n, math.exp(total / n)))
+        print("epoch %d train ppl %.2f" % (epoch, math.exp(total / n)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""SSD-style detection training step (reference: example/ssd/train.py).
+
+Shows the full target-assignment -> loss -> detection-decode pipeline on a
+toy backbone with MultiBoxPrior/MultiBoxTarget/MultiBoxDetection, all
+jit-compatible (static shapes, -1-padded NMS)."""
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+from incubator_mxnet_tpu import ops
+
+
+class ToySSD(gluon.HybridBlock):
+    def __init__(self, num_classes=2, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.backbone = gluon.nn.HybridSequential()
+            for f in (16, 32, 64):
+                self.backbone.add(gluon.nn.Conv2D(f, 3, strides=2, padding=1,
+                                                  activation="relu"))
+            self.cls_head = gluon.nn.Conv2D((num_classes + 1) * 4, 3,
+                                            padding=1)
+            self.loc_head = gluon.nn.Conv2D(4 * 4, 3, padding=1)
+        self.num_classes = num_classes
+
+    def hybrid_forward(self, F, x):
+        feat = self.backbone(x)
+        b = feat.shape[0] if hasattr(feat, "shape") else feat.shape[0]
+        cls = self.cls_head(feat)      # (B, (C+1)*A, H, W)
+        loc = self.loc_head(feat)      # (B, 4A, H, W)
+        anchors = ops.MultiBoxPrior(feat, sizes=(0.2, 0.4), ratios=(1, 2))
+        return cls, loc, anchors
+
+
+def main():
+    np.random.seed(0)
+    num_classes = 2
+    net = ToySSD(num_classes)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    l1 = gluon.loss.L1Loss()
+
+    for step in range(10):
+        x = nd.array(np.random.rand(4, 3, 64, 64).astype(np.float32))
+        label = np.full((4, 3, 5), -1.0, np.float32)
+        label[:, 0] = [1, 0.2, 0.2, 0.6, 0.6]  # one gt box per image
+        label = nd.array(label)
+        with autograd.record():
+            cls, loc, anchors = net(x)
+            b = cls.shape[0]
+            n_anchor = anchors.shape[1]
+            cls = cls.reshape((b, num_classes + 1, -1))
+            loc = loc.reshape((b, -1))
+            box_t, box_m, cls_t = nd.contrib_multibox_target(
+                anchors, label, cls) if hasattr(nd, "contrib_multibox_target") \
+                else nd.MultiBoxTarget(anchors, label, cls)
+            loss = ce(cls.transpose((0, 2, 1)), cls_t) + \
+                l1(loc * box_m, box_t)
+        loss.backward()
+        trainer.step(4)
+        print("step %d loss %.4f" % (step, float(loss.mean()._data)))
+
+    # inference decode
+    cls, loc, anchors = net(x)
+    b = cls.shape[0]
+    probs = nd.softmax(cls.reshape((b, num_classes + 1, -1)), axis=1)
+    det = nd.MultiBoxDetection(probs, loc.reshape((b, -1)), anchors)
+    print("detections:", det.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Legacy data-parallel executor group.
+
+Reference parity: python/mxnet/module/executor_group.py
+(``DataParallelExecutorGroup``: bind one executor per device, split the
+batch by ``_split_input_slice``, merge outputs) and
+python/mxnet/executor_manager.py per SURVEY §2.6.
+
+TPU-first: per-device Python executors are an anti-pattern on TPU — XLA's
+GSPMD partitioner does the splitting inside ONE compiled program (see
+``parallel.ShardedTrainer`` for the modern path). This class keeps the
+reference's API for ported code: it binds one executor per context and
+slices the batch on the host, which is also how multi-process CPU testing
+works (reference tests model parallelism on cpu contexts the same way).
+"""
+
+import numpy as _np
+
+from .ndarray import NDArray, array as nd_array, concatenate as nd_concat
+
+__all__ = ["_split_input_slice", "DataParallelExecutorGroup"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Split [0, batch_size) into per-device slices proportional to the
+    work load list (reference: executor_manager.py:_split_input_slice)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        end = batch_size if i == len(work_load_list) - 1 else \
+            start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup:
+    """One executor per context; batch split across them on the host."""
+
+    def __init__(self, symbol, contexts, data_shapes, label_shapes=None,
+                 param_names=None, for_training=True, grad_req="write",
+                 work_load_list=None):
+        self.symbol = symbol
+        self.contexts = list(contexts)
+        self.for_training = for_training
+        work_load_list = work_load_list or [1] * len(self.contexts)
+        shapes = [(d.name, d.shape) if hasattr(d, "name") else d
+                  for d in data_shapes]
+        if label_shapes:
+            shapes += [(d.name, d.shape) if hasattr(d, "name") else d
+                       for d in label_shapes]
+        self.batch_size = shapes[0][1][0]
+        self.slices = _split_input_slice(self.batch_size, work_load_list)
+        self.data_names = [n for n, _ in shapes]
+        self.execs = []
+        for sl in self.slices:
+            n = sl.stop - sl.start
+            feed = {name: (n,) + tuple(shape[1:]) for name, shape in shapes}
+            self.execs.append(symbol.simple_bind(
+                grad_req=grad_req if for_training else "null", **feed))
+
+    def set_params(self, arg_params, aux_params=None):
+        for ex in self.execs:
+            for name, arr in (arg_params or {}).items():
+                if name in ex.arg_dict and name not in self.data_names:
+                    ex.arg_dict[name]._data = arr._data
+            for name, arr in (aux_params or {}).items():
+                if name in ex.aux_dict:
+                    ex.aux_dict[name]._data = arr._data
+
+    def forward(self, data_batch, is_train=None):
+        feeds = {}
+        for name, arr in zip(self.data_names, list(data_batch.data) +
+                             list(data_batch.label or [])):
+            feeds[name] = arr
+        for ex, sl in zip(self.execs, self.slices):
+            part = {n: a[sl] for n, a in feeds.items()}
+            ex.forward(is_train=bool(is_train if is_train is not None
+                                     else self.for_training), **part)
+
+    def backward(self, out_grads=None):
+        for ex in self.execs:
+            ex.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        per_exec = [ex.outputs for ex in self.execs]
+        if not merge_multi_context:
+            return per_exec
+        merged = []
+        for i in range(len(per_exec[0])):
+            merged.append(nd_concat([p[i] for p in per_exec], axis=0)
+                          if len(per_exec) > 1 else per_exec[0][i])
+        return merged
+
+    def get_grads(self):
+        """Per-parameter gradients summed across executors (the DP
+        all-reduce the reference does through KVStore)."""
+        grads = {}
+        for ex in self.execs:
+            for name, g in ex.grad_dict.items():
+                if g is None or name in self.data_names:
+                    continue
+                grads[name] = g if name not in grads else grads[name] + g
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        outs = self.get_outputs()
+        eval_metric.update(labels, outs)

@@ -109,3 +109,37 @@ class ImageIter:
     @property
     def provide_label(self):
         return self._inner.provide_label
+
+
+class ImageDetIter(ImageIter):
+    """Detection image iterator (reference: python/mxnet/image/detection.py
+    ImageDetIter): labels are (batch, max_objects, 5+) rows
+    [cls, x0, y0, x1, y1, ...] padded with -1, bbox-aware augmentation is
+    delegated to the underlying record iterator."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 label_width=-1, **kwargs):
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         **kwargs)
+        self._label_width = label_width
+
+    def _reshape_label(self, label):
+        arr = label if not hasattr(label, "_data") else label
+        import numpy as np
+        raw = np.asarray(arr._data if hasattr(arr, "_data") else arr)
+        if raw.ndim == 2 and raw.shape[1] > 2:
+            # flat detection label: [header_len, obj_width, obj0..., pad(-1)]
+            header = int(raw[0, 0]) if raw.shape[1] > 0 else 2
+            obj_w = int(raw[0, 1]) if raw.shape[1] > 1 else 5
+            body = raw[:, 2 + header - 2:] if header >= 2 else raw
+            n_obj = body.shape[1] // obj_w
+            out = body[:, :n_obj * obj_w].reshape(raw.shape[0], n_obj, obj_w)
+            return nd_array(out)
+        return nd_array(raw)
+
+    def __next__(self):
+        batch = super().__next__()
+        batch.label = [self._reshape_label(l) for l in batch.label]
+        return batch
+
+    next = __next__

@@ -31,3 +31,94 @@ def load_checkpoint(prefix, epoch):
         elif tp == "aux":
             aux_params[name] = v
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """v0.x training API kept for compatibility (reference:
+    python/mxnet/model.py FeedForward — SURVEY §2.6). Thin veneer over
+    Module: ``create``/``fit``/``predict``/``score``/``save``."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, arg_params=None,
+                 aux_params=None, begin_epoch=0, **kwargs):
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _build_module(self, train_data):
+        from .module import Module
+        data_names = [d[0] if isinstance(d, (list, tuple)) else d.name
+                      for d in train_data.provide_data]
+        label_names = [d[0] if isinstance(d, (list, tuple)) else d.name
+                      for d in train_data.provide_label]
+        self._module = Module(self.symbol, data_names=data_names,
+                              label_names=label_names)
+        return self._module
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from . import metric as _metric
+        mod = self._build_module(X)
+        mod.bind(data_shapes=X.provide_data, label_shapes=X.provide_label)
+        mod.init_params(initializer=self.initializer,
+                        arg_params=self.arg_params,
+                        aux_params=self.aux_params, allow_missing=True)
+        opt_params = {k: v for k, v in self.kwargs.items()
+                      if k in ("learning_rate", "momentum", "wd")}
+        mod.init_optimizer(kvstore=kvstore, optimizer=self.optimizer,
+                           optimizer_params=tuple(opt_params.items()) or
+                           (("learning_rate", 0.01),))
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        for epoch in range(self.begin_epoch, self.num_epoch or 1):
+            X.reset()
+            eval_metric.reset()
+            for batch in X:
+                mod.forward_backward(batch)
+                mod.update()
+                mod.update_metric(eval_metric, batch.label)
+            if epoch_end_callback:
+                arg_p, aux_p = mod.get_params()
+                for cb in (epoch_end_callback
+                           if isinstance(epoch_end_callback, list)
+                           else [epoch_end_callback]):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None):
+        mod = self._module
+        assert mod is not None, "call fit() first (or use Module directly)"
+        return mod.predict(X, num_batch=num_batch)
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        return self._module.score(X, eval_metric, num_batch=num_batch)
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None else
+                        (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, optimizer="sgd",
+               initializer=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            optimizer=optimizer, initializer=initializer,
+                            **kwargs)
+        model.fit(X, y)
+        return model

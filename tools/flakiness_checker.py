@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Re-run a test many times with random seeds to expose flakiness.
+
+Reference parity: tools/flakiness_checker.py (same CLI shape:
+``python tools/flakiness_checker.py tests/test_operator.py::test_foo -n 30``).
+"""
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id, e.g. tests/test_x.py::test_y")
+    ap.add_argument("-n", "--trials", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="base seed (default: random)")
+    args = ap.parse_args()
+
+    base = args.seed if args.seed is not None else random.randint(0, 10**6)
+    failures = []
+    for i in range(args.trials):
+        seed = base + i
+        env = dict(os.environ, MXNET_TEST_SEED=str(seed))
+        r = subprocess.run([sys.executable, "-m", "pytest", args.test, "-x",
+                            "-q"], env=env, capture_output=True, text=True)
+        status = "PASS" if r.returncode == 0 else "FAIL"
+        print("trial %d seed=%d %s" % (i, seed, status))
+        if r.returncode != 0:
+            failures.append((seed, r.stdout[-2000:]))
+    print("%d/%d failed" % (len(failures), args.trials))
+    for seed, out in failures[:3]:
+        print("--- seed %d ---\n%s" % (seed, out))
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
