@@ -130,9 +130,9 @@ def record_op(fn, arrays, op_name=""):
     # marked leaves, but autograd.grad() may target any recorded array.
     if not is_recording() or not arrays:
         out = fn(*vals)
-        return (out if isinstance(out, tuple) else (out,)), None
+        return (tuple(out) if isinstance(out, (tuple, list)) else (out,)), None
     out, vjp_fn = jax.vjp(fn, *vals)
-    outs = out if isinstance(out, tuple) else (out,)
+    outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
     templates = [(o.shape, o.dtype) for o in outs]
     node = TapeNode(list(arrays), vjp_fn, len(outs), templates, op_name)
     return outs, node

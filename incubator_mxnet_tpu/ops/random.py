@@ -39,13 +39,13 @@ def _shape(shape):
     return tuple(shape) if hasattr(shape, "__len__") else (shape,)
 
 
-@register("random_uniform", aliases=("_random_uniform", "uniform", "_sample_uniform"))
+@register("random_uniform", aliases=("_random_uniform", "uniform"))
 def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", key=None):
     key = key if key is not None else next_key()
     return jax.random.uniform(key, _shape(shape), jnp.dtype(dtype), low, high)
 
 
-@register("random_normal", aliases=("_random_normal", "normal", "_sample_normal"))
+@register("random_normal", aliases=("_random_normal", "normal"))
 def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", key=None):
     key = key if key is not None else next_key()
     return loc + scale * jax.random.normal(key, _shape(shape), jnp.dtype(dtype))
@@ -121,3 +121,80 @@ def sample_multinomial(data, shape=None, get_prob=False, dtype="int32", key=None
 register("bernoulli")(lambda p=0.5, shape=None, dtype="float32", key=None:
                       jax.random.bernoulli(key if key is not None else next_key(),
                                            p, _shape(shape)).astype(jnp.dtype(dtype)))
+
+
+# ---------------------------------------------------------------------------
+# multisample ops (reference: multisample_op.cc — _sample_uniform etc. take
+# ARRAY parameters of shape (n,) and draw `shape` samples per row, giving
+# output params.shape + shape). Distinct from the scalar _random_* family.
+# ---------------------------------------------------------------------------
+
+def _multisample(draw, params, shape, dtype, key):
+    """Vectorize `draw(key, *row_params) -> sample block` over param rows."""
+    key = key if key is not None else next_key()
+    params = [jnp.asarray(p) for p in params]
+    pshape = params[0].shape
+    n = 1
+    for s in pshape:
+        n *= s
+    flat = [p.reshape(n) for p in params]
+    keys = jax.random.split(key, n)
+    out = jax.vmap(draw)(keys, *flat)
+    return out.reshape(pshape + _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("sample_uniform_multi", aliases=("_sample_uniform",))
+def sample_uniform_multi(low, high, shape=None, dtype="float32", key=None):
+    return _multisample(
+        lambda k, lo, hi: jax.random.uniform(k, _shape(shape)) * (hi - lo) + lo,
+        [low, high], shape, dtype, key)
+
+
+@register("sample_normal_multi", aliases=("_sample_normal",))
+def sample_normal_multi(mu, sigma, shape=None, dtype="float32", key=None):
+    return _multisample(
+        lambda k, m, s: m + s * jax.random.normal(k, _shape(shape)),
+        [mu, sigma], shape, dtype, key)
+
+
+@register("sample_gamma_multi", aliases=("_sample_gamma",))
+def sample_gamma_multi(alpha, beta, shape=None, dtype="float32", key=None):
+    return _multisample(
+        lambda k, a, b: jax.random.gamma(k, a, _shape(shape)) * b,
+        [alpha, beta], shape, dtype, key)
+
+
+@register("sample_exponential_multi", aliases=("_sample_exponential",))
+def sample_exponential_multi(lam, shape=None, dtype="float32", key=None):
+    return _multisample(
+        lambda k, l: jax.random.exponential(k, _shape(shape)) / l,
+        [lam], shape, dtype, key)
+
+
+@register("sample_poisson_multi", aliases=("_sample_poisson",))
+def sample_poisson_multi(lam, shape=None, dtype="float32", key=None):
+    return _multisample(
+        lambda k, l: jax.random.poisson(k, l, _shape(shape)).astype(jnp.float32),
+        [lam], shape, dtype, key)
+
+
+@register("sample_negative_binomial_multi", aliases=("_sample_negative_binomial",))
+def sample_negative_binomial_multi(k, p, shape=None, dtype="float32", key=None):
+    def draw(rk, kk, pp):
+        k1, k2 = jax.random.split(rk)
+        lam = jax.random.gamma(k1, kk, _shape(shape)) * ((1 - pp) / pp)
+        return jax.random.poisson(k2, lam).astype(jnp.float32)
+    return _multisample(draw, [k, p], shape, dtype, key)
+
+
+@register("sample_generalized_negative_binomial_multi",
+          aliases=("_sample_generalized_negative_binomial",))
+def sample_generalized_negative_binomial_multi(mu, alpha, shape=None,
+                                               dtype="float32", key=None):
+    def draw(rk, m, a):
+        k1, k2 = jax.random.split(rk)
+        r = 1.0 / a
+        pp = r / (r + m)
+        lam = jax.random.gamma(k1, r, _shape(shape)) * ((1 - pp) / pp)
+        return jax.random.poisson(k2, lam).astype(jnp.float32)
+    return _multisample(draw, [mu, alpha], shape, dtype, key)

@@ -667,3 +667,188 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis
     src = jnp.where(steps < lens, lens - 1 - steps, steps)  # (T,B)
     out = jnp.take_along_axis(moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
     return jnp.moveaxis(out, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# parity-gap ops (reference: elemwise_binary_scalar_op_logic.cc, matrix_op.cc
+# reshape_like/broadcast_like, histogram.cc, ravel.cc, smooth_l1 in
+# mshadow_op.h, indexing_op.cc scatter variants, matrix_op.cc _split_v2)
+# ---------------------------------------------------------------------------
+
+# MXNet's round is half-away-from-zero (mshadow_op roundf), not banker's
+register("round")(lambda x: jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5))
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """reference: mshadow_op.h smooth_l1_loss — sigma-parameterised Huber."""
+    sigma2 = scalar * scalar
+    absx = jnp.abs(data)
+    return jnp.where(absx < 1.0 / sigma2,
+                     0.5 * sigma2 * jnp.square(data),
+                     absx - 0.5 / sigma2)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """reference: matrix_op.cc reshape_like with partial-range support."""
+    if lhs_begin is None and rhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = 0 if lhs_begin is None else int(lhs_begin)
+    le = lhs.ndim if lhs_end is None else int(lhs_end)
+    rb = 0 if rhs_begin is None else int(rhs_begin)
+    re_ = rhs.ndim if rhs_end is None else int(rhs_end)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return jnp.reshape(lhs, new_shape)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    if rhs_axes is None or len(rhs_axes) != len(lhs_axes):
+        raise ValueError("broadcast_like: lhs_axes and rhs_axes must be "
+                         "given together with equal length")
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[int(la)] = rhs.shape[int(ra)]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("_histogram", aliases=("histogram",), num_outputs=2)
+def histogram(data, bins=10, range=None, bin_cnt=None):
+    """reference: histogram.cc — counts plus bin edges."""
+    if hasattr(bins, "ndim") and getattr(bins, "ndim", 0) >= 1:
+        edges = jnp.asarray(bins)
+        cnt, _ = jnp.histogram(data, bins=edges)
+        return cnt, edges
+    nbin = int(bin_cnt if bin_cnt is not None else bins)
+    return jnp.histogram(data, bins=nbin, range=range)
+
+
+@register("_ravel_multi_index", aliases=("ravel_multi_index",))
+def ravel_multi_index(data, shape):
+    """reference: ravel.cc — data is (ndim, n) of coordinates."""
+    shape = tuple(int(s) for s in shape)
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.asarray(list(reversed(strides)), dtype=data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@register("_unravel_index", aliases=("unravel_index",))
+def unravel_index(data, shape):
+    shape = tuple(int(s) for s in shape)
+    out = jnp.stack(jnp.unravel_index(data.astype(jnp.int64), shape))
+    return out.astype(data.dtype)
+
+
+@register("_grad_add")
+def grad_add(lhs, rhs):
+    return lhs + rhs
+
+
+@register("_identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+register("_zeros_without_dtype")(
+    lambda shape=None, ctx=None, dtype=None:
+    zeros(shape if shape is not None else (), dtype=dtype or "float32"))
+
+
+@register("_square_sum")
+def square_sum(data, axis=None, keepdims=False):
+    """reference: square_sum.cc — fused square+sum for row_sparse grads."""
+    return jnp.sum(jnp.square(data), axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    return data
+
+
+@register("_rnn_param_concat")
+def rnn_param_concat(*args, dim=0):
+    return jnp.concatenate([a.reshape(-1) if dim == 0 and a.ndim != 1 else a
+                            for a in args], axis=0 if dim == 0 else dim)
+
+
+def _slice_spec(data, begin, end, step=None):
+    import builtins
+    nd = data.ndim
+    step = step if step is not None else (None,) * len(begin)
+    idx = []
+    for i in range(nd):
+        if i < len(begin):
+            b = begin[i]
+            e = end[i] if i < len(end) else None
+            s = step[i] if i < len(step) else None
+            idx.append(builtins.slice(b, e, s))
+        else:
+            idx.append(builtins.slice(None))
+    return tuple(idx)
+
+
+@register("_slice_assign", aliases=("slice_assign",))
+def slice_assign(lhs, rhs, begin, end, step=None):
+    """reference: matrix_op.cc _slice_assign — functional slice write."""
+    return lhs.at[_slice_spec(lhs, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=("slice_assign_scalar",))
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=None):
+    return data.at[_slice_spec(data, begin, end, step)].set(
+        jnp.asarray(scalar, data.dtype))
+
+
+@register("_split_v2", aliases=("split_v2",), num_outputs="sections")
+def split_v2(data, indices_or_sections=1, axis=0, squeeze_axis=False,
+             sections=0):
+    if sections and not hasattr(indices_or_sections, "__len__"):
+        parts = jnp.split(data, int(sections), axis=axis)
+    elif hasattr(indices_or_sections, "__len__"):
+        idx = [int(i) for i in indices_or_sections if int(i) != 0]
+        parts = jnp.split(data, idx, axis=axis) if idx else [data]
+    else:
+        parts = jnp.split(data, int(indices_or_sections), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+# scatter variants (reference: indexing_op.cc _scatter_set_nd etc. — used for
+# advanced-index writes; dense functional equivalents)
+@register("_scatter_set_nd", aliases=("scatter_set_nd",))
+def scatter_set_nd(lhs, rhs, indices, shape=None):
+    idx = tuple(indices[i] for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+register("_scatter_plus_scalar")(lambda data, scalar: data + scalar)
+register("_scatter_minus_scalar")(lambda data, scalar: data - scalar)
+register("_scatter_elemwise_div")(lambda lhs, rhs: lhs / rhs)
+
+
+# scalar comparison/logic family (reference: elemwise_binary_scalar_op_logic.cc)
+def _cmp_scalar(fn):
+    return lambda data, scalar: fn(data, scalar).astype(data.dtype)
+
+
+register("_equal_scalar")(_cmp_scalar(lambda d, s: d == s))
+register("_not_equal_scalar")(_cmp_scalar(lambda d, s: d != s))
+register("_greater_scalar")(_cmp_scalar(lambda d, s: d > s))
+register("_greater_equal_scalar")(_cmp_scalar(lambda d, s: d >= s))
+register("_lesser_scalar")(_cmp_scalar(lambda d, s: d < s))
+register("_lesser_equal_scalar")(_cmp_scalar(lambda d, s: d <= s))
+register("_logical_and_scalar")(_cmp_scalar(lambda d, s: jnp.logical_and(d != 0, s != 0)))
+register("_logical_or_scalar")(_cmp_scalar(lambda d, s: jnp.logical_or(d != 0, s != 0)))
+register("_logical_xor_scalar")(_cmp_scalar(lambda d, s: jnp.logical_xor(d != 0, s != 0)))
+register("_hypot_scalar")(lambda data, scalar: jnp.hypot(data, jnp.asarray(scalar, data.dtype)))
+register("_rmod_scalar")(lambda data, scalar: jnp.asarray(scalar, data.dtype) % data)

@@ -369,3 +369,78 @@ def test_box_nms():
     assert scores[0] == pytest.approx(0.9)
     assert scores[1] == pytest.approx(0.7)
     assert scores[2] == pytest.approx(-1.0)
+
+
+def test_parity_gap_ops():
+    """Ops added for NNVM-registry parity (scalar logic family, reshape_like,
+    histogram, ravel, slice_assign, split_v2, smooth_l1...)."""
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = nd.array(x)
+    # smooth_l1 (sigma=2): |x|<1/4 -> 0.5*(2x)^2 ; else |x|-1/8
+    v = np.array([-1.0, -0.1, 0.0, 0.2, 3.0], dtype=np.float32)
+    out = nd.smooth_l1(nd.array(v), 2.0).asnumpy()
+    ref = np.where(np.abs(v) < 0.25, 0.5 * (2 * v) ** 2, np.abs(v) - 0.125)
+    assert_almost_equal(out, ref)
+    # reshape_like / broadcast_like
+    assert nd.reshape_like(a, nd.zeros((4, 3))).shape == (4, 3)
+    assert nd.broadcast_like(nd.ones((1, 4)), a).shape == (3, 4)
+    # round
+    assert_almost_equal(nd.round(nd.array(np.array([0.4, 0.6]))),
+                        np.array([0.0, 1.0]))
+    # scalar comparisons keep input dtype
+    out = nd._greater_scalar(a, 5.0)
+    assert out.asnumpy().dtype == np.float32
+    assert_almost_equal(out, (x > 5).astype(np.float32))
+    assert_almost_equal(nd._equal_scalar(a, 4.0), (x == 4).astype(np.float32))
+    assert_almost_equal(nd._hypot_scalar(a, 3.0), np.hypot(x, 3.0))
+    assert_almost_equal(nd._rmod_scalar(a + 1, 5.0), 5.0 % (x + 1))
+    # histogram
+    cnt, edges = nd._histogram(a, bins=4, range=(0, 12))
+    assert_almost_equal(cnt, np.histogram(x, bins=4, range=(0, 12))[0])
+    assert edges.shape == (5,)
+    # ravel / unravel roundtrip
+    coords = np.array([[0, 1, 2], [3, 2, 1]], dtype=np.int32)
+    flat = nd._ravel_multi_index(nd.array(coords, dtype="int32"), (3, 4))
+    assert_almost_equal(flat, np.ravel_multi_index(coords, (3, 4)))
+    back = nd._unravel_index(flat, (3, 4))
+    assert_almost_equal(back, coords)
+    # slice_assign
+    out = nd._slice_assign(a, nd.zeros((2, 2)), (0, 0), (2, 2)).asnumpy()
+    ref = x.copy(); ref[:2, :2] = 0
+    assert_almost_equal(out, ref)
+    out = nd._slice_assign_scalar(a, -1.0, (1,), (3,)).asnumpy()
+    ref = x.copy(); ref[1:3] = -1
+    assert_almost_equal(out, ref)
+    # split_v2 by indices and sections
+    parts = nd._split_v2(a, (1, 3), axis=1)
+    assert [p.shape for p in parts] == [(3, 1), (3, 2), (3, 1)]
+    parts = nd._split_v2(a, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    # square_sum
+    assert_almost_equal(nd._square_sum(a, axis=1), (x * x).sum(axis=1))
+    # scatter_set_nd
+    out = nd._scatter_set_nd(a, nd.array(np.array([9.0, 9.0])),
+                             nd.array(np.array([[0, 1], [1, 2]]), dtype="int32"))
+    ref = x.copy(); ref[0, 1] = 9; ref[1, 2] = 9
+    assert_almost_equal(out, ref)
+
+
+def test_multisample_ops():
+    """Reference multisample_op.cc: array params -> params.shape + shape."""
+    from incubator_mxnet_tpu.ops import registry as R
+    mu = nd.array(np.array([0.0, 50.0], dtype=np.float32))
+    sig = nd.array(np.array([1.0, 2.0], dtype=np.float32))
+    out = nd._sample_normal(mu, sig, shape=(4000,))
+    assert out.shape == (2, 4000)
+    m = out.asnumpy().mean(axis=1)
+    assert abs(m[0]) < 0.2 and abs(m[1] - 50) < 0.5
+    out = nd._sample_gamma(nd.array(np.array([2.0, 9.0])),
+                           nd.array(np.array([1.0, 0.5])), shape=(4000,))
+    m = out.asnumpy().mean(axis=1)
+    assert abs(m[0] - 2.0) < 0.3 and abs(m[1] - 4.5) < 0.4
+    out = nd._sample_poisson(nd.array(np.array([1.0, 7.0])), shape=(2000,))
+    m = out.asnumpy().mean(axis=1)
+    assert abs(m[0] - 1.0) < 0.2 and abs(m[1] - 7.0) < 0.5
+    out = nd._sample_uniform(nd.array(np.array([0.0, 10.0])),
+                             nd.array(np.array([1.0, 20.0])), shape=(3,))
+    assert out.shape == (2, 3)
