@@ -1,0 +1,176 @@
+"""Jitted optimizer update kernels (pure jax, no framework deps).
+
+Reference parity: the fused update kernels of src/operator/optimizer_op.cc.
+Shared by the Optimizer classes and the registered optimizer update ops.
+"""
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# jitted update kernels (analogue of optimizer_op.cc fused ops)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _sgd_update(w, g, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    return w - lr * (g + wd * w)
+
+
+@jax.jit
+def _sgd_mom_update(w, g, mom, lr, wd, momentum, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    mom = momentum * mom - lr * (g + wd * w)
+    return w + mom, mom
+
+
+@jax.jit
+def _nag_update(w, g, mom, lr, wd, momentum, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    mom = momentum * mom + g
+    return w - lr * (momentum * mom + g), mom
+
+
+@jax.jit
+def _adam_update(w, g, m, v, lr, wd, b1, b2, eps, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    coef = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+    return w - coef * m / (jnp.sqrt(v) + eps), m, v
+
+
+@jax.jit
+def _adamw_update(w, g, m, v, lr, wd, eta, b1, b2, eps, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** t)
+    vhat = v / (1 - b2 ** t)
+    return w - eta * (lr * mhat / (jnp.sqrt(vhat) + eps) + wd * w), m, v
+
+
+@jax.jit
+def _adagrad_update(w, g, h, lr, wd, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    h = h + g * g
+    return w - lr * g / (jnp.sqrt(h) + eps), h
+
+
+@jax.jit
+def _rmsprop_update(w, g, n, lr, wd, rho, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    n = rho * n + (1 - rho) * g * g
+    return w - lr * g / (jnp.sqrt(n + eps)), n
+
+
+@jax.jit
+def _rmspropalex_update(w, g, n, gavg, delta, lr, wd, rho, momentum, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    n = rho * n + (1 - rho) * g * g
+    gavg = rho * gavg + (1 - rho) * g
+    delta = momentum * delta - lr * g / jnp.sqrt(n - gavg * gavg + eps)
+    return w + delta, n, gavg, delta
+
+
+@jax.jit
+def _adadelta_update(w, g, acc_g, acc_d, wd, rho, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    acc_g = rho * acc_g + (1 - rho) * g * g
+    d = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+    acc_d = rho * acc_d + (1 - rho) * d * d
+    return w - d, acc_g, acc_d
+
+
+@jax.jit
+def _adamax_update(w, g, m, u, lr, wd, b1, b2, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    m = b1 * m + (1 - b1) * g
+    u = jnp.maximum(b2 * u, jnp.abs(g))
+    return w - (lr / (1 - b1 ** t)) * m / (u + 1e-8), m, u
+
+
+@jax.jit
+def _nadam_update(w, g, m, v, lr, wd, b1, b2, eps, t, m_schedule, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    mt = b1 * (1 - 0.5 * 0.96 ** (t * 0.004))
+    mt1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * 0.004))
+    m_schedule_new = m_schedule * mt
+    m_schedule_next = m_schedule_new * mt1
+    gp = g / (1 - m_schedule_new)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mp = m / (1 - m_schedule_next)
+    vp = v / (1 - b2 ** t)
+    mbar = (1 - mt) * gp + mt1 * mp
+    return w - lr * mbar / (jnp.sqrt(vp) + eps), m, v, m_schedule_new
+
+
+@jax.jit
+def _ftrl_update(w, g, z, n, lr, wd, lamda1, beta, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    n_new = n + g * g
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * w
+    n = n_new
+    w = jnp.where(jnp.abs(z) > lamda1,
+                  -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n)) / lr + wd),
+                  0.0)
+    return w, z, n
+
+
+@jax.jit
+def _signsgd_update(w, g, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    return w - lr * (jnp.sign(g) + wd * w)
+
+
+@jax.jit
+def _signum_update(w, g, mom, lr, wd, momentum, wd_lh, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    mom = momentum * mom - (1 - momentum) * (g + wd * w)
+    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom), mom
+
+
+@jax.jit
+def _ftml_update(w, g, d, sig, z, v, lr, wd, b1, b2, eps, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    v = b2 * v + (1 - b2) * g * g
+    d_new = (1 - b1 ** t) / lr * (jnp.sqrt(v / (1 - b2 ** t)) + eps)
+    sig_new = d_new - b1 * d
+    z_new = b1 * z + (1 - b1) * g - sig_new * w
+    return -z_new / d_new, d_new, sig_new, z_new, v
+
+
+@jax.jit
+def _sgld_update(w, g, lr, wd, noise, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    return w - lr / 2 * g + jnp.sqrt(lr) * noise
+
+

@@ -731,19 +731,14 @@ def histogram(data, bins=10, range=None, bin_cnt=None):
 def ravel_multi_index(data, shape):
     """reference: ravel.cc — data is (ndim, n) of coordinates."""
     shape = tuple(int(s) for s in shape)
-    strides = []
-    acc = 1
-    for s in reversed(shape):
-        strides.append(acc)
-        acc *= s
-    strides = jnp.asarray(list(reversed(strides)), dtype=data.dtype)
-    return jnp.sum(data * strides[:, None], axis=0)
+    coords = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
+    return jnp.ravel_multi_index(coords, shape, mode="clip").astype(data.dtype)
 
 
 @register("_unravel_index", aliases=("unravel_index",))
 def unravel_index(data, shape):
     shape = tuple(int(s) for s in shape)
-    out = jnp.stack(jnp.unravel_index(data.astype(jnp.int64), shape))
+    out = jnp.stack(jnp.unravel_index(data.astype(jnp.int32), shape))
     return out.astype(data.dtype)
 
 
@@ -808,7 +803,17 @@ def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=None):
         jnp.asarray(scalar, data.dtype))
 
 
-@register("_split_v2", aliases=("split_v2",), num_outputs="sections")
+def _split_v2_nout(attrs):
+    ios = attrs.get("indices_or_sections", 1)
+    sec = attrs.get("sections", 0)
+    if sec and not hasattr(ios, "__len__"):
+        return int(sec)
+    if hasattr(ios, "__len__"):
+        return len([i for i in ios if int(i) != 0]) + 1
+    return int(ios)
+
+
+@register("_split_v2", aliases=("split_v2",), num_outputs=_split_v2_nout)
 def split_v2(data, indices_or_sections=1, axis=0, squeeze_axis=False,
              sections=0):
     if sections and not hasattr(indices_or_sections, "__len__"):

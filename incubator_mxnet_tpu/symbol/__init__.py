@@ -390,8 +390,12 @@ def ones(shape, dtype="float32", **kwargs):
 
 def _make_apply(opname, input_syms, attrs, name=None):
     info = get_op(opname)
-    nout = info.num_outputs if isinstance(info.num_outputs, int) else \
-        int(attrs.get(info.num_outputs, 1))
+    if callable(info.num_outputs):
+        nout = int(info.num_outputs(attrs))
+    elif isinstance(info.num_outputs, int):
+        nout = info.num_outputs
+    else:
+        nout = int(attrs.get(info.num_outputs, 1))
     return Symbol(info.name, name or _auto_name(opname.lower().strip("_")),
                   list(input_syms), attrs, num_outputs=nout)
 

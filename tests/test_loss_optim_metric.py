@@ -293,3 +293,89 @@ def test_mixed_initializer():
     mixed("fc_weight", w)
     np.testing.assert_allclose(b.asnumpy(), 1.0)
     np.testing.assert_allclose(w.asnumpy(), 2.0)
+
+
+def test_optimizer_update_ops_registered():
+    """Reference optimizer_op.cc registers update rules as named ops."""
+    from incubator_mxnet_tpu import nd
+    w = nd.array(np.array([1.0, -2.0, 3.0], dtype=np.float32))
+    g = nd.array(np.array([0.1, 0.2, -0.3], dtype=np.float32))
+    # sgd_update: w - lr*(g + wd*w)
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.01)
+    ref = w.asnumpy() - 0.1 * (g.asnumpy() + 0.01 * w.asnumpy())
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+    # sgd_mom_update
+    mom = nd.zeros((3,))
+    w2, m2 = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(m2.asnumpy(), -0.1 * g.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(w2.asnumpy(), w.asnumpy() - 0.1 * g.asnumpy(),
+                               rtol=1e-6)
+    # mp_sgd_update keeps fp32 master
+    w16 = nd.array(np.array([1.0, 2.0], dtype=np.float16))
+    g16 = nd.array(np.array([0.5, -0.5], dtype=np.float16))
+    w32 = nd.array(np.array([1.0, 2.0], dtype=np.float32))
+    new16, new32 = nd.mp_sgd_update(w16, g16, w32, lr=0.1)
+    assert new16.asnumpy().dtype == np.float16
+    np.testing.assert_allclose(new32.asnumpy(), [0.95, 2.05], rtol=1e-3)
+    # adam_update: reference op has NO bias correction (optimizer_op.cc) —
+    # callers pre-fold the correction into lr
+    m = nd.zeros((3,)); v = nd.zeros((3,))
+    w3, m3, v3 = nd.adam_update(w, g, m, v, lr=0.01)
+    gref = g.asnumpy()
+    mref = 0.1 * gref
+    vref = 0.001 * gref * gref
+    np.testing.assert_allclose(
+        w3.asnumpy(), w.asnumpy() - 0.01 * mref / (np.sqrt(vref) + 1e-8),
+        rtol=1e-5)
+    # signsgd
+    out = nd.signsgd_update(w, g, lr=0.1)
+    np.testing.assert_allclose(out.asnumpy(),
+                               w.asnumpy() - 0.1 * np.sign(g.asnumpy()),
+                               rtol=1e-6)
+    # ftrl: first step from zero state, z = g - sqrt(g^2)/lr * w ...
+    z = nd.zeros((3,)); n = nd.zeros((3,))
+    w4, z4, n4 = nd.ftrl_update(w, g, z, n, lr=0.1, lamda1=0.01)
+    assert w4.shape == (3,)
+    np.testing.assert_allclose(n4.asnumpy(), g.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_sparse_and_multi_tensor_update_ops():
+    from incubator_mxnet_tpu import nd
+    # sparse adagrad: only rows in `indices` change
+    w = nd.array(np.ones((4, 3), dtype=np.float32))
+    h = nd.zeros((4, 3))
+    g_rows = nd.array(np.full((2, 3), 0.5, dtype=np.float32))
+    idx = nd.array(np.array([1, 3]), dtype="int32")
+    w2, h2 = nd._sparse_adagrad_update(w, g_rows, h, lr=0.1, indices=idx)
+    wn = w2.asnumpy()
+    np.testing.assert_allclose(wn[0], 1.0)
+    np.testing.assert_allclose(wn[2], 1.0)
+    assert (wn[1] < 1.0).all() and (wn[3] < 1.0).all()
+    assert (h2.asnumpy()[1] > 0).all() and (h2.asnumpy()[0] == 0).all()
+    # group adagrad: one history scalar per row
+    hg = nd.zeros((4, 1))
+    w3, hg3 = nd._contrib_group_adagrad_update(w, g_rows, hg, lr=0.1,
+                                               indices=idx)
+    assert hg3.shape == (4, 1)
+    assert hg3.asnumpy()[1, 0] > 0 and hg3.asnumpy()[0, 0] == 0
+    # multi-tensor fused sgd
+    ws = [nd.array(np.ones((2,), dtype=np.float32) * (i + 1)) for i in range(3)]
+    gs = [nd.array(np.ones((2,), dtype=np.float32) * 0.1) for _ in range(3)]
+    flat = []
+    for wi, gi in zip(ws, gs):
+        flat.extend([wi, gi])
+    outs = nd.multi_sgd_update(*flat, lrs=(0.1, 0.2, 0.3), wds=(0, 0, 0))
+    assert len(outs) == 3
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(
+            o.asnumpy(), (i + 1) - (0.1, 0.2, 0.3)[i] * 0.1, rtol=1e-6)
+    # multi mp sgd mom: w, g, mom, w32 quadruples
+    w16 = nd.array(np.ones((2,), dtype=np.float16))
+    g16 = nd.array(np.ones((2,), dtype=np.float16) * 0.5)
+    mom = nd.zeros((2,))
+    w32 = nd.array(np.ones((2,), dtype=np.float32))
+    outs = nd.multi_mp_sgd_mom_update(w16, g16, mom, w32, lrs=(0.1,),
+                                      wds=(0.0,), momentum=0.9)
+    assert len(outs) == 3
+    assert outs[0].asnumpy().dtype == np.float16
+    np.testing.assert_allclose(outs[2].asnumpy(), [0.95, 0.95], rtol=1e-5)
