@@ -1,23 +1,29 @@
 """Benchmark: ResNet-50 training throughput, imgs/sec/chip (BASELINE primary
 metric). One fully-jitted train step (fwd+bwd+SGD) on one TPU chip via
-ShardedTrainer — the framework's performance path.
+ShardedTrainer — the framework's performance path. Mixed precision by
+default: bfloat16 compute, fp32 master weights (the reference's mp_sgd
+semantics; BENCH_DTYPE=float32 for full precision).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline: reference's in-repo resnet-50 single-GPU figure (109 img/s,
 example/image-classification/README.md:149-155).
+
+Timing is honest against async dispatch: the measured window ends with a
+host transfer of the final loss (float(...)), which cannot complete before
+every queued step has executed on device.
 """
 
 import json
 import os
-import sys
 import time
 
 import numpy as np
 
 
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "100"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -29,10 +35,10 @@ def main():
     net.initialize(mx.init.Xavier())
     data = mx.nd.array(np.random.rand(batch, 3, 224, 224).astype(np.float32))
     label = mx.nd.array(np.random.randint(0, 1000, (batch,)).astype(np.float32))
-    net(data[0:1])  # materialize deferred shapes cheaply? (full fwd)
+    net(data[0:1])  # materialize deferred shapes
 
     def loss_fn(out, lab):
-        logp = jax.nn.log_softmax(out, axis=-1)
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(logp, lab.astype(jnp.int32)[:, None], axis=-1)
         return -picked.mean()
 
@@ -40,19 +46,20 @@ def main():
     trainer = ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
                              optimizer_params={"learning_rate": 0.1,
                                                "momentum": 0.9},
-                             data_specs=P(), label_spec=P())
+                             data_specs=P(), label_spec=P(),
+                             compute_dtype=None if dtype == "float32" else dtype)
 
-    # warmup/compile
-    loss = trainer.step(data, label)
-    jax.block_until_ready(loss)
-    loss = trainer.step(data, label)
-    jax.block_until_ready(loss)
+    # warmup/compile + fill the dispatch pipeline
+    for _ in range(8):
+        loss = trainer.step(data, label)
+    float(loss)   # full sync
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(data, label)
-    jax.block_until_ready(loss)
+    final = float(loss)   # host transfer: waits for the whole queue
     dt = time.perf_counter() - t0
+    assert np.isfinite(final), "training diverged: loss=%r" % final
     imgs_per_sec = batch * steps / dt
 
     baseline = 109.0

@@ -71,4 +71,6 @@ from . import module as mod
 from . import module
 from .model import save_checkpoint, load_checkpoint
 from . import model
+from . import executor_manager
+from . import test_utils
 from . import contrib

@@ -15,7 +15,8 @@ from ..ndarray import NDArray
 from ..ops import quantization as qops
 
 __all__ = ["quantize_net", "calibrate_ranges", "QuantizedDense",
-           "QuantizedConv2D"]
+           "QuantizedConv2D", "quantize_model", "quantize_symbol",
+           "calibrate_symbol"]
 
 
 class _RangeCollector:
@@ -175,3 +176,137 @@ def quantize_net(net, calib_data=None, calib_mode="naive", num_calib_batches=10,
         if name in parent.__dict__:
             setattr(parent, name, qlayer)
     return net
+
+
+# ---------------------------------------------------------------------------
+# Symbol-mode quantization (reference: quantize_graph_pass.cc clones the graph
+# inserting quantize/dequantize nodes; quantization.py:422 quantize_model)
+# ---------------------------------------------------------------------------
+
+_QUANTIZABLE_OPS = ("FullyConnected", "Convolution")
+
+
+def _quantizable_nodes(sym, excluded):
+    return [n for n in sym._topo()
+            if n._op in _QUANTIZABLE_OPS and n._name not in excluded]
+
+
+def calibrate_symbol(sym, arg_params, calib_data, data_names=("data",),
+                     calib_mode="naive", num_calib_batches=10, excluded=()):
+    """Run calibration batches through the fp32 graph and return
+    {node_name: activation_threshold} for each quantizable node's input."""
+    from .. import symbol as sym_mod
+
+    nodes = _quantizable_nodes(sym, excluded)
+    if not nodes:
+        return {}
+    taps = sym_mod.Group([n._inputs[0] for n in nodes])
+    samples = {n._name: [] for n in nodes}
+    for i, batch in enumerate(calib_data):
+        if i >= num_calib_batches:
+            break
+        data = batch[0] if isinstance(batch, (list, tuple)) else batch
+        if hasattr(data, "data") and not isinstance(data, np.ndarray):
+            data = data.data[0]   # DataBatch (np.ndarray.data is a memoryview)
+        feed = dict(arg_params)
+        feed[data_names[0]] = data if isinstance(data, NDArray) \
+            else NDArray(np.asarray(data))
+        outs = taps.eval(**{k: (v if isinstance(v, NDArray)
+                                else NDArray(np.asarray(v)))
+                            for k, v in feed.items()})
+        for n, o in zip(nodes, outs):
+            samples[n._name].append(np.asarray(o.asnumpy()))
+    thresholds = {}
+    for name, vals in samples.items():
+        flat = np.concatenate([v.ravel() for v in vals])
+        thresholds[name] = (qops.entropy_threshold(flat)
+                            if calib_mode == "entropy"
+                            else qops.minmax_threshold(flat))
+    return thresholds
+
+
+def quantize_symbol(sym, excluded_sym_names=(), thresholds=None):
+    """Clone the symbolic graph, replacing each quantizable node with
+    quantize_v2 -> quantized op -> dequantize (the reference's graph pass)."""
+    from .. import symbol as sym_mod
+    from ..symbol import Group
+
+    thresholds = thresholds or {}
+    excluded = set(excluded_sym_names or ())
+    rebuilt = {}   # id(original base node) -> rebuilt Symbol (fp32-out)
+
+    def lookup(inp):
+        base = rebuilt[id(inp)]
+        if inp._out_index is not None:
+            return base[inp._out_index]
+        return base
+
+    for n in sym._topo():
+        if n._op is None or n._op == "_group":
+            rebuilt[id(n)] = n
+            continue
+        ins = [lookup(i) for i in n._inputs]
+        if n._op in _QUANTIZABLE_OPS and n._name not in excluded:
+            attrs = {k: v for k, v in n._attrs.items()
+                     if not k.startswith("__")}
+            thr = thresholds.get(n._name)
+            qkw = {}
+            if thr is not None:
+                qkw = {"min_calib_range": -float(thr),
+                       "max_calib_range": float(thr)}
+            qd = sym_mod.quantize_v2(ins[0], name=n._name + "_quantize", **qkw)
+            qw = sym_mod.quantize_v2(ins[1], name=n._name + "_wquantize")
+            call_kw = dict(data_min=qd[1], data_max=qd[2],
+                           weight_min=qw[1], weight_max=qw[2],
+                           name=n._name + "_quantized", **attrs)
+            if len(ins) > 2 and not attrs.get("no_bias"):
+                qb = sym_mod.quantize_v2(ins[2], name=n._name + "_bquantize")
+                call_kw.update(bias=qb[0], bias_min=qb[1], bias_max=qb[2])
+            qop = ("quantized_fully_connected" if n._op == "FullyConnected"
+                   else "quantized_conv")
+            qnode = getattr(sym_mod, qop)(qd[0], qw[0], **call_kw)
+            rq = sym_mod.requantize(qnode[0], qnode[1], qnode[2],
+                                    name=n._name + "_requantize")
+            deq = sym_mod.dequantize(rq[0], rq[1], rq[2],
+                                     name=n._name + "_dequantize")
+            rebuilt[id(n)] = deq
+        else:
+            from ..symbol import Symbol
+            rebuilt[id(n)] = Symbol(n._op, n._name, ins, n._attrs,
+                                    n._num_outputs)
+
+    if sym._op == "_group":
+        return Group([lookup(s) for s in sym._inputs])
+    out = rebuilt[id(sym._topo()[-1])]
+    return out[sym._out_index] if sym._out_index is not None else out
+
+
+def quantize_model(sym=None, arg_params=None, aux_params=None,
+                   data_names=("data",), ctx=None, excluded_sym_names=None,
+                   calib_mode="none", calib_data=None, num_calib_examples=None,
+                   num_calib_batches=10, quantized_dtype="int8", **kwargs):
+    """Symbol/Module-style quantization driver (reference:
+    python/mxnet/contrib/quantization.py:422).
+
+    Returns ``(qsym, arg_params, aux_params)`` — weights stay fp32 in the
+    param dict; the in-graph quantize_v2 on weight vars is constant-folded
+    by XLA at compile time (the reference quantizes them offline instead)."""
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise ValueError("unknown quantized_dtype %s" % quantized_dtype)
+    excluded = set(excluded_sym_names or ())
+    arg_params = dict(arg_params or {})
+    aux_params = dict(aux_params or {})
+    thresholds = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise ValueError("calib_data required for calib_mode=%r" % calib_mode)
+        params = {k: (v if isinstance(v, NDArray) else NDArray(np.asarray(v)))
+                  for k, v in {**arg_params, **aux_params}.items()}
+        thresholds = calibrate_symbol(
+            sym, params, calib_data, data_names=data_names,
+            calib_mode=calib_mode,
+            num_calib_batches=num_calib_examples or num_calib_batches,
+            excluded=excluded)
+    qsym = quantize_symbol(sym, excluded_sym_names=excluded,
+                           thresholds=thresholds)
+    return qsym, arg_params, aux_params

@@ -61,11 +61,12 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride, dilate = _tup(stride, sd), _tup(dilate, sd)
     pad = _tup(pad, sd) if pad is not None else (0,) * sd
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dim_numbers(data.ndim))
+    # bf16 inputs: XLA's TPU lowering accumulates in fp32 on the MXU already;
+    # forcing preferred_element_type=f32 here breaks the conv transpose rule
+    # (cotangent dtype mismatch in grad-of-weight).
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride, padding=[(p, p) for p in pad],
-        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    out = out.astype(data.dtype)
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * sd)
     return out
@@ -206,6 +207,12 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **_ignored):
+    if axis in (-1, data.ndim - 1):
+        from .pallas import fused_layer_norm, fused_norm_available
+        if fused_norm_available():
+            out = fused_layer_norm(data, gamma, beta, eps)
+            if out is not None:
+                return out
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     inv = lax.rsqrt(var + jnp.asarray(eps, var.dtype))
@@ -313,6 +320,12 @@ def softmax(data, axis=-1, temperature=None, length=None, use_length=False):
         mask = steps.reshape(bshape) < length.reshape(
             [length.shape[0]] + [1] * (data.ndim - 1))
         data = jnp.where(mask, data, -jnp.inf)
+    if axis in (-1, data.ndim - 1):
+        from .pallas import fused_softmax, fused_norm_available
+        if fused_norm_available():
+            out = fused_softmax(data, axis=axis)
+            if out is not None:
+                return out
     return jax.nn.softmax(data, axis=axis)
 
 

@@ -199,9 +199,11 @@ def sparse_adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     if indices is None:
+        g = g + wd * weight
         h = history + g * g
         return weight - lr * g / (jnp.sqrt(h) + epsilon), h
     idx = indices.astype(jnp.int32)
+    g = g + wd * weight[idx]
     h_rows = history[idx] + g * g
     w_rows = weight[idx] - lr * g / (jnp.sqrt(h_rows) + epsilon)
     return weight.at[idx].set(w_rows), history.at[idx].set(h_rows)

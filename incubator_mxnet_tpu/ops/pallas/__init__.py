@@ -4,3 +4,5 @@ emits near-peak MXU code for matmul/conv, so kernels here target what XLA
 does NOT fuse well: flash attention (O(T) memory softmax-attention)."""
 
 from .flash_attention import flash_attention, flash_attention_available
+from .fused_norm import (fused_layer_norm, fused_softmax,
+                         fused_norm_available)

@@ -18,7 +18,7 @@ from jax import lax
 from .registry import register
 
 
-@register("quantize_v2", aliases=("_contrib_quantize_v2", "quantize"))
+@register("quantize_v2", num_outputs=3, aliases=("_contrib_quantize_v2", "quantize"))
 def quantize_v2(data, min_calib_range=None, max_calib_range=None,
                 out_type="int8"):
     """float -> (int8 data, min, max). Symmetric around 0."""
@@ -38,7 +38,7 @@ def dequantize(data, min_range, max_range, out_type="float32"):
     return data.astype(jnp.float32) * (amax / 127.0)
 
 
-@register("requantize")
+@register("requantize", num_outputs=3)
 def requantize(data, min_range, max_range, min_calib_range=None,
                max_calib_range=None):
     """int32 accum -> int8 with new range."""
@@ -54,9 +54,9 @@ def requantize(data, min_range, max_range, min_calib_range=None,
     return q, -out_amax * jnp.ones(()), out_amax * jnp.ones(())
 
 
-@register("quantized_fully_connected", aliases=("_contrib_quantized_fully_connected",))
-def quantized_fully_connected(data, weight, bias, data_min, data_max,
-                              weight_min, weight_max, bias_min=None,
+@register("quantized_fully_connected", num_outputs=3, aliases=("_contrib_quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias=None, data_min=None, data_max=None,
+                              weight_min=None, weight_max=None, bias_min=None,
                               bias_max=None, num_hidden=None, no_bias=False,
                               flatten=True):
     """int8 x int8 -> int32 accumulate on the MXU; returns (int32, min, max)."""
@@ -76,20 +76,22 @@ def quantized_fully_connected(data, weight, bias, data_min, data_max,
     return acc, -out_amax, out_amax
 
 
-@register("quantized_conv", aliases=("_contrib_quantized_conv",))
-def quantized_conv(data, weight, bias, data_min, data_max, weight_min,
-                   weight_max, bias_min=None, bias_max=None, kernel=None,
-                   stride=None, pad=None, num_filter=None, num_group=1,
-                   no_bias=False, **_ignored):
+@register("quantized_conv", num_outputs=3, aliases=("_contrib_quantized_conv",))
+def quantized_conv(data, weight, bias=None, data_min=None, data_max=None, weight_min=None,
+                   weight_max=None, bias_min=None, bias_max=None, kernel=None,
+                   stride=None, pad=None, dilate=None, num_filter=None,
+                   num_group=1, no_bias=False, **_ignored):
     sd = data.ndim - 2
     stride = (stride if stride else (1,) * sd)
     pad = (pad if pad else (0,) * sd)
+    dilate = (dilate if dilate else (1,) * sd)
     from .nn import _conv_dim_numbers
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     _conv_dim_numbers(data.ndim))
     acc = lax.conv_general_dilated(
         data.astype(jnp.int8), weight.astype(jnp.int8),
         window_strides=tuple(stride), padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=jnp.int32)
     d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max))
@@ -104,7 +106,7 @@ def quantized_conv(data, weight, bias, data_min, data_max, weight_min,
     return acc, -out_amax, out_amax
 
 
-@register("quantized_pooling", aliases=("_contrib_quantized_pooling",))
+@register("quantized_pooling", num_outputs=3, aliases=("_contrib_quantized_pooling",))
 def quantized_pooling(data, data_min, data_max, **kwargs):
     from .nn import pooling
     out = pooling(data.astype(jnp.float32), **kwargs)
@@ -113,7 +115,7 @@ def quantized_pooling(data, data_min, data_max, **kwargs):
     return jnp.round(out).astype(jnp.int8), data_min, data_max
 
 
-@register("quantized_flatten", aliases=("_contrib_quantized_flatten",))
+@register("quantized_flatten", num_outputs=3, aliases=("_contrib_quantized_flatten",))
 def quantized_flatten(data, data_min, data_max):
     return data.reshape(data.shape[0], -1), data_min, data_max
 

@@ -49,11 +49,17 @@ class ShardedTrainer:
 
     def __init__(self, block, loss, mesh, rules=None, optimizer="sgd",
                  optimizer_params=None, data_specs=None, label_spec=None,
-                 dp_axis="dp"):
+                 dp_axis="dp", compute_dtype=None):
         self._block = block
         self._loss = loss
         self._mesh = mesh
         self._opt = optimizer
+        # mixed precision: fp32 master weights + optimizer state, compute in
+        # compute_dtype (reference: mp_sgd_update fp16 master-weight ops,
+        # src/operator/optimizer_op.cc) — on TPU bfloat16 feeds the MXU at
+        # full rate with no loss-scaling needed.
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype is not None else None)
         hp = dict(optimizer_params or {})
         self._lr = float(hp.get("learning_rate", 0.01))
         self._momentum = float(hp.get("momentum", 0.0))
@@ -128,11 +134,23 @@ class ShardedTrainer:
         block, loss_block = self._block, self._loss
         diff_names, aux_names = self._diff_names, self._aux_names
 
+        cdt = self._compute_dtype
+
         def step_fn(param_vals, aux_vals, opt_state, t, key, *batch):
             data, label = batch[:n_data_args], batch[n_data_args:]
+            if cdt is not None:
+                data = tuple(d.astype(cdt) if jnp.issubdtype(d.dtype, jnp.floating)
+                             else d for d in data)
 
             def loss_fn(pv):
-                ctx = _TraceCtx({**pv, **aux_vals}, key, training=True)
+                if cdt is not None:
+                    pv_c = {n: (v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                                else v) for n, v in pv.items()}
+                    aux_c = {n: (v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                                 else v) for n, v in aux_vals.items()}
+                else:
+                    pv_c, aux_c = pv, aux_vals
+                ctx = _TraceCtx({**pv_c, **aux_c}, key, training=True)
                 prev = getattr(_trace_state, "ctx", None)
                 _trace_state.ctx = ctx
                 try:
@@ -141,11 +159,14 @@ class ShardedTrainer:
                         loss = loss_block(out, *label)
                     else:
                         loss = loss_block(out, *label)
-                    loss = jnp.mean(loss)
+                    loss = jnp.mean(loss.astype(jnp.float32))
                 finally:
                     _trace_state.ctx = prev
                 new_aux = {n: ctx.aux_updates.get(n, aux_vals[n])
                            for n in aux_names}
+                if cdt is not None:   # running stats stay fp32 master copies
+                    new_aux = {n: v.astype(aux_vals[n].dtype)
+                               for n, v in new_aux.items()}
                 return loss, new_aux
 
             (loss, new_aux), grads = jax.value_and_grad(

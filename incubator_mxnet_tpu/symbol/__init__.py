@@ -102,6 +102,23 @@ class Symbol:
     def __neg__(self):
         return _make_apply("negative", [self], {})
 
+    def _cmp(self, other, opname, scalar_op):
+        if isinstance(other, Symbol):
+            return _make_apply(opname, [self, other], {})
+        return _make_apply(scalar_op, [self], {"scalar": other})
+
+    def __lt__(self, other):
+        return self._cmp(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._cmp(other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __gt__(self, other):
+        return self._cmp(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._cmp(other, "broadcast_greater_equal", "_greater_equal_scalar")
+
     # ------------------------------------------------------------ structure
     def get_internals(self):
         nodes = self._topo()
@@ -400,6 +417,13 @@ def _make_apply(opname, input_syms, attrs, name=None):
                   list(input_syms), attrs, num_outputs=nout)
 
 
+# Parameter slots auto-materialized as variables when the caller omits them
+# (reference: mx.sym.FullyConnected(x, num_hidden=N) creates fc_weight/fc_bias
+# vars via NNVM's ListInputNames). moving_* are auxiliary states.
+_AUTO_PARAM_SLOTS = ("weight", "bias", "gamma", "beta",
+                     "moving_mean", "moving_var")
+
+
 def __getattr__(opname):
     """mx.sym.<Op>(...) — symbol-building function for any registered op."""
     try:
@@ -408,14 +432,51 @@ def __getattr__(opname):
         raise AttributeError(opname)
 
     def sym_fn(*args, **kwargs):
+        import inspect
         name = kwargs.pop("name", None)
-        input_syms = [a for a in args if isinstance(a, Symbol)]
-        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        try:
+            sig_params = [p for p in
+                          inspect.signature(info.fn).parameters.values()
+                          if p.kind == p.POSITIONAL_OR_KEYWORD]
+            if any(p.kind == p.VAR_POSITIONAL for p in
+                   inspect.signature(info.fn).parameters.values()):
+                sig_params = []   # *args ops (Concat/add_n): no name binding
+        except (ValueError, TypeError):
+            sig_params = []
+        input_syms, attrs = [], {}
+        provided = set(kwargs)
+        for j, a in enumerate(args):
+            if isinstance(a, Symbol):
+                input_syms.append(a)
+            elif j < len(sig_params):
+                # positional scalar arg -> named attr (split_v2(x, 3) etc.)
+                attrs[sig_params[j].name] = a
+            if j < len(sig_params):
+                provided.add(sig_params[j].name)
+        attrs.update({k: v for k, v in kwargs.items()
+                      if not isinstance(v, Symbol)})
         for k, v in kwargs.items():
             if isinstance(v, Symbol):
                 input_syms.append(v)
                 attrs.setdefault("__kwarg_inputs__", []).append(
                     (k, len(input_syms) - 1))
+        if input_syms:
+            kw_inputs = attrs.get("__kwarg_inputs__", [])
+            missing = [p.name for p in sig_params
+                       if p.name in _AUTO_PARAM_SLOTS and p.name not in provided]
+            if missing:
+                name = name or _auto_name(opname.lower().strip("_"))
+                for pname in missing:
+                    if pname == "bias" and (attrs.get("no_bias") or
+                                            attrs.get("use_bias") is False):
+                        continue
+                    v = var("%s_%s" % (name, pname))
+                    if pname.startswith("moving_"):
+                        v._attrs["__aux__"] = True
+                    input_syms.append(v)
+                    if kw_inputs:   # kwarg-style call: bind new vars by name
+                        attrs.setdefault("__kwarg_inputs__", []).append(
+                            (pname, len(input_syms) - 1))
         return _make_apply(opname, input_syms, attrs, name)
 
     sym_fn.__name__ = opname
@@ -490,8 +551,12 @@ def load_json(json_str):
             built.append(var(n["name"], attr=attrs))
         else:
             info = get_op(n["op"])
-            nout = info.num_outputs if isinstance(info.num_outputs, int) else \
-                int(attrs.get(info.num_outputs, 1))
+            if callable(info.num_outputs):
+                nout = int(info.num_outputs(attrs))
+            elif isinstance(info.num_outputs, int):
+                nout = info.num_outputs
+            else:
+                nout = int(attrs.get(info.num_outputs, 1))
             built.append(Symbol(info.name, n["name"], inputs, attrs,
                                 num_outputs=nout))
     heads = data.get("heads", [[len(built) - 1, 0, 0]])
@@ -562,3 +627,5 @@ def block_to_json(block, input_names=("data",)):
     if isinstance(out, (list, tuple)):
         out = Group([o for o in out if isinstance(o, Symbol)])
     return out.tojson()
+
+from . import contrib  # noqa: E402,F401  (mx.sym.contrib — control flow)

@@ -1,0 +1,203 @@
+"""mx.sym.contrib — symbolic control flow (foreach / while_loop / cond).
+
+Reference parity: python/mxnet/symbol/contrib.py:751 (foreach/while_loop/
+cond build ``_foreach``/``_while_loop``/``_cond`` nodes holding cut-out
+NNVM subgraphs; src/operator/control_flow.cc:1255,1316,1378 interprets
+them per iteration).
+
+TPU-first redesign: the body is traced ONCE on placeholder Symbols into a
+sub-Symbol-graph; a closure op is registered whose evaluation lowers the
+whole construct to the matching XLA structured-control-flow primitive
+(``lax.scan`` / masked bounded scan / ``lax.cond`` via
+``ops/control_flow.py``). The construct is a single graph node — exactly
+the reference's single ``_foreach`` node — so symbolic autograd and jit
+see one differentiable primitive instead of an unrolled loop.
+
+Known limitation vs the reference: the closure op lives only in this
+process's registry, so ``tojson()`` of a graph containing control flow is
+not loadable in a fresh process (the reference serializes the cut-out
+subgraph inside the node). Export such models via HybridBlock tracing
+instead.
+"""
+
+from ..ops import control_flow as _cf
+from ..ops.registry import register as _register_op
+from . import Symbol, Group, var, _make_apply, _eval_symbol
+import incubator_mxnet_tpu.symbol as _sym_mod
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+_uid = [0]
+
+
+def _next_uid():
+    _uid[0] += 1
+    return _uid[0]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _free_vars(out_syms, placeholder_names):
+    """Leaf variable nodes of the subgraph that are NOT loop placeholders.
+
+    These are outer-graph symbols the body closed over; they become extra
+    inputs of the control-flow node (the reference hoists them the same way
+    when cutting the subgraph)."""
+    seen, free = set(), []
+    for s in out_syms:
+        for n in s._topo():
+            if n._op is None and n._name not in placeholder_names \
+                    and id(n) not in seen:
+                seen.add(id(n))
+                free.append(n)
+    return free
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """``body(data_slice_sym, states_sym) -> (outputs, new_states)`` scanned
+    over axis 0 of ``data``. Returns ``(outputs, final_states)`` Symbols."""
+    uid = _next_uid()
+    data_list = _as_list(data)
+    multi_data = isinstance(data, (list, tuple))
+    states = _as_list(init_states)
+    multi_state = isinstance(init_states, (list, tuple))
+
+    data_ph = [var("_foreach%d_data%d" % (uid, i)) for i in range(len(data_list))]
+    state_ph = [var("_foreach%d_state%d" % (uid, i)) for i in range(len(states))]
+    ph_names = {v._name for v in data_ph + state_ph}
+
+    outs, new_states = body(data_ph if multi_data else data_ph[0],
+                            state_ph if multi_state else state_ph[0])
+    out_syms = _as_list(outs)
+    new_state_syms = _as_list(new_states)
+    multi_out = isinstance(outs, (list, tuple))
+    sub = Group(out_syms + new_state_syms)
+    free = _free_vars(out_syms + new_state_syms, ph_names)
+
+    nd_, ns_, nf_ = len(data_list), len(states), len(free)
+    data_names = [v._name for v in data_ph]
+    state_names = [v._name for v in state_ph]
+    free_names = [v._name for v in free]
+    n_out = len(out_syms)
+
+    def op_fn(*arrays, **_attrs):
+        d, s = arrays[:nd_], arrays[nd_:nd_ + ns_]
+        fv = arrays[nd_ + ns_:]
+
+        def jbody(x, st):
+            feed = dict(zip(free_names, fv))
+            feed.update(zip(data_names, _as_list(x) if multi_data else [x]))
+            feed.update(zip(state_names, _as_list(st) if multi_state else [st]))
+            vals = _eval_symbol(sub, feed, wrap=False)
+            o = vals[:n_out]
+            ns = vals[n_out:]
+            return (o if multi_out else o[0],
+                    ns if multi_state else ns[0])
+
+        stacked, final = _cf.foreach(jbody, list(d) if multi_data else d[0],
+                                     list(s) if multi_state else s[0])
+        return tuple(_as_list(stacked)) + tuple(_as_list(final))
+
+    opname = "_foreach_sub%d" % uid
+    _register_op(opname, num_outputs=n_out + ns_)(op_fn)
+    node = _make_apply(opname, data_list + states + free,
+                       {"__subgraph__": "foreach"}, name="%s%d" % (name, uid))
+    out_nodes = [node[i] for i in range(n_out)]
+    st_nodes = [node[n_out + i] for i in range(ns_)]
+    return (out_nodes if multi_out else out_nodes[0],
+            st_nodes if multi_state else (st_nodes[0] if st_nodes else []))
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None, name="while_loop"):
+    """Bounded symbolic while loop; see ``ops.control_flow.while_loop``."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static shapes)")
+    uid = _next_uid()
+    loop_vars = _as_list(loop_vars)
+    var_ph = [var("_while%d_var%d" % (uid, i)) for i in range(len(loop_vars))]
+    ph_names = {v._name for v in var_ph}
+
+    pred_sym = cond_fn(*var_ph)
+    outs, new_vars = func(*var_ph)
+    out_syms = _as_list(outs)
+    multi_out = isinstance(outs, (list, tuple))
+    new_var_syms = _as_list(new_vars)
+    if len(new_var_syms) != len(loop_vars):
+        raise ValueError("func must return as many loop_vars as it takes")
+    sub = Group([pred_sym] + out_syms + new_var_syms)
+    free = _free_vars([pred_sym] + out_syms + new_var_syms, ph_names)
+
+    nv_, nf_ = len(loop_vars), len(free)
+    var_names = [v._name for v in var_ph]
+    free_names = [v._name for v in free]
+    n_out = len(out_syms)
+
+    def op_fn(*arrays, **_attrs):
+        vs, fv = arrays[:nv_], arrays[nv_:]
+
+        def feed_for(vals):
+            feed = dict(zip(free_names, fv))
+            feed.update(zip(var_names, vals))
+            return feed
+
+        def jcond(*vals):
+            return _eval_symbol(sub, feed_for(vals), wrap=False)[0]
+
+        def jfunc(*vals):
+            res = _eval_symbol(sub, feed_for(vals), wrap=False)
+            o, nv = res[1:1 + n_out], res[1 + n_out:]
+            return (o if multi_out else o[0]), list(nv)
+
+        stacked, final = _cf.while_loop(jcond, jfunc, list(vs),
+                                        int(max_iterations))
+        return tuple(_as_list(stacked)) + tuple(final)
+
+    opname = "_while_loop_sub%d" % uid
+    _register_op(opname, num_outputs=n_out + nv_)(op_fn)
+    node = _make_apply(opname, loop_vars + free,
+                       {"__subgraph__": "while_loop",
+                        "max_iterations": int(max_iterations)},
+                       name="%s%d" % (name, uid))
+    out_nodes = [node[i] for i in range(n_out)]
+    var_nodes = [node[n_out + i] for i in range(nv_)]
+    return (out_nodes if multi_out else out_nodes[0]), var_nodes
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Symbolic two-way branch; both branches traced, one executed."""
+    uid = _next_uid()
+    then_out = _as_list(then_func())
+    else_out = _as_list(else_func())
+    multi = len(then_out) > 1
+    if len(then_out) != len(else_out):
+        raise ValueError("then_func/else_func must produce the same outputs")
+    sub_t, sub_e = Group(then_out), Group(else_out)
+    free = _free_vars([pred] + then_out + else_out, set())
+    free_names = [v._name for v in free]
+    n_out = len(then_out)
+
+    def op_fn(*arrays, **_attrs):
+        p, fv = arrays[0], arrays[1:]
+        feed = dict(zip(free_names, fv))
+
+        def run_then():
+            return tuple(_eval_symbol(sub_t, feed, wrap=False))
+
+        def run_else():
+            return tuple(_eval_symbol(sub_e, feed, wrap=False))
+
+        return _cf.cond(p, run_then, run_else)
+
+    opname = "_cond_sub%d" % uid
+    _register_op(opname, num_outputs=n_out)(op_fn)
+    node = _make_apply(opname, [pred] + free, {"__subgraph__": "cond"},
+                       name="%s%d" % (name, uid))
+    return [node[i] for i in range(n_out)] if multi else node
+
+
+def __getattr__(opname):
+    """Everything else in mx.sym.contrib delegates to the registered-op
+    symbol builders (boolean_mask, index_copy, quadratic, ...)."""
+    return getattr(_sym_mod, opname)

@@ -1,0 +1,13 @@
+"""mx.test_utils — test harness (reference: python/mxnet/test_utils.py).
+
+Thin façade over ``utils.test_utils`` so both the reference's import path
+(``mxnet.test_utils``) and the internal one work.
+"""
+
+from .utils.test_utils import *  # noqa: F401,F403
+from .utils.test_utils import (  # noqa: F401
+    default_context, set_default_context, default_dtype, same, almost_equal,
+    assert_almost_equal, rand_ndarray, rand_shape_2d, rand_shape_3d,
+    rand_shape_nd, simple_forward, check_numeric_gradient, check_consistency,
+    check_symbolic_forward, check_symbolic_backward,
+)

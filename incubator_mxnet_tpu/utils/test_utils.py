@@ -157,3 +157,45 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):
     assert_almost_equal(eager_np, jit_out, rtol=rtol, atol=atol,
                         names=("eager", "jit"))
     return eager_np
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-5, atol=1e-20,
+                           dtype=_np.float32):
+    """Bind a Symbol to the given inputs and compare outputs against numpy
+    references (reference: test_utils.check_symbolic_forward:939)."""
+    args = sym.list_arguments()
+    feed = {n: nd_array(_np.asarray(v, dtype=dtype))
+            for n, v in (inputs.items() if isinstance(inputs, dict)
+                         else zip(args, inputs))}
+    outs = sym.eval(**feed)
+    expected = expected if isinstance(expected, (list, tuple)) else [expected]
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(_as_np(o), _np.asarray(e), rtol=rtol, atol=atol,
+                            names=("output_%d" % i, "expected_%d" % i))
+    return [_as_np(o) for o in outs]
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected_grads,
+                            rtol=1e-5, atol=1e-20, dtype=_np.float32):
+    """Bind a Symbol, run forward+backward with the given head gradients and
+    compare argument gradients against numpy references (reference:
+    test_utils.check_symbolic_backward)."""
+    args = sym.list_arguments()
+    feed = {n: _np.asarray(v, dtype=dtype)
+            for n, v in (inputs.items() if isinstance(inputs, dict)
+                         else zip(args, inputs))}
+    exe = sym.bind(args=[nd_array(feed[n]) for n in args],
+                   args_grad=[nd_array(_np.zeros_like(feed[n])) for n in args])
+    exe.forward(is_train=True)
+    ograds = out_grads if isinstance(out_grads, (list, tuple)) else [out_grads]
+    exe.backward([nd_array(_np.asarray(g, dtype=dtype)) for g in ograds])
+    expected = (expected_grads if isinstance(expected_grads, (list, tuple))
+                else [expected_grads])
+    got = []
+    for i, (g, e) in enumerate(zip(exe.grad_arrays, expected)):
+        if e is None:
+            continue
+        assert_almost_equal(_as_np(g), _np.asarray(e), rtol=rtol, atol=atol,
+                            names=("grad_%d" % i, "expected_grad_%d" % i))
+        got.append(_as_np(g))
+    return got

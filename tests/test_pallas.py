@@ -57,3 +57,72 @@ def test_flash_causal_and_grads():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2,
                                    atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# fused LayerNorm / Softmax (interpret mode runs on CPU, so these check
+# numerics everywhere; on TPU the same code path compiles via Mosaic)
+# ---------------------------------------------------------------------------
+
+def test_fused_layer_norm_matches_jnp():
+    from incubator_mxnet_tpu.ops.pallas import fused_layer_norm
+    np.random.seed(1)
+    x = jnp.asarray(np.random.randn(32, 256).astype(np.float32))
+    g = jnp.asarray(np.random.rand(256).astype(np.float32) + 0.5)
+    b = jnp.asarray(np.random.randn(256).astype(np.float32))
+    got = fused_layer_norm(x, g, b, eps=1e-5, interpret=True)
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * g + b
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_layer_norm_grad():
+    from incubator_mxnet_tpu.ops.pallas.fused_norm import _ln_core
+    np.random.seed(2)
+    x = jnp.asarray(np.random.randn(16, 128).astype(np.float32))
+    g = jnp.asarray(np.random.rand(128).astype(np.float32) + 0.5)
+    b = jnp.asarray(np.random.randn(128).astype(np.float32))
+
+    def f_pallas(x, g, b):
+        return jnp.sum(_ln_core(x, g, b, 1e-5, True) ** 2)
+
+    def f_ref(x, g, b):
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        return jnp.sum(((x - mean) / jnp.sqrt(var + 1e-5) * g + b) ** 2)
+
+    got = jax.grad(f_pallas, argnums=(0, 1, 2))(x, g, b)
+    want = jax.grad(f_ref, argnums=(0, 1, 2))(x, g, b)
+    for a, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_fused_softmax_matches_jnp():
+    from incubator_mxnet_tpu.ops.pallas import fused_softmax
+    np.random.seed(3)
+    x = jnp.asarray(np.random.randn(8, 4, 128).astype(np.float32) * 3)
+    got = fused_softmax(x, interpret=True)
+    want = jax.nn.softmax(x, axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_softmax_grad():
+    from incubator_mxnet_tpu.ops.pallas.fused_norm import _softmax_core
+    np.random.seed(4)
+    x = jnp.asarray(np.random.randn(8, 128).astype(np.float32))
+    got = jax.grad(lambda v: jnp.sum(_softmax_core(v, True) ** 2))(x)
+    want = jax.grad(lambda v: jnp.sum(jax.nn.softmax(v, -1) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_fallback_on_bad_shapes():
+    from incubator_mxnet_tpu.ops.pallas import fused_layer_norm, fused_softmax
+    # 7 rows doesn't tile -> None (caller falls back)
+    x = jnp.zeros((7, 64))
+    assert fused_layer_norm(x, jnp.ones(64), jnp.zeros(64)) is None
+    assert fused_softmax(jnp.zeros((5, 3, 7, 64))[..., 0]) is None

@@ -1,0 +1,115 @@
+"""Symbolic control flow + parity-gap APIs added on top of the core suite.
+
+Reference model: tests/python/unittest/test_contrib_control_flow.py (symbol
+mode) and test_utils usage across the reference suite.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def test_sym_foreach_matches_numpy():
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    outs, final = mx.sym.contrib.foreach(
+        lambda d, s: (d + s, d + s), data, init)
+    d = np.arange(6, dtype=np.float32).reshape(3, 2)
+    s = np.zeros(2, dtype=np.float32)
+    got = outs.eval(data=mx.nd.array(d), init=mx.nd.array(s))[0].asnumpy()
+    want = np.cumsum(d, axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    fin = final.eval(data=mx.nd.array(d), init=mx.nd.array(s))[0].asnumpy()
+    np.testing.assert_allclose(fin, want[-1], rtol=1e-6)
+
+
+def test_sym_foreach_closure_over_outer_symbol():
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    scale = mx.sym.var("scale")
+    outs, _ = mx.sym.contrib.foreach(
+        lambda d, s: (d * scale + s, s), data, init)
+    d = np.ones((4, 3), dtype=np.float32)
+    got = outs.eval(data=mx.nd.array(d), init=mx.nd.array(np.zeros(3, np.float32)),
+                    scale=mx.nd.array(np.array(2.0, np.float32)))[0].asnumpy()
+    np.testing.assert_allclose(got, 2 * d)
+
+
+def test_sym_while_loop():
+    outs, final = mx.sym.contrib.while_loop(
+        lambda i, s: i < 3,
+        lambda i, s: ([i + s], [i + 1, s + i]),
+        [mx.sym.var("i"), mx.sym.var("s")], max_iterations=5)
+    feed = dict(i=mx.nd.array(np.array(0.0, np.float32)),
+                s=mx.nd.array(np.array(1.0, np.float32)))
+    got = outs[0].eval(**feed)[0].asnumpy()
+    np.testing.assert_allclose(got, [1.0, 2.0, 4.0, 0.0, 0.0])
+
+
+def test_sym_cond():
+    a, b = mx.sym.var("a"), mx.sym.var("b")
+    out = mx.sym.contrib.cond(a < b, lambda: a + b, lambda: a - b)
+    va = mx.nd.array(np.array(1.0, np.float32))
+    vb = mx.nd.array(np.array(2.0, np.float32))
+    assert float(out.eval(a=va, b=vb)[0].asnumpy()) == 3.0
+    vb2 = mx.nd.array(np.array(0.5, np.float32))
+    assert float(out.eval(a=va, b=vb2)[0].asnumpy()) == 0.5
+
+
+def test_check_symbolic_forward_backward():
+    x = mx.sym.var("x")
+    y = (x * x)
+    data = np.array([1.0, 2.0, 3.0], np.float32)
+    mx.test_utils.check_symbolic_forward(y, [data], [data * data])
+    mx.test_utils.check_symbolic_backward(
+        y, [data], [np.ones(3, np.float32)], [2 * data], rtol=1e-4)
+
+
+def test_fused_rnn_initializer_packs_lstm():
+    h, i = 4, 3
+    size = 4 * h * i + 4 * h * h + 2 * 4 * h
+    arr = mx.nd.zeros((size,))
+    init = mx.init.FusedRNN(mx.init.Xavier(), num_hidden=h, num_layers=1,
+                            mode="lstm", forget_bias=1.0)
+    init("rnn_parameters_weight", arr)
+    v = arr.asnumpy()
+    assert np.abs(v[:4 * h * i]).sum() > 0          # W_x filled
+    bias = v[4 * h * i + 4 * h * h:]
+    # forget gate rows carry forget_bias/2 in each of b_x, b_h
+    np.testing.assert_allclose(bias.sum(), 1.0 * h)
+
+
+def test_executor_manager_split_and_group():
+    slices = mx.executor_manager._split_input_slice(10, [1, 1, 1])
+    assert slices[-1].stop == 10 and len(slices) == 3
+
+
+def test_sym_auto_param_vars():
+    x = mx.sym.var("data")
+    y = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    assert y.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    z = mx.sym.BatchNorm(mx.sym.var("d2"), name="bn0")
+    assert set(z.list_auxiliary_states()) == {"bn0_moving_mean",
+                                              "bn0_moving_var"}
+
+
+def test_quantize_model_roundtrip():
+    rng = np.random.RandomState(0)
+    x = mx.sym.var("data")
+    y = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    w = rng.randn(8, 4).astype(np.float32) * 0.1
+    b = rng.randn(8).astype(np.float32) * 0.01
+    calib = [mx.nd.array(rng.randn(2, 4).astype(np.float32))
+             for _ in range(2)]
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        sym=y, arg_params={"fc1_weight": w, "fc1_bias": b}, aux_params={},
+        data_names=("data",), calib_mode="naive", calib_data=calib)
+    ops_used = {n["op"] for n in qsym.debug_list_nodes()}
+    assert "quantized_fully_connected" in ops_used
+    assert "dequantize" in ops_used
+    xin = rng.randn(2, 4).astype(np.float32)
+    got = qsym.eval(data=mx.nd.array(xin), fc1_weight=mx.nd.array(w),
+                    fc1_bias=mx.nd.array(b))[0].asnumpy()
+    want = xin @ w.T + b
+    np.testing.assert_allclose(got, want, rtol=0.2, atol=0.08)
