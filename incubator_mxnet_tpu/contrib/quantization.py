@@ -291,8 +291,9 @@ def quantize_model(sym=None, arg_params=None, aux_params=None,
     Returns ``(qsym, arg_params, aux_params)`` — weights stay fp32 in the
     param dict; the in-graph quantize_v2 on weight vars is constant-folded
     by XLA at compile time (the reference quantizes them offline instead)."""
-    if quantized_dtype not in ("int8", "uint8", "auto"):
-        raise ValueError("unknown quantized_dtype %s" % quantized_dtype)
+    if quantized_dtype not in ("int8", "auto"):
+        raise ValueError("quantized_dtype %r not supported: this build emits "
+                         "symmetric int8 (the MXU-native layout)" % quantized_dtype)
     excluded = set(excluded_sym_names or ())
     arg_params = dict(arg_params or {})
     aux_params = dict(aux_params or {})
@@ -302,10 +303,20 @@ def quantize_model(sym=None, arg_params=None, aux_params=None,
             raise ValueError("calib_data required for calib_mode=%r" % calib_mode)
         params = {k: (v if isinstance(v, NDArray) else NDArray(np.asarray(v)))
                   for k, v in {**arg_params, **aux_params}.items()}
+        if num_calib_examples is not None:
+            # reference semantics: example count / batch size -> batch count
+            bs = None
+            first = calib_data[0] if isinstance(calib_data, (list, tuple)) \
+                else None
+            if first is not None:
+                arr = first[0] if isinstance(first, (list, tuple)) else first
+                if hasattr(arr, "shape") and len(arr.shape) > 0:
+                    bs = int(arr.shape[0])
+            num_calib_batches = max(1, num_calib_examples // (bs or 1))
         thresholds = calibrate_symbol(
             sym, params, calib_data, data_names=data_names,
             calib_mode=calib_mode,
-            num_calib_batches=num_calib_examples or num_calib_batches,
+            num_calib_batches=num_calib_batches,
             excluded=excluded)
     qsym = quantize_symbol(sym, excluded_sym_names=excluded,
                            thresholds=thresholds)

@@ -4,7 +4,10 @@ VariationalDropoutCell."""
 from ...rnn.rnn_cell import ModifierCell, HybridRecurrentCell
 from ...nn.basic_layers import _train_flag, _maybe_key
 
-__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell"]
+__all__ = ["VariationalDropoutCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
 
 
 class VariationalDropoutCell(ModifierCell):
@@ -55,44 +58,94 @@ class VariationalDropoutCell(ModifierCell):
         return out, next_states
 
 
-class Conv2DLSTMCell(HybridRecurrentCell):
-    """Convolutional LSTM cell (reference: contrib.rnn.Conv2DLSTMCell)."""
+class _ConvRNNCellBase(HybridRecurrentCell):
+    """Shared machinery for Conv{1,2,3}D{RNN,LSTM,GRU}Cell (reference:
+    python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py _BaseConvRNNCell):
+    gates = conv(x, Wx) + conv(h, Wh); h2h is 'same'-padded so the spatial
+    shape is carried through the scan unchanged."""
+
+    _num_gates = 1
+    _layouts = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
 
     def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
-                 i2h_pad=(0, 0), **kwargs):
+                 i2h_pad=0, conv_dims=2, **kwargs):
         super().__init__(**kwargs)
+        d = conv_dims
+        self._conv_dims = d
         self._hidden_channels = hidden_channels
         self._input_shape = tuple(input_shape)
-        k = i2h_kernel if isinstance(i2h_kernel, tuple) else (i2h_kernel, i2h_kernel)
-        hk = h2h_kernel if isinstance(h2h_kernel, tuple) else (h2h_kernel, h2h_kernel)
-        pad = i2h_pad if isinstance(i2h_pad, tuple) else (i2h_pad, i2h_pad)
-        self._i2h_kernel, self._h2h_kernel, self._i2h_pad = k, hk, pad
+
+        def tup(v):
+            return tuple(v) if isinstance(v, (tuple, list)) else (v,) * d
+        self._i2h_kernel = tup(i2h_kernel)
+        self._h2h_kernel = tup(h2h_kernel)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, "h2h_kernel must be odd for 'same' padding"
+        self._i2h_pad = tup(i2h_pad)
+        ng = self._num_gates
         in_c = input_shape[0]
         with self.name_scope():
             self.i2h_weight = self.params.get(
-                "i2h_weight", shape=(4 * hidden_channels, in_c) + k)
+                "i2h_weight", shape=(ng * hidden_channels, in_c) + self._i2h_kernel)
             self.h2h_weight = self.params.get(
-                "h2h_weight", shape=(4 * hidden_channels, hidden_channels) + hk)
+                "h2h_weight",
+                shape=(ng * hidden_channels, hidden_channels) + self._h2h_kernel)
             self.i2h_bias = self.params.get(
-                "i2h_bias", shape=(4 * hidden_channels,), init="zeros")
+                "i2h_bias", shape=(ng * hidden_channels,), init="zeros")
             self.h2h_bias = self.params.get(
-                "h2h_bias", shape=(4 * hidden_channels,), init="zeros")
+                "h2h_bias", shape=(ng * hidden_channels,), init="zeros")
+
+    def _state_shape(self, batch_size):
+        spatial = tuple(
+            (s + 2 * p - k) + 1 for s, p, k in
+            zip(self._input_shape[1:], self._i2h_pad, self._i2h_kernel))
+        return (batch_size, self._hidden_channels) + spatial
 
     def state_info(self, batch_size=0):
-        shape = (batch_size, self._hidden_channels) + self._input_shape[1:]
-        return [{"shape": shape, "__layout__": "NCHW"},
-                {"shape": shape, "__layout__": "NCHW"}]
+        shape = self._state_shape(batch_size)
+        layout = self._layouts[self._conv_dims]
+        return [{"shape": shape, "__layout__": layout}
+                for _ in range(len(self._state_names))]
+
+    _state_names = ("h",)
+
+    def _gates(self, F, inputs, h, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        ng = self._num_gates
+        hpad = tuple(k // 2 for k in self._h2h_kernel)
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=ng * self._hidden_channels)
+        h2h = F.Convolution(h, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=hpad,
+                            num_filter=ng * self._hidden_channels)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_ConvRNNCellBase):
+    _num_gates = 1
+    _state_names = ("h",)
+
+    def __init__(self, *args, activation="tanh", **kwargs):
+        self._activation = activation
+        super().__init__(*args, **kwargs)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvRNNCellBase):
+    _num_gates = 4
+    _state_names = ("h", "c")
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
         prev_h, prev_c = states
-        hpad = (self._h2h_kernel[0] // 2, self._h2h_kernel[1] // 2)
-        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
-                            kernel=self._i2h_kernel, pad=self._i2h_pad,
-                            num_filter=4 * self._hidden_channels)
-        h2h = F.Convolution(prev_h, h2h_weight, h2h_bias,
-                            kernel=self._h2h_kernel, pad=hpad,
-                            num_filter=4 * self._hidden_channels)
+        i2h, h2h = self._gates(F, inputs, prev_h, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
         gates = i2h + h2h
         i, f, g, o = F.SliceChannel(gates, num_outputs=4, axis=1)
         i = F.Activation(i, act_type="sigmoid")
@@ -102,3 +155,51 @@ class Conv2DLSTMCell(HybridRecurrentCell):
         next_c = f * prev_c + i * g
         next_h = o * F.Activation(next_c, act_type="tanh")
         return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_ConvRNNCellBase):
+    _num_gates = 3
+    _state_names = ("h",)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h = states[0]
+        i2h, h2h = self._gates(F, inputs, prev_h, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        i_r, i_z, i_n = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h_r, h_z, h_n = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        r = F.Activation(i_r + h_r, act_type="sigmoid")
+        z = F.Activation(i_z + h_z, act_type="sigmoid")
+        n = F.Activation(i_n + r * h_n, act_type="tanh")
+        next_h = (1 - z) * n + z * prev_h
+        return next_h, [next_h]
+
+
+def _conv_cell(base, dims, doc):
+    class Cell(base):
+        __doc__ = doc
+
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad=i2h_pad, conv_dims=dims,
+                             **kwargs)
+    return Cell
+
+
+Conv1DRNNCell = _conv_cell(_ConvRNNCell, 1, "1-D convolutional RNN cell (reference: contrib.rnn.Conv1DRNNCell).")
+Conv2DRNNCell = _conv_cell(_ConvRNNCell, 2, "2-D convolutional RNN cell (reference: contrib.rnn.Conv2DRNNCell).")
+Conv3DRNNCell = _conv_cell(_ConvRNNCell, 3, "3-D convolutional RNN cell (reference: contrib.rnn.Conv3DRNNCell).")
+Conv1DLSTMCell = _conv_cell(_ConvLSTMCell, 1, "1-D convolutional LSTM cell (reference: contrib.rnn.Conv1DLSTMCell).")
+Conv2DLSTMCell = _conv_cell(_ConvLSTMCell, 2, "2-D convolutional LSTM cell (Shi et al. 2015; reference: contrib.rnn.Conv2DLSTMCell).")
+Conv3DLSTMCell = _conv_cell(_ConvLSTMCell, 3, "3-D convolutional LSTM cell (reference: contrib.rnn.Conv3DLSTMCell).")
+Conv1DGRUCell = _conv_cell(_ConvGRUCell, 1, "1-D convolutional GRU cell (reference: contrib.rnn.Conv1DGRUCell).")
+Conv2DGRUCell = _conv_cell(_ConvGRUCell, 2, "2-D convolutional GRU cell (reference: contrib.rnn.Conv2DGRUCell).")
+Conv3DGRUCell = _conv_cell(_ConvGRUCell, 3, "3-D convolutional GRU cell (reference: contrib.rnn.Conv3DGRUCell).")
+
+for _c, _n in [(Conv1DRNNCell, "Conv1DRNNCell"), (Conv2DRNNCell, "Conv2DRNNCell"),
+               (Conv3DRNNCell, "Conv3DRNNCell"), (Conv1DLSTMCell, "Conv1DLSTMCell"),
+               (Conv2DLSTMCell, "Conv2DLSTMCell"), (Conv3DLSTMCell, "Conv3DLSTMCell"),
+               (Conv1DGRUCell, "Conv1DGRUCell"), (Conv2DGRUCell, "Conv2DGRUCell"),
+               (Conv3DGRUCell, "Conv3DGRUCell")]:
+    _c.__name__ = _c.__qualname__ = _n

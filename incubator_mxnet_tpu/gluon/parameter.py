@@ -84,6 +84,8 @@ class Parameter:
         data = NDArray(jnp.zeros(self.shape, _dtype(self.dtype)))
         desc = init.InitDesc(self.name, {"__init__": ""})
         actual = initializer if initializer is not None else (self.init or default_init)
+        if isinstance(actual, str):   # e.g. Parameter(init="zeros")
+            actual = init.create(actual)
         actual(desc, data)
         data._data = data._data.astype(_dtype(self.dtype))
         self._set_data_arr(data)

@@ -3,4 +3,5 @@
 from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
                        GRUCell, SequentialRNNCell, HybridSequentialRNNCell,
-                       DropoutCell, ZoneoutCell, ResidualCell, BidirectionalCell)
+                       DropoutCell, ModifierCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell)

@@ -463,9 +463,20 @@ def _wrap_outputs(outs, node):
     return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
 
 
+def _prof_scope(name):
+    """Profiler op scope when profiling is on, else a no-op context."""
+    from .. import profiler as _prof
+    if _prof.is_profiling_ops():
+        return _prof.record_op(name)
+    import contextlib
+    return contextlib.nullcontext()
+
+
 def _invoke_simple(fn, *arrays, op_name=None):
     """Invoke a jax-traceable fn over NDArray args (all positional arrays)."""
-    outs, node = _ag.record_op(fn, list(arrays), op_name or getattr(fn, "__name__", "op"))
+    name = op_name or getattr(fn, "__name__", "op")
+    with _prof_scope(name):
+        outs, node = _ag.record_op(fn, list(arrays), name)
     return _wrap_outputs(outs, node)
 
 
@@ -492,7 +503,8 @@ def _invoke_op(name, args, kwargs):
             vi += 1
         return fn(*new_args, **new_kw)
 
-    outs, node = _ag.record_op(closure, arrays, info.name)
+    with _prof_scope(info.name):
+        outs, node = _ag.record_op(closure, arrays, info.name)
     result = _wrap_outputs(outs, node)
     if out_arg is not None:
         if isinstance(result, tuple):

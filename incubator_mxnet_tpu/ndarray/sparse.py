@@ -169,3 +169,40 @@ def zeros(stype, shape, ctx=None, dtype=None):
                           _np.zeros((shape[0] + 1,), _np.int32), shape)
     from .ndarray import zeros as _z
     return _z(shape, ctx=ctx, dtype=dtype)
+
+
+def add(lhs, rhs):
+    """Elementwise add with sparse-aware result storage (reference:
+    mx.nd.sparse.add — rsp+rsp stays row_sparse, anything else densifies)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        idx = _np.union1d(_np.asarray(lhs._sp_indices),
+                          _np.asarray(rhs._sp_indices)).astype(_np.int32)
+        dense = _np.asarray(lhs._data) + _np.asarray(rhs._data)
+        return RowSparseNDArray(dense[idx], idx, lhs._sp_shape)
+    lv = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
+    rv = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
+    return lv + rv
+
+
+def subtract(lhs, rhs):
+    """See ``add``."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        idx = _np.union1d(_np.asarray(lhs._sp_indices),
+                          _np.asarray(rhs._sp_indices)).astype(_np.int32)
+        dense = _np.asarray(lhs._data) - _np.asarray(rhs._data)
+        return RowSparseNDArray(dense[idx], idx, lhs._sp_shape)
+    lv = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
+    rv = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
+    return lv - rv
+
+
+def multiply(lhs, rhs):
+    """Elementwise multiply; rsp*rsp intersects row sets."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        idx = _np.intersect1d(_np.asarray(lhs._sp_indices),
+                              _np.asarray(rhs._sp_indices)).astype(_np.int32)
+        dense = _np.asarray(lhs._data) * _np.asarray(rhs._data)
+        return RowSparseNDArray(dense[idx], idx, lhs._sp_shape)
+    lv = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
+    rv = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
+    return lv * rv

@@ -155,10 +155,7 @@ class ShardedTrainer:
                 _trace_state.ctx = ctx
                 try:
                     out = block.forward(*data)
-                    if callable(loss_block) and not hasattr(loss_block, "forward"):
-                        loss = loss_block(out, *label)
-                    else:
-                        loss = loss_block(out, *label)
+                    loss = loss_block(out, *label)
                     loss = jnp.mean(loss.astype(jnp.float32))
                 finally:
                     _trace_state.ctx = prev

@@ -179,3 +179,34 @@ def scope(name):
 
 
 atexit.register(lambda: dump() if _events and _config.get("continuous_dump") else None)
+
+
+def record_op(name):
+    """Context manager used by the NDArray dispatch path to record one op
+    event (reference: OprBlock::opr_profile start/stop from the engine,
+    src/engine/threaded_engine.h:84). Cheap no-op when not profiling."""
+    return _OpScope(name)
+
+
+class _OpScope:
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *a):
+        if _state["running"] and (_config.get("profile_all")
+                                  or _config.get("profile_imperative")):
+            t1 = time.time()
+            _record("operator", self.name, ts=self._t0 * 1e6,
+                    dur=(t1 - self._t0) * 1e6)
+
+
+def is_profiling_ops():
+    """Fast gate for the dispatch hot path."""
+    return _state["running"] and (_config.get("profile_all")
+                                  or _config.get("profile_imperative"))

@@ -1,0 +1,82 @@
+"""Conv RNN cell family, sparse elementwise ops, profiler op recording.
+
+Reference model: tests/python/unittest/test_gluon_contrib.py (conv cells)
+and test_profiler.py.
+"""
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+
+def _run_cell(cell, shape, batch=2):
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(batch, *shape).astype(np.float32))
+    out, states = cell(x, cell.begin_state(batch_size=batch))
+    return out, states
+
+
+def test_conv_rnn_cells_all_dims():
+    cases = [
+        (mx.gluon.contrib.rnn.Conv1DRNNCell, (4, 16), 1),
+        (mx.gluon.contrib.rnn.Conv2DRNNCell, (4, 8, 8), 1),
+        (mx.gluon.contrib.rnn.Conv1DLSTMCell, (4, 16), 2),
+        (mx.gluon.contrib.rnn.Conv2DLSTMCell, (4, 8, 8), 2),
+        (mx.gluon.contrib.rnn.Conv3DLSTMCell, (2, 4, 4, 4), 2),
+        (mx.gluon.contrib.rnn.Conv1DGRUCell, (4, 16), 1),
+        (mx.gluon.contrib.rnn.Conv2DGRUCell, (4, 8, 8), 1),
+    ]
+    for C, shape, n_states in cases:
+        out, states = _run_cell(C(shape, 6, 3, 3, i2h_pad=1), shape)
+        assert out.shape == (2, 6) + shape[1:], C.__name__
+        assert len(states) == n_states, C.__name__
+
+
+def test_conv_lstm_unroll_in_scan():
+    cell = mx.gluon.contrib.rnn.Conv2DLSTMCell((3, 8, 8), 5, 3, 3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    seq = [mx.nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+           for _ in range(4)]
+    outputs, states = cell.unroll(4, seq, layout="TNC", merge_outputs=False)
+    assert len(outputs) == 4
+    assert outputs[0].shape == (2, 5, 8, 8)
+
+
+def test_modifier_cell_exported():
+    assert issubclass(mx.gluon.rnn.ZoneoutCell, mx.gluon.rnn.ModifierCell)
+
+
+def test_sparse_elementwise():
+    a = mx.nd.sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), [0, 2]), shape=(4, 3))
+    b = mx.nd.sparse.row_sparse_array(
+        (np.full((2, 3), 2.0, np.float32), [1, 2]), shape=(4, 3))
+    c = mx.nd.sparse.add(a, b)
+    assert c.stype == "row_sparse"
+    want = a.asnumpy() + b.asnumpy()
+    np.testing.assert_allclose(c.asnumpy(), want)
+    d = mx.nd.sparse.subtract(a, b)
+    np.testing.assert_allclose(d.asnumpy(), a.asnumpy() - b.asnumpy())
+    m = mx.nd.sparse.multiply(a, b)
+    np.testing.assert_allclose(m.asnumpy(), a.asnumpy() * b.asnumpy())
+
+
+def test_profiler_records_ops():
+    mx.profiler.set_config(profile_all=True, filename="/tmp/_prof_test.json")
+    mx.profiler.start()
+    a = mx.nd.ones((8, 8))
+    b = mx.nd.dot(a, a)
+    b.asnumpy()
+    mx.profiler.stop()
+    table = mx.profiler.dumps()
+    assert "dot" in table
+    mx.profiler.dump()
+    import json
+    trace = json.load(open("/tmp/_prof_test.json"))
+    assert any(e.get("name") == "dot" for e in trace["traceEvents"])
+
+
+def test_parameter_string_init():
+    p = mx.gluon.Parameter("w", shape=(3, 3), init="zeros")
+    p.initialize()
+    np.testing.assert_allclose(p.data().asnumpy(), 0.0)

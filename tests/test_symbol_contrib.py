@@ -67,17 +67,20 @@ def test_check_symbolic_forward_backward():
 
 
 def test_fused_rnn_initializer_packs_lstm():
-    h, i = 4, 3
-    size = 4 * h * i + 4 * h * h + 2 * 4 * h
+    from incubator_mxnet_tpu.ops.rnn import unpack_rnn_params, rnn_param_size
+    h, i, L = 4, 3, 2
+    size = rnn_param_size(i, h, L, "lstm", bidirectional=True)
     arr = mx.nd.zeros((size,))
-    init = mx.init.FusedRNN(mx.init.Xavier(), num_hidden=h, num_layers=1,
-                            mode="lstm", forget_bias=1.0)
+    init = mx.init.FusedRNN(mx.init.Xavier(), num_hidden=h, num_layers=L,
+                            mode="lstm", bidirectional=True, forget_bias=1.0)
     init("rnn_parameters_weight", arr)
-    v = arr.asnumpy()
-    assert np.abs(v[:4 * h * i]).sum() > 0          # W_x filled
-    bias = v[4 * h * i + 4 * h * h:]
-    # forget gate rows carry forget_bias/2 in each of b_x, b_h
-    np.testing.assert_allclose(bias.sum(), 1.0 * h)
+    layers = unpack_rnn_params(arr._data, i, h, L, "lstm", bidirectional=True)
+    for dirs in layers:
+        for pr in dirs:
+            bx = np.asarray(pr["bx"])
+            np.testing.assert_allclose(bx[h:2 * h], 0.5)   # forget_bias/2
+            np.testing.assert_allclose(bx[:h], 0.0)
+            assert np.abs(np.asarray(pr["wx"])).sum() > 0
 
 
 def test_executor_manager_split_and_group():
