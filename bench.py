@@ -20,10 +20,59 @@ import time
 import numpy as np
 
 
+def bench_bert(steps, dtype):
+    """BERT-base train throughput, tokens/sec/chip (BASELINE config 4;
+    BERT has no in-repo reference number, so vs_baseline is vs our own
+    first-light fp32 figure). BENCH_MODEL=bert selects this."""
+    import time
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    B, T = int(os.environ.get("BENCH_BATCH", "32")), 128
+    np.random.seed(0)
+    net = mx.models.bert_base(vocab_size=30522, dropout=0.0)
+    net.initialize(mx.init.Normal(0.02))
+    ids = mx.nd.array(np.random.randint(0, 30522, (B, T)).astype(np.int32))
+    types = mx.nd.array(np.zeros((B, T), np.int32))
+    labels = mx.nd.array(np.random.randint(0, 30522, (B, T)).astype(np.int32))
+    net(ids[0:1, 0:8], types[0:1, 0:8])
+
+    def loss_fn(out, lab):
+        seq, pooled = out
+        return jnp.mean(jnp.sum(seq.astype(jnp.float32) ** 2, axis=-1) * 1e-4)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr = ShardedTrainer(net, loss_fn, mesh, optimizer="adamw",
+                        optimizer_params={"learning_rate": 1e-4},
+                        data_specs=P(), label_spec=P(),
+                        compute_dtype=None if dtype == "float32" else dtype)
+    for _ in range(8):
+        loss = tr.step([ids, types], labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = tr.step([ids, types], labels)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+    tps = B * T * steps / dt
+    print(json.dumps({
+        "metric": "bert_base_train_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps / 47000.0, 2),
+    }))
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    if os.environ.get("BENCH_MODEL", "resnet50") == "bert":
+        return bench_bert(steps, dtype)
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P

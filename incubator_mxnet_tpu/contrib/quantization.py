@@ -305,14 +305,14 @@ def quantize_model(sym=None, arg_params=None, aux_params=None,
                   for k, v in {**arg_params, **aux_params}.items()}
         if num_calib_examples is not None:
             # reference semantics: example count / batch size -> batch count
-            bs = None
-            first = calib_data[0] if isinstance(calib_data, (list, tuple)) \
-                else None
-            if first is not None:
-                arr = first[0] if isinstance(first, (list, tuple)) else first
+            bs = getattr(calib_data, "batch_size", None)   # DataIter
+            if bs is None and isinstance(calib_data, (list, tuple)) \
+                    and calib_data:
+                arr = calib_data[0]
+                arr = arr[0] if isinstance(arr, (list, tuple)) else arr
                 if hasattr(arr, "shape") and len(arr.shape) > 0:
                     bs = int(arr.shape[0])
-            num_calib_batches = max(1, num_calib_examples // (bs or 1))
+            num_calib_batches = max(1, num_calib_examples // int(bs or 1))
         thresholds = calibrate_symbol(
             sym, params, calib_data, data_names=data_names,
             calib_mode=calib_mode,

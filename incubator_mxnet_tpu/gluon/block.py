@@ -475,10 +475,17 @@ class HybridBlock(Block):
         json_str = block_to_json(self)
         with open("%s-symbol.json" % path, "w") as f:
             f.write(json_str)
-        params = self._collect_params_with_prefix()
+        # keys must match the symbol's argument/aux names (the reference
+        # writes arg:/aux:<full param name>), or SymbolBlock.imports and
+        # model.load_checkpoint cannot rebind them.
         from ..ndarray import save as nd_save
-        nd_save("%s-%04d.params" % (path, epoch),
-                {"arg:" + k: v.data() for k, v in params.items()})
+        out = {}
+        for p in self.collect_params().values():
+            if p._data is None:
+                continue
+            tag = "aux:" if getattr(p, "_aux", False) else "arg:"
+            out[tag + p.name] = p.data()
+        nd_save("%s-%04d.params" % (path, epoch), out)
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         self.hybridize(True)

@@ -148,7 +148,7 @@ class ImageRecordDataset(RecordFileDataset):
         self._transform = transform
 
     def __getitem__(self, idx):
-        from ...recordio import unpack_img
+        from incubator_mxnet_tpu.recordio import unpack_img
         record = super().__getitem__(idx)
         header, img = unpack_img(record, self._flag)
         label = header.label

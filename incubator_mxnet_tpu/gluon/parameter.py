@@ -29,6 +29,10 @@ class Parameter:
                  differentiable=True, stype="default", grad_stype="default"):
         self.name = name
         self._grad_req = grad_req if differentiable else "null"
+        # construction-time role: auxiliary state (running stats etc.) vs a
+        # weight the user may later freeze with grad_req="null" — export and
+        # symbol tracing need the role, not the current grad_req.
+        self._aux = not differentiable
         if isinstance(shape, int):
             shape = (shape,)
         self.shape = tuple(shape) if shape is not None else None

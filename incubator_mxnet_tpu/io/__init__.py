@@ -9,8 +9,8 @@ threads of the reference's ImageRecordIter map to DataLoader workers.
 
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter,
-                 LibSVMIter)
+                 LibSVMIter, ImageDetRecordIter, MXDataIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter"]
+           "LibSVMIter", "ImageDetRecordIter", "MXDataIter"]

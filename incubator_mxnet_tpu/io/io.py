@@ -10,7 +10,7 @@ from ..ndarray import NDArray, array as nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter"]
+           "LibSVMIter", "ImageDetRecordIter", "MXDataIter"]
 
 
 class DataDesc:
@@ -387,12 +387,17 @@ class ImageRecordIter(DataIter):
             if self._rand_mirror and _np.random.rand() < 0.5:
                 img = img[:, ::-1]
             img = (img - self._mean) / self._std
-            return _np.ascontiguousarray(img.transpose(2, 0, 1)), _np.float32(label)
+            return (_np.ascontiguousarray(img.transpose(2, 0, 1)),
+                    self._label_transform(label))
 
         self._loader = DataLoader(dataset.transform(transform), batch_size,
                                   shuffle=shuffle, num_workers=0,
                                   last_batch="discard" if not round_batch else "rollover")
         self._it = iter(self._loader)
+
+    def _label_transform(self, label):
+        """Per-sample label mapping; subclasses (detection) override."""
+        return _np.float32(label)
 
     @property
     def provide_data(self):
@@ -411,3 +416,74 @@ class ImageRecordIter(DataIter):
         except StopIteration:
             raise
         return DataBatch(data=[data], label=[label], pad=0)
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection RecordIO iterator (reference: iter_image_det_recordio.cc
+    ImageDetRecordIter): per-image labels are variable-length object lists
+    [header..., (cls, xmin, ymin, xmax, ymax) * n], padded with -1 into a
+    fixed (batch, max_objects, 5) tensor so the compiled step sees static
+    shapes (the TPU version of the reference's padded DataBatch)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=-1,
+                 label_pad_width=-1, label_pad_value=-1.0, object_width=5,
+                 has_header=True, **kwargs):
+        self._object_width = int(object_width)
+        self._label_pad_width = int(label_pad_width)
+        self._label_pad_value = float(label_pad_value)
+        self._has_header = bool(has_header)
+        if self._label_pad_width <= 0:
+            # one cheap header-only scan to find max objects/record so every
+            # batch has one static shape (the reference errors instead when
+            # label_pad_width is unset and counts vary)
+            self._label_pad_width = max(
+                1, self._scan_max_objects(path_imgrec))
+        super().__init__(path_imgrec, data_shape, batch_size,
+                         label_width=label_width, **kwargs)
+
+    def _parse(self, raw):
+        """Split a flat detection label into (object_width, objects-array).
+        Header format (im2rec detection): [header_width, object_width,
+        extras..., objects...]; ``has_header=False`` = raw object list."""
+        ow = self._object_width
+        flat = _np.asarray(raw, dtype=_np.float32).ravel()
+        if self._has_header and flat.size >= 2:
+            hw = int(flat[0])
+            ow = int(flat[1])
+            flat = flat[hw:]
+        n = flat.size // ow
+        return ow, flat[:n * ow].reshape(n, ow)
+
+    def _scan_max_objects(self, path_imgrec):
+        from ..recordio import MXRecordIO, unpack
+        r = MXRecordIO(path_imgrec, "r")
+        max_n = 0
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            header, _ = unpack(rec)
+            _, objs = self._parse(header.label)
+            max_n = max(max_n, objs.shape[0])
+        r.close()
+        return max_n
+
+    def _label_transform(self, raw):
+        """Per-sample: parse the flat detection label and pad to a fixed
+        (max_objects, object_width) block so batches have static shape."""
+        ow, objs = self._parse(raw)
+        n = objs.shape[0]
+        max_obj = self._label_pad_width
+        out = _np.full((max_obj, ow), self._label_pad_value, _np.float32)
+        out[:min(n, max_obj)] = objs[:max_obj]
+        return out
+
+    @property
+    def provide_label(self):
+        width = self._label_pad_width if self._label_pad_width > 0 else 1
+        return [DataDesc("label", (self.batch_size, width, self._object_width))]
+
+
+# C-backed iterator name kept for API parity: in this build every iterator
+# is already host-native (the RecordIO parser is the C++ one in native/).
+MXDataIter = DataIter

@@ -22,7 +22,8 @@ from ..ops import control_flow as _cf
 from .ndarray import NDArray, _invoke_simple, _invoke_op
 
 __all__ = ["foreach", "while_loop", "cond", "boolean_mask", "index_copy",
-           "arange_like"]
+           "arange_like", "edge_id", "dgl_adjacency", "dgl_subgraph",
+           "dgl_csr_neighbor_uniform_sample", "dgl_graph_compact"]
 
 
 def _as_list(x):
@@ -191,3 +192,153 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
             return seq(d.size).reshape(d.shape)
         return seq(d.shape[axis])
     return _invoke_simple(f, data, op_name="arange_like")
+
+
+# ---------------------------------------------------------------------------
+# DGL graph ops (reference: src/operator/contrib/dgl_graph.cc — CSR neighbor
+# sampling, vertex-induced subgraphs, edge ids, adjacency, graph compaction).
+#
+# TPU-first note: graph sampling is dynamic-shape, data-dependent host work —
+# in the reference it runs as CPU-only kernels feeding the trainer; here it
+# runs as numpy host ops producing padded CSRNDArray/NDArray results the
+# compiled step can consume (same split the reference makes).
+# ---------------------------------------------------------------------------
+
+def _csr_parts(csr):
+    from .sparse import CSRNDArray
+    if not isinstance(csr, CSRNDArray):
+        raise TypeError("expected CSRNDArray, got %s" % type(csr).__name__)
+    return (_np.asarray(csr._sp_data), _np.asarray(csr._sp_indices),
+            _np.asarray(csr._sp_indptr), csr._sp_shape)
+
+
+def edge_id(csr, u, v):
+    """Edge data value for each (u[i], v[i]) pair, -1 where absent
+    (reference: _contrib_edge_id)."""
+    data, indices, indptr, shape = _csr_parts(csr)
+    uu = _np.asarray(u.asnumpy() if isinstance(u, NDArray) else u).astype(_np.int64)
+    vv = _np.asarray(v.asnumpy() if isinstance(v, NDArray) else v).astype(_np.int64)
+    out = _np.full(uu.shape, -1.0, dtype=_np.float32)
+    for i, (a, b) in enumerate(zip(uu.ravel(), vv.ravel())):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = _np.nonzero(row == b)[0]
+        if hit.size:
+            out.ravel()[i] = data[indptr[a] + hit[0]]
+    from .ndarray import array as nd_array
+    return nd_array(out)
+
+
+def dgl_adjacency(csr):
+    """Adjacency matrix of the graph: same structure, all-ones data
+    (reference: _contrib_dgl_adjacency)."""
+    from .sparse import CSRNDArray
+    data, indices, indptr, shape = _csr_parts(csr)
+    return CSRNDArray(_np.ones_like(data, dtype=_np.float32), indices,
+                      indptr, shape)
+
+
+def dgl_subgraph(graph, *vids, return_mapping=False):
+    """Vertex-induced subgraph(s) (reference: _contrib_dgl_subgraph).
+
+    ``vids``: one or more 1-D vertex-id arrays. Returns one CSRNDArray per
+    vid set (plus, if return_mapping, one CSR whose data are the ORIGINAL
+    edge ids, for looking up edge features)."""
+    from .sparse import CSRNDArray
+    data, indices, indptr, shape = _csr_parts(graph)
+    outs, mappings = [], []
+    for vid in vids:
+        v = _np.asarray(vid.asnumpy() if isinstance(vid, NDArray) else vid
+                        ).astype(_np.int64).ravel()
+        n = v.size
+        old2new = {int(o): i for i, o in enumerate(v)}
+        new_indptr = _np.zeros(n + 1, dtype=_np.int32)
+        new_indices, new_data, new_eid = [], [], []
+        for i, o in enumerate(v):
+            for p in range(indptr[o], indptr[o + 1]):
+                dst = int(indices[p])
+                if dst in old2new:
+                    new_indices.append(old2new[dst])
+                    new_data.append(1.0)
+                    new_eid.append(data[p])
+            new_indptr[i + 1] = len(new_indices)
+        outs.append(CSRNDArray(_np.asarray(new_data, _np.float32),
+                               _np.asarray(new_indices, _np.int32),
+                               new_indptr, (n, n)))
+        if return_mapping:   # CSRNDArray materializes dense — build lazily
+            mappings.append(CSRNDArray(_np.asarray(new_eid, _np.float32),
+                                       _np.asarray(new_indices, _np.int32),
+                                       new_indptr, (n, n)))
+    res = outs + (mappings if return_mapping else [])
+    return res if len(res) > 1 else res[0]
+
+
+def dgl_csr_neighbor_uniform_sample(csr, seeds, num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100, rng=None):
+    """Uniform neighbor sampling from seed vertices (reference:
+    _contrib_dgl_csr_neighbor_uniform_sample).
+
+    Returns (sampled_vertices, subgraph_csr, layer) where sampled_vertices
+    is padded to ``max_num_vertices`` with -1 and its first element count is
+    the number of valid vertices; layer[i] is the BFS hop of vertex i."""
+    data, indices, indptr, shape = _csr_parts(csr)
+    rng = rng or _np.random
+    sv = _np.asarray(seeds.asnumpy() if isinstance(seeds, NDArray) else seeds
+                     ).astype(_np.int64).ravel()
+    sv = sv[sv >= 0][:max_num_vertices]
+    visited = {int(s): 0 for s in sv}
+    frontier = list(sv)
+    edges = []   # (src, dst, edge_val)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for u in frontier:
+            row = _np.arange(indptr[u], indptr[u + 1])
+            if row.size > num_neighbor:
+                row = rng.choice(row, num_neighbor, replace=False)
+            for p in row:
+                dst = int(indices[p])
+                edges.append((u, dst, data[p]))
+                if dst not in visited and len(visited) < max_num_vertices:
+                    visited[dst] = hop
+                    nxt.append(dst)
+        frontier = nxt
+    verts = list(visited)
+    old2new = {o: i for i, o in enumerate(verts)}
+    n = len(verts)
+    rows = [[] for _ in range(n)]
+    for (u, dst, val) in edges:
+        if u in old2new and dst in old2new:
+            rows[old2new[u]].append((old2new[dst], val))
+    new_indptr = _np.zeros(n + 1, dtype=_np.int32)
+    new_indices, new_data = [], []
+    for i, r in enumerate(rows):
+        for (j, val) in sorted(r):
+            new_indices.append(j)
+            new_data.append(val)
+        new_indptr[i + 1] = len(new_indices)
+    from .sparse import CSRNDArray
+    from .ndarray import array as nd_array
+    sub = CSRNDArray(_np.asarray(new_data, _np.float32),
+                     _np.asarray(new_indices, _np.int32), new_indptr, (n, n))
+    padded = _np.full(max_num_vertices, -1, dtype=_np.int64)
+    padded[:n] = verts
+    layer = _np.full(max_num_vertices, -1, dtype=_np.int64)
+    layer[:n] = [visited[o] for o in verts]
+    return nd_array(padded), sub, nd_array(layer)
+
+
+def dgl_graph_compact(*subgraphs, graph_sizes=None, return_mapping=False):
+    """Remove padded (isolated, id -1) vertices from sampled subgraphs
+    (reference: _contrib_dgl_graph_compact). ``graph_sizes[i]`` = number of
+    valid vertices of subgraph i."""
+    from .sparse import CSRNDArray
+    if graph_sizes is None:
+        raise ValueError("graph_sizes is required")
+    outs = []
+    for g, size in zip(subgraphs, graph_sizes):
+        data, indices, indptr, shape = _csr_parts(g)
+        size = int(size)
+        new_indptr = indptr[:size + 1]
+        nnz = int(new_indptr[-1])
+        outs.append(CSRNDArray(data[:nnz], indices[:nnz], new_indptr,
+                               (size, size)))
+    return outs if len(outs) > 1 else outs[0]

@@ -7,6 +7,7 @@ are subsumed; ``wait_to_read`` maps to ``block_until_ready``).
 """
 
 import builtins
+import contextlib as _contextlib
 
 import numpy as _np
 import jax
@@ -463,13 +464,20 @@ def _wrap_outputs(outs, node):
     return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
 
 
+_prof_mod = None
+_NULL_CTX = _contextlib.nullcontext()
+
+
 def _prof_scope(name):
-    """Profiler op scope when profiling is on, else a no-op context."""
-    from .. import profiler as _prof
-    if _prof.is_profiling_ops():
-        return _prof.record_op(name)
-    import contextlib
-    return contextlib.nullcontext()
+    """Profiler op scope when profiling is on, else a shared no-op context
+    (kept to one cached-module boolean check on the eager hot path)."""
+    global _prof_mod
+    if _prof_mod is None:
+        from .. import profiler
+        _prof_mod = profiler
+    if _prof_mod.is_profiling_ops():
+        return _prof_mod.record_op(name)
+    return _NULL_CTX
 
 
 def _invoke_simple(fn, *arrays, op_name=None):

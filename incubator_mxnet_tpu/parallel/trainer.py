@@ -84,12 +84,15 @@ class ShardedTrainer:
         self._opt_state = self._init_opt_state()
 
         dp_in_mesh = dp_axis in mesh.axis_names
-        self._data_sharding = NamedSharding(
-            mesh, data_specs if data_specs is not None
-            else (P(dp_axis) if dp_in_mesh else P()))
+        default_spec = P(dp_axis) if dp_in_mesh else P()
+        if data_specs is None:
+            data_specs = default_spec
+        if isinstance(data_specs, (list, tuple)):
+            self._data_shardings = [NamedSharding(mesh, s) for s in data_specs]
+        else:
+            self._data_shardings = NamedSharding(mesh, data_specs)
         self._label_sharding = NamedSharding(
-            mesh, label_spec if label_spec is not None
-            else (P(dp_axis) if dp_in_mesh else P()))
+            mesh, label_spec if label_spec is not None else default_spec)
         self._jit_step = None
 
     # ------------------------------------------------------------------ opt
@@ -188,7 +191,15 @@ class ShardedTrainer:
                  for d in datas]
         labels = [l._data if isinstance(l, NDArray) else jnp.asarray(l)
                   for l in labels]
-        datas = [jax.device_put(d, self._data_sharding) for d in datas]
+        if isinstance(self._data_shardings, list):
+            if len(self._data_shardings) != len(datas):
+                raise ValueError("data_specs has %d entries but step got %d "
+                                 "data arrays" % (len(self._data_shardings),
+                                                  len(datas)))
+            datas = [jax.device_put(d, s)
+                     for d, s in zip(datas, self._data_shardings)]
+        else:
+            datas = [jax.device_put(d, self._data_shardings) for d in datas]
         labels = [jax.device_put(l, self._label_sharding) for l in labels]
         if self._jit_step is None:
             self._jit_step = self._build(len(datas))

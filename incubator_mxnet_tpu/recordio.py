@@ -126,13 +126,35 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
-        if not self.writable and os.path.isfile(self.idx_path):
-            with open(self.idx_path) as fin:
-                for line in fin:
-                    line = line.strip().split("\t")
-                    key = self.key_type(line[0])
-                    self.idx[key] = int(line[1])
-                    self.keys.append(key)
+        if not self.writable:
+            if os.path.isfile(self.idx_path):
+                with open(self.idx_path) as fin:
+                    for line in fin:
+                        line = line.strip().split("\t")
+                        key = self.key_type(line[0])
+                        self.idx[key] = int(line[1])
+                        self.keys.append(key)
+            else:
+                self._build_index()
+
+    def _build_index(self):
+        """No .idx sidecar: scan the record stream once and index records
+        sequentially (reference behavior is to require im2rec's .idx; auto-
+        indexing keeps ad-hoc .rec files usable)."""
+        i = 0
+        while True:
+            pos = self.tell()
+            if self.read() is None:
+                break
+            key = self.key_type(i)
+            self.idx[key] = pos
+            self.keys.append(key)
+            i += 1
+        # rewind the underlying stream
+        if getattr(self, "_native", None) is not None:
+            self._native.seek(0)
+        else:
+            self.handle.seek(0)
 
     def close(self):
         if self.handle is None:

@@ -612,7 +612,7 @@ def block_to_json(block, input_names=("data",)):
     param_map = {}
     for name, p in params.items():
         v = var(name)
-        if p.grad_req == "null":
+        if getattr(p, "_aux", False):
             v._attrs["__aux__"] = True
         param_map[name] = v
     inputs = [var(n) for n in input_names]

@@ -1,0 +1,68 @@
+"""Checkpoint/resume formats (SURVEY §5): gluon save/load_parameters,
+HybridBlock.export + SymbolBlock.imports, Module save/load_checkpoint."""
+
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def test_gluon_params_roundtrip(tmp_path):
+    net = mx.models.lenet5()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(2, 1, 28, 28).astype(np.float32))
+    out1 = net(x).asnumpy()
+    p = str(tmp_path / "p.params")
+    net.save_parameters(p)
+    net2 = mx.models.lenet5()
+    net2.load_parameters(p)
+    np.testing.assert_allclose(net2(x).asnumpy(), out1, rtol=1e-6)
+
+
+def test_export_symbolblock_roundtrip(tmp_path):
+    net = mx.models.lenet5()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(2, 1, 28, 28).astype(np.float32))
+    out1 = net(x).asnumpy()
+    net.hybridize()
+    net(x)
+    base = str(tmp_path / "m")
+    net.export(base)
+    sb = mx.gluon.SymbolBlock.imports(base + "-symbol.json", ["data"],
+                                      base + "-0000.params")
+    np.testing.assert_allclose(sb(x).asnumpy(), out1, rtol=1e-4, atol=1e-4)
+
+
+def test_export_with_batchnorm_aux(tmp_path):
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(8), mx.gluon.nn.BatchNorm(),
+            mx.gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    out1 = net(x).asnumpy()          # inference stats path
+    net.hybridize()
+    net(x)
+    base = str(tmp_path / "bn")
+    net.export(base)
+    loaded = mx.nd.load(base + "-0000.params")
+    assert any(k.startswith("aux:") for k in loaded), sorted(loaded)
+    sb = mx.gluon.SymbolBlock.imports(base + "-symbol.json", ["data"],
+                                      base + "-0000.params")
+    np.testing.assert_allclose(sb(x).asnumpy(), out1, rtol=1e-4, atol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4, name="fc")
+    mod = mx.module.Module(sym, data_names=["data"], label_names=[])
+    mod.bind(data_shapes=[("data", (2, 8))])
+    mod.init_params(mx.init.Xavier())
+    base = str(tmp_path / "ck")
+    mod.save_checkpoint(base, 3)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(base, 3)
+    assert sorted(arg2) == ["fc_bias", "fc_weight"]
+    x = np.random.rand(2, 8).astype(np.float32)
+    out = sym2.eval(data=mx.nd.array(x), **{k: v for k, v in arg2.items()})
+    want = x @ arg2["fc_weight"].asnumpy().T + arg2["fc_bias"].asnumpy()
+    np.testing.assert_allclose(out[0].asnumpy(), want, rtol=1e-5)

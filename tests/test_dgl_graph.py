@@ -1,0 +1,62 @@
+"""DGL graph-sampling op suite (reference: src/operator/contrib/dgl_graph.cc,
+tested by tests/python/unittest/test_dgl_graph.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _ring(n=6):
+    rows = np.arange(n)
+    cols = (rows + 1) % n
+    indptr = np.arange(n + 1, dtype=np.int32)
+    order = np.argsort(rows, kind="stable")
+    return mx.nd.sparse.csr_matrix(
+        (np.arange(1, n + 1, dtype=np.float32), cols[order].astype(np.int32),
+         indptr), shape=(n, n))
+
+
+def test_edge_id():
+    g = _ring()
+    out = mx.nd.contrib.edge_id(g, np.array([0, 1, 2]), np.array([1, 2, 0]))
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0, -1.0])
+
+
+def test_dgl_adjacency():
+    g = _ring()
+    adj = mx.nd.contrib.dgl_adjacency(g)
+    np.testing.assert_allclose(adj.data.asnumpy(), 1.0)
+    assert adj.asnumpy().sum() == 6
+
+
+def test_dgl_subgraph_induced():
+    g = _ring()
+    sub, mapping = mx.nd.contrib.dgl_subgraph(g, np.array([0, 1, 2]),
+                                              return_mapping=True)
+    dense = sub.asnumpy()
+    assert dense[0, 1] == 1 and dense[1, 2] == 1
+    assert dense[2, 0] == 0            # 2->3 leaves the vertex set
+    # mapping holds ORIGINAL edge data values
+    np.testing.assert_allclose(mapping.asnumpy()[0, 1], 1.0)
+
+
+def test_neighbor_uniform_sample_bfs_layers():
+    g = _ring()
+    verts, sub, layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, np.array([0]), num_hops=2, num_neighbor=2, max_num_vertices=10)
+    v = verts.asnumpy()
+    l = layer.asnumpy()
+    assert v[0] == 0 and l[0] == 0
+    assert set(v[v >= 0]) == {0, 1, 2}
+    assert l[list(v).index(2)] == 2
+    n_valid = (v >= 0).sum()
+    assert sub.asnumpy().shape == (n_valid, n_valid)
+
+
+def test_graph_compact():
+    g = _ring()
+    _, sub, _ = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, np.array([0]), num_hops=1, num_neighbor=1, max_num_vertices=8)
+    comp = mx.nd.contrib.dgl_graph_compact(sub, graph_sizes=[2])
+    assert comp.asnumpy().shape == (2, 2)
