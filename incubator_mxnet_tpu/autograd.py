@@ -110,14 +110,17 @@ def predict_mode():
 class TapeNode:
     """One recorded op: parents + the vjp closure produced by jax.vjp."""
 
-    __slots__ = ("parents", "vjp_fn", "n_outputs", "out_templates", "op_name")
+    __slots__ = ("parents", "vjp_fn", "n_outputs", "out_templates", "op_name",
+                 "fn")
 
-    def __init__(self, parents, vjp_fn, n_outputs, out_templates, op_name=""):
+    def __init__(self, parents, vjp_fn, n_outputs, out_templates, op_name="",
+                 fn=None):
         self.parents = parents          # list of NDArray inputs (diff'able slots)
         self.vjp_fn = vjp_fn            # cotangents(outs) -> cotangents(parents)
         self.n_outputs = n_outputs
         self.out_templates = out_templates  # list of (shape, dtype) per output
         self.op_name = op_name
+        self.fn = fn                    # primal fn — create_graph re-vjps it
 
 
 def record_op(fn, arrays, op_name=""):
@@ -134,7 +137,8 @@ def record_op(fn, arrays, op_name=""):
     out, vjp_fn = jax.vjp(fn, *vals)
     outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
     templates = [(o.shape, o.dtype) for o in outs]
-    node = TapeNode(list(arrays), vjp_fn, len(outs), templates, op_name)
+    node = TapeNode(list(arrays), vjp_fn, len(outs), templates, op_name,
+                    fn=fn)
     return outs, node
 
 
@@ -194,9 +198,8 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
     from .ndarray import NDArray
 
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager autograd) is not supported "
-            "yet; use jax.grad composition via hybridize() for higher-order.")
+        return _backward_create_graph(heads, head_grads,
+                                      accumulate_to_leaves, variables)
     want = set(id(v) for v in variables) if variables is not None else None
     order = _topo_order(heads)
 
@@ -266,6 +269,107 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
     return results
 
 
+def _backward_create_graph(heads, head_grads, accumulate_to_leaves, variables):
+    """Higher-order backward: replay the tape's vjp closures THROUGH the
+    recording NDArray frontend, so every cotangent computation lands on the
+    tape and can itself be differentiated (reference: Imperative::Backward
+    with create_graph=true re-records the gradient graph). The graph is
+    implicitly retained (vjp closures stay alive inside the new tape nodes)."""
+    from .ndarray import NDArray
+    from .ndarray.ndarray import _invoke_simple
+
+    want = set(id(v) for v in variables) if variables is not None else None
+    order = _topo_order(heads)
+    node_ct = {}     # id(node) -> [NDArray or None] * n_outputs
+    leaf_ct = {}     # id(array) -> NDArray cotangent
+    leaf_map = {}
+
+    def add_ct(store, key, ct, slot=None):
+        if slot is None:
+            cur = store.get(key)
+            store[key] = ct if cur is None else cur + ct
+        else:
+            lst = store[key]
+            lst[slot] = ct if lst[slot] is None else lst[slot] + ct
+
+    with record():
+        for i, h in enumerate(heads):
+            if head_grads is not None and head_grads[i] is not None:
+                hg = head_grads[i] if isinstance(head_grads[i], NDArray) \
+                    else NDArray(jnp.asarray(head_grads[i]))
+            else:
+                hg = NDArray(jnp.ones(h.shape, h._data.dtype))
+            if h._node is not None:
+                node_ct.setdefault(id(h._node), [None] * h._node.n_outputs)
+                add_ct(node_ct, id(h._node), hg, slot=h._out_index)
+            elif h._requires_tape():
+                add_ct(leaf_ct, id(h), hg)
+                leaf_map[id(h)] = h
+
+        for node in reversed(order):
+            cts = node_ct.get(id(node))
+            if cts is None:
+                continue
+            full = [c if c is not None else
+                    NDArray(jnp.zeros(shape, dtype))
+                    for c, (shape, dtype) in zip(cts, node.out_templates)]
+            if node.fn is None:
+                raise NotImplementedError(
+                    "create_graph=True cannot differentiate through %r "
+                    "(custom Function / CachedOp tape nodes record no "
+                    "re-traceable primal); run the model un-hybridized or "
+                    "use jax.grad composition for higher-order gradients."
+                    % (node.op_name or "op"))
+            n_par = len(node.parents)
+            n_out = node.n_outputs
+
+            def apply_vjp(*vals, _fn=node.fn, _np=n_par, _n=n_out):
+                # recompute the vjp from the primal fn so the PRIMALS are
+                # tape inputs — gradients-of-gradients flow back into them
+                primals, ct_vals = vals[:_np], vals[_np:]
+                _, vjp = jax.vjp(_fn, *primals)
+                arg = tuple(ct_vals) if _n > 1 else ct_vals[0]
+                res = vjp(arg)
+                # single-cotangent results must stay a bare array so this
+                # node's own vjp (next derivative order) sees one output
+                return res if len(res) > 1 else res[0]
+
+            in_cts = _invoke_simple(apply_vjp, *(list(node.parents) + full),
+                                    op_name="_backward")
+            if isinstance(in_cts, NDArray):
+                in_cts = [in_cts]
+            for parent, ict in zip(node.parents, in_cts):
+                if ict is None or ict._data.dtype == jax.dtypes.float0:
+                    continue
+                if parent._node is not None:
+                    node_ct.setdefault(id(parent._node),
+                                       [None] * parent._node.n_outputs)
+                    add_ct(node_ct, id(parent._node), ict,
+                           slot=parent._out_index)
+                is_leaf = (parent._grad_req is not None
+                           and parent._grad_req != "null"
+                           and parent._node is None)
+                if is_leaf or (want is not None and id(parent) in want):
+                    add_ct(leaf_ct, id(parent), ict)
+                    leaf_map[id(parent)] = parent
+
+    if accumulate_to_leaves:
+        for key, ct in leaf_ct.items():
+            leaf = leaf_map[key]
+            if leaf._grad_req == "add" and leaf._grad is not None:
+                leaf._grad = leaf._grad + ct
+            else:
+                leaf._grad = ct   # tape-connected grad, differentiable again
+        return None
+
+    results = []
+    for v in variables:
+        ct = leaf_ct.get(id(v))
+        results.append(ct if ct is not None
+                       else NDArray(jnp.zeros(v.shape, v._data.dtype)))
+    return results
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Functional gradient: returns grads of heads w.r.t. variables without
@@ -289,7 +393,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     finally:
         for v, req in saved_reqs:
             v._grad_req = req
-    outs = [NDArray(r) for r in raw]
+    outs = [r if isinstance(r, NDArray) else NDArray(r) for r in raw]
     return outs[0] if single else outs
 
 

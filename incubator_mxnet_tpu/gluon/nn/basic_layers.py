@@ -201,11 +201,11 @@ class BatchNorm(HybridBlock):
             self.running_mean = self.params.get(
                 "running_mean", shape=(in_channels,),
                 init=_init_of(running_mean_initializer),
-                allow_deferred_init=True, differentiable=False)
+                allow_deferred_init=True, differentiable=False, aux=True)
             self.running_var = self.params.get(
                 "running_var", shape=(in_channels,),
                 init=_init_of(running_variance_initializer),
-                allow_deferred_init=True, differentiable=False)
+                allow_deferred_init=True, differentiable=False, aux=True)
 
     def _shape_hook(self, x, *args):
         c = x.shape[self._axis]

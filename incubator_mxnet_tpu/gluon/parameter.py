@@ -26,13 +26,15 @@ class DeferredInitializationError(MXNetError):
 class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype="float32",
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
-                 differentiable=True, stype="default", grad_stype="default"):
+                 differentiable=True, stype="default", grad_stype="default",
+                 aux=False):
         self.name = name
         self._grad_req = grad_req if differentiable else "null"
-        # construction-time role: auxiliary state (running stats etc.) vs a
-        # weight the user may later freeze with grad_req="null" — export and
-        # symbol tracing need the role, not the current grad_req.
-        self._aux = not differentiable
+        # explicit role flag: auxiliary state (running stats) vs a weight
+        # that is merely frozen (differentiable=False / grad_req="null").
+        # The reference keeps fix-gamma etc. as arg params; only moving_*
+        # stats are aux — export and symbol tracing need this distinction.
+        self._aux = bool(aux)
         if isinstance(shape, int):
             shape = (shape,)
         self.shape = tuple(shape) if shape is not None else None
@@ -229,6 +231,9 @@ class ParameterDict:
 
     def __iter__(self):
         return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
 
     def items(self):
         return self._params.items()

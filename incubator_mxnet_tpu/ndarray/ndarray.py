@@ -185,9 +185,11 @@ class NDArray:
         else:
             self._grad._data = ct.astype(self._grad._data.dtype)
 
-    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True,
+                 create_graph=False):
         _ag.backward([self], [out_grad] if out_grad is not None else None,
-                     retain_graph=retain_graph, train_mode=train_mode)
+                     retain_graph=retain_graph, train_mode=train_mode,
+                     create_graph=create_graph)
 
     def detach(self):
         return NDArray(self._data)

@@ -78,12 +78,12 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
 def make_ring_attention(mesh, seq_axis="sp", causal=False):
     """Return a jit-able attention fn over globally-sharded (B,H,T,D) arrays:
     shard_map'ing ring_attention over the sequence axis."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, seq_axis, None)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_rep=False)
+                       out_specs=spec, check_vma=False)
     def fn(q, k, v):
         return ring_attention(q, k, v, seq_axis, causal=causal)
 

@@ -26,8 +26,10 @@ import pytest
 
 @pytest.fixture(autouse=True)
 def _seed_everything():
-    """Deterministic per-test seeding (reference: with_seed decorator)."""
-    _np.random.seed(0)
+    """Deterministic per-test seeding (reference: with_seed decorator;
+    MXNET_TEST_SEED overrides, logged seed for repro)."""
+    seed = int(os.environ.get("MXNET_TEST_SEED", "0"))
+    _np.random.seed(seed)
     import incubator_mxnet_tpu as mx
-    mx.random.seed(0)
+    mx.random.seed(seed)
     yield

@@ -181,3 +181,52 @@ def test_softmax_output_grad():
     onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
     np.testing.assert_allclose(data.grad.asnumpy(), p - onehot, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_second_order_grad():
+    """create_graph=True: differentiate the gradient (reference:
+    test_autograd.py higher-order tests; imperative.cc:285)."""
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g = autograd.grad(y, [x], create_graph=True)[0]      # 3x^2
+        z = (g * g).sum()                                    # 9x^4
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [36.0 * 8], rtol=1e-5)
+
+
+def test_third_order_grad():
+    x = mx.nd.array(np.array([1.5], np.float32))
+    with autograd.record():
+        y = x * x * x * x
+        g1 = autograd.grad(y, [x], create_graph=True)[0]     # 4x^3
+        g2 = autograd.grad(g1, [x], create_graph=True)[0]    # 12x^2
+        g3 = autograd.grad(g2, [x])[0]                       # 24x
+    np.testing.assert_allclose(g3.asnumpy(), [36.0], rtol=1e-5)
+
+
+def test_backward_create_graph_grad_buffer_differentiable():
+    x = mx.nd.array(np.array([3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        y.backward(create_graph=True)                        # grad = 3x^2
+        h = (x.grad * x).sum()                               # 3x^3
+    g = autograd.grad(h, [x])[0]                             # 9x^2
+    np.testing.assert_allclose(g.asnumpy(), [81.0], rtol=1e-5)
+
+
+def test_second_order_sigmoid_matches_jax():
+    import jax
+    import jax.numpy as jnp
+    v = np.array([0.3, -0.7], np.float32)
+    x = mx.nd.array(v)
+    with autograd.record():
+        y = x.sigmoid().sum()
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        s = g1.sum()
+    g2 = autograd.grad(s, [x])[0]
+    want = jax.grad(lambda t: jax.grad(
+        lambda u: jax.nn.sigmoid(u).sum())(t).sum())(jnp.asarray(v))
+    np.testing.assert_allclose(g2.asnumpy(), np.asarray(want), rtol=1e-4)

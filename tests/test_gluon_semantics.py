@@ -1,0 +1,109 @@
+"""Gluon semantics ported from the reference's test_gluon.py: deferred
+initialization, parameter sharing, name scopes, grad_req interactions."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, autograd
+
+
+def test_deferred_init_infers_in_units():
+    net = gluon.nn.Dense(8)              # in_units unknown
+    net.initialize(mx.init.Xavier())     # deferred
+    x = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    out = net(x)
+    assert out.shape == (4, 8)
+    assert net.weight.shape == (8, 5)
+
+
+def test_deferred_init_error_before_forward():
+    net = gluon.nn.Dense(8)
+    net.initialize()
+    with pytest.raises(Exception):
+        net.weight.data()                # shape still unknown
+
+
+def test_shared_params_between_blocks():
+    shared = gluon.nn.Dense(6)
+    shared.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+    shared(x)
+    tied = gluon.nn.Dense(6, params=shared.collect_params())
+    out1 = shared(x).asnumpy()
+    out2 = tied(x).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+    # updating one updates the other
+    shared.weight.set_data(mx.nd.zeros(shared.weight.shape))
+    np.testing.assert_allclose(tied(x).asnumpy(),
+                               np.broadcast_to(
+                                   shared.bias.data().asnumpy(), (2, 6)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_name_scope_unique_prefixes():
+    net1 = gluon.nn.Dense(2)
+    net2 = gluon.nn.Dense(2)
+    assert net1.weight.name != net2.weight.name
+
+
+def test_grad_req_null_params_not_updated():
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+    net(x)
+    net.weight.grad_req = "null"
+    before = net.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), before)
+    # bias still trains
+    assert np.abs(net.bias.data().asnumpy()).sum() > 0
+
+
+def test_grad_add_accumulates():
+    x = mx.nd.array(np.ones(3, np.float32))
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6.0)   # 3 * 2x
+
+
+def test_block_children_iteration_and_repr():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))
+    assert len(list(net._children.values())) == 2
+    params = net.collect_params()
+    assert len(params) == 4              # 2 weights + 2 biases
+
+
+def test_hybridize_shape_change_retriggers_trace():
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    out1 = net(mx.nd.array(np.random.rand(2, 3).astype(np.float32)))
+    out2 = net(mx.nd.array(np.random.rand(5, 3).astype(np.float32)))
+    assert out1.shape == (2, 4) and out2.shape == (5, 4)
+
+
+def test_constant_parameter():
+    class WithConst(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "const", np.array([1.0, 2.0], np.float32))
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = WithConst()
+    net.initialize()
+    out = net(mx.nd.array(np.ones((3, 2), np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), [[1, 2]] * 3)

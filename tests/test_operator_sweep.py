@@ -1,0 +1,215 @@
+"""Broad numpy-reference sweep over the registered op surface (reference
+model: tests/python/unittest/test_operator.py — op-level numerical testing
+against numpy; SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ops.registry import get_op
+
+RNG = np.random.RandomState(7)
+
+
+def _call(name, *arrays, **attrs):
+    out = get_op(name).fn(*[np.asarray(a) for a in arrays], **attrs)
+    if isinstance(out, tuple):
+        return [np.asarray(o) for o in out]
+    return np.asarray(out)
+
+
+# (op name, input builder, numpy reference) — positive-domain ops get
+# positive inputs, domain-limited ops get squeezed ranges.
+_X = RNG.randn(3, 4).astype(np.float32)
+_XP = np.abs(_X) + 0.5
+_X01 = RNG.rand(3, 4).astype(np.float32) * 0.8 + 0.1
+
+UNARY = [
+    ("abs", _X, np.abs), ("sign", _X, np.sign),
+    ("ceil", _X, np.ceil), ("floor", _X, np.floor),
+    ("trunc", _X, np.trunc), ("rint", _X, np.rint),
+    ("exp", _X, np.exp), ("log", _XP, np.log),
+    ("log2", _XP, np.log2), ("log10", _XP, np.log10),
+    ("log1p", _XP, np.log1p), ("expm1", _X, np.expm1),
+    ("sqrt", _XP, np.sqrt), ("rsqrt", _XP, lambda x: 1 / np.sqrt(x)),
+    ("cbrt", _XP, np.cbrt), ("square", _X, np.square),
+    ("reciprocal", _XP, lambda x: 1 / x), ("negative", _X, np.negative),
+    ("sigmoid", _X, lambda x: 1 / (1 + np.exp(-x))),
+    ("relu", _X, lambda x: np.maximum(x, 0)),
+    ("softsign", _X, lambda x: x / (1 + np.abs(x))),
+    ("erf", _X, None),
+    ("sin", _X, np.sin), ("cos", _X, np.cos), ("tan", _X * 0.3, np.tan),
+    ("arcsin", _X01, np.arcsin), ("arccos", _X01, np.arccos),
+    ("arctan", _X, np.arctan),
+    ("sinh", _X, np.sinh), ("cosh", _X, np.cosh), ("tanh", _X, np.tanh),
+    ("arcsinh", _X, np.arcsinh), ("arccosh", _XP + 1.0, np.arccosh),
+    ("arctanh", _X01 * 0.9, np.arctanh),
+    ("degrees", _X, np.degrees), ("radians", _X, np.radians),
+    ("gammaln", _XP, None),
+]
+
+
+@pytest.mark.parametrize("name,x,ref", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_matches_numpy(name, x, ref):
+    got = _call(name, x)
+    if ref is None:
+        import scipy.special as sps
+        ref = {"erf": sps.erf, "gammaln": sps.gammaln}[name]
+    np.testing.assert_allclose(got, ref(x.astype(np.float64)), rtol=2e-5,
+                               atol=2e-6)
+
+
+_A = RNG.randn(3, 4).astype(np.float32)
+_B = RNG.randn(3, 4).astype(np.float32)
+_BP = np.abs(_B) + 0.5
+
+BINARY = [
+    ("broadcast_add", _A, _B, np.add),
+    ("broadcast_subtract", _A, _B, np.subtract),
+    ("broadcast_multiply", _A, _B, np.multiply),
+    ("broadcast_divide", _A, _BP, np.divide),
+    ("broadcast_power", np.abs(_A) + 0.2, _B, np.power),
+    ("broadcast_maximum", _A, _B, np.maximum),
+    ("broadcast_minimum", _A, _B, np.minimum),
+    ("broadcast_hypot", _A, _B, np.hypot),
+    ("broadcast_equal", _A, _A, lambda a, b: (a == b).astype(np.float32)),
+    ("broadcast_not_equal", _A, _B, lambda a, b: (a != b).astype(np.float32)),
+    ("broadcast_greater", _A, _B, lambda a, b: (a > b).astype(np.float32)),
+    ("broadcast_lesser", _A, _B, lambda a, b: (a < b).astype(np.float32)),
+    ("broadcast_logical_and", (_A > 0).astype(np.float32),
+     (_B > 0).astype(np.float32),
+     lambda a, b: np.logical_and(a, b).astype(np.float32)),
+    ("broadcast_logical_or", (_A > 0).astype(np.float32),
+     (_B > 0).astype(np.float32),
+     lambda a, b: np.logical_or(a, b).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("name,a,b,ref", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_matches_numpy(name, a, b, ref):
+    got = _call(name, a, b)
+    np.testing.assert_allclose(
+        got, ref(a.astype(np.float64), b.astype(np.float64)),
+        rtol=2e-5, atol=2e-6)
+
+
+def test_binary_broadcasting_shapes():
+    a = RNG.randn(3, 1, 4).astype(np.float32)
+    b = RNG.randn(1, 5, 4).astype(np.float32)
+    got = _call("broadcast_add", a, b)
+    np.testing.assert_allclose(got, a + b, rtol=1e-6)
+
+
+REDUCE = [
+    ("sum", dict(), np.sum),
+    ("sum", dict(axis=1), lambda x, axis=1: x.sum(axis=axis)),
+    ("sum", dict(axis=0, keepdims=True),
+     lambda x: x.sum(axis=0, keepdims=True)),
+    ("mean", dict(axis=1), lambda x: x.mean(axis=1)),
+    ("prod", dict(axis=1), lambda x: x.prod(axis=1)),
+    ("max", dict(axis=0), lambda x: x.max(axis=0)),
+    ("min", dict(axis=0), lambda x: x.min(axis=0)),
+    ("argmax", dict(axis=1), lambda x: x.argmax(axis=1).astype(np.float32)),
+    ("argmin", dict(axis=1), lambda x: x.argmin(axis=1).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("name,attrs,ref", REDUCE,
+                         ids=["%s-%s" % (r[0], r[1]) for r in REDUCE])
+def test_reduction_matches_numpy(name, attrs, ref):
+    got = _call(name, _X, **attrs)
+    np.testing.assert_allclose(got, ref(_X.astype(np.float64)), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_norm_l2():
+    got = _call("norm", _X, ord=2)
+    np.testing.assert_allclose(got, np.linalg.norm(_X), rtol=1e-5)
+
+
+SHAPE_CASES = [
+    ("reshape", (_X,), dict(shape=(4, 3)), lambda x: x.reshape(4, 3)),
+    ("transpose", (_X,), dict(), lambda x: x.T),
+    ("transpose", (RNG.randn(2, 3, 4).astype(np.float32),),
+     dict(axes=(2, 0, 1)), lambda x: x.transpose(2, 0, 1)),
+    ("swapaxes", (RNG.randn(2, 3, 4).astype(np.float32),),
+     dict(dim1=0, dim2=2), lambda x: x.swapaxes(0, 2)),
+    ("flip", (_X,), dict(axis=1), lambda x: x[:, ::-1]),
+    ("tile", (_X,), dict(reps=(2, 1)), lambda x: np.tile(x, (2, 1))),
+    ("repeat", (_X,), dict(repeats=2, axis=1),
+     lambda x: np.repeat(x, 2, axis=1)),
+    ("expand_dims", (_X,), dict(axis=1), lambda x: x[:, None, :]),
+    ("clip", (_X,), dict(a_min=-0.5, a_max=0.5),
+     lambda x: np.clip(x, -0.5, 0.5)),
+    ("slice_axis", (_X,), dict(axis=1, begin=1, end=3), lambda x: x[:, 1:3]),
+]
+
+
+@pytest.mark.parametrize("name,args,attrs,ref", SHAPE_CASES,
+                         ids=["%s-%d" % (c[0], i)
+                              for i, c in enumerate(SHAPE_CASES)])
+def test_shape_op_matches_numpy(name, args, attrs, ref):
+    got = _call(name, *args, **attrs)
+    np.testing.assert_allclose(got, ref(*[np.asarray(a) for a in args]),
+                               rtol=1e-6)
+
+
+def test_take_gather_scatter():
+    x = RNG.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 3, 4], np.int32)
+    np.testing.assert_allclose(_call("take", x, idx), x[idx], rtol=1e-6)
+    data = RNG.randn(4,).astype(np.float32)
+    indices = np.array([[0, 2]], np.int32)  # gather_nd indices (1, k)
+    got = _call("gather_nd", x, np.array([[0, 1], [2, 0]], np.int32))
+    np.testing.assert_allclose(got, x[np.array([0, 1]), np.array([2, 0])],
+                               rtol=1e-6)
+
+
+def test_one_hot():
+    got = _call("one_hot", np.array([0, 2, 1], np.int32), depth=4)
+    want = np.eye(4, dtype=np.float32)[[0, 2, 1]]
+    np.testing.assert_allclose(got, want)
+
+
+def test_topk_and_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    got = _call("topk", x, k=2, ret_typ="value")
+    np.testing.assert_allclose(got, np.array([[3.0, 2.0], [5.0, 4.0]]))
+    got = _call("sort", x, axis=1)
+    np.testing.assert_allclose(got, np.sort(x, axis=1))
+    got = _call("argsort", x, axis=1)
+    np.testing.assert_allclose(got, np.argsort(x, axis=1))
+
+
+def test_dot_and_batch_dot():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(_call("dot", a, b), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        _call("dot", a, b.T, transpose_b=True), a @ b, rtol=1e-5)
+    ba = RNG.randn(2, 3, 4).astype(np.float32)
+    bb = RNG.randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(_call("batch_dot", ba, bb), ba @ bb, rtol=1e-5)
+
+
+def test_where_and_concat_split():
+    cond = (RNG.rand(3, 4) > 0.5).astype(np.float32)
+    got = _call("where", cond, _A, _B)
+    np.testing.assert_allclose(got, np.where(cond > 0, _A, _B))
+    got = _call("Concat", _A, _B, dim=0)
+    np.testing.assert_allclose(got, np.concatenate([_A, _B], 0))
+    parts = _call("SliceChannel", _A, num_outputs=2, axis=1)
+    np.testing.assert_allclose(parts[0], _A[:, :2])
+
+
+def test_gradients_of_core_ops():
+    """Spot finite-difference check through the tape on composite ops."""
+    from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+    import incubator_mxnet_tpu as mx
+
+    check_numeric_gradient(
+        lambda a: (a.exp() * a).sum(), [RNG.randn(3).astype(np.float32) * 0.3],
+        rtol=5e-2, atol=1e-3)
+    check_numeric_gradient(
+        lambda a: mx.nd.softmax(a).square().sum(),
+        [RNG.randn(4).astype(np.float32)], rtol=5e-2, atol=1e-3)
