@@ -274,7 +274,12 @@ def _backward_create_graph(heads, head_grads, accumulate_to_leaves, variables):
     recording NDArray frontend, so every cotangent computation lands on the
     tape and can itself be differentiated (reference: Imperative::Backward
     with create_graph=true re-records the gradient graph). The graph is
-    implicitly retained (vjp closures stay alive inside the new tape nodes)."""
+    implicitly retained (vjp closures stay alive inside the new tape nodes).
+
+    NOTE: the traversal intentionally mirrors _backward_impl (same head
+    seeding / slot accumulation / leaf routing) with recorded-NDArray
+    cotangents instead of raw jax arrays — keep the two walks in sync when
+    changing cotangent routing."""
     from .ndarray import NDArray
     from .ndarray.ndarray import _invoke_simple
 
@@ -353,13 +358,17 @@ def _backward_create_graph(heads, head_grads, accumulate_to_leaves, variables):
                     add_ct(leaf_ct, id(parent), ict)
                     leaf_map[id(parent)] = parent
 
+        if accumulate_to_leaves:
+            # still inside record(): the grad_req="add" accumulation must
+            # itself be a tape node or the summed buffer severs the graph
+            for key, ct in leaf_ct.items():
+                leaf = leaf_map[key]
+                if leaf._grad_req == "add" and leaf._grad is not None:
+                    leaf._grad = leaf._grad + ct
+                else:
+                    leaf._grad = ct   # tape-connected, differentiable again
+
     if accumulate_to_leaves:
-        for key, ct in leaf_ct.items():
-            leaf = leaf_map[key]
-            if leaf._grad_req == "add" and leaf._grad is not None:
-                leaf._grad = leaf._grad + ct
-            else:
-                leaf._grad = ct   # tape-connected grad, differentiable again
         return None
 
     results = []

@@ -258,6 +258,10 @@ class ParameterDict:
             for k, v in kwargs.items():
                 if k == "shape" and v is not None and param.shape is not None:
                     continue
+                if k == "aux":   # role flag lives on _aux
+                    if v and not param._aux:
+                        param._aux = True
+                    continue
                 if getattr(param, k, None) in (None, 0) and v is not None:
                     setattr(param, k, v)
         return param

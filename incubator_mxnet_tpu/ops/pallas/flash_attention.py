@@ -1,4 +1,5 @@
-"""Flash attention — Pallas TPU kernel with online softmax.
+"""Flash attention — Pallas TPU kernels with online softmax, forward AND
+backward.
 
 The O(T)-memory attention kernel (net-new vs the reference, which predates
 flash attention; justified by the BERT/long-context BASELINE configs).
@@ -6,12 +7,17 @@ flash attention; justified by the BERT/long-context BASELINE configs).
 Forward: grid (batch*heads, q_blocks, kv_blocks); K/V stream through VMEM
 one block at a time (constant VMEM footprint at any sequence length), with
 the online-softmax accumulator held in VMEM scratch across the innermost
-grid dimension. QK^T and PV ride the MXU; the rescale runs on the VPU.
-Backward: standard flash backward recomputation in jnp (XLA-fused); a
-Pallas backward kernel is a later optimization.
+grid dimension; also emits the per-row LSE for the backward. QK^T and PV
+ride the MXU; the rescale runs on the VPU.
+
+Backward: the standard flash recomputation split into two kernels so every
+output has its own accumulation order — dQ over KV blocks, dK/dV over Q
+blocks — each streaming one tile pair at a time (O(T) memory, no T x T
+materialization). delta = rowsum(dO * O) is a cheap fused jnp elementwise.
 
 Falls back transparently on CPU (no Mosaic) — callers check
-``flash_attention_available()``.
+``flash_attention_available()``; tests run the same kernels with
+``interpret=True``.
 """
 
 import functools
@@ -33,7 +39,7 @@ def flash_attention_available():
     return _PALLAS_OK and jax.default_backend() == "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 block_q, block_k, scale, causal):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -80,9 +86,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse is materialized 8-sublane-replicated: Mosaic requires block
+        # sublane dims divisible by 8, and (1, BQ) blocks of a (bh, T) array
+        # are not; (1, 8, BQ) blocks of (bh, 8, T) are.
+        lse_ref[0] = jnp.broadcast_to((m_ref[:, 0] + jnp.log(l_safe))[None],
+                                      lse_ref.shape[1:])
 
 
-def _fwd_call(q, k, v, scale, causal, block_q, block_k):
+def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret=False):
     bh, T, d = q.shape
     grid = (bh, T // block_q, T // block_k)
     return pl.pallas_call(
@@ -94,14 +105,157 @@ def _fwd_call(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, T, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, T, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, T), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v)
+
+
+def _recompute_p_ds(q, k, v, do, lse, delta, qi, kj, block_q, block_k,
+                    scale, causal):
+    """Shared tile math of the backward kernels: p and ds for one (Q, KV)
+    tile pair (runs in fp32 on the MXU/VPU)."""
+    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])                         # (BQ, BK)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, block_q, block_k, scale, causal):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = True if not causal else qi * block_q + block_q - 1 >= kj * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _recompute_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
+                                qi, kj, block_q, block_k, scale, causal)
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+                scale, causal):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True if not causal else qi * block_q + block_q - 1 >= kj * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _recompute_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
+                                qi, kj, block_q, block_k, scale, causal)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+              interpret=False):
+    bh, T, d = q.shape
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, T))
+
+    qkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),   # lse
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),   # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(bh, T // block_q, T // block_k),
+        in_specs=qkv_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    kv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),   # lse
+        pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),   # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(bh, T // block_k, T // block_q),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, T, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, T, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _bq(q):
@@ -112,44 +266,28 @@ def _bk(q):
     return min(q.shape[1], 128)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, scale, causal):
-    return _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, scale, causal, interpret):
+    out, _ = _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q), interpret)
+    return out
 
 
-def _flash_fwd(q, k, v, scale, causal):
-    out = _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q))
-    return out, (q, k, v, out)
+def _flash_fwd(q, k, v, scale, causal, interpret):
+    out, lse = _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q), interpret)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, res, g):
-    """Standard flash backward; jnp/XLA-fused (lse recomputed — backward
-    materializes s anyway; the Pallas bwd kernel is a later optimization)."""
-    q, k, v, out = res
-    qf = q.astype(jnp.float32) * scale
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    s = jnp.einsum("btd,bsd->bts", qf, kf)
-    if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None], s, _NEG_INF)
-    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - lse)                                # (B,T,S)
-    dv = jnp.einsum("bts,btd->bsd", p, gf)
-    dp = jnp.einsum("btd,bsd->bts", gf, vf)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1, keepdims=True)
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bts,bsd->btd", ds, kf) * scale
-    dk = jnp.einsum("bts,btd->bsd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+def _flash_bwd(scale, causal, interpret, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, out, lse, g, scale, causal,
+                           _bq(q), _bk(q), interpret)
+    return dq, dk, dv
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, scale=None, causal=False):
+def flash_attention(q, k, v, scale=None, causal=False, interpret=False):
     """q/k/v: (B, H, T, D). Returns (B, H, T, D). Requires T % 128 == 0 or
     T <= 128; callers fall back to the einsum path otherwise."""
     B, H, T, D = q.shape
@@ -161,5 +299,5 @@ def flash_attention(q, k, v, scale=None, causal=False):
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
-    out = _flash_core(qf, kf, vf, float(scale), bool(causal))
+    out = _flash_core(qf, kf, vf, float(scale), bool(causal), bool(interpret))
     return out.reshape(B, H, T, D)

@@ -158,8 +158,6 @@ def test_take_gather_scatter():
     x = RNG.randn(5, 3).astype(np.float32)
     idx = np.array([0, 3, 4], np.int32)
     np.testing.assert_allclose(_call("take", x, idx), x[idx], rtol=1e-6)
-    data = RNG.randn(4,).astype(np.float32)
-    indices = np.array([[0, 2]], np.int32)  # gather_nd indices (1, k)
     got = _call("gather_nd", x, np.array([[0, 1], [2, 0]], np.int32))
     np.testing.assert_allclose(got, x[np.array([0, 1]), np.array([2, 0])],
                                rtol=1e-6)

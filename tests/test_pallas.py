@@ -126,3 +126,45 @@ def test_fused_fallback_on_bad_shapes():
     x = jnp.zeros((7, 64))
     assert fused_layer_norm(x, jnp.ones(64), jnp.zeros(64)) is None
     assert fused_softmax(jnp.zeros((5, 3, 7, 64))[..., 0]) is None
+
+
+# ---------------------------------------------------------------------------
+# flash attention backward (Pallas kernels, interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+def _dense_ref(q, k, v, causal):
+    T = q.shape[2]
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k) / jnp.sqrt(q.shape[-1])
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -1e30)
+    return jax.nn.softmax(s, -1) @ v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    np.random.seed(0)
+    B, H, T, D = 1, 2, 256, 64
+    q = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, interpret=True) ** 2).sum()
+
+    def fr(q, k, v):
+        return (_dense_ref(q, k, v, causal) ** 2).sum()
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        rel = float(jnp.abs(a - b).max() / jnp.abs(b).max())
+        assert rel < 1e-4, rel
+
+
+def test_flash_forward_interpret_matches_dense():
+    np.random.seed(1)
+    q = jnp.asarray(np.random.randn(1, 2, 256, 64).astype(np.float32))
+    out = flash_attention(q, q, q, interpret=True)
+    want = _dense_ref(q, q, q, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
