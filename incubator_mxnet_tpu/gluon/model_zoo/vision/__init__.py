@@ -31,6 +31,12 @@ _models = {
 }
 
 
+# python-identifier aliases (mobilenet1_0 == reference key "mobilenet1.0")
+_models.update({k.replace(".", "_"): v for k, v in list(_models.items())})
+_models["inception_v3"] = inception_v3
+_models["mobilenet_v2_1_0"] = mobilenet_v2_1_0
+
+
 def get_model(name, **kwargs):
     name = name.lower()
     if name not in _models:

@@ -54,6 +54,7 @@ class RNNModel(HybridBlock):
 
 
 def lstm_lm_ptb(**kwargs):
-    return RNNModel(mode="lstm", vocab_size=10000, num_embed=650,
-                    num_hidden=650, num_layers=2, dropout=0.5,
-                    tie_weights=True, **kwargs)
+    cfg = dict(mode="lstm", vocab_size=10000, num_embed=650, num_hidden=650,
+               num_layers=2, dropout=0.5, tie_weights=True)
+    cfg.update(kwargs)
+    return RNNModel(**cfg)

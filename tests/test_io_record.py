@@ -69,3 +69,31 @@ def test_image_det_record_iter_padding(tmp_path):
     assert (l[0, 1:] == -1).all()
     assert (l[1, 2:] == -1).all()
     np.testing.assert_allclose(l[1, 1], [1.0, 0.1, 0.1, 0.5, 0.5], rtol=1e-6)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    """tools/im2rec.py packs a .lst of images into .rec/.idx readable by
+    ImageRecordIter (reference: tools/im2rec.py)."""
+    import subprocess
+    import sys
+    root = tmp_path / "imgs"
+    root.mkdir()
+    lst = tmp_path / "data.lst"
+    lines = []
+    for i in range(4):
+        p = root / ("img%d.npy" % i)
+        np.save(p, np.full((8, 8, 3), i * 5, np.uint8))
+        lines.append("%d\t%d\t%s" % (i, i % 2, p.name))
+    lst.write_text("\n".join(lines) + "\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+         str(lst)[:-4], str(root)],
+        capture_output=True, text=True, env={**os.environ,
+                                             "JAX_PLATFORM_NAME": "cpu"})
+    assert r.returncode == 0, r.stderr[-500:]
+    rec = str(tmp_path / "data.rec")
+    assert os.path.exists(rec)
+    it = mx.io.ImageRecordIter(rec, data_shape=(3, 8, 8), batch_size=2)
+    b = it.next()
+    assert b.data[0].shape == (2, 3, 8, 8)

@@ -159,3 +159,35 @@ def test_sharding_rules_matcher():
     match = sharding_rules([(r"weight$", P("tp", None))])
     assert match("layer0_weight") == P("tp", None)
     assert match("layer0_bias") == P()
+
+
+def test_ring_attention_differentiable_on_mesh():
+    """Gradients flow through the ring (scan + ppermute) — the long-context
+    training path, on a 4-device slice of the virtual CPU mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from incubator_mxnet_tpu.parallel.ring_attention import make_ring_attention
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("sp",))
+    B, H, T, D = 1, 2, 64 * 4, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    fn = make_ring_attention(mesh, seq_axis="sp", causal=True)
+
+    def loss(q):
+        return (fn(q, q, q) ** 2).sum()
+
+    g = jax.jit(jax.grad(loss))(q)
+    assert g.shape == q.shape
+
+    def ref_loss(q):
+        s = jnp.einsum("bhtd,bhsd->bhts", q, q) / (D ** 0.5)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -1e30)
+        return ((jax.nn.softmax(s, -1) @ q) ** 2).sum()
+
+    gr = jax.grad(ref_loss)(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=2e-3,
+                               atol=2e-4)
