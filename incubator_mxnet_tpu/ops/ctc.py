@@ -15,10 +15,12 @@ NEG_INF = -1e30
 
 def _logsumexp3(a, b, c):
     m = jnp.maximum(jnp.maximum(a, b), c)
-    m_safe = jnp.where(m == NEG_INF, 0.0, m)
-    out = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe) +
-                           jnp.exp(c - m_safe))
-    return jnp.where(m == NEG_INF, NEG_INF, out)
+    dead = m <= NEG_INF
+    m_safe = jnp.where(dead, 0.0, m)
+    s = jnp.exp(a - m_safe) + jnp.exp(b - m_safe) + jnp.exp(c - m_safe)
+    # guard the unselected branch: log(0) would be -inf with NaN cotangent
+    out = m_safe + jnp.log(jnp.where(dead, 1.0, s))
+    return jnp.where(dead, NEG_INF, out)
 
 
 def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
@@ -85,6 +87,9 @@ def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
                                           axis=1)[:, 0],
                       NEG_INF)
     m = jnp.maximum(a_last, a_lab)
-    m_safe = jnp.where(m == NEG_INF, 0.0, m)
-    ll = m_safe + jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_lab - m_safe))
+    dead = m <= NEG_INF
+    m_safe = jnp.where(dead, 0.0, m)
+    s = jnp.exp(a_last - m_safe) + jnp.exp(a_lab - m_safe)
+    ll = m_safe + jnp.log(jnp.where(dead, 1.0, s))
+    ll = jnp.where(dead, NEG_INF, ll)
     return -ll

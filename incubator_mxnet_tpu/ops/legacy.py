@@ -1,0 +1,144 @@
+"""Legacy v0.x-style ops kept for API parity (reference: the flat
+src/operator/*.cc family bridged by legacy_op_util.cc — SURVEY §2.3
+"flat legacy ops").
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+from .ctc import ctc_loss as _ctc_impl
+
+# v0.x names are straight aliases of the modern ops
+alias("BatchNorm", "BatchNorm_v1")
+alias("Convolution", "Convolution_v1")
+alias("Pooling", "Pooling_v1")
+alias("SliceChannel", "slice_channel")
+alias("make_loss", "MakeLoss")
+
+
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def ctc_loss_op(data, label, data_lengths=None, label_lengths=None,
+                use_data_lengths=False, use_label_lengths=False,
+                blank_label="first"):
+    """CTC loss (reference: src/operator/nn/ctc_loss.cc over warp-ctc).
+    data: (T, N, C) activations; label: (N, L) padded classes. Returns (N,)
+    losses; gradients flow through the soft alignment (lax.scan forward
+    algorithm in ops/ctc.py). blank_label='first' = class 0 is blank (the
+    reference's default; 'last' uses C-1)."""
+    blank = 0 if blank_label == "first" else data.shape[-1] - 1
+    return _ctc_impl(data, label,
+                     data_lengths if use_data_lengths else None,
+                     label_lengths if use_label_lengths else None,
+                     layout="TNC", blank=blank)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_svm_output(margin, reg_coef, use_linear):
+    @jax.custom_vjp
+    def svm(data, label):
+        return data
+
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        c = data.shape[-1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), c, dtype=data.dtype)
+        # L1-SVM: grad -1 on target margin violations, +1 on violating others
+        score_t = jnp.sum(data * onehot, axis=-1, keepdims=True)
+        viol = (data - score_t + margin) > 0
+        if use_linear:
+            grad = jnp.where(viol, jnp.ones_like(data), 0.0)
+            grad = grad * (1 - onehot) - onehot * jnp.sum(
+                grad * (1 - onehot), axis=-1, keepdims=True)
+        else:  # squared hinge
+            m = jnp.maximum(data - score_t + margin, 0.0) * (1 - onehot)
+            grad = 2 * m - onehot * jnp.sum(2 * m, axis=-1, keepdims=True)
+        # no batch normalization — reference svm_output.cc emits the raw
+        # per-sample hinge gradient (matches SoftmaxOutput's default too)
+        return (grad * reg_coef, jnp.zeros_like(label))
+
+    svm.defvjp(fwd, bwd)
+    return svm
+
+
+@register("SVMOutput")
+def svm_output(data, label=None, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Forward = identity; backward = hinge-loss gradient (reference:
+    src/operator/svm_output.cc)."""
+    if label is None:
+        return data
+    return _make_svm_output(float(margin), float(regularization_coefficient),
+                            bool(use_linear))(data, label.astype(data.dtype))
+
+
+@register("Crop")
+def crop(data, *shape_like, offset=(0, 0), h_w=(0, 0), num_args=1,
+         center_crop=False):
+    """Legacy NCHW spatial crop (reference: src/operator/crop.cc): crop to
+    ``shape_like[-1]``'s HxW (2-arg form) or to explicit ``h_w``."""
+    if shape_like:
+        th, tw = shape_like[-1].shape[2], shape_like[-1].shape[3]
+    else:
+        th, tw = h_w
+    H, W = data.shape[2], data.shape[3]
+    if th > H or tw > W:
+        raise ValueError("Crop size (%d, %d) exceeds input (%d, %d)"
+                         % (th, tw, H, W))
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+        if y0 + th > H or x0 + tw > W:
+            raise ValueError("Crop offset (%d, %d) + size (%d, %d) exceeds "
+                             "input (%d, %d)" % (y0, x0, th, tw, H, W))
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] (reference: src/operator/tensor/
+    broadcast_reduce_op_index.cc) — same gather as ``pick(axis=1)``."""
+    from .tensor import pick
+    return pick(lhs, rhs, axis=1)
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (reference: same file)."""
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float16"):
+    """AMP cast (reference: src/operator/tensor/amp_cast.cc). float16
+    requests map to bfloat16 — the TPU-native half type."""
+    dt = jnp.bfloat16 if str(dtype) in ("float16", "fp16", "bfloat16") \
+        else jnp.dtype(dtype)
+    return data.astype(dt)
+
+
+def _amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    """Cast all FLOAT inputs to the widest (or narrowest) common float type;
+    non-float inputs pass through untouched (the reference op only handles
+    float tensors)."""
+    order = {jnp.dtype(jnp.float16): 0, jnp.dtype(jnp.bfloat16): 0,
+             jnp.dtype(jnp.float32): 1, jnp.dtype(jnp.float64): 2}
+    ranks = [order[jnp.dtype(d.dtype)] for d in data
+             if jnp.dtype(d.dtype) in order]
+    if not ranks:
+        return tuple(data)
+    rank = min(ranks) if cast_narrow else max(ranks)
+    target = [jnp.bfloat16, jnp.float32, jnp.float64][rank]
+    return tuple(d.astype(target) if jnp.dtype(d.dtype) in order else d
+                 for d in data)
+
+
+register("amp_multicast", num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)))(
+    _amp_multicast)
