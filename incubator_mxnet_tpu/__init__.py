@@ -73,4 +73,5 @@ from .model import save_checkpoint, load_checkpoint
 from . import model
 from . import executor_manager
 from . import test_utils
+from . import torch_bridge as th
 from . import contrib

@@ -155,7 +155,8 @@ class NDArray:
     as_in_ctx = as_in_context
 
     def to_dlpack_for_read(self):
-        return jax.dlpack.to_dlpack(self._data)
+        # modern DLPack protocol (jax>=0.5 removed jax.dlpack.to_dlpack)
+        return self._data.__dlpack__()
 
     def wait_to_read(self):
         self._data.block_until_ready()

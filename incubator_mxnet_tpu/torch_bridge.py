@@ -1,0 +1,52 @@
+"""mx.th — PyTorch interop (reference: plugin/torch + python/mxnet/torch.py
+ran Torch7 ops in-graph; the modern equivalent is zero-copy tensor exchange
+with PyTorch via DLPack).
+
+``to_torch`` / ``from_torch`` move tensors between frameworks; ``torch_fn``
+wraps a torch callable as an op on NDArrays (host round-trip — torch here is
+CPU-only; use it for data preprocessing / reference checks, not the hot
+path).
+"""
+
+from .ndarray import NDArray, array as _nd_array
+
+__all__ = ["to_torch", "from_torch", "torch_fn"]
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor. Always a COPY: jax treats buffers as
+    immutable, so handing torch a writable zero-copy view would let in-place
+    torch ops corrupt values jax has already traced/cached."""
+    import torch
+    if not isinstance(arr, NDArray):
+        raise TypeError("expected NDArray, got %s" % type(arr).__name__)
+    try:
+        return torch.from_dlpack(arr._data).clone()
+    except Exception:
+        return torch.from_numpy(arr.asnumpy().copy())
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor -> NDArray."""
+    import torch
+    if not isinstance(tensor, torch.Tensor):
+        raise TypeError("expected torch.Tensor, got %s" % type(tensor).__name__)
+    # copy for the same immutability reason as to_torch: the caller may
+    # keep mutating the torch tensor afterwards
+    t = tensor.detach().contiguous()
+    return _nd_array(t.cpu().numpy().copy(), ctx=ctx)
+
+
+def torch_fn(fn):
+    """Wrap ``fn(torch tensors) -> torch tensor(s)`` as an NDArray function
+    (reference: mxnet.torch exposing torch ops on mx arrays)."""
+    def wrapped(*arrays, **kwargs):
+        ins = [to_torch(a) if isinstance(a, NDArray) else a for a in arrays]
+        kw = {k: (to_torch(v) if isinstance(v, NDArray) else v)
+              for k, v in kwargs.items()}
+        out = fn(*ins, **kw)
+        if isinstance(out, (list, tuple)):
+            return [from_torch(o) for o in out]
+        return from_torch(out)
+    wrapped.__name__ = getattr(fn, "__name__", "torch_fn")
+    return wrapped

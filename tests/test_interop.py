@@ -1,0 +1,43 @@
+"""Interop: torch bridge (reference: plugin/torch), DataLoader workers,
+dlpack."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def test_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = mx.th.to_torch(a)
+    assert tuple(t.shape) == (2, 3)
+    b = mx.th.from_torch(t * 2)
+    np.testing.assert_allclose(b.asnumpy(), a.asnumpy() * 2)
+
+
+def test_torch_fn_wraps_ops():
+    torch = pytest.importorskip("torch")
+    mm = mx.th.torch_fn(torch.mm)
+    a = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+    b = mx.nd.array(np.random.rand(3, 4).astype(np.float32))
+    np.testing.assert_allclose(mm(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_dataloader_multiprocess_workers():
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(32, dtype=np.float32).reshape(16, 2)
+    y = np.arange(16, dtype=np.float32)
+    loader = DataLoader(ArrayDataset(X, y), batch_size=4, num_workers=2)
+    seen = 0
+    for data, label in loader:
+        assert data.shape == (4, 2)
+        seen += data.shape[0]
+    assert seen == 16
+
+
+def test_dlpack_export():
+    a = mx.nd.array(np.ones((2, 2), np.float32))
+    cap = a.to_dlpack_for_read()
+    assert cap is not None
