@@ -7,6 +7,13 @@ long-sequence answer).
 On one chip use --dp 1 --tp 1; on a pod slice the same script shards
 embeddings/FFN over tp and the batch over dp."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
 import argparse
 import time
 
@@ -30,12 +37,15 @@ def main():
     from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
 
     vocab = 30522
-    net = mx.models.bert_base(num_layers=args.layers, vocab_size=vocab)
+    net = mx.models.BERTForPretrain(
+        mx.models.bert_base(num_layers=args.layers, vocab_size=vocab),
+        vocab_size=vocab)
     net.initialize(mx.init.Normal(0.02))
 
     def mlm_loss(out, labels):
-        # out: (B, T, vocab) prediction scores; labels: (B, T) with -1 = pad
-        logp = jax.nn.log_softmax(out, axis=-1)
+        # out = (mlm (B, T, vocab), nsp); labels: (B, T) with -1 = pad
+        mlm, _nsp = out
+        logp = jax.nn.log_softmax(mlm, axis=-1)
         lab = labels.astype(jnp.int32)
         picked = jnp.take_along_axis(logp, jnp.maximum(lab, 0)[..., None],
                                      axis=-1)[..., 0]

@@ -13,6 +13,11 @@ import os
 
 import numpy as np
 
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, autograd, gluon
 

@@ -8,6 +8,13 @@ ICI: dp axis = data parallel replicas. On one chip, dp=1 still runs the
 same compiled program.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
 import argparse
 
 import numpy as np

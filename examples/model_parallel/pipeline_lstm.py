@@ -8,6 +8,13 @@ XLA inserts the transfers — but the reference's explicit style also works
 with Context placement, shown here on the virtual CPU mesh
 (XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
 import numpy as np
 
 
@@ -32,17 +39,23 @@ def main():
         # sharding == the reference's ctx-group placement)
         ws.append(jax.device_put(w, devs[i]))
 
-    @jax.jit
-    def forward(x, *ws):
+    # the reference's style: each stage computes on ITS device, activations
+    # are explicitly transferred between stages (group2ctx semantics); a
+    # per-stage jit keeps each stage one compiled program on its device.
+    stage = jax.jit(lambda h, w: jnp.tanh(h @ w))
+
+    def forward(x):
         h = x
-        for w in ws:
-            h = jnp.tanh(h @ w)   # XLA inserts the inter-device transfer
+        for i, w in enumerate(ws):
+            h = jax.device_put(h, devs[i])     # inter-stage transfer
+            h = stage(h, w)
         return h
 
     x = jnp.asarray(rng.rand(32, dims[0]).astype(np.float32))
-    out = forward(x, *ws)
+    out = forward(x)
     print("pipeline out:", out.shape, "stages:", n_stage,
-          "device of stage0 w:", list(ws[0].devices())[0])
+          "device of stage0 w:", list(ws[0].devices())[0],
+          "device of out:", list(out.devices())[0])
 
 
 if __name__ == "__main__":

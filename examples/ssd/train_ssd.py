@@ -7,6 +7,12 @@ jit-compatible (static shapes, -1-padded NMS)."""
 
 import numpy as np
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, autograd, gluon
 from incubator_mxnet_tpu import ops
@@ -20,9 +26,10 @@ class ToySSD(gluon.HybridBlock):
             for f in (16, 32, 64):
                 self.backbone.add(gluon.nn.Conv2D(f, 3, strides=2, padding=1,
                                                   activation="relu"))
-            self.cls_head = gluon.nn.Conv2D((num_classes + 1) * 4, 3,
+            # anchors/pixel = len(sizes) + len(ratios) - 1 = 3
+            self.cls_head = gluon.nn.Conv2D((num_classes + 1) * 3, 3,
                                             padding=1)
-            self.loc_head = gluon.nn.Conv2D(4 * 4, 3, padding=1)
+            self.loc_head = gluon.nn.Conv2D(4 * 3, 3, padding=1)
         self.num_classes = num_classes
 
     def hybrid_forward(self, F, x):

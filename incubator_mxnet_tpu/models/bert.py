@@ -173,13 +173,17 @@ class BERTForPretrain(HybridBlock):
 
 
 def bert_base(vocab_size=30522, dropout=0.1, **kwargs):
-    return BERTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
-                     num_layers=12, num_heads=12, dropout=dropout, **kwargs)
+    cfg = dict(vocab_size=vocab_size, units=768, hidden_size=3072,
+               num_layers=12, num_heads=12, dropout=dropout)
+    cfg.update(kwargs)
+    return BERTModel(**cfg)
 
 
 def bert_large(vocab_size=30522, dropout=0.1, **kwargs):
-    return BERTModel(vocab_size=vocab_size, units=1024, hidden_size=4096,
-                     num_layers=24, num_heads=16, dropout=dropout, **kwargs)
+    cfg = dict(vocab_size=vocab_size, units=1024, hidden_size=4096,
+               num_layers=24, num_heads=16, dropout=dropout)
+    cfg.update(kwargs)
+    return BERTModel(**cfg)
 
 
 def bert_sharding_rules(tp_axis="tp"):

@@ -24,10 +24,12 @@ def make_mesh(axes, devices=None):
     total = 1
     for s in sizes:
         total *= s
-    if total != n:
+    if total > n or any(s <= 0 for s in sizes):
         raise ValueError("mesh %s needs %d devices, have %d"
                          % (dict(zip(names, sizes)), total, n))
-    arr = _np.asarray(devices).reshape(sizes)
+    # a smaller mesh uses the leading devices (reference: ctx lists pick a
+    # subset of visible devices the same way)
+    arr = _np.asarray(devices[:total]).reshape(sizes)
     return Mesh(arr, tuple(names))
 
 
