@@ -444,3 +444,24 @@ def test_multisample_ops():
     out = nd._sample_uniform(nd.array(np.array([0.0, 10.0])),
                              nd.array(np.array([1.0, 20.0])), shape=(3,))
     assert out.shape == (2, 3)
+
+
+def test_conv_stem_s2d_matches_generic():
+    """The space-to-depth lowering of the 7x7/2 stem conv is exact."""
+    import os
+    from incubator_mxnet_tpu.ops import nn as ops_nn
+    np.random.seed(0)
+    x = np.random.randn(2, 3, 64, 64).astype(np.float32)
+    w = np.random.randn(8, 3, 7, 7).astype(np.float32)
+    fast = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                          kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                          num_filter=8).asnumpy()
+    os.environ["MXTPU_CONV1_S2D"] = "0"
+    try:
+        ref = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                             kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                             num_filter=8).asnumpy()
+    finally:
+        os.environ.pop("MXTPU_CONV1_S2D", None)
+    assert fast.shape == ref.shape == (2, 8, 32, 32)
+    np.testing.assert_allclose(fast, ref, rtol=1e-4, atol=1e-4)
