@@ -249,11 +249,26 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         with self.name_scope():
-            self.weight = self.params.get("weight", shape=(input_dim, output_dim),
-                                          init=weight_initializer, dtype=dtype)
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim),
+                init=weight_initializer, dtype=dtype,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
+        from ...ndarray import NDArray
+        from ...ndarray.sparse import sparse_embedding
+        from ... import autograd as _ag
+        if (self._sparse_grad and isinstance(x, NDArray)
+                and isinstance(weight, NDArray) and _ag.is_recording()):
+            # eager path: the recorded gradient w.r.t. weight is a
+            # RowSparseNDArray over the batch's unique ids (reference:
+            # sparse_grad=True Embedding, indexing_op.cc). The jit/trace
+            # path stays dense — XLA's scatter-add in one fused program is
+            # the TPU-idiomatic equivalent there.
+            return sparse_embedding(x, weight, self._input_dim,
+                                    self._output_dim)
         return F.Embedding(x, weight, input_dim=self._input_dim,
                            output_dim=self._output_dim)
 

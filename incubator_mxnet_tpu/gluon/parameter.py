@@ -170,7 +170,13 @@ class Parameter:
     def zero_grad(self):
         if self._data is not None and self._data._grad is not None:
             g = self._data._grad
-            g._data = jnp.zeros_like(g._data)
+            from ..ndarray.sparse import BaseSparseNDArray, zeros as _sp_zeros
+            if isinstance(g, BaseSparseNDArray):
+                # reset to an EMPTY row set — zeroing must not densify
+                self._data._grad = _sp_zeros(g.stype, g.shape,
+                                             dtype=str(g.dtype))
+            else:
+                g._data = jnp.zeros_like(g._data)
 
     def reset_ctx(self, ctx):
         pass  # placement is sharding-driven; kept for API parity

@@ -90,6 +90,29 @@ class KVStore:
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        from ..ndarray.sparse import (BaseSparseNDArray, RowSparseNDArray,
+                                      add as _sp_add)
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        if any(isinstance(v, BaseSparseNDArray) for v in vals):
+            # sparse push: aggregate on structure, hand the sparse array to
+            # the updater/optimizer (lazy row updates) or store it sparse —
+            # only row payloads ever move (reference: kvstore_dist.h
+            # row-sparse push, no dense staging)
+            agg_nd = vals[0]
+            for v in vals[1:]:
+                agg_nd = _sp_add(agg_nd, v) \
+                    if isinstance(agg_nd, RowSparseNDArray) \
+                    and isinstance(v, RowSparseNDArray) else agg_nd + v
+            if self._optimizer is not None:
+                self._opt_updater(key, agg_nd, self._store[key])
+            elif self._updater is not None:
+                if key not in self._store:
+                    self._store[key] = NDArray(
+                        jnp.zeros(agg_nd.shape, agg_nd._sp_data.dtype))
+                self._updater(key, agg_nd, self._store[key])
+            else:
+                self._store[key] = agg_nd
+            return
         if isinstance(value, (list, tuple)):
             agg = value[0]._data
             for v in value[1:]:
@@ -127,16 +150,31 @@ class KVStore:
         self.pull(key, out=out if out is not None else value, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the requested rows (reference: PullRowSparse)."""
+        """Pull only the requested rows (reference: PullRowSparse). Only row
+        payloads move; a RowSparseNDArray `out` receives the structure
+        directly and nothing densifies."""
+        import numpy as _host_np
+        from ..ndarray.sparse import RowSparseNDArray, retain as _retain
         value = self._store[key]
         if row_ids is None:
             return self.pull(key, out=out, priority=priority)
-        rids = row_ids.asnumpy().astype("int32") if hasattr(row_ids, "asnumpy") else row_ids
-        rows = value._data[jnp.asarray(rids)]
-        full = jnp.zeros_like(value._data).at[jnp.asarray(rids)].set(rows)
+        rids = _host_np.unique(_host_np.asarray(
+            row_ids.asnumpy() if hasattr(row_ids, "asnumpy") else row_ids
+        ).ravel()).astype("int32")
+        if isinstance(value, RowSparseNDArray):
+            pulled = _retain(value, rids)
+            rows, rids = pulled._sp_data, _host_np.asarray(pulled._sp_indices)
+        else:
+            rows = jnp.take(value._data, jnp.asarray(rids), axis=0)
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
-            o._data = full
+            if isinstance(o, RowSparseNDArray):
+                o._sp_data = rows
+                o._sp_indices = jnp.asarray(rids, dtype=jnp.int32)
+                o._dense_cache = None
+            else:   # dense out keeps legacy scatter-into-zeros behavior
+                o._data = jnp.zeros(value.shape, rows.dtype).at[
+                    jnp.asarray(rids)].set(rows)
         return out
 
     # -- persistence ---------------------------------------------------------

@@ -181,6 +181,21 @@ class NDArray:
             self._grad = grad if grad is not None else NDArray(jnp.zeros(self.shape, self._data.dtype))
 
     def _accumulate_grad(self, ct):
+        from .sparse import BaseSparseNDArray, RowSparseNDArray, add as _sp_add
+        if isinstance(ct, BaseSparseNDArray):
+            # sparse cotangent (e.g. Embedding sparse_grad): the grad buffer
+            # BECOMES the row-sparse array — memory ∝ touched rows
+            # (reference: kRowSparseStorage gradients, indexing_op.cc)
+            if self._grad_req == "add":
+                if isinstance(self._grad, RowSparseNDArray):
+                    self._grad = _sp_add(self._grad, ct)
+                else:   # accumulate into an existing dense buffer
+                    self._grad._data = self._grad._data.at[
+                        ct._sp_indices].add(ct._sp_data.astype(
+                            self._grad._data.dtype))
+            else:
+                self._grad = ct
+            return
         if self._grad_req == "add":
             self._grad._data = self._grad._data + ct.astype(self._grad._data.dtype)
         else:

@@ -1,45 +1,166 @@
-"""Sparse NDArray formats: CSR and row-sparse.
+"""Sparse NDArray formats: CSR and row-sparse — REAL sparse storage.
 
 Reference parity: include/mxnet/ndarray.h storage types kCSRStorage /
 kRowSparseStorage + python/mxnet/ndarray/sparse.py (CSRNDArray,
 RowSparseNDArray, cast_storage, retain, sparse dot) per SURVEY §2.1/2.6.
 
-TPU-first: XLA has no native sparse storage, so both formats are explicit
-structure-of-arrays over dense jax buffers with static nnz; compute lowers to
-gather/scatter/segment-sum which XLA maps onto the VPU. Dense fallback always
-exists (reference: storage-fallback densification, imperative_utils.h:280).
+TPU-first design: XLA has no native sparse storage, so both formats are
+explicit structure-of-arrays over dense jax buffers — (data, indices[,
+indptr]) — whose sizes scale with nnz, NOT with the logical shape. Nothing
+densifies at construction: the dense view is materialized lazily, only when
+an operation genuinely requires it (the reference's storage-fallback
+densification, imperative_utils.h:280), and sparse-aware consumers (lazy
+optimizer updates, KVStore row_sparse_pull, sparse embedding gradients)
+never trigger it. `arr._dense_cache is None` is the tested invariant that a
+code path stayed sparse. Compute on structure lowers to gather/scatter/
+segment-sum, which XLA maps onto the VPU.
 """
 
 import numpy as _np
+import jax
 import jax.numpy as jnp
 
-from .ndarray import NDArray, array as _dense_array
+from .ndarray import NDArray
 
-__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
-           "cast_storage", "retain", "dot"]
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage", "retain", "dot",
+           "zeros", "add", "subtract", "multiply"]
 
 
 class BaseSparseNDArray(NDArray):
-    pass
+    """Sparse base: holds structure arrays only; the dense view is lazy.
+
+    Subclasses must set `_sp_shape` and `_sp_data` and implement
+    `_make_dense()`. The `_data` property densifies on first use and caches;
+    sparse-aware code paths must go through the structure properties and
+    never touch `_data`.
+    """
+
+    def _init_sparse(self, shape):
+        # NDArray.__init__ is intentionally NOT called: there is no dense
+        # buffer. Reproduce the tape-protocol attributes it sets.
+        self._sp_shape = tuple(int(s) for s in shape)
+        self._dense_cache = None
+        self._structure_stale = False
+        self._node = None
+        self._out_index = 0
+        self._grad = None
+        self._grad_req = None
+
+    # -- dense view (lazy) ---------------------------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._make_dense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        # in-place dense write (e.g. kvstore pull into this buffer): the
+        # dense view becomes authoritative and the structure arrays are
+        # STALE — they are lazily recomputed from the dense view on next
+        # structure access (reference: CheckAndAlloc dense fallback). This
+        # keeps sparse-aware consumers (lazy optimizers, retain, pulls)
+        # correct even after a dense write, at the cost of a host sync.
+        self._dense_cache = value
+        self._structure_stale = True
+
+    def _ensure_fresh(self):
+        if getattr(self, "_structure_stale", False):
+            self._structure_stale = False
+            self._refresh_structure_from_dense()
+
+    # structure accessors: plain attribute reads routed through the
+    # staleness check so a dense write can never be silently shadowed by
+    # obsolete (indices, values)
+    @property
+    def _sp_data(self):
+        self._ensure_fresh()
+        return self._sp_data_
+
+    @_sp_data.setter
+    def _sp_data(self, v):
+        self._sp_data_ = v
+        self._structure_stale = False
+
+    @property
+    def _sp_indices(self):
+        self._ensure_fresh()
+        return self._sp_indices_
+
+    @_sp_indices.setter
+    def _sp_indices(self, v):
+        self._sp_indices_ = v
+
+    # -- metadata from structure (no densification) --------------------------
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._sp_data.dtype) \
+            if self._sp_data.dtype != jnp.bfloat16 else self._sp_data.dtype
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._sp_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    @property
+    def nnz(self):
+        return int(self._sp_data.shape[0])
+
+    def __repr__(self):
+        return "<%s %s @%s, nnz-rows/elems=%d>" % (
+            type(self).__name__, "x".join(map(str, self._sp_shape)),
+            "sparse", self._sp_data.shape[0])
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """Compressed sparse row matrix: (data, indices, indptr)."""
+    """Compressed sparse row matrix: (data (nnz,), indices (nnz,),
+    indptr (m+1,)). Storage ∝ nnz."""
 
     def __init__(self, data, indices, indptr, shape):
         self._sp_data = jnp.asarray(data)
         self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
-        self._sp_indptr = jnp.asarray(indptr, dtype=jnp.int32)
-        self._sp_shape = tuple(shape)
-        super().__init__(self._to_dense_val())
+        self._sp_indptr_ = jnp.asarray(indptr, dtype=jnp.int32)
+        self._init_sparse(shape)
 
-    def _to_dense_val(self):
+    @property
+    def _sp_indptr(self):
+        self._ensure_fresh()
+        return self._sp_indptr_
+
+    @_sp_indptr.setter
+    def _sp_indptr(self, v):
+        self._sp_indptr_ = v
+
+    def _refresh_structure_from_dense(self):
+        import scipy.sparse as sps
+        m = sps.csr_matrix(_np.asarray(self._dense_cache))
+        self._sp_data_ = jnp.asarray(m.data)
+        self._sp_indices_ = jnp.asarray(m.indices, dtype=jnp.int32)
+        self._sp_indptr_ = jnp.asarray(m.indptr, dtype=jnp.int32)
+
+    def _make_dense(self):
         n_rows = self._sp_shape[0]
         counts = self._sp_indptr[1:] - self._sp_indptr[:-1]
         rows = jnp.repeat(jnp.arange(n_rows), counts,
                           total_repeat_length=self._sp_data.shape[0])
         dense = jnp.zeros(self._sp_shape, self._sp_data.dtype)
         return dense.at[rows, self._sp_indices].add(self._sp_data)
+
+    def _row_ids(self):
+        counts = self._sp_indptr[1:] - self._sp_indptr[:-1]
+        return jnp.repeat(jnp.arange(self._sp_shape[0]), counts,
+                          total_repeat_length=self._sp_data.shape[0])
 
     @property
     def stype(self):
@@ -61,25 +182,54 @@ class CSRNDArray(BaseSparseNDArray):
         if stype == "csr":
             return self
         if stype == "default":
-            return NDArray(self._data)
-        return cast_storage(NDArray(self._data), stype)
+            return NDArray(self._make_dense())
+        if stype == "row_sparse":
+            # structure-level conversion: rows with any nonzero become
+            # stored rows; memory ∝ nnz, the dense view is never built
+            counts = _np.asarray(self._sp_indptr[1:] - self._sp_indptr[:-1])
+            rids = _np.nonzero(counts > 0)[0].astype(_np.int32)
+            if not len(rids):
+                return zeros("row_sparse", self._sp_shape,
+                             dtype=str(self.dtype))
+            # position of each nnz within the selected-row block
+            row_pos = _np.repeat(_np.arange(len(rids)), counts[rids])
+            rows = jnp.zeros((len(rids), self._sp_shape[1]),
+                             self._sp_data.dtype)
+            rows = rows.at[jnp.asarray(row_pos), self._sp_indices].add(
+                self._sp_data)
+            return RowSparseNDArray(rows, rids, self._sp_shape)
+        raise ValueError("unknown stype %r" % stype)
 
     def asscipy(self):
         import scipy.sparse as sps
         return sps.csr_matrix((_np.asarray(self._sp_data),
                                _np.asarray(self._sp_indices),
-                               _np.asarray(self._sp_indptr)), shape=self._sp_shape)
+                               _np.asarray(self._sp_indptr)),
+                              shape=self._sp_shape)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Row-sparse: (data (nnz_rows, *row_shape), indices (nnz_rows,))."""
+    """Row-sparse: (data (nnz_rows, *row_shape), indices (nnz_rows,)).
+    Storage ∝ number of non-zero rows. The workhorse for large embeddings
+    and their gradients (reference: kRowSparseStorage)."""
 
     def __init__(self, data, indices, shape):
         self._sp_data = jnp.asarray(data)
         self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
-        self._sp_shape = tuple(shape)
+        self._init_sparse(shape)
+
+    def _refresh_structure_from_dense(self):
+        dense = _np.asarray(self._dense_cache)
+        nz = _np.where(_np.abs(dense).reshape(dense.shape[0], -1)
+                       .sum(axis=1) > 0)[0]
+        self._sp_data_ = jnp.asarray(dense[nz])
+        self._sp_indices_ = jnp.asarray(nz.astype(_np.int32))
+
+    def _make_dense(self):
         dense = jnp.zeros(self._sp_shape, self._sp_data.dtype)
-        super().__init__(dense.at[self._sp_indices].set(self._sp_data))
+        if self._sp_data.shape[0] == 0:
+            return dense
+        return dense.at[self._sp_indices].set(self._sp_data)
 
     @property
     def stype(self):
@@ -97,8 +247,20 @@ class RowSparseNDArray(BaseSparseNDArray):
         if stype == "row_sparse":
             return self
         if stype == "default":
-            return NDArray(self._data)
-        return cast_storage(NDArray(self._data), stype)
+            return NDArray(self._make_dense())
+        if stype == "csr":
+            return csr_matrix(NDArray(self._make_dense()))
+        raise ValueError("unknown stype %r" % stype)
+
+    # sparse-aware arithmetic: rsp+rsp stays sparse (gradient accumulation
+    # path — grad_req='add' / multi-call embeddings must not densify)
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return add(self, other)
+        return NDArray.__add__(self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
@@ -111,11 +273,13 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         return CSRNDArray(data.astype(dtype or "float32"), indices, indptr, shape)
     if hasattr(arg1, "tocsr"):  # scipy
         m = arg1.tocsr()
-        return CSRNDArray(m.data.astype(dtype or "float32"), m.indices, m.indptr, m.shape)
+        return CSRNDArray(m.data.astype(dtype or "float32"), m.indices,
+                          m.indptr, m.shape)
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
     import scipy.sparse as sps
     m = sps.csr_matrix(dense)
-    return CSRNDArray(m.data.astype(dtype or dense.dtype), m.indices, m.indptr, dense.shape)
+    return CSRNDArray(m.data.astype(dtype or dense.dtype), m.indices,
+                      m.indptr, dense.shape)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -123,14 +287,26 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         data, indices = arg1
         data = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
         indices = indices.asnumpy() if isinstance(indices, NDArray) else _np.asarray(indices)
+        if len(indices) and not _np.all(indices[:-1] < indices[1:]):
+            # enforce the sorted-unique row-id invariant the structure ops
+            # rely on (reference: RowSparseAux kIdx is sorted)
+            order = _np.argsort(indices)
+            indices = _np.asarray(indices)[order]
+            data = _np.asarray(data)[order]
+            if _np.any(indices[:-1] == indices[1:]):
+                raise ValueError("row_sparse_array: duplicate row indices")
         return RowSparseNDArray(data.astype(dtype or "float32"), indices, shape)
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
     nz = _np.where(_np.abs(dense).reshape(dense.shape[0], -1).sum(axis=1) > 0)[0]
-    return RowSparseNDArray(dense[nz].astype(dtype or dense.dtype), nz, dense.shape)
+    return RowSparseNDArray(dense[nz].astype(dtype or dense.dtype), nz,
+                            dense.shape)
 
 
 def cast_storage(arr, stype):
-    """reference: cast_storage op (cast_storage-inl.h)."""
+    """reference: cast_storage op (cast_storage-inl.h). Sparse→sparse and
+    sparse→dense go through `tostype` (structure-level where possible)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
     if stype == "default":
         return NDArray(arr._data)
     if stype == "csr":
@@ -141,28 +317,78 @@ def cast_storage(arr, stype):
 
 
 def retain(arr, indices):
-    """Keep only the given rows of a row_sparse array (reference: sparse_retain)."""
+    """Keep only the given rows of a row_sparse array (reference:
+    sparse_retain) — pure structure op, nothing densifies."""
     if not isinstance(arr, RowSparseNDArray):
         raise TypeError("retain expects RowSparseNDArray")
-    idx = indices.asnumpy().astype(_np.int32) if isinstance(indices, NDArray) \
-        else _np.asarray(indices, dtype=_np.int32)
-    dense = _np.asarray(arr._data)
-    return RowSparseNDArray(dense[idx], idx, arr._sp_shape)
+    idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) \
+        else _np.asarray(indices, dtype=_np.int64)
+    idx = _np.unique(idx)               # sorted unique request
+    own = _np.asarray(arr._sp_indices)
+    # positions of requested ids that are present in arr's row set; robust
+    # to unsorted stored indices (sorted is the invariant, but a stale-
+    # structure refresh or user construction must not break correctness)
+    order = _np.argsort(own, kind="stable")
+    own_sorted = own[order]
+    pos = _np.searchsorted(own_sorted, idx)
+    pos_c = _np.clip(pos, 0, max(len(own) - 1, 0))
+    present = (own_sorted[pos_c] == idx) if len(own) \
+        else _np.zeros(len(idx), bool)
+    keep_ids = idx[present].astype(_np.int32)
+    rows = jnp.take(arr._sp_data, jnp.asarray(order[pos_c[present]]), axis=0) \
+        if present.any() else jnp.zeros((0,) + arr._sp_data.shape[1:],
+                                        arr._sp_data.dtype)
+    return RowSparseNDArray(rows, keep_ids, arr._sp_shape)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse-aware dot; densifies (XLA fuses the gather) — capability parity
-    with the reference's dot(csr, dense)."""
+    """Sparse dot. csr × dense runs as a real sparse matvec/matmat
+    (gather + segment-sum over nnz — reference: src/operator/tensor/
+    dot-inl.h SpMM); csr^T × dense scatter-adds into the output rows.
+    Dense × dense falls through to the dense op."""
+    rhs_v = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    if isinstance(lhs, CSRNDArray):
+        rows = lhs._row_ids()
+        cols = lhs._sp_indices
+        vals = lhs._sp_data
+        if transpose_b:
+            rhs_v = rhs_v.T
+        vec_rhs = rhs_v.ndim == 1           # SpMV: treat as (n, 1), squeeze
+        if vec_rhs:
+            rhs_v = rhs_v[:, None]
+        if not transpose_a:
+            # out[r] += v * rhs[c]  per nnz
+            contrib = vals[:, None] * jnp.take(rhs_v, cols, axis=0)
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=lhs._sp_shape[0])
+        else:
+            # csr^T: out[c] += v * rhs[r]
+            contrib = vals[:, None] * jnp.take(rhs_v, rows, axis=0)
+            out = jnp.zeros((lhs._sp_shape[1], rhs_v.shape[1]), contrib.dtype)
+            out = out.at[cols].add(contrib)
+        return NDArray(out[:, 0] if vec_rhs else out)
+    if isinstance(lhs, RowSparseNDArray) and not transpose_a:
+        # rows of the output are dense anyway; compute on the stored rows
+        # then scatter (memory ∝ nnz-rows for the lhs side)
+        rhs_v = rhs_v.T if transpose_b else rhs_v
+        vec_rhs = rhs_v.ndim == 1
+        if vec_rhs:
+            rhs_v = rhs_v[:, None]
+        part = jnp.matmul(lhs._sp_data, rhs_v)
+        out = jnp.zeros((lhs._sp_shape[0], part.shape[1]), part.dtype)
+        out = out.at[lhs._sp_indices].set(part)
+        return NDArray(out[:, 0] if vec_rhs else out)
     from . import dot as _dense_dot
-    return _dense_dot(NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs,
-                      NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs,
-                      transpose_a=transpose_a, transpose_b=transpose_b)
+    lv = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
+    rv = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _dense_dot(lv, rv, transpose_a=transpose_a, transpose_b=transpose_b)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "row_sparse":
-        return RowSparseNDArray(_np.zeros((0,) + tuple(shape[1:]), dtype or "float32"),
-                                _np.zeros((0,), _np.int32), shape)
+        return RowSparseNDArray(
+            _np.zeros((0,) + tuple(shape[1:]), dtype or "float32"),
+            _np.zeros((0,), _np.int32), shape)
     if stype == "csr":
         return CSRNDArray(_np.zeros((0,), dtype or "float32"),
                           _np.zeros((0,), _np.int32),
@@ -171,14 +397,25 @@ def zeros(stype, shape, ctx=None, dtype=None):
     return _z(shape, ctx=ctx, dtype=dtype)
 
 
+def _union_rsp(lhs, rhs, sign):
+    """rsp ± rsp on structure: union the row sets, segment-add the rows."""
+    li = _np.asarray(lhs._sp_indices)
+    ri = _np.asarray(rhs._sp_indices)
+    union, l_pos = _np.unique(_np.concatenate([li, ri]), return_inverse=True)
+    n = len(union)
+    lrows = jnp.zeros((n,) + lhs._sp_data.shape[1:], lhs._sp_data.dtype)
+    lrows = lrows.at[jnp.asarray(l_pos[:len(li)])].add(lhs._sp_data)
+    rrows = jnp.zeros((n,) + rhs._sp_data.shape[1:], rhs._sp_data.dtype)
+    rrows = rrows.at[jnp.asarray(l_pos[len(li):])].add(rhs._sp_data)
+    return RowSparseNDArray(lrows + sign * rrows, union.astype(_np.int32),
+                            lhs._sp_shape)
+
+
 def add(lhs, rhs):
     """Elementwise add with sparse-aware result storage (reference:
     mx.nd.sparse.add — rsp+rsp stays row_sparse, anything else densifies)."""
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
-        idx = _np.union1d(_np.asarray(lhs._sp_indices),
-                          _np.asarray(rhs._sp_indices)).astype(_np.int32)
-        dense = _np.asarray(lhs._data) + _np.asarray(rhs._data)
-        return RowSparseNDArray(dense[idx], idx, lhs._sp_shape)
+        return _union_rsp(lhs, rhs, 1.0)
     lv = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
     rv = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
     return lv + rv
@@ -187,22 +424,64 @@ def add(lhs, rhs):
 def subtract(lhs, rhs):
     """See ``add``."""
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
-        idx = _np.union1d(_np.asarray(lhs._sp_indices),
-                          _np.asarray(rhs._sp_indices)).astype(_np.int32)
-        dense = _np.asarray(lhs._data) - _np.asarray(rhs._data)
-        return RowSparseNDArray(dense[idx], idx, lhs._sp_shape)
+        return _union_rsp(lhs, rhs, -1.0)
     lv = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
     rv = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
     return lv - rv
 
 
 def multiply(lhs, rhs):
-    """Elementwise multiply; rsp*rsp intersects row sets."""
+    """Elementwise multiply; rsp*rsp intersects row sets (structure op)."""
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
-        idx = _np.intersect1d(_np.asarray(lhs._sp_indices),
-                              _np.asarray(rhs._sp_indices)).astype(_np.int32)
-        dense = _np.asarray(lhs._data) * _np.asarray(rhs._data)
-        return RowSparseNDArray(dense[idx], idx, lhs._sp_shape)
+        li = _np.asarray(lhs._sp_indices)
+        ri = _np.asarray(rhs._sp_indices)
+        common, l_idx, r_idx = _np.intersect1d(li, ri, return_indices=True)
+        rows = (jnp.take(lhs._sp_data, jnp.asarray(l_idx), axis=0)
+                * jnp.take(rhs._sp_data, jnp.asarray(r_idx), axis=0)) \
+            if len(common) else jnp.zeros((0,) + lhs._sp_data.shape[1:],
+                                          lhs._sp_data.dtype)
+        return RowSparseNDArray(rows, common.astype(_np.int32), lhs._sp_shape)
     lv = NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs
     rv = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
     return lv * rv
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding gradient (reference: _backward_Embedding with
+# sparse_grad=True emits a kRowSparseStorage gradient,
+# src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+def sparse_embedding(x, weight, input_dim, output_dim):
+    """Eager embedding lookup whose recorded gradient w.r.t. `weight` is a
+    RowSparseNDArray over the batch's UNIQUE ids — memory ∝ touched rows,
+    never ∝ vocab. Ids are concrete in eager mode, so the unique set is
+    computed on host at forward time; the backward segment-sums cotangent
+    rows on device."""
+    from .. import autograd as _ag
+    from ..autograd import TapeNode
+
+    xv = x._data
+    wv = weight._data
+    out_v = jnp.take(wv, xv.astype(jnp.int32), axis=0)
+    out = NDArray(out_v)
+    if not _ag.is_recording():
+        return out
+
+    ids = _np.unique(_np.asarray(xv).ravel()).astype(_np.int64)
+    inv = _np.searchsorted(ids, _np.asarray(xv).ravel())
+    inv_j = jnp.asarray(inv, dtype=jnp.int32)
+    n_unique = len(ids)
+    shape = (int(input_dim), int(output_dim))
+
+    def vjp_fn(dy):
+        vals = jax.ops.segment_sum(
+            dy.reshape(-1, dy.shape[-1]).astype(wv.dtype), inv_j,
+            num_segments=n_unique)
+        return (None, RowSparseNDArray(vals, ids.astype(_np.int32), shape))
+
+    node = TapeNode([x, weight], vjp_fn, 1, [(out_v.shape, out_v.dtype)],
+                    op_name="SparseEmbedding", fn=None)
+    out._node = node
+    out._out_index = 0
+    return out

@@ -174,3 +174,53 @@ def _sgld_update(w, g, lr, wd, noise, rescale, clip):
     return w - lr / 2 * g + jnp.sqrt(lr) * noise
 
 
+
+
+# ---------------------------------------------------------------------------
+# lazy row-sparse update kernels (reference: the sparse/lazy branches of
+# optimizer_op.cc — SGDUpdateRspImpl / SGDMomLazyUpdateRspImpl /
+# AdamLazyUpdateRspImpl / AdagradUpdateRspImpl). Only the rows present in
+# the gradient are touched: gather -> fused row update -> scatter. Memory
+# and compute scale with nnz rows, never with the full table.
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _sgd_lazy_update(w, idx, g, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    rows = jnp.take(w, idx, axis=0)
+    return w.at[idx].set(rows - lr * (g + wd * rows))
+
+
+@jax.jit
+def _sgd_mom_lazy_update(w, idx, g, mom, lr, wd, momentum, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    wrows = jnp.take(w, idx, axis=0)
+    mrows = jnp.take(mom, idx, axis=0)
+    mrows = momentum * mrows - lr * (g + wd * wrows)
+    return w.at[idx].set(wrows + mrows), mom.at[idx].set(mrows)
+
+
+@jax.jit
+def _adam_lazy_update(w, idx, g, m, v, lr, wd, b1, b2, eps, t, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    wrows = jnp.take(w, idx, axis=0)
+    g = g + wd * wrows
+    mrows = b1 * jnp.take(m, idx, axis=0) + (1 - b1) * g
+    vrows = b2 * jnp.take(v, idx, axis=0) + (1 - b2) * g * g
+    coef = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+    return (w.at[idx].set(wrows - coef * mrows / (jnp.sqrt(vrows) + eps)),
+            m.at[idx].set(mrows), v.at[idx].set(vrows))
+
+
+@jax.jit
+def _adagrad_lazy_update(w, idx, g, h, lr, wd, eps, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    wrows = jnp.take(w, idx, axis=0)
+    g = g + wd * wrows
+    hrows = jnp.take(h, idx, axis=0) + g * g
+    return (w.at[idx].set(wrows - lr * g / (jnp.sqrt(hrows) + eps)),
+            h.at[idx].set(hrows))

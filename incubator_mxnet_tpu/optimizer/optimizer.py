@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ndarray import NDArray
-from ..ops._optim_kernels import (_sgd_update, _sgd_mom_update, _nag_update, _adam_update, _adamw_update, _adagrad_update, _rmsprop_update, _rmspropalex_update, _adadelta_update, _adamax_update, _nadam_update, _ftrl_update, _signsgd_update, _signum_update, _ftml_update, _sgld_update)  # noqa: F401
+from ..ops._optim_kernels import (_sgd_update, _sgd_mom_update, _nag_update, _adam_update, _adamw_update, _adagrad_update, _rmsprop_update, _rmspropalex_update, _adadelta_update, _adamax_update, _nadam_update, _ftrl_update, _signsgd_update, _signum_update, _ftml_update, _sgld_update, _sgd_lazy_update, _sgd_mom_lazy_update, _adam_lazy_update, _adagrad_lazy_update)  # noqa: F401
 
 __all__ = ["Optimizer", "register", "create", "Updater", "get_updater"]
 
@@ -150,6 +150,21 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # lazy sparse update: touch ONLY the gradient's rows (reference:
+            # SGDUpdateRspImpl / SGDMomLazyUpdateRspImpl, optimizer_op.cc)
+            idx, vals = grad._sp_indices, grad._sp_data
+            if state is None:
+                weight._data = _sgd_lazy_update(
+                    weight._data, idx, vals, jnp.float32(lr), jnp.float32(wd),
+                    jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+            else:
+                weight._data, state._data = _sgd_mom_lazy_update(
+                    weight._data, idx, vals, state._data, jnp.float32(lr),
+                    jnp.float32(wd), jnp.float32(self.momentum),
+                    jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+            return
         if state is None:
             weight._data = _sgd_update(weight._data, grad._data,
                                        jnp.float32(lr), jnp.float32(wd),
@@ -242,6 +257,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, weight._data.dtype)
@@ -251,6 +267,18 @@ class Adam(Optimizer):
         self._update_count(index)
         t = self._index_update_count[index]
         m, v = state
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # reference: AdamLazyUpdateRspImpl — m/v/w rows touched only
+            # where the gradient has rows
+            weight._data, m._data, v._data = _adam_lazy_update(
+                weight._data, grad._sp_indices, grad._sp_data, m._data,
+                v._data, jnp.float32(self._get_lr(index)),
+                jnp.float32(self._get_wd(index)), jnp.float32(self.beta1),
+                jnp.float32(self.beta2), jnp.float32(self.epsilon),
+                jnp.float32(t), jnp.float32(self.rescale_grad),
+                _c(self.clip_gradient))
+            return
         weight._data, m._data, v._data = _adam_update(
             weight._data, grad._data, m._data, v._data,
             jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
@@ -295,6 +323,16 @@ class AdaGrad(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            # reference: AdagradUpdateRspImpl (sparse-native optimizer)
+            weight._data, state._data = _adagrad_lazy_update(
+                weight._data, grad._sp_indices, grad._sp_data, state._data,
+                jnp.float32(self._get_lr(index)),
+                jnp.float32(self._get_wd(index)),
+                jnp.float32(self.float_stable_eps),
+                jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+            return
         weight._data, state._data = _adagrad_update(
             weight._data, grad._data, state._data,
             jnp.float32(self._get_lr(index)), jnp.float32(self._get_wd(index)),
