@@ -61,7 +61,7 @@ class Parameter:
                 self._data._grad = None
                 self._data._grad_req = "null"
             else:
-                self._data.attach_grad(req)
+                self._data.attach_grad(req, stype=self._grad_stype)
 
     def _shape_known(self):
         return self.shape is not None and all(s > 0 for s in self.shape)
@@ -100,7 +100,8 @@ class Parameter:
         self._data = data
         self._deferred_init = None
         if self._grad_req != "null":
-            self._data.attach_grad(self._grad_req)
+            self._data.attach_grad(self._grad_req,
+                                    stype=self._grad_stype)
 
     def _finish_deferred_init(self, in_shape_hint=None):
         if self._deferred_init is None:

@@ -7,10 +7,22 @@ rank/num_workers/barrier; server-side optimizer from worker 0) per SURVEY
 §2.4 / call stack §3.5. Bootstrap env mirrors the reference's dmlc vars:
 DMLC_ROLE, DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER,
 DMLC_NUM_SERVER.
-"""
+
+Comm/compute overlap: pushes are ASYNC by default — each (key, shard) send
+runs on an I/O thread with per-key ordering, the engine-style dependency
+the reference gets from Engine::PushAsync + FnProperty::kCopyToDevice
+priorities (include/mxnet/engine.h:95). `pull`/`row_sparse_pull` on a key
+waits for that key's in-flight pushes; `barrier`/`close` drain everything.
+Set MXTPU_PS_ASYNC_PUSH=0 for fully synchronous sends.
+
+Liveness: a background heartbeat thread beats the scheduler
+(`get_num_dead_node` surfaces stale peers); `barrier()` RAISES on timeout
+or when the scheduler reports a dead participant, instead of hanging."""
 
 import os
 import pickle
+import threading
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -39,10 +51,19 @@ class KVStoreDist(KVStore):
         self._sync_mode = sync_mode
         self._sched = SchedulerClient((uri, port))
         self._rank = self._sched.register("worker", ("127.0.0.1", 0))
+        self._sched.start_heartbeats("worker", self._rank)
         nodes = self._sched.get_nodes()
         self._servers = [Connection(tuple(a)) for _, a in
                          sorted(nodes["servers"].items())]
         self._key_shard = {}
+        self._async_push = os.environ.get("MXTPU_PS_ASYNC_PUSH", "1") != "0"
+        # one lane per server: sends to different servers overlap, sends on
+        # one connection serialize (the Connection lock would anyway)
+        self._io = ThreadPoolExecutor(
+            max_workers=max(2, len(self._servers))) if self._async_push else None
+        self._pending = {}       # key -> [futures]
+        self._chain = {}         # key -> last submitted future (ordering)
+        self._pending_lock = threading.Lock()
 
     # -- identity ------------------------------------------------------------
     @property
@@ -57,11 +78,49 @@ class KVStoreDist(KVStore):
     def num_workers(self):
         return self._num_workers
 
-    def barrier(self):
-        self._sched.barrier("worker")
+    def barrier(self, timeout=600):
+        self._flush()
+        self._sched.barrier("worker", timeout=timeout)
 
-    def get_num_dead_node(self, node_id=0, timeout=60):
-        return self._sched.num_dead_nodes(timeout)
+    def get_num_dead_node(self, node_id=0, timeout=None):
+        from .dist_server import _DEAD_TIMEOUT
+        return self._sched.num_dead_nodes(timeout or _DEAD_TIMEOUT)
+
+    # -- async push bookkeeping ----------------------------------------------
+    def _submit(self, key, fn):
+        """Queue a send with PER-KEY ordering: each key's sends chain on the
+        key's previous future (safe with a FIFO pool — a task only ever
+        waits on strictly earlier-submitted tasks). Cross-key sends
+        overlap freely."""
+        if self._io is None:
+            fn()
+            return
+        with self._pending_lock:
+            prev = self._chain.get(key)
+
+            def run(_prev=prev):
+                if _prev is not None:
+                    try:
+                        _prev.result()
+                    except Exception:
+                        pass    # predecessor failure surfaces via _flush
+                return fn()
+
+            fut = self._io.submit(run)
+            self._chain[key] = fut
+            self._pending.setdefault(key, []).append(fut)
+
+    def _flush(self, key=None):
+        """Wait for in-flight pushes (one key, or all). Raises the first
+        transport error — a lost push must not be silent."""
+        with self._pending_lock:
+            if key is None:
+                futs = [f for fs in self._pending.values() for f in fs]
+                self._pending.clear()
+            else:
+                futs = self._pending.pop(key, [])
+        for f in futs:
+            f.result()
 
     # -- key -> server placement (reference: EncodeDefaultKey) ---------------
     def _shards_for(self, key, shape):
@@ -95,7 +154,7 @@ class KVStoreDist(KVStore):
             part = arr[lo:hi] if arr.ndim else arr
             self._servers[sid].call(
                 {"op": "init", "key": self._part_key(key, lo),
-                 "shape": part.shape, "dtype": str(part.dtype)},
+                 "shape": list(part.shape), "dtype": str(part.dtype)},
                 np.ascontiguousarray(part).tobytes())
         # mirror shape for pulls
         self._store[key] = NDArray(value._data)
@@ -109,13 +168,22 @@ class KVStoreDist(KVStore):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
-        if isinstance(value, (list, tuple)):  # local pre-aggregation
-            agg = value[0]._data
-            for v in value[1:]:
-                agg = agg + v._data
-            arr = np.asarray(agg, dtype=np.float32)
+        from ..ndarray.sparse import RowSparseNDArray, add as _sp_add
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        if any(isinstance(v, RowSparseNDArray) for v in vals):
+            agg = vals[0]
+            for v in vals[1:]:
+                agg = _sp_add(agg, v)
+            if isinstance(agg, RowSparseNDArray):
+                return self._push_row_sparse(key, agg)
+            vals = [agg]    # mixed dense+sparse: aggregation densified
+        if len(vals) > 1:   # local pre-aggregation
+            acc = vals[0]._data
+            for v in vals[1:]:
+                acc = acc + v._data
+            arr = np.asarray(acc, dtype=np.float32)
         else:
-            arr = np.asarray(value._data, dtype=np.float32)
+            arr = np.asarray(vals[0]._data, dtype=np.float32)
         compressed = self._compression is not None
         for sid, lo, hi in self._shards_for(key, arr.shape):
             part = arr[lo:hi] if arr.ndim else arr
@@ -124,27 +192,53 @@ class KVStoreDist(KVStore):
                 q = self._compression.compress(self._part_key(key, lo),
                                                jnp.asarray(part))
                 packed = np.asarray(self._compression.pack(q), dtype=np.int32)
-                self._servers[sid].call(
-                    {"op": "push", "key": self._part_key(key, lo),
-                     "shape": part.shape, "dtype": "float32",
-                     "compressed": True}, packed.tobytes())
+                meta = {"op": "push", "key": self._part_key(key, lo),
+                        "shape": list(part.shape), "dtype": "float32",
+                        "compressed": True, "rank": self._rank}
+                payload = packed.tobytes()
             else:
-                self._servers[sid].call(
-                    {"op": "push", "key": self._part_key(key, lo),
-                     "shape": part.shape, "dtype": str(part.dtype)},
-                    np.ascontiguousarray(part).tobytes())
+                meta = {"op": "push", "key": self._part_key(key, lo),
+                        "shape": list(part.shape), "dtype": str(part.dtype),
+                        "rank": self._rank}
+                payload = np.ascontiguousarray(part).tobytes()
+            conn = self._servers[sid]
+            self._submit(key, lambda c=conn, m=meta, p=payload: c.call(m, p))
+
+    def _push_row_sparse(self, key, rsp):
+        """Send only (row ids, row payloads) per shard (reference:
+        kvstore_dist.h PushRowSparse — no dense staging anywhere)."""
+        ids = np.asarray(rsp._sp_indices, dtype=np.int64)
+        rows = np.asarray(rsp._sp_data, dtype=np.float32)
+        shape = rsp.shape
+        for sid, lo, hi in self._shards_for(key, shape):
+            mask = (ids >= lo) & (ids < hi)
+            # an empty shard still sends a zero-row message: sync-mode
+            # servers count one push per worker per round, so skipping
+            # would desynchronize the aggregation generation
+            local = (ids[mask] - lo).tolist()
+            part = np.ascontiguousarray(rows[mask])
+            meta = {"op": "push", "key": self._part_key(key, lo),
+                    "shape": list(part.shape), "dtype": str(part.dtype),
+                    "rows": local, "rank": self._rank}
+            conn = self._servers[sid]
+            self._submit(key,
+                         lambda c=conn, m=meta, p=part.tobytes(): c.call(m, p))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
             for k, o in zip(key, out):
                 self.pull(k, out=o, priority=priority)
             return
+        self._flush(key)
         ref = out if not isinstance(out, (list, tuple)) else out[0]
         shape = tuple(ref.shape)
         parts = []
         for sid, lo, hi in self._shards_for(key, shape):
             meta, payload = self._servers[sid].call(
-                {"op": "pull", "key": self._part_key(key, lo)})
+                {"op": "pull", "key": self._part_key(key, lo),
+                 "rank": self._rank})
+            if meta.get("error"):
+                raise RuntimeError("pull(%r): %s" % (key, meta["error"]))
             parts.append(np.frombuffer(payload, dtype=meta["dtype"])
                          .reshape(meta["shape"]))
         full = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
@@ -152,13 +246,16 @@ class KVStoreDist(KVStore):
         val = jnp.asarray(full)
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
-            o._data = val.astype(o._data.dtype)
+            o._data = val.astype(o.dtype)   # .dtype never densifies sparse
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         if row_ids is None:
             return self.pull(key, out=out, priority=priority)
-        rids = np.asarray(row_ids.asnumpy() if hasattr(row_ids, "asnumpy")
-                          else row_ids, dtype=np.int64)
+        self._flush(key)
+        from ..ndarray.sparse import RowSparseNDArray
+        rids = np.unique(np.asarray(
+            row_ids.asnumpy() if hasattr(row_ids, "asnumpy") else row_ids
+        ).ravel().astype(np.int64))
         ref = out if not isinstance(out, (list, tuple)) else out[0]
         shape = tuple(ref.shape)
         shards = self._shards_for(key, shape)
@@ -170,15 +267,24 @@ class KVStoreDist(KVStore):
             local = rids[mask] - lo
             meta, payload = self._servers[sid].call(
                 {"op": "pull", "key": self._part_key(key, lo),
-                 "rows": local.tolist()})
+                 "rows": local.tolist(), "rank": self._rank})
+            if meta.get("error"):
+                raise RuntimeError("row_sparse_pull(%r): %s"
+                                   % (key, meta["error"]))
             rows_acc[mask] = np.frombuffer(payload, dtype=meta["dtype"]) \
                 .reshape(meta["shape"])
         import jax.numpy as jnp
-        full = jnp.zeros(shape, jnp.float32).at[jnp.asarray(rids)].set(
-            jnp.asarray(rows_acc))
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
-            o._data = full.astype(o._data.dtype)
+            if isinstance(o, RowSparseNDArray):
+                # structure fill: only the row payloads ever exist worker-side
+                o._sp_data = jnp.asarray(rows_acc)
+                o._sp_indices = jnp.asarray(rids.astype(np.int32))
+                o._dense_cache = None
+            else:
+                o._data = jnp.zeros(shape, jnp.float32).at[
+                    jnp.asarray(rids)].set(jnp.asarray(rows_acc)) \
+                    .astype(o._data.dtype)
 
     # -- control -------------------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -188,7 +294,9 @@ class KVStoreDist(KVStore):
         if self._rank == 0:
             blob = pickle.dumps(optimizer)
             for conn in self._servers:
-                conn.call({"op": "set_optimizer"}, blob)
+                meta, _ = conn.call({"op": "set_optimizer"}, blob)
+                if meta.get("error"):
+                    raise RuntimeError(meta["error"])
         self.barrier()
 
     def set_gradient_compression(self, compression_params):
@@ -204,5 +312,11 @@ class KVStoreDist(KVStore):
             conn.call({"op": "command", "head": head, "body": body})
 
     def close(self):
-        for conn in self._servers:
-            conn.close()
+        try:
+            self._flush()
+        finally:
+            self._sched.bye("worker", self._rank)
+            if self._io is not None:
+                self._io.shutdown(wait=True)
+            for conn in self._servers:
+                conn.close()

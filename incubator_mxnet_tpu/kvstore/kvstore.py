@@ -103,16 +103,18 @@ class KVStore:
                 agg_nd = _sp_add(agg_nd, v) \
                     if isinstance(agg_nd, RowSparseNDArray) \
                     and isinstance(v, RowSparseNDArray) else agg_nd + v
-            if self._optimizer is not None:
-                self._opt_updater(key, agg_nd, self._store[key])
-            elif self._updater is not None:
-                if key not in self._store:
-                    self._store[key] = NDArray(
-                        jnp.zeros(agg_nd.shape, agg_nd._sp_data.dtype))
-                self._updater(key, agg_nd, self._store[key])
-            else:
-                self._store[key] = agg_nd
-            return
+            if isinstance(agg_nd, BaseSparseNDArray):
+                if self._optimizer is not None:
+                    self._opt_updater(key, agg_nd, self._store[key])
+                elif self._updater is not None:
+                    if key not in self._store:
+                        self._store[key] = NDArray(
+                            jnp.zeros(agg_nd.shape, agg_nd._sp_data.dtype))
+                    self._updater(key, agg_nd, self._store[key])
+                else:
+                    self._store[key] = agg_nd
+                return
+            value = agg_nd   # mixed dense+sparse densified: dense path below
         if isinstance(value, (list, tuple)):
             agg = value[0]._data
             for v in value[1:]:

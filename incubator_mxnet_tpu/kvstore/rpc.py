@@ -1,24 +1,40 @@
-"""Tiny TCP message layer for the parameter-server processes.
+"""TCP message layer for the parameter-server processes.
 
 Reference parity: the role ps-lite's zmq van/customer plays (SURVEY §2.4) —
-length-prefixed request/response messages between scheduler/servers/workers.
-stdlib-only (sockets + pickle for metadata, raw buffers for tensor payloads);
-the DCN path of a real pod would swap this transport for gRPC without
-touching the KVStore semantics layered above.
+length-prefixed request/response messages between scheduler/servers/workers,
+persistent connections, liveness-aware receive timeouts.
+
+Wire format (typed, no code execution on the metadata path):
+    [u32 meta_len][u32 payload_len][meta: UTF-8 JSON object][payload bytes]
+Metadata is a JSON object (validated to be a dict with a string "op");
+tensor data rides in the raw payload frame. The reference's ps-lite packs
+typed protobuf-ish Meta structs the same way — JSON here keeps the stdlib-
+only promise while staying safe against untrusted peers (the previous
+pickle framing allowed arbitrary object construction from any connecting
+socket). The DCN path of a real pod would swap this transport for gRPC
+without touching the KVStore semantics layered above.
 """
 
-import pickle
+import json
 import socket
 import struct
 import threading
 
 _HDR = struct.Struct("<I")
 
+_MAX_META = 64 * 1024 * 1024        # sanity bounds against garbage frames
+_MAX_PAYLOAD = 1 << 40
+
+
+class ProtocolError(RuntimeError):
+    pass
+
 
 def send_msg(sock, obj, payload=b""):
-    """obj: picklable metadata; payload: raw bytes (tensor data)."""
-    meta = pickle.dumps(obj, protocol=4)
-    sock.sendall(_HDR.pack(len(meta)) + _HDR.pack(len(payload)) + meta + payload)
+    """obj: JSON-serializable metadata dict; payload: raw bytes."""
+    meta = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    sock.sendall(_HDR.pack(len(meta)) + _HDR.pack(len(payload)) + meta
+                 + payload)
 
 
 def recv_msg(sock):
@@ -26,9 +42,20 @@ def recv_msg(sock):
     if hdr is None:
         return None, None
     meta_len, payload_len = _HDR.unpack(hdr[:4])[0], _HDR.unpack(hdr[4:])[0]
-    meta = _recv_exact(sock, meta_len)
+    if meta_len > _MAX_META or payload_len > _MAX_PAYLOAD:
+        raise ProtocolError("frame size out of bounds (%d, %d)"
+                            % (meta_len, payload_len))
+    meta_raw = _recv_exact(sock, meta_len)
+    if meta_raw is None:
+        return None, None
     payload = _recv_exact(sock, payload_len) if payload_len else b""
-    return pickle.loads(meta), payload
+    try:
+        meta = json.loads(meta_raw.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError("bad metadata frame: %s" % e)
+    if not isinstance(meta, dict) or not isinstance(meta.get("op", ""), str):
+        raise ProtocolError("metadata must be a JSON object")
+    return meta, payload
 
 
 def _recv_exact(sock, n):
@@ -42,7 +69,8 @@ def _recv_exact(sock, n):
 
 
 def request(addr, obj, payload=b"", timeout=60.0):
-    """One-shot request/response."""
+    """One-shot request/response (bootstrap only; steady-state traffic uses
+    persistent Connections)."""
     with socket.create_connection(addr, timeout=timeout) as s:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_msg(s, obj, payload)
@@ -50,32 +78,55 @@ def request(addr, obj, payload=b"", timeout=60.0):
 
 
 class Connection:
-    """Persistent connection with per-call locking."""
+    """Persistent connection with per-call locking and auto-reconnect."""
 
     def __init__(self, addr, timeout=120.0):
-        self._addr = addr
+        self._addr = tuple(addr)
         self._timeout = timeout
         self._sock = None
         self._lock = threading.Lock()
 
     def _ensure(self):
         if self._sock is None:
-            self._sock = socket.create_connection(self._addr, timeout=self._timeout)
+            self._sock = socket.create_connection(self._addr,
+                                                  timeout=self._timeout)
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
-    def call(self, obj, payload=b""):
+    def call(self, obj, payload=b"", timeout=None):
         with self._lock:
-            self._ensure()
-            send_msg(self._sock, obj, payload)
-            return recv_msg(self._sock)
+            try:
+                self._ensure()
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
+                send_msg(self._sock, obj, payload)
+                meta, data = recv_msg(self._sock)
+            except (OSError, ProtocolError):
+                # NO automatic resend: the request may already have been
+                # applied server-side (push/register are not idempotent).
+                # Drop the socket so the NEXT call reconnects; surface the
+                # failure to the caller.
+                self._close_locked()
+                raise
+            finally:
+                if timeout is not None and self._sock is not None:
+                    self._sock.settimeout(self._timeout)
+            if meta is None:
+                self._close_locked()
+                raise ConnectionError("peer %s closed the connection"
+                                      % (self._addr,))
+            return meta, data
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def close(self):
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            self._close_locked()
 
 
 class Server:
@@ -110,13 +161,18 @@ class Server:
 
     def _serve_conn(self, conn):
         try:
+            peer = conn.getpeername()[0]
+        except OSError:
+            peer = ""
+        try:
             while not self._stop.is_set():
                 meta, payload = recv_msg(conn)
                 if meta is None:
                     return
+                meta["_peer"] = peer    # server-authoritative, not spoofable
                 out_meta, out_payload = self._handler(meta, payload)
                 send_msg(conn, out_meta, out_payload)
-        except (OSError, EOFError):
+        except (OSError, EOFError, ProtocolError):
             pass
         finally:
             conn.close()

@@ -48,16 +48,19 @@ class MultiHeadAttention(HybridBlock):
 
     def _attend(self, F, q, k, v, mask, B, T, D):
         # Pallas flash-attention fast path (O(T) memory on the MXU) when on
-        # TPU inside a trace with no mask/attention-dropout; einsum otherwise.
+        # TPU inside a trace with no attention-dropout; einsum otherwise.
+        # Valid-length masks ride the kernel's kv-mask path (r2).
         from ..ops.pallas import flash_attention, flash_attention_available
         in_trace = current_trace() is not None
-        # Crossover measured on v5e: XLA-fused dense attention is faster up
-        # to T~8k (40.6 vs 36.8 ms at 8192 fwd+bwd), but its O(T^2)
-        # activations start dominating HBM much earlier; switch at 2048 where
-        # the memory win matters and the speed delta is small.
-        if (in_trace and mask is None and self.dropout._rate == 0
+        # Crossover re-measured on v5e after the r2 kernel tuning (bf16 MXU
+        # feeds + 1024-blocks): flash fwd+bwd beats XLA dense attention from
+        # T=2048 up (6.3 vs 20.5 ms at 2048; 9.1 vs 252 ms at 8192, bf16
+        # B=1 H=8 D=64) and is within noise below that, where per-call
+        # overhead dominates. Switch where the win is measurable.
+        if (in_trace and self.dropout._rate == 0
                 and T >= 2048 and T % 128 == 0 and flash_attention_available()):
-            return flash_attention(q, k, v, scale=1.0 / math.sqrt(D))
+            return flash_attention(q, k, v, scale=1.0 / math.sqrt(D),
+                                   kv_mask=mask)
         scores = F.batch_dot(q, k, transpose_b=True) * (1.0 / math.sqrt(D))
         if mask is not None:
             neg = (1.0 - F.reshape(mask, shape=(B, 1, 1, T))) * -1e30

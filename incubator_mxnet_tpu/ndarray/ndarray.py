@@ -169,7 +169,16 @@ class NDArray:
         return self._node is not None or (self._grad_req not in (None, "null"))
 
     def attach_grad(self, grad_req="write", stype=None):
-        """Mark as autograd leaf with a zero-initialized gradient buffer."""
+        """Mark as autograd leaf with a zero-initialized gradient buffer.
+        stype="row_sparse" allocates an EMPTY row-sparse buffer instead of
+        a dense zeros array — a 10M-row embedding must not pay a dense
+        vocab-sized grad allocation it will never use (reference:
+        Parameter.grad_stype)."""
+        if stype == "row_sparse":
+            from .sparse import zeros as _sp_zeros
+            g = _sp_zeros("row_sparse", self.shape, dtype=str(self.dtype))
+            self._mark_variable(g, grad_req)
+            return
         self._mark_variable(None, grad_req)
 
     def _mark_variable(self, grad, grad_req):

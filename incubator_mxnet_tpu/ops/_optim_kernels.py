@@ -188,7 +188,7 @@ def _sgld_update(w, g, lr, wd, noise, rescale, clip):
 def _sgd_lazy_update(w, idx, g, lr, wd, rescale, clip):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    rows = jnp.take(w, idx, axis=0)
+    rows = jnp.take(w, idx, axis=0, mode="fill", fill_value=0)
     return w.at[idx].set(rows - lr * (g + wd * rows))
 
 
@@ -196,8 +196,8 @@ def _sgd_lazy_update(w, idx, g, lr, wd, rescale, clip):
 def _sgd_mom_lazy_update(w, idx, g, mom, lr, wd, momentum, rescale, clip):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    wrows = jnp.take(w, idx, axis=0)
-    mrows = jnp.take(mom, idx, axis=0)
+    wrows = jnp.take(w, idx, axis=0, mode="fill", fill_value=0)
+    mrows = jnp.take(mom, idx, axis=0, mode="fill", fill_value=0)
     mrows = momentum * mrows - lr * (g + wd * wrows)
     return w.at[idx].set(wrows + mrows), mom.at[idx].set(mrows)
 
@@ -206,10 +206,10 @@ def _sgd_mom_lazy_update(w, idx, g, mom, lr, wd, momentum, rescale, clip):
 def _adam_lazy_update(w, idx, g, m, v, lr, wd, b1, b2, eps, t, rescale, clip):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    wrows = jnp.take(w, idx, axis=0)
+    wrows = jnp.take(w, idx, axis=0, mode="fill", fill_value=0)
     g = g + wd * wrows
-    mrows = b1 * jnp.take(m, idx, axis=0) + (1 - b1) * g
-    vrows = b2 * jnp.take(v, idx, axis=0) + (1 - b2) * g * g
+    mrows = b1 * jnp.take(m, idx, axis=0, mode="fill", fill_value=0) + (1 - b1) * g
+    vrows = b2 * jnp.take(v, idx, axis=0, mode="fill", fill_value=0) + (1 - b2) * g * g
     coef = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
     return (w.at[idx].set(wrows - coef * mrows / (jnp.sqrt(vrows) + eps)),
             m.at[idx].set(mrows), v.at[idx].set(vrows))
@@ -219,8 +219,29 @@ def _adam_lazy_update(w, idx, g, m, v, lr, wd, b1, b2, eps, t, rescale, clip):
 def _adagrad_lazy_update(w, idx, g, h, lr, wd, eps, rescale, clip):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    wrows = jnp.take(w, idx, axis=0)
+    wrows = jnp.take(w, idx, axis=0, mode="fill", fill_value=0)
     g = g + wd * wrows
-    hrows = jnp.take(h, idx, axis=0) + g * g
+    hrows = jnp.take(h, idx, axis=0, mode="fill", fill_value=0) + g * g
     return (w.at[idx].set(wrows - lr * g / (jnp.sqrt(hrows) + eps)),
             h.at[idx].set(hrows))
+
+
+def _pad_sparse(idx, vals, n_rows):
+    """Pad (idx, vals) to the next power-of-two nnz so the jitted lazy
+    kernels compile once per size bucket instead of once per distinct
+    touched-row count (the unique-id count varies almost every batch).
+    Padding entries use an OUT-OF-BOUNDS row index: XLA scatter drops
+    out-of-bounds updates (jax GatherScatterMode.FILL_OR_DROP), so the
+    padding is a guaranteed no-op; the paired gathers use fill_value=0 in
+    the kernels above to keep the dead lanes finite."""
+    n = int(idx.shape[0])
+    if n == 0:
+        return idx, vals
+    bucket = 1 << (n - 1).bit_length()
+    if bucket == n:
+        return idx, vals
+    pad = bucket - n
+    idx_p = jnp.concatenate([idx, jnp.full((pad,), n_rows, idx.dtype)])
+    vals_p = jnp.concatenate(
+        [vals, jnp.zeros((pad,) + vals.shape[1:], vals.dtype)])
+    return idx_p, vals_p

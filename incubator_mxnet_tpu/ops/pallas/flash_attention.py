@@ -39,8 +39,9 @@ def flash_attention_available():
     return _PALLAS_OK and jax.default_backend() == "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                block_q, block_k, scale, causal):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, block_q, block_k, scale, causal,
+                has_bias):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -59,11 +60,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)                  # (BK, D)
-        v = v_ref[0].astype(jnp.float32)
+        # feed the MXU in the INPUT dtype (bf16 at full rate, f32 accum via
+        # preferred_element_type); scale applied to the f32 scores
+        q = q_ref[0]                                      # (BQ, D)
+        k = k_ref[0]                                      # (BK, D)
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            # additive kv bias (0 for live, -inf for padding): broadcast
+            # over the query rows of this tile
+            s = s + bias_ref[0, 0][None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -79,7 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:, 0] = l_prev * alpha + jnp.sum(p, axis=-1)
         m_ref[:, 0] = m_new
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == nk - 1)
@@ -93,18 +100,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                                       lse_ref.shape[1:])
 
 
-def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret=False):
+def _fwd_call(q, k, v, bias, scale, causal, block_q, block_k,
+              interpret=False):
     bh, T, d = q.shape
     grid = (bh, T // block_q, T // block_k)
+    has_bias = bias is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 8, block_k),
+                                     lambda b, i, j: (b, 0, j)))
+        args.append(bias)
+    kern = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                             scale=scale, causal=causal, has_bias=has_bias)
+    if not has_bias:
+        def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+            return _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                               acc_ref, m_ref, l_ref, block_q=block_q,
+                               block_k=block_k, scale=scale, causal=causal,
+                               has_bias=False)
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal),
+        kern,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
@@ -119,15 +141,17 @@ def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret=False):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 def _recompute_p_ds(q, k, v, do, lse, delta, qi, kj, block_q, block_k,
-                    scale, causal):
+                    scale, causal, bias=None):
     """Shared tile math of the backward kernels: p and ds for one (Q, KV)
-    tile pair (runs in fp32 on the MXU/VPU)."""
-    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    tile pair (MXU in input dtype, fp32 accumulation)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias[None, :]
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -138,11 +162,12 @@ def _recompute_p_ds(q, k, v, do, lse, delta, qi, kj, block_q, block_k,
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None]) * scale
-    return p, ds
+    return p.astype(v.dtype), ds.astype(v.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, block_q, block_k, scale, causal):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+               dq_ref, acc_ref, *, block_q, block_k, scale, causal,
+               has_bias):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -155,12 +180,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        bias = bias_ref[0, 0] if has_bias else None
         _, ds = _recompute_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
-                                qi, kj, block_q, block_k, scale, causal)
+                                qi, kj, block_q, block_k, scale, causal,
+                                bias)
         acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -170,9 +194,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
-                scale, causal):
+                scale, causal, has_bias):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -186,12 +210,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        bias = bias_ref[0, 0] if has_bias else None
         p, ds = _recompute_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
-                                qi, kj, block_q, block_k, scale, causal)
+                                qi, kj, block_q, block_k, scale, causal,
+                                bias)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -205,11 +228,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+def _bwd_call(q, k, v, out, lse, g, bias, scale, causal, block_q, block_k,
               interpret=False):
     bh, T, d = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, T))
+    has_bias = bias is not None
 
     qkv_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
@@ -219,16 +243,29 @@ def _bwd_call(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),   # lse
         pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),   # delta
     ]
+    args = [q, k, v, g, lse, delta]
+    if has_bias:
+        qkv_specs.append(pl.BlockSpec((1, 8, block_k),
+                                      lambda b, i, j: (b, 0, j)))
+        args.append(bias)
+    dq_kern = functools.partial(_dq_kernel, block_q=block_q,
+                                block_k=block_k, scale=scale, causal=causal,
+                                has_bias=has_bias)
+    if not has_bias:
+        base_dq = dq_kern
+
+        def dq_kern(q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, acc_r):
+            return base_dq(q_r, k_r, v_r, do_r, lse_r, dl_r, None, dq_r,
+                           acc_r)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal),
+        dq_kern,
         grid=(bh, T // block_q, T // block_k),
         in_specs=qkv_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*args)
 
     kv_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
@@ -238,9 +275,21 @@ def _bwd_call(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),   # lse
         pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),   # delta
     ]
+    if has_bias:
+        kv_specs.append(pl.BlockSpec((1, 8, block_k),
+                                     lambda b, j, i: (b, 0, j)))
+    dkv_kern = functools.partial(_dkv_kernel, block_q=block_q,
+                                 block_k=block_k, scale=scale, causal=causal,
+                                 has_bias=has_bias)
+    if not has_bias:
+        base_dkv = dkv_kern
+
+        def dkv_kern(q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r,
+                     dk_a, dv_a):
+            return base_dkv(q_r, k_r, v_r, do_r, lse_r, dl_r, None, dk_r,
+                            dv_r, dk_a, dv_a)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal),
+        dkv_kern,
         grid=(bh, T // block_k, T // block_q),
         in_specs=kv_specs,
         out_specs=[
@@ -254,50 +303,80 @@ def _bwd_call(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*args)
     return dq, dk, dv
 
 
-def _bq(q):
-    return min(q.shape[1], 128)
+import os as _os
 
 
-def _bk(q):
-    return min(q.shape[1], 128)
+def _default_blocks(T):
+    """Block sizes: tunable via MXTPU_FLASH_BLOCK_Q/K; defaults from the
+    on-chip sweep in BENCHMARKS.md (v5e)."""
+    bq = int(_os.environ.get("MXTPU_FLASH_BLOCK_Q", "0")) or min(T, 1024)
+    bk = int(_os.environ.get("MXTPU_FLASH_BLOCK_K", "0")) or min(T, 1024)
+    while T % bq:
+        bq //= 2
+    while T % bk:
+        bk //= 2
+    return max(bq, 8), max(bk, 8)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(q, k, v, scale, causal, interpret):
-    out, _ = _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q), interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd_call(q, k, v, bias, scale, causal, block_q, block_k,
+                       interpret)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret):
-    out, lse = _fwd_call(q, k, v, scale, causal, _bq(q), _bk(q), interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(q, k, v, bias, scale, causal, block_q, block_k,
+                         interpret)
+    return out, (q, k, v, bias, out, lse)
 
 
-def _flash_bwd(scale, causal, interpret, res, g):
-    q, k, v, out, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, out, lse, g, scale, causal,
-                           _bq(q), _bk(q), interpret)
-    return dq, dk, dv
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, out, lse, g, bias, scale, causal,
+                           block_q, block_k, interpret)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, scale=None, causal=False, interpret=False):
-    """q/k/v: (B, H, T, D). Returns (B, H, T, D). Requires T % 128 == 0 or
-    T <= 128; callers fall back to the einsum path otherwise."""
+def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
+                    block_q=None, block_k=None, interpret=False):
+    """q/k/v: (B, H, T, D). Returns (B, H, T, D).
+
+    kv_mask: optional (B, T) array, nonzero = live key/value position,
+    0 = padding (the reference BERT valid-length mask). Padded positions
+    receive zero attention in forward AND backward.
+
+    Requires T % 128 == 0, or T <= 128 with T % 8 == 0 (Mosaic sublane
+    tiling); callers fall back to the einsum path otherwise."""
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    bq = min(T, 128)
-    if T % bq != 0:
-        raise ValueError("flash_attention requires seq_len %% %d == 0" % bq)
+    if T > 128:
+        if T % 128 != 0:
+            raise ValueError("flash_attention requires seq_len % 128 == 0")
+    elif T % 8 != 0:
+        raise ValueError("flash_attention requires seq_len % 8 == 0")
+    bq0, bk0 = _default_blocks(T)
+    bq = block_q or bq0
+    bk = block_k or bk0
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
-    out = _flash_core(qf, kf, vf, float(scale), bool(causal), bool(interpret))
+    bias = None
+    if kv_mask is not None:
+        live = jnp.asarray(kv_mask).reshape(B, T) != 0
+        b1 = jnp.where(live, 0.0, _NEG_INF).astype(jnp.float32)
+        # (B,H,8,T) -> (B*H,8,T): replicated-sublane layout like lse/delta
+        bias = jnp.broadcast_to(b1[:, None, None, :], (B, H, 8, T)) \
+            .reshape(B * H, 8, T)
+    out = _flash_core(qf, kf, vf, bias, float(scale), bool(causal),
+                      int(bq), int(bk), bool(interpret))
     return out.reshape(B, H, T, D)

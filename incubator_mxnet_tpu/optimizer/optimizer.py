@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ndarray import NDArray
-from ..ops._optim_kernels import (_sgd_update, _sgd_mom_update, _nag_update, _adam_update, _adamw_update, _adagrad_update, _rmsprop_update, _rmspropalex_update, _adadelta_update, _adamax_update, _nadam_update, _ftrl_update, _signsgd_update, _signum_update, _ftml_update, _sgld_update, _sgd_lazy_update, _sgd_mom_lazy_update, _adam_lazy_update, _adagrad_lazy_update)  # noqa: F401
+from ..ops._optim_kernels import (_sgd_update, _sgd_mom_update, _nag_update, _adam_update, _adamw_update, _adagrad_update, _rmsprop_update, _rmspropalex_update, _adadelta_update, _adamax_update, _nadam_update, _ftrl_update, _signsgd_update, _signum_update, _ftml_update, _sgld_update, _sgd_lazy_update, _sgd_mom_lazy_update, _adam_lazy_update, _adagrad_lazy_update, _pad_sparse)  # noqa: F401
 
 __all__ = ["Optimizer", "register", "create", "Updater", "get_updater"]
 
@@ -154,7 +154,8 @@ class SGD(Optimizer):
         if isinstance(grad, RowSparseNDArray) and self.lazy_update:
             # lazy sparse update: touch ONLY the gradient's rows (reference:
             # SGDUpdateRspImpl / SGDMomLazyUpdateRspImpl, optimizer_op.cc)
-            idx, vals = grad._sp_indices, grad._sp_data
+            idx, vals = _pad_sparse(grad._sp_indices, grad._sp_data,
+                                    weight.shape[0])
             if state is None:
                 weight._data = _sgd_lazy_update(
                     weight._data, idx, vals, jnp.float32(lr), jnp.float32(wd),
@@ -271,8 +272,10 @@ class Adam(Optimizer):
         if isinstance(grad, RowSparseNDArray) and self.lazy_update:
             # reference: AdamLazyUpdateRspImpl — m/v/w rows touched only
             # where the gradient has rows
+            idx, vals = _pad_sparse(grad._sp_indices, grad._sp_data,
+                                    weight.shape[0])
             weight._data, m._data, v._data = _adam_lazy_update(
-                weight._data, grad._sp_indices, grad._sp_data, m._data,
+                weight._data, idx, vals, m._data,
                 v._data, jnp.float32(self._get_lr(index)),
                 jnp.float32(self._get_wd(index)), jnp.float32(self.beta1),
                 jnp.float32(self.beta2), jnp.float32(self.epsilon),
@@ -326,8 +329,10 @@ class AdaGrad(Optimizer):
         from ..ndarray.sparse import RowSparseNDArray
         if isinstance(grad, RowSparseNDArray):
             # reference: AdagradUpdateRspImpl (sparse-native optimizer)
+            idx, vals = _pad_sparse(grad._sp_indices, grad._sp_data,
+                                    weight.shape[0])
             weight._data, state._data = _adagrad_lazy_update(
-                weight._data, grad._sp_indices, grad._sp_data, state._data,
+                weight._data, idx, vals, state._data,
                 jnp.float32(self._get_lr(index)),
                 jnp.float32(self._get_wd(index)),
                 jnp.float32(self.float_stable_eps),

@@ -142,7 +142,8 @@ def _optimizer_worker(rank):
     return out.asnumpy().tolist()
 
 
-def _spawn_ps_group(n_workers, n_servers, worker_fn_name):
+def _spawn_ps_group(n_workers, n_servers, worker_fn_name,
+                    expected_results=None):
     from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
                                                          run_server,
                                                          SchedulerClient)
@@ -180,7 +181,8 @@ def _spawn_ps_group(n_workers, n_servers, worker_fn_name):
         w.start()
         workers.append(w)
     results = {}
-    for _ in range(n_workers):
+    for _ in range(expected_results if expected_results is not None
+                   else n_workers):
         rank, res = queue.get(timeout=120)
         results[rank] = res
     for w in workers:
@@ -231,3 +233,67 @@ def test_dist_sharded_bigarray_and_rowsparse():
         np.testing.assert_allclose(full, [3.0] * 8)
         assert rs[1] == 3.0 and rs[6] == 3.0
         assert rs[0] == 0.0 and rs[7] == 0.0
+
+
+def _rsp_push_worker(rank):
+    """Both workers push row-sparse grads; server aggregates rows only."""
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    from incubator_mxnet_tpu.ndarray import sparse
+    kv = KVStoreDist("dist_sync")
+    if kv.rank == 0:
+        kv.init("emb", nd.zeros((10, 2)))
+    kv.barrier()
+    # worker 0 touches rows {1,3}; worker 1 touches rows {3,7}
+    ids = [1, 3] if kv.rank == 0 else [3, 7]
+    g = sparse.row_sparse_array(
+        (np.ones((2, 2), np.float32) * (kv.rank + 1), ids), shape=(10, 2))
+    kv.push("emb", g)
+    out = sparse.zeros("row_sparse", (10, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 3, 7],
+                                                        dtype="int32"))
+    kv.barrier()
+    kv.close()
+    assert out._dense_cache is None
+    return (out.indices.asnumpy().tolist(), out.data.asnumpy().tolist())
+
+
+def test_dist_row_sparse_push_pull():
+    results = _spawn_ps_group(2, 1, "_rsp_push_worker")
+    for rank, res in results.items():
+        assert not (isinstance(res, str) and res.startswith("ERROR")), res
+        ids, rows = res
+        assert ids == [1, 3, 7]
+        # rows: 1 -> w0 only (1), 3 -> w0+w1 (1+2), 7 -> w1 only (2)
+        np.testing.assert_allclose(rows, [[1, 1], [3, 3], [2, 2]])
+
+
+def _dying_worker(rank):
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_sync")
+    if kv.rank == 1:
+        # die without deregistering: heartbeats stop, peers must detect it
+        kv._sched.stop_heartbeats()
+        os._exit(1)
+    # surviving worker: barrier must RAISE (dead node or timeout), not hang
+    t0 = time.time()
+    try:
+        kv.barrier(timeout=60)
+        return "ERROR: barrier returned despite dead peer"
+    except (RuntimeError, TimeoutError) as e:
+        took = time.time() - t0
+        kv.close()
+        return ("raised", type(e).__name__, round(took, 1))
+
+
+def test_dist_barrier_detects_dead_worker():
+    os.environ["MXTPU_PS_DEAD_TIMEOUT"] = "4"
+    try:
+        results = _spawn_ps_group(2, 1, "_dying_worker",
+                                  expected_results=1)
+    finally:
+        os.environ.pop("MXTPU_PS_DEAD_TIMEOUT", None)
+    (res,) = list(results.values())   # exactly one survivor reports
+    assert not (isinstance(res, str) and res.startswith("ERROR")), res
+    assert res[0] == "raised", res
+    # detection must come from liveness (seconds), not the 60s barrier timeout
+    assert res[2] < 30, res

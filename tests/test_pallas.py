@@ -168,3 +168,42 @@ def test_flash_forward_interpret_matches_dense():
     want = _dense_ref(q, q, q, False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_kv_mask_interpret():
+    """Padding mask: padded kv positions get zero attention fwd+bwd."""
+    np.random.seed(0)
+    B, H, T, D = 2, 2, 128, 32
+    valid = 96
+    q = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    mask = jnp.asarray(
+        (np.arange(T) < valid).astype(np.int32)[None].repeat(B, 0))
+
+    def flash_loss(q, k, v):
+        out = flash_attention(q, k, v, kv_mask=mask, interpret=True)
+        return (out[:, :, :valid] ** 2).sum(), out
+
+    def dense_loss(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(mask[:, None, None, :] != 0, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return (out[:, :, :valid] ** 2).sum(), out
+
+    (lf, of), gf = jax.value_and_grad(flash_loss, argnums=(0, 1, 2),
+                                      has_aux=True)(q, k, v)
+    (ld, od), gd = jax.value_and_grad(dense_loss, argnums=(0, 1, 2),
+                                      has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(of[:, :, :valid]),
+                               np.asarray(od[:, :, :valid]),
+                               rtol=2e-3, atol=2e-3)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
+    # no attention mass on padded keys: dk/dv vanish there
+    np.testing.assert_allclose(np.asarray(gf[1][:, :, valid:]), 0,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gf[2][:, :, valid:]), 0,
+                               atol=1e-6)

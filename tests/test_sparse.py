@@ -276,3 +276,15 @@ def test_csr_to_rsp_no_densify():
     assert csr._dense_cache is None        # conversion must not densify
     assert list(rsp.indices.asnumpy()) == [1, 4]
     np.testing.assert_allclose(rsp.tostype("default").asnumpy(), dense)
+
+
+def test_sparse_grad_param_never_allocates_dense_grad():
+    """grad_stype=row_sparse: the grad buffer starts as an EMPTY rsp array;
+    no vocab-sized dense zeros allocation ever happens."""
+    emb = gluon.nn.Embedding(5_000_000, 32, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    p = list(emb.collect_params().values())[0]
+    g = p.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert g.nnz == 0 and g._dense_cache is None
+    assert g.shape == (5_000_000, 32)

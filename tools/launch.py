@@ -18,6 +18,10 @@ import socket
 import subprocess
 import sys
 
+# launched as `python tools/launch.py`: sys.path[0] is tools/, so the
+# package import for the shutdown hook needs the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def _free_port():
     s = socket.socket()
