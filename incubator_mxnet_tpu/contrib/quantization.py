@@ -233,17 +233,26 @@ def quantize_symbol(sym, excluded_sym_names=(), thresholds=None):
 
     thresholds = thresholds or {}
     excluded = set(excluded_sym_names or ())
-    rebuilt = {}   # id(original base node) -> rebuilt Symbol (fp32-out)
+    rebuilt = {}   # node identity key -> rebuilt Symbol (fp32-out)
+
+    def _key(n):
+        # views of a multi-output node are distinct Symbol objects sharing
+        # the SAME inputs list/name/op; key them to one rebuild so each op
+        # is cloned exactly once (a per-view clone would duplicate nodes —
+        # and duplicate side effects for stochastic ops)
+        return (id(n._inputs), n._name, n._op)
 
     def lookup(inp):
-        base = rebuilt[id(inp)]
+        base = rebuilt[_key(inp)]
         if inp._out_index is not None:
             return base[inp._out_index]
         return base
 
     for n in sym._topo():
         if n._op is None or n._op == "_group":
-            rebuilt[id(n)] = n
+            rebuilt.setdefault(_key(n), n)
+            continue
+        if _key(n) in rebuilt:   # another view of an already-rebuilt node
             continue
         ins = [lookup(i) for i in n._inputs]
         if n._op in _QUANTIZABLE_OPS and n._name not in excluded:
@@ -269,16 +278,15 @@ def quantize_symbol(sym, excluded_sym_names=(), thresholds=None):
                                     name=n._name + "_requantize")
             deq = sym_mod.dequantize(rq[0], rq[1], rq[2],
                                      name=n._name + "_dequantize")
-            rebuilt[id(n)] = deq
+            rebuilt[_key(n)] = deq
         else:
             from ..symbol import Symbol
-            rebuilt[id(n)] = Symbol(n._op, n._name, ins, n._attrs,
-                                    n._num_outputs)
+            rebuilt[_key(n)] = Symbol(n._op, n._name, ins, n._attrs,
+                                      n._num_outputs)
 
     if sym._op == "_group":
         return Group([lookup(s) for s in sym._inputs])
-    out = rebuilt[id(sym._topo()[-1])]
-    return out[sym._out_index] if sym._out_index is not None else out
+    return lookup(sym)
 
 
 def quantize_model(sym=None, arg_params=None, aux_params=None,

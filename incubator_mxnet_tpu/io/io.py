@@ -433,11 +433,25 @@ class ImageDetRecordIter(ImageRecordIter):
         self._label_pad_value = float(label_pad_value)
         self._has_header = bool(has_header)
         if self._label_pad_width <= 0:
-            # one cheap header-only scan to find max objects/record so every
-            # batch has one static shape (the reference errors instead when
-            # label_pad_width is unset and counts vary)
-            self._label_pad_width = max(
-                1, self._scan_max_objects(path_imgrec))
+            # full header scan only when the pad width must be discovered:
+            # (a) max objects/record for one static batch shape, (b) the
+            # ACTUAL header object width (mixed widths are a hard error —
+            # they would make ragged batches)
+            max_n, widths = self._scan_headers(path_imgrec)
+            self._label_pad_width = max(1, max_n)
+            if len(widths) > 1:
+                raise ValueError(
+                    "ImageDetRecordIter: records declare mixed object "
+                    "widths %s; batches would be ragged" % sorted(widths))
+            if widths:
+                self._object_width = widths.pop()
+        elif self._has_header:
+            # pad width given (no scan wanted): peek ONE record for the
+            # header object width so provide_label matches the arrays;
+            # per-record validation in _label_transform catches the rest
+            w = self._peek_width(path_imgrec)
+            if w is not None:
+                self._object_width = w
         super().__init__(path_imgrec, data_shape, batch_size,
                          label_width=label_width, **kwargs)
 
@@ -454,24 +468,43 @@ class ImageDetRecordIter(ImageRecordIter):
         n = flat.size // ow
         return ow, flat[:n * ow].reshape(n, ow)
 
-    def _scan_max_objects(self, path_imgrec):
+    def _peek_width(self, path_imgrec):
+        from ..recordio import MXRecordIO, unpack
+        r = MXRecordIO(path_imgrec, "r")
+        try:
+            rec = r.read()
+            if rec is None:
+                return None
+            header, _ = unpack(rec)
+            ow, _objs = self._parse(header.label)
+            return int(ow)
+        finally:
+            r.close()
+
+    def _scan_headers(self, path_imgrec):
         from ..recordio import MXRecordIO, unpack
         r = MXRecordIO(path_imgrec, "r")
         max_n = 0
+        widths = set()
         while True:
             rec = r.read()
             if rec is None:
                 break
             header, _ = unpack(rec)
-            _, objs = self._parse(header.label)
+            ow, objs = self._parse(header.label)
+            widths.add(int(ow))
             max_n = max(max_n, objs.shape[0])
         r.close()
-        return max_n
+        return max_n, widths
 
     def _label_transform(self, raw):
         """Per-sample: parse the flat detection label and pad to a fixed
         (max_objects, object_width) block so batches have static shape."""
         ow, objs = self._parse(raw)
+        if ow != self._object_width:
+            raise ValueError(
+                "ImageDetRecordIter: record object width %d != iterator "
+                "width %d" % (ow, self._object_width))
         n = objs.shape[0]
         max_obj = self._label_pad_width
         out = _np.full((max_obj, ow), self._label_pad_value, _np.float32)

@@ -214,15 +214,18 @@ class KVStoreDist(KVStore):
             mask = (ids >= lo) & (ids < hi)
             # an empty shard still sends a zero-row message: sync-mode
             # servers count one push per worker per round, so skipping
-            # would desynchronize the aggregation generation
-            local = (ids[mask] - lo).tolist()
+            # would desynchronize the aggregation generation. Row ids ride
+            # the BINARY payload (int64), not JSON metadata — a 1M-row
+            # gradient must not serialize a million JSON integers.
+            local = np.ascontiguousarray(ids[mask] - lo, dtype=np.int64)
             part = np.ascontiguousarray(rows[mask])
             meta = {"op": "push", "key": self._part_key(key, lo),
                     "shape": list(part.shape), "dtype": str(part.dtype),
-                    "rows": local, "rank": self._rank}
+                    "rows_n": int(local.size), "rank": self._rank}
+            payload = local.tobytes() + part.tobytes()
             conn = self._servers[sid]
             self._submit(key,
-                         lambda c=conn, m=meta, p=part.tobytes(): c.call(m, p))
+                         lambda c=conn, m=meta, p=payload: c.call(m, p))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -267,7 +270,8 @@ class KVStoreDist(KVStore):
             local = rids[mask] - lo
             meta, payload = self._servers[sid].call(
                 {"op": "pull", "key": self._part_key(key, lo),
-                 "rows": local.tolist(), "rank": self._rank})
+                 "rows_n": int(local.size), "rank": self._rank},
+                np.ascontiguousarray(local, dtype=np.int64).tobytes())
             if meta.get("error"):
                 raise RuntimeError("row_sparse_pull(%r): %s"
                                    % (key, meta["error"]))

@@ -322,7 +322,11 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             return {"ok": True}, b""
         if op == "push":
             key = meta["key"]
-            rows = meta.get("rows")
+            rows = meta.get("rows")          # legacy JSON ids
+            if meta.get("rows_n") is not None:
+                n = int(meta["rows_n"])
+                rows = np.frombuffer(payload[:8 * n], dtype=np.int64)
+                payload = payload[8 * n:]
             if meta.get("compressed") and state.compression is not None:
                 import jax.numpy as jnp
                 packed = jnp.asarray(np.frombuffer(payload, dtype=np.int32))
@@ -331,6 +335,8 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             else:
                 arr = _decode(meta, payload)
             with state.cv:
+                if key not in state.store:
+                    return {"error": "push(%r) before init" % key}, b""
                 full_shape = tuple(state.store[key].shape)
                 if state.sync_mode:
                     # the push RESPONSE never waits for the other workers
@@ -387,6 +393,9 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                         state.cv.wait(timeout=_BARRIER_POLL)
                 arr = state.store[key]
             rows = meta.get("rows")
+            if meta.get("rows_n") is not None:
+                rows = np.frombuffer(payload[:8 * int(meta["rows_n"])],
+                                     dtype=np.int64)
             if rows is not None:
                 arr = arr[np.asarray(rows, dtype=np.int64)]
             return ({"shape": list(arr.shape), "dtype": str(arr.dtype)},

@@ -170,7 +170,11 @@ class Server:
                 if meta is None:
                     return
                 meta["_peer"] = peer    # server-authoritative, not spoofable
-                out_meta, out_payload = self._handler(meta, payload)
+                try:
+                    out_meta, out_payload = self._handler(meta, payload)
+                except Exception as e:   # noqa: BLE001 — reply, don't die
+                    out_meta, out_payload = (
+                        {"error": "%s: %s" % (type(e).__name__, e)}, b"")
                 send_msg(conn, out_meta, out_payload)
         except (OSError, EOFError, ProtocolError):
             pass

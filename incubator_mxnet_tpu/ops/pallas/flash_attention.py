@@ -367,6 +367,9 @@ def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
     bq0, bk0 = _default_blocks(T)
     bq = block_q or bq0
     bk = block_k or bk0
+    if T % bq or T % bk:
+        raise ValueError("flash_attention: block sizes (%d, %d) must divide "
+                         "seq_len %d" % (bq, bk, T))
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)

@@ -48,3 +48,14 @@ def axis_index(axis_name):
 
 def axis_size(axis_name):
     return lax.psum(1, axis_name)
+
+
+def collective_counts(hlo_text):
+    """Count collective instruction definitions in compiled HLO text —
+    the audit companion to ``ShardedTrainer.lowered()`` (names like
+    ``%all-reduce.5 = ...``; result types may be tuples with spaces, so
+    match the defined name, including async ``-start`` variants)."""
+    import re
+    return {op: len(re.findall(r"%%%s(?:-start)?[.\d]*\s+?=" % op, hlo_text))
+            for op in ("all-reduce", "all-gather", "reduce-scatter",
+                       "all-to-all", "collective-permute")}

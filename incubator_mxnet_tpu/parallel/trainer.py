@@ -272,8 +272,7 @@ class ShardedTrainer:
         self._opt_state = new_opt if new_opt else self._opt_state
         return losses
 
-    def step(self, data, label, key=None):
-        """Run one sharded train step; returns the (device) scalar loss."""
+    def _prep_batch(self, data, label):
         datas = list(data) if isinstance(data, (list, tuple)) else [data]
         labels = list(label) if isinstance(label, (list, tuple)) else [label]
         datas = [d._data if isinstance(d, NDArray) else jnp.asarray(d)
@@ -290,6 +289,11 @@ class ShardedTrainer:
         else:
             datas = [jax.device_put(d, self._data_shardings) for d in datas]
         labels = [jax.device_put(l, self._label_sharding) for l in labels]
+        return datas, labels
+
+    def step(self, data, label, key=None):
+        """Run one sharded train step; returns the (device) scalar loss."""
+        datas, labels = self._prep_batch(data, label)
         if self._jit_step is None:
             self._jit_step = self._build(len(datas))
         if key is None:
@@ -304,6 +308,21 @@ class ShardedTrainer:
         self._param_vals = {**new_params, **new_aux}
         self._opt_state = new_opt if new_opt else self._opt_state
         return loss
+
+    def lowered(self, data, label, key=None):
+        """Lower (but do not run) the full sharded train step for this batch
+        and return the jax ``Lowered`` object — `.compile().as_text()` gives
+        the post-GSPMD HLO, the supported way to AUDIT collective placement
+        (which all-reduces/all-gathers the partitioner inserted and where).
+        Does not mutate trainer state."""
+        datas, labels = self._prep_batch(data, label)
+        fn = jax.jit(self._build_raw(len(datas)))   # no donation: inspection
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        pv = {n: self._param_vals[n] for n in self._diff_names}
+        aux_vals = {n: self._param_vals[n] for n in self._aux_names}
+        return fn.lower(pv, aux_vals, self._opt_state, jnp.float32(1), key,
+                        *datas, *labels)
 
     def sync_to_block(self):
         """Copy sharded params back into the gluon block's NDArrays."""

@@ -549,7 +549,18 @@ def load_json(json_str):
                   for i in n.get("inputs", [])]
         if n["op"] == "null":
             built.append(var(n["name"], attr=attrs))
+        elif n["op"] == "_group":
+            built.append(Group(inputs))
         else:
+            if attrs.get("subgraph_kind"):
+                # control-flow closure op serialized as nested graph JSON:
+                # (re)build it in this process's registry before resolving
+                # (reference: control_flow.cc subgraph deserialization)
+                try:
+                    get_op(n["op"])
+                except KeyError:
+                    from .contrib import reregister_subgraph_op
+                    reregister_subgraph_op(n["op"], attrs)
             info = get_op(n["op"])
             if callable(info.num_outputs):
                 nout = int(info.num_outputs(attrs))

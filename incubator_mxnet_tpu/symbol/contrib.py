@@ -13,12 +13,16 @@ whole construct to the matching XLA structured-control-flow primitive
 the reference's single ``_foreach`` node — so symbolic autograd and jit
 see one differentiable primitive instead of an unrolled loop.
 
-Known limitation vs the reference: the closure op lives only in this
-process's registry, so ``tojson()`` of a graph containing control flow is
-not loadable in a fresh process (the reference serializes the cut-out
-subgraph inside the node). Export such models via HybridBlock tracing
-instead.
+Cross-process serialization (r2): the cut-out subgraph rides in the node's
+attrs as nested graph JSON (``subgraph_json``/``subgraph_meta``), exactly
+like the reference serializes the subgraph inside the ``_foreach`` node
+(control_flow.cc:1255-1378). ``load_json`` re-registers the closure op
+from those attrs in a fresh process, so export -> import -> eval round-
+trips; nested control flow nests JSON recursively for free.
 """
+
+import json as _json
+import uuid as _uuid
 
 from ..ops import control_flow as _cf
 from ..ops.registry import register as _register_op
@@ -32,7 +36,9 @@ _uid = [0]
 
 def _next_uid():
     _uid[0] += 1
-    return _uid[0]
+    # the uuid suffix keeps loader-registered op names from colliding with
+    # ops built live in the same process (both use this namespace)
+    return "%d_%s" % (_uid[0], _uuid.uuid4().hex[:8])
 
 
 def _as_list(x):
@@ -55,32 +61,19 @@ def _free_vars(out_syms, placeholder_names):
     return free
 
 
-def foreach(body, data, init_states, name="foreach"):
-    """``body(data_slice_sym, states_sym) -> (outputs, new_states)`` scanned
-    over axis 0 of ``data``. Returns ``(outputs, final_states)`` Symbols."""
-    uid = _next_uid()
-    data_list = _as_list(data)
-    multi_data = isinstance(data, (list, tuple))
-    states = _as_list(init_states)
-    multi_state = isinstance(init_states, (list, tuple))
+# ---------------------------------------------------------------------------
+# op builders — shared by the live tracer and the JSON loader
+# ---------------------------------------------------------------------------
 
-    data_ph = [var("_foreach%d_data%d" % (uid, i)) for i in range(len(data_list))]
-    state_ph = [var("_foreach%d_state%d" % (uid, i)) for i in range(len(states))]
-    ph_names = {v._name for v in data_ph + state_ph}
-
-    outs, new_states = body(data_ph if multi_data else data_ph[0],
-                            state_ph if multi_state else state_ph[0])
-    out_syms = _as_list(outs)
-    new_state_syms = _as_list(new_states)
-    multi_out = isinstance(outs, (list, tuple))
-    sub = Group(out_syms + new_state_syms)
-    free = _free_vars(out_syms + new_state_syms, ph_names)
-
-    nd_, ns_, nf_ = len(data_list), len(states), len(free)
-    data_names = [v._name for v in data_ph]
-    state_names = [v._name for v in state_ph]
-    free_names = [v._name for v in free]
-    n_out = len(out_syms)
+def _build_foreach_op(sub, meta):
+    data_names = meta["data_names"]
+    state_names = meta["state_names"]
+    free_names = meta["free_names"]
+    n_out = meta["n_out"]
+    multi_data = meta["multi_data"]
+    multi_state = meta["multi_state"]
+    multi_out = meta["multi_out"]
+    nd_, ns_ = len(data_names), len(state_names)
 
     def op_fn(*arrays, **_attrs):
         d, s = arrays[:nd_], arrays[nd_:nd_ + ns_]
@@ -89,7 +82,8 @@ def foreach(body, data, init_states, name="foreach"):
         def jbody(x, st):
             feed = dict(zip(free_names, fv))
             feed.update(zip(data_names, _as_list(x) if multi_data else [x]))
-            feed.update(zip(state_names, _as_list(st) if multi_state else [st]))
+            feed.update(zip(state_names,
+                            _as_list(st) if multi_state else [st]))
             vals = _eval_symbol(sub, feed, wrap=False)
             o = vals[:n_out]
             ns = vals[n_out:]
@@ -100,39 +94,16 @@ def foreach(body, data, init_states, name="foreach"):
                                      list(s) if multi_state else s[0])
         return tuple(_as_list(stacked)) + tuple(_as_list(final))
 
-    opname = "_foreach_sub%d" % uid
-    _register_op(opname, num_outputs=n_out + ns_)(op_fn)
-    node = _make_apply(opname, data_list + states + free,
-                       {"__subgraph__": "foreach"}, name="%s%d" % (name, uid))
-    out_nodes = [node[i] for i in range(n_out)]
-    st_nodes = [node[n_out + i] for i in range(ns_)]
-    return (out_nodes if multi_out else out_nodes[0],
-            st_nodes if multi_state else (st_nodes[0] if st_nodes else []))
+    return op_fn, n_out + ns_
 
 
-def while_loop(cond_fn, func, loop_vars, max_iterations=None, name="while_loop"):
-    """Bounded symbolic while loop; see ``ops.control_flow.while_loop``."""
-    if max_iterations is None:
-        raise ValueError("while_loop requires max_iterations (static shapes)")
-    uid = _next_uid()
-    loop_vars = _as_list(loop_vars)
-    var_ph = [var("_while%d_var%d" % (uid, i)) for i in range(len(loop_vars))]
-    ph_names = {v._name for v in var_ph}
-
-    pred_sym = cond_fn(*var_ph)
-    outs, new_vars = func(*var_ph)
-    out_syms = _as_list(outs)
-    multi_out = isinstance(outs, (list, tuple))
-    new_var_syms = _as_list(new_vars)
-    if len(new_var_syms) != len(loop_vars):
-        raise ValueError("func must return as many loop_vars as it takes")
-    sub = Group([pred_sym] + out_syms + new_var_syms)
-    free = _free_vars([pred_sym] + out_syms + new_var_syms, ph_names)
-
-    nv_, nf_ = len(loop_vars), len(free)
-    var_names = [v._name for v in var_ph]
-    free_names = [v._name for v in free]
-    n_out = len(out_syms)
+def _build_while_op(sub, meta):
+    var_names = meta["var_names"]
+    free_names = meta["free_names"]
+    n_out = meta["n_out"]
+    multi_out = meta["multi_out"]
+    max_iterations = meta["max_iterations"]
+    nv_ = len(var_names)
 
     def op_fn(*arrays, **_attrs):
         vs, fv = arrays[:nv_], arrays[nv_:]
@@ -154,12 +125,147 @@ def while_loop(cond_fn, func, loop_vars, max_iterations=None, name="while_loop")
                                         int(max_iterations))
         return tuple(_as_list(stacked)) + tuple(final)
 
-    opname = "_while_loop_sub%d" % uid
-    _register_op(opname, num_outputs=n_out + nv_)(op_fn)
+    return op_fn, n_out + nv_
+
+
+def _build_cond_op(sub_t, sub_e, meta):
+    free_names = meta["free_names"]
+    n_out = meta["n_out"]
+
+    def op_fn(*arrays, **_attrs):
+        p, fv = arrays[0], arrays[1:]
+        feed = dict(zip(free_names, fv))
+
+        def run_then():
+            return tuple(_eval_symbol(sub_t, feed, wrap=False))
+
+        def run_else():
+            return tuple(_eval_symbol(sub_e, feed, wrap=False))
+
+        return _cf.cond(p, run_then, run_else)
+
+    return op_fn, n_out
+
+
+def _subgraph_attrs(kind, subs, meta):
+    """Node attrs carrying everything a fresh process needs to rebuild the
+    closure op: the cut-out subgraph(s) as nested graph JSON + metadata."""
+    attrs = {"subgraph_kind": kind,
+             "subgraph_meta": _json.dumps(meta)}
+    for i, sub in enumerate(subs):
+        key = "subgraph_json" if i == 0 else "subgraph_json%d" % i
+        attrs[key] = sub.tojson()
+    return attrs
+
+
+def reregister_subgraph_op(opname, attrs):
+    """Called by ``load_json`` for an unknown control-flow closure op:
+    rebuild it from the serialized subgraph (reference analogue: the graph
+    loader materializing the `_foreach` node's subgraph)."""
+    from . import load_json as _load_json
+
+    def _as_json_str(v):
+        # the generic attr parser may have already decoded the nested JSON
+        return _json.dumps(v) if isinstance(v, dict) else v
+
+    def _as_group(sym):
+        # the builders rely on Group list-eval semantics; a single-head
+        # subgraph loads back as a plain Symbol
+        return sym if sym._op == "_group" else Group([sym])
+
+    kind = attrs["subgraph_kind"]
+    meta = attrs["subgraph_meta"]
+    if isinstance(meta, str):
+        meta = _json.loads(meta)
+    sub = _as_group(_load_json(_as_json_str(attrs["subgraph_json"])))
+    if kind == "foreach":
+        op_fn, nout = _build_foreach_op(sub, meta)
+    elif kind == "while_loop":
+        op_fn, nout = _build_while_op(sub, meta)
+    elif kind == "cond":
+        sub_e = _as_group(_load_json(_as_json_str(attrs["subgraph_json1"])))
+        op_fn, nout = _build_cond_op(sub, sub_e, meta)
+    else:
+        raise ValueError("unknown subgraph kind %r" % kind)
+    _register_op(opname, num_outputs=nout)(op_fn)
+
+
+# ---------------------------------------------------------------------------
+# public tracers
+# ---------------------------------------------------------------------------
+
+def foreach(body, data, init_states, name="foreach"):
+    """``body(data_slice_sym, states_sym) -> (outputs, new_states)`` scanned
+    over axis 0 of ``data``. Returns ``(outputs, final_states)`` Symbols."""
+    uid = _next_uid()
+    data_list = _as_list(data)
+    multi_data = isinstance(data, (list, tuple))
+    states = _as_list(init_states)
+    multi_state = isinstance(init_states, (list, tuple))
+
+    data_ph = [var("_foreach%s_data%d" % (uid, i))
+               for i in range(len(data_list))]
+    state_ph = [var("_foreach%s_state%d" % (uid, i))
+                for i in range(len(states))]
+    ph_names = {v._name for v in data_ph + state_ph}
+
+    outs, new_states = body(data_ph if multi_data else data_ph[0],
+                            state_ph if multi_state else state_ph[0])
+    out_syms = _as_list(outs)
+    new_state_syms = _as_list(new_states)
+    multi_out = isinstance(outs, (list, tuple))
+    sub = Group(out_syms + new_state_syms)
+    free = _free_vars(out_syms + new_state_syms, ph_names)
+
+    meta = {"data_names": [v._name for v in data_ph],
+            "state_names": [v._name for v in state_ph],
+            "free_names": [v._name for v in free],
+            "n_out": len(out_syms), "multi_data": multi_data,
+            "multi_state": multi_state, "multi_out": multi_out}
+    op_fn, nout = _build_foreach_op(sub, meta)
+    opname = "_foreach_sub%s" % uid
+    _register_op(opname, num_outputs=nout)(op_fn)
+    node = _make_apply(opname, data_list + states + free,
+                       _subgraph_attrs("foreach", [sub], meta),
+                       name="%s%s" % (name, uid))
+    n_out, ns_ = meta["n_out"], len(states)
+    out_nodes = [node[i] for i in range(n_out)]
+    st_nodes = [node[n_out + i] for i in range(ns_)]
+    return (out_nodes if multi_out else out_nodes[0],
+            st_nodes if multi_state else (st_nodes[0] if st_nodes else []))
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Bounded symbolic while loop; see ``ops.control_flow.while_loop``."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static shapes)")
+    uid = _next_uid()
+    loop_vars = _as_list(loop_vars)
+    var_ph = [var("_while%s_var%d" % (uid, i)) for i in range(len(loop_vars))]
+    ph_names = {v._name for v in var_ph}
+
+    pred_sym = cond_fn(*var_ph)
+    outs, new_vars = func(*var_ph)
+    out_syms = _as_list(outs)
+    multi_out = isinstance(outs, (list, tuple))
+    new_var_syms = _as_list(new_vars)
+    if len(new_var_syms) != len(loop_vars):
+        raise ValueError("func must return as many loop_vars as it takes")
+    sub = Group([pred_sym] + out_syms + new_var_syms)
+    free = _free_vars([pred_sym] + out_syms + new_var_syms, ph_names)
+
+    meta = {"var_names": [v._name for v in var_ph],
+            "free_names": [v._name for v in free],
+            "n_out": len(out_syms), "multi_out": multi_out,
+            "max_iterations": int(max_iterations)}
+    op_fn, nout = _build_while_op(sub, meta)
+    opname = "_while_loop_sub%s" % uid
+    _register_op(opname, num_outputs=nout)(op_fn)
     node = _make_apply(opname, loop_vars + free,
-                       {"__subgraph__": "while_loop",
-                        "max_iterations": int(max_iterations)},
-                       name="%s%d" % (name, uid))
+                       _subgraph_attrs("while_loop", [sub], meta),
+                       name="%s%s" % (name, uid))
+    n_out, nv_ = meta["n_out"], len(loop_vars)
     out_nodes = [node[i] for i in range(n_out)]
     var_nodes = [node[n_out + i] for i in range(nv_)]
     return (out_nodes if multi_out else out_nodes[0]), var_nodes
@@ -175,26 +281,15 @@ def cond(pred, then_func, else_func, name="cond"):
         raise ValueError("then_func/else_func must produce the same outputs")
     sub_t, sub_e = Group(then_out), Group(else_out)
     free = _free_vars([pred] + then_out + else_out, set())
-    free_names = [v._name for v in free]
-    n_out = len(then_out)
 
-    def op_fn(*arrays, **_attrs):
-        p, fv = arrays[0], arrays[1:]
-        feed = dict(zip(free_names, fv))
-
-        def run_then():
-            return tuple(_eval_symbol(sub_t, feed, wrap=False))
-
-        def run_else():
-            return tuple(_eval_symbol(sub_e, feed, wrap=False))
-
-        return _cf.cond(p, run_then, run_else)
-
-    opname = "_cond_sub%d" % uid
-    _register_op(opname, num_outputs=n_out)(op_fn)
-    node = _make_apply(opname, [pred] + free, {"__subgraph__": "cond"},
-                       name="%s%d" % (name, uid))
-    return [node[i] for i in range(n_out)] if multi else node
+    meta = {"free_names": [v._name for v in free], "n_out": len(then_out)}
+    op_fn, nout = _build_cond_op(sub_t, sub_e, meta)
+    opname = "_cond_sub%s" % uid
+    _register_op(opname, num_outputs=nout)(op_fn)
+    node = _make_apply(opname, [pred] + free,
+                       _subgraph_attrs("cond", [sub_t, sub_e], meta),
+                       name="%s%s" % (name, uid))
+    return [node[i] for i in range(nout)] if multi else node
 
 
 def __getattr__(opname):

@@ -268,3 +268,72 @@ def test_step_scan_per_step_batches_dp_mesh():
         np.testing.assert_allclose(np.asarray(jax.device_get(p1[k])),
                                    np.asarray(jax.device_get(p2[k])),
                                    rtol=2e-4, atol=1e-5)
+
+
+from incubator_mxnet_tpu.parallel.collectives import \
+    collective_counts as _collective_counts
+
+
+def test_dp_step_inserts_grad_allreduce():
+    """HLO audit: a pure-dp step must contain gradient all-reduce(s) over
+    the dp axis — and a single-device step must contain none."""
+    np.random.seed(0)
+    X = np.random.rand(16, 8).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.int32)
+
+    net1 = _make_mlp(0)
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr1 = ShardedTrainer(net1, _loss_fn, mesh1)
+    hlo1 = tr1.lowered(nd.array(X), nd.array(y)).compile().as_text()
+    c1 = _collective_counts(hlo1)
+    assert c1["all-reduce"] == 0, c1
+
+    net2 = _make_mlp(0)
+    mesh4 = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    tr2 = ShardedTrainer(net2, _loss_fn, mesh4)
+    hlo4 = tr2.lowered(nd.array(X), nd.array(y)).compile().as_text()
+    c4 = _collective_counts(hlo4)
+    # GSPMD combines per-parameter psums; expect >=1 and a small combined
+    # count (4 diff params + loss -> must not explode into per-op chatter)
+    assert 1 <= c4["all-reduce"] <= 6, c4
+    assert c4["all-to-all"] == 0 and c4["collective-permute"] == 0, c4
+
+
+def test_tp_forward_single_allreduce():
+    """Megatron placement: column-parallel then row-parallel Dense needs
+    exactly ONE all-reduce in the forward pass."""
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential(prefix="tpmlp_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu", in_units=16,
+                               prefix="col_"),
+                gluon.nn.Dense(16, in_units=32, prefix="row_"))
+    net.initialize(mx.init.Xavier())
+    mesh = make_mesh({"tp": 4}, devices=jax.devices()[:4])
+    from incubator_mxnet_tpu.gluon.block import _TraceCtx, _trace_state
+    from jax.sharding import NamedSharding
+
+    rules = sharding_rules([
+        (r"col_weight$", P("tp", None)),     # (out, in): shard out
+        (r"col_bias$", P("tp")),
+        (r"row_weight$", P(None, "tp")),     # contract over sharded in
+    ])
+    params = {p.name: p for p in net.collect_params().values()}
+    pv = {n: jax.device_put(p._data._data, NamedSharding(mesh, rules(n)))
+          for n, p in params.items()}
+
+    def fwd(pv, x):
+        ctx = _TraceCtx(pv, jax.random.PRNGKey(0), training=False)
+        prev = getattr(_trace_state, "ctx", None)
+        _trace_state.ctx = ctx
+        try:
+            return net.forward(x)
+        finally:
+            _trace_state.ctx = prev
+
+    x = jax.device_put(jnp.asarray(np.random.rand(8, 16), jnp.float32),
+                       NamedSharding(mesh, P()))
+    hlo = jax.jit(fwd).lower(pv, x).compile().as_text()
+    c = _collective_counts(hlo)
+    assert c["all-reduce"] == 1, c
+    assert c["all-gather"] == 0, c

@@ -116,3 +116,90 @@ def test_quantize_model_roundtrip():
                     fc1_bias=mx.nd.array(b))[0].asnumpy()
     want = xin @ w.T + b
     np.testing.assert_allclose(got, want, rtol=0.2, atol=0.08)
+
+
+# --------------------------------------------------- JSON round-trip (r2)
+def _strip_closure_ops(json_str):
+    """Simulate a fresh process: delete the in-process closure ops the
+    serialized graph references, forcing the loader to rebuild them from
+    the nested subgraph JSON."""
+    import json as _json
+    from incubator_mxnet_tpu.ops import registry as _reg
+    for node in _json.loads(json_str)["nodes"]:
+        if node["op"].startswith(("_foreach_sub", "_while_loop_sub",
+                                  "_cond_sub")):
+            _reg._OP_REGISTRY.pop(node["op"], None)
+
+
+def test_sym_foreach_json_roundtrip():
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    scale = mx.sym.var("scale")
+    outs, final = mx.sym.contrib.foreach(
+        lambda d, s: (d * scale + s, d * scale + s), data, init)
+    js = outs.tojson()
+    d = np.arange(6, dtype=np.float32).reshape(3, 2)
+    s0 = np.zeros(2, dtype=np.float32)
+    want = outs.eval(data=mx.nd.array(d), init=mx.nd.array(s0),
+                     scale=mx.nd.array([2.0]))[0].asnumpy()
+    _strip_closure_ops(js)
+    loaded = mx.sym.load_json(js)
+    got = loaded.eval(data=mx.nd.array(d), init=mx.nd.array(s0),
+                      scale=mx.nd.array([2.0]))[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_sym_while_loop_json_roundtrip():
+    i = mx.sym.var("i")
+    acc = mx.sym.var("acc")
+    outs, final_vars = mx.sym.contrib.while_loop(
+        lambda i, a: i < 5, lambda i, a: ((i, i), [i + 1, a + i]),
+        [i, acc], max_iterations=8)
+    js = final_vars[1].tojson()
+    kw = dict(i=mx.nd.array([0.0]), acc=mx.nd.array([0.0]))
+    want = final_vars[1].eval(**kw)[0].asnumpy()
+    _strip_closure_ops(js)
+    loaded = mx.sym.load_json(js)
+    got = loaded.eval(**kw)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    np.testing.assert_allclose(got, [10.0])   # 0+1+2+3+4
+
+
+def test_sym_cond_json_roundtrip():
+    x = mx.sym.var("x")
+    out = mx.sym.contrib.cond(
+        mx.sym.sum(x) > 0, lambda: x * 2, lambda: x - 1)
+    js = out.tojson()
+    for val in ([1.0, 2.0], [-3.0, -4.0]):
+        want = out.eval(x=mx.nd.array(val))[0].asnumpy()
+        _strip_closure_ops(js)
+        loaded = mx.sym.load_json(js)
+        got = loaded.eval(x=mx.nd.array(val))[0].asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_sym_foreach_json_fresh_process():
+    """True cross-process check: export here, eval in a clean interpreter."""
+    import subprocess, sys, tempfile, os, textwrap
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    outs, _ = mx.sym.contrib.foreach(
+        lambda d, s: (d + s, d + s), data, init)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "g.json")
+        outs.save(path)
+        code = textwrap.dedent("""
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import incubator_mxnet_tpu as mx
+            sym = mx.sym.load(%r)
+            d = np.arange(6, dtype=np.float32).reshape(3, 2)
+            out = sym.eval(data=mx.nd.array(d),
+                           init=mx.nd.zeros((2,)))[0].asnumpy()
+            np.testing.assert_allclose(out, np.cumsum(d, axis=0), rtol=1e-6)
+            print("FRESH_OK")
+        """ % path)
+        env = dict(os.environ, JAX_PLATFORM_NAME="cpu", JAX_PLATFORMS="cpu")
+        res = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert "FRESH_OK" in res.stdout, res.stderr[-2000:]
