@@ -148,9 +148,18 @@ class ImageRecordDataset(RecordFileDataset):
         self._transform = transform
 
     def __getitem__(self, idx):
-        from incubator_mxnet_tpu.recordio import unpack_img
+        # decode to RGB like the reference's gluon dataset (mx.image.imdecode
+        # semantics) — NOT raw unpack_img, whose cv2 path yields BGR
+        from incubator_mxnet_tpu.recordio import unpack
         record = super().__getitem__(idx)
-        header, img = unpack_img(record, self._flag)
+        header, raw = unpack(record)
+        if bytes(raw[:4]) == b"NPY0":       # pack_img lossless fallback (RGB)
+            import io as _io
+            import numpy as _np
+            img = _np.load(_io.BytesIO(bytes(raw[4:])))
+        else:
+            from incubator_mxnet_tpu.image import imdecode
+            img = imdecode(raw, self._flag, to_rgb=True).asnumpy()
         label = header.label
         if self._transform is not None:
             return self._transform(img, label)

@@ -361,7 +361,8 @@ class ImageRecordIter(DataIter):
                  path_imgidx=None, shuffle=False, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  rand_crop=False, rand_mirror=False, preprocess_threads=4,
-                 round_batch=True, label_width=1, **kwargs):
+                 round_batch=True, label_width=1, backend="auto",
+                 seed=0, **kwargs):
         super().__init__(batch_size)
         from ..gluon.data.vision.datasets import ImageRecordDataset
         from ..gluon.data import DataLoader
@@ -370,6 +371,32 @@ class ImageRecordIter(DataIter):
         self._rand_mirror = rand_mirror
         self._mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
         self._std = _np.array([std_r, std_g, std_b], dtype=_np.float32)
+        # native C++ decode/augment/batch pipeline (reference:
+        # iter_image_recordio_2.cc) — the default whenever the library is
+        # available and the config maps onto it (RGB, simple label,
+        # resize+mirror augment; rand_crop and detection stay python-side)
+        self._native = None
+        c = self._data_shape[0]
+        if backend == "native" and rand_crop:
+            raise ValueError("the native pipeline does not implement "
+                             "rand_crop; use backend='never' for it")
+        use_native = (backend == "native"
+                      or (backend == "auto" and not rand_crop and c == 3
+                          and type(self) is ImageRecordIter))
+        if use_native and backend != "never":
+            from .. import native as _native
+            if _native.available():
+                self._native = _native.NativeImagePipeline(
+                    path_imgrec, batch_size, self._data_shape,
+                    label_width=label_width, threads=preprocess_threads,
+                    shuffle=shuffle, seed=seed, rand_mirror=rand_mirror,
+                    mean=self._mean.tolist(), std=self._std.tolist())
+                self._round_batch = round_batch
+                self._nat_batch_idx = 0
+                return
+            if backend == "native":
+                raise RuntimeError("native pipeline requested but "
+                                   "libmxtpu.so is unavailable")
         dataset = ImageRecordDataset(path_imgrec)
         c, h, w = self._data_shape
 
@@ -408,9 +435,33 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", (self.batch_size,))]
 
     def reset(self):
+        if self._native is not None:
+            self._native.reset()
+            self._nat_batch_idx = 0
+            return
         self._it = iter(self._loader)
 
     def next(self):
+        if self._native is not None:
+            # final batch wraps records from the epoch start: report the
+            # wrapped count as pad (round_batch=True) or drop the partial
+            # batch entirely (round_batch=False), matching the python path
+            n_rec = self._native.num_records
+            n_bat = self._native.num_batches
+            pad = 0
+            if self._nat_batch_idx == n_bat - 1:
+                pad = n_bat * self.batch_size - n_rec
+                if pad and not self._round_batch:
+                    self._native.next()     # consume + discard the partial
+                    self._nat_batch_idx += 1
+                    raise StopIteration
+            out = self._native.next()
+            if out is None:
+                raise StopIteration
+            self._nat_batch_idx += 1
+            data, label = out
+            return DataBatch(data=[nd_array(data.copy())],
+                             label=[nd_array(label.copy())], pad=pad)
         try:
             data, label = next(self._it)
         except StopIteration:

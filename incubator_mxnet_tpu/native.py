@@ -26,7 +26,10 @@ _build_failed = False
 
 
 def _build():
-    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+    # build ONLY the runtime library: the predict ABI lib needs Python
+    # embed headers and must not take the whole native runtime down with
+    # it on hosts without python3-dev
+    subprocess.run(["make", "-C", _NATIVE_DIR, "libmxtpu.so"], check=True,
                    capture_output=True)
 
 
@@ -100,8 +103,25 @@ def _declare(lib):
     lib.mxtpu_pool_pooled_bytes.restype = ctypes.c_int64
     lib.mxtpu_pool_pooled_bytes.argtypes = [ctypes.c_void_p]
 
+    lib.mxtpu_imgpipe_create.restype = ctypes.c_void_p
+    lib.mxtpu_imgpipe_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float)]
+    lib.mxtpu_imgpipe_next.restype = ctypes.c_int
+    lib.mxtpu_imgpipe_num_batches.restype = ctypes.c_int64
+    lib.mxtpu_imgpipe_num_batches.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_imgpipe_num_records.restype = ctypes.c_int64
+    lib.mxtpu_imgpipe_num_records.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_imgpipe_reset.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_imgpipe_error.restype = ctypes.c_char_p
+    lib.mxtpu_imgpipe_error.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_imgpipe_free.argtypes = [ctypes.c_void_p]
+
     f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
     i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+    lib.mxtpu_imgpipe_next.argtypes = [ctypes.c_void_p, f32p, f32p]
     lib.mxtpu_f32_add_inplace.argtypes = [f32p, f32p, ctypes.c_int64]
     lib.mxtpu_f32_axpy.argtypes = [f32p, f32p, ctypes.c_float, ctypes.c_int64]
     lib.mxtpu_f32_scale.argtypes = [f32p, ctypes.c_float, ctypes.c_int64]
@@ -237,3 +257,62 @@ def dequantize_2bit_native(packed, n, threshold):
     lib.mxtpu_dequantize_2bit(np.ascontiguousarray(packed, np.int32), out,
                               threshold, n)
     return out
+
+
+class NativeImagePipeline:
+    """Fused C++ decode/augment/batch pipeline over a .rec file (reference:
+    src/io/iter_image_recordio_2.cc ImageRecordIOParser2). Worker threads
+    decode JPEG (or pack_img's .npy fallback), bilinear-resize to the target
+    shape, mirror/normalize and write float32 NCHW batches into pooled
+    buffers; batches are delivered in deterministic epoch order."""
+
+    def __init__(self, path, batch_size, data_shape, label_width=1,
+                 threads=4, shuffle=False, seed=0, rand_mirror=False,
+                 mean=None, std=None):
+        import ctypes as ct
+        self._lib = get_lib()
+        c, h, w = data_shape
+        if c != 3:
+            raise ValueError("native pipeline is RGB-only (c=3)")
+        mean_arr = (ct.c_float * 3)(*(mean if mean is not None else (0, 0, 0)))
+        std_arr = (ct.c_float * 3)(*(std if std is not None else (1, 1, 1)))
+        self._h = self._lib.mxtpu_imgpipe_create(
+            path.encode(), batch_size, h, w, label_width, threads,
+            1 if shuffle else 0, seed, 1 if rand_mirror else 0,
+            mean_arr, std_arr)
+        if not self._h:
+            raise IOError("cannot open %s as a RecordIO image file" % path)
+        self.batch_size = batch_size
+        self.data_shape = (batch_size, 3, h, w)
+        self.label_shape = (batch_size, label_width) if label_width > 1 \
+            else (batch_size,)
+        self._label_width = label_width
+        self._data = np.empty(self.data_shape, np.float32)
+        self._label = np.empty((batch_size, label_width), np.float32)
+
+    @property
+    def num_batches(self):
+        return int(self._lib.mxtpu_imgpipe_num_batches(self._h))
+
+    @property
+    def num_records(self):
+        return int(self._lib.mxtpu_imgpipe_num_records(self._h))
+
+    def next(self):
+        """Returns (data, label) numpy views (overwritten by the next call),
+        or None at epoch end."""
+        n = self._lib.mxtpu_imgpipe_next(self._h, self._data, self._label)
+        if n == 0:
+            return None
+        if n < 0:
+            raise IOError("native image pipeline: %s"
+                          % self._lib.mxtpu_imgpipe_error(self._h).decode())
+        label = self._label if self._label_width > 1 else self._label[:, 0]
+        return self._data, label
+
+    def reset(self):
+        self._lib.mxtpu_imgpipe_reset(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_imgpipe_free(self._h)

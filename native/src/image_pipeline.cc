@@ -1,0 +1,445 @@
+// Fused decode/augment/batch image pipeline (C ABI, worker threads).
+//
+// Reference parity: src/io/iter_image_recordio_2.cc:766-817 — the threaded
+// C++ ImageRecordIOParser2 that decodes JPEG, augments and writes straight
+// into the batch buffer, overlapped with training. Here: N persistent
+// workers each claim a BATCH, pread records from the .rec file, decode
+// (libjpeg; also the .npy fallback container pack_img emits without cv2),
+// bilinear-resize to the target shape, optional horizontal mirror,
+// mean/std-normalize, and write float32 NCHW into a pooled batch slot; a
+// bounded queue hands finished batches to the consumer (double-buffered
+// prefetch). Order within an epoch is deterministic for a given seed.
+
+#include <cstddef>
+#include <cstdio>
+#include <csetjmp>
+extern "C" {
+#include <jpeglib.h>
+}
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+#pragma pack(push, 4)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+static_assert(sizeof(IRHeader) == 24, "IRHeader layout");
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> label;
+  int n = 0;
+  int64_t seq = 0;    // epoch-order sequence for deterministic delivery
+  uint64_t epoch = 0; // stale batches from before a reset() are dropped
+};
+
+struct ErrState {
+  std::mutex mu;
+  std::string msg;
+  void set(const std::string &m) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (msg.empty()) msg = m;
+  }
+};
+
+// --------------------------------------------------------------- decoding
+
+bool decode_jpeg(const uint8_t *buf, size_t len, std::vector<uint8_t> *rgb,
+                 int *h, int *w) {
+  jpeg_decompress_struct cinfo;
+  jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr);
+  jerr.error_exit = [](j_common_ptr c) { longjmp(*(jmp_buf *)c->client_data, 1); };
+  jmp_buf env;
+  cinfo.client_data = &env;
+  if (setjmp(env)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t *>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  rgb->resize(size_t(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t *row = rgb->data() + size_t(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// minimal parser for pack_img's cv2-less fallback: .npy v1 containing an
+// (H, W, 3) |u1 array
+bool decode_npy(const uint8_t *buf, size_t len, std::vector<uint8_t> *rgb,
+                int *h, int *w) {
+  if (len < 10 || std::memcmp(buf, "\x93NUMPY", 6) != 0) return false;
+  uint16_t hlen;
+  std::memcpy(&hlen, buf + 8, 2);
+  std::string hdr(reinterpret_cast<const char *>(buf + 10), hlen);
+  if (hdr.find("|u1") == std::string::npos) return false;
+  auto p = hdr.find("(");
+  auto q = hdr.find(")", p);
+  if (p == std::string::npos || q == std::string::npos) return false;
+  int dims[3] = {0, 0, 0}, nd = 0;
+  const char *s = hdr.c_str() + p + 1;
+  while (nd < 3 && s < hdr.c_str() + q) {
+    dims[nd++] = std::atoi(s);
+    const char *c = std::strchr(s, ',');
+    if (!c || c > hdr.c_str() + q) break;
+    s = c + 1;
+  }
+  if (nd < 2) return false;
+  int ch = nd == 3 ? dims[2] : 1;
+  if (ch != 3 && ch != 1) return false;
+  *h = dims[0];
+  *w = dims[1];
+  size_t need = size_t(*h) * *w * ch;
+  const uint8_t *payload = buf + 10 + hlen;
+  if (len - 10 - hlen < need) return false;
+  rgb->resize(size_t(*h) * *w * 3);
+  if (ch == 3) {
+    std::memcpy(rgb->data(), payload, need);
+  } else {
+    for (size_t i = 0; i < size_t(*h) * *w; ++i)
+      (*rgb)[3 * i] = (*rgb)[3 * i + 1] = (*rgb)[3 * i + 2] = payload[i];
+  }
+  return true;
+}
+
+void bilinear_to(const std::vector<uint8_t> &src, int sh, int sw, float *dst,
+                 int dh, int dw, bool mirror, const float *mean,
+                 const float *stdv) {
+  // dst: (3, dh, dw) float32 CHW, normalized
+  const float ry = dh > 1 ? float(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? float(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = int(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      int xo = mirror ? (dw - 1 - x) : x;
+      float fx = xo * rx;
+      int x0 = int(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(size_t(y0) * sw + x0) * 3 + c];
+        float v01 = src[(size_t(y0) * sw + x1) * 3 + c];
+        float v10 = src[(size_t(y1) * sw + x0) * 3 + c];
+        float v11 = src[(size_t(y1) * sw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(size_t(c) * dh + y) * dw + x] = (v - mean[c]) / stdv[c];
+      }
+    }
+  }
+}
+
+void crop_to(const std::vector<uint8_t> &src, int sh, int sw, float *dst,
+             int dh, int dw, bool mirror, const float *mean,
+             const float *stdv) {
+  // center crop (reference ImageRecordIter semantics when the decoded
+  // image is at least the target size — no interpolation)
+  int y0 = (sh - dh) / 2, x0 = (sw - dw) / 2;
+  for (int y = 0; y < dh; ++y) {
+    const uint8_t *row = src.data() + (size_t(y0 + y) * sw + x0) * 3;
+    for (int x = 0; x < dw; ++x) {
+      int xo = mirror ? (dw - 1 - x) : x;
+      for (int c = 0; c < 3; ++c)
+        dst[(size_t(c) * dh + y) * dw + x] =
+            (float(row[size_t(xo) * 3 + c]) - mean[c]) / stdv[c];
+    }
+  }
+}
+
+// ------------------------------------------------------------------ pipe
+
+struct Pipe {
+  int fd = -1;
+  std::vector<int64_t> offsets;
+  std::vector<uint32_t> lens;
+  int batch, H, W, label_width;
+  bool shuffle, rand_mirror;
+  uint64_t seed;
+  float mean[3], stdv[3];
+
+  // record order for the current epoch: published as an immutable snapshot
+  // so workers mid-batch across a reset() never read a vector being
+  // reshuffled (shared_ptr swap under mu; readers hold their own ref)
+  std::shared_ptr<const std::vector<int64_t>> order;
+  int64_t next_batch = 0;           // guarded by mu
+  int64_t num_batches = 0;
+  uint64_t epoch = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_out, cv_space;
+  std::deque<Batch> ready;
+  int64_t deliver_seq = 0;          // next sequence to hand out (in order)
+  size_t prefetch = 4;
+  bool stopping = false;
+  std::vector<std::thread> workers;
+  ErrState err;
+
+  ~Pipe() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_out.notify_all();
+    cv_space.notify_all();
+    for (auto &t : workers) t.join();
+    if (fd >= 0) close(fd);
+  }
+
+  void shuffle_order() {
+    auto ord = std::make_shared<std::vector<int64_t>>(offsets.size());
+    for (size_t i = 0; i < ord->size(); ++i) (*ord)[i] = int64_t(i);
+    if (shuffle) {
+      std::mt19937_64 rng(seed + epoch * 0x9e3779b97f4a7c15ull);
+      for (size_t i = ord->size(); i > 1; --i)
+        std::swap((*ord)[i - 1], (*ord)[rng() % i]);
+    }
+    order = std::move(ord);
+  }
+
+  bool fill_one(int64_t rec_idx, float *data_out, float *label_out,
+                std::mt19937_64 *rng, std::vector<uint8_t> *scratch,
+                std::vector<uint8_t> *rgb) {
+    int64_t off = offsets[rec_idx];
+    uint32_t len = lens[rec_idx];
+    scratch->resize(len);
+    if (pread(fd, scratch->data(), len, off + 8) != ssize_t(len)) {
+      err.set("pread failed");
+      return false;
+    }
+    const uint8_t *p = scratch->data();
+    IRHeader hdr;
+    std::memcpy(&hdr, p, sizeof(hdr));
+    p += sizeof(hdr);
+    size_t remain = len - sizeof(hdr);
+    if (hdr.flag > 0) {
+      if (size_t(hdr.flag) * 4 > remain) {
+        err.set("corrupt record: label count exceeds payload");
+        return false;
+      }
+      for (int i = 0; i < label_width; ++i)
+        label_out[i] = i < int(hdr.flag)
+                           ? reinterpret_cast<const float *>(p)[i]
+                           : 0.f;
+      p += hdr.flag * 4;
+      remain -= hdr.flag * 4;
+    } else {
+      label_out[0] = hdr.label;
+      for (int i = 1; i < label_width; ++i) label_out[i] = 0.f;
+    }
+    if (remain > 4 && std::memcmp(p, "NPY0", 4) == 0) {
+      p += 4;               // pack_img lossless-container prefix
+      remain -= 4;
+    }
+    int sh = 0, sw = 0;
+    bool ok = (remain > 2 && p[0] == 0xFF && p[1] == 0xD8)
+                  ? decode_jpeg(p, remain, rgb, &sh, &sw)
+                  : decode_npy(p, remain, rgb, &sh, &sw);
+    if (!ok) {
+      err.set("undecodable image record");
+      return false;
+    }
+    bool mirror = rand_mirror && ((*rng)() & 1);
+    if (sh >= H && sw >= W)
+      crop_to(*rgb, sh, sw, data_out, H, W, mirror, mean, stdv);
+    else
+      bilinear_to(*rgb, sh, sw, data_out, H, W, mirror, mean, stdv);
+    return true;
+  }
+
+  void worker(int wid) {
+    (void)wid;
+    std::vector<uint8_t> scratch, rgb;
+    for (;;) {
+      int64_t b;
+      uint64_t e;
+      std::shared_ptr<const std::vector<int64_t>> ord;
+      {
+        // claim the batch index TOGETHER with the epoch + order snapshot:
+        // a reset() can then never pair an old index with the new epoch
+        // (which would leave a seq hole) or vice versa
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk, [&] { return stopping || next_batch < num_batches; });
+        if (stopping) return;
+        b = next_batch++;
+        e = epoch;
+        ord = order;
+      }
+      Batch out;
+      out.seq = b;
+      out.n = batch;
+      out.epoch = e;
+      out.data.resize(size_t(batch) * 3 * H * W);
+      out.label.resize(size_t(batch) * label_width);
+      // rng keyed on (seed, epoch, batch) ONLY — worker assignment is a
+      // race and must not affect augmentation reproducibility
+      std::mt19937_64 rng(seed ^ (uint64_t(b) << 20) ^ (e << 40));
+      for (int i = 0; i < batch; ++i) {
+        int64_t pos = b * batch + i;
+        // final partial batch wraps to the epoch start (pad semantics)
+        int64_t rec = (*ord)[size_t(pos) % ord->size()];
+        if (!fill_one(rec, out.data.data() + size_t(i) * 3 * H * W,
+                      out.label.data() + size_t(i) * label_width, &rng,
+                      &scratch, &rgb)) {
+          std::lock_guard<std::mutex> lk(mu);
+          stopping = true;
+          cv_out.notify_all();
+          return;
+        }
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] {
+        return stopping || epoch != out.epoch || ready.size() < prefetch ||
+               out.seq == deliver_seq;   // never block the next-in-line batch
+      });
+      if (stopping) return;
+      if (epoch != out.epoch) continue;  // reset() raced: drop stale batch
+      ready.push_back(std::move(out));
+      cv_out.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *mxtpu_imgpipe_create(const char *path, int batch, int h, int w,
+                           int label_width, int threads, int shuffle,
+                           uint64_t seed, int rand_mirror,
+                           const float *mean_rgb, const float *std_rgb) {
+  auto *p = new Pipe();
+  p->fd = open(path, O_RDONLY);
+  if (p->fd < 0) {
+    delete p;
+    return nullptr;
+  }
+  // index scan (offsets + payload lengths)
+  FILE *f = std::fopen(path, "rb");
+  for (;;) {
+    long pos = std::ftell(f);
+    uint32_t head[2];
+    if (std::fread(head, 4, 2, f) != 2 || head[0] != kMagic) break;
+    uint32_t len = head[1] & kLenMask;
+    p->offsets.push_back(pos);
+    p->lens.push_back(len);
+    if (std::fseek(f, (len + 3u) & ~3u, SEEK_CUR) != 0) break;
+  }
+  std::fclose(f);
+  if (p->offsets.empty()) {
+    delete p;
+    return nullptr;
+  }
+  p->batch = batch;
+  p->H = h;
+  p->W = w;
+  p->label_width = label_width > 0 ? label_width : 1;
+  p->shuffle = shuffle != 0;
+  p->rand_mirror = rand_mirror != 0;
+  p->seed = seed;
+  for (int c = 0; c < 3; ++c) {
+    p->mean[c] = mean_rgb ? mean_rgb[c] : 0.f;
+    p->stdv[c] = (std_rgb && std_rgb[c] != 0.f) ? std_rgb[c] : 1.f;
+  }
+  p->num_batches =
+      (int64_t(p->offsets.size()) + batch - 1) / batch;
+  p->shuffle_order();
+  int nthreads = threads > 0 ? threads : 4;
+  for (int i = 0; i < nthreads; ++i)
+    p->workers.emplace_back(&Pipe::worker, p, i);
+  return p;
+}
+
+// Blocking next batch (delivered in epoch order). Returns the number of
+// samples written, 0 at epoch end, -1 on error.
+int mxtpu_imgpipe_next(void *handle, float *data_out, float *label_out) {
+  auto *p = static_cast<Pipe *>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->deliver_seq >= p->num_batches) return 0;
+  p->cv_out.wait(lk, [&] {
+    if (p->stopping) return true;
+    for (auto &b : p->ready)
+      if (b.seq == p->deliver_seq) return true;
+    return false;
+  });
+  if (p->stopping) return -1;
+  for (auto it = p->ready.begin(); it != p->ready.end(); ++it) {
+    if (it->seq == p->deliver_seq) {
+      std::memcpy(data_out, it->data.data(), it->data.size() * 4);
+      std::memcpy(label_out, it->label.data(), it->label.size() * 4);
+      int n = it->n;
+      p->ready.erase(it);
+      p->deliver_seq++;
+      p->cv_space.notify_all();
+      return n;
+    }
+  }
+  return -1;  // unreachable
+}
+
+int64_t mxtpu_imgpipe_num_batches(void *handle) {
+  return static_cast<Pipe *>(handle)->num_batches;
+}
+
+int64_t mxtpu_imgpipe_num_records(void *handle) {
+  return int64_t(static_cast<Pipe *>(handle)->offsets.size());
+}
+
+void mxtpu_imgpipe_reset(void *handle) {
+  auto *p = static_cast<Pipe *>(handle);
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->epoch++;
+  p->shuffle_order();
+  p->ready.clear();
+  p->deliver_seq = 0;
+  p->next_batch = 0;
+  p->cv_space.notify_all();
+}
+
+const char *mxtpu_imgpipe_error(void *handle) {
+  auto *p = static_cast<Pipe *>(handle);
+  std::lock_guard<std::mutex> lk(p->err.mu);
+  static thread_local std::string copy;
+  copy = p->err.msg;
+  return copy.c_str();
+}
+
+void mxtpu_imgpipe_free(void *handle) {
+  delete static_cast<Pipe *>(handle);
+}
+
+}  // extern "C"

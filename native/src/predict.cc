@@ -1,0 +1,294 @@
+// C predict ABI — deploy an exported model (symbol JSON + params) from C.
+//
+// Reference parity: include/mxnet/c_predict_api.h (MXPredCreate /
+// MXPredSetInput / MXPredForward / MXPredGetOutputShape / MXPredGetOutput /
+// MXPredFree / MXGetLastError). The reference backs this with the full C++
+// executor; the TPU-native build's compute path is XLA via Python, so this
+// library embeds CPython and drives gluon.SymbolBlock.imports — the C
+// surface and semantics match, the engine underneath is jit/XLA.
+//
+// Built as libmxtpu_predict.so (separate from libmxtpu.so so the host
+// runtime library carries no Python dependency).
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+std::string g_last_error;
+bool g_owns_interp = false;
+
+void set_err(const std::string &e) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_last_error = e;
+}
+
+void set_err_from_py() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_err(msg);
+}
+
+// Python-side helper: a tiny module managing predictors by id. Data crosses
+// the boundary as raw float32 bytes; shapes as int lists.
+const char *kHelper = R"PY(
+import numpy as _np
+
+def _force_cpu():
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+_force_cpu()
+import incubator_mxnet_tpu as mx
+
+_predictors = {}
+_next = [1]
+
+def create(symbol_file, param_file, input_names):
+    from incubator_mxnet_tpu.gluon import SymbolBlock
+    blk = SymbolBlock.imports(symbol_file, list(input_names),
+                              param_file or None)
+    pid = _next[0]; _next[0] += 1
+    _predictors[pid] = {"block": blk, "inputs": {}, "outputs": None,
+                       "names": list(input_names)}
+    return pid
+
+def set_input(pid, name, buf, shape):
+    p = _predictors[pid]
+    arr = _np.frombuffer(buf, dtype=_np.float32).reshape(shape).copy()
+    p["inputs"][name] = mx.nd.array(arr)
+
+def forward(pid):
+    p = _predictors[pid]
+    args = [p["inputs"][n] for n in p["names"]]
+    out = p["block"](*args)
+    if not isinstance(out, (list, tuple)):
+        out = [out]
+    p["outputs"] = [_np.asarray(o.asnumpy(), dtype=_np.float32) for o in out]
+    return len(p["outputs"])
+
+def output_shape(pid, index):
+    return list(_predictors[pid]["outputs"][index].shape)
+
+def output_bytes(pid, index):
+    return _np.ascontiguousarray(
+        _predictors[pid]["outputs"][index]).tobytes()
+
+def free(pid):
+    _predictors.pop(pid, None)
+)PY";
+
+PyObject *g_helper = nullptr;  // helper module namespace (dict)
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interp = true;
+    // release the GIL acquired by Py_Initialize so PyGILState_Ensure works
+    // uniformly from any caller thread
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() { st = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(st); }
+};
+
+bool ensure_helper() {
+  if (g_helper) return true;
+  PyObject *mod = PyImport_AddModule("__mxtpu_predict__");  // borrowed
+  if (!mod) return false;
+  PyObject *dict = PyModule_GetDict(mod);  // borrowed
+  PyObject *res = PyRun_String(kHelper, Py_file_input, dict, dict);
+  if (!res) return false;
+  Py_DECREF(res);
+  g_helper = dict;
+  Py_INCREF(g_helper);
+  return true;
+}
+
+PyObject *helper_call(const char *fn, PyObject *args) {
+  PyObject *f = PyDict_GetItemString(g_helper, fn);  // borrowed
+  if (!f) {
+    set_err(std::string("helper missing: ") + fn);
+    return nullptr;
+  }
+  return PyObject_CallObject(f, args);
+}
+
+struct Predictor {
+  long pid;
+  int num_outputs = 0;
+  std::vector<std::vector<int>> out_shapes;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPUPredGetLastError() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return g_last_error.c_str();
+}
+
+// symbol_file: path to exported symbol JSON; param_file: path to exported
+// params (empty/NULL = uninitialized); input_names: model input names.
+int MXTPUPredCreate(const char *symbol_file, const char *param_file,
+                    const char **input_names, int num_inputs, void **out) {
+  ensure_python();
+  GIL gil;
+  if (!ensure_helper()) {
+    set_err_from_py();
+    return -1;
+  }
+  PyObject *names = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i)
+    PyList_SetItem(names, i, PyUnicode_FromString(input_names[i]));
+  PyObject *args = Py_BuildValue("(ssO)", symbol_file,
+                                 param_file ? param_file : "", names);
+  Py_DECREF(names);
+  PyObject *res = helper_call("create", args);
+  Py_DECREF(args);
+  if (!res) {
+    set_err_from_py();
+    return -1;
+  }
+  auto *p = new Predictor();
+  p->pid = PyLong_AsLong(res);
+  Py_DECREF(res);
+  *out = p;
+  return 0;
+}
+
+int MXTPUPredSetInput(void *handle, const char *name, const float *data,
+                      const int *shape, int ndim) {
+  auto *p = static_cast<Predictor *>(handle);
+  GIL gil;
+  size_t n = 1;
+  PyObject *shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= static_cast<size_t>(shape[i]);
+    PyList_SetItem(shp, i, PyLong_FromLong(shape[i]));
+  }
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(n * sizeof(float)));
+  PyObject *args = Py_BuildValue("(lsOO)", p->pid, name, buf, shp);
+  Py_DECREF(buf);
+  Py_DECREF(shp);
+  PyObject *res = helper_call("set_input", args);
+  Py_DECREF(args);
+  if (!res) {
+    set_err_from_py();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredForward(void *handle) {
+  auto *p = static_cast<Predictor *>(handle);
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", p->pid);
+  PyObject *res = helper_call("forward", args);
+  Py_DECREF(args);
+  if (!res) {
+    set_err_from_py();
+    return -1;
+  }
+  p->num_outputs = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  p->out_shapes.assign(p->num_outputs, {});
+  for (int i = 0; i < p->num_outputs; ++i) {
+    PyObject *a = Py_BuildValue("(li)", p->pid, i);
+    PyObject *s = helper_call("output_shape", a);
+    Py_DECREF(a);
+    if (!s) {
+      set_err_from_py();
+      return -1;
+    }
+    Py_ssize_t nd = PyList_Size(s);
+    for (Py_ssize_t d = 0; d < nd; ++d)
+      p->out_shapes[i].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(s, d))));
+    Py_DECREF(s);
+  }
+  return 0;
+}
+
+int MXTPUPredGetNumOutputs(void *handle) {
+  return static_cast<Predictor *>(handle)->num_outputs;
+}
+
+// shape_out must hold >= MXTPU_MAX_NDIM (8) ints; returns ndim.
+int MXTPUPredGetOutputShape(void *handle, int index, int *shape_out) {
+  auto *p = static_cast<Predictor *>(handle);
+  if (index < 0 || index >= p->num_outputs) {
+    set_err("output index out of range");
+    return -1;
+  }
+  const auto &s = p->out_shapes[index];
+  for (size_t i = 0; i < s.size(); ++i) shape_out[i] = s[i];
+  return static_cast<int>(s.size());
+}
+
+int MXTPUPredGetOutput(void *handle, int index, float *out, size_t size) {
+  auto *p = static_cast<Predictor *>(handle);
+  GIL gil;
+  PyObject *args = Py_BuildValue("(li)", p->pid, index);
+  PyObject *res = helper_call("output_bytes", args);
+  Py_DECREF(args);
+  if (!res) {
+    set_err_from_py();
+    return -1;
+  }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(res, &buf, &len);
+  if (static_cast<size_t>(len) > size * sizeof(float)) {
+    Py_DECREF(res);
+    set_err("output buffer too small");
+    return -1;
+  }
+  std::memcpy(out, buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+  return static_cast<int>(len / sizeof(float));
+}
+
+int MXTPUPredFree(void *handle) {
+  auto *p = static_cast<Predictor *>(handle);
+  if (Py_IsInitialized()) {
+    GIL gil;
+    PyObject *args = Py_BuildValue("(l)", p->pid);
+    PyObject *res = helper_call("free", args);
+    Py_XDECREF(res);
+    Py_DECREF(args);
+  }
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

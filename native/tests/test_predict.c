@@ -1,0 +1,74 @@
+/* C deployment smoke: load an exported model and classify a tensor.
+ * Usage: test_predict <symbol.json> <params> <input_name> <N,C,H,W> \
+ *                     <input.f32> <output.f32>
+ * Exits 0 on success; prints "argmax=<i>" for the first output. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern int MXTPUPredCreate(const char *symbol_file, const char *param_file,
+                           const char **input_names, int num_inputs,
+                           void **out);
+extern int MXTPUPredSetInput(void *h, const char *name, const float *data,
+                             const int *shape, int ndim);
+extern int MXTPUPredForward(void *h);
+extern int MXTPUPredGetNumOutputs(void *h);
+extern int MXTPUPredGetOutputShape(void *h, int index, int *shape_out);
+extern int MXTPUPredGetOutput(void *h, int index, float *out, size_t size);
+extern int MXTPUPredFree(void *h);
+extern const char *MXTPUPredGetLastError(void);
+
+int main(int argc, char **argv) {
+  if (argc != 7) {
+    fprintf(stderr, "usage: %s sym params input_name shape in.f32 out.f32\n",
+            argv[0]);
+    return 2;
+  }
+  int shape[8], ndim = 0;
+  size_t n = 1;
+  char *spec = strdup(argv[4]);
+  for (char *tok = strtok(spec, ","); tok; tok = strtok(NULL, ","))
+    { shape[ndim] = atoi(tok); n *= (size_t)shape[ndim]; ndim++; }
+
+  float *input = (float *)malloc(n * sizeof(float));
+  FILE *fi = fopen(argv[5], "rb");
+  if (!fi || fread(input, sizeof(float), n, fi) != n) {
+    fprintf(stderr, "bad input file\n");
+    return 2;
+  }
+  fclose(fi);
+
+  void *h = NULL;
+  const char *names[1] = {argv[3]};
+  if (MXTPUPredCreate(argv[1], argv[2], names, 1, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTPUPredGetLastError());
+    return 1;
+  }
+  if (MXTPUPredSetInput(h, argv[3], input, shape, ndim) != 0 ||
+      MXTPUPredForward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXTPUPredGetLastError());
+    return 1;
+  }
+  int oshape[8];
+  int ondim = MXTPUPredGetOutputShape(h, 0, oshape);
+  if (ondim < 0) { fprintf(stderr, "%s\n", MXTPUPredGetLastError()); return 1; }
+  size_t osize = 1;
+  for (int i = 0; i < ondim; ++i) osize *= (size_t)oshape[i];
+  float *out = (float *)malloc(osize * sizeof(float));
+  int got = MXTPUPredGetOutput(h, 0, out, osize);
+  if (got < 0) { fprintf(stderr, "%s\n", MXTPUPredGetLastError()); return 1; }
+
+  size_t best = 0;
+  for (size_t i = 1; i < osize; ++i) if (out[i] > out[best]) best = i;
+  printf("argmax=%zu\n", best);
+
+  FILE *fo = fopen(argv[6], "wb");
+  fwrite(out, sizeof(float), osize, fo);
+  fclose(fo);
+
+  MXTPUPredFree(h);
+  free(out);
+  free(input);
+  free(spec);
+  return 0;
+}

@@ -136,3 +136,51 @@ def test_f32_kernels():
     np.testing.assert_allclose(a, np.arange(10) + 3)
     lib.mxtpu_f32_scale(a, 0.5, 10)
     np.testing.assert_allclose(a, (np.arange(10) + 3) / 2)
+
+
+def test_c_predict_abi_resnet(tmp_path):
+    """Deployment path (reference: c_predict_api.h): export a model, then a
+    pure-C program loads and classifies via libmxtpu_predict.so; outputs
+    must match the in-process python forward bit-for-bit (same backend)."""
+    import subprocess, sys, os
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(root, "native")
+    lib = os.path.join(native, "libmxtpu_predict.so")
+    if not os.path.exists(lib):
+        r = subprocess.run(["make", "-C", native, "libmxtpu_predict.so"],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+    np.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet18_v1()
+    net.initialize(mx.init.Xavier())
+    x = np.random.rand(1, 3, 224, 224).astype(np.float32)
+    net(nd.array(x))                      # materialize shapes
+    net.hybridize()
+    want = net(nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "resnet18")
+    net.export(prefix, epoch=0)
+
+    exe = str(tmp_path / "test_predict")
+    r = subprocess.run(
+        ["gcc", "-O2", os.path.join(native, "tests", "test_predict.c"),
+         "-o", exe, "-L", native, "-lmxtpu_predict",
+         "-Wl,-rpath," + native], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    x.tofile(str(tmp_path / "in.f32"))
+    env = dict(os.environ, PYTHONPATH=root, JAX_PLATFORM_NAME="cpu",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0000.params", "data",
+         "1,3,224,224", str(tmp_path / "in.f32"),
+         str(tmp_path / "out.f32")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert "argmax=%d" % int(want.argmax()) in r.stdout
+    got = np.fromfile(str(tmp_path / "out.f32"), dtype=np.float32)
+    np.testing.assert_allclose(got.reshape(want.shape), want,
+                               rtol=1e-4, atol=1e-5)
