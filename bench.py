@@ -38,7 +38,7 @@ def bench_bert(steps, dtype):
     from incubator_mxnet_tpu.models.bert import BERTForPretrain
     from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
 
-    B, T = int(os.environ.get("BENCH_BATCH", "32")), 128
+    B, T = int(os.environ.get("BENCH_BATCH", "64")), 128
     V = 30522
     MASK_FRAC = 0.15
     n_mask = max(1, int(T * MASK_FRAC))
