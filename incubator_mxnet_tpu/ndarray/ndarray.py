@@ -515,8 +515,55 @@ def _invoke_simple(fn, *arrays, op_name=None):
     return _wrap_outputs(outs, node)
 
 
+_storage_fallback_warned = set()
+
+
+def _sparse_dispatch(name, args, kwargs):
+    """stype-aware dispatch (reference: the FInferStorageType DispatchMode —
+    ops with sparse implementations run on structure; everything else takes
+    the dense storage-fallback path with a one-time log, matching
+    imperative_utils.h's fallback semantics)."""
+    from . import sparse as _sp
+    if name == "dot":
+        lhs, rhs = args[0], args[1]
+        if isinstance(lhs, _sp.BaseSparseNDArray):
+            return _sp.dot(lhs, rhs,
+                           transpose_a=kwargs.get("transpose_a", False),
+                           transpose_b=kwargs.get("transpose_b", False))
+    if name in ("elemwise_add", "broadcast_add", "_plus") and len(args) == 2 \
+            and all(isinstance(a, _sp.RowSparseNDArray) for a in args):
+        return _sp.add(args[0], args[1])
+    if name in ("elemwise_sub", "broadcast_sub", "_minus") and len(args) == 2 \
+            and all(isinstance(a, _sp.RowSparseNDArray) for a in args):
+        return _sp.subtract(args[0], args[1])
+    if name in ("elemwise_mul", "broadcast_mul") and len(args) == 2 \
+            and all(isinstance(a, _sp.RowSparseNDArray) for a in args):
+        return _sp.multiply(args[0], args[1])
+    if name == "sparse_retain" and isinstance(args[0], _sp.RowSparseNDArray):
+        return _sp.retain(args[0], args[1])
+    if name == "cast_storage":
+        return _sp.cast_storage(args[0], kwargs.get("stype", "default"))
+    return NotImplemented
+
+
 def _invoke_op(name, args, kwargs):
     """Invoke a registered op, splitting NDArray vs static arguments."""
+    from .sparse import BaseSparseNDArray
+    if any(isinstance(a, BaseSparseNDArray)
+           for a in list(args) + list(kwargs.values())):
+        routed = _sparse_dispatch(name, args, kwargs)
+        if routed is not NotImplemented:
+            return routed
+        import os as _os
+        if name not in _storage_fallback_warned and \
+                _os.environ.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE",
+                                "1") != "0":
+            _storage_fallback_warned.add(name)
+            import logging
+            logging.getLogger(__name__).warning(
+                "storage fallback: op %r has no sparse implementation; "
+                "converting inputs to dense (set "
+                "MXNET_STORAGE_FALLBACK_LOG_VERBOSE=0 to silence)", name)
     info = get_op(name)
     fn = info.fn
     out_arg = kwargs.pop("out", None)  # in-place target, never an op input

@@ -288,3 +288,27 @@ def test_sparse_grad_param_never_allocates_dense_grad():
     assert isinstance(g, RowSparseNDArray)
     assert g.nnz == 0 and g._dense_cache is None
     assert g.shape == (5_000_000, 32)
+
+
+def test_stype_aware_dispatch():
+    """nd-namespace ops route sparse inputs to structure implementations
+    (reference: FInferStorageType dispatch); unsupported ops fall back to
+    dense with a one-time storage-fallback warning."""
+    dense = np.array([[1., 0., 2.], [0., 3., 0.]], np.float32)
+    csr = sparse.csr_matrix(nd.array(dense))
+    rhs = np.random.RandomState(0).rand(3, 2).astype(np.float32)
+    out = nd.dot(csr, nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5)
+    assert csr._dense_cache is None          # routed, not densified
+
+    a = sparse.row_sparse_array((np.ones((1, 2), np.float32), [1]),
+                                shape=(10, 2))
+    b = sparse.row_sparse_array((2 * np.ones((1, 2), np.float32), [3]),
+                                shape=(10, 2))
+    s = nd.elemwise_add(a, b)
+    assert isinstance(s, RowSparseNDArray) and s._dense_cache is None
+    assert list(s.indices.asnumpy()) == [1, 3]
+
+    # storage fallback densifies but stays correct
+    r = nd.relu(a)
+    np.testing.assert_allclose(r.asnumpy(), a.tostype("default").asnumpy())
