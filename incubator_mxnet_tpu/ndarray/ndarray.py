@@ -516,41 +516,81 @@ def _invoke_simple(fn, *arrays, op_name=None):
 
 
 _storage_fallback_warned = set()
+_sparse_base_cls = None   # cached on first use: hot-path isinstance check
+
+
+def _sparse_dot_recorded(lhs, rhs, ta, tb):
+    """Sparse dot with tape support: gradient flows to the DENSE rhs only
+    (reference: sparse dot backward supports the dense input; the sparse
+    lhs is data, not a parameter — dot-inl.h)."""
+    from . import sparse as _sp
+    from ..autograd import TapeNode
+    out = _sp.dot(lhs, rhs, transpose_a=ta, transpose_b=tb)
+    if not _ag.is_recording():
+        return out
+
+    def vjp_fn(dy):
+        if tb:
+            # out = L @ rhs^T  ->  d(rhs) = dy^T @ L = (L^T @ dy)^T
+            g = _sp.dot(lhs, NDArray(dy), transpose_a=not ta)
+            return (None, jnp.swapaxes(g._data, -1, -2))
+        g = _sp.dot(lhs, NDArray(dy), transpose_a=not ta)
+        return (None, g._data)
+
+    node = TapeNode([lhs, rhs], vjp_fn, 1, [(out.shape, out._data.dtype)],
+                    op_name="sparse_dot", fn=None)
+    out._node = node
+    out._out_index = 0
+    return out
 
 
 def _sparse_dispatch(name, args, kwargs):
     """stype-aware dispatch (reference: the FInferStorageType DispatchMode —
     ops with sparse implementations run on structure; everything else takes
     the dense storage-fallback path with a one-time log, matching
-    imperative_utils.h's fallback semantics)."""
+    imperative_utils.h's fallback semantics). Returns NotImplemented to
+    request the dense fallback."""
     from . import sparse as _sp
-    if name == "dot":
-        lhs, rhs = args[0], args[1]
-        if isinstance(lhs, _sp.BaseSparseNDArray):
-            return _sp.dot(lhs, rhs,
-                           transpose_a=kwargs.get("transpose_a", False),
-                           transpose_b=kwargs.get("transpose_b", False))
-    if name in ("elemwise_add", "broadcast_add", "_plus") and len(args) == 2 \
-            and all(isinstance(a, _sp.RowSparseNDArray) for a in args):
+    if "out" in kwargs:
+        return NotImplemented   # in-place targets take the dense path
+    if name == "dot" and len(args) >= 2 \
+            and isinstance(args[0], _sp.BaseSparseNDArray) \
+            and isinstance(args[1], NDArray) \
+            and not isinstance(args[1], _sp.BaseSparseNDArray):
+        return _sparse_dot_recorded(args[0], args[1],
+                                    kwargs.get("transpose_a", False),
+                                    kwargs.get("transpose_b", False))
+    if _ag.is_recording():
+        # structure results carry no tape node; while recording, only ops
+        # with explicit sparse vjps may route — the rest must fall back so
+        # gradients keep flowing (densified, like the reference fallback)
+        return NotImplemented
+    two_rsp = (len(args) == 2
+               and all(isinstance(a, _sp.RowSparseNDArray) for a in args)
+               and args[0].shape == args[1].shape)
+    if name in ("elemwise_add", "broadcast_add", "_plus") and two_rsp:
         return _sp.add(args[0], args[1])
-    if name in ("elemwise_sub", "broadcast_sub", "_minus") and len(args) == 2 \
-            and all(isinstance(a, _sp.RowSparseNDArray) for a in args):
+    if name in ("elemwise_sub", "broadcast_sub", "_minus") and two_rsp:
         return _sp.subtract(args[0], args[1])
-    if name in ("elemwise_mul", "broadcast_mul") and len(args) == 2 \
-            and all(isinstance(a, _sp.RowSparseNDArray) for a in args):
+    if name in ("elemwise_mul", "broadcast_mul") and two_rsp:
         return _sp.multiply(args[0], args[1])
-    if name == "sparse_retain" and isinstance(args[0], _sp.RowSparseNDArray):
+    if name == "sparse_retain" and len(args) >= 2 \
+            and isinstance(args[0], _sp.RowSparseNDArray):
         return _sp.retain(args[0], args[1])
-    if name == "cast_storage":
-        return _sp.cast_storage(args[0], kwargs.get("stype", "default"))
+    if name == "cast_storage" and len(args) >= 1:
+        stype = args[1] if len(args) > 1 else kwargs.get("stype", "default")
+        return _sp.cast_storage(args[0], stype)
     return NotImplemented
 
 
 def _invoke_op(name, args, kwargs):
     """Invoke a registered op, splitting NDArray vs static arguments."""
-    from .sparse import BaseSparseNDArray
-    if any(isinstance(a, BaseSparseNDArray)
-           for a in list(args) + list(kwargs.values())):
+    global _sparse_base_cls
+    if _sparse_base_cls is None:
+        from .sparse import BaseSparseNDArray as _B
+        _sparse_base_cls = _B
+    if any(isinstance(a, _sparse_base_cls) for a in args) or \
+            any(isinstance(v, _sparse_base_cls) for v in kwargs.values()):
         routed = _sparse_dispatch(name, args, kwargs)
         if routed is not NotImplemented:
             return routed
@@ -561,7 +601,7 @@ def _invoke_op(name, args, kwargs):
             _storage_fallback_warned.add(name)
             import logging
             logging.getLogger(__name__).warning(
-                "storage fallback: op %r has no sparse implementation; "
+                "storage fallback: op %r has no sparse implementation here; "
                 "converting inputs to dense (set "
                 "MXNET_STORAGE_FALLBACK_LOG_VERBOSE=0 to silence)", name)
     info = get_op(name)

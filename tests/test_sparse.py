@@ -312,3 +312,41 @@ def test_stype_aware_dispatch():
     # storage fallback densifies but stays correct
     r = nd.relu(a)
     np.testing.assert_allclose(r.asnumpy(), a.tostype("default").asnumpy())
+
+
+def test_sparse_dot_gradient_flows():
+    """Sparse dot is tape-aware: grad reaches the dense rhs (reference:
+    dot-inl.h sparse backward to the dense input)."""
+    dense = np.array([[1., 0., 2.], [0., 3., 0.]], np.float32)
+    csr = sparse.csr_matrix(nd.array(dense))
+    w = nd.array(np.random.RandomState(0).rand(3, 2).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        out = nd.dot(csr, w)
+        loss = (out * out).sum()
+    loss.backward()
+    wd = nd.array(dense)
+    wd2 = nd.array(np.asarray(w.asnumpy()))
+    wd2.attach_grad()
+    with autograd.record():
+        loss2 = (nd.dot(wd, wd2) ** 2).sum()
+    loss2.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), wd2.grad.asnumpy(),
+                               rtol=1e-5)
+    assert csr._dense_cache is None
+
+
+def test_sparse_elemwise_fallback_under_record():
+    """While recording, ops without sparse vjps fall back to the dense tape
+    path so gradients keep flowing."""
+    a = sparse.row_sparse_array((np.ones((1, 2), np.float32), [1]),
+                                shape=(4, 2))
+    b = nd.array(np.ones((4, 2), np.float32))
+    b.attach_grad()
+    with autograd.record():
+        loss = (nd.elemwise_add(a, b) ** 2).sum()
+    loss.backward()
+    assert b.grad is not None
+    g = b.grad.asnumpy()
+    want = 2 * (a.tostype("default").asnumpy() + 1)
+    np.testing.assert_allclose(g, want)
