@@ -110,9 +110,23 @@ class KVStoreDist(KVStore):
             self._chain[key] = fut
             self._pending.setdefault(key, []).append(fut)
 
+    @staticmethod
+    def _checked_call(conn, meta, payload=None):
+        """RPC call that surfaces server-reported failures. The server wraps
+        handler exceptions into {"error": ...} replies — without this check
+        an async push failure is silently swallowed (the gradient update is
+        dropped; in sync mode the round never completes and surfaces much
+        later as an unrelated pull timeout)."""
+        rmeta, rpayload = conn.call(meta, payload)
+        if isinstance(rmeta, dict) and rmeta.get("error"):
+            raise RuntimeError("%s(%r): %s" % (
+                meta.get("op"), meta.get("key"), rmeta["error"]))
+        return rmeta, rpayload
+
     def _flush(self, key=None):
         """Wait for in-flight pushes (one key, or all). Raises the first
-        transport error — a lost push must not be silent."""
+        transport OR server-reported error — a lost push must not be
+        silent."""
         with self._pending_lock:
             if key is None:
                 futs = [f for fs in self._pending.values() for f in fs]
@@ -152,9 +166,11 @@ class KVStoreDist(KVStore):
         arr = np.asarray(value.asnumpy(), dtype=np.float32)
         for sid, lo, hi in self._shards_for(key, arr.shape):
             part = arr[lo:hi] if arr.ndim else arr
-            self._servers[sid].call(
+            self._checked_call(
+                self._servers[sid],
                 {"op": "init", "key": self._part_key(key, lo),
-                 "shape": list(part.shape), "dtype": str(part.dtype)},
+                 "shape": list(part.shape), "dtype": str(part.dtype),
+                 "rank": self._rank},
                 np.ascontiguousarray(part).tobytes())
         # mirror shape for pulls
         self._store[key] = NDArray(value._data)
@@ -202,7 +218,8 @@ class KVStoreDist(KVStore):
                         "rank": self._rank}
                 payload = np.ascontiguousarray(part).tobytes()
             conn = self._servers[sid]
-            self._submit(key, lambda c=conn, m=meta, p=payload: c.call(m, p))
+            self._submit(key, lambda c=conn, m=meta, p=payload:
+                         self._checked_call(c, m, p))
 
     def _push_row_sparse(self, key, rsp):
         """Send only (row ids, row payloads) per shard (reference:
@@ -224,8 +241,8 @@ class KVStoreDist(KVStore):
                     "rows_n": int(local.size), "rank": self._rank}
             payload = local.tobytes() + part.tobytes()
             conn = self._servers[sid]
-            self._submit(key,
-                         lambda c=conn, m=meta, p=payload: c.call(m, p))
+            self._submit(key, lambda c=conn, m=meta, p=payload:
+                         self._checked_call(c, m, p))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -307,13 +324,14 @@ class KVStoreDist(KVStore):
         super().set_gradient_compression(compression_params)
         if self._rank == 0:
             for conn in self._servers:
-                conn.call({"op": "set_compression",
-                           "params": dict(compression_params)})
+                self._checked_call(conn, {"op": "set_compression",
+                                          "params": dict(compression_params)})
         self.barrier()
 
     def send_command_to_servers(self, head, body):
         for conn in self._servers:
-            conn.call({"op": "command", "head": head, "body": body})
+            self._checked_call(conn, {"op": "command", "head": head,
+                                      "body": body})
 
     def close(self):
         try:

@@ -318,7 +318,12 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
         op = meta["op"]
         if op == "init":
             with state.lock:
-                state.store[meta["key"]] = _decode(meta, payload).copy()
+                # first init wins: every worker sends init (Trainer loops
+                # kv.init unconditionally) and a straggler's init must not
+                # overwrite weights already advanced by aggregation rounds
+                # (reference gates dist init to rank 0 + barrier)
+                if meta["key"] not in state.store:
+                    state.store[meta["key"]] = _decode(meta, payload).copy()
             return {"ok": True}, b""
         if op == "push":
             key = meta["key"]
@@ -345,7 +350,26 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                     # push couples the workers' key orders and deadlocks
                     # when sends race) — aggregation completes when the
                     # last worker's push lands, and PULL waits for it
-                    acc, cnt = state.accum.get(key, (None, 0))
+                    pend = state.pending.setdefault(key, set())
+                    rank = meta.get("rank")
+                    if rank is None:
+                        # a synthetic rank could collide with a real one and
+                        # stall (or early-complete) the round — reject, the
+                        # worker's _checked_call surfaces this immediately
+                        return {"error": "sync push(%r) without a rank"
+                                         % key}, b""
+                    # a second push from one rank ACCUMULATES (same as async
+                    # and local aggregation), but the round only completes
+                    # when every DISTINCT rank has contributed — a
+                    # double-pushing worker must never complete the round
+                    # early with another worker's gradient missing. Pushes
+                    # land in the round open at arrival: the transport never
+                    # retries (rpc.py), so in sync mode each worker must
+                    # push each key exactly once per round (the Trainer
+                    # does); a user-level retry after an error is NOT
+                    # idempotent (same property as the reference server's
+                    # raw merge counting).
+                    acc, _cnt = state.accum.get(key, (None, 0))
                     if acc is None:
                         acc = np.zeros(full_shape, np.float32)
                     if rows is not None:
@@ -355,17 +379,15 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                                   arr.astype(np.float32))
                     else:
                         acc = acc + arr.astype(np.float32)
-                    cnt += 1
-                    state.pending.setdefault(key, set()).add(
-                        meta.get("rank", cnt - 1))
-                    if cnt == state.num_workers:
+                    pend.add(rank)
+                    if len(pend) == state.num_workers:
                         apply_update(key, acc)
                         state.accum[key] = (None, 0)
                         state.pending[key] = set()
                         state.push_gen[key] = state.push_gen.get(key, 0) + 1
                         state.cv.notify_all()
                     else:
-                        state.accum[key] = (acc, cnt)
+                        state.accum[key] = (acc, len(pend))
                 else:
                     if rows is not None:
                         g = np.zeros(full_shape, np.float32)

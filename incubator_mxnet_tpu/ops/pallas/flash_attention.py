@@ -92,12 +92,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc_ref,
     @pl.when(kj == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
-        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # a query row whose keys are ALL masked leaves m at (about) the bias
+        # floor: the online softmax would renormalize it into near-uniform
+        # attention over padding. Emit EXACT zeros instead, and set lse=0 so
+        # the backward's p = exp(s - lse) = exp(-1e30) underflows to 0 —
+        # zero grads for dead rows in both directions.
+        dead = m_ref[:, 0] <= _NEG_INF * 0.5
+        o = acc_ref[...] / l_safe[:, None]
+        o_ref[0] = jnp.where(dead[:, None], 0.0, o).astype(o_ref.dtype)
         # lse is materialized 8-sublane-replicated: Mosaic requires block
         # sublane dims divisible by 8, and (1, BQ) blocks of a (bh, T) array
         # are not; (1, 8, BQ) blocks of (bh, 8, T) are.
-        lse_ref[0] = jnp.broadcast_to((m_ref[:, 0] + jnp.log(l_safe))[None],
-                                      lse_ref.shape[1:])
+        lse = jnp.where(dead, 0.0, m_ref[:, 0] + jnp.log(l_safe))
+        lse_ref[0] = jnp.broadcast_to(lse[None], lse_ref.shape[1:])
 
 
 def _fwd_call(q, k, v, bias, scale, causal, block_q, block_k,
@@ -146,8 +153,9 @@ def _fwd_call(q, k, v, bias, scale, causal, block_q, block_k,
 
 def _recompute_p_ds(q, k, v, do, lse, delta, qi, kj, block_q, block_k,
                     scale, causal, bias=None):
-    """Shared tile math of the backward kernels: p and ds for one (Q, KV)
-    tile pair (MXU in input dtype, fp32 accumulation)."""
+    """Shared tile math of the backward kernels: p, ds, and the UNscaled
+    score cotangent (= the additive-bias cotangent) for one (Q, KV) tile
+    pair (MXU in input dtype, fp32 accumulation)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if bias is not None:
@@ -161,8 +169,9 @@ def _recompute_p_ds(q, k, v, do, lse, delta, qi, kj, block_q, block_k,
     p = jnp.exp(s - lse[:, None])                         # (BQ, BK)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None]) * scale
-    return p.astype(v.dtype), ds.astype(v.dtype)
+    ds_bias = p * (dp - delta[:, None])                   # dL/ds (f32)
+    ds = ds_bias * scale                                  # dL/d(qk)
+    return p.astype(v.dtype), ds.astype(v.dtype), ds_bias
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
@@ -182,9 +191,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         bias = bias_ref[0, 0] if has_bias else None
-        _, ds = _recompute_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
-                                qi, kj, block_q, block_k, scale, causal,
-                                bias)
+        _, ds, _ = _recompute_p_ds(q, k, v, do, lse_ref[0, 0],
+                                   delta_ref[0, 0], qi, kj, block_q,
+                                   block_k, scale, causal, bias)
         acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -195,8 +204,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
-                scale, causal, has_bias):
+                dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, db_acc, *,
+                block_q, block_k, scale, causal, has_bias, has_dbias):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -205,6 +214,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
+        if has_dbias:
+            db_acc[...] = jnp.zeros_like(db_acc)
 
     run = True if not causal else qi * block_q + block_q - 1 >= kj * block_k
 
@@ -212,24 +223,34 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         bias = bias_ref[0, 0] if has_bias else None
-        p, ds = _recompute_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
-                                qi, kj, block_q, block_k, scale, causal,
-                                bias)
+        p, ds, ds_bias = _recompute_p_ds(q, k, v, do, lse_ref[0, 0],
+                                         delta_ref[0, 0], qi, kj, block_q,
+                                         block_k, scale, causal, bias)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if has_dbias:
+            # per-key bias cotangent: sum dL/ds over this tile's query rows
+            db_acc[...] += jnp.broadcast_to(
+                jnp.sum(ds_bias, axis=0)[None, :], db_acc.shape)
 
     @pl.when(qi == nq - 1)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+        if has_dbias:
+            # the kernel only READS sublane 0 of the replicated (8, T) bias
+            # layout, so only sublane 0 carries a true cotangent
+            sub = jax.lax.broadcasted_iota(jnp.int32, db_acc.shape, 0)
+            dbias_ref[0] = jnp.where(sub == 0, db_acc[...], 0.0) \
+                .astype(dbias_ref.dtype)
 
 
 def _bwd_call(q, k, v, out, lse, g, bias, scale, causal, block_q, block_k,
-              interpret=False):
+              interpret=False, needs_dbias=False):
     bh, T, d = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, T))
@@ -278,33 +299,50 @@ def _bwd_call(q, k, v, out, lse, g, bias, scale, causal, block_q, block_k,
     if has_bias:
         kv_specs.append(pl.BlockSpec((1, 8, block_k),
                                      lambda b, j, i: (b, 0, j)))
+    has_dbias = has_bias and needs_dbias
     dkv_kern = functools.partial(_dkv_kernel, block_q=block_q,
                                  block_k=block_k, scale=scale, causal=causal,
-                                 has_bias=has_bias)
-    if not has_bias:
-        base_dkv = dkv_kern
-
+                                 has_bias=has_bias, has_dbias=has_dbias)
+    out_specs = [
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, T, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, T, d), v.dtype),
+    ]
+    scratch = [pltpu.VMEM((block_k, d), jnp.float32),
+               pltpu.VMEM((block_k, d), jnp.float32)]
+    if has_dbias:
+        out_specs.append(pl.BlockSpec((1, 8, block_k),
+                                      lambda b, j, i: (b, 0, j)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, 8, T), jnp.float32))
+        scratch.append(pltpu.VMEM((8, block_k), jnp.float32))
+    base_dkv = dkv_kern
+    if has_bias and not has_dbias:
+        def dkv_kern(q_r, k_r, v_r, do_r, lse_r, dl_r, b_r, dk_r, dv_r,
+                     dk_a, dv_a):
+            return base_dkv(q_r, k_r, v_r, do_r, lse_r, dl_r, b_r, dk_r,
+                            dv_r, None, dk_a, dv_a, None)
+    elif not has_bias:
         def dkv_kern(q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r,
                      dk_a, dv_a):
             return base_dkv(q_r, k_r, v_r, do_r, lse_r, dl_r, None, dk_r,
-                            dv_r, dk_a, dv_a)
-    dk, dv = pl.pallas_call(
+                            dv_r, None, dk_a, dv_a, None)
+    outs = pl.pallas_call(
         dkv_kern,
         grid=(bh, T // block_k, T // block_q),
         in_specs=kv_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, T, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, T, d), v.dtype),
-        ],
-        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
-                        pltpu.VMEM((block_k, d), jnp.float32)],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret,
     )(*args)
-    return dq, dk, dv
+    if has_dbias:
+        dk, dv, dbias = outs
+    else:
+        (dk, dv), dbias = outs, None
+    return dq, dk, dv, dbias
 
 
 import os as _os
@@ -322,24 +360,33 @@ def _default_blocks(T):
     return max(bq, 8), max(bk, 8)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, interpret,
+                needs_dbias):
     out, _ = _fwd_call(q, k, v, bias, scale, causal, block_q, block_k,
                        interpret)
     return out
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret,
+               needs_dbias):
     out, lse = _fwd_call(q, k, v, bias, scale, causal, block_q, block_k,
                          interpret)
     return out, (q, k, v, bias, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, needs_dbias,
+               res, g):
     q, k, v, bias, out, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, out, lse, g, bias, scale, causal,
-                           block_q, block_k, interpret)
-    dbias = None if bias is None else jnp.zeros_like(bias)
+    dq, dk, dv, dbias = _bwd_call(q, k, v, out, lse, g, bias, scale, causal,
+                                  block_q, block_k, interpret,
+                                  needs_dbias=needs_dbias)
+    if bias is not None:
+        # mask-only biases are non-differentiable constants: skip the
+        # in-kernel accumulation and return a zeros cotangent (XLA folds
+        # the dead upstream ops away under jit)
+        dbias = (jnp.zeros_like(bias) if dbias is None
+                 else dbias.astype(bias.dtype))
     return dq, dk, dv, dbias
 
 
@@ -347,12 +394,19 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
-                    block_q=None, block_k=None, interpret=False):
+                    kv_bias=None, block_q=None, block_k=None,
+                    interpret=False):
     """q/k/v: (B, H, T, D). Returns (B, H, T, D).
 
     kv_mask: optional (B, T) array, nonzero = live key/value position,
     0 = padding (the reference BERT valid-length mask). Padded positions
-    receive zero attention in forward AND backward.
+    receive zero attention in forward AND backward. Query rows whose keys
+    are ALL masked return exact zeros (and zero grads), not renormalized
+    garbage.
+
+    kv_bias: optional LEARNED additive per-key bias, (B, H, T) or (B, T),
+    added to the attention scores. Differentiable — the backward kernel
+    accumulates the true bias cotangent (no silent zero gradient).
 
     Requires T % 128 == 0, or T <= 128 with T % 8 == 0 (Mosaic sublane
     tiling); callers fall back to the einsum path otherwise."""
@@ -374,12 +428,22 @@ def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
     bias = None
-    if kv_mask is not None:
-        live = jnp.asarray(kv_mask).reshape(B, T) != 0
-        b1 = jnp.where(live, 0.0, _NEG_INF).astype(jnp.float32)
-        # (B,H,8,T) -> (B*H,8,T): replicated-sublane layout like lse/delta
-        bias = jnp.broadcast_to(b1[:, None, None, :], (B, H, 8, T)) \
+    if kv_mask is not None or kv_bias is not None:
+        b1 = jnp.zeros((B, H, T), jnp.float32)
+        if kv_bias is not None:
+            kb = jnp.asarray(kv_bias, jnp.float32)
+            if kb.ndim == 2:
+                kb = kb[:, None, :]
+            b1 = b1 + jnp.broadcast_to(kb, (B, H, T))
+        if kv_mask is not None:
+            live = jnp.asarray(kv_mask).reshape(B, T) != 0
+            b1 = b1 + jnp.where(live, 0.0, _NEG_INF)[:, None, :]
+        # (B,H,8,T) -> (B*H,8,T): replicated-sublane layout like lse/delta.
+        # Only sublane 0 is read in-kernel, and only sublane 0 carries a
+        # backward cotangent, so AD through this broadcast stays exact.
+        bias = jnp.broadcast_to(b1[:, :, None, :], (B, H, 8, T)) \
             .reshape(B * H, 8, T)
     out = _flash_core(qf, kf, vf, bias, float(scale), bool(causal),
-                      int(bq), int(bk), bool(interpret))
+                      int(bq), int(bk), bool(interpret),
+                      kv_bias is not None)
     return out.reshape(B, H, T, D)

@@ -207,6 +207,63 @@ def test_dist_server_side_optimizer():
         np.testing.assert_allclose(res, [0.8] * 4, rtol=1e-5)
 
 
+def _dup_push_worker(rank):
+    """Rank 0 pushes TWICE; both payloads must fold into the aggregate, but
+    the sync round must still WAIT for rank 1's distinct contribution —
+    never complete early with a worker's gradient missing (ADVICE r2).
+    Total = 1 + 7 + 2 = 10."""
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_sync")
+    if kv.rank == 0:
+        kv.init("w", nd.zeros((4,)))
+    kv.barrier()
+    if kv.rank == 0:
+        kv.push("w", nd.ones((4,)))
+        kv.push("w", nd.ones((4,)) * 7)   # second same-rank push: folds in
+    # barrier flushes rank 0's async sends BEFORE rank 1 pushes, so the
+    # ordering (two rank-0 pushes, then rank 1's) is deterministic
+    kv.barrier()
+    if kv.rank == 1:
+        kv.push("w", nd.ones((4,)) * 2)   # completes the round
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    kv.barrier()
+    kv.close()
+    return out.asnumpy().tolist()
+
+
+def test_dist_sync_double_push_folds_and_waits_for_all_ranks():
+    results = _spawn_ps_group(2, 1, "_dup_push_worker")
+    for rank, res in results.items():
+        assert not (isinstance(res, str) and res.startswith("ERROR")), res
+        np.testing.assert_allclose(res, [10.0] * 4)
+
+
+def _push_before_init_worker(rank):
+    """A server-side push failure (push before init) must RAISE at the next
+    flush point on the worker, not be silently swallowed (ADVICE r2)."""
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist("dist_async")
+    try:
+        kv.push("never_inited", nd.ones((4,)))
+        out = nd.zeros((4,))
+        kv.pull("never_inited", out=out)
+    except RuntimeError as e:
+        kv._pending.clear()   # drop the poisoned future before close()
+        kv.close()
+        return "raised: %s" % e
+    kv.close()
+    return "no error raised"
+
+
+def test_dist_push_error_propagates_to_worker():
+    results = _spawn_ps_group(1, 1, "_push_before_init_worker")
+    res = results[0]
+    assert not (isinstance(res, str) and res.startswith("ERROR")), res
+    assert res.startswith("raised"), res
+    assert "before init" in res
+
+
 def _bigarray_worker(rank):
     from incubator_mxnet_tpu.kvstore import dist as dist_mod
     dist_mod._BIGARRAY_BOUND = 4  # force sharding across servers

@@ -207,3 +207,81 @@ def test_flash_kv_mask_interpret():
                                atol=1e-6)
     np.testing.assert_allclose(np.asarray(gf[2][:, :, valid:]), 0,
                                atol=1e-6)
+
+
+def test_flash_fully_masked_rows_are_zero():
+    """A sample with valid_length == 0 must produce EXACT zero outputs and
+    zero grads, not renormalized attention over padding (ADVICE r2)."""
+    np.random.seed(5)
+    B, H, T, D = 2, 2, 128, 32
+    q = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    # sample 0 fully masked, sample 1 fully live
+    mask = jnp.asarray(np.stack([np.zeros(T), np.ones(T)]).astype(np.int32))
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, kv_mask=mask, interpret=True)
+        return (out ** 2).sum(), out
+
+    (_, out), grads = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                         has_aux=True)(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+    for g in grads:
+        np.testing.assert_array_equal(np.asarray(g[0]), 0.0)
+    # the live sample still matches the dense reference
+    want = _dense_ref(q[1:], k[1:], v[1:], False)
+    np.testing.assert_allclose(np.asarray(out[1:]), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_kv_bias_gradient_matches_dense():
+    """Learned per-key additive bias: forward AND the bias cotangent match
+    einsum attention (the r2 kernel silently returned dbias = 0)."""
+    np.random.seed(6)
+    B, H, T, D = 2, 2, 128, 32
+    q = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    bias = jnp.asarray(np.random.randn(B, H, T).astype(np.float32))
+
+    def flash_loss(q, k, v, bias):
+        out = flash_attention(q, k, v, kv_bias=bias, interpret=True)
+        return (out ** 2).sum()
+
+    def dense_loss(q, k, v, bias):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = s + bias[:, :, None, :]
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        return (out ** 2).sum()
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_flash_kv_bias_causal_gradient():
+    np.random.seed(7)
+    B, H, T, D = 1, 2, 128, 32
+    q = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, H, T, D).astype(np.float32))
+    bias = jnp.asarray(np.random.randn(B, T).astype(np.float32))   # 2-D form
+
+    def flash_loss(bias):
+        out = flash_attention(q, k, v, causal=True, kv_bias=bias,
+                              interpret=True)
+        return (out ** 2).sum()
+
+    def dense_loss(bias):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = s + bias[:, None, None, :]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -1e30)
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        return (out ** 2).sum()
+
+    np.testing.assert_allclose(np.asarray(jax.grad(flash_loss)(bias)),
+                               np.asarray(jax.grad(dense_loss)(bias)),
+                               rtol=2e-2, atol=2e-3)
