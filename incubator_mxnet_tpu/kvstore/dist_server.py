@@ -269,7 +269,7 @@ class SchedulerClient:
 class _ServerState:
     def __init__(self, num_workers, sync_mode):
         self.store = {}          # key -> np.ndarray (the weights)
-        self.accum = {}          # key -> (np.ndarray sum, count) for sync mode
+        self.accum = {}          # key -> np.ndarray gradient sum (sync mode)
         self.pending = {}        # key -> set of worker ranks in current round
         self.num_workers = num_workers
         self.sync_mode = sync_mode
@@ -369,7 +369,7 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                     # does); a user-level retry after an error is NOT
                     # idempotent (same property as the reference server's
                     # raw merge counting).
-                    acc, _cnt = state.accum.get(key, (None, 0))
+                    acc = state.accum.get(key)
                     if acc is None:
                         acc = np.zeros(full_shape, np.float32)
                     if rows is not None:
@@ -382,12 +382,12 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                     pend.add(rank)
                     if len(pend) == state.num_workers:
                         apply_update(key, acc)
-                        state.accum[key] = (None, 0)
+                        state.accum[key] = None
                         state.pending[key] = set()
                         state.push_gen[key] = state.push_gen.get(key, 0) + 1
                         state.cv.notify_all()
                     else:
-                        state.accum[key] = (acc, len(pend))
+                        state.accum[key] = acc
                 else:
                     if rows is not None:
                         g = np.zeros(full_shape, np.float32)

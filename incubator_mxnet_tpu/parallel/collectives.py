@@ -52,10 +52,16 @@ def axis_size(axis_name):
 
 def collective_counts(hlo_text):
     """Count collective instruction definitions in compiled HLO text —
-    the audit companion to ``ShardedTrainer.lowered()`` (names like
-    ``%all-reduce.5 = ...``; result types may be tuples with spaces, so
-    match the defined name, including async ``-start`` variants)."""
+    the audit companion to ``ShardedTrainer.lowered()``. Matches the
+    OPCODE on the right of ``=`` (shard_map-produced instructions carry
+    metadata-derived names like ``%reduce_scatter.7``, so counting defined
+    names undercounts), including async ``-start`` variants and tuple
+    result types."""
     import re
-    return {op: len(re.findall(r"%%%s(?:-start)?[.\d]*\s+?=" % op, hlo_text))
+    # whitespace-preceded opcode: operand USES are always %-prefixed names,
+    # and result types may be tuples whose layout annotations contain
+    # parentheses (e.g. bf16[8,128]{1,0:T(8,128)} on TPU), so matching the
+    # type expression itself is not robust
+    return {op: len(re.findall(r"\s%s(?:-start)?\(" % op, hlo_text))
             for op in ("all-reduce", "all-gather", "reduce-scatter",
                        "all-to-all", "collective-permute")}

@@ -45,11 +45,20 @@ class ShardedTrainer:
     data_specs : PartitionSpec(s) for the data batch (default: shard batch
         axis over 'dp' if present in the mesh).
     optimizer : 'sgd' | 'adam' | 'adamw'
+    zero1 : shard optimizer state over the dp axis (ZeRO stage 1). Grads
+        are constrained to a dp-sharded layout so GSPMD lowers the dp
+        gradient reduction to REDUCE-SCATTER; each dp rank updates only its
+        1/dp param shard with its 1/dp optimizer-state shard, and the fresh
+        params are all-gathered back. Memory for optimizer state drops by
+        the dp degree; collective bytes match all-reduce (RS + AG).
+    grad_accum : number of microbatches to accumulate per step. The batch's
+        leading dim splits into `grad_accum` slices consumed by a lax.scan;
+        the optimizer applies once on the mean gradient.
     """
 
     def __init__(self, block, loss, mesh, rules=None, optimizer="sgd",
                  optimizer_params=None, data_specs=None, label_spec=None,
-                 dp_axis="dp", compute_dtype=None):
+                 dp_axis="dp", compute_dtype=None, zero1=False, grad_accum=1):
         self._block = block
         self._loss = loss
         self._mesh = mesh
@@ -81,6 +90,19 @@ class ShardedTrainer:
         self._param_vals = {n: jax.device_put(params[n]._data._data,
                                               self._param_shardings[n])
                             for n in self._diff_names + self._aux_names}
+        self._dp_axis = dp_axis
+        self._dp_size = dict(mesh.shape).get(dp_axis, 1)
+        self._zero1 = bool(zero1) and self._dp_size > 1
+        self._accum = int(grad_accum)
+        if self._accum < 1:
+            raise ValueError("grad_accum must be >= 1")
+        if self._zero1:
+            self._zero_axes = {n: self._zero_axis_for(n)
+                               for n in self._diff_names}
+            self._zero_shardings = {n: self._zero_sharding(n)
+                                    for n in self._diff_names}
+        else:
+            self._zero_axes, self._zero_shardings = {}, {}
         self._opt_state = self._init_opt_state()
 
         dp_in_mesh = dp_axis in mesh.axis_names
@@ -96,18 +118,42 @@ class ShardedTrainer:
         self._jit_step = None
 
     # ------------------------------------------------------------------ opt
+    def _zero_axis_for(self, n):
+        """ZeRO-1 shard dimension for param n: the first free dimension the
+        dp degree divides (its spec entry is None so tp/ep shardings stay
+        untouched). None = no such dimension; that param keeps replicated
+        optimizer state (tiny biases — negligible memory)."""
+        shape = self._param_vals[n].shape
+        spec = tuple(self._param_shardings[n].spec)
+        spec = spec + (None,) * (len(shape) - len(spec))
+        for i, (dim, ax) in enumerate(zip(shape, spec)):
+            if ax is None and dim % self._dp_size == 0 and dim > 0:
+                return i
+        return None
+
+    def _zero_sharding(self, n):
+        """NamedSharding for param n's ZeRO-1 optimizer-state storage
+        (shard axis single-sourced from self._zero_axes)."""
+        i = self._zero_axes[n]
+        if i is None:
+            return self._param_shardings[n]
+        spec = tuple(self._param_shardings[n].spec)
+        spec = spec + (None,) * (self._param_vals[n].ndim - len(spec))
+        return NamedSharding(
+            self._mesh, P(*spec[:i], self._dp_axis, *spec[i + 1:]))
+
     def _init_opt_state(self):
         state = {}
         if self._opt == "sgd" and self._momentum == 0.0:
             return state
         for n in self._diff_names:
-            z = jnp.zeros_like(self._param_vals[n])
-            z = jax.device_put(z, self._param_shardings[n])
+            sh = self._zero_shardings.get(n, self._param_shardings[n])
+            z = jax.device_put(jnp.zeros_like(self._param_vals[n]), sh)
             if self._opt == "sgd":
                 state[n] = (z,)
             else:
-                state[n] = (z, jax.device_put(jnp.zeros_like(z),
-                                              self._param_shardings[n]))
+                state[n] = (z, jax.device_put(
+                    jnp.zeros_like(self._param_vals[n]), sh))
         return state
 
     def _apply_opt(self, p, g, st, t):
@@ -136,44 +182,83 @@ class ShardedTrainer:
     def _build(self, n_data_args):
         return jax.jit(self._build_raw(n_data_args), donate_argnums=(0, 1, 2))
 
-    def _build_raw(self, n_data_args):
+    def _make_grad_stage(self, n_data_args):
+        """Shared loss/grad computation: returns grads(param_vals, aux_vals,
+        data, label, key) -> (grads, new_aux, loss), with the grad-accum
+        microbatch scan folded in. Under zero1 this runs PER dp RANK (batch
+        = the rank's local slice) inside the manual region."""
         block, loss_block = self._block, self._loss
-        diff_names, aux_names = self._diff_names, self._aux_names
-
+        aux_names = self._aux_names
         cdt = self._compute_dtype
+        accum = self._accum
+
+        def loss_fn(pv, av, data, label, key):
+            if cdt is not None:
+                data = tuple(d.astype(cdt)
+                             if jnp.issubdtype(d.dtype, jnp.floating)
+                             else d for d in data)
+                pv_c = {n: (v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                            else v) for n, v in pv.items()}
+                aux_c = {n: (v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                             else v) for n, v in av.items()}
+            else:
+                pv_c, aux_c = pv, av
+            ctx = _TraceCtx({**pv_c, **aux_c}, key, training=True)
+            prev = getattr(_trace_state, "ctx", None)
+            _trace_state.ctx = ctx
+            try:
+                out = block.forward(*data)
+                loss = loss_block(out, *label)
+                loss = jnp.mean(loss.astype(jnp.float32))
+            finally:
+                _trace_state.ctx = prev
+            new_aux = {n: ctx.aux_updates.get(n, av[n]) for n in aux_names}
+            if cdt is not None:   # running stats stay fp32 master copies
+                new_aux = {n: v.astype(av[n].dtype)
+                           for n, v in new_aux.items()}
+            return loss, new_aux
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def grads_of(param_vals, aux_vals, data, label, key):
+            if accum == 1:
+                (loss, new_aux), grads = grad_fn(param_vals, aux_vals, data,
+                                                 label, key)
+                return grads, new_aux, loss
+            # microbatch scan: split the batch's leading dim and average
+            # the gradients — the optimizer (and its collective traffic
+            # under zero1) runs ONCE per step, not per micro
+            mb = tuple(a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+                       for a in data + label)
+            keys = jax.random.split(key, accum)
+
+            def body(carry, xs):
+                g_sum, aux_c, loss_sum = carry
+                k_i, arrs = xs[0], xs[1:]
+                (loss, new_aux), g = grad_fn(param_vals, aux_c,
+                                             arrs[:len(data)],
+                                             arrs[len(data):], k_i)
+                g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g)
+                return (g_sum, new_aux, loss_sum + loss), None
+
+            g0 = jax.tree_util.tree_map(jnp.zeros_like, param_vals)
+            (grads, new_aux, loss), _ = jax.lax.scan(
+                body, (g0, aux_vals, jnp.float32(0)), (keys,) + mb)
+            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            return grads, new_aux, loss / accum
+
+        return grads_of
+
+    def _build_raw(self, n_data_args):
+        if self._zero1:
+            return self._build_raw_zero1(n_data_args)
+        diff_names = self._diff_names
+        grads_of = self._make_grad_stage(n_data_args)
 
         def step_fn(param_vals, aux_vals, opt_state, t, key, *batch):
             data, label = batch[:n_data_args], batch[n_data_args:]
-            if cdt is not None:
-                data = tuple(d.astype(cdt) if jnp.issubdtype(d.dtype, jnp.floating)
-                             else d for d in data)
-
-            def loss_fn(pv):
-                if cdt is not None:
-                    pv_c = {n: (v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
-                                else v) for n, v in pv.items()}
-                    aux_c = {n: (v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
-                                 else v) for n, v in aux_vals.items()}
-                else:
-                    pv_c, aux_c = pv, aux_vals
-                ctx = _TraceCtx({**pv_c, **aux_c}, key, training=True)
-                prev = getattr(_trace_state, "ctx", None)
-                _trace_state.ctx = ctx
-                try:
-                    out = block.forward(*data)
-                    loss = loss_block(out, *label)
-                    loss = jnp.mean(loss.astype(jnp.float32))
-                finally:
-                    _trace_state.ctx = prev
-                new_aux = {n: ctx.aux_updates.get(n, aux_vals[n])
-                           for n in aux_names}
-                if cdt is not None:   # running stats stay fp32 master copies
-                    new_aux = {n: v.astype(aux_vals[n].dtype)
-                               for n, v in new_aux.items()}
-                return loss, new_aux
-
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(param_vals)
+            grads, new_aux, loss = grads_of(param_vals, aux_vals, data,
+                                            label, key)
             new_params, new_opt = {}, {}
             for n in diff_names:
                 st = opt_state.get(n, ())
@@ -182,6 +267,93 @@ class ShardedTrainer:
                 if new_st:
                     new_opt[n] = new_st
             return new_params, new_aux, new_opt, loss
+
+        return step_fn
+
+    def _manual_spec(self, sharding):
+        """Project a NamedSharding's spec onto the dp axis only (shard_map
+        in_specs may reference manual axes only; tp/sp/... stay auto)."""
+        spec = tuple(sharding.spec)
+        return P(*((ax if ax == self._dp_axis else None) for ax in spec))
+
+    def _build_raw_zero1(self, n_data_args):
+        """ZeRO-1 step: dp is a MANUAL shard_map axis with explicit
+        collectives — psum_scatter(grad) -> shard-local optimizer ->
+        all_gather(params) — while tp/sp/... stay GSPMD-auto. This is the
+        reduce-scatter formulation of data parallelism (same bytes as
+        all-reduce, 1/dp optimizer memory); the KVStore-device superset per
+        SURVEY §2.4. Note: batch stats (BatchNorm aux) are computed per dp
+        rank and pmean'd — the reference's per-device BN semantics."""
+        diff_names = self._diff_names
+        dp, dp_size = self._dp_axis, self._dp_size
+        grads_of = self._make_grad_stage(n_data_args)
+        zero_axes = self._zero_axes
+
+        def manual_step(param_vals, aux_vals, opt_state, t, key, *batch):
+            data, label = batch[:n_data_args], batch[n_data_args:]
+            # per-rank dropout/noise streams
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp))
+            grads, new_aux, loss = grads_of(param_vals, aux_vals, data,
+                                            label, key)
+            loss = jax.lax.pmean(loss, dp)
+            new_aux = {n: (jax.lax.pmean(v, dp)
+                           if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                       for n, v in new_aux.items()}
+            new_params, new_opt = {}, {}
+            for n in diff_names:
+                st = opt_state.get(n, ())
+                p, g = param_vals[n], grads[n]
+                ax = zero_axes[n]
+                if ax is None:
+                    # no dp-divisible dim: plain all-reduce + full update
+                    g = jax.lax.pmean(g, dp)
+                    newp, new_st = self._apply_opt(p, g, st, t)
+                else:
+                    # grad mean arrives SHARDED (reduce-scatter), each rank
+                    # updates only its 1/dp slice of param + opt state,
+                    # fresh weights are all-gathered
+                    g = jax.lax.psum_scatter(
+                        g, dp, scatter_dimension=ax, tiled=True) / dp_size
+                    size = p.shape[ax] // dp_size
+                    start = jax.lax.axis_index(dp) * size
+                    p_sh = jax.lax.dynamic_slice_in_dim(p, start, size,
+                                                        axis=ax)
+                    newp_sh, new_st = self._apply_opt(p_sh, g, st, t)
+                    newp = jax.lax.all_gather(newp_sh, dp, axis=ax,
+                                              tiled=True)
+                new_params[n] = newp
+                if new_st:
+                    new_opt[n] = new_st
+            return new_params, new_aux, new_opt, loss
+
+        rep = P()
+        param_specs = {n: rep for n in diff_names}
+        aux_specs = {n: rep for n in self._aux_names}
+        opt_specs = {n: tuple(self._manual_spec(self._zero_shardings[n])
+                              for _ in st)
+                     for n, st in self._opt_state.items()}
+        if isinstance(self._data_shardings, list):
+            data_specs = tuple(self._manual_spec(s)
+                               for s in self._data_shardings)
+        else:
+            data_specs = (self._manual_spec(self._data_shardings),) \
+                * n_data_args
+        label_manual = self._manual_spec(self._label_sharding)
+
+        def step_fn(param_vals, aux_vals, opt_state, t, key, *batch):
+            n_labels = len(batch) - n_data_args
+            in_specs = (param_specs, aux_specs,
+                        {n: opt_specs[n] for n in opt_state},
+                        rep, rep) + data_specs[:n_data_args] \
+                + (label_manual,) * n_labels
+            out_specs = (param_specs,
+                         {n: rep for n in aux_vals},
+                         {n: opt_specs[n] for n in opt_state},
+                         rep)
+            return jax.shard_map(
+                manual_step, mesh=self._mesh, in_specs=in_specs,
+                out_specs=out_specs, axis_names={dp}, check_vma=False,
+            )(param_vals, aux_vals, opt_state, t, key, *batch)
 
         return step_fn
 

@@ -337,3 +337,126 @@ def test_tp_forward_single_allreduce():
     c = _collective_counts(hlo)
     assert c["all-reduce"] == 1, c
     assert c["all-gather"] == 0, c
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 (reduce-scatter sharded optimizer) + gradient accumulation
+# ---------------------------------------------------------------------------
+
+def test_zero1_emits_reduce_scatter():
+    """HLO audit: zero1=True must lower the dp gradient reduction to
+    reduce-scatter (+ param all-gather), replacing plain all-reduce."""
+    from incubator_mxnet_tpu.parallel.collectives import \
+        collective_counts as cc
+    np.random.seed(0)
+    X = np.random.rand(16, 8).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.int32)
+    net = _make_mlp(0)
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    tr = ShardedTrainer(net, _loss_fn, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": 0.01},
+                        zero1=True)
+    hlo = tr.lowered(nd.array(X), nd.array(y)).compile().as_text()
+    c = cc(hlo)
+    assert c["reduce-scatter"] >= 1, c
+    assert c["all-gather"] >= 1, c
+
+
+def test_zero1_matches_unsharded_adam():
+    """ZeRO-1 is a memory layout, not an algorithm change: training with
+    dp-sharded optimizer state must produce the same weights."""
+    np.random.seed(0)
+    X = np.random.rand(16, 8).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.int32)
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    tr_ref = ShardedTrainer(_make_mlp(0), _loss_fn, mesh, optimizer="adam",
+                            optimizer_params={"learning_rate": 0.01})
+    tr_z = ShardedTrainer(_make_mlp(0), _loss_fn, mesh, optimizer="adam",
+                          optimizer_params={"learning_rate": 0.01},
+                          zero1=True)
+    for _ in range(5):
+        l_ref = tr_ref.step(nd.array(X), nd.array(y))
+        l_z = tr_z.step(nd.array(X), nd.array(y))
+    np.testing.assert_allclose(float(jax.device_get(l_ref)),
+                               float(jax.device_get(l_z)), rtol=1e-5)
+    p_ref, p_z = tr_ref.param_values, tr_z.param_values
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(jax.device_get(p_ref[k])),
+                                   np.asarray(jax.device_get(p_z[k])),
+                                   rtol=2e-5, atol=1e-6)
+    # optimizer state really is dp-sharded
+    for n, st in tr_z._opt_state.items():
+        for s in st:
+            spec = s.sharding.spec
+            assert "dp" in tuple(spec), (n, spec)
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=4 over a 16-batch == one step on the full 16-batch
+    (mean-of-micro-means equals the full-batch mean for equal slices)."""
+    np.random.seed(0)
+    X = np.random.rand(16, 8).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.int32)
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    tr_full = ShardedTrainer(_make_mlp(0), _loss_fn, mesh, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1})
+    tr_acc = ShardedTrainer(_make_mlp(0), _loss_fn, mesh, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1},
+                            grad_accum=4)
+    for _ in range(3):
+        l_full = tr_full.step(nd.array(X), nd.array(y))
+        l_acc = tr_acc.step(nd.array(X), nd.array(y))
+    np.testing.assert_allclose(float(jax.device_get(l_full)),
+                               float(jax.device_get(l_acc)), rtol=1e-5)
+    p_full, p_acc = tr_full.param_values, tr_acc.param_values
+    for k in p_full:
+        np.testing.assert_allclose(np.asarray(jax.device_get(p_full[k])),
+                                   np.asarray(jax.device_get(p_acc[k])),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_multidevice_convergence_lenet():
+    """VERDICT r2 #2: train LeNet 50 steps on the 8-device mesh (with
+    zero1 + grad accumulation) vs 1 device — same final weights."""
+    def make_lenet(seed):
+        np.random.seed(seed)
+        return mx.models.lenet5()
+
+    np.random.seed(0)
+    X = np.random.rand(32, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, (32,)).astype(np.int32)
+
+    net1 = make_lenet(1)
+    net1.initialize(mx.init.Xavier())
+    net1(nd.array(X[:2]))   # materialize deferred shapes
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr1 = ShardedTrainer(net1, _loss_fn, mesh1, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.05,
+                                           "momentum": 0.9})
+    net8 = make_lenet(1)
+    net8.initialize(mx.init.Xavier())
+    net8(nd.array(X[:2]))
+    mesh8 = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    tr8 = ShardedTrainer(net8, _loss_fn, mesh8, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.05,
+                                           "momentum": 0.9},
+                         zero1=True, grad_accum=2)
+    losses1, losses8 = [], []
+    for _ in range(50):
+        losses1.append(float(jax.device_get(tr1.step(nd.array(X),
+                                                     nd.array(y)))))
+        losses8.append(float(jax.device_get(tr8.step(nd.array(X),
+                                                     nd.array(y)))))
+    # training converged and both meshes took the same trajectory
+    assert losses1[-1] < losses1[0] * 0.5, losses1[::10]
+    np.testing.assert_allclose(losses1[-1], losses8[-1], rtol=5e-3)
+    p1, p8 = tr1.param_values, tr8.param_values
+    # prefixes auto-number per-net (hybridsequential0_ vs 1_): match by the
+    # suffix after the net prefix
+    def suffix(k):
+        return k.split("_", 1)[1]
+    m8 = {suffix(k): v for k, v in p8.items()}
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(jax.device_get(p1[k])),
+                                   np.asarray(jax.device_get(m8[suffix(k)])),
+                                   rtol=5e-3, atol=5e-4)
