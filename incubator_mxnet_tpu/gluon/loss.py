@@ -1,11 +1,21 @@
-"""Loss layers.
+"""Loss layers — thin Block shells over the pure-jnp kernels in
+``ops.losses``.
 
-Reference parity: python/mxnet/gluon/loss.py:70-815 (L1/L2, SigmoidBCE,
+Reference surface: python/mxnet/gluon/loss.py (L1/L2, SigmoidBCE,
 SoftmaxCE, KLDiv, CTC, Huber, Hinge, SquaredHinge, Logistic, Triplet,
-PoissonNLL, CosineEmbedding) per SURVEY §2.6.
+PoissonNLL, CosineEmbedding) per SURVEY §2.6. The math lives in
+``incubator_mxnet_tpu/ops/losses.py`` as jnp functions; each class here
+only binds constructor options and routes arrays through one tape hop
+(``_invoke_simple``) in eager mode or calls the kernel directly on
+tracers inside a jit/pjit trace.
 """
 
+import functools
+
 from .block import HybridBlock
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _invoke_simple
+from ..ops import losses as _L
 
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
@@ -14,23 +24,45 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "PoissonNLLLoss", "CosineEmbeddingLoss"]
 
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    if sample_weight is not None:
-        loss = F.broadcast_multiply(loss, sample_weight)
-    if weight is not None:
-        loss = loss * weight
-    return loss
-
-
-def _reshape_like(F, x, y):
-    return F.reshape(x, shape=y.shape)
-
-
 class Loss(HybridBlock):
+    """Base: subclasses set ``_kernel`` (a function from ops.losses) and
+    ``_options()`` (constructor state forwarded as keywords)."""
+
+    _kernel = None
+
     def __init__(self, weight, batch_axis, **kwargs):
         super().__init__(**kwargs)
         self._weight = weight
         self._batch_axis = batch_axis
+
+    def _options(self):
+        return {"weight": self._weight, "batch_axis": self._batch_axis}
+
+    def _run(self, *args, _kernel=None, **extra):
+        """Dispatch a kernel over a mixed (array-or-None) argument list:
+        NDArrays go through the autograd tape; raw tracers (inside a
+        hybridize/ShardedTrainer trace) call the kernel directly.
+        ``_kernel`` overrides the class kernel (then ``_options()`` is NOT
+        applied); ``extra`` adds call-time keywords."""
+        if _kernel is None:
+            _kernel = functools.partial(type(self)._kernel,
+                                        **self._options())
+        if extra:
+            _kernel = functools.partial(_kernel, **extra)
+        present = [i for i, a in enumerate(args) if a is not None]
+        arrays = [args[i] for i in present]
+        if arrays and isinstance(arrays[0], NDArray):
+            def fn(*vals):
+                full = [None] * len(args)
+                for i, v in zip(present, vals):
+                    full[i] = v
+                return _kernel(*full)
+            return _invoke_simple(fn, *arrays,
+                                  op_name=type(self).__name__)
+        return _kernel(*args)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        return self._run(pred, label, sample_weight)
 
     def __repr__(self):
         return "%s(batch_axis=%s, w=%s)" % (
@@ -38,58 +70,41 @@ class Loss(HybridBlock):
 
 
 class L2Loss(Loss):
+    _kernel = staticmethod(_L.l2_loss)
+
     def __init__(self, weight=1.0, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(label - pred)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
 
 class L1Loss(Loss):
+    _kernel = staticmethod(_L.l1_loss)
+
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
-    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+    _kernel = staticmethod(_L.sigmoid_bce)
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None, pos_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            if pos_weight is None:
-                loss = F.relu(pred) - pred * label + \
-                    F.Activation(-F.abs(pred), act_type="softrelu")
-            else:
-                log_weight = 1 + F.broadcast_multiply(pos_weight - 1, label)
-                loss = pred - pred * label + log_weight * \
-                    (F.Activation(-F.abs(pred), act_type="softrelu") + F.relu(-pred))
-        else:
-            eps = 1e-12
-            if pos_weight is None:
-                loss = -(F.log(pred + eps) * label
-                         + F.log(1. - pred + eps) * (1. - label))
-            else:
-                loss = -(F.broadcast_multiply(F.log(pred + eps) * label, pos_weight)
-                         + F.log(1. - pred + eps) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _options(self):
+        return {**super()._options(), "from_sigmoid": self._from_sigmoid}
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       pos_weight=None):
+        return self._run(pred, label, sample_weight, pos_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
+    _kernel = staticmethod(_L.softmax_ce)
+
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
@@ -97,177 +112,139 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._sparse_label = sparse_label
         self._from_logits = from_logits
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
-        else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _options(self):
+        return {**super()._options(), "axis": self._axis,
+                "sparse_label": self._sparse_label,
+                "from_logits": self._from_logits}
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
 class KLDivLoss(Loss):
+    _kernel = staticmethod(_L.kl_div)
+
     def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_logits = from_logits
         self._axis = axis
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _options(self):
+        return {**super()._options(), "from_logits": self._from_logits,
+                "axis": self._axis}
 
 
 class CTCLoss(Loss):
-    """Connectionist temporal classification loss (reference: warp-ctc-based
-    CTCLoss op; here a log-domain dynamic-programming forward in jax which
-    XLA compiles to a scan)."""
+    """Connectionist temporal classification (reference: warp-ctc CTCLoss
+    op; here the log-domain DP forward in ``ops.ctc``, compiled by XLA to
+    a scan)."""
 
-    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
         assert layout in ("NTC", "TNC")
         assert label_layout in ("NT", "TN")
         self._layout = layout
         self._label_layout = label_layout
-        batch_axis = label_layout.find("N")
-        super().__init__(weight, batch_axis, **kwargs)
+        super().__init__(weight, label_layout.find("N"), **kwargs)
 
-    def hybrid_forward(self, F, pred, label, pred_lengths=None, label_lengths=None,
-                       sample_weight=None):
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
         from ..ops.ctc import ctc_loss as _ctc
-        if isinstance(pred, type(label)) and hasattr(pred, "_data"):
-            from ..ndarray.ndarray import _invoke_simple
-            args = [pred, label]
-            if pred_lengths is not None:
-                args.append(pred_lengths)
-            if label_lengths is not None:
-                args.append(label_lengths)
-            n = len(args)
-
-            def fn(*vals):
-                p, l = vals[0], vals[1]
-                pl = vals[2] if n > 2 else None
-                ll = vals[3] if n > 3 else None
-                return _ctc(p, l, pl, ll, layout=self._layout,
-                            label_layout=self._label_layout)
-            loss = _invoke_simple(fn, *args, op_name="CTCLoss")
-        else:
-            loss = _ctc(pred, label,
-                        pred_lengths if pred_lengths is not None else None,
-                        label_lengths if label_lengths is not None else None,
-                        layout=self._layout, label_layout=self._label_layout)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        kernel = functools.partial(_ctc, layout=self._layout,
+                                   label_layout=self._label_layout)
+        loss = self._run(pred, label, pred_lengths, label_lengths,
+                         _kernel=kernel)
+        if sample_weight is not None:
+            loss = loss * sample_weight
+        if self._weight is not None:
+            loss = loss * self._weight
+        return loss
 
 
 class HuberLoss(Loss):
+    _kernel = staticmethod(_L.huber_loss)
+
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._rho = rho
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _options(self):
+        return {**super()._options(), "rho": self._rho}
 
 
 class HingeLoss(Loss):
+    _kernel = staticmethod(_L.hinge_loss)
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _options(self):
+        return {**super()._options(), "margin": self._margin}
 
 
-class SquaredHingeLoss(Loss):
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+class SquaredHingeLoss(HingeLoss):
+    _kernel = staticmethod(_L.squared_hinge_loss)
 
 
 class LogisticLoss(Loss):
-    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+    _kernel = staticmethod(_L.logistic_loss)
+
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise ValueError("label_format must be signed or binary, got %s"
+                             % label_format)
         self._label_format = label_format
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        if self._label_format == "signed":
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(-F.abs(pred), act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _options(self):
+        return {**super()._options(), "label_format": self._label_format}
 
 
 class TripletLoss(Loss):
+    _kernel = staticmethod(_L.triplet_loss)
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+    def _options(self):
+        return {**super()._options(), "margin": self._margin}
+
+    def hybrid_forward(self, F, pred, positive, negative,
+                       sample_weight=None):
+        return self._run(pred, positive, negative, sample_weight)
 
 
 class PoissonNLLLoss(Loss):
+    _kernel = staticmethod(_L.poisson_nll)
+
     def __init__(self, weight=None, from_logits=True, batch_axis=0,
                  compute_full=False, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_logits = from_logits
         self._compute_full = compute_full
 
-    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-08):
-        target = _reshape_like(F, target, pred)
-        if self._from_logits:
-            loss = F.exp(pred) - target * pred
-        else:
-            loss = pred - target * F.log(pred + epsilon)
-        if self._compute_full:
-            stirling = target * F.log(target + epsilon) - target + \
-                0.5 * F.log(2 * target * 3.1415926535)
-            stirling = F.where(target <= 1, F.zeros_like(target), stirling)
-            loss = loss + stirling
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss)
+    def _options(self):
+        return {**super()._options(), "from_logits": self._from_logits,
+                "compute_full": self._compute_full}
+
+    def hybrid_forward(self, F, pred, target, sample_weight=None,
+                       epsilon=1e-08):
+        return self._run(pred, target, sample_weight, epsilon=epsilon)
 
 
 class CosineEmbeddingLoss(Loss):
+    _kernel = staticmethod(_L.cosine_embedding_loss)
+
     def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
+    def _options(self):
+        return {**super()._options(), "margin": self._margin}
+
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
-        input1 = _reshape_like(F, input1, input2)
-        cos = F.sum(input1 * input2, axis=-1) / (
-            F.norm(input1, axis=-1) * F.norm(input2, axis=-1) + 1e-12)
-        label = F.reshape(label, shape=cos.shape)
-        loss = F.where(label == 1, 1.0 - cos,
-                       F.relu(cos - self._margin))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return loss
+        return self._run(input1, input2, label, sample_weight)

@@ -7,7 +7,9 @@ from .vgg import (vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn,
                   vgg19_bn, VGG)
 from .squeezenet import squeezenet1_0, squeezenet1_1, SqueezeNet
 from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,
-                        mobilenet0_25, mobilenet_v2_1_0, MobileNet, MobileNetV2)
+                        mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_75,
+                        mobilenet_v2_0_5, mobilenet_v2_0_25, MobileNet,
+                        MobileNetV2)
 from .densenet import densenet121, densenet161, densenet169, densenet201, DenseNet
 from .inception import inception_v3, Inception3
 
@@ -24,7 +26,8 @@ _models = {
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
     "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
-    "mobilenetv2_1.0": mobilenet_v2_1_0,
+    "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
     "inceptionv3": inception_v3,

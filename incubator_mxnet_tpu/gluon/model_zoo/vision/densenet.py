@@ -1,57 +1,50 @@
-"""DenseNet 121/161/169/201 (reference: model_zoo/vision/densenet.py)."""
+"""DenseNet 121/161/169/201 (reference surface:
+python/mxnet/gluon/model_zoo/vision/densenet.py; Huang et al. 2016).
+
+Structured as one ``_DenseStage`` block that owns a stage's composite
+cells and performs the feature concatenation in its own forward loop
+(the reference nests a concat inside every layer block). The classifier
+input width is computed from the spec, so construction never depends on
+deferred shape inference, and pooling is global-average — any input
+size >= 32 works, not just 224.
+"""
 
 from ...block import HybridBlock
 from ... import nn
-from .squeezenet import HybridConcurrent
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
 
-class Identity(HybridBlock):
-    def hybrid_forward(self, F, x):
-        return x
+def _composite(growth_rate, bn_size, dropout):
+    """BN-relu-conv1x1-BN-relu-conv3x3(-dropout): one densely-connected
+    cell producing ``growth_rate`` new channels."""
+    cell = nn.HybridSequential(prefix="")
+    cell.add(nn.BatchNorm(), nn.Activation("relu"),
+             nn.Conv2D(bn_size * growth_rate, kernel_size=1, use_bias=False),
+             nn.BatchNorm(), nn.Activation("relu"),
+             nn.Conv2D(growth_rate, kernel_size=3, padding=1,
+                       use_bias=False))
+    if dropout:
+        cell.add(nn.Dropout(dropout))
+    return cell
 
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix="stage%d_" % stage_index)
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_make_dense_layer(growth_rate, bn_size, dropout))
-    return out
+class _DenseStage(HybridBlock):
+    """num_layers composite cells; the stage forward threads the growing
+    concatenation, so each cell sees every earlier feature map."""
 
-
-class _DenseLayer(HybridBlock):
-    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+    def __init__(self, num_layers, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                use_bias=False))
-        if dropout:
-            self.body.add(nn.Dropout(dropout))
+        with self.name_scope():
+            self.cells = nn.HybridSequential(prefix="")
+            for _ in range(num_layers):
+                self.cells.add(_composite(growth_rate, bn_size, dropout))
 
     def hybrid_forward(self, F, x):
-        out = self.body(x)
-        return F.Concat(x, out, dim=1)
-
-
-def _make_dense_layer(growth_rate, bn_size, dropout):
-    return _DenseLayer(growth_rate, bn_size, dropout)
-
-
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+        for cell in self.cells:
+            x = F.Concat(x, cell(x), dim=1)
+        return x
 
 
 class DenseNet(HybridBlock):
@@ -60,53 +53,52 @@ class DenseNet(HybridBlock):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
+            self.features.add(
+                nn.Conv2D(num_init_features, kernel_size=7, strides=2,
+                          padding=3, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            width = num_init_features
             for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(num_layers, bn_size,
-                                                    growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
+                self.features.add(_DenseStage(num_layers, growth_rate,
+                                              bn_size, dropout,
+                                              prefix="stage%d_" % (i + 1)))
+                width += num_layers * growth_rate
                 if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features = num_features // 2
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.AvgPool2D(pool_size=7))
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes)
+                    # transition: halve channels and spatial dims
+                    width //= 2
+                    trans = nn.HybridSequential(prefix="")
+                    trans.add(nn.BatchNorm(), nn.Activation("relu"),
+                              nn.Conv2D(width, kernel_size=1, use_bias=False),
+                              nn.AvgPool2D(pool_size=2, strides=2))
+                    self.features.add(trans)
+            self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                              nn.GlobalAvgPool2D(), nn.Flatten())
+            self.output = nn.Dense(classes, in_units=width)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
+# depth -> (init features, growth rate, layers per stage)
 densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  161: (96, 48, [6, 12, 36, 24]),
                  169: (64, 32, [6, 12, 32, 32]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def _get_densenet(num_layers, **kwargs):
-    kwargs.pop("pretrained", None); kwargs.pop("ctx", None); kwargs.pop("root", None)
-    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+def _variant(depth):
+    def build(**kwargs):
+        for k in ("pretrained", "ctx", "root"):
+            kwargs.pop(k, None)
+        init, growth, stages = densenet_spec[depth]
+        return DenseNet(init, growth, stages, **kwargs)
+    build.__name__ = "densenet%d" % depth
+    build.__doc__ = "DenseNet-%d from the densenet_spec table." % depth
+    return build
 
 
-def densenet121(**kwargs):
-    return _get_densenet(121, **kwargs)
-
-
-def densenet161(**kwargs):
-    return _get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return _get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return _get_densenet(201, **kwargs)
+densenet121 = _variant(121)
+densenet161 = _variant(161)
+densenet169 = _variant(169)
+densenet201 = _variant(201)

@@ -1,4 +1,17 @@
-"""Inception V3 (reference: model_zoo/vision/inception.py)."""
+"""Inception V3, table-driven.
+
+Reference surface: python/mxnet/gluon/model_zoo/vision/inception.py
+(Szegedy et al. 2015). The whole network is DATA here: every inception
+module is a list of branch specs interpreted by one builder, instead of
+five hand-written factory functions.
+
+Branch spec grammar (per element):
+  (channels, kernel)                  conv-BN-relu, stride 1, no pad
+  (channels, kernel, stride)          ... explicit stride
+  (channels, kernel, stride, pad)     ... explicit padding
+  "avg" / "max"                       3x3 pooling prelude
+  "fork33"                            the E-module (1,3)/(3,1) concat fork
+"""
 
 from ...block import HybridBlock
 from ... import nn
@@ -7,106 +20,91 @@ from .squeezenet import HybridConcurrent
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _cbr(channels, kernel, stride=1, pad=0):
+    """The conv-BN-relu cell every Inception conv uses (BN eps 1e-3)."""
+    cell = nn.HybridSequential(prefix="")
+    cell.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                       padding=pad, use_bias=False),
+             nn.BatchNorm(epsilon=0.001),
+             nn.Activation("relu"))
+    return cell
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ["channels", "kernel_size", "strides", "padding"]
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+class _Fork33(HybridBlock):
+    """E-module tail: concat of (1,3)- and (3,1)-convs of the same input."""
 
-
-def _make_A(pool_features, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
-
-
-def _make_B(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
-
-
-def _make_C(channels_7x7, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
-
-
-def _make_D(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
-
-
-class _EBranch(HybridBlock):
-    """3x3 branch that splits into (1,3) and (3,1) concatenated."""
-
-    def __init__(self, pre_settings, **kwargs):
+    def __init__(self, **kwargs):
         super().__init__(**kwargs)
-        self.pre = nn.HybridSequential(prefix="")
-        setting_names = ["channels", "kernel_size", "strides", "padding"]
-        for setting in pre_settings:
-            kw = {setting_names[i]: v for i, v in enumerate(setting)
-                  if v is not None}
-            self.pre.add(_make_basic_conv(**kw))
-        self.a = _make_basic_conv(channels=384, kernel_size=(1, 3), padding=(0, 1))
-        self.b = _make_basic_conv(channels=384, kernel_size=(3, 1), padding=(1, 0))
+        self.a = _cbr(384, (1, 3), pad=(0, 1))
+        self.b = _cbr(384, (3, 1), pad=(1, 0))
 
     def hybrid_forward(self, F, x):
-        x = self.pre(x)
         return F.Concat(self.a(x), self.b(x), dim=1)
 
 
-def _make_E(prefix):
+def _branch(spec):
+    seq = nn.HybridSequential(prefix="")
+    for item in spec:
+        if item == "avg":
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif item == "max":
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        elif item == "fork33":
+            seq.add(_Fork33())
+        else:
+            seq.add(_cbr(*item))
+    return seq
+
+
+def _module(branch_specs, prefix):
     out = HybridConcurrent(axis=1, prefix=prefix)
     with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
-        out.add(_EBranch([(384, 1, None, None)]))
-        out.add(_EBranch([(448, 1, None, None), (384, 3, None, 1)]))
-        out.add(_make_branch("avg", (192, 1, None, None)))
+        for spec in branch_specs:
+            out.add(_branch(spec))
     return out
+
+
+def _A(pool_ch):
+    return [[(64, 1)],
+            [(48, 1), (64, 5, 1, 2)],
+            [(64, 1), (96, 3, 1, 1), (96, 3, 1, 1)],
+            ["avg", (pool_ch, 1)]]
+
+
+_B = [[(384, 3, 2)],
+      [(64, 1), (96, 3, 1, 1), (96, 3, 2)],
+      ["max"]]
+
+
+def _C(c7):
+    return [[(192, 1)],
+            [(c7, 1), (c7, (1, 7), 1, (0, 3)), (192, (7, 1), 1, (3, 0))],
+            [(c7, 1), (c7, (7, 1), 1, (3, 0)), (c7, (1, 7), 1, (0, 3)),
+             (c7, (7, 1), 1, (3, 0)), (192, (1, 7), 1, (0, 3))],
+            ["avg", (192, 1)]]
+
+
+_D = [[(192, 1), (320, 3, 2)],
+      [(192, 1), (192, (1, 7), 1, (0, 3)), (192, (7, 1), 1, (3, 0)),
+       (192, 3, 2)],
+      ["max"]]
+
+_E = [[(320, 1)],
+      [(384, 1), "fork33"],
+      [(448, 1), (384, 3, 1, 1), "fork33"],
+      ["avg", (192, 1)]]
+
+# the whole net: stem convs/pools then the module sequence
+_ARCH = [
+    ("stem", (32, 3, 2)), ("stem", (32, 3)), ("stem", (64, 3, 1, 1)),
+    ("pool",), ("stem", (80, 1)), ("stem", (192, 3)), ("pool",),
+    ("mix", "A1_", _A(32)), ("mix", "A2_", _A(64)), ("mix", "A3_", _A(64)),
+    ("mix", "B_", _B),
+    ("mix", "C1_", _C(128)), ("mix", "C2_", _C(160)),
+    ("mix", "C3_", _C(160)), ("mix", "C4_", _C(192)),
+    ("mix", "D_", _D),
+    ("mix", "E1_", _E), ("mix", "E2_", _E),
+]
 
 
 class Inception3(HybridBlock):
@@ -114,33 +112,21 @@ class Inception3(HybridBlock):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3, padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
-            self.features.add(nn.AvgPool2D(pool_size=8))
-            self.features.add(nn.Dropout(0.5))
+            for entry in _ARCH:
+                if entry[0] == "stem":
+                    self.features.add(_cbr(*entry[1]))
+                elif entry[0] == "pool":
+                    self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                else:
+                    self.features.add(_module(entry[2], entry[1]))
+            self.features.add(nn.AvgPool2D(pool_size=8), nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
 def inception_v3(**kwargs):
-    kwargs.pop("pretrained", None); kwargs.pop("ctx", None); kwargs.pop("root", None)
+    for k in ("pretrained", "ctx", "root"):
+        kwargs.pop(k, None)
     return Inception3(**kwargs)

@@ -1,19 +1,20 @@
-"""MobileNet v1/v2 (reference: model_zoo/vision/mobilenet.py)."""
+"""MobileNet v1/v2 (reference surface:
+python/mxnet/gluon/model_zoo/vision/mobilenet.py; Howard et al. 2017,
+Sandler et al. 2018).
+
+v1 is a (out_channels, stride) table of depthwise-separable units; v2 is
+the inverted-residual setting table in (expansion t, channels c, repeats
+n, first-stride s) form — the shape the MobileNetV2 paper publishes —
+consumed by one loop each.
+"""
 
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
-           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0"]
-
-
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
-                      use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    if active:
-        out.add(RELU6() if relu6 else nn.Activation("relu"))
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+           "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25",
+           "get_mobilenet", "get_mobilenet_v2"]
 
 
 class RELU6(HybridBlock):
@@ -21,109 +22,135 @@ class RELU6(HybridBlock):
         return F.clip(x, 0, 6)
 
 
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
-    _add_conv(out, channels=dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels=channels, relu6=relu6)
+def _cbn(seq, channels, kernel=1, stride=1, pad=0, groups=1, act="relu"):
+    """conv-BN(-activation) cell; act in {'relu', 'relu6', None}."""
+    seq.add(nn.Conv2D(channels, kernel, stride, pad, groups=groups,
+                      use_bias=False),
+            nn.BatchNorm(scale=True))
+    if act == "relu":
+        seq.add(nn.Activation("relu"))
+    elif act == "relu6":
+        seq.add(RELU6())
 
 
-class LinearBottleneck(HybridBlock):
-    def __init__(self, in_channels, channels, t, stride, **kwargs):
-        super().__init__(**kwargs)
-        self.use_shortcut = stride == 1 and in_channels == channels
-        with self.name_scope():
-            self.out = nn.HybridSequential()
-            _add_conv(self.out, in_channels * t, relu6=True)
-            _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
-                      pad=1, num_group=in_channels * t, relu6=True)
-            _add_conv(self.out, channels, active=False, relu6=True)
+# v1: (output channels, stride) per depthwise-separable unit
+_V1_UNITS = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+             (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+             (1024, 1)]
 
-    def hybrid_forward(self, F, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = out + x
-        return out
+# v2: (expansion t, channels c, repeats n, first stride s) — Table 2 of
+# the MobileNetV2 paper
+_V2_SETTINGS = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+                (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
 
 
 class MobileNet(HybridBlock):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: int(c * multiplier)   # noqa: E731
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            _add_conv(self.features, channels=int(32 * multiplier), kernel=3,
-                      pad=1, stride=2)
-            dw_channels = [int(x * multiplier) for x in
-                           [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
-            channels = [int(x * multiplier) for x in
-                        [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
-            strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
-            for dwc, c, s in zip(dw_channels, channels, strides):
-                _add_conv_dw(self.features, dw_channels=dwc, channels=c, stride=s)
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
+            _cbn(self.features, scale(32), kernel=3, stride=2, pad=1)
+            width = scale(32)
+            for out_c, stride in _V1_UNITS:
+                # depthwise 3x3 then pointwise 1x1
+                _cbn(self.features, width, kernel=3, stride=stride, pad=1,
+                     groups=width)
+                width = scale(out_c)
+                _cbn(self.features, width)
+            self.features.add(nn.GlobalAvgPool2D(), nn.Flatten())
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
+
+
+class _InvertedResidual(HybridBlock):
+    """expand 1x1 -> depthwise 3x3 -> project 1x1 (linear); identity
+    shortcut when the unit keeps shape."""
+
+    def __init__(self, in_c, out_c, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self._shortcut = stride == 1 and in_c == out_c
+        mid = in_c * t
+        with self.name_scope():
+            self.out = nn.HybridSequential()
+            _cbn(self.out, mid, act="relu6")
+            _cbn(self.out, mid, kernel=3, stride=stride, pad=1, groups=mid,
+                 act="relu6")
+            _cbn(self.out, out_c, act=None)
+
+    def hybrid_forward(self, F, x):
+        y = self.out(x)
+        return y + x if self._shortcut else y
+
+
+# reference API-parity alias (its constructor order: in, out, t, stride)
+class LinearBottleneck(_InvertedResidual):
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(in_channels, channels, t, stride, **kwargs)
 
 
 class MobileNetV2(HybridBlock):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: int(c * multiplier)   # noqa: E731
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="features_")
             with self.features.name_scope():
-                _add_conv(self.features, int(32 * multiplier), kernel=3,
-                          stride=2, pad=1, relu6=True)
-                in_channels_group = [int(x * multiplier) for x in
-                                     [32] + [16] + [24] * 2 + [32] * 3
-                                     + [64] * 4 + [96] * 3 + [160] * 3]
-                channels_group = [int(x * multiplier) for x in
-                                  [16] + [24] * 2 + [32] * 3 + [64] * 4
-                                  + [96] * 3 + [160] * 3 + [320]]
-                ts = [1] + [6] * 16
-                strides = [1, 2] + [1, 2] + [1] * 2 + [2] + [1] * 3 \
-                    + [1] * 3 + [2] + [1] * 2 + [1]
-                for in_c, c, t, s in zip(in_channels_group, channels_group,
-                                         ts, strides):
-                    self.features.add(LinearBottleneck(in_channels=in_c,
-                                                       channels=c, t=t,
-                                                       stride=s))
-                last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
-                _add_conv(self.features, last_channels, relu6=True)
+                width = scale(32)
+                _cbn(self.features, width, kernel=3, stride=2, pad=1,
+                     act="relu6")
+                for t, c, n, s in _V2_SETTINGS:
+                    for i in range(n):
+                        out_c = scale(c)
+                        self.features.add(_InvertedResidual(
+                            width, out_c, t, s if i == 0 else 1))
+                        width = out_c
+                last = scale(1280) if multiplier > 1.0 else 1280
+                _cbn(self.features, last, act="relu6")
                 self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.HybridSequential(prefix="output_")
             with self.output.name_scope():
                 self.output.add(nn.Conv2D(classes, 1, use_bias=False,
-                                          prefix="pred_"))
-                self.output.add(nn.Flatten())
+                                          prefix="pred_"),
+                                nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
-def _strip(kw):
-    kw.pop("pretrained", None); kw.pop("ctx", None); kw.pop("root", None)
-    return kw
+def get_mobilenet(multiplier, **kwargs):
+    for k in ("pretrained", "ctx", "root"):
+        kwargs.pop(k, None)
+    return MobileNet(multiplier, **kwargs)
 
 
-def mobilenet1_0(**kwargs):
-    return MobileNet(1.0, **_strip(kwargs))
+def get_mobilenet_v2(multiplier, **kwargs):
+    for k in ("pretrained", "ctx", "root"):
+        kwargs.pop(k, None)
+    return MobileNetV2(multiplier, **kwargs)
 
 
-def mobilenet0_75(**kwargs):
-    return MobileNet(0.75, **_strip(kwargs))
+def _v1(mult):
+    def build(**kwargs):
+        return get_mobilenet(mult, **kwargs)
+    build.__name__ = "mobilenet%s" % str(mult).replace(".", "_")
+    return build
 
 
-def mobilenet0_5(**kwargs):
-    return MobileNet(0.5, **_strip(kwargs))
+def _v2(mult):
+    def build(**kwargs):
+        return get_mobilenet_v2(mult, **kwargs)
+    build.__name__ = "mobilenet_v2_%s" % str(mult).replace(".", "_")
+    return build
 
 
-def mobilenet0_25(**kwargs):
-    return MobileNet(0.25, **_strip(kwargs))
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return MobileNetV2(1.0, **_strip(kwargs))
+mobilenet1_0 = _v1(1.0)
+mobilenet0_75 = _v1(0.75)
+mobilenet0_5 = _v1(0.5)
+mobilenet0_25 = _v1(0.25)
+mobilenet_v2_1_0 = _v2(1.0)
+mobilenet_v2_0_75 = _v2(0.75)
+mobilenet_v2_0_5 = _v2(0.5)
+mobilenet_v2_0_25 = _v2(0.25)

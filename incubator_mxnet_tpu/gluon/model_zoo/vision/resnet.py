@@ -1,8 +1,11 @@
-"""ResNet v1/v2 families.
+"""ResNet v1/v2 families, config-driven.
 
-Reference parity: python/mxnet/gluon/model_zoo/vision/resnet.py (BasicBlock/
-BottleneckV1/V2, resnet18..152 v1/v2). No pretrained download in this
-environment; architectures and parameter names match.
+Reference surface: python/mxnet/gluon/model_zoo/vision/resnet.py
+(BasicBlock/Bottleneck x V1/V2, resnet18..152). The architectures are a
+published spec (He et al. 2015/2016); this implementation expresses them
+as ONE generic residual unit driven by a conv-plan table plus one network
+assembler, instead of eight hand-written classes. No pretrained download
+in this zero-egress environment.
 """
 
 from ...block import HybridBlock
@@ -15,267 +18,184 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+def _conv_plan(kind, channels, stride, preact):
+    """(out_channels, kernel, stride, pad, bias) per conv of one residual
+    unit. v1 bottlenecks stride on the first 1x1 (and carry the reference's
+    quirk of BIASED 1x1 convs); v2 strides on the 3x3, all convs bias-free."""
+    if kind == "basic":
+        return [(channels, 3, stride, 1, False), (channels, 3, 1, 1, False)]
+    mid = channels // 4
+    if preact:
+        return [(mid, 1, 1, 0, False), (mid, 3, stride, 1, False),
+                (channels, 1, 1, 0, False)]
+    return [(mid, 1, stride, 0, True), (mid, 3, 1, 1, False),
+            (channels, 1, 1, 0, True)]
 
 
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+class _ResidualUnit(HybridBlock):
+    """One residual unit. ``preact=False`` is the v1 ordering
+    (conv-BN-relu ... + identity, relu after the add); ``preact=True`` is
+    the v2 ordering (BN-relu-conv ..., identity added raw, and the
+    downsample path branches from the ACTIVATED input)."""
+
+    def __init__(self, kind, channels, stride, downsample=False,
+                 in_channels=0, preact=False, **kwargs):
         super().__init__(**kwargs)
+        self._preact = preact
+        plan = _conv_plan(kind, channels, stride, preact)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
+        for i, (c, k, s, p, bias) in enumerate(plan):
+            if preact:
+                self.body.add(nn.BatchNorm(), nn.Activation("relu"))
+            self.body.add(nn.Conv2D(c, kernel_size=k, strides=s, padding=p,
+                                    use_bias=bias))
+            if not preact:
+                self.body.add(nn.BatchNorm())
+                if i < len(plan) - 1:
+                    self.body.add(nn.Activation("relu"))
+        if not downsample:
             self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
-
-
-class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
-
-
-class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
+        elif preact:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
                                         in_channels=in_channels)
         else:
-            self.downsample = None
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(
+                nn.Conv2D(channels, 1, stride, use_bias=False,
+                          in_channels=in_channels),
+                nn.BatchNorm())
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+        if self._preact:
+            # v2: the first BN-relu of the body also feeds the shortcut.
+            # list(self.body) iterates children directly — slicing a
+            # HybridSequential would build a throwaway Block per call.
+            cells = list(self.body)
+            pre = cells[1](cells[0](x))
+            shortcut = self.downsample(pre) if self.downsample else x
+            out = pre
+            for layer in cells[2:]:
+                out = layer(out)
+            return out + shortcut
+        shortcut = self.downsample(x) if self.downsample else x
+        return F.relu(self.body(x) + shortcut)
 
 
-class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1, use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
+def _unit_cls(kind, preact):
+    """API-parity shells: BasicBlockV1(channels, stride, downsample, ...)"""
+    class _Unit(_ResidualUnit):
+        def __init__(self, channels, stride, downsample=False, in_channels=0,
+                     **kwargs):
+            super().__init__(kind, channels, stride, downsample, in_channels,
+                             preact, **kwargs)
+    return _Unit
 
 
-class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+BasicBlockV1 = _unit_cls("basic", False)
+BottleneckV1 = _unit_cls("bottleneck", False)
+BasicBlockV2 = _unit_cls("basic", True)
+BottleneckV2 = _unit_cls("bottleneck", True)
+
+
+class _ResNet(HybridBlock):
+    """Assembler: stem -> 4 stages of residual units -> pool -> classifier.
+
+    v2 (preact) wraps the stages with the reference's extra input BN
+    (scale/center off) and a final BN-relu before pooling."""
+
+    def __init__(self, kind, layers, channels, preact, classes=1000,
+                 thumbnail=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        self._preact = preact
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
+            if preact:
+                self.features.add(nn.BatchNorm(scale=False, center=False))
             if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
+                self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
+                                            use_bias=False))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
+                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                            use_bias=False),
+                                  nn.BatchNorm(), nn.Activation("relu"),
+                                  nn.MaxPool2D(3, 2, 1))
+            in_c = channels[0]
+            for i, (n_units, out_c) in enumerate(zip(layers, channels[1:])):
+                stage = nn.HybridSequential(prefix="stage%d_" % (i + 1))
+                with stage.name_scope():
+                    for j in range(n_units):
+                        stride = 2 if (i > 0 and j == 0) else 1
+                        stage.add(_ResidualUnit(
+                            kind, out_c, stride,
+                            downsample=(j == 0 and out_c != in_c),
+                            in_channels=in_c, preact=preact, prefix=""))
+                        in_c = out_c
+                self.features.add(stage)
+            if preact:
+                self.features.add(nn.BatchNorm(), nn.Activation("relu"))
             self.features.add(nn.GlobalAvgPool2D())
+            if preact:
+                self.features.add(nn.Flatten())
             self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
-class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+class ResNetV1(_ResNet):
+    def __init__(self, block, layers, channels, **kwargs):
+        kind = "basic" if block in (BasicBlockV1, BasicBlockV2) \
+            else "bottleneck"
+        super().__init__(kind, layers, channels, preact=False, **kwargs)
 
 
+class ResNetV2(_ResNet):
+    def __init__(self, block, layers, channels, **kwargs):
+        kind = "basic" if block in (BasicBlockV1, BasicBlockV2) \
+            else "bottleneck"
+        super().__init__(kind, layers, channels, preact=True, **kwargs)
+
+
+# depth -> (unit kind, units per stage, channels incl. stem)
 resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+    18: ("basic", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: ("basic", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: ("bottleneck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: ("bottleneck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: ("bottleneck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
 }
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [
-    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
-    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
-]
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
-    assert num_layers in resnet_spec
-    assert version in (1, 2)
-    block_type, layers, channels = resnet_spec[num_layers]
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    if num_layers not in resnet_spec:
+        raise ValueError("no resnet spec for depth %r" % (num_layers,))
+    if version not in (1, 2):
+        raise ValueError("resnet version must be 1 or 2")
     if pretrained:
         raise RuntimeError("pretrained weights unavailable in this "
                            "zero-egress environment; load_parameters manually")
-    return net
+    kind, layers, channels = resnet_spec[num_layers]
+    return _ResNet(kind, layers, channels, preact=(version == 2), **kwargs)
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _variant(version, depth):
+    def build(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    build.__name__ = "resnet%d_v%d" % (depth, version)
+    build.__doc__ = "ResNet-%d v%d from the resnet_spec table." % (depth,
+                                                                   version)
+    return build
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _variant(1, 18)
+resnet34_v1 = _variant(1, 34)
+resnet50_v1 = _variant(1, 50)
+resnet101_v1 = _variant(1, 101)
+resnet152_v1 = _variant(1, 152)
+resnet18_v2 = _variant(2, 18)
+resnet34_v2 = _variant(2, 34)
+resnet50_v2 = _variant(2, 50)
+resnet101_v2 = _variant(2, 101)
+resnet152_v2 = _variant(2, 152)

@@ -1,11 +1,17 @@
-"""VGG 11/13/16/19 (+bn) (reference: model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19 (+BN) (reference surface:
+python/mxnet/gluon/model_zoo/vision/vgg.py; Simonyan & Zisserman 2014).
+
+The constructor flattens the depth spec into one layer plan — channel
+counts with "M" pooling markers — interpreted by a single loop."""
 
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg13_bn",
-           "vgg16_bn", "vgg19_bn"]
+           "vgg16_bn", "vgg19_bn", "get_vgg"]
 
+# depth -> (convs per stage, stage filters); flattened to a conv plan with
+# "M" pool markers by the constructor
 vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
             16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
@@ -13,75 +19,51 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 
 class VGG(HybridBlock):
-    def __init__(self, layers, filters, classes=1000, batch_norm=False, **kwargs):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(filters)
+        # accept either the reference's (layers, filters) pair or a flat plan
+        plan = layers if filters is None else [
+            c for n, f in zip(layers, filters) for c in [f] * n + ["M"]]
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal"))
-            self.features.add(nn.Dropout(rate=0.5))
+            self.features = nn.HybridSequential(prefix="")
+            for item in plan:
+                if item == "M":
+                    self.features.add(nn.MaxPool2D(strides=2))
+                    continue
+                self.features.add(nn.Conv2D(item, kernel_size=3, padding=1))
+                if batch_norm:
+                    self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+            for _ in range(2):
+                self.features.add(nn.Dense(4096, activation="relu",
+                                           weight_initializer="normal"),
+                                  nn.Dropout(rate=0.5))
             self.output = nn.Dense(classes, weight_initializer="normal")
 
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1,
-                                         weight_initializer=None))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
-def _get_vgg(num_layers, batch_norm=False, **kwargs):
+def get_vgg(num_layers, batch_norm=False, **kwargs):
+    for k in ("pretrained", "ctx", "root"):
+        kwargs.pop(k, None)
     layers, filters = vgg_spec[num_layers]
     return VGG(layers, filters, batch_norm=batch_norm, **kwargs)
 
 
-def vgg11(**kw):
-    return _get_vgg(11, **_strip(kw))
+def _variant(depth, bn):
+    def build(**kwargs):
+        return get_vgg(depth, batch_norm=bn, **kwargs)
+    build.__name__ = "vgg%d%s" % (depth, "_bn" if bn else "")
+    return build
 
 
-def vgg13(**kw):
-    return _get_vgg(13, **_strip(kw))
-
-
-def vgg16(**kw):
-    return _get_vgg(16, **_strip(kw))
-
-
-def vgg19(**kw):
-    return _get_vgg(19, **_strip(kw))
-
-
-def vgg11_bn(**kw):
-    return _get_vgg(11, batch_norm=True, **_strip(kw))
-
-
-def vgg13_bn(**kw):
-    return _get_vgg(13, batch_norm=True, **_strip(kw))
-
-
-def vgg16_bn(**kw):
-    return _get_vgg(16, batch_norm=True, **_strip(kw))
-
-
-def vgg19_bn(**kw):
-    return _get_vgg(19, batch_norm=True, **_strip(kw))
-
-
-def _strip(kw):
-    kw.pop("pretrained", None)
-    kw.pop("ctx", None)
-    kw.pop("root", None)
-    return kw
+vgg11 = _variant(11, False)
+vgg13 = _variant(13, False)
+vgg16 = _variant(16, False)
+vgg19 = _variant(19, False)
+vgg11_bn = _variant(11, True)
+vgg13_bn = _variant(13, True)
+vgg16_bn = _variant(16, True)
+vgg19_bn = _variant(19, True)

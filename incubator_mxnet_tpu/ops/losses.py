@@ -1,0 +1,169 @@
+"""Functional loss kernels — pure jnp, written for XLA fusion.
+
+These are the math behind ``gluon.loss`` (reference surface:
+python/mxnet/gluon/loss.py per SURVEY §2.6), reformulated in jax idiom
+rather than transliterated from the reference's F-DSL:
+
+- binary cross-entropies ride ``jax.nn.log_sigmoid`` / ``softplus``
+  (numerically equal to the reference's relu/softrelu decomposition —
+  ``relu(x) - x*y + softplus(-|x|) == -(y*logsig(x) + (1-y)*logsig(-x))``
+  — but stated as the probability it is);
+- every kernel is a plain jnp function over arrays, so it jits, vmaps,
+  shards, and lands on the tape through one ``_invoke_simple`` hop.
+
+All kernels reduce with ``mean over every axis except batch_axis``
+(the reference's ``F.mean(..., exclude=True)`` semantics).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "l1_loss", "l2_loss", "sigmoid_bce", "softmax_ce", "kl_div",
+    "huber_loss", "hinge_loss", "squared_hinge_loss", "logistic_loss",
+    "triplet_loss", "poisson_nll", "cosine_embedding_loss",
+]
+
+
+def _batch_mean(loss, batch_axis):
+    """Mean over every axis except the batch axis."""
+    if loss.ndim <= 1:
+        return loss
+    axes = tuple(i for i in range(loss.ndim) if i != batch_axis % loss.ndim)
+    return loss.mean(axis=axes)
+
+
+def _finish(loss, weight, sample_weight, batch_axis):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return _batch_mean(loss, batch_axis)
+
+
+def l2_loss(pred, label, sample_weight=None, *, weight=1.0, batch_axis=0):
+    err = pred - label.reshape(pred.shape)
+    return _finish(0.5 * err * err, weight, sample_weight, batch_axis)
+
+
+def l1_loss(pred, label, sample_weight=None, *, weight=None, batch_axis=0):
+    err = jnp.abs(pred - label.reshape(pred.shape))
+    return _finish(err, weight, sample_weight, batch_axis)
+
+
+def sigmoid_bce(pred, label, sample_weight=None, pos_weight=None, *,
+                from_sigmoid=False, weight=None, batch_axis=0):
+    label = label.reshape(pred.shape)
+    if from_sigmoid:
+        eps = 1e-12
+        pos = jnp.log(pred + eps) * label
+        if pos_weight is not None:
+            pos = pos * pos_weight
+        loss = -(pos + jnp.log1p(-pred + eps) * (1.0 - label))
+    else:
+        # -(w_pos * y * log sigma(x) + (1-y) * log sigma(-x)); log_sigmoid
+        # is the stable primitive XLA fuses best
+        pos = jax.nn.log_sigmoid(pred) * label
+        if pos_weight is not None:
+            pos = pos * pos_weight
+        loss = -(pos + jax.nn.log_sigmoid(-pred) * (1.0 - label))
+    return _finish(loss, weight, sample_weight, batch_axis)
+
+
+def softmax_ce(pred, label, sample_weight=None, *, axis=-1, sparse_label=True,
+               from_logits=False, weight=None, batch_axis=0):
+    if not from_logits:
+        pred = jax.nn.log_softmax(pred, axis=axis)
+    if sparse_label:
+        idx = jnp.expand_dims(label.astype(jnp.int32), axis)
+        loss = -jnp.take_along_axis(pred, idx, axis=axis)
+    else:
+        loss = -(pred * label.reshape(pred.shape)).sum(axis=axis,
+                                                       keepdims=True)
+    return _finish(loss, weight, sample_weight, batch_axis)
+
+
+def kl_div(pred, label, sample_weight=None, *, from_logits=True, axis=-1,
+           weight=None, batch_axis=0):
+    if not from_logits:
+        pred = jax.nn.log_softmax(pred, axis=axis)
+    loss = label * (jnp.log(label + 1e-12) - pred)
+    return _finish(loss, weight, sample_weight, batch_axis)
+
+
+def huber_loss(pred, label, sample_weight=None, *, rho=1.0, weight=None,
+               batch_axis=0):
+    err = jnp.abs(pred - label.reshape(pred.shape))
+    loss = jnp.where(err > rho, err - 0.5 * rho, 0.5 / rho * err * err)
+    return _finish(loss, weight, sample_weight, batch_axis)
+
+
+def hinge_loss(pred, label, sample_weight=None, *, margin=1.0, weight=None,
+               batch_axis=0):
+    loss = jax.nn.relu(margin - pred * label.reshape(pred.shape))
+    return _finish(loss, weight, sample_weight, batch_axis)
+
+
+def squared_hinge_loss(pred, label, sample_weight=None, *, margin=1.0,
+                       weight=None, batch_axis=0):
+    m = jax.nn.relu(margin - pred * label.reshape(pred.shape))
+    return _finish(m * m, weight, sample_weight, batch_axis)
+
+
+def logistic_loss(pred, label, sample_weight=None, *, label_format="signed",
+                  weight=None, batch_axis=0):
+    label = label.reshape(pred.shape)
+    if label_format == "binary":
+        label = 2.0 * label - 1.0          # {0,1} -> {-1,+1}
+    # -log sigma(y * x): one softplus, the whole loss
+    loss = jax.nn.softplus(-pred * label)
+    return _finish(loss, weight, sample_weight, batch_axis)
+
+
+def triplet_loss(pred, positive, negative, sample_weight=None, *,
+                 margin=1.0, weight=None, batch_axis=0):
+    positive = positive.reshape(pred.shape)
+    negative = negative.reshape(pred.shape)
+    d = jnp.square(positive - pred) - jnp.square(negative - pred)
+    axes = tuple(i for i in range(pred.ndim) if i != batch_axis % pred.ndim)
+    loss = jax.nn.relu(d.sum(axis=axes) + margin)
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def poisson_nll(pred, target, sample_weight=None, *, from_logits=True,
+                compute_full=False, weight=None, batch_axis=0, epsilon=1e-8):
+    target = target.reshape(pred.shape)
+    if from_logits:
+        loss = jnp.exp(pred) - target * pred
+    else:
+        loss = pred - target * jnp.log(pred + epsilon)
+    if compute_full:
+        # Stirling correction log(t!) ~ t log t - t + 0.5 log(2 pi t)
+        stirling = (target * jnp.log(target + epsilon) - target
+                    + 0.5 * jnp.log(2.0 * jnp.pi * target))
+        loss = loss + jnp.where(target <= 1.0, 0.0, stirling)
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss.mean()
+
+
+def cosine_embedding_loss(input1, input2, label, sample_weight=None, *,
+                          margin=0.0, weight=None, batch_axis=0):
+    input1 = input1.reshape(input2.shape)
+    dot = (input1 * input2).sum(axis=-1)
+    n1 = jnp.linalg.norm(input1, axis=-1)
+    n2 = jnp.linalg.norm(input2, axis=-1)
+    cos = dot / (n1 * n2 + 1e-12)
+    label = label.reshape(cos.shape)
+    loss = jnp.where(label == 1, 1.0 - cos, jax.nn.relu(cos - margin))
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss

@@ -316,11 +316,27 @@ def test_lambda_blocks():
 
 
 def test_model_zoo_builds():
-    for name in ["resnet18_v1", "resnet18_v2", "mobilenet0.25", "squeezenet1.1"]:
+    for name in ["resnet18_v1", "resnet18_v2", "mobilenet0.25",
+                 "squeezenet1.1", "densenet121"]:
         net = gluon.model_zoo.vision.get_model(name, classes=10)
         net.initialize(mx.init.Xavier())
         out = net(nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32)))
         assert out.shape == (1, 10), name
+
+
+def test_model_zoo_canonical_param_counts():
+    """Architecture fidelity: learnable-parameter counts must equal the
+    published models' (torchvision/gluon reference values, classes=1000)."""
+    want = {"resnet18_v1": 11689512, "resnet50_v2": 25549480,
+            "densenet121": 7978856}
+    for name, expect in want.items():
+        net = gluon.model_zoo.vision.get_model(name, classes=1000)
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32)))
+        n = sum(int(np.prod(p._data.shape))
+                for p in net.collect_params().values()
+                if p._data is not None and p.grad_req != "null")
+        assert n == expect, (name, n, expect)
 
 
 def test_summary_runs(capsys):
