@@ -25,12 +25,17 @@ import time
 import numpy as np
 
 
-def bench_bert(steps, dtype):
+def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
     """BERT-base PRETRAIN throughput, tokens/sec/chip (BASELINE config 4).
     Runs the complete objective: MLM cross-entropy on masked positions
     (including the 768x30522 vocab projection) + NSP cross-entropy.
     vs_baseline is vs our own round-1 fp32 first-light figure (47k tok/s,
-    encoder-only — the r1 bench omitted the MLM head; this one does not)."""
+    encoder-only — the r1 bench omitted the MLM head; this one does not).
+
+    BENCH_MODEL=bert_long runs the LONG-SEQUENCE config (T=2048, batch 8)
+    where the Pallas flash-attention kernels carry the attention stack
+    (O(T) memory); vs_baseline there is vs the XLA dense-attention einsum
+    path at the identical config (MXTPU_DISABLE_FLASH=1)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -38,13 +43,16 @@ def bench_bert(steps, dtype):
     from incubator_mxnet_tpu.models.bert import BERTForPretrain
     from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
 
-    B, T = int(os.environ.get("BENCH_BATCH", "64")), 128
+    default_b = "64" if seqlen == 128 else "8"
+    B, T = int(os.environ.get("BENCH_BATCH", default_b)), seqlen
     V = 30522
     MASK_FRAC = 0.15
     n_mask = max(1, int(T * MASK_FRAC))
     np.random.seed(0)
-    net = BERTForPretrain(bert=mx.models.bert_base(vocab_size=V, dropout=0.0),
-                          vocab_size=V)
+    net = BERTForPretrain(
+        bert=mx.models.bert_base(vocab_size=V, dropout=0.0,
+                                 max_length=max(512, T)),
+        vocab_size=V)
     net.initialize(mx.init.Normal(0.02))
     ids = np.random.randint(0, V, (B, T)).astype(np.int32)
     types = np.zeros((B, T), np.int32)
@@ -92,10 +100,10 @@ def bench_bert(steps, dtype):
     assert np.isfinite(final)
     tps = B * T * n_chunks * chunk / dt
     print(json.dumps({
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "metric": metric or "bert_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tps, 2),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tps / 47000.0, 2),
+        "vs_baseline": round(tps / (baseline or 47000.0), 2),
     }))
 
 
@@ -103,8 +111,18 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    if os.environ.get("BENCH_MODEL", "resnet50") == "bert":
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    if model == "bert":
         return bench_bert(steps, dtype)
+    if model == "bert_long":
+        # T=2048: the Pallas flash-attention path. vs_baseline = the best
+        # XLA dense-einsum attention figure at T=2048 on the same chip
+        # (44,346 tok/s at B=4 with MXTPU_DISABLE_FLASH=1; B=8 dense OOMs
+        # while flash runs it — see BENCHMARKS.md)
+        return bench_bert(steps, dtype, seqlen=2048,
+                          metric="bert_long_T2048_tokens_per_sec_per_chip",
+                          baseline=float(os.environ.get(
+                              "BENCH_LONG_BASELINE", "44346")))
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P

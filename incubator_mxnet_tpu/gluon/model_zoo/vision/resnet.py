@@ -81,20 +81,21 @@ class _ResidualUnit(HybridBlock):
         return F.relu(self.body(x) + shortcut)
 
 
-def _unit_cls(kind, preact):
+def _unit_cls(name, kind, preact):
     """API-parity shells: BasicBlockV1(channels, stride, downsample, ...)"""
     class _Unit(_ResidualUnit):
         def __init__(self, channels, stride, downsample=False, in_channels=0,
                      **kwargs):
             super().__init__(kind, channels, stride, downsample, in_channels,
                              preact, **kwargs)
+    _Unit.__name__ = _Unit.__qualname__ = name
     return _Unit
 
 
-BasicBlockV1 = _unit_cls("basic", False)
-BottleneckV1 = _unit_cls("bottleneck", False)
-BasicBlockV2 = _unit_cls("basic", True)
-BottleneckV2 = _unit_cls("bottleneck", True)
+BasicBlockV1 = _unit_cls("BasicBlockV1", "basic", False)
+BottleneckV1 = _unit_cls("BottleneckV1", "bottleneck", False)
+BasicBlockV2 = _unit_cls("BasicBlockV2", "basic", True)
+BottleneckV2 = _unit_cls("BottleneckV2", "bottleneck", True)
 
 
 class _ResNet(HybridBlock):
@@ -104,10 +105,15 @@ class _ResNet(HybridBlock):
     (scale/center off) and a final BN-relu before pooling."""
 
     def __init__(self, kind, layers, channels, preact, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, unit_factory=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._preact = preact
+        if unit_factory is None:
+            def unit_factory(out_c, stride, downsample, in_c):
+                return _ResidualUnit(kind, out_c, stride, downsample,
+                                     in_channels=in_c, preact=preact,
+                                     prefix="")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             if preact:
@@ -126,10 +132,8 @@ class _ResNet(HybridBlock):
                 with stage.name_scope():
                     for j in range(n_units):
                         stride = 2 if (i > 0 and j == 0) else 1
-                        stage.add(_ResidualUnit(
-                            kind, out_c, stride,
-                            downsample=(j == 0 and out_c != in_c),
-                            in_channels=in_c, preact=preact, prefix=""))
+                        stage.add(unit_factory(
+                            out_c, stride, j == 0 and out_c != in_c, in_c))
                         in_c = out_c
                 self.features.add(stage)
             if preact:
@@ -143,18 +147,24 @@ class _ResNet(HybridBlock):
         return self.output(self.features(x))
 
 
+def _block_factory(block):
+    """Reference API parity: ResNetV1/V2 INSTANTIATE the block class the
+    caller passes (including user subclasses), never a lookalike."""
+    def make(out_c, stride, downsample, in_c):
+        return block(out_c, stride, downsample, in_channels=in_c, prefix="")
+    return make
+
+
 class ResNetV1(_ResNet):
     def __init__(self, block, layers, channels, **kwargs):
-        kind = "basic" if block in (BasicBlockV1, BasicBlockV2) \
-            else "bottleneck"
-        super().__init__(kind, layers, channels, preact=False, **kwargs)
+        super().__init__("custom", layers, channels, preact=False,
+                         unit_factory=_block_factory(block), **kwargs)
 
 
 class ResNetV2(_ResNet):
     def __init__(self, block, layers, channels, **kwargs):
-        kind = "basic" if block in (BasicBlockV1, BasicBlockV2) \
-            else "bottleneck"
-        super().__init__(kind, layers, channels, preact=True, **kwargs)
+        super().__init__("custom", layers, channels, preact=True,
+                         unit_factory=_block_factory(block), **kwargs)
 
 
 # depth -> (unit kind, units per stage, channels incl. stem)

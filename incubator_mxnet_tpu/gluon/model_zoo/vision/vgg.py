@@ -19,7 +19,7 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 
 class VGG(HybridBlock):
-    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+    def __init__(self, layers, filters=None, classes=1000, batch_norm=False,
                  **kwargs):
         super().__init__(**kwargs)
         # accept either the reference's (layers, filters) pair or a flat plan

@@ -50,6 +50,7 @@ class MultiHeadAttention(HybridBlock):
         # Pallas flash-attention fast path (O(T) memory on the MXU) when on
         # TPU inside a trace with no attention-dropout; einsum otherwise.
         # Valid-length masks ride the kernel's kv-mask path (r2).
+        import os as _os
         from ..ops.pallas import flash_attention, flash_attention_available
         in_trace = current_trace() is not None
         # Crossover re-measured on v5e after the r2 kernel tuning (bf16 MXU
@@ -57,7 +58,9 @@ class MultiHeadAttention(HybridBlock):
         # T=2048 up (6.3 vs 20.5 ms at 2048; 9.1 vs 252 ms at 8192, bf16
         # B=1 H=8 D=64) and is within noise below that, where per-call
         # overhead dominates. Switch where the win is measurable.
+        # MXTPU_DISABLE_FLASH=1 forces the einsum path (A/B benchmarking).
         if (in_trace and self.dropout._rate == 0
+                and _os.environ.get("MXTPU_DISABLE_FLASH", "0") != "1"
                 and T >= 2048 and T % 128 == 0 and flash_attention_available()):
             return flash_attention(q, k, v, scale=1.0 / math.sqrt(D),
                                    kv_mask=mask)
