@@ -13,6 +13,7 @@ from ....ndarray.ndarray import _invoke_op
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomLighting", "RandomRotation",
            "RandomColorJitter", "CropResize"]
 
 
@@ -169,6 +170,43 @@ class RandomSaturation(Block):
                           {"min_factor": self._args[0], "max_factor": self._args[1]})
 
 
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__(prefix="", params=None)
+        self._hue = hue
+
+    def forward(self, x):
+        return _invoke_op("image_random_hue", (_as_nd(x),),
+                          {"hue": self._hue})
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference transforms
+    RandomLighting / pca_noise augmenter)."""
+
+    def __init__(self, alpha):
+        super().__init__(prefix="", params=None)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return _invoke_op("image_random_lighting", (_as_nd(x),),
+                          {"alpha_std": self._alpha})
+
+
+class RandomRotation(Block):
+    """Rotate by a uniform random angle in `angle_limits` degrees
+    (reference: rotation augmenter, image_aug_default.cc)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False):
+        super().__init__(prefix="", params=None)
+        self._args = {"angle_limits": tuple(angle_limits),
+                      "zoom_in": zoom_in, "zoom_out": zoom_out}
+
+    def forward(self, x):
+        return _invoke_op("image_random_rotate", (_as_nd(x),),
+                          dict(self._args))
+
+
 class RandomColorJitter(Block):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
         super().__init__(prefix="", params=None)
@@ -179,6 +217,8 @@ class RandomColorJitter(Block):
             self._transforms.append(RandomContrast(contrast))
         if saturation:
             self._transforms.append(RandomSaturation(saturation))
+        if hue:
+            self._transforms.append(RandomHue(hue))
 
     def forward(self, x):
         ts = list(self._transforms)

@@ -86,3 +86,107 @@ def random_saturation(data, min_factor, max_factor, key=None):
     coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
     gray = (data * coef).sum(axis=-1, keepdims=True)
     return data * f + gray * (1 - f)
+
+
+# YIQ color rotation basis for hue adjustment (reference:
+# src/operator/image/image_random-inl.h RandomHue / AdjustLighting,
+# src/io/image_aug_default.cc:40-120)
+import numpy as _np
+
+_TYIQ = jnp.asarray([[0.299, 0.587, 0.114],
+                     [0.596, -0.274, -0.321],
+                     [0.211, -0.523, 0.311]])
+_TYIQ_INV = jnp.asarray(_np.linalg.inv(_np.asarray(_TYIQ, _np.float64)),
+                        jnp.float32)
+
+# AlexNet-style PCA lighting statistics (reference image_aug_default.cc)
+_PCA_EIGVAL = jnp.asarray([55.46, 4.794, 1.148])
+_PCA_EIGVEC = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+
+
+@register("image_adjust_hue")
+def adjust_hue(data, alpha):
+    """Rotate hue by `alpha` TURNS (reference AdjustHueImpl: h += alpha*360
+    degrees in HLS space) via the YIQ chroma rotation — RGB -> YIQ, rotate
+    the IQ plane by alpha*2*pi, back to RGB (the linear approximation of
+    HLS hue rotation, same convention as TF's fused adjust_hue).
+    Channels-last."""
+    a = alpha * 2.0 * jnp.pi
+    u, w = jnp.cos(a), jnp.sin(a)
+    rot = jnp.stack([jnp.stack([jnp.ones_like(u), jnp.zeros_like(u),
+                                jnp.zeros_like(u)]),
+                     jnp.stack([jnp.zeros_like(u), u, -w]),
+                     jnp.stack([jnp.zeros_like(u), w, u])])
+    m = (_TYIQ_INV @ rot @ _TYIQ).astype(jnp.float32)
+    out = jnp.einsum("...c,dc->...d", data.astype(jnp.float32), m)
+    return out.astype(data.dtype)
+
+
+@register("image_random_hue")
+def random_hue(data, min_factor=None, max_factor=None, hue=None, key=None):
+    """Reference RandomHueAug: alpha ~ U[-hue, hue] (or U[min,max]-1)."""
+    key = key if key is not None else _rnd.next_key()
+    if hue is not None:
+        lo, hi = -abs(hue), abs(hue)
+    else:
+        lo, hi = min_factor - 1.0, max_factor - 1.0
+    alpha = jax.random.uniform(key, (), minval=lo, maxval=hi)
+    return adjust_hue(data, alpha)
+
+
+@register("image_random_lighting")
+def random_lighting(data, alpha_std=0.05, key=None):
+    """AlexNet PCA lighting noise (reference pca_noise augmenter):
+    per-image alpha ~ N(0, alpha_std) per principal component, added as
+    eigvec @ (eigval * alpha) to every pixel. Channels-last RGB."""
+    key = key if key is not None else _rnd.next_key()
+    alpha = jax.random.normal(key, (3,)) * alpha_std
+    noise = _PCA_EIGVEC @ (_PCA_EIGVAL * alpha)
+    return (data.astype(jnp.float32) + noise).astype(data.dtype)
+
+
+@register("image_rotate")
+def rotate(data, angle, zoom_in=False, zoom_out=False):
+    """Rotate HWC (or NHWC) image(s) by `angle` degrees around the center
+    with bilinear sampling, zero fill (reference: image rotate op /
+    image_aug_default.cc rotation). zoom_in crops so no fill is visible;
+    zoom_out scales so the full rotated frame fits."""
+    rad = jnp.deg2rad(jnp.asarray(angle, jnp.float32))
+
+    def one(img):
+        # zero-padded bilinear taps shared with the vision ops (single
+        # boundary-semantics implementation, CHW layout)
+        from .vision import _bilinear_gather
+        h, w = img.shape[0], img.shape[1]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        c, s = jnp.cos(rad), jnp.sin(rad)
+        zoom = 1.0
+        if zoom_out:
+            zoom = jnp.abs(c) + jnp.abs(s) * (max(h, w) / min(h, w))
+        elif zoom_in:
+            zoom = 1.0 / (jnp.abs(c) + jnp.abs(s))
+        yy, xx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                              jnp.arange(w, dtype=jnp.float32),
+                              indexing="ij")
+        # inverse-map output pixels to source coords
+        dy, dx = (yy - cy) * zoom, (xx - cx) * zoom
+        sy = cy + dy * c - dx * s
+        sx = cx + dy * s + dx * c
+        chw = jnp.transpose(img, (2, 0, 1)).astype(jnp.float32)
+        out = _bilinear_gather(chw, sx, sy)       # (C, H, W)
+        return jnp.transpose(out, (1, 2, 0)).astype(img.dtype)
+
+    if data.ndim == 3:
+        return one(data)
+    return jax.vmap(one)(data)
+
+
+@register("image_random_rotate")
+def random_rotate(data, angle_limits, zoom_in=False, zoom_out=False,
+                  key=None):
+    key = key if key is not None else _rnd.next_key()
+    lo, hi = angle_limits
+    angle = jax.random.uniform(key, (), minval=lo, maxval=hi)
+    return rotate(data, angle, zoom_in=zoom_in, zoom_out=zoom_out)

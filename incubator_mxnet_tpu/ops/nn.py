@@ -236,11 +236,40 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
 
 
 @register("UpSampling")
-def upsampling(data, scale=2, sample_type="nearest", **_ignored):
-    if sample_type != "nearest":
-        raise NotImplementedError("bilinear UpSampling via contrib.BilinearResize2D")
+def upsampling(data, weight=None, scale=2, sample_type="nearest",
+               num_filter=0, **_ignored):
+    """NCHW upsampling. 'nearest' replicates pixels; 'bilinear' is the
+    reference's Deconvolution formulation (src/operator/nn/upsampling.cc:
+    kernel 2*scale - scale%2, stride scale, pad ceil((scale-1)/2),
+    per-channel groups) — `weight` (C, 1, k, k) is the learnable kernel;
+    omitted, a fixed bilinear-interpolation kernel is used (the
+    reference's standard initializer for it)."""
     b, c, h, w = data.shape
-    return jax.image.resize(data, (b, c, h * scale, w * scale), method="nearest")
+    if sample_type == "nearest":
+        return jax.image.resize(data, (b, c, h * scale, w * scale),
+                                method="nearest")
+    if sample_type != "bilinear":
+        raise ValueError("sample_type must be nearest or bilinear")
+    k = 2 * scale - scale % 2
+    pad = -(-(scale - 1) // 2)   # ceil((scale-1)/2)
+    if weight is None:
+        # bilinear interpolation kernel (reference init.Bilinear)
+        center = (2 * scale - 1 - scale % 2) / (2.0 * scale)
+        og = jnp.arange(k, dtype=jnp.float32)
+        f1d = 1.0 - jnp.abs(og / scale - center)
+        kern = f1d[:, None] * f1d[None, :]
+        weight = jnp.broadcast_to(kern, (c, 1, k, k)).astype(data.dtype)
+    # per-channel transposed conv: lhs_dilation=scale with OIHW (C,1,k,k)
+    # weights and feature_group_count=C. The reference is a TRUE
+    # Deconvolution (flipped kernel), and conv_general_dilated computes
+    # cross-correlation — flip the taps so reference-trained asymmetric
+    # weights transfer exactly (no-op for the symmetric bilinear init).
+    return lax.conv_general_dilated(
+        data, weight[..., ::-1, ::-1], window_strides=(1, 1),
+        padding=[(k - 1 - pad, k - 1 - pad)] * 2,
+        lhs_dilation=(scale, scale),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=c)
 
 
 # ---------------------------------------------------------------------------

@@ -408,3 +408,103 @@ def correlation(data1, data2, kernel_size=1, max_displacement=4, stride1=1,
             outs.append(prod[:, pad_size:pad_size + h:stride1,
                              pad_size:pad_size + w:stride1])
     return jnp.stack(outs, axis=1)
+
+
+@register("MultiProposal", aliases=("_contrib_MultiProposal",
+                                    "multi_proposal"))
+def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Batch RPN proposals (reference: src/operator/contrib/
+    multi_proposal.cc — the batch-capable Proposal). This framework's
+    `Proposal` is already batched via vmap, so MultiProposal shares the
+    implementation; both return (B*post_nms, 5) rows
+    [batch_idx, x0, y0, x1, y1] flattened like the reference."""
+    out = proposal(cls_prob, bbox_pred, im_info, **kwargs)
+    return out.reshape(-1, 5)
+
+
+@register("DeformablePSROIPooling",
+          aliases=("_contrib_DeformablePSROIPooling",
+                   "deformable_psroi_pooling"))
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=None, group_size=1, pooled_size=7,
+                             part_size=0, sample_per_part=4, trans_std=0.0,
+                             no_trans=False):
+    """Deformable position-sensitive ROI pooling (reference:
+    src/operator/contrib/deformable_psroi_pooling.cc, Dai et al. 2017).
+
+    data: (B, output_dim*group_size^2, H, W) score maps; rois: (N, 5)
+    [batch_idx, x0, y0, x1, y1]; trans: (N, 2*cls, part, part) learned
+    bin offsets (ignored when no_trans). Returns (N, output_dim, P, P).
+    Differentiable in data AND trans (bilinear sampling), vmapped over
+    rois and the output grid — no dynamic shapes."""
+    B, C, H, W = data.shape
+    P = int(pooled_size)
+    G = int(group_size)
+    part = int(part_size) or P
+    if output_dim is None:
+        output_dim = C // (G * G)
+    no_trans = no_trans or trans is None
+    n_cls = 1 if no_trans else trans.shape[1] // 2
+    per_cls = output_dim // n_cls
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        img = jnp.take(data, bidx, axis=0)                  # (C, H, W)
+        x0 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y0 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x1 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y1 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bin_w, bin_h = rw / P, rh / P
+        sub_w = bin_w / sample_per_part
+        sub_h = bin_h / sample_per_part
+
+        ph = jnp.arange(P)
+        pw = jnp.arange(P)
+        phh, pww = jnp.meshgrid(ph, pw, indexing="ij")      # (P, P)
+        part_h = jnp.floor(phh / P * part).astype(jnp.int32)
+        part_w = jnp.floor(pww / P * part).astype(jnp.int32)
+
+        def for_channel(ctop):
+            cls = ctop // per_cls
+            if no_trans:
+                dx = dy = jnp.zeros((P, P))
+            else:
+                dx = tr[2 * cls, part_h, part_w] * trans_std * rw
+                dy = tr[2 * cls + 1, part_h, part_w] * trans_std * rh
+            wstart = pww * bin_w + x0 + dx                  # (P, P)
+            hstart = phh * bin_h + y0 + dy
+            iw = jnp.arange(sample_per_part)
+            ih = jnp.arange(sample_per_part)
+            # reference kernel samples at wstart + iw*sub (no half-offset)
+            sw = wstart[..., None, None] + iw[None, None, :, None] * sub_w
+            sh = hstart[..., None, None] + ih[None, None, None, :] * sub_h
+            inside = ((sw > -0.5) & (sw < W - 0.5)
+                      & (sh > -0.5) & (sh < H - 0.5))
+            swc = jnp.clip(sw, 0.0, W - 1.0)
+            shc = jnp.clip(sh, 0.0, H - 1.0)
+            # position-sensitive channel per output bin: pick the single
+            # needed plane BEFORE sampling (sampling all C channels and
+            # discarding C-1 would waste a factor of C on R-FCN inputs)
+            gw = jnp.clip(jnp.floor(pww * G / P), 0, G - 1).astype(jnp.int32)
+            gh = jnp.clip(jnp.floor(phh * G / P), 0, G - 1).astype(jnp.int32)
+            chan = (ctop * G + gh) * G + gw                 # (P, P)
+            planes = img[chan]                              # (P, P, H, W)
+
+            def sample_bin(plane, x, y):
+                # (s,s) bilinear taps on one (H, W) plane
+                return _bilinear_gather(plane[None], x, y)[0]
+
+            picked = jax.vmap(jax.vmap(sample_bin))(planes, swc, shc)
+            picked = picked * inside
+            cnt = jnp.maximum(inside.sum(axis=(-1, -2)), 1)
+            return picked.sum(axis=(-1, -2)) / cnt          # (P, P)
+
+        return jax.vmap(for_channel)(jnp.arange(output_dim))
+
+    if trans is None:
+        trans_arg = jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+    else:
+        trans_arg = trans
+    return jax.vmap(one_roi)(rois, trans_arg)               # (N, D, P, P)

@@ -267,3 +267,110 @@ def test_proposal_suppressed_rows_invalidated():
                                 ratios=(1, 1), feature_stride=16))
     valid = out[0][out[0, :, 1] >= 0]
     assert len(valid) == 1, out
+
+
+# ---------------------------------------------------------------------------
+# r3 contrib/image op tail (VERDICT r2 #9)
+# ---------------------------------------------------------------------------
+
+def test_multi_proposal_registered_and_shapes():
+    from incubator_mxnet_tpu.ops.registry import get_op
+    assert get_op("MultiProposal") is not None
+    assert get_op("_contrib_MultiProposal") is not None
+    import jax.numpy as jnp
+    np.random.seed(0)
+    B, A, H, W = 2, 12, 4, 4   # A = len(scales) * len(ratios) defaults
+    cls_prob = jnp.asarray(np.random.rand(B, 2 * A, H, W).astype("float32"))
+    bbox = jnp.asarray(np.random.randn(B, 4 * A, H, W).astype("float32") * 0.1)
+    im_info = jnp.asarray([[64, 64, 1.0]] * B, jnp.float32)
+    from incubator_mxnet_tpu.ops.vision import multi_proposal
+    out = multi_proposal(cls_prob, bbox, im_info, rpn_pre_nms_top_n=50,
+                         rpn_post_nms_top_n=10, feature_stride=16)
+    assert out.shape == (B * 10, 5)
+    # rows carry their batch index in column 0 (ignoring -1 padding)
+    col0 = np.asarray(out[:, 0])
+    assert set(np.unique(col0[col0 >= 0])) <= {0.0, 1.0}
+
+
+def test_deformable_psroi_pooling_matches_plain_psroi_when_no_offset():
+    """With zero offsets and group_size=1 it reduces to average pooling of
+    the ROI bins of the single score map group."""
+    from incubator_mxnet_tpu.ops.vision import deformable_psroi_pooling
+    import jax.numpy as jnp
+    np.random.seed(1)
+    data = jnp.asarray(np.random.rand(1, 2, 8, 8).astype("float32"))
+    rois = jnp.asarray([[0, 0, 0, 7, 7]], jnp.float32)
+    out = deformable_psroi_pooling(data, rois, None, spatial_scale=1.0,
+                                   output_dim=2, group_size=1,
+                                   pooled_size=2, sample_per_part=8,
+                                   no_trans=True)
+    assert out.shape == (1, 2, 2, 2)
+    # dense sampling of each quadrant ~= the quadrant mean
+    want = np.asarray(data[0, 0].reshape(2, 4, 2, 4).mean(axis=(1, 3)))
+    np.testing.assert_allclose(np.asarray(out[0, 0]), want, atol=0.05)
+
+
+def test_upsampling_bilinear():
+    from incubator_mxnet_tpu.ops.nn import upsampling
+    import jax.numpy as jnp
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = upsampling(x, scale=2, sample_type="bilinear")
+    assert out.shape == (1, 1, 8, 8)
+    # interior values interpolate smoothly; corner alignment of the deconv
+    # formulation keeps the mean close
+    np.testing.assert_allclose(float(out.mean()), float(x.mean()), rtol=0.15)
+    # learnable-weight form: explicit kernel matches the default
+    k = 4
+    center = (2 * 2 - 1 - 2 % 2) / 4.0
+    og = np.arange(k, dtype=np.float32)
+    f1d = 1.0 - np.abs(og / 2 - center)
+    w = jnp.asarray((f1d[:, None] * f1d[None, :])[None, None])
+    out2 = upsampling(x, weight=w, scale=2, sample_type="bilinear")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-6)
+
+
+def test_image_hue_lighting_rotate():
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops import image as im
+    np.random.seed(2)
+    img = jnp.asarray(np.random.rand(8, 8, 3).astype("float32"))
+    # hue: zero rotation is identity; rotation preserves luma-ish energy
+    np.testing.assert_allclose(np.asarray(im.adjust_hue(img, 0.0)),
+                               np.asarray(img), atol=1e-5)
+    shifted = im.adjust_hue(img, 0.3)
+    assert shifted.shape == img.shape
+    assert float(jnp.abs(shifted - img).max()) > 1e-3
+    # luma (Y of YIQ) is invariant under the IQ-plane rotation
+    coef = jnp.asarray([0.299, 0.587, 0.114])
+    np.testing.assert_allclose(np.asarray((shifted * coef).sum(-1)),
+                               np.asarray((img * coef).sum(-1)), atol=1e-4)
+    # lighting: deterministic with an explicit key; zero std is identity
+    out = im.random_lighting(img, alpha_std=0.0,
+                             key=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-6)
+    out = im.random_lighting(img, alpha_std=0.5,
+                             key=jax.random.PRNGKey(0))
+    assert float(jnp.abs(out - img).max()) > 1e-4
+    # rotate: 0 deg is identity; 90 deg of a delta image moves the pixel
+    np.testing.assert_allclose(np.asarray(im.rotate(img, 0.0)),
+                               np.asarray(img), atol=1e-5)
+    delta = jnp.zeros((5, 5, 1)).at[1, 2, 0].set(1.0)
+    rot = im.rotate(delta, 90.0)
+    assert float(rot[2, 1, 0]) > 0.9 or float(rot[2, 3, 0]) > 0.9
+
+
+def test_random_color_jitter_honors_hue():
+    from incubator_mxnet_tpu.gluon.data.vision.transforms import (
+        RandomColorJitter, RandomHue, RandomLighting, RandomRotation)
+    jit = RandomColorJitter(hue=0.4)
+    assert len(jit._transforms) == 1
+    from incubator_mxnet_tpu import nd
+    np.random.seed(3)
+    x = nd.array(np.random.rand(6, 6, 3).astype("float32"))
+    out = jit(x)
+    assert out.shape == x.shape
+    # and the standalone transforms run
+    assert RandomHue(0.2)(x).shape == x.shape
+    assert RandomLighting(0.1)(x).shape == x.shape
+    assert RandomRotation((-10, 10))(x).shape == x.shape
