@@ -4,5 +4,6 @@ environment, so the translation layer targets ONNX's JSON-serializable
 graph dict; ``to_onnx_proto``/``from_onnx_proto`` plug into the real
 protobuf when the package is installed."""
 
-from .export import export_model, block_to_onnx_graph
-from .import_ import import_model, onnx_graph_to_symbol
+from .export import (export_model, block_to_onnx_graph,
+                     symbol_to_onnx_graph, MX2ONNX_OPS)
+from .import_ import import_model, onnx_graph_to_symbol, ONNX2MX_OPS

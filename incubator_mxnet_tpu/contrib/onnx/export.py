@@ -1,135 +1,336 @@
-"""Export: framework graph -> ONNX graph dict (mx2onnx direction).
+"""Export: Symbol/HybridBlock -> ONNX graph (mx2onnx direction).
 
-Reference parity: python/mxnet/contrib/onnx/mx2onnx (per-op translation
-table). The symbol JSON graph is translated node-by-node into ONNX ops;
-serialization to protobuf happens only if the onnx package exists.
+Reference parity: python/mxnet/contrib/onnx/mx2onnx/_op_translations.py
+(~90 per-op converters) per SURVEY §2.6. The graph is produced as an
+ONNX-shaped dict (node/input/initializer/output, opset-13 op names and
+attribute spellings); parameter tensors are embedded base64(float32) in
+the initializers so an exported file is self-contained. Multi-node
+translations (scalar ops -> Constant + binary op) follow the reference's
+converter structure.
 """
 
+import base64
 import json
 
-__all__ = ["export_model", "block_to_onnx_graph", "MX2ONNX_OPS"]
+import numpy as _np
 
-# op-name -> (onnx_op, attr translator)
+__all__ = ["export_model", "block_to_onnx_graph", "symbol_to_onnx_graph",
+           "MX2ONNX_OPS"]
+
+
+def _simple(onnx_op, attr_fn=None):
+    fn = attr_fn or (lambda a: {})
+    return (onnx_op, fn)
+
+
+def _pool_attrs(a):
+    out = {}
+    if a.get("kernel"):
+        out["kernel_shape"] = list(a["kernel"])
+    if a.get("stride"):
+        out["strides"] = list(a["stride"])
+    if a.get("pad"):
+        p = list(a["pad"])
+        out["pads"] = p + p
+    return out
+
+
+def _reduce_attrs(a):
+    axis = a.get("axis")
+    out = {"keepdims": int(bool(a.get("keepdims", False)))}
+    if axis is not None:
+        out["axes"] = list(axis) if isinstance(axis, (tuple, list)) else [axis]
+    return out
+
+
+# mx op -> (onnx op, attr translation). One row per reference converter
+# family; Activation/Pooling/LeakyReLU/scalar ops get refined in
+# _translate_node.
 MX2ONNX_OPS = {
-    "FullyConnected": ("Gemm", lambda a: {"transB": 1}),
-    "Convolution": ("Conv", lambda a: {
+    # --- layers
+    "FullyConnected": _simple("Gemm", lambda a: {"transB": 1}),
+    "Convolution": _simple("Conv", lambda a: {
         "kernel_shape": list(a.get("kernel", ())),
         "strides": list(a.get("stride", (1, 1))),
         "pads": list(a.get("pad", (0, 0))) * 2,
-        "group": a.get("num_group", 1)}),
-    "Activation": ("Relu", lambda a: {}),  # refined below per act_type
-    "relu": ("Relu", lambda a: {}),
-    "sigmoid": ("Sigmoid", lambda a: {}),
-    "tanh": ("Tanh", lambda a: {}),
-    "softmax": ("Softmax", lambda a: {"axis": a.get("axis", -1)}),
-    "BatchNorm": ("BatchNormalization", lambda a: {
-        "epsilon": a.get("eps", 1e-3), "momentum": a.get("momentum", 0.9)}),
-    "Pooling": ("MaxPool", lambda a: {
+        "dilations": list(a.get("dilate", (1, 1))),
+        "group": int(a.get("num_group", 1))}),
+    "Deconvolution": _simple("ConvTranspose", lambda a: {
         "kernel_shape": list(a.get("kernel", ())),
         "strides": list(a.get("stride", (1, 1))),
-        "pads": list(a.get("pad", (0, 0))) * 2}),
-    "Flatten": ("Flatten", lambda a: {"axis": 1}),
-    "Reshape": ("Reshape", lambda a: {}),
-    "Concat": ("Concat", lambda a: {"axis": a.get("dim", 1)}),
-    "broadcast_add": ("Add", lambda a: {}),
-    "broadcast_multiply": ("Mul", lambda a: {}),
-    "broadcast_subtract": ("Sub", lambda a: {}),
-    "broadcast_divide": ("Div", lambda a: {}),
-    "Dropout": ("Dropout", lambda a: {"ratio": a.get("p", 0.5)}),
-    "LayerNorm": ("LayerNormalization", lambda a: {
-        "epsilon": a.get("eps", 1e-5), "axis": a.get("axis", -1)}),
-    "Embedding": ("Gather", lambda a: {}),
-    "transpose": ("Transpose", lambda a: {"perm": list(a.get("axes", ()))}),
-    "dot": ("MatMul", lambda a: {}),
-    "LeakyReLU": ("LeakyRelu", lambda a: {"alpha": a.get("slope", 0.25)}),
+        "pads": list(a.get("pad", (0, 0))) * 2,
+        "group": int(a.get("num_group", 1))}),
+    # eps defaults MIRROR THE OPS' EXECUTION DEFAULTS (ops/nn.py: 1e-3),
+    # not the ONNX spec default — the exported graph must compute what the
+    # source model computed
+    "BatchNorm": _simple("BatchNormalization", lambda a: {
+        "epsilon": float(a.get("eps", 1e-3)),
+        "momentum": float(a.get("momentum", 0.9))}),
+    "InstanceNorm": _simple("InstanceNormalization", lambda a: {
+        "epsilon": float(a.get("eps", 1e-3))}),
+    "LayerNorm": _simple("LayerNormalization", lambda a: {
+        "epsilon": float(a.get("eps", 1e-5)),
+        "axis": int(a.get("axis", -1))}),
+    "LRN": _simple("LRN", lambda a: {
+        "size": int(a.get("nsize", 5)), "alpha": float(a.get("alpha", 1e-4)),
+        "beta": float(a.get("beta", 0.75)), "bias": float(a.get("knorm", 2))}),
+    "L2Normalization": _simple("LpNormalization", lambda a: {"p": 2,
+                                                             "axis": -1}),
+    "Pooling": _simple("MaxPool", _pool_attrs),
+    "Dropout": _simple("Dropout", lambda a: {"ratio": float(a.get("p", 0.5))}),
+    "Flatten": _simple("Flatten", lambda a: {"axis": 1}),
+    "Embedding": _simple("Gather", lambda a: {}),
+    "Concat": _simple("Concat", lambda a: {"axis": int(a.get("dim", 1))}),
+    "Pad": _simple("Pad", lambda a: {"mode": a.get("mode", "constant"),
+                                     "pads": list(a.get("pad_width", ())),
+                                     "value": float(a.get("constant_value",
+                                                          0.0))}),
+    "ROIPooling": _simple("MaxRoiPool", lambda a: {
+        "pooled_shape": list(a.get("pooled_size", ())),
+        "spatial_scale": float(a.get("spatial_scale", 1.0))}),
+    "SoftmaxOutput": _simple("Softmax", lambda a: {"axis": 1}),
+    "LogisticRegressionOutput": _simple("Sigmoid", lambda a: {}),
+    "BlockGrad": _simple("Identity", lambda a: {}),
+    "MakeLoss": _simple("Identity", lambda a: {}),
+    "identity": _simple("Identity", lambda a: {}),
+    "_copy": _simple("Identity", lambda a: {}),
+    # --- activations (Activation/LeakyReLU/square are translated in
+    # _translate_node's dispatch, not via this table)
+    "relu": _simple("Relu"),
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "softsign": _simple("Softsign"),
+    "hard_sigmoid": _simple("HardSigmoid", lambda a: {
+        "alpha": float(a.get("alpha", 0.2)),
+        "beta": float(a.get("beta", 0.5))}),
+    "softmax": _simple("Softmax", lambda a: {"axis": int(a.get("axis", -1))}),
+    "log_softmax": _simple("LogSoftmax", lambda a: {
+        "axis": int(a.get("axis", -1))}),
+    # --- elementwise math
+    "abs": _simple("Abs"), "ceil": _simple("Ceil"), "floor": _simple("Floor"),
+    "exp": _simple("Exp"), "log": _simple("Log"), "sqrt": _simple("Sqrt"),
+    "negative": _simple("Neg"), "reciprocal": _simple("Reciprocal"),
+    "cos": _simple("Cos"), "sin": _simple("Sin"), "tan": _simple("Tan"),
+    "arccos": _simple("Acos"), "arcsin": _simple("Asin"),
+    "arctan": _simple("Atan"), "erf": _simple("Erf"),
+    "sign": _simple("Sign"), "round": _simple("Round"),
+    "logical_not": _simple("Not"),
+    "clip": _simple("Clip", lambda a: {"min": float(a.get("a_min", 0.0)),
+                                       "max": float(a.get("a_max", 0.0))}),
+    # --- binary (broadcast and elemwise spell the same in ONNX)
+    "broadcast_add": _simple("Add"), "elemwise_add": _simple("Add"),
+    "_plus": _simple("Add"), "_Plus": _simple("Add"),
+    "broadcast_subtract": _simple("Sub"), "elemwise_sub": _simple("Sub"),
+    "broadcast_multiply": _simple("Mul"), "elemwise_mul": _simple("Mul"),
+    "broadcast_divide": _simple("Div"), "elemwise_div": _simple("Div"),
+    "broadcast_power": _simple("Pow"), "_power": _simple("Pow"),
+    "broadcast_maximum": _simple("Max"), "maximum": _simple("Max"),
+    "broadcast_minimum": _simple("Min"), "minimum": _simple("Min"),
+    "broadcast_equal": _simple("Equal"),
+    "broadcast_greater": _simple("Greater"),
+    "broadcast_lesser": _simple("Less"),
+    "broadcast_logical_and": _simple("And"),
+    "broadcast_logical_or": _simple("Or"),
+    "broadcast_logical_xor": _simple("Xor"),
+    "broadcast_mod": _simple("Mod"),
+    "add_n": _simple("Sum"),
+    "dot": _simple("MatMul"), "batch_dot": _simple("MatMul"),
+    "linalg_gemm2": _simple("MatMul"),
+    "where": _simple("Where"),
+    # --- reductions
+    "sum": _simple("ReduceSum", _reduce_attrs),
+    "mean": _simple("ReduceMean", _reduce_attrs),
+    "max": _simple("ReduceMax", _reduce_attrs),
+    "min": _simple("ReduceMin", _reduce_attrs),
+    "prod": _simple("ReduceProd", _reduce_attrs),
+    "norm": _simple("ReduceL2", _reduce_attrs),
+    "argmax": _simple("ArgMax", lambda a: {
+        "axis": int(a.get("axis", 0)),
+        "keepdims": int(bool(a.get("keepdims", False)))}),
+    "argmin": _simple("ArgMin", lambda a: {
+        "axis": int(a.get("axis", 0)),
+        "keepdims": int(bool(a.get("keepdims", False)))}),
+    # --- shape manipulation
+    "Reshape": _simple("Reshape", lambda a: {"shape": list(a.get("shape",
+                                                                 ()))}),
+    "reshape": _simple("Reshape", lambda a: {"shape": list(a.get("shape",
+                                                                 ()))}),
+    "transpose": _simple("Transpose", lambda a: {
+        "perm": list(a.get("axes", ()))}),
+    "expand_dims": _simple("Unsqueeze", lambda a: {
+        "axes": [int(a.get("axis", 0))]}),
+    "squeeze": _simple("Squeeze", lambda a: (
+        {"axes": [a["axis"]] if not isinstance(a.get("axis"), (list, tuple))
+         else list(a["axis"])} if a.get("axis") is not None else {})),
+    "slice_axis": _simple("Slice", lambda a: {
+        "axes": [int(a.get("axis", 0))],
+        "starts": [int(a.get("begin", 0))],
+        "ends": [int(a["end"]) if a.get("end") is not None else 2 ** 31]}),
+    "SliceChannel": _simple("Split", lambda a: {
+        "axis": int(a.get("axis", 1)),
+        "num_outputs": int(a.get("num_outputs", 1))}),
+    "tile": _simple("Tile", lambda a: {"repeats": list(a.get("reps", ()))}),
+    "broadcast_to": _simple("Expand", lambda a: {
+        "shape": list(a.get("shape", ()))}),
+    "stack": _simple("ConcatFromSequence", lambda a: {
+        "axis": int(a.get("axis", 0)), "new_axis": 1}),
+    "take": _simple("Gather", lambda a: {"axis": int(a.get("axis", 0))}),
+    "Cast": _simple("Cast", lambda a: {"to": str(a.get("dtype",
+                                                       "float32"))}),
+    "cast": _simple("Cast", lambda a: {"to": str(a.get("dtype",
+                                                       "float32"))}),
+    "shape_array": _simple("Shape"), "size_array": _simple("Size"),
+    "depth_to_space": _simple("DepthToSpace", lambda a: {
+        "blocksize": int(a.get("block_size", 2))}),
+    "space_to_depth": _simple("SpaceToDepth", lambda a: {
+        "blocksize": int(a.get("block_size", 2))}),
+    "topk": _simple("TopK", lambda a: {"axis": int(a.get("axis", -1)),
+                                       "k": int(a.get("k", 1))}),
+    # --- random
+    "_random_uniform": _simple("RandomUniform", lambda a: {
+        "low": float(a.get("low", 0.0)), "high": float(a.get("high", 1.0))}),
+    "_random_normal": _simple("RandomNormal", lambda a: {
+        "mean": float(a.get("loc", 0.0)),
+        "scale": float(a.get("scale", 1.0))}),
+    "_sample_multinomial": _simple("Multinomial", lambda a: {}),
 }
 
 _ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-            "softrelu": "Softplus"}
+            "softrelu": "Softplus", "softsign": "Softsign"}
+_LEAKY_MAP = {"leaky": "LeakyRelu", "elu": "Elu", "prelu": "PRelu",
+              "selu": "Selu", "gelu": "Gelu"}
+
+# mx scalar ops -> ONNX binary op + (scalar, reverse) handling
+_SCALAR_OPS = {
+    "_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+    "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+    "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+    "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
+    "_maximum_scalar": ("Max", False), "_minimum_scalar": ("Min", False),
+    "_equal_scalar": ("Equal", False), "_greater_scalar": ("Greater", False),
+    "_lesser_scalar": ("Less", False), "_mod_scalar": ("Mod", False),
+}
 
 
-def _translate_node(node, input_names):
+def _translate_node(node, input_names, num_outputs=1):
+    """Returns a LIST of ONNX node dicts; the last node's outputs are the
+    translated values (multi-node lowerings mirror the reference's
+    converter structure for scalar ops)."""
     op = node["op"]
     attrs = node.get("attrs", {})
+    name = node["name"]
+    if num_outputs > 1:
+        outs = ["%s_output%d" % (name, i) for i in range(num_outputs)]
+    else:
+        outs = [name + "_output"]
+    if op in _SCALAR_OPS:
+        onnx_op, reverse = _SCALAR_OPS[op]
+        cname = name + "_const"
+        const = {"op_type": "Constant", "name": cname, "inputs": [],
+                 "outputs": [cname + "_output"],
+                 "attributes": {"value": float(attrs.get("scalar", 0.0))}}
+        ins = ([cname + "_output"] + input_names) if reverse \
+            else (input_names + [cname + "_output"])
+        return [const, {"op_type": onnx_op, "name": name, "inputs": ins,
+                        "outputs": [name + "_output"], "attributes": {}}]
     if op == "Activation":
         onnx_op = _ACT_MAP.get(attrs.get("act_type", "relu"), "Relu")
         onnx_attrs = {}
+    elif op == "LeakyReLU":
+        onnx_op = _LEAKY_MAP.get(attrs.get("act_type", "leaky"), "LeakyRelu")
+        onnx_attrs = {} if onnx_op in ("Selu", "Gelu", "PRelu") \
+            else {"alpha": float(attrs.get("slope", 0.25))}
+    elif op == "square":
+        return [{"op_type": "Mul", "name": name,
+                 "inputs": input_names + input_names,
+                 "outputs": [name + "_output"], "attributes": {}}]
     elif op in MX2ONNX_OPS:
         onnx_op, fn = MX2ONNX_OPS[op]
-        if op == "Pooling" and attrs.get("pool_type") == "avg":
-            onnx_op = "AveragePool"
-        if op == "Pooling" and attrs.get("global_pool"):
-            onnx_op = "GlobalMaxPool" if attrs.get("pool_type", "max") == "max" \
-                else "GlobalAveragePool"
+        if op == "Pooling":
+            if attrs.get("global_pool"):
+                onnx_op = "GlobalMaxPool" \
+                    if attrs.get("pool_type", "max") == "max" \
+                    else "GlobalAveragePool"
+                return [{"op_type": onnx_op, "name": name,
+                         "inputs": input_names,
+                         "outputs": outs, "attributes": {}}]
+            if attrs.get("pool_type") == "avg":
+                onnx_op = "AveragePool"
         onnx_attrs = fn(attrs)
     else:
         raise NotImplementedError("no ONNX translation for op %r" % op)
-    return {"op_type": onnx_op, "name": node["name"],
-            "inputs": input_names, "outputs": [node["name"] + "_output"],
-            "attributes": onnx_attrs}
+    return [{"op_type": onnx_op, "name": name, "inputs": input_names,
+             "outputs": outs, "attributes": onnx_attrs}]
 
 
-def symbol_to_onnx_graph(sym, params=None):
-    """Translate a Symbol DAG into an ONNX-style graph dict."""
-    from ...symbol import Symbol
+def symbol_to_onnx_graph(sym, params=None, embed_params=True):
+    """Translate a Symbol DAG into an ONNX-style graph dict. Parameter
+    data is embedded base64(float32-le) when `embed_params`."""
     nodes = sym._topo()
     name_of = {}
     onnx_nodes = []
     initializers = []
     inputs = []
+    emitted = {}
     params = params or {}
     for n in nodes:
         if n._op is None:
-            out_name = n._name
-            name_of[id(n)] = out_name
+            name_of[id(n)] = n._name
             if n._name in params:
-                arr = params[n._name]
-                initializers.append({
-                    "name": n._name,
-                    "dims": list(arr.shape),
-                    "data_type": "FLOAT",
-                })
+                arr = _np.ascontiguousarray(_np.asarray(params[n._name],
+                                                        _np.float32))
+                init = {"name": n._name, "dims": list(arr.shape),
+                        "data_type": "FLOAT"}
+                if embed_params:
+                    init["data_b64"] = base64.b64encode(
+                        arr.tobytes()).decode("ascii")
+                initializers.append(init)
             else:
                 inputs.append({"name": n._name})
             continue
         if n._op == "_group":
             continue
-        in_names = [name_of[id(i)] for i in n._inputs]
-        jnode = {"op": n._op, "name": n._name,
-                 "attrs": {k: v for k, v in n._attrs.items()
-                           if not k.startswith("__")}}
-        onnx_node = _translate_node(jnode, in_names)
-        onnx_nodes.append(onnx_node)
-        name_of[id(n)] = onnx_node["outputs"][0]
+        # multi-output views (SliceChannel parts, topk pairs) share one
+        # underlying node: translate it ONCE and route each view to its
+        # own output name — re-emitting would silently wire every
+        # consumer to output 0
+        if n._name in emitted:
+            outs = emitted[n._name]
+        else:
+            in_names = [name_of[id(i)] for i in n._inputs]
+            jnode = {"op": n._op, "name": n._name,
+                     "attrs": {k: v for k, v in n._attrs.items()
+                               if not k.startswith("__")}}
+            new_nodes = _translate_node(jnode, in_names,
+                                        getattr(n, "_num_outputs", 1))
+            onnx_nodes.extend(new_nodes)
+            outs = new_nodes[-1]["outputs"]
+            emitted[n._name] = outs
+        name_of[id(n)] = outs[n._out_index or 0]
     outputs = [{"name": name_of[id(nodes[-1])]}]
     return {"ir_version": 8, "opset": 13,
             "graph": {"node": onnx_nodes, "input": inputs,
                       "initializer": initializers, "output": outputs}}
 
 
-def block_to_onnx_graph(block, input_names=("data",)):
+def block_to_onnx_graph(block, input_names=("data",), embed_params=True):
     from ...symbol import block_to_json, load_json
     sym = load_json(block_to_json(block, input_names))
     params = {p.name: p.data().asnumpy()
               for p in block.collect_params().values() if p._data is not None}
-    return symbol_to_onnx_graph(sym, params)
+    return symbol_to_onnx_graph(sym, params, embed_params=embed_params)
 
 
 def export_model(sym_or_block, params=None, input_shape=None, onnx_file=None,
                  **kwargs):
-    """reference: onnx_mxnet.export_model. Writes JSON graph (always) and
-    protobuf when the onnx package is importable."""
+    """reference: onnx_mxnet.export_model. Writes the JSON graph (with
+    embedded parameters) when `onnx_file` is given; returns the graph."""
     from ...gluon.block import HybridBlock
     if isinstance(sym_or_block, HybridBlock):
         graph = block_to_onnx_graph(sym_or_block)
     else:
         graph = symbol_to_onnx_graph(sym_or_block, params)
     if onnx_file:
-        try:
-            import onnx  # noqa: F401
-            raise NotImplementedError(
-                "protobuf serialization: install hook pending")
-        except ImportError:
-            with open(onnx_file, "w") as f:
-                json.dump(graph, f, indent=1, default=str)
+        with open(onnx_file, "w") as f:
+            json.dump(graph, f, default=str)
     return graph

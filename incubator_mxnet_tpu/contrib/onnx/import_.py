@@ -1,55 +1,181 @@
 """Import: ONNX graph dict -> Symbol (onnx2mx direction).
 
-Reference parity: python/mxnet/contrib/onnx/onnx2mx (per-op translation +
-import_model returning (sym, arg_params, aux_params)).
+Reference parity: python/mxnet/contrib/onnx/onnx2mx/_op_translations.py
+(per-op translation + import_model returning (sym, arg_params,
+aux_params)). Accepts this framework's exported JSON graphs (including
+base64-embedded parameter data) and plain dict graphs of the same shape.
 """
 
+import base64
 import json
 
 import numpy as _np
 
 __all__ = ["import_model", "onnx_graph_to_symbol", "ONNX2MX_OPS"]
 
+
+def _pool(kind):
+    def attrs(a):
+        return {"kernel": tuple(a.get("kernel_shape", ())),
+                "stride": tuple(a.get("strides", (1, 1))),
+                "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]),
+                "pool_type": kind}
+    return attrs
+
+
+def _reduce(a):
+    out = {"keepdims": bool(a.get("keepdims", 0))}
+    if a.get("axes") is not None:
+        out["axis"] = tuple(a["axes"])
+    return out
+
+
+# ONNX op -> (mx op, attr translation)
 ONNX2MX_OPS = {
+    # --- layers
     "Gemm": ("FullyConnected", lambda a: {}),
+    "MatMul": ("dot", lambda a: {}),
     "Conv": ("Convolution", lambda a: {
         "kernel": tuple(a.get("kernel_shape", ())),
         "stride": tuple(a.get("strides", (1, 1))),
         "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]),
+        "dilate": tuple(a.get("dilations", (1, 1))),
         "num_group": a.get("group", 1)}),
-    "Relu": ("relu", lambda a: {}),
-    "Sigmoid": ("sigmoid", lambda a: {}),
-    "Tanh": ("tanh", lambda a: {}),
-    "Softmax": ("softmax", lambda a: {"axis": a.get("axis", -1)}),
+    "ConvTranspose": ("Deconvolution", lambda a: {
+        "kernel": tuple(a.get("kernel_shape", ())),
+        "stride": tuple(a.get("strides", (1, 1))),
+        "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]),
+        "num_group": a.get("group", 1)}),
     "BatchNormalization": ("BatchNorm", lambda a: {
         "eps": a.get("epsilon", 1e-5), "momentum": a.get("momentum", 0.9)}),
-    "MaxPool": ("Pooling", lambda a: {
-        "kernel": tuple(a.get("kernel_shape", ())),
-        "stride": tuple(a.get("strides", (1, 1))),
-        "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]), "pool_type": "max"}),
-    "AveragePool": ("Pooling", lambda a: {
-        "kernel": tuple(a.get("kernel_shape", ())),
-        "stride": tuple(a.get("strides", (1, 1))),
-        "pad": tuple(a.get("pads", (0, 0, 0, 0))[:2]), "pool_type": "avg"}),
+    "InstanceNormalization": ("InstanceNorm", lambda a: {
+        "eps": a.get("epsilon", 1e-5)}),
+    "LayerNormalization": ("LayerNorm", lambda a: {
+        "eps": a.get("epsilon", 1e-5), "axis": a.get("axis", -1)}),
+    "LRN": ("LRN", lambda a: {"nsize": a.get("size", 5),
+                              "alpha": a.get("alpha", 1e-4),
+                              "beta": a.get("beta", 0.75),
+                              "knorm": a.get("bias", 2.0)}),
+    "LpNormalization": ("L2Normalization", lambda a: {}),
+    "MaxPool": ("Pooling", _pool("max")),
+    "AveragePool": ("Pooling", _pool("avg")),
     "GlobalAveragePool": ("Pooling", lambda a: {"global_pool": True,
                                                 "pool_type": "avg"}),
     "GlobalMaxPool": ("Pooling", lambda a: {"global_pool": True,
                                             "pool_type": "max"}),
-    "Flatten": ("Flatten", lambda a: {}),
-    "Add": ("broadcast_add", lambda a: {}),
-    "Mul": ("broadcast_multiply", lambda a: {}),
-    "Sub": ("broadcast_subtract", lambda a: {}),
-    "Div": ("broadcast_divide", lambda a: {}),
-    "MatMul": ("dot", lambda a: {}),
-    "Concat": ("Concat", lambda a: {"dim": a.get("axis", 1)}),
+    "MaxRoiPool": ("ROIPooling", lambda a: {
+        "pooled_size": tuple(a.get("pooled_shape", ())),
+        "spatial_scale": a.get("spatial_scale", 1.0)}),
     "Dropout": ("Dropout", lambda a: {"p": a.get("ratio", 0.5)}),
-    "Transpose": ("transpose", lambda a: {"axes": tuple(a.get("perm", ()))}),
+    "Flatten": ("Flatten", lambda a: {}),
+    "Identity": ("identity", lambda a: {}),
+    "Concat": ("Concat", lambda a: {"dim": a.get("axis", 1)}),
+    "Pad": ("pad", lambda a: {"mode": a.get("mode", "constant"),
+                              "pad_width": tuple(a.get("pads", ())),
+                              "constant_value": a.get("value", 0.0)}),
+    "ConcatFromSequence": ("stack", lambda a: {"axis": a.get("axis", 0)}),
+    # --- activations
+    "Relu": ("relu", lambda a: {}),
+    "Sigmoid": ("sigmoid", lambda a: {}),
+    "Tanh": ("tanh", lambda a: {}),
+    "Softplus": ("Activation", lambda a: {"act_type": "softrelu"}),
+    "Softsign": ("softsign", lambda a: {}),
     "LeakyRelu": ("LeakyReLU", lambda a: {"act_type": "leaky",
                                           "slope": a.get("alpha", 0.01)}),
-    "Gather": ("take", lambda a: {}),
-    "Reshape": ("Reshape", lambda a: {}),
-    "Identity": ("identity", lambda a: {}),
+    "Elu": ("LeakyReLU", lambda a: {"act_type": "elu",
+                                    "slope": a.get("alpha", 1.0)}),
+    "PRelu": ("LeakyReLU", lambda a: {"act_type": "prelu"}),
+    "Selu": ("LeakyReLU", lambda a: {"act_type": "selu"}),
+    "Gelu": ("LeakyReLU", lambda a: {"act_type": "gelu"}),
+    "HardSigmoid": ("hard_sigmoid", lambda a: {
+        "alpha": a.get("alpha", 0.2), "beta": a.get("beta", 0.5)}),
+    "Softmax": ("softmax", lambda a: {"axis": a.get("axis", -1)}),
+    "LogSoftmax": ("log_softmax", lambda a: {"axis": a.get("axis", -1)}),
+    # --- elementwise math
+    "Abs": ("abs", lambda a: {}), "Ceil": ("ceil", lambda a: {}),
+    "Floor": ("floor", lambda a: {}), "Exp": ("exp", lambda a: {}),
+    "Log": ("log", lambda a: {}), "Sqrt": ("sqrt", lambda a: {}),
+    "Neg": ("negative", lambda a: {}),
+    "Reciprocal": ("reciprocal", lambda a: {}),
+    "Cos": ("cos", lambda a: {}), "Sin": ("sin", lambda a: {}),
+    "Tan": ("tan", lambda a: {}), "Acos": ("arccos", lambda a: {}),
+    "Asin": ("arcsin", lambda a: {}), "Atan": ("arctan", lambda a: {}),
+    "Erf": ("erf", lambda a: {}), "Sign": ("sign", lambda a: {}),
+    "Round": ("round", lambda a: {}), "Not": ("logical_not", lambda a: {}),
+    "Clip": ("clip", lambda a: {"a_min": a.get("min", float("-inf")),
+                                "a_max": a.get("max", float("inf"))}),
+    "Pow": ("broadcast_power", lambda a: {}),
+    # --- binary
+    "Add": ("broadcast_add", lambda a: {}),
+    "Sub": ("broadcast_subtract", lambda a: {}),
+    "Mul": ("broadcast_multiply", lambda a: {}),
+    "Div": ("broadcast_divide", lambda a: {}),
+    "Max": ("broadcast_maximum", lambda a: {}),
+    "Min": ("broadcast_minimum", lambda a: {}),
+    "Sum": ("add_n", lambda a: {}),
+    "Equal": ("broadcast_equal", lambda a: {}),
+    "Greater": ("broadcast_greater", lambda a: {}),
+    "Less": ("broadcast_lesser", lambda a: {}),
+    "And": ("broadcast_logical_and", lambda a: {}),
+    "Or": ("broadcast_logical_or", lambda a: {}),
+    "Xor": ("broadcast_logical_xor", lambda a: {}),
+    "Mod": ("broadcast_mod", lambda a: {}),
+    "Where": ("where", lambda a: {}),
+    # --- reductions
+    "ReduceSum": ("sum", _reduce), "ReduceMean": ("mean", _reduce),
+    "ReduceMax": ("max", _reduce), "ReduceMin": ("min", _reduce),
+    "ReduceProd": ("prod", _reduce), "ReduceL2": ("norm", _reduce),
+    "ArgMax": ("argmax", lambda a: {"axis": a.get("axis", 0),
+                                    "keepdims": bool(a.get("keepdims", 0))}),
+    "ArgMin": ("argmin", lambda a: {"axis": a.get("axis", 0),
+                                    "keepdims": bool(a.get("keepdims", 0))}),
+    # --- shape manipulation
+    "Reshape": ("Reshape", lambda a: (
+        {"shape": tuple(a["shape"])} if a.get("shape") else {})),
+    "Transpose": ("transpose", lambda a: {"axes": tuple(a.get("perm", ()))}),
+    "Unsqueeze": ("expand_dims", lambda a: {
+        "axis": (a.get("axes") or [0])[0]}),
+    "Squeeze": ("squeeze", lambda a: (
+        {"axis": tuple(a["axes"])} if a.get("axes") else {})),
+    "Slice": ("slice_axis", lambda a: {
+        "axis": (a.get("axes") or [0])[0],
+        "begin": (a.get("starts") or [0])[0],
+        "end": (a.get("ends") or [None])[0]}),
+    "Split": ("SliceChannel", lambda a: {
+        "axis": a.get("axis", 1),
+        "num_outputs": a.get("num_outputs", 1)}),
+    "Tile": ("tile", lambda a: {"reps": tuple(a.get("repeats", ()))}),
+    "Expand": ("broadcast_to", lambda a: {
+        "shape": tuple(a.get("shape", ()))}),
+    "Gather": ("take", lambda a: {"axis": a.get("axis", 0)}),
+    "Cast": ("Cast", lambda a: {"dtype": a.get("to", "float32")}),
+    "Shape": ("shape_array", lambda a: {}),
+    "Size": ("size_array", lambda a: {}),
+    "DepthToSpace": ("depth_to_space", lambda a: {
+        "block_size": a.get("blocksize", 2)}),
+    "SpaceToDepth": ("space_to_depth", lambda a: {
+        "block_size": a.get("blocksize", 2)}),
+    "TopK": ("topk", lambda a: {"axis": a.get("axis", -1),
+                                "k": a.get("k", 1)}),
+    # --- random
+    "RandomUniform": ("_random_uniform", lambda a: {
+        "low": a.get("low", 0.0), "high": a.get("high", 1.0)}),
+    "RandomNormal": ("_random_normal", lambda a: {
+        "loc": a.get("mean", 0.0), "scale": a.get("scale", 1.0)}),
+    "Multinomial": ("_sample_multinomial", lambda a: {}),
 }
+
+
+def _init_array(init):
+    """Initializer payload: base64(float32-le) preferred, plain list
+    fallback."""
+    dims = tuple(init.get("dims", (-1,)))
+    if "data_b64" in init:
+        buf = base64.b64decode(init["data_b64"])
+        return _np.frombuffer(buf, dtype="<f4").reshape(dims).copy()
+    if "data" in init:
+        return _np.asarray(init["data"], dtype=_np.float32).reshape(dims)
+    return None
 
 
 def onnx_graph_to_symbol(graph):
@@ -58,29 +184,75 @@ def onnx_graph_to_symbol(graph):
     g = graph["graph"] if "graph" in graph else graph
     sym_of = {}
     params = {}
+    consts = {}
     for inp in g.get("input", []):
         sym_of[inp["name"]] = var(inp["name"])
     for init in g.get("initializer", []):
         sym_of[init["name"]] = var(init["name"])
-        if "data" in init:
-            params[init["name"]] = _np.asarray(init["data"], dtype=_np.float32) \
-                .reshape(init.get("dims", (-1,)))
+        arr = _init_array(init)
+        if arr is not None:
+            params[init["name"]] = arr
     for node in g.get("node", []):
         op_type = node["op_type"]
+        if op_type == "Constant":
+            # scalar constants from the export's multi-node lowerings
+            out = node["outputs"][0]
+            consts[out] = node.get("attributes", {}).get("value", 0.0)
+            continue
         if op_type not in ONNX2MX_OPS:
             raise NotImplementedError("no import translation for ONNX op %r"
                                       % op_type)
         mx_op, attr_fn = ONNX2MX_OPS[op_type]
         attrs = attr_fn(node.get("attributes", {}))
-        inputs = [sym_of[i] for i in node["inputs"]]
+        # a Constant input folds back into the scalar form of the op
+        in_names = list(node["inputs"])
+        scalar = None
+        for i, nm in enumerate(in_names):
+            if nm in consts:
+                scalar = (i, consts[nm])
+        if scalar is not None:
+            idx, val = scalar
+            in_names = [nm for nm in in_names if nm not in consts]
+            mx_op, attrs = _scalar_form(op_type, idx == 0, val, attrs)
+        inputs = [sym_of[i] for i in in_names]
         if op_type == "Gemm":
             attrs["num_hidden"] = 0  # resolved at bind from weight shape
+        n_out = len(node["outputs"])
         out = Symbol(_resolve_opname(mx_op), node.get("name", mx_op),
-                     inputs, attrs)
-        for out_name in node["outputs"]:
-            sym_of[out_name] = out
+                     inputs, attrs, num_outputs=n_out)
+        for i, out_name in enumerate(node["outputs"]):
+            sym_of[out_name] = out[i] if n_out > 1 else out
     out_name = g["output"][0]["name"]
     return sym_of[out_name], params
+
+
+_SCALAR_BACK = {"Add": "_plus_scalar", "Sub": "_minus_scalar",
+                "Mul": "_mul_scalar", "Div": "_div_scalar",
+                "Pow": "_power_scalar", "Max": "_maximum_scalar",
+                "Min": "_minimum_scalar", "Equal": "_equal_scalar",
+                "Greater": "_greater_scalar", "Less": "_lesser_scalar",
+                "Mod": "_mod_scalar"}
+# const-first forms: reversed ops where they exist, MIRRORED comparisons
+# (Greater(c, x) == x < c), commutative ops unchanged — never silently
+# fall back to the unreversed op for a non-commutative one
+_SCALAR_BACK_REV = {"Sub": "_rminus_scalar", "Div": "_rdiv_scalar",
+                    "Pow": "_rpower_scalar",
+                    "Greater": "_lesser_scalar", "Less": "_greater_scalar",
+                    "Add": "_plus_scalar", "Mul": "_mul_scalar",
+                    "Max": "_maximum_scalar", "Min": "_minimum_scalar",
+                    "Equal": "_equal_scalar"}
+
+
+def _scalar_form(onnx_op, const_first, value, attrs):
+    table = _SCALAR_BACK_REV if const_first else _SCALAR_BACK
+    mx_op = table.get(onnx_op)
+    if mx_op is None:
+        raise NotImplementedError(
+            "constant-%s-input %s has no scalar form"
+            % ("first" if const_first else "second", onnx_op))
+    out = dict(attrs)
+    out["scalar"] = value
+    return mx_op, out
 
 
 def _resolve_opname(name):
@@ -94,5 +266,13 @@ def import_model(model_file):
         graph = json.load(f)
     sym, params = onnx_graph_to_symbol(graph)
     from ...ndarray import array
-    arg_params = {k: array(v) for k, v in params.items()}
-    return sym, arg_params, {}
+    arg_params = {k: array(v) for k, v in params.items()
+                  if not _is_aux_name(k)}
+    aux_params = {k: array(v) for k, v in params.items()
+                  if _is_aux_name(k)}
+    return sym, arg_params, aux_params
+
+
+def _is_aux_name(name):
+    return name.endswith(("running_mean", "running_var", "moving_mean",
+                          "moving_var"))
