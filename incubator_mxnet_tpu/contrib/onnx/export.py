@@ -81,10 +81,13 @@ MX2ONNX_OPS = {
     "Flatten": _simple("Flatten", lambda a: {"axis": 1}),
     "Embedding": _simple("Gather", lambda a: {}),
     "Concat": _simple("Concat", lambda a: {"axis": int(a.get("dim", 1))}),
-    "Pad": _simple("Pad", lambda a: {"mode": a.get("mode", "constant"),
-                                     "pads": list(a.get("pad_width", ())),
-                                     "value": float(a.get("constant_value",
-                                                          0.0))}),
+    # mx pad_width interleaves (before, after) per axis; ONNX pads is all
+    # begins then all ends
+    "Pad": _simple("Pad", lambda a: {
+        "mode": a.get("mode", "constant"),
+        "pads": (list(a.get("pad_width", ())[0::2])
+                 + list(a.get("pad_width", ())[1::2])),
+        "value": float(a.get("constant_value", 0.0))}),
     "ROIPooling": _simple("MaxRoiPool", lambda a: {
         "pooled_shape": list(a.get("pooled_size", ())),
         "spatial_scale": float(a.get("spatial_scale", 1.0))}),
@@ -115,8 +118,10 @@ MX2ONNX_OPS = {
     "arctan": _simple("Atan"), "erf": _simple("Erf"),
     "sign": _simple("Sign"), "round": _simple("Round"),
     "logical_not": _simple("Not"),
-    "clip": _simple("Clip", lambda a: {"min": float(a.get("a_min", 0.0)),
-                                       "max": float(a.get("a_max", 0.0))}),
+    # absent bounds stay absent (ONNX Clip treats missing min/max as open)
+    "clip": _simple("Clip", lambda a: {
+        k: float(a[src]) for k, src in (("min", "a_min"), ("max", "a_max"))
+        if a.get(src) is not None}),
     # --- binary (broadcast and elemwise spell the same in ONNX)
     "broadcast_add": _simple("Add"), "elemwise_add": _simple("Add"),
     "_plus": _simple("Add"), "_Plus": _simple("Add"),

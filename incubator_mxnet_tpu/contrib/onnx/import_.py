@@ -70,9 +70,14 @@ ONNX2MX_OPS = {
     "Flatten": ("Flatten", lambda a: {}),
     "Identity": ("identity", lambda a: {}),
     "Concat": ("Concat", lambda a: {"dim": a.get("axis", 1)}),
-    "Pad": ("pad", lambda a: {"mode": a.get("mode", "constant"),
-                              "pad_width": tuple(a.get("pads", ())),
-                              "constant_value": a.get("value", 0.0)}),
+    # ONNX pads = begins then ends; mx pad_width interleaves per axis
+    "Pad": ("pad", lambda a: {
+        "mode": a.get("mode", "constant"),
+        "pad_width": tuple(v for pair in zip(
+            a.get("pads", ())[:len(a.get("pads", ())) // 2],
+            a.get("pads", ())[len(a.get("pads", ())) // 2:])
+            for v in pair),
+        "constant_value": a.get("value", 0.0)}),
     "ConcatFromSequence": ("stack", lambda a: {"axis": a.get("axis", 0)}),
     # --- activations
     "Relu": ("relu", lambda a: {}),
@@ -137,6 +142,7 @@ ONNX2MX_OPS = {
         "axis": (a.get("axes") or [0])[0]}),
     "Squeeze": ("squeeze", lambda a: (
         {"axis": tuple(a["axes"])} if a.get("axes") else {})),
+    # single-axis form; multi-axis Slice is chained in onnx_graph_to_symbol
     "Slice": ("slice_axis", lambda a: {
         "axis": (a.get("axes") or [0])[0],
         "begin": (a.get("starts") or [0])[0],
@@ -199,22 +205,50 @@ def onnx_graph_to_symbol(graph):
             out = node["outputs"][0]
             consts[out] = node.get("attributes", {}).get("value", 0.0)
             continue
+        a = node.get("attributes", {})
+        if op_type in ("Slice", "Unsqueeze") and len(a.get("axes") or []) > 1:
+            # multi-axis forms chain one mx op per axis
+            cur = sym_of[node["inputs"][0]]
+            if op_type == "Slice":
+                for ax, st, en in zip(a["axes"], a.get("starts", []),
+                                      a.get("ends", [])):
+                    cur = Symbol(_resolve_opname("slice_axis"),
+                                 "%s_ax%d" % (node.get("name", "slice"), ax),
+                                 [cur], {"axis": ax, "begin": st, "end": en})
+            else:       # Unsqueeze: insert in ascending output order
+                for ax in sorted(a["axes"]):
+                    cur = Symbol(_resolve_opname("expand_dims"),
+                                 "%s_ax%d" % (node.get("name", "unsq"), ax),
+                                 [cur], {"axis": ax})
+            sym_of[node["outputs"][0]] = cur
+            continue
         if op_type not in ONNX2MX_OPS:
             raise NotImplementedError("no import translation for ONNX op %r"
                                       % op_type)
         mx_op, attr_fn = ONNX2MX_OPS[op_type]
-        attrs = attr_fn(node.get("attributes", {}))
-        # a Constant input folds back into the scalar form of the op
+        attrs = attr_fn(a)
         in_names = list(node["inputs"])
-        scalar = None
-        for i, nm in enumerate(in_names):
-            if nm in consts:
-                scalar = (i, consts[nm])
-        if scalar is not None:
-            idx, val = scalar
-            in_names = [nm for nm in in_names if nm not in consts]
+        const_idx = [i for i, nm in enumerate(in_names) if nm in consts]
+        foldable = (len(const_idx) == 1 and len(in_names) == 2
+                    and op_type in (_SCALAR_BACK_REV if const_idx[0] == 0
+                                    else _SCALAR_BACK))
+        if foldable:
+            # exactly one constant on a binary op: fold to the scalar form
+            idx = const_idx[0]
+            val = consts[in_names[idx]]
+            in_names = [nm for i, nm in enumerate(in_names)
+                        if i != idx]
             mx_op, attrs = _scalar_form(op_type, idx == 0, val, attrs)
-        inputs = [sym_of[i] for i in in_names]
+            inputs = [sym_of[i] for i in in_names]
+        else:
+            # constants feeding non-foldable positions become scalar
+            # parameter tensors — never silently dropped
+            inputs = []
+            for nm in in_names:
+                if nm in consts and nm not in sym_of:
+                    sym_of[nm] = var(nm)
+                    params[nm] = _np.asarray(consts[nm], _np.float32)
+                inputs.append(sym_of[nm])
         if op_type == "Gemm":
             attrs["num_hidden"] = 0  # resolved at bind from weight shape
         n_out = len(node["outputs"])

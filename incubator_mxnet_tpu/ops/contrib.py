@@ -146,7 +146,7 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2)
         ix = (jnp.arange(pw * sr) + 0.5) / sr
         ys = y1 + iy * bin_h
         xs = x1 + ix * bin_w
-        img = data[bidx]  # (C,H,W)
+        img = jnp.take(jnp.asarray(data), bidx, axis=0)  # (C,H,W)
 
         def bilinear(c):
             y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
@@ -194,7 +194,9 @@ def quadratic(data, a=0.0, b=0.0, c=0.0):
 
 @register("index_copy", aliases=("_contrib_index_copy",))
 def index_copy(old_tensor, index_vector, new_tensor):
-    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+    old_tensor = jnp.asarray(old_tensor)
+    return old_tensor.at[jnp.asarray(index_vector).astype(jnp.int32)] \
+        .set(new_tensor)
 
 
 @register("fft", aliases=("_contrib_fft",))

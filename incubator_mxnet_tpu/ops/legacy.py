@@ -111,7 +111,8 @@ def choose_element_0index(lhs, rhs):
 @register("fill_element_0index")
 def fill_element_0index(lhs, mhs, rhs):
     """out = lhs with out[i, rhs[i]] = mhs[i] (reference: same file)."""
-    idx = rhs.astype(jnp.int32)
+    lhs = jnp.asarray(lhs)
+    idx = jnp.asarray(rhs).astype(jnp.int32)
     return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
 
 

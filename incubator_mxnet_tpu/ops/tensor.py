@@ -794,11 +794,13 @@ def _slice_spec(data, begin, end, step=None):
 @register("_slice_assign", aliases=("slice_assign",))
 def slice_assign(lhs, rhs, begin, end, step=None):
     """reference: matrix_op.cc _slice_assign — functional slice write."""
+    lhs = jnp.asarray(lhs)
     return lhs.at[_slice_spec(lhs, begin, end, step)].set(rhs)
 
 
 @register("_slice_assign_scalar", aliases=("slice_assign_scalar",))
 def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=None):
+    data = jnp.asarray(data)
     return data.at[_slice_spec(data, begin, end, step)].set(
         jnp.asarray(scalar, data.dtype))
 
@@ -832,6 +834,8 @@ def split_v2(data, indices_or_sections=1, axis=0, squeeze_axis=False,
 # advanced-index writes; dense functional equivalents)
 @register("_scatter_set_nd", aliases=("scatter_set_nd",))
 def scatter_set_nd(lhs, rhs, indices, shape=None):
+    lhs = jnp.asarray(lhs)
+    indices = jnp.asarray(indices)
     idx = tuple(indices[i] for i in range(indices.shape[0]))
     return lhs.at[idx].set(rhs)
 

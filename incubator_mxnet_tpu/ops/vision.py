@@ -311,7 +311,7 @@ def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
     _, c, h, w = data.shape
 
     def one(roi):
-        img = data[roi[0].astype(jnp.int32)]
+        img = jnp.take(jnp.asarray(data), roi[0].astype(jnp.int32), axis=0)
         x0 = jnp.round(roi[1] * spatial_scale)
         y0 = jnp.round(roi[2] * spatial_scale)
         x1 = jnp.round(roi[3] * spatial_scale)

@@ -211,3 +211,870 @@ def test_gradients_of_core_ops():
     check_numeric_gradient(
         lambda a: mx.nd.softmax(a).square().sum(),
         [RNG.randn(4).astype(np.float32)], rtol=5e-2, atol=1e-3)
+
+
+# ===========================================================================
+# r3: FULL-REGISTRY coverage ledger (VERDICT r2 #4). Every op in the
+# registry must have either a forward case below or a named home in
+# another test file; test_registry_coverage_is_complete FAILS when a new
+# op lands with no coverage anywhere.
+# ===========================================================================
+
+import jax
+import jax.numpy as jnp
+from incubator_mxnet_tpu.ops.registry import list_ops
+
+_S = RNG.randn(2, 3).astype(np.float32)
+_SP = np.abs(_S) + 0.5
+_IDX3 = np.array([0, 2, 1], np.int32)
+
+
+def _stat_check(draw, mean, std, tol):
+    """Statistical forward check for random ops: mean/std of a large draw."""
+    assert abs(float(np.mean(draw)) - mean) < tol, (np.mean(draw), mean)
+    if std is not None:
+        assert abs(float(np.std(draw)) - std) < tol, (np.std(draw), std)
+
+
+# --- scalar-operand family --------------------------------------------------
+SCALAR_CASES = {
+    "_plus_scalar": lambda: (_call("_plus_scalar", _S, scalar=2.5),
+                             _S + 2.5),
+    "_minus_scalar": lambda: (_call("_minus_scalar", _S, scalar=1.5),
+                              _S - 1.5),
+    "_rminus_scalar": lambda: (_call("_rminus_scalar", _S, scalar=1.5),
+                               1.5 - _S),
+    "_mul_scalar": lambda: (_call("_mul_scalar", _S, scalar=3.0), _S * 3),
+    "_div_scalar": lambda: (_call("_div_scalar", _S, scalar=4.0), _S / 4),
+    "_rdiv_scalar": lambda: (_call("_rdiv_scalar", _SP, scalar=2.0),
+                             2.0 / _SP),
+    "_power_scalar": lambda: (_call("_power_scalar", _SP, scalar=2.0),
+                              _SP ** 2),
+    "_rpower_scalar": lambda: (_call("_rpower_scalar", _S, scalar=2.0),
+                               2.0 ** _S),
+    "_mod_scalar": lambda: (_call("_mod_scalar", _SP, scalar=0.4),
+                            np.mod(_SP, 0.4)),
+    "_rmod_scalar": lambda: (_call("_rmod_scalar", _SP, scalar=0.7),
+                             np.mod(0.7, _SP)),
+    "_maximum_scalar": lambda: (_call("_maximum_scalar", _S, scalar=0.0),
+                                np.maximum(_S, 0)),
+    "_minimum_scalar": lambda: (_call("_minimum_scalar", _S, scalar=0.0),
+                                np.minimum(_S, 0)),
+    "_hypot_scalar": lambda: (_call("_hypot_scalar", _S, scalar=1.0),
+                              np.hypot(_S, 1.0)),
+    "_equal_scalar": lambda: (_call("_equal_scalar", _IDX3.astype(np.float32),
+                                    scalar=2.0),
+                              (_IDX3 == 2).astype(np.float32)),
+    "_not_equal_scalar": lambda: (
+        _call("_not_equal_scalar", _IDX3.astype(np.float32), scalar=2.0),
+        (_IDX3 != 2).astype(np.float32)),
+    "_greater_scalar": lambda: (_call("_greater_scalar", _S, scalar=0.0),
+                                (_S > 0).astype(np.float32)),
+    "_greater_equal_scalar": lambda: (
+        _call("_greater_equal_scalar", _S, scalar=0.0),
+        (_S >= 0).astype(np.float32)),
+    "_lesser_scalar": lambda: (_call("_lesser_scalar", _S, scalar=0.0),
+                               (_S < 0).astype(np.float32)),
+    "_lesser_equal_scalar": lambda: (
+        _call("_lesser_equal_scalar", _S, scalar=0.0),
+        (_S <= 0).astype(np.float32)),
+    "_logical_and_scalar": lambda: (
+        _call("_logical_and_scalar", (_S > 0).astype(np.float32), scalar=1.0),
+        np.logical_and(_S > 0, True).astype(np.float32)),
+    "_logical_or_scalar": lambda: (
+        _call("_logical_or_scalar", (_S > 0).astype(np.float32), scalar=0.0),
+        np.logical_or(_S > 0, False).astype(np.float32)),
+    "_logical_xor_scalar": lambda: (
+        _call("_logical_xor_scalar", (_S > 0).astype(np.float32), scalar=1.0),
+        np.logical_xor(_S > 0, True).astype(np.float32)),
+    "_scatter_plus_scalar": lambda: (
+        _call("_scatter_plus_scalar", _S, scalar=1.0), _S + 1.0),
+    "_scatter_minus_scalar": lambda: (
+        _call("_scatter_minus_scalar", _S, scalar=1.0), _S - 1.0),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCALAR_CASES),
+                         ids=sorted(SCALAR_CASES))
+def test_scalar_op_matches_numpy(name):
+    got, want = SCALAR_CASES[name]()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+# --- remaining elementwise/binary ------------------------------------------
+MISC_ELEMWISE = {
+    "erfinv": lambda: (_call("erfinv", _X01 * 0.8),
+                       __import__("scipy.special", fromlist=["x"]).erfinv(
+                           (_X01 * 0.8).astype(np.float64))),
+    "fix": lambda: (_call("fix", _S * 3), np.fix(_S * 3)),
+    "rcbrt": lambda: (_call("rcbrt", _SP), 1.0 / np.cbrt(_SP)),
+    "gamma": lambda: (_call("gamma", _SP),
+                      __import__("scipy.special", fromlist=["x"]).gamma(
+                          _SP.astype(np.float64))),
+    "gelu": lambda: (_call("gelu", _S),
+                     0.5 * _S * (1 + np.vectorize(__import__(
+                         "math").erf)(_S / np.sqrt(2)))),
+    "swish": lambda: (_call("swish", _S), _S / (1 + np.exp(-_S))),
+    "hard_sigmoid": lambda: (_call("hard_sigmoid", _S),
+                             np.clip(0.2 * _S + 0.5, 0, 1)),
+    "logical_not": lambda: (_call("logical_not", (_S > 0).astype(np.float32)),
+                            (~(_S > 0)).astype(np.float32)),
+    "broadcast_arctan2": lambda: (_call("broadcast_arctan2", _S, _SP),
+                                  np.arctan2(_S, _SP)),
+    "broadcast_mod": lambda: (_call("broadcast_mod", _SP, _SP.T.copy().T * 0.7
+                                    + 0.1),
+                              np.mod(_SP, _SP * 0.7 + 0.1)),
+    "broadcast_greater_equal": lambda: (
+        _call("broadcast_greater_equal", _S, _S.mean()),
+        (_S >= _S.mean()).astype(np.float32)),
+    "broadcast_lesser_equal": lambda: (
+        _call("broadcast_lesser_equal", _S, _S.mean()),
+        (_S <= _S.mean()).astype(np.float32)),
+    "broadcast_not_equal": lambda: (_call("broadcast_not_equal", _S, _S),
+                                    np.zeros_like(_S)),
+    "broadcast_logical_xor": lambda: (
+        _call("broadcast_logical_xor", (_S > 0).astype(np.float32),
+              (_S < 0).astype(np.float32)),
+        np.logical_xor(_S > 0, _S < 0).astype(np.float32)),
+    "broadcast_hypot": lambda: (_call("broadcast_hypot", _S, _SP),
+                                np.hypot(_S, _SP)),
+    "add_n": lambda: (_call("add_n", _S, _S, _S), 3 * _S),
+    "_grad_add": lambda: (_call("_grad_add", _S, _SP), _S + _SP),
+    "smooth_l1": lambda: (_call("smooth_l1", _S, scalar=1.0),
+                          np.where(np.abs(_S) < 1, 0.5 * _S ** 2,
+                                   np.abs(_S) - 0.5)),
+    "gradient_multiplier": lambda: (_call("gradient_multiplier", _S,
+                                          scalar=2.0), _S),
+    "quadratic": lambda: (_call("quadratic", _S, a=2.0, b=1.0, c=0.5),
+                          2 * _S ** 2 + _S + 0.5),
+    "allclose": lambda: (np.float32(_call("allclose", _S, _S)),
+                         np.float32(1.0)),
+    "identity": lambda: (_call("identity", _S), _S),
+    "BlockGrad": lambda: (_call("BlockGrad", _S), _S),
+    "make_loss": lambda: (_call("make_loss", _S), _S),
+    "_identity_with_attr_like_rhs": lambda: (
+        _call("_identity_with_attr_like_rhs", _S, _SP), _S),
+    "amp_cast": lambda: (_call("amp_cast", _S, dtype="float32"), _S),
+    "Cast": lambda: (_call("Cast", _S, dtype="float16"),
+                     _S.astype(np.float16)),
+    "_scatter_elemwise_div": lambda: (
+        _call("_scatter_elemwise_div", _S, _SP), _S / _SP),
+    "nansum": lambda: (_call("nansum", np.where(_S > 0, _S, np.nan), axis=1),
+                       np.nansum(np.where(_S > 0, _S, np.nan), axis=1)),
+    "nanprod": lambda: (
+        _call("nanprod", np.where(_S > 0, _S, np.nan), axis=1),
+        np.nanprod(np.where(_S > 0, _S, np.nan), axis=1)),
+    "_square_sum": lambda: (_call("_square_sum", _S, axis=1),
+                            (_S ** 2).sum(axis=1)),
+    "softmax_cross_entropy": lambda: (
+        _call("softmax_cross_entropy", _S, _IDX3[:2].astype(np.float32)),
+        -np.log(np.exp(_S - _S.max(1, keepdims=True))
+                / np.exp(_S - _S.max(1, keepdims=True)).sum(1, keepdims=True)
+                )[np.arange(2), _IDX3[:2]].sum()),
+    "softmin": lambda: (_call("softmin", _S, axis=-1),
+                        np.exp(-_S) / np.exp(-_S).sum(-1, keepdims=True)),
+    "log_softmax": lambda: (
+        _call("log_softmax", _S, axis=-1),
+        _S - _S.max(-1, keepdims=True)
+        - np.log(np.exp(_S - _S.max(-1, keepdims=True)).sum(-1,
+                                                            keepdims=True))),
+    "SoftmaxActivation": lambda: (
+        _call("SoftmaxActivation", _S),
+        np.exp(_S - _S.max(-1, keepdims=True))
+        / np.exp(_S - _S.max(-1, keepdims=True)).sum(-1, keepdims=True)),
+    "LinearRegressionOutput": lambda: (
+        _call("LinearRegressionOutput", _S, _SP), _S),
+    "MAERegressionOutput": lambda: (
+        _call("MAERegressionOutput", _S, _SP), _S),
+    "LogisticRegressionOutput": lambda: (
+        _call("LogisticRegressionOutput", _S, _SP), 1 / (1 + np.exp(-_S))),
+    "IdentityAttachKLSparseReg": lambda: (
+        _call("IdentityAttachKLSparseReg", _X01), _X01),
+}
+
+
+@pytest.mark.parametrize("name", sorted(MISC_ELEMWISE),
+                         ids=sorted(MISC_ELEMWISE))
+def test_misc_elemwise_matches_numpy(name):
+    got, want = MISC_ELEMWISE[name]()
+    np.testing.assert_allclose(got, np.asarray(want, np.float64),
+                               rtol=2e-4, atol=2e-5)
+
+
+# --- creation / shape / index ----------------------------------------------
+def _scatter_ref():
+    idx = np.array([[0, 2], [1, 0]], np.int32)
+    data = np.array([5.0, 7.0], np.float32)
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1] = 5.0
+    want[2, 0] = 7.0
+    return idx, data, want
+
+
+STRUCT_CASES = {
+    "arange": lambda: (_call("arange", 1, 7, step=2), np.arange(1, 7, 2,
+                                                                "float32")),
+    "eye": lambda: (_call("eye", 3, 4, k=1), np.eye(3, 4, 1, "float32")),
+    "full": lambda: (_call("full", (2, 2), 3.5), np.full((2, 2), 3.5,
+                                                         "float32")),
+    "ones": lambda: (_call("ones", shape=(2, 3)), np.ones((2, 3), "float32")),
+    "zeros": lambda: (_call("zeros", shape=(2, 3)), np.zeros((2, 3),
+                                                             "float32")),
+    "_zeros_without_dtype": lambda: (_call("_zeros_without_dtype",
+                                           shape=(2, 2)),
+                                     np.zeros((2, 2), "float32")),
+    "ones_like": lambda: (_call("ones_like", _S), np.ones_like(_S)),
+    "zeros_like": lambda: (_call("zeros_like", _S), np.zeros_like(_S)),
+    "diag": lambda: (_call("diag", _S), np.diag(_S)),
+    "shape_array": lambda: (_call("shape_array", _S),
+                            np.array([2, 3], np.int64)),
+    "size_array": lambda: (_call("size_array", _S), np.array([6], np.int64)),
+    "slice": lambda: (_call("slice", _S, begin=(0, 1), end=(2, 3)),
+                      _S[0:2, 1:3]),
+    "slice_like": lambda: (_call("slice_like", RNG.randn(4, 5)
+                                 .astype(np.float32), _S),
+                           None),
+    "reshape_like": lambda: (_call("reshape_like", _S,
+                                   np.zeros((3, 2), np.float32)),
+                             _S.reshape(3, 2)),
+    "squeeze": lambda: (_call("squeeze", _S[:, None, :]), _S),
+    "stack": lambda: (_call("stack", _S, _S, axis=1),
+                      np.stack([_S, _S], 1)),
+    "space_to_depth": lambda: (
+        _call("space_to_depth", np.arange(16, dtype=np.float32)
+              .reshape(1, 1, 4, 4), block_size=2), None),
+    "depth_to_space": lambda: (
+        _call("depth_to_space",
+              _call("space_to_depth", np.arange(16, dtype=np.float32)
+                    .reshape(1, 1, 4, 4), block_size=2), block_size=2),
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)),
+    "pad": lambda: (_call("pad", _S[None, None], mode="constant",
+                          pad_width=(0, 0, 0, 0, 1, 1, 2, 2),
+                          constant_value=0.0),
+                    np.pad(_S[None, None], ((0, 0), (0, 0), (1, 1), (2, 2)))),
+    "pick": lambda: (_call("pick", _S, _IDX3[:2].astype(np.float32), axis=1),
+                     _S[np.arange(2), _IDX3[:2]]),
+    "batch_take": lambda: (_call("batch_take", _S,
+                                 _IDX3[:2].astype(np.int32)),
+                           _S[np.arange(2), _IDX3[:2]]),
+    "choose_element_0index": lambda: (
+        _call("choose_element_0index", _S, _IDX3[:2].astype(np.float32)),
+        _S[np.arange(2), _IDX3[:2]]),
+    "fill_element_0index": lambda: (
+        _call("fill_element_0index", _S, np.array([9.0, 9.0], np.float32),
+              _IDX3[:2].astype(np.float32)), None),
+    "argmax_channel": lambda: (_call("argmax_channel", _S),
+                               _S.argmax(1).astype(np.float32)),
+    "broadcast_axis": lambda: (_call("broadcast_axis", _S[:, :1], axis=1,
+                                     size=4),
+                               np.broadcast_to(_S[:, :1], (2, 4))),
+    "broadcast_to": lambda: (_call("broadcast_to", _S[:1], shape=(4, 3)),
+                             np.broadcast_to(_S[:1], (4, 3))),
+    "broadcast_like": lambda: (_call("broadcast_like", _S[:1],
+                                     np.zeros((4, 3), np.float32)),
+                               np.broadcast_to(_S[:1], (4, 3))),
+    "scatter_nd": lambda: (_call("scatter_nd", _scatter_ref()[1],
+                                 _scatter_ref()[0], shape=(3, 3)),
+                           _scatter_ref()[2]),
+    "_scatter_set_nd": lambda: (
+        _call("_scatter_set_nd", np.ones((3, 3), np.float32),
+              _scatter_ref()[1], _scatter_ref()[0], shape=(3, 3)), None),
+    "_slice_assign": lambda: (
+        _call("_slice_assign", np.zeros((3, 3), np.float32),
+              np.ones((2, 2), np.float32), begin=(0, 0), end=(2, 2)), None),
+    "_slice_assign_scalar": lambda: (
+        _call("_slice_assign_scalar", np.zeros((3, 3), np.float32),
+              scalar=2.0, begin=(0, 0), end=(2, 2)), None),
+    "_ravel_multi_index": lambda: (
+        _call("_ravel_multi_index", np.array([[0, 1], [2, 0]], np.float32),
+              shape=(3, 4)),
+        np.ravel_multi_index(np.array([[0, 1], [2, 0]], np.int64),
+                             (3, 4)).astype(np.float32)),
+    "_unravel_index": lambda: (
+        _call("_unravel_index", np.array([2, 4], np.float32), shape=(3, 4)),
+        np.stack(np.unravel_index(np.array([2, 4]), (3, 4))
+                 ).astype(np.float32)),
+    "boolean_mask": lambda: (
+        _call("boolean_mask", _S, np.array([1, 0], np.float32)), None),
+    "index_copy": lambda: (
+        _call("index_copy", np.zeros((3, 3), np.float32),
+              np.array([1], np.int32), np.ones((1, 3), np.float32)), None),
+    "_split_v2": lambda: (
+        _call("_split_v2", _S, indices_or_sections=(1,), axis=0)[0], _S[:1]),
+    "_rnn_param_concat": lambda: (
+        _call("_rnn_param_concat", _S.ravel(), _S.ravel(), dim=0),
+        np.concatenate([_S.ravel(), _S.ravel()])),
+    "amp_multicast": lambda: (
+        _call("amp_multicast", _S, _SP, num_outputs=2)[0], _S),
+    "_histogram": lambda: (
+        _call("_histogram", _S, bins=4, range=(-2.0, 2.0))[0],
+        np.histogram(_S, bins=4, range=(-2, 2))[0].astype(np.float32)),
+    "Reshape": lambda: (_call("Reshape", _S, shape=(3, 2)),
+                        _S.reshape(3, 2)),
+    "shuffle": lambda: (np.sort(np.asarray(
+        _call("shuffle", np.arange(10, dtype=np.float32))).ravel()),
+        np.arange(10, dtype=np.float32)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(STRUCT_CASES), ids=sorted(STRUCT_CASES))
+def test_struct_op_matches_numpy(name):
+    got, want = STRUCT_CASES[name]()
+    if want is None:
+        assert np.asarray(got).size >= 0   # shape/sanity-only case
+        # targeted semantic checks for the None-ref cases
+        if name == "slice_like":
+            assert np.asarray(got).shape == (2, 3)
+        if name == "boolean_mask":
+            np.testing.assert_allclose(got, _S[:1])
+        if name == "_slice_assign":
+            assert float(np.asarray(got)[:2, :2].sum()) == 4.0
+        if name == "_slice_assign_scalar":
+            assert float(np.asarray(got)[:2, :2].sum()) == 8.0
+        if name == "_scatter_set_nd":
+            assert float(np.asarray(got)[0, 1]) == 5.0
+        if name == "index_copy":
+            assert float(np.asarray(got)[1].sum()) == 3.0
+        if name == "fill_element_0index":
+            assert float(np.asarray(got)[0, _IDX3[0]]) == 9.0
+        if name == "space_to_depth":
+            assert np.asarray(got).shape == (1, 4, 2, 2)
+        return
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# --- linalg family ----------------------------------------------------------
+def _spd(n=3):
+    a = RNG.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+
+
+def test_linalg_det_inverse_gemm():
+    a = _spd()
+    np.testing.assert_allclose(_call("linalg_det", a),
+                               np.linalg.det(a.astype(np.float64)),
+                               rtol=1e-4)
+    sign, logdet = _call("linalg_slogdet", a)
+    s2, l2 = np.linalg.slogdet(a.astype(np.float64))
+    np.testing.assert_allclose(sign, s2, rtol=1e-5)
+    np.testing.assert_allclose(logdet, l2, rtol=1e-4)
+    np.testing.assert_allclose(_call("linalg_inverse", a),
+                               np.linalg.inv(a.astype(np.float64)),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        _call("linalg_gemm2", _S, _S.T.copy()), _S @ _S.T, rtol=1e-5)
+    np.testing.assert_allclose(
+        _call("linalg_gemm", _S, _S.T.copy(), np.ones((2, 2), np.float32),
+              alpha=2.0, beta=0.5), 2 * (_S @ _S.T) + 0.5, rtol=1e-5)
+    np.testing.assert_allclose(
+        _call("linalg_sumlogdiag", a), np.log(np.diag(a)).sum(), rtol=1e-5)
+    np.testing.assert_allclose(_call("linalg_extractdiag", a), np.diag(a),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        _call("linalg_makediag", np.array([1.0, 2.0], np.float32)),
+        np.diag([1.0, 2.0]), rtol=1e-6)
+
+
+def test_linalg_factorizations():
+    a = _spd()
+    # potri: inverse from the cholesky factor
+    L = _call("linalg_potrf", a)
+    inv = _call("linalg_potri", L)
+    np.testing.assert_allclose(inv, np.linalg.inv(a.astype(np.float64)),
+                               rtol=1e-3, atol=1e-4)
+    # syevd: eigendecomposition U diag(l) U^T == a
+    U, lam = _call("linalg_syevd", a)
+    np.testing.assert_allclose(U.T @ np.diag(lam) @ U, a, rtol=1e-3,
+                               atol=1e-3)
+    # trmm: triangular matmul 2*L@x
+    x = RNG.randn(3, 3).astype(np.float32)
+    got = _call("linalg_trmm", L, x, alpha=2.0)
+    np.testing.assert_allclose(got, 2.0 * np.tril(L) @ x, rtol=1e-4,
+                               atol=1e-5)
+    # gelqf: a = L @ Q with orthonormal Q rows
+    m = RNG.randn(2, 3).astype(np.float32)
+    Lq, Q = _call("linalg_gelqf", m)
+    np.testing.assert_allclose(Lq @ Q, m, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(Q @ Q.T, np.eye(2), rtol=1e-4, atol=1e-5)
+    # trian round-trip
+    tri = _call("linalg_extracttrian", a)
+    back = _call("linalg_maketrian", tri)
+    np.testing.assert_allclose(back, np.tril(a), rtol=1e-6)
+
+
+# --- random family (statistical forward checks) -----------------------------
+def test_random_ops_statistics():
+    shape = (20000,)
+    k = jax.random.PRNGKey(3)
+    _stat_check(_call("random_uniform", low=0.0, high=1.0, shape=shape,
+                      key=k), 0.5, np.sqrt(1 / 12.0), 0.05)
+    _stat_check(_call("random_normal", loc=1.0, scale=2.0, shape=shape,
+                      key=k), 1.0, 2.0, 0.08)
+    _stat_check(_call("random_exponential", lam=2.0, shape=shape, key=k),
+                0.5, 0.5, 0.05)
+    _stat_check(_call("random_poisson", lam=3.0, shape=shape, key=k),
+                3.0, np.sqrt(3.0), 0.08)
+    _stat_check(_call("random_gamma", alpha=2.0, beta=0.5, shape=shape,
+                      key=k), 1.0, None, 0.05)
+    draw = _call("random_randint", low=0, high=10, shape=shape, key=k)
+    assert draw.min() >= 0 and draw.max() <= 9
+    _stat_check(_call("bernoulli", p=0.3, shape=shape, key=k),
+                0.3, None, 0.03)
+    nb = _call("random_negative_binomial", k=4, p=0.5, shape=shape, key=k)
+    _stat_check(nb, 4 * 0.5 / 0.5, None, 0.25)
+    gnb = _call("random_generalized_negative_binomial", mu=2.0, alpha=0.3,
+                shape=shape, key=k)
+    _stat_check(gnb, 2.0, None, 0.25)
+
+
+def test_sample_multi_ops():
+    k = jax.random.PRNGKey(5)
+    mu = np.array([0.0, 10.0], np.float32)
+    sg = np.array([1.0, 0.1], np.float32)
+    draw = _call("sample_normal_multi", mu, sg, shape=(5000,), key=k)
+    assert draw.shape == (2, 5000)
+    assert abs(draw[0].mean()) < 0.1 and abs(draw[1].mean() - 10) < 0.1
+    lam = np.array([1.0, 5.0], np.float32)
+    d = _call("sample_poisson_multi", lam, shape=(5000,), key=k)
+    assert abs(d[0].mean() - 1.0) < 0.15 and abs(d[1].mean() - 5.0) < 0.25
+    d = _call("sample_uniform_multi", np.array([0.0, 2.0], np.float32),
+              np.array([1.0, 4.0], np.float32), shape=(5000,), key=k)
+    assert abs(d[0].mean() - 0.5) < 0.05 and abs(d[1].mean() - 3.0) < 0.1
+    d = _call("sample_exponential_multi", np.array([1.0, 4.0], np.float32),
+              shape=(5000,), key=k)
+    assert abs(d[0].mean() - 1.0) < 0.1 and abs(d[1].mean() - 0.25) < 0.05
+    d = _call("sample_gamma_multi", np.array([2.0], np.float32),
+              np.array([1.0], np.float32), shape=(5000,), key=k)
+    assert abs(d[0].mean() - 2.0) < 0.15
+    d = _call("sample_negative_binomial_multi", np.array([4], np.float32),
+              np.array([0.5], np.float32), shape=(5000,), key=k)
+    assert abs(d[0].mean() - 4.0) < 0.5
+    d = _call("sample_generalized_negative_binomial_multi",
+              np.array([2.0], np.float32), np.array([0.3], np.float32),
+              shape=(5000,), key=k)
+    assert abs(d[0].mean() - 2.0) < 0.5
+    probs = np.array([[0.8, 0.2, 0.0]], np.float32)
+    d = _call("sample_multinomial", probs, shape=(2000,), key=k)
+    assert abs((np.asarray(d) == 0).mean() - 0.8) < 0.05
+
+
+# --- optimizer update ops (single-step formula checks) ----------------------
+def test_optimizer_update_op_formulas():
+    w = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.25], np.float32)
+    got = _call("sgd_update", w, g, lr=0.1, wd=0.0)
+    np.testing.assert_allclose(got, w - 0.1 * g, rtol=1e-6)
+    mom = np.zeros(2, np.float32)
+    got_w, got_m = _call("sgd_mom_update", w, g, mom, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(got_m, -0.1 * g, rtol=1e-6)
+    np.testing.assert_allclose(got_w, w - 0.1 * g, rtol=1e-6)
+    m = np.zeros(2, np.float32)
+    v = np.zeros(2, np.float32)
+    outs = _call("adam_update", w, g, m, v, lr=0.1, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8)
+    # first adam step == -lr * sign-ish update
+    assert np.all(np.abs(np.asarray(outs[0]) - w) > 0)
+    got = _call("signsgd_update", w, g, lr=0.1)
+    np.testing.assert_allclose(got, w - 0.1 * np.sign(g), rtol=1e-6)
+    st = np.zeros(2, np.float32)
+    got_w, _ = _call("signum_update", w, g, st, lr=0.1, momentum=0.9)
+    assert got_w.shape == w.shape
+    n = np.zeros(2, np.float32)
+    got_w, _ = _call("rmsprop_update", w, g, n, lr=0.1, gamma1=0.9,
+                     epsilon=1e-8)
+    np.testing.assert_allclose(
+        got_w, w - 0.1 * g / np.sqrt(0.1 * g * g + 1e-8), rtol=1e-5)
+    outs = _call("rmspropalex_update", w, g, np.zeros(2, np.float32),
+                 np.zeros(2, np.float32), np.zeros(2, np.float32), lr=0.1)
+    assert np.asarray(outs[0]).shape == w.shape
+    outs = _call("ftml_update", w, g, np.zeros(2, np.float32),
+                 np.zeros(2, np.float32), np.zeros(2, np.float32),
+                 np.zeros(2, np.float32), lr=0.1, t=1)
+    assert np.asarray(outs[0]).shape == w.shape
+    outs = _call("ftrl_update", w, g, np.zeros(2, np.float32),
+                 np.zeros(2, np.float32), lr=0.1)
+    assert np.asarray(outs[0]).shape == w.shape
+    got_w, _ = _call("nag_mom_update", w, g, np.zeros(2, np.float32), lr=0.1,
+                     momentum=0.9)
+    assert got_w.shape == w.shape
+    outs = _call("mp_sgd_update", w.astype(np.float16), g, w, lr=0.1)
+    assert np.asarray(outs[0]).dtype == np.float16
+    outs = _call("mp_sgd_mom_update", w.astype(np.float16), g,
+                 np.zeros(2, np.float32), w, lr=0.1, momentum=0.9)
+    assert np.asarray(outs[0]).dtype == np.float16
+    outs = _call("mp_nag_mom_update", w.astype(np.float16), g,
+                 np.zeros(2, np.float32), w, lr=0.1, momentum=0.9)
+    assert np.asarray(outs[0]).dtype == np.float16
+    got = _call("_adamw_update", w, g, m, v, lr=0.1, eta=1.0, wd=0.01)
+    assert np.asarray(got[0]).shape == w.shape
+    got = _call("_mp_adamw_update", w.astype(np.float16), g, m, v, w, lr=0.1,
+                eta=1.0, wd=0.01)
+    assert np.asarray(got[0]).dtype == np.float16
+    # multi-tensor forms
+    outs = _call("multi_sgd_update", w, g, w, g, lrs=(0.1, 0.1),
+                 wds=(0.0, 0.0), num_weights=2)
+    np.testing.assert_allclose(outs[0], w - 0.1 * g, rtol=1e-6)
+    outs = _call("multi_sgd_mom_update", w, g, mom, w, g, mom,
+                 lrs=(0.1, 0.1), wds=(0.0, 0.0), num_weights=2)
+    assert np.asarray(outs[0]).shape == w.shape
+    outs = _call("multi_mp_sgd_update", w.astype(np.float16), g, w,
+                 w.astype(np.float16), g, w, lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                 num_weights=2)
+    assert np.asarray(outs[0]).dtype == np.float16
+    outs = _call("multi_mp_sgd_mom_update", w.astype(np.float16), g, mom, w,
+                 w.astype(np.float16), g, mom, w, lrs=(0.1, 0.1),
+                 wds=(0.0, 0.0), num_weights=2)
+    assert np.asarray(outs[0]).dtype == np.float16
+
+
+# --- normalization / image / quantization stragglers ------------------------
+def test_instance_norm_l2norm_lrn():
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    got = _call("InstanceNorm", x, np.ones(3, np.float32),
+                np.zeros(3, np.float32), eps=1e-5)
+    mu = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    np.testing.assert_allclose(got, (x - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-4, atol=1e-5)
+    got = _call("L2Normalization", x, mode="instance")
+    flat = x.reshape(2, -1)
+    want = (flat / np.sqrt((flat ** 2).sum(1, keepdims=True) + 1e-10)) \
+        .reshape(x.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got = _call("LRN", x, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    assert got.shape == x.shape and np.isfinite(np.asarray(got)).all()
+
+
+def test_round_and_softmax_forward():
+    np.testing.assert_allclose(_call("round", _S * 3), np.round(_S * 3))
+    got = _call("softmax", _S, axis=-1)
+    e = np.exp(_S - _S.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+
+def test_image_op_battery():
+    img = (RNG.rand(6, 8, 3) * 255).astype(np.uint8)
+    t = _call("image_to_tensor", img)
+    np.testing.assert_allclose(t, img.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    n = _call("image_normalize", img.astype(np.float32),
+              mean=np.array([1.0, 2.0, 3.0], np.float32)[:, None, None]
+              .transpose(1, 2, 0) * 0 + 0.5, std=2.0)
+    np.testing.assert_allclose(n, (img - 0.5) / 2.0, rtol=1e-5)
+    np.testing.assert_allclose(_call("image_flip_left_right",
+                                     img.astype(np.float32)),
+                               img[:, ::-1].astype(np.float32))
+    np.testing.assert_allclose(_call("image_flip_top_bottom",
+                                     img.astype(np.float32)),
+                               img[::-1].astype(np.float32))
+    c = _call("image_crop", img.astype(np.float32), 1, 2, 4, 3)
+    np.testing.assert_allclose(c, img[2:5, 1:5].astype(np.float32))
+    r = _call("image_resize", img.astype(np.float32), (4, 3))
+    assert r.shape == (3, 4, 3)
+    # random jitters: shape-preserving, keyed deterministic
+    k = jax.random.PRNGKey(0)
+    for name, kw in [("image_random_brightness", dict(min_factor=0.5,
+                                                      max_factor=1.5)),
+                     ("image_random_contrast", dict(min_factor=0.5,
+                                                    max_factor=1.5)),
+                     ("image_random_saturation", dict(min_factor=0.5,
+                                                      max_factor=1.5)),
+                     ("image_random_hue", dict(hue=0.2)),
+                     ("image_random_lighting", dict(alpha_std=0.1)),
+                     ("image_random_rotate", dict(angle_limits=(-20, 20)))]:
+        out = _call(name, img.astype(np.float32), key=k, **kw)
+        assert out.shape == img.shape, name
+    np.testing.assert_allclose(
+        _call("image_adjust_hue", img.astype(np.float32), 0.0),
+        img.astype(np.float32), atol=1e-3)
+    np.testing.assert_allclose(
+        _call("image_rotate", img.astype(np.float32), 0.0),
+        img.astype(np.float32), atol=1e-3)
+
+
+def test_quantization_op_battery():
+    x = RNG.randn(2, 8).astype(np.float32)
+    q, qmin, qmax = _call("quantize_v2", x, min_calib_range=-3.0,
+                          max_calib_range=3.0)
+    assert np.asarray(q).dtype == np.int8
+    deq = _call("dequantize", q, qmin, qmax)
+    np.testing.assert_allclose(deq, np.clip(x, -3, 3), atol=0.05)
+    rq, rmin, rmax = _call("requantize", q.astype(np.int32), qmin, qmax,
+                           min_calib_range=-3.0, max_calib_range=3.0)
+    assert np.asarray(rq).dtype == np.int8
+    fq, fmin, fmax = _call("quantized_flatten", q.reshape(2, 2, 4), qmin,
+                           qmax)
+    assert np.asarray(fq).shape == (2, 8)
+    # int8 FC == fp32 FC on dequantized operands (within quant noise)
+    w = RNG.randn(4, 8).astype(np.float32)
+    qw, wmin, wmax = _call("quantize_v2", w, min_calib_range=-3.0,
+                           max_calib_range=3.0)
+    out, omin, omax = _call("quantized_fully_connected", q, qw,
+                            data_min=qmin, data_max=qmax, weight_min=wmin,
+                            weight_max=wmax, num_hidden=4)
+    # one int32 accumulator unit = (d_amax/127) * (w_amax/127)
+    scale = np.asarray(omax) / (127.0 * 127.0)
+    got = np.asarray(out, np.float64) * scale
+    want = np.clip(x, -3, 3) @ np.clip(w, -3, 3).T
+    np.testing.assert_allclose(got, want, atol=0.2)
+    # int8 conv + pooling: shapes + finite
+    xc = RNG.randn(1, 2, 6, 6).astype(np.float32)
+    wc = RNG.randn(3, 2, 3, 3).astype(np.float32)
+    qx, xmin, xmax = _call("quantize_v2", xc, min_calib_range=-3.0,
+                           max_calib_range=3.0)
+    qwc, wmn, wmx = _call("quantize_v2", wc, min_calib_range=-3.0,
+                          max_calib_range=3.0)
+    oc, cmin, cmax = _call("quantized_conv", qx, qwc, data_min=xmin,
+                           data_max=xmax, weight_min=wmn, weight_max=wmx,
+                           kernel=(3, 3), num_filter=3)
+    assert np.asarray(oc).shape == (1, 3, 4, 4)
+    op_, pmin, pmax = _call("quantized_pooling", qx, xmin, xmax,
+                            kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert np.asarray(op_).shape == (1, 2, 3, 3)
+
+
+# --- edge cases: 0-size, odd dims, broadcast --------------------------------
+def test_zero_size_and_odd_dim_edges():
+    empty = np.zeros((0, 4), np.float32)
+    assert _call("relu", empty).shape == (0, 4)
+    assert _call("sum", empty, axis=0).shape == (4,)
+    assert _call("broadcast_add", empty, np.float32(1.0)).shape == (0, 4)
+    assert _call("Concat", empty, empty, dim=0).shape == (0, 4)
+    odd = RNG.randn(3, 5, 7).astype(np.float32)
+    np.testing.assert_allclose(_call("sum", odd, axis=(0, 2)),
+                               odd.sum((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(
+        _call("broadcast_add", odd[:, :, :1], odd[:1, :1, :]),
+        odd[:, :, :1] + odd[:1, :1, :], rtol=1e-6)
+    np.testing.assert_allclose(_call("transpose", odd, axes=(1, 2, 0)),
+                               odd.transpose(1, 2, 0), rtol=1e-6)
+
+
+# --- numeric gradient sweep over differentiable families --------------------
+_GRAD_UNARY = ["exp", "log", "sqrt", "square", "sigmoid", "tanh", "relu",
+               "softsign", "sin", "cos", "arctan", "sinh", "cosh", "cbrt",
+               "rsqrt", "reciprocal", "erf", "gelu", "swish", "hard_sigmoid",
+               "log1p", "expm1", "negative", "abs"]
+
+
+@pytest.mark.parametrize("name", _GRAD_UNARY, ids=_GRAD_UNARY)
+def test_unary_numeric_gradient(name):
+    from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+    x = (RNG.rand(5).astype(np.float32) * 0.8 + 0.3)  # positive, smooth
+
+    def fn(a):
+        return getattr(mx.nd, name)(a).sum()
+    check_numeric_gradient(fn, [x], rtol=5e-2, atol=5e-3)
+
+
+_GRAD_BINARY = ["broadcast_add", "broadcast_subtract", "broadcast_multiply",
+                "broadcast_divide", "broadcast_maximum", "broadcast_minimum",
+                "broadcast_hypot", "broadcast_power"]
+
+
+@pytest.mark.parametrize("name", _GRAD_BINARY, ids=_GRAD_BINARY)
+def test_binary_numeric_gradient(name):
+    from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+    a = RNG.rand(3, 4).astype(np.float32) + 0.5
+    b = RNG.rand(3, 4).astype(np.float32) + 0.5
+
+    def fn(x, y):
+        return getattr(mx.nd, name)(x, y).sum()
+    check_numeric_gradient(fn, [a, b], rtol=5e-2, atol=5e-3)
+
+
+_GRAD_REDUCE = [("sum", dict(axis=1)), ("mean", dict(axis=0)),
+                ("prod", dict(axis=1)), ("norm", dict()),
+                ("nansum", dict(axis=1)), ("_square_sum", dict(axis=1))]
+
+
+@pytest.mark.parametrize("name,kw", _GRAD_REDUCE,
+                         ids=[n for n, _ in _GRAD_REDUCE])
+def test_reduce_numeric_gradient(name, kw):
+    from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+    x = RNG.rand(3, 4).astype(np.float32) + 0.5
+
+    def fn(a):
+        return getattr(mx.nd, name)(a, **kw).sum()
+    check_numeric_gradient(fn, [x], rtol=5e-2, atol=5e-3)
+
+
+_GRAD_MISC = [
+    ("softmax", lambda a: mx.nd.softmax(a, axis=-1).square().sum()),
+    ("log_softmax", lambda a: mx.nd.log_softmax(a, axis=-1).sum()),
+    ("softmin", lambda a: mx.nd.softmin(a, axis=-1).square().sum()),
+    ("dot", lambda a: mx.nd.dot(a, a.T()).sum() if callable(getattr(a, "T"))
+     else mx.nd.dot(a, a).sum()),
+    ("take", lambda a: mx.nd.take(a, mx.nd.array([0, 2]).astype("int32"))
+     .sum()),
+    ("clip", lambda a: mx.nd.clip(a, 0.4, 0.9).square().sum()),
+    ("smooth_l1", lambda a: mx.nd.smooth_l1(a, scalar=1.0).sum()),
+    ("pick", lambda a: mx.nd.pick(
+        a, mx.nd.array(np.array([0, 1, 0], np.float32)), axis=1).sum()),
+    ("LayerNorm-composite", lambda a: (a - a.mean()).square().sum()),
+]
+
+
+@pytest.mark.parametrize("name,fn", _GRAD_MISC,
+                         ids=[n for n, _ in _GRAD_MISC])
+def test_misc_numeric_gradient(name, fn):
+    from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+    x = RNG.rand(3, 4).astype(np.float32) + 0.3
+    if name == "dot":
+        def f(a):
+            return mx.nd.dot(a, a).sum()
+        check_numeric_gradient(f, [RNG.rand(3, 3).astype(np.float32) + 0.3],
+                               rtol=5e-2, atol=5e-3)
+        return
+    check_numeric_gradient(fn, [x], rtol=5e-2, atol=5e-3)
+
+
+# --- the LEDGER: every registered op must have a home -----------------------
+# ops whose substantive tests live in another file (claim is VERIFIED below
+# by scanning that file's text)
+TESTED_ELSEWHERE = {
+    # nn layer families — tests/test_operator.py
+    "Activation": "test_operator.py", "BatchNorm": "test_operator.py",
+    "Convolution": "test_operator.py", "Deconvolution": "test_operator.py",
+    "Dropout": "test_operator.py", "Embedding": "test_operator.py",
+    "Flatten": "test_operator.py", "FullyConnected": "test_operator.py",
+    "LayerNorm": "test_operator.py", "LeakyReLU": "test_operator.py",
+    "Pooling": "test_operator.py", "RNN": "test_operator.py",
+    "SequenceLast": "test_operator.py", "SequenceMask": "test_operator.py",
+    "SequenceReverse": "test_operator.py", "CTCLoss": "test_operator.py",
+    "UpSampling": "test_vision_linalg.py",
+    # legacy heads — tests/test_legacy_ops.py
+    "SoftmaxOutput": "test_autograd.py", "SVMOutput": "test_legacy_ops.py",
+    "Crop": "test_legacy_ops.py",
+    # vision/contrib — tests/test_vision_linalg.py
+    "BilinearSampler": "test_vision_linalg.py",
+    "Correlation": "test_vision_linalg.py",
+    "SpatialTransformer": "test_vision_linalg.py",
+    "DeformableConvolution": "test_vision_linalg.py",
+    "DeformablePSROIPooling": "test_vision_linalg.py",
+    "MultiBoxDetection": "test_vision_linalg.py",
+    "MultiBoxTarget": "test_vision_linalg.py",
+    "Proposal": "test_vision_linalg.py",
+    "MultiProposal": "test_vision_linalg.py",
+    "box_iou": "test_operator.py", "box_nms": "test_operator.py",
+    "linalg_potrf": "test_vision_linalg.py",
+    # sparse/optimizer — tests/test_loss_optim_metric.py, test_sparse.py
+    "_sparse_adagrad_update": "test_loss_optim_metric.py",
+    "_contrib_group_adagrad_update": "test_loss_optim_metric.py",
+}
+
+
+def test_registry_coverage_is_complete():
+    """REGISTRY-DRIVEN completeness: every op has a forward case in this
+    file or a verified home in another test file. Registering a new op
+    without tests FAILS here."""
+    import os
+    import re
+    full = open(__file__).read()
+    # exclude the TESTED_ELSEWHERE dict literal from the in-file scan —
+    # otherwise its own keys would satisfy coverage and the cross-file
+    # verification below would be dead code
+    d0 = full.index("TESTED_ELSEWHERE = {")
+    d1 = full.index("\n}", d0) + 2
+    here = full[:d0] + full[d1:]
+    cache = {}
+    missing = []
+    for op in sorted(list_ops()):
+        entry = TESTED_ELSEWHERE.get(op)
+        if entry is None and re.search(r"[\"']%s[\"']" % re.escape(op), here):
+            continue
+        if entry:
+            home, probe = entry if isinstance(entry, tuple) else (entry, op)
+            path = os.path.join(os.path.dirname(__file__), home)
+            if home not in cache:
+                # underscore-insensitive: tests call snake_case wrappers
+                # (roi_align) of CamelCase ops (ROIAlign)
+                cache[home] = open(path).read().lower().replace("_", "")
+            if probe.lower().replace("_", "") in cache[home]:
+                continue
+            missing.append("%s (claimed in %s but not found)" % (op, home))
+            continue
+        missing.append(op)
+    assert not missing, ("ops with NO test coverage: %s" % missing)
+
+
+# --- ops the strict ledger found untested anywhere (r3) ---------------------
+def test_roi_align_and_adaptive_pool():
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = _call("ROIAlign", x, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert np.asarray(out).shape == (1, 1, 2, 2)
+    # averaging quadrants of a linear ramp ~ quadrant centers
+    assert float(out[0, 0, 1, 1]) > float(out[0, 0, 0, 0])
+    got = _call("AdaptiveAvgPooling2D", x, output_size=2)
+    want = x.reshape(1, 1, 2, 4, 2, 4).mean(axis=(3, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bilinear_resize_and_grid_generator():
+    x = RNG.rand(1, 1, 4, 4).astype(np.float32)
+    out = _call("BilinearResize2D", x, height=8, width=8)
+    assert np.asarray(out).shape == (1, 1, 8, 8)
+    np.testing.assert_allclose(np.asarray(out).mean(), x.mean(), rtol=0.05)
+    # affine identity grid == the regular [-1,1] lattice
+    theta = np.array([[1.0, 0, 0, 0, 1.0, 0]], np.float32)
+    grid = _call("GridGenerator", theta, transform_type="affine",
+                 target_shape=(4, 4))
+    assert np.asarray(grid).shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(np.asarray(grid)[0, 0, 0],
+                               np.linspace(-1, 1, 4), atol=1e-5)
+
+
+def test_multibox_prior_anchors():
+    x = np.zeros((1, 3, 4, 4), np.float32)
+    anchors = _call("MultiBoxPrior", x, sizes=(0.5,), ratios=(1.0,))
+    a = np.asarray(anchors).reshape(-1, 4)
+    assert a.shape == (16, 4)
+    # centered 0.5-sized square anchor at each of the 4x4 cells
+    np.testing.assert_allclose(a[0, 2] - a[0, 0], 0.5, atol=1e-5)
+
+
+def test_fft_ifft_roundtrip_and_sketches():
+    x = RNG.randn(2, 8).astype(np.float32)
+    f = _call("fft", x)
+    assert np.asarray(f).shape == (2, 16)          # interleaved re/im
+    back = _call("ifft", f)
+    # the reference ifft is unnormalized (cuFFT): scaled by n vs numpy
+    np.testing.assert_allclose(back / 8.0, x, rtol=1e-4, atol=1e-4)
+    # count_sketch with an injective hash is an exact signed scatter
+    h = np.arange(8, dtype=np.float32)[None]
+    s = (RNG.randint(0, 2, (1, 8)) * 2 - 1).astype(np.float32)
+    sk = _call("count_sketch", x, h, s, out_dim=16)
+    assert np.asarray(sk).shape == (2, 16)
+    np.testing.assert_allclose(np.asarray(sk)[:, :8], x * s, rtol=1e-5)
+    # khatri_rao: column-wise kronecker
+    a = RNG.randn(2, 3).astype(np.float32)
+    b = RNG.randn(4, 3).astype(np.float32)
+    kr = _call("khatri_rao", a, b)
+    want = np.vstack([np.kron(a[:, i], b[:, i]) for i in range(3)]).T
+    np.testing.assert_allclose(kr, want, rtol=1e-5)
+
+
+def test_roi_pooling_and_triangular_linalg():
+    # ROIPooling: max-pool of the ROI's bins
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = _call("ROIPooling", x, rois, pooled_size=(2, 2),
+                spatial_scale=1.0)
+    assert np.asarray(out).shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 1, 1], 63.0)
+    # syrk: alpha * A @ A.T
+    a = RNG.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(_call("linalg_syrk", a, alpha=2.0),
+                               2.0 * a @ a.T, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        _call("linalg_syrk", a, transpose=True), a.T @ a, rtol=1e-4,
+        atol=1e-5)
+    # trsm: solve L X = alpha B for lower-triangular L
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = np.linalg.cholesky(spd).astype(np.float32)
+    B = RNG.randn(3, 2).astype(np.float32)
+    X = _call("linalg_trsm", L, B, alpha=1.0)
+    np.testing.assert_allclose(np.tril(L) @ np.asarray(X), B, rtol=1e-4,
+                               atol=1e-4)
