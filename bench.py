@@ -107,6 +107,138 @@ def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
     }))
 
 
+def bench_pipeline_fed(dtype):
+    import shutil
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="bench_pipe_")
+    try:
+        return _bench_pipeline_fed(dtype, tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_pipeline_fed(dtype, tmp):
+    """ResNet-50 training FED BY THE NATIVE C++ JPEG PIPELINE (VERDICT r2
+    #7). Reports pipeline-fed imgs/sec and the overlap efficiency vs the
+    binding resource: fed_rate / min(pipeline_alone, train_alone). On this
+    sandbox's single CPU core the pipeline is the wall (~550 imgs/s/core
+    at 224x224 q95); a TPU-VM host with tens of cores moves the wall to
+    the chip — either way <5% loss to the binding resource means decode
+    fully overlaps device compute."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+    from incubator_mxnet_tpu.recordio import (MXIndexedRecordIO, IRHeader,
+                                              pack_img)
+
+    np.random.seed(0)
+    os.environ["MXTPU_IO_HOST_BATCHES"] = "1"   # host-resident batches
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    n_img = int(os.environ.get("BENCH_PIPE_IMAGES", "1024"))
+    prefix = os.path.join(tmp, "train")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n_img):
+        img = (np.random.rand(224, 224, 3) * 255).astype(np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 1000), i, 0), img,
+                                  quality=95))
+    rec.close()
+
+    import multiprocessing
+    threads = int(os.environ.get("BENCH_PIPE_THREADS",
+                                 str(max(1, multiprocessing.cpu_count()))))
+    def make_iter():
+        return mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 224, 224), batch_size=batch, shuffle=False,
+            backend="native", preprocess_threads=threads)
+
+    # feed-chain-alone rate: decode (host) + H2D transfer, no training.
+    # In this sandbox H2D rides the axon tunnel; on a TPU-VM it is local
+    # PCIe/DMA — either way it belongs to the feed chain being overlapped.
+    it = make_iter()
+    for b in it:        # warm one epoch
+        pass
+    dev = jax.devices()[0]
+    t0 = time.perf_counter()
+    n = 0
+    last = None
+    for _ in range(2):
+        it.reset()
+        for b in it:
+            last = jax.device_put(b.data[0]._data, dev)
+            n += b.data[0].shape[0]
+    last.block_until_ready()
+    pipe_rate = n / (time.perf_counter() - t0)
+
+    net = mx.gluon.model_zoo.vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.random.rand(1, 3, 224, 224).astype(np.float32)))
+
+    def loss_fn(out, lab):
+        import jax.numpy as jnp
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logp, lab.astype(jnp.int32)[:, None],
+                                     axis=-1)
+        return -picked.mean()
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr = ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9},
+                        data_specs=P(), label_spec=P(),
+                        compute_dtype=None if dtype == "float32" else dtype)
+
+    # train-alone rate (synthetic resident batch)
+    data = mx.nd.array(np.random.rand(batch, 3, 224, 224).astype(np.float32))
+    label = mx.nd.array(np.random.randint(0, 1000, (batch,))
+                        .astype(np.float32))
+    losses = tr.step_scan(data, label, 30, per_step_batches=False)
+    float(losses[-1])    # compile the 30-step program
+    t0 = time.perf_counter()
+    losses = tr.step_scan(data, label, 30, per_step_batches=False)
+    float(losses[-1])
+    train_rate = batch * 30 / (time.perf_counter() - t0)
+
+    # pipeline-FED training: K pipeline batches per scanned device program
+    # (one H2D + one dispatch per K batches — host decode overlaps the
+    # in-flight device work)
+    K = int(os.environ.get("BENCH_PIPE_CHUNK", "4"))
+    it = make_iter()
+
+    def run_epochs(n_epochs):
+        n = 0
+        losses = None
+        buf_d, buf_l = [], []
+        for _ in range(n_epochs):
+            it.reset()
+            for b in it:
+                buf_d.append(np.asarray(b.data[0]._data))
+                buf_l.append(np.asarray(b.label[0]._data))
+                if len(buf_d) == K:
+                    losses = tr.step_scan(np.stack(buf_d), np.stack(buf_l),
+                                          K, per_step_batches=True)
+                    buf_d, buf_l = [], []
+                    n += batch * K
+        if losses is not None:
+            float(jax.device_get(losses[-1]))
+        return n
+
+    run_epochs(1)       # warm + compile the K-step program
+    t0 = time.perf_counter()
+    n = run_epochs(3)
+    fed_rate = n / (time.perf_counter() - t0)
+
+    bound = min(pipe_rate, train_rate)
+    print(json.dumps({
+        "metric": "resnet50_native_pipeline_fed_imgs_per_sec",
+        "value": round(fed_rate, 2),
+        "unit": "imgs/sec (feed-chain %.0f, train %.0f)" % (pipe_rate,
+                                                            train_rate),
+        "vs_baseline": round(fed_rate / bound, 3),
+    }))
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
@@ -114,6 +246,8 @@ def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "bert":
         return bench_bert(steps, dtype)
+    if model == "resnet50_pipe":
+        return bench_pipeline_fed(dtype)
     if model == "bert_long":
         # T=2048: the Pallas flash-attention path. vs_baseline = the best
         # XLA dense-einsum attention figure at T=2048 on the same chip

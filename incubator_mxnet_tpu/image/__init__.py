@@ -24,8 +24,17 @@ def imdecode(buf, flag=1, to_rgb=True):
             img = img[:, :, ::-1]
         return nd_array(_np.ascontiguousarray(img))
     except ImportError:
-        raise ImportError("cv2 is required to decode compressed images; "
-                          "use .npy inputs in this environment")
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        img = _np.asarray(Image.open(_io.BytesIO(bytes(buf))).convert("RGB"))
+        if not to_rgb:
+            img = img[:, :, ::-1]                       # RGB -> BGR
+        return nd_array(_np.ascontiguousarray(img))
+    except ImportError:
+        raise ImportError("neither cv2 nor PIL available to decode "
+                          "compressed images; use .npy inputs")
 
 
 def imread(filename, flag=1, to_rgb=True):

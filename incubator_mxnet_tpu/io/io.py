@@ -8,6 +8,32 @@ import numpy as _np
 
 from ..ndarray import NDArray, array as nd_array
 
+
+_HOST_CPU_DEV = None
+
+
+def _host_nd(a):
+    """Wrap a freshly-decoded batch. Default: a plain NDArray (uncommitted,
+    default device — mixes freely with any consumer's placement). With
+    MXTPU_IO_HOST_BATCHES=1 the batch is COMMITTED to the JAX CPU device:
+    host-resident until the consumer's own device_put (the trainer owns the
+    single H2D). The committed form is for feed-pipeline consumers that do
+    explicit placement — under JAX placement rules a committed-CPU array
+    pulls eager mixed computation onto the host, so it is opt-in."""
+    if os.environ.get("MXTPU_IO_HOST_BATCHES", "0") != "1":
+        return nd_array(a)
+    global _HOST_CPU_DEV
+    if _HOST_CPU_DEV is None:
+        import jax
+        try:
+            _HOST_CPU_DEV = jax.devices("cpu")[0]
+        except RuntimeError:
+            _HOST_CPU_DEV = False
+    if _HOST_CPU_DEV is False:
+        return nd_array(a)
+    import jax
+    return NDArray(jax.device_put(_np.asarray(a), _HOST_CPU_DEV))
+
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
            "LibSVMIter", "ImageDetRecordIter", "MXDataIter"]
@@ -460,8 +486,8 @@ class ImageRecordIter(DataIter):
                 raise StopIteration
             self._nat_batch_idx += 1
             data, label = out
-            return DataBatch(data=[nd_array(data.copy())],
-                             label=[nd_array(label.copy())], pad=pad)
+            return DataBatch(data=[_host_nd(data.copy())],
+                             label=[_host_nd(label.copy())], pad=pad)
         try:
             data, label = next(self._it)
         except StopIteration:

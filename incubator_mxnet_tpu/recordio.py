@@ -223,21 +223,42 @@ def unpack(s):
 
 
 def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Encode an image array and pack. Uses cv2 when present; falls back to
-    the lossless .npy container (decoded transparently by unpack_img)."""
+    """Encode an image array and pack. Uses cv2 when present, then PIL
+    (real JPEG/PNG bytes, so the native libjpeg pipeline can decode them);
+    falls back to the lossless .npy container last (decoded transparently
+    by unpack_img). Array convention is BGR, matching cv2."""
+    ext = img_fmt.lower()
     try:
         import cv2
-        ext = img_fmt.lower()
         params = [cv2.IMWRITE_JPEG_QUALITY, quality] if ext in (".jpg", ".jpeg") \
             else ([cv2.IMWRITE_PNG_COMPRESSION, 3] if ext == ".png" else [])
         ok, buf = cv2.imencode(img_fmt, img, params)
         assert ok, "failed to encode image"
         return pack(header, buf.tobytes())
     except ImportError:
-        import io as _io
-        bio = _io.BytesIO()
-        _np.save(bio, _np.asarray(img))
-        return pack(header, b"NPY0" + bio.getvalue())
+        pass
+    arr = _np.asarray(img)
+    # PIL only for images it represents faithfully: uint8 HWC/HW. Anything
+    # else (float data, CHW, exotic dtypes) keeps the LOSSLESS npy
+    # container — jpeg-encoding a float image via astype(uint8) would be
+    # silent corruption.
+    if arr.dtype == _np.uint8 and (arr.ndim == 2 or
+                                   (arr.ndim == 3 and arr.shape[2] == 3)):
+        try:
+            from PIL import Image
+            import io as _io
+            if arr.ndim == 3:
+                arr = arr[:, :, ::-1]      # BGR (cv2 convention) -> RGB
+            bio = _io.BytesIO()
+            fmt = "JPEG" if ext in (".jpg", ".jpeg") else "PNG"
+            Image.fromarray(arr).save(bio, format=fmt, quality=quality)
+            return pack(header, bio.getvalue())
+        except ImportError:
+            pass
+    import io as _io
+    bio = _io.BytesIO()
+    _np.save(bio, _np.asarray(img))
+    return pack(header, b"NPY0" + bio.getvalue())
 
 
 def unpack_img(s, iscolor=-1):
@@ -250,5 +271,14 @@ def unpack_img(s, iscolor=-1):
             import cv2
             img = cv2.imdecode(_np.frombuffer(raw, dtype=_np.uint8), iscolor)
         except ImportError:
-            raise IOError("cv2 not available to decode compressed image records")
+            # PIL decode fallback, mirroring pack_img's PIL encode path
+            # (BGR array convention on both sides, matching cv2)
+            try:
+                from PIL import Image
+                import io as _io
+                img = _np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
+                img = img[:, :, ::-1].copy()            # RGB -> BGR
+            except ImportError:
+                raise IOError("neither cv2 nor PIL available to decode "
+                              "compressed image records")
     return header, img
