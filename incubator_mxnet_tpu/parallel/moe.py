@@ -1,0 +1,148 @@
+"""Expert parallelism: Switch-style mixture-of-experts over an `ep` axis.
+
+TPU-native formulation (Mesh-TensorFlow / Switch Transformer lineage):
+token->expert routing is expressed as DENSE dispatch/combine einsums over
+a fixed per-expert capacity — no dynamic shapes, everything rides the
+MXU — and expert weights carry a leading E axis sharded over `ep`.
+Constraining the dispatched activations to `P("ep", ...)` makes GSPMD
+materialize the token redistribution as the all-to-all over ICI; the
+combine einsum brings tokens home. Fully differentiable (router included,
+via the straight-through gate weighting).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..gluon.block import HybridBlock
+
+__all__ = ["moe_apply", "MoEBlock"]
+
+
+def moe_apply(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
+              ep_sharding=None):
+    """Top-1 (switch) MoE feed-forward.
+
+    x : (S, d) tokens (flatten batch x seq first)
+    gate_w : (d, E) router
+    w1, b1, w2, b2 : (E, d, h), (E, h), (E, h, d), (E, d) expert MLPs
+    capacity_factor : per-expert capacity C = ceil(S/E * factor); tokens
+        over capacity are DROPPED (output 0 for them — Switch semantics)
+    ep_sharding : optional (mesh, axis) — constrains the dispatched
+        (E, C, d) activations so the redistribution lowers to the ep
+        collective.
+
+    Returns (out (S, d), aux_loss) — aux_loss is the Switch load-balance
+    loss (mean over experts of fraction_tokens * fraction_router_prob * E).
+    """
+    S, d = x.shape
+    E = gate_w.shape[1]
+    C = max(1, int(-(-(S * capacity_factor) // E)))   # ceil(S/E * factor)
+
+    logits = x @ gate_w                                   # (S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                   # (S,)
+    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)     # (S, E)
+    gate = (probs * onehot).sum(-1)                       # chosen prob
+
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # (S, E)
+    in_cap = (pos < C).astype(x.dtype) * onehot
+    pos_clamped = jnp.clip(pos.sum(-1).astype(jnp.int32), 0, C - 1)
+    cap_oh = jax.nn.one_hot(pos_clamped, C, dtype=x.dtype)  # (S, C)
+    dispatch = in_cap[:, :, None] * cap_oh[:, None, :]    # (S, E, C)
+
+    xin = jnp.einsum("sec,sd->ecd", dispatch, x)          # (E, C, d)
+    if ep_sharding is not None:
+        mesh, axis = ep_sharding
+        xin = jax.lax.with_sharding_constraint(
+            xin, NamedSharding(mesh, P(axis, None, None)))
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xin, w1) + b1[:, None, :])
+    y = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]  # (E, C, d)
+    if ep_sharding is not None:
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(axis, None, None)))
+    combine = dispatch * gate[:, None, None]              # weight by router
+    out = jnp.einsum("sec,ecd->sd", combine, y)           # (S, d)
+
+    # Switch load-balance auxiliary (encourages uniform expert usage)
+    frac_tokens = onehot.mean(axis=0)                     # (E,)
+    frac_probs = probs.mean(axis=0)
+    aux = (frac_tokens * frac_probs).sum() * E
+    return out, aux
+
+
+class MoEBlock(HybridBlock):
+    """gluon layer: switch-MoE feed-forward over the last axis.
+
+    Holds E expert MLPs as stacked parameters so `ShardedTrainer` rules
+    like ``(r"moe.*_expert", P("ep", None, None))`` shard them over the
+    expert axis. ``__call__`` returns the mixed output only; use
+    ``forward_with_aux(x)`` to also get the Switch load-balance aux loss
+    for the training objective (works on the eager tape and inside
+    traces)."""
+
+    def __init__(self, units, hidden, num_experts, capacity_factor=1.25,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._hidden = hidden
+        self._E = num_experts
+        self._cf = capacity_factor
+        from ..gluon.nn.basic_layers import _init_of
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(units, num_experts))
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, units, hidden))
+            self.expert_b1 = self.params.get(
+                "expert_b1", shape=(num_experts, hidden),
+                init=_init_of("zeros"))
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden, units))
+            self.expert_b2 = self.params.get(
+                "expert_b2", shape=(num_experts, units),
+                init=_init_of("zeros"))
+
+    def _apply(self, x, gate_weight, expert_w1, expert_b1, expert_w2,
+               expert_b2, with_aux):
+        shape = x.shape
+        flat = x.reshape(-1, shape[-1])
+        if hasattr(flat, "_data"):          # eager NDArray path (tape)
+            from ..ndarray.ndarray import _invoke_simple
+            args = [flat, gate_weight, expert_w1, expert_b1, expert_w2,
+                    expert_b2]
+
+            def fn(xf, gw, w1, b1, w2, b2):
+                out, aux = moe_apply(xf, gw, w1, b1, w2, b2, self._cf)
+                return (out, aux) if with_aux else out
+            res = _invoke_simple(fn, *args, op_name="MoEBlock")
+            if with_aux:
+                out, aux = res
+                return out.reshape(shape), aux
+            return res.reshape(shape)
+        out, aux = moe_apply(flat, gate_weight, expert_w1, expert_b1,
+                             expert_w2, expert_b2, self._cf)
+        out = out.reshape(shape)
+        return (out, aux) if with_aux else out
+
+    def hybrid_forward(self, F, x, gate_weight=None, expert_w1=None,
+                       expert_b1=None, expert_w2=None, expert_b2=None):
+        return self._apply(x, gate_weight, expert_w1, expert_b1, expert_w2,
+                           expert_b2, with_aux=False)
+
+    def forward_with_aux(self, x):
+        """(mixed output, load-balance aux loss). Eager: both ride the
+        autograd tape as NDArrays. Traced: raw arrays/tracers."""
+        from ..gluon.block import current_trace
+        if current_trace() is not None:
+            ctx = current_trace()
+            kw = {ln: ctx.param_map[p.name] for ln, p in
+                  self._reg_params.items() if p.name in ctx.param_map}
+            return self._apply(x, kw["gate_weight"], kw["expert_w1"],
+                               kw["expert_b1"], kw["expert_w2"],
+                               kw["expert_b2"], with_aux=True)
+        kw = {ln: p.data() for ln, p in self._reg_params.items()}
+        return self._apply(x, kw["gate_weight"], kw["expert_w1"],
+                           kw["expert_b1"], kw["expert_w2"],
+                           kw["expert_b2"], with_aux=True)

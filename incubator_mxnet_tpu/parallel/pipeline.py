@@ -1,0 +1,105 @@
+"""Pipeline parallelism over a `pp` mesh axis — the TPU-native form.
+
+The reference scales pipelines by process placement (one worker per
+stage over ps-lite/NCCL); here the WHOLE pipeline is one SPMD program:
+every stage has identical structure (the homogeneous-layer case —
+transformer blocks, MLP stacks), stage weights are STACKED on a leading
+axis sharded over `pp`, and a `lax.scan` over the GPipe schedule shifts
+activations to the next stage with `lax.ppermute` each tick. Because
+`ppermute` and `scan` are differentiable, `jax.grad` through
+`pipeline_apply` IS the backward pipeline (reverse schedule, reversed
+permutes) — no hand-written 1F1B machinery.
+
+Schedule: M microbatches through S stages takes M + S - 1 ticks; device
+s computes its stage every tick (idle ticks feed garbage that is never
+read — the standard bubble, fraction (S-1)/(M+S-1)).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params, mesh=None, axis="pp"):
+    """[params_stage0, params_stage1, ...] (matching pytrees) -> one
+    pytree with a leading stage axis, device_put sharded over `axis`
+    when a mesh is given."""
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+    if mesh is not None:
+        def put(x):
+            spec = P(axis, *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+        stacked = jax.tree_util.tree_map(put, stacked)
+    return stacked
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
+                   n_microbatch=None):
+    """Run `x` through S pipelined stages of `stage_fn`.
+
+    stage_fn : (stage_params, activations) -> activations, same shape
+        (the homogeneous-stage contract; heterogeneous heads/tails stay
+        outside the pipelined region).
+    stacked_params : pytree with leading stage axis S, sharded over
+        `axis` (see stack_stage_params).
+    x : (B, ...) global batch; split into `n_microbatch` microbatches
+        (default: the pp degree) along axis 0.
+    Returns (B, ...) outputs. Differentiable end to end.
+    """
+    S = mesh.shape[axis]
+    M = int(n_microbatch or S)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError("batch %d not divisible into %d microbatches"
+                         % (B, M))
+    mb = x.reshape((M, B // M) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda v: P(axis, *([None] * (v.ndim - 1))), stacked_params)
+
+    def manual(params, mb):
+        # params: this device's stage slice, leading axis length 1
+        local = jax.tree_util.tree_map(lambda v: v[0], params)
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t (clamped once the feed is dry)
+            feed = jax.lax.dynamic_index_in_dim(
+                mb, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+            x_in = jnp.where(idx == 0, feed, state)
+            y = stage_fn(local, x_in)
+            # the LAST stage's result for tick t belongs to microbatch
+            # t - (S - 1); stash it before the shift
+            take = jnp.logical_and(idx == S - 1, t >= S - 1)
+            outs = jax.lax.cond(
+                take,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(t - (S - 1), 0), axis=0),
+                lambda o: o, outs)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, outs), None
+
+        state0 = jnp.zeros_like(mb[0])
+        outs0 = jnp.zeros_like(mb)
+        (state, outs), _ = jax.lax.scan(tick, (state0, outs0),
+                                        jnp.arange(M + S - 1))
+        # outs live on the last stage only; rotate them to every device so
+        # the result leaves the region replicated over pp
+        outs = jax.lax.psum(
+            jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    out = jax.shard_map(
+        manual, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={axis}, check_vma=False,
+    )(stacked_params, mb)
+    return out.reshape((B,) + x.shape[1:])

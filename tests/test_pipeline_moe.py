@@ -1,0 +1,227 @@
+"""Pipeline (pp) and expert (ep) parallelism on the 8-device virtual mesh
+(net-new vs the reference, which scales pipelines by process placement;
+SURVEY §5 long-context/distributed mandate)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.parallel import (make_mesh, pipeline_apply,
+                                          stack_stage_params, moe_apply,
+                                          MoEBlock)
+from incubator_mxnet_tpu.parallel.collectives import collective_counts
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stages(S, d, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rng.randn(d, d).astype(np.float32) * 0.3),
+             "b": jnp.asarray(rng.randn(d).astype(np.float32) * 0.1)}
+            for _ in range(S)]
+
+
+def test_pipeline_matches_serial_forward():
+    S, d, B = 4, 16, 8
+    mesh = make_mesh({"pp": S}, devices=jax.devices()[:S])
+    stages = _make_stages(S, d)
+    stacked = stack_stage_params(stages, mesh, axis="pp")
+    x = jnp.asarray(np.random.RandomState(1).randn(B, d).astype(np.float32))
+    out = pipeline_apply(_stage_fn, stacked, x, mesh, axis="pp")
+    ref = x
+    for p in stages:
+        ref = _stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_pipeline_gradients_match_serial():
+    """jax.grad THROUGH the pipelined scan == grads of serial execution
+    (ppermute transposes give the backward pipeline for free)."""
+    S, d, B = 4, 8, 8
+    mesh = make_mesh({"pp": S}, devices=jax.devices()[:S])
+    stages = _make_stages(S, d, seed=2)
+    stacked = stack_stage_params(stages, mesh, axis="pp")
+    x = jnp.asarray(np.random.RandomState(3).randn(B, d).astype(np.float32))
+
+    def loss_pp(params, x):
+        return (pipeline_apply(_stage_fn, params, x, mesh) ** 2).sum()
+
+    def loss_serial(params, x):
+        y = x
+        for s in range(S):
+            p = jax.tree_util.tree_map(lambda v: v[s], params)
+            y = _stage_fn(p, y)
+        return (y ** 2).sum()
+
+    g_pp = jax.grad(loss_pp)(stacked, x)
+    g_sr = jax.grad(loss_serial)(
+        jax.tree_util.tree_map(lambda *l: jnp.stack(l), *stages), x)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                    jax.tree_util.tree_leaves(g_sr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_pipeline_emits_collective_permute():
+    S, d, B = 4, 8, 8
+    mesh = make_mesh({"pp": S}, devices=jax.devices()[:S])
+    stacked = stack_stage_params(_make_stages(S, d), mesh, axis="pp")
+    x = jnp.zeros((B, d), jnp.float32)
+    hlo = jax.jit(lambda p, x: pipeline_apply(_stage_fn, p, x, mesh)) \
+        .lower(stacked, x).compile().as_text()
+    c = collective_counts(hlo)
+    assert c["collective-permute"] >= 1, c
+
+
+def test_pipeline_more_microbatches():
+    S, d, B = 2, 8, 12
+    mesh = make_mesh({"pp": S}, devices=jax.devices()[:S])
+    stages = _make_stages(S, d, seed=4)
+    stacked = stack_stage_params(stages, mesh, axis="pp")
+    x = jnp.asarray(np.random.RandomState(5).randn(B, d).astype(np.float32))
+    out = pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatch=6)
+    ref = x
+    for p in stages:
+        ref = _stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# expert parallelism
+# ---------------------------------------------------------------------------
+
+def _moe_params(d, h, E, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(d, E).astype(np.float32) * 0.5),
+            jnp.asarray(rng.randn(E, d, h).astype(np.float32) * 0.2),
+            jnp.zeros((E, h), jnp.float32),
+            jnp.asarray(rng.randn(E, h, d).astype(np.float32) * 0.2),
+            jnp.zeros((E, d), jnp.float32))
+
+
+def test_moe_matches_per_token_expert():
+    """With ample capacity, every token's output equals gate_prob * its
+    argmax expert's MLP applied to it."""
+    d, h, E, S = 8, 16, 4, 32
+    gw, w1, b1, w2, b2 = _moe_params(d, h, E)
+    x = jnp.asarray(np.random.RandomState(1).randn(S, d).astype(np.float32))
+    out, aux = moe_apply(x, gw, w1, b1, w2, b2, capacity_factor=E * 1.0)
+    probs = jax.nn.softmax(x @ gw, axis=-1)
+    eidx = np.asarray(jnp.argmax(probs, -1))
+    want = np.zeros((S, d), np.float32)
+    for s in range(S):
+        e = eidx[s]
+        hmid = jax.nn.gelu(x[s] @ w1[e] + b1[e])
+        want[s] = np.asarray((hmid @ w2[e] + b2[e]) * probs[s, e])
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-5)
+    assert float(aux) > 0
+
+
+def test_moe_capacity_drops_overflow():
+    """Over-capacity tokens produce ZERO output (Switch semantics), never
+    garbage."""
+    d, h, E, S = 4, 8, 2, 16
+    gw, w1, b1, w2, b2 = _moe_params(d, h, E, seed=2)
+    # route everything to expert 0 by biasing the router
+    gw = gw.at[:, 0].set(10.0)
+    out, _ = moe_apply(jnp.ones((S, d)), gw, w1, b1, w2, b2,
+                       capacity_factor=0.25)   # capacity 2 of 16 tokens
+    nonzero_rows = int((np.abs(np.asarray(out)).sum(-1) > 1e-6).sum())
+    assert nonzero_rows == 2, nonzero_rows
+
+
+def test_moe_grads_flow_to_router_and_experts():
+    d, h, E, S = 8, 16, 4, 32
+    params = _moe_params(d, h, E, seed=3)
+    x = jnp.asarray(np.random.RandomState(4).randn(S, d).astype(np.float32))
+
+    def loss(*ps):
+        out, aux = moe_apply(x, *ps, capacity_factor=4.0)
+        return (out ** 2).sum() + 0.01 * aux
+
+    grads = jax.grad(loss, argnums=tuple(range(5)))(*params)
+    for g in grads:
+        assert float(jnp.abs(g).sum()) > 0
+
+
+def test_moe_ep_sharded_matches_unsharded():
+    d, h, E, S = 8, 16, 4, 32
+    params = _moe_params(d, h, E, seed=5)
+    x = jnp.asarray(np.random.RandomState(6).randn(S, d).astype(np.float32))
+    ref, _ = moe_apply(x, *params, capacity_factor=4.0)
+    mesh = make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    from jax.sharding import NamedSharding
+    sharded = [jax.device_put(p, NamedSharding(
+        mesh, P("ep", *([None] * (p.ndim - 1)))) if p.ndim == 3 else
+        NamedSharding(mesh, P(*([None] * p.ndim))))
+        for p in params]
+
+    @jax.jit
+    def run(x, *ps):
+        out, _ = moe_apply(x, *ps, capacity_factor=4.0,
+                           ep_sharding=(mesh, "ep"))
+        return out
+
+    out = run(x, *sharded)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_moe_capacity_is_ceil_and_never_zero():
+    """C = ceil(S/E * factor) exactly; tiny factors floor at 1, never 0."""
+    d, h, E, S = 4, 8, 8, 8
+    gw, w1, b1, w2, b2 = _moe_params(d, h, E, seed=8)
+    # factor 0.9 with S==E: C must be 1 (was 0 -> all tokens dropped)
+    out, _ = moe_apply(jnp.ones((S, d)), gw, w1, b1, w2, b2,
+                       capacity_factor=0.9)
+    assert float(jnp.abs(out).sum()) > 0
+    # ceil semantics: S=32, E=4, cf=1.1 -> C=9 slots (not 8)
+    gw2 = jnp.zeros((d, 4)).at[:, 0].set(10.0)   # everything to expert 0
+    _, w1b, b1b, w2b, b2b = _moe_params(d, h, 4, seed=9)
+    out, _ = moe_apply(jnp.ones((32, d)), gw2, w1b, b1b, w2b, b2b,
+                       capacity_factor=1.1)
+    nonzero = int((jnp.abs(out).sum(-1) > 1e-6).sum())
+    assert nonzero == 9, nonzero
+
+
+def test_moe_forward_with_aux_eager_and_traced():
+    np.random.seed(10)
+    blk = MoEBlock(units=8, hidden=16, num_experts=4)
+    blk.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(12, 8).astype(np.float32))
+    out, aux = blk.forward_with_aux(x)
+    assert out.shape == (12, 8)
+    assert float(aux.asnumpy()) > 0
+    # aux participates in the tape
+    from incubator_mxnet_tpu import autograd
+    with autograd.record():
+        o, a = blk.forward_with_aux(x)
+        L = (o * o).mean() + 0.1 * a
+    L.backward()
+    assert float(np.abs(blk.gate_weight.grad().asnumpy()).sum()) > 0
+
+
+def test_moe_block_in_gluon_net():
+    np.random.seed(7)
+    blk = MoEBlock(units=8, hidden=16, num_experts=4)
+    blk.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(2, 5, 8).astype(np.float32))
+    out = blk(x)
+    assert out.shape == (2, 5, 8)
+    # trains: grads reach the experts through the tape
+    from incubator_mxnet_tpu import autograd
+    with autograd.record():
+        y = blk(x)
+        L = (y * y).mean()
+    L.backward()
+    g = blk.expert_w1.grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
