@@ -42,12 +42,15 @@ def moe_apply(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
     logits = x @ gate_w                                   # (S, E)
     probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)                   # (S,)
-    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)     # (S, E)
+    # routing bookkeeping stays fp32: a bf16 cumsum rounds queue
+    # positions past 256 and double-books capacity slots
+    onehot32 = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+    onehot = onehot32.astype(x.dtype)
     gate = (probs * onehot).sum(-1)                       # chosen prob
 
     # position of each token within its expert's queue
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # (S, E)
-    in_cap = (pos < C).astype(x.dtype) * onehot
+    pos = (jnp.cumsum(onehot32, axis=0) - 1.0) * onehot32   # (S, E)
+    in_cap = ((pos < C) * (onehot32 > 0)).astype(x.dtype)
     pos_clamped = jnp.clip(pos.sum(-1).astype(jnp.int32), 0, C - 1)
     cap_oh = jax.nn.one_hot(pos_clamped, C, dtype=x.dtype)  # (S, C)
     dispatch = in_cap[:, :, None] * cap_oh[:, None, :]    # (S, E, C)

@@ -15,8 +15,6 @@ s computes its stage every tick (idle ticks feed garbage that is never
 read — the standard bubble, fraction (S-1)/(M+S-1)).
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -57,6 +55,11 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
     if B % M:
         raise ValueError("batch %d not divisible into %d microbatches"
                          % (B, M))
+    n_stages = {v.shape[0] for v in jax.tree_util.tree_leaves(stacked_params)}
+    if n_stages != {S}:
+        raise ValueError(
+            "stacked stage axis %s must equal the %r mesh degree %d — each "
+            "device runs exactly ONE stage" % (sorted(n_stages), axis, S))
     mb = x.reshape((M, B // M) + x.shape[1:])
 
     param_specs = jax.tree_util.tree_map(

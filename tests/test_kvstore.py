@@ -239,6 +239,41 @@ def test_dist_sync_double_push_folds_and_waits_for_all_ranks():
         np.testing.assert_allclose(res, [10.0] * 4)
 
 
+def _trainer_rescale_worker(rank):
+    """First step(batch_size) must SHIP the scaled optimizer (not raise);
+    a later batch-size change must raise (server copy is stale)."""
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    from incubator_mxnet_tpu import gluon, autograd
+    import incubator_mxnet_tpu as mxl
+    kv = KVStoreDist("dist_sync")
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize(mxl.init.Constant(0.5))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=kv)
+    x = nd.ones((4, 3))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    tr.step(4)                     # must not raise on the FIRST step
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    try:
+        tr.step(8)                 # changed batch size -> must raise
+        res = "no error raised"
+    except UserWarning:
+        res = "raised"
+    kv.close()
+    return res
+
+
+def test_dist_trainer_first_step_ships_scaled_optimizer():
+    results = _spawn_ps_group(1, 1, "_trainer_rescale_worker")
+    res = results[0]
+    assert not (isinstance(res, str) and res.startswith("ERROR")), res
+    assert res == "raised", res
+
+
 def _push_before_init_worker(rank):
     """A server-side push failure (push before init) must RAISE at the next
     flush point on the worker, not be silently swallowed (ADVICE r2)."""
