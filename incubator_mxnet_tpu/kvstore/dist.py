@@ -117,7 +117,8 @@ class KVStoreDist(KVStore):
         an async push failure is silently swallowed (the gradient update is
         dropped; in sync mode the round never completes and surfaces much
         later as an unrelated pull timeout)."""
-        rmeta, rpayload = conn.call(meta, payload)
+        rmeta, rpayload = conn.call(meta, payload if payload is not None
+                                    else b"")
         if isinstance(rmeta, dict) and rmeta.get("error"):
             raise RuntimeError("%s(%r): %s" % (
                 meta.get("op"), meta.get("key"), rmeta["error"]))
@@ -310,14 +311,27 @@ class KVStoreDist(KVStore):
     # -- control -------------------------------------------------------------
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the servers (worker 0 only, reference:
-        kvstore.py set_optimizer via SendCommandToServers)."""
+        kvstore.py set_optimizer via SendCommandToServers). Preferred wire
+        form: a JSON registry-token spec (class name + JSON-clean
+        hyperparameters) — no code execution on the server. Optimizers
+        carrying non-JSON state (e.g. an lr_scheduler object) fall back to
+        the pickle blob, which the server only accepts from localhost or
+        under MXTPU_PS_ALLOW_PICKLE=1."""
+        from .optimizer_spec import optimizer_to_spec
         self._optimizer = optimizer
         if self._rank == 0:
-            blob = pickle.dumps(optimizer)
-            for conn in self._servers:
-                meta, _ = conn.call({"op": "set_optimizer"}, blob)
-                if meta.get("error"):
-                    raise RuntimeError(meta["error"])
+            try:
+                spec = optimizer_to_spec(optimizer)
+            except TypeError:
+                spec = None     # non-JSON state: gated pickle fallback
+            if spec is not None:
+                for conn in self._servers:
+                    self._checked_call(
+                        conn, {"op": "set_optimizer_spec", "spec": spec})
+            else:
+                blob = pickle.dumps(optimizer)
+                for conn in self._servers:
+                    self._checked_call(conn, {"op": "set_optimizer"}, blob)
         self.barrier()
 
     def set_gradient_compression(self, compression_params):

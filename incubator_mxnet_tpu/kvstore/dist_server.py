@@ -422,6 +422,15 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                 arr = arr[np.asarray(rows, dtype=np.int64)]
             return ({"shape": list(arr.shape), "dtype": str(arr.dtype)},
                     np.ascontiguousarray(arr).tobytes())
+        if op == "set_optimizer_spec":
+            # registry-token form: class name + JSON-clean attrs, rebuilt
+            # through the optimizer registry — NO code crosses the wire
+            from .optimizer_spec import optimizer_from_spec
+            from .. import optimizer as optmod
+            opt = optimizer_from_spec(meta["spec"])
+            state.optimizer = opt
+            state.updater = optmod.get_updater(opt)
+            return {"ok": True}, b""
         if op == "set_optimizer":
             if not _pickle_allowed(meta):
                 return {"error": "optimizer blob refused from non-local "

@@ -1,0 +1,80 @@
+"""Registry-token optimizer serialization for the parameter server.
+
+The reference ships the optimizer as a pickle blob (kvstore.py
+_send_command_to_servers(kController, pickle(optimizer))) — unpickling
+executes code, so the server must trust the worker. This module carries
+the common case with DATA instead: the registry name of the optimizer
+class plus its JSON-clean ``__dict__``. The server rebuilds through the
+same ``optimizer.create`` registry the worker used — no code crosses the
+wire. Optimizers holding non-JSON state (an lr_scheduler object, custom
+callables) raise TypeError and the caller falls back to the gated pickle
+path.
+"""
+
+__all__ = ["optimizer_to_spec", "optimizer_from_spec"]
+
+# runtime bookkeeping that must not travel / is rebuilt server-side
+_SKIP_KEYS = {"param_dict", "_index_update_count"}
+_INT_DICT = "__int_keys__"
+
+
+def _clean(value, path):
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_clean(v, path) for v in value]
+    if isinstance(value, dict):
+        if all(isinstance(k, str) for k in value):
+            return {k: _clean(v, path) for k, v in value.items()}
+        if all(isinstance(k, int) for k in value):
+            # idx2name / lr_mult key by parameter index
+            return {_INT_DICT: {str(k): _clean(v, path)
+                                for k, v in value.items()}}
+    raise TypeError("optimizer attribute %r is not JSON-clean (%r)"
+                    % (path, type(value).__name__))
+
+
+def _restore(value):
+    if isinstance(value, dict):
+        if set(value) == {_INT_DICT}:
+            return {int(k): _restore(v) for k, v in value[_INT_DICT].items()}
+        return {k: _restore(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_restore(v) for v in value]
+    return value
+
+
+def optimizer_to_spec(optimizer):
+    """-> {"class": registry name, "state": JSON-clean attrs}.
+    Raises TypeError when any attribute cannot travel as data or the
+    class is not resolvable through the shared registry."""
+    from ..optimizer.optimizer import _OPT_REGISTRY
+    name = type(optimizer).__name__.lower()
+    if _OPT_REGISTRY.get(name) is not type(optimizer):
+        raise TypeError("optimizer %r is not in the shared registry; "
+                        "falling back to the gated pickle path"
+                        % type(optimizer).__name__)
+    state = {}
+    for k, v in optimizer.__dict__.items():
+        if k in _SKIP_KEYS:
+            continue
+        state[k] = _clean(v, k)
+    # param_dict holds live Parameter objects (worker-side only); their
+    # per-parameter multipliers FOLD into the index-keyed mult dicts,
+    # which _get_lr/_get_wd consult when param_dict is absent
+    mults_lr = dict(optimizer.lr_mult)
+    mults_wd = dict(optimizer.wd_mult)
+    for idx, p in getattr(optimizer, "param_dict", {}).items():
+        mults_lr[idx] = float(getattr(p, "lr_mult", 1.0))
+        mults_wd[idx] = float(getattr(p, "wd_mult", 1.0))
+    state["lr_mult"] = _clean(mults_lr, "lr_mult")
+    state["wd_mult"] = _clean(mults_wd, "wd_mult")
+    return {"class": name, "state": state}
+
+
+def optimizer_from_spec(spec):
+    """Rebuild via the optimizer registry; never executes shipped code."""
+    from .. import optimizer as optmod
+    opt = optmod.create(spec["class"])
+    opt.__dict__.update({k: _restore(v) for k, v in spec["state"].items()})
+    return opt

@@ -389,3 +389,47 @@ def test_dist_barrier_detects_dead_worker():
     assert res[0] == "raised", res
     # detection must come from liveness (seconds), not the 60s barrier timeout
     assert res[2] < 30, res
+
+
+def test_optimizer_spec_roundtrip_no_pickle():
+    """Registry-token optimizer shipping: JSON-clean spec rebuilds an
+    equivalent optimizer through the registry — no pickle involved."""
+    from incubator_mxnet_tpu.kvstore.optimizer_spec import (
+        optimizer_to_spec, optimizer_from_spec)
+    import json
+    opt = mx.optimizer.create("adam", learning_rate=0.05, beta1=0.8,
+                              wd=0.01, rescale_grad=0.5)
+    opt.set_lr_mult({0: 0.1})
+    spec = optimizer_to_spec(opt)
+    json.dumps(spec)            # wire-safe by construction
+    back = optimizer_from_spec(spec)
+    assert type(back) is type(opt)
+    assert back.lr == opt.lr and back.beta1 == 0.8
+    assert back.rescale_grad == 0.5 and back.lr_mult == {0: 0.1}
+    # per-PARAMETER multipliers fold into the index dicts so the server's
+    # _get_lr honors them without live Parameter objects
+    class _P:
+        lr_mult, wd_mult = 0.25, 2.0
+    opt3 = mx.optimizer.create("sgd", learning_rate=1.0,
+                               param_dict={1: _P()})
+    spec3 = optimizer_to_spec(opt3)
+    back3 = optimizer_from_spec(spec3)
+    assert back3._get_lr(1) == 0.25 and back3._get_wd(1) == 0.0 * 2.0
+    # unregistered subclasses must REFUSE the spec path
+    class MyOpt(type(opt)):
+        pass
+    with __import__("pytest").raises(TypeError):
+        optimizer_to_spec(MyOpt())
+    # the rebuilt optimizer trains identically
+    w1, w2 = nd.array([1.0]), nd.array([1.0])
+    s1 = opt.create_state(0, w1)
+    s2 = back.create_state(0, w2)
+    opt.update(0, w1, nd.array([0.2]), s1)
+    back.update(0, w2, nd.array([0.2]), s2)
+    np.testing.assert_allclose(w1.asnumpy(), w2.asnumpy(), rtol=1e-6)
+    # non-JSON state (an lr_scheduler object) falls back to pickle
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt2 = mx.optimizer.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        optimizer_to_spec(opt2)
