@@ -85,12 +85,16 @@ class _BlockScope:
 # ---------------------------------------------------------------------------
 
 class _TraceCtx:
-    def __init__(self, param_map, key, training):
+    def __init__(self, param_map, key, training, mesh_ctx=None):
         self.param_map = param_map    # full param name -> jax tracer
         self.aux_updates = {}         # full param name -> jax tracer (new value)
         self.key = key
         self.training = training
         self.F = _ops                 # op namespace (symbol module for export)
+        # the ShardedTrainer's Mesh (when tracing under one): blocks that
+        # own a parallelism axis (PipelineStack -> pp, MoEBlock -> ep)
+        # read it to engage their sharded execution path
+        self.mesh_ctx = mesh_ctx
 
     def take_key(self):
         if self.key is None:  # symbolic export trace: no RNG
@@ -441,11 +445,13 @@ class HybridBlock(Block):
         rebuild = out_container["rebuild"]
         return (fwd_jit, bwd_jit, rebuild)
 
-    def hybrid_call(self, *args):
+    def hybrid_call(self, *args, **extra):
         """Forward used inside a trace: route to hybrid_forward with param
-        tracers looked up from the active trace context."""
+        tracers looked up from the active trace context. ``extra`` =
+        caller keyword arguments (e.g. keyword-only model inputs), passed
+        through alongside the param kwargs."""
         ctx = current_trace()
-        kwargs = {}
+        kwargs = dict(extra)
         for local_name, p in self._reg_params.items():
             if p.name in ctx.param_map:
                 kwargs[local_name] = ctx.param_map[p.name]
@@ -453,10 +459,14 @@ class HybridBlock(Block):
                 kwargs[local_name] = p._data._data
         return self.hybrid_forward(ctx.F, *args, **kwargs)
 
-    def forward(self, *args):
+    def forward(self, *args, **extra):
         if current_trace() is not None:
-            return self.hybrid_call(*args)
+            return self.hybrid_call(*args, **extra)
         if self._active:
+            if extra:
+                raise TypeError(
+                    "hybridized blocks take positional inputs only; got "
+                    "keyword arguments %s" % sorted(extra))
             return self._call_compiled(*args)
         # eager path: params as NDArrays, F = mx.nd
         try:
@@ -464,7 +474,7 @@ class HybridBlock(Block):
         except DeferredInitializationError:
             self._shape_hook(*args)
             kwargs = {ln: p.data() for ln, p in self._reg_params.items()}
-        return self.hybrid_forward(nd, *args, **kwargs)
+        return self.hybrid_forward(nd, *args, **{**extra, **kwargs})
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
@@ -538,7 +548,13 @@ class SymbolBlock(HybridBlock):
         self._sym_outputs = outputs
         self._sym_inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         from ..symbol import Symbol
-        all_params = outputs.list_arguments() if hasattr(outputs, "list_arguments") else []
+        # arguments AND auxiliary states (running stats round-trip through
+        # JSON as __aux__-marked vars; both need Parameter slots fed at
+        # forward — reference SymbolBlock:975 aux_params handling)
+        all_params = []
+        if hasattr(outputs, "list_arguments"):
+            all_params = list(outputs.list_arguments()) \
+                + list(outputs.list_auxiliary_states())
         input_names = {s.name for s in self._sym_inputs}
         for name in all_params:
             if name not in input_names:

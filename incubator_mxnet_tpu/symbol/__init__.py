@@ -22,6 +22,29 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
            "zeros", "ones", "executor_eval", "block_to_json"]
 
 
+def _is_floating(dt):
+    """np.issubdtype misses ml_dtypes extension floats (bfloat16)."""
+    import jax.numpy as jnp
+    return jnp.issubdtype(_np.dtype(dt), jnp.floating)
+
+
+def _fill_unknown_dtypes(node, in_dtypes, kdt, record):
+    """FInferType's ElemwiseType propagation for parameter slots: unknown
+    input dtypes follow the op's first known floating input (falling back
+    to the op's `dtype` attr / fp32). Backfills variable dtypes into `kdt`
+    and calls `record(var_node, dtype)` so the walk's per-node table stays
+    in sync. Shared by the exact walk and the shape-free fallback — one
+    promotion rule, two integration points."""
+    floats = [d for d in in_dtypes if d is not None and _is_floating(d)]
+    fill = floats[0] if floats else _np.dtype(
+        node._attrs.get("dtype", _np.float32))
+    for i, d in zip(node._inputs, in_dtypes):
+        if d is None and i._op is None and kdt.get(i._name) is None:
+            kdt[i._name] = fill
+            record(i, fill)
+    return [fill if d is None else d for d in in_dtypes]
+
+
 class Symbol:
     """A node (or multi-output view) in the symbolic graph."""
 
@@ -159,43 +182,68 @@ class Symbol:
         return order
 
     # --------------------------------------------------------------- shapes
-    def infer_shape(self, **kwargs):
-        """Node-by-node abstract-shape walk. Parameter shapes missing from
-        ``kwargs`` are filled by per-op backward rules (the reference's
-        FInferShape bidirectional inference for weight/bias/gamma slots)."""
+    def _infer_walk(self, known_shapes, known_dtypes):
+        """Node-by-node abstract walk carrying BOTH shape and dtype through
+        ``jax.eval_shape`` (the reference runs shape and type inference as
+        two fixed-point passes over the same graph —
+        src/executor/infer_graph_attr_pass.cc:677; here one abstract-eval
+        walk yields both, with XLA's own promotion semantics). Parameter
+        shapes missing from the feed are filled by per-op backward rules
+        (FInferShape weight/bias/gamma slots); unknown parameter dtypes
+        follow the op's first known floating input (FInferType's
+        ElemwiseType propagation). Returns None when inference fails."""
         import jax
 
-        known = {k: tuple(v) for k, v in kwargs.items()}
+        known = {k: tuple(v) for k, v in known_shapes.items()}
+        kdt = dict(known_dtypes)
         nodes = self._topo()
-        out_shapes = {}   # id(node) -> tuple of output shapes
+        out_info = {}   # id(node) -> (shapes tuple, dtypes tuple)
+
+        def var_dtype(n):
+            dt = kdt.get(n._name)
+            if dt is None and n._attrs.get("__dtype__") is not None:
+                dt = _np.dtype(n._attrs["__dtype__"])
+                kdt[n._name] = dt
+            return dt
 
         for n in nodes:
             if n._op is None:
                 s = known.get(n._name)
                 if s is None:  # () is a valid scalar shape — explicit check
                     s = n._attrs.get("__shape__")
-                out_shapes[id(n)] = (tuple(s),) if s is not None else (None,)
+                dt = var_dtype(n)
+                out_info[id(n)] = (((tuple(s),) if s is not None else (None,)),
+                                   (dt,))
                 continue
             if n._op == "_group":
                 continue
-            in_shapes = [out_shapes[id(i)][i._out_index or 0]
+            in_shapes = [out_info[id(i)][0][i._out_index or 0]
+                         for i in n._inputs]
+            in_dtypes = [out_info[id(i)][1][min(i._out_index or 0,
+                                                len(out_info[id(i)][1]) - 1)]
                          for i in n._inputs]
             if any(s is None for s in in_shapes):
                 rule = _PARAM_SHAPE_RULES.get(n._op)
                 if rule is None:
-                    return None, None, None
+                    return None
                 filled = rule(in_shapes, n._attrs)
                 if filled is None or any(s is None for s in filled):
-                    return None, None, None
+                    return None
                 for i, s in zip(n._inputs, filled):
                     if i._op is None and known.get(i._name) is None:
                         known[i._name] = tuple(s)
-                        out_shapes[id(i)] = (tuple(s),)
+                        out_info[id(i)] = ((tuple(s),), out_info[id(i)][1])
                 in_shapes = [tuple(s) for s in filled]
+            if any(d is None for d in in_dtypes):
+                in_dtypes = _fill_unknown_dtypes(
+                    n, in_dtypes, kdt,
+                    lambda i, f: out_info.__setitem__(
+                        id(i), (out_info[id(i)][0], (f,))))
             attrs = {k: v for k, v in n._attrs.items() if not k.startswith("__")}
             kw_inputs = n._attrs.get("__kwarg_inputs__", [])
             kw_pos = {p for _, p in kw_inputs}
-            feed = [jax.ShapeDtypeStruct(s, _np.float32) for s in in_shapes]
+            feed = [jax.ShapeDtypeStruct(s, d)
+                    for s, d in zip(in_shapes, in_dtypes)]
             kw = {k: feed[p] for k, p in kw_inputs}
             pos = [v for j, v in enumerate(feed) if j not in kw_pos]
             try:
@@ -203,21 +251,33 @@ class Symbol:
                     lambda *a, **k: get_op(n._op).fn(*a, **{**attrs, **k}),
                     *pos, **kw)
             except Exception:
-                return None, None, None
+                return None
             outs = out if isinstance(out, (list, tuple)) else [out]
-            out_shapes[id(n)] = tuple(tuple(o.shape) for o in outs)
+            out_info[id(n)] = (tuple(tuple(o.shape) for o in outs),
+                               tuple(_np.dtype(o.dtype) for o in outs))
+        return out_info, known, kdt, nodes
 
+    def _collect_heads(self, out_info, nodes, slot):
+        if self._op == "_group":
+            return [out_info[id(s)][slot][s._out_index or 0]
+                    for s in self._inputs]
+        sink = out_info[id(nodes[-1])][slot]
+        return [sink[self._out_index]] if self._out_index is not None \
+            else list(sink)
+
+    def infer_shape(self, **kwargs):
+        """Node-by-node abstract-shape walk. Parameter shapes missing from
+        ``kwargs`` are filled by per-op backward rules (the reference's
+        FInferShape bidirectional inference for weight/bias/gamma slots)."""
+        r = self._infer_walk(kwargs, {})
+        if r is None:
+            return None, None, None
+        out_info, known, _, nodes = r
         arg_shapes = [known.get(nm) for nm in self.list_arguments()]
         aux_shapes = [known.get(nm) for nm in self.list_auxiliary_states()]
         if any(s is None for s in arg_shapes + aux_shapes):
             return None, None, None
-        if self._op == "_group":
-            outs = [out_shapes[id(s)][s._out_index or 0] for s in self._inputs]
-        else:
-            sink = out_shapes[id(nodes[-1])]
-            outs = [sink[self._out_index]] if self._out_index is not None \
-                else list(sink)
-        return arg_shapes, outs, aux_shapes
+        return arg_shapes, self._collect_heads(out_info, nodes, 0), aux_shapes
 
     def infer_shape_partial(self, **kwargs):
         try:
@@ -226,8 +286,73 @@ class Symbol:
             return None, None, None
 
     def infer_type(self, **kwargs):
-        args = self.list_arguments()
-        return ([_np.float32] * len(args), [_np.float32], [])
+        """Per-arg dtype inference (reference: the FInferType fixed point,
+        src/executor/infer_graph_attr_pass.cc:677). kwargs map arg name ->
+        dtype. Exact path: the abstract-eval walk with real dtypes (needs
+        shapes from ``__shape__`` var attrs / parameter rules, and matches
+        eager execution's promotion by construction). When shapes are
+        unavailable, falls back to dtype-only propagation: result_type
+        promotion over known inputs plus the mxnet-semantics exceptions
+        (Cast -> dtype attr, argmax/argmin -> fp32, creation ops -> their
+        dtype attr)."""
+        kdt = {k: _np.dtype(v) for k, v in kwargs.items()}
+        r = self._infer_walk({}, kdt)
+        if r is not None:
+            out_info, _, known_dt, nodes = r
+            arg_types = [known_dt.get(nm, _np.dtype(_np.float32))
+                         for nm in self.list_arguments()]
+            aux_types = [known_dt.get(nm, _np.dtype(_np.float32))
+                         for nm in self.list_auxiliary_states()]
+            return arg_types, self._collect_heads(out_info, nodes, 1), \
+                aux_types
+        return self._infer_type_propagate(kdt)
+
+    def _infer_type_propagate(self, kdt):
+        """Shape-free dtype propagation (used when shapes are unknown)."""
+        import jax.numpy as jnp
+
+        kdt = dict(kdt)
+        nodes = self._topo()
+        out_dt = {}    # id(node) -> tuple of output dtypes
+
+        for n in nodes:
+            if n._op is None:
+                dt = kdt.get(n._name)
+                if dt is None and n._attrs.get("__dtype__") is not None:
+                    dt = _np.dtype(n._attrs["__dtype__"])
+                    kdt[n._name] = dt
+                out_dt[id(n)] = (dt,)
+                continue
+            if n._op == "_group":
+                continue
+            in_dts = [out_dt[id(i)][min(i._out_index or 0,
+                                        len(out_dt[id(i)]) - 1)]
+                      for i in n._inputs]
+            if any(d is None for d in in_dts):
+                in_dts = _fill_unknown_dtypes(
+                    n, in_dts, kdt,
+                    lambda i, f: out_dt.__setitem__(id(i), (f,)))
+            rule = _DTYPE_RULES.get(n._op)
+            if rule is not None:
+                o = rule(in_dts, n._attrs)
+            elif in_dts:
+                o = _np.dtype(jnp.result_type(*in_dts)) if len(in_dts) > 1 \
+                    else in_dts[0]
+            else:
+                o = _np.dtype(n._attrs.get("dtype", _np.float32))
+            out_dt[id(n)] = (o,) * max(1, n._num_outputs)
+
+        arg_types = [kdt.get(nm, _np.dtype(_np.float32))
+                     for nm in self.list_arguments()]
+        aux_types = [kdt.get(nm, _np.dtype(_np.float32))
+                     for nm in self.list_auxiliary_states()]
+        if self._op == "_group":
+            outs = [out_dt[id(s)][s._out_index or 0] for s in self._inputs]
+        else:
+            sink = out_dt[id(nodes[-1])]
+            outs = [sink[self._out_index]] if self._out_index is not None \
+                else list(sink)
+        return arg_types, outs, aux_types
 
     # ----------------------------------------------------------------- eval
     def eval(self, ctx=None, **kwargs):
@@ -256,11 +381,18 @@ class Symbol:
         idx = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []
         for n in nodes:
+            # __shape__/__dtype__ var metadata round-trips (the reference
+            # serializes these via nnvm node attrs so infer_shape/infer_type
+            # work on loaded graphs); other dunder attrs stay process-local.
+            attrs = {k: v for k, v in n._attrs.items()
+                     if not k.startswith("__") or k in ("__shape__",
+                                                        "__dtype__",
+                                                        "__aux__")}
             jnodes.append({
                 "op": "null" if n._op is None else n._op,
                 "name": n._name,
                 "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
-                          for k, v in n._attrs.items() if not k.startswith("__")},
+                          for k, v in attrs.items()},
                 "inputs": [[idx[id(i)], getattr(i, "_out_index", 0) or 0, 0]
                            for i in n._inputs],
             })
@@ -367,6 +499,27 @@ _PARAM_SHAPE_RULES = {
     "LayerNorm": _ln_shapes,
     "InstanceNorm": _ln_shapes,
     "Embedding": _embed_shapes,
+}
+
+
+# dtype exceptions for the shape-free propagation path (mxnet semantics,
+# matched against this repo's eager ops: comparisons keep the input dtype,
+# argmax/argmin return fp32, Cast/creation ops follow their dtype attr)
+def _attr_dtype(default="float32"):
+    return lambda ins, attrs: _np.dtype(attrs.get("dtype", default))
+
+
+_DTYPE_RULES = {
+    "Cast": lambda ins, attrs: _np.dtype(attrs["dtype"]),
+    "argmax": lambda ins, attrs: _np.dtype(_np.float32),
+    "argmin": lambda ins, attrs: _np.dtype(_np.float32),
+    "one_hot": _attr_dtype(),
+    "zeros": _attr_dtype(),
+    "ones": _attr_dtype(),
+    "full": _attr_dtype(),
+    "arange": _attr_dtype(),
+    "zeros_like": lambda ins, attrs: ins[0],
+    "ones_like": lambda ins, attrs: ins[0],
 }
 
 

@@ -1,0 +1,117 @@
+"""Symbol.infer_type — real dtype inference (VERDICT r3 #2).
+
+Reference: the FInferType fixed point over the nnvm graph
+(src/executor/infer_graph_attr_pass.cc:677). Here the abstract-eval walk
+carries real dtypes through jax.eval_shape, so inferred dtypes match eager
+execution's promotion by construction; a shape-free propagation fallback
+covers graphs without shape annotations.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+sym = mx.sym
+
+
+def test_infer_type_fc_fp16():
+    x = sym.var("x", shape=(8, 16), dtype="float16")
+    fc = sym.FullyConnected(x, num_hidden=4, name="fc")
+    arg_t, out_t, _ = fc.infer_type()
+    assert [str(t) for t in arg_t] == ["float16"] * 3
+    assert str(out_t[0]) == "float16"
+
+
+def test_infer_type_bf16_propagates_to_params():
+    d = sym.var("d", shape=(2, 3, 4, 4), dtype="bfloat16")
+    bn = sym.BatchNorm(d, name="bn")
+    arg_t, out_t, aux_t = bn.infer_type()
+    assert all(str(t) == "bfloat16" for t in arg_t)
+    assert all(str(t) == "bfloat16" for t in aux_t)
+
+
+def test_infer_type_int32_embedding():
+    ids = sym.var("ids", shape=(4, 7), dtype="int32")
+    emb = sym.Embedding(ids, input_dim=100, output_dim=8, name="emb")
+    arg_t, out_t, _ = emb.infer_type(emb_weight="bfloat16")
+    named = dict(zip(emb.list_arguments(), arg_t))
+    assert str(named["ids"]) == "int32"
+    assert str(named["emb_weight"]) == "bfloat16"
+    assert str(out_t[0]) == "bfloat16"
+
+
+def test_infer_type_mixed_promotion_matches_eager():
+    a = sym.var("a", shape=(2, 3), dtype="bfloat16")
+    b = sym.var("b", shape=(2, 3), dtype="float32")
+    c = a + b
+    _, out_t, _ = c.infer_type()
+    eager = (mx.nd.array(np.ones((2, 3))).astype("bfloat16")
+             + mx.nd.array(np.ones((2, 3))))
+    assert np.dtype(out_t[0]) == np.dtype(eager.dtype)
+
+
+def test_infer_type_kwargs_drive_inference():
+    a = sym.var("a", shape=(2, 3))
+    r = sym.relu(a)
+    arg_t, out_t, _ = r.infer_type(a="float16")
+    assert str(arg_t[0]) == "float16" and str(out_t[0]) == "float16"
+
+
+def test_infer_type_cast_and_argmax():
+    x = sym.var("x", shape=(4, 5), dtype="bfloat16")
+    y = sym.Cast(x, dtype="float16")
+    _, out_t, _ = y.infer_type()
+    assert str(out_t[0]) == "float16"
+    z = sym.argmax(sym.var("w", shape=(4, 5), dtype="float16"), axis=1)
+    _, out_t, _ = z.infer_type()
+    # mxnet semantics: argmax returns fp32 regardless of input
+    assert str(out_t[0]) == "float32"
+
+
+def test_infer_type_shape_free_fallback():
+    # no shapes anywhere: the dtype-propagation path must still answer
+    y = sym.var("y")
+    z = sym.Cast(sym.relu(y), dtype="bfloat16")
+    arg_t, out_t, _ = z.infer_type(y="float16")
+    assert str(arg_t[0]) == "float16"
+    assert str(out_t[0]) == "bfloat16"
+
+
+def test_infer_type_json_roundtrip():
+    x = sym.var("x", shape=(8, 16), dtype="float16")
+    fc = sym.FullyConnected(x, num_hidden=4, name="fc")
+    fc2 = sym.load_json(fc.tojson())
+    arg_t, out_t, _ = fc2.infer_type()
+    assert [str(t) for t in arg_t] == ["float16"] * 3
+    assert str(out_t[0]) == "float16"
+    # shapes round-trip too
+    arg_s, out_s, _ = fc2.infer_shape()
+    assert arg_s == [(8, 16), (4, 16), (4,)]
+    assert out_s == [(8, 4)]
+
+
+def test_infer_type_matches_eager_on_mixed_graph():
+    # fp16 data through FC -> relu -> cast bf16 -> add fp32 bias
+    x = sym.var("x", shape=(3, 6), dtype="float16")
+    w = sym.var("w", shape=(4, 6), dtype="float16")
+    b = sym.var("b", shape=(4,), dtype="float16")
+    g = sym.Cast(sym.relu(sym.FullyConnected(x, w, b, num_hidden=4)),
+                 dtype="bfloat16")
+    h = g + sym.var("c", shape=(4,), dtype="float32")
+    _, out_t, _ = h.infer_type()
+
+    rng = np.random.RandomState(0)
+    feed = {"x": mx.nd.array(rng.rand(3, 6)).astype("float16"),
+            "w": mx.nd.array(rng.rand(4, 6)).astype("float16"),
+            "b": mx.nd.array(rng.rand(4)).astype("float16"),
+            "c": mx.nd.array(rng.rand(4))}
+    out = h.eval(**feed)[0]
+    assert np.dtype(out_t[0]) == np.dtype(out.dtype)
+
+
+def test_infer_type_multi_output():
+    x = sym.var("x", shape=(2, 6), dtype="bfloat16")
+    parts = sym.split(x, num_outputs=2, axis=1)
+    _, out_t, _ = parts.infer_type()
+    assert [str(t) for t in out_t] == ["bfloat16", "bfloat16"]
