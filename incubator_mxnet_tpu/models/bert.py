@@ -158,7 +158,18 @@ class BERTModel(HybridBlock):
 
 
 class BERTForPretrain(HybridBlock):
-    """MLM + NSP heads over BERTModel (the benchmarked training config)."""
+    """MLM + NSP heads over BERTModel (the benchmarked training config).
+
+    When ``mlm_positions`` (B, M) int32 is given (KEYWORD-ONLY), the
+    masked positions' hidden states are GATHERED before the
+    transform/decoder so the 768x30522 vocab projection runs only on the
+    ~15% masked slots — the reference decodes masked_positions the same
+    way (GluonNLP BERTModel's ``masked_positions`` argument / reference
+    `python/mxnet` pretraining recipe); decoding all T positions
+    materializes a (B,T,V) logits tensor (1 GB at B=64 T=128 fp32) that
+    the objective immediately discards. Without ``mlm_positions`` the
+    full-sequence logits are returned (the fine-tune / scoring path).
+    """
 
     def __init__(self, bert=None, vocab_size=30522, **kwargs):
         super().__init__(**kwargs)
@@ -171,8 +182,21 @@ class BERTForPretrain(HybridBlock):
                                         prefix="decoder_")
             self.nsp = nn.Dense(2, prefix="nsp_")
 
-    def hybrid_forward(self, F, token_ids, token_types=None, valid_mask=None):
+    def hybrid_forward(self, F, token_ids, token_types=None,
+                       valid_mask=None, *, mlm_positions=None):
+        # keyword-only: the pre-r4 positional contract (ids, types, mask)
+        # keeps working; a mask can never silently land in the positions
+        # slot (call sites that pipeline positional data through a trainer
+        # wrap the model — see bench.py's _BertPretrainStep)
         seq, pooled = self.bert(token_ids, token_types, valid_mask)
+        if mlm_positions is not None:
+            B = token_ids.shape[0]
+            M = mlm_positions.shape[1]
+            rows = F.broadcast_to(
+                F.reshape(F.arange(0, B, dtype="int32"), shape=(B, 1)),
+                shape=(B, M))
+            idx = F.stack(rows, mlm_positions, axis=0)      # (2, B, M)
+            seq = F.gather_nd(seq, idx)                     # (B, M, units)
         mlm = self.mlm_decoder(self.mlm_ln(self.mlm_dense(seq)))
         nsp = self.nsp(pooled)
         return mlm, nsp
