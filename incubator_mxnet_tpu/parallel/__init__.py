@@ -17,11 +17,12 @@ Components:
 from .mesh import make_mesh, replicate, shard_like, P
 from .trainer import ShardedTrainer, sharding_rules
 from .ring_attention import ring_attention, local_attention
-from .pipeline import pipeline_apply, stack_stage_params
+from .ring_attention import ring_flash_attention
+from .pipeline import pipeline_apply, stack_stage_params, PipelineStack
 from .moe import MoEBlock, moe_apply
 from . import collectives
 
 __all__ = ["make_mesh", "replicate", "shard_like", "P", "ShardedTrainer",
-           "sharding_rules", "ring_attention", "local_attention",
-           "pipeline_apply", "stack_stage_params", "MoEBlock", "moe_apply",
-           "collectives"]
+           "sharding_rules", "ring_attention", "ring_flash_attention",
+           "local_attention", "pipeline_apply", "stack_stage_params",
+           "PipelineStack", "MoEBlock", "moe_apply", "collectives"]

@@ -460,3 +460,62 @@ def test_multidevice_convergence_lenet():
         np.testing.assert_allclose(np.asarray(jax.device_get(p1[k])),
                                    np.asarray(jax.device_get(m8[suffix(k)])),
                                    rtol=5e-3, atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# ring + flash composition (VERDICT r3 #4: flash inner loop, ring outer loop)
+# ---------------------------------------------------------------------------
+
+def _rand_qkv(B, H, T, D, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.rand(B, H, T, D).astype(np.float32))
+                 for _ in range(3))
+
+
+def test_ring_flash_matches_dense_ring():
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, H, T, D = 2, 2, 64, 8          # T_local = 16: flash tiling contract
+    q, k, v = _rand_qkv(B, H, T, D, seed=3)
+    dense = make_ring_attention(mesh, seq_axis="sp", impl="dense")(q, k, v)
+    flash = make_ring_attention(mesh, seq_axis="sp", impl="flash",
+                                interpret=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)
+    # and both match single-device attention
+    num, den, _ = local_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(num / den),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_flash_causal_matches_dense_ring():
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, H, T, D = 1, 2, 64, 8
+    q, k, v = _rand_qkv(B, H, T, D, seed=4)
+    flash = make_ring_attention(mesh, seq_axis="sp", causal=True,
+                                impl="flash", interpret=True)(q, k, v)
+    num, den, _ = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(num / den),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_gradients_match_dense(causal):
+    """The ring-flash custom VJP (dK/dV accumulators riding the ring) must
+    produce the same gradients as differentiating the einsum ring."""
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, H, T, D = 1, 2, 64, 8
+    q, k, v = _rand_qkv(B, H, T, D, seed=5)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    dense_fn = make_ring_attention(mesh, seq_axis="sp", causal=causal,
+                                   impl="dense")
+    flash_fn = make_ring_attention(mesh, seq_axis="sp", causal=causal,
+                                   impl="flash", interpret=True)
+    gd = jax.grad(loss(dense_fn), argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss(flash_fn), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg="d%s mismatch" % name)
