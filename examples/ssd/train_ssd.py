@@ -1,82 +1,87 @@
 #!/usr/bin/env python
-"""SSD-style detection training step (reference: example/ssd/train.py).
+"""SSD detection training (reference: example/ssd/train.py).
 
-Shows the full target-assignment -> loss -> detection-decode pipeline on a
-toy backbone with MultiBoxPrior/MultiBoxTarget/MultiBoxDetection, all
-jit-compatible (static shapes, -1-padded NMS)."""
+Trains the zoo SSD (`--network resnet50` = ssd_512_resnet50_v1, the
+BASELINE config-5 model; `--network toy` for a quick run) on synthetic
+detection data through the same ShardedTrainer step as every other model,
+then evaluates VOC07 mAP with the MultiBoxDetection decode. The whole
+train step (multi-scale forward, MultiBoxTarget assignment with
+hard-negative mining, CE + SmoothL1, optimizer) is ONE XLA program.
+"""
 
-import numpy as np
-
+import argparse
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
-import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import nd, autograd, gluon
-from incubator_mxnet_tpu import ops
-
-
-class ToySSD(gluon.HybridBlock):
-    def __init__(self, num_classes=2, **kw):
-        super().__init__(**kw)
-        with self.name_scope():
-            self.backbone = gluon.nn.HybridSequential()
-            for f in (16, 32, 64):
-                self.backbone.add(gluon.nn.Conv2D(f, 3, strides=2, padding=1,
-                                                  activation="relu"))
-            # anchors/pixel = len(sizes) + len(ratios) - 1 = 3
-            self.cls_head = gluon.nn.Conv2D((num_classes + 1) * 3, 3,
-                                            padding=1)
-            self.loc_head = gluon.nn.Conv2D(4 * 3, 3, padding=1)
-        self.num_classes = num_classes
-
-    def hybrid_forward(self, F, x):
-        feat = self.backbone(x)
-        b = feat.shape[0] if hasattr(feat, "shape") else feat.shape[0]
-        cls = self.cls_head(feat)      # (B, (C+1)*A, H, W)
-        loc = self.loc_head(feat)      # (B, 4A, H, W)
-        anchors = ops.MultiBoxPrior(feat, sizes=(0.2, 0.4), ratios=(1, 2))
-        return cls, loc, anchors
+import numpy as np
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="toy",
+                    choices=["toy", "resnet50"])
+    ap.add_argument("--data-size", type=int, default=0,
+                    help="input resolution (default 64 toy / 512 resnet50)")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.models.ssd import (ssd_toy,
+                                                ssd_512_resnet50_v1,
+                                                ssd_targets, ssd_decode,
+                                                synthetic_detection_data
+                                                as make_data)
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    size = args.data_size or (64 if args.network == "toy" else 512)
     np.random.seed(0)
-    num_classes = 2
-    net = ToySSD(num_classes)
+    net = ssd_toy(2) if args.network == "toy" \
+        else ssd_512_resnet50_v1(num_classes=2)
     net.initialize(mx.init.Xavier())
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.01})
-    ce = gluon.loss.SoftmaxCrossEntropyLoss()
-    l1 = gluon.loss.L1Loss()
+    net(nd.array(np.zeros((1, 3, size, size), np.float32)))
 
-    for step in range(10):
-        x = nd.array(np.random.rand(4, 3, 64, 64).astype(np.float32))
-        label = np.full((4, 3, 5), -1.0, np.float32)
-        label[:, 0] = [1, 0.2, 0.2, 0.6, 0.6]  # one gt box per image
-        label = nd.array(label)
-        with autograd.record():
-            cls, loc, anchors = net(x)
-            b = cls.shape[0]
-            n_anchor = anchors.shape[1]
-            cls = cls.reshape((b, num_classes + 1, -1))
-            loc = loc.reshape((b, -1))
-            box_t, box_m, cls_t = nd.contrib_multibox_target(
-                anchors, label, cls) if hasattr(nd, "contrib_multibox_target") \
-                else nd.MultiBoxTarget(anchors, label, cls)
-            loss = ce(cls.transpose((0, 2, 1)), cls_t) + \
-                l1(loc * box_m, box_t)
-        loss.backward()
-        trainer.step(4)
-        print("step %d loss %.4f" % (step, float(loss.mean()._data)))
+    Xtr, Ytr = make_data(256, size, seed=1)
+    Xte, Yte = make_data(64, size, seed=2)
 
-    # inference decode
-    cls, loc, anchors = net(x)
-    b = cls.shape[0]
-    probs = nd.softmax(cls.reshape((b, num_classes + 1, -1)), axis=1)
-    det = nd.MultiBoxDetection(probs, loc.reshape((b, -1)), anchors)
-    print("detections:", det.shape)
+    def det_loss(out, labels):
+        cls, loc, anchors = out
+        return ssd_targets(cls, loc, anchors, labels)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr = ShardedTrainer(net, det_loss, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr},
+                        data_specs=P(), label_spec=P())
+    B = args.batch_size
+    if B > len(Xtr):
+        raise SystemExit("--batch-size %d exceeds the %d-image training set"
+                         % (B, len(Xtr)))
+    for epoch in range(args.epochs):
+        order = np.random.permutation(len(Xtr))
+        t0 = time.perf_counter()
+        n = 0
+        for i in range(0, len(Xtr) - B + 1, B):
+            idx = order[i:i + B]
+            loss = tr.step(Xtr[idx], Ytr[idx])
+            n += B
+        dt = time.perf_counter() - t0
+        print("epoch %d loss %.4f (%.1f imgs/s)"
+              % (epoch, float(loss), n / dt))
+    tr.sync_to_block()
+
+    metric = mx.metric.create("VOC07MApMetric", ovp_thresh=0.5)
+    cls, loc, anchors = net(nd.array(Xte))
+    det = ssd_decode(cls._data, loc._data, anchors._data, threshold=0.2)
+    metric.update([Yte], [np.asarray(det)])
+    print("held-out %s = %.4f" % metric.get())
 
 
 if __name__ == "__main__":

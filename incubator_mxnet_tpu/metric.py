@@ -12,7 +12,7 @@ import numpy as _np
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
            "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
            "Perplexity", "PearsonCorrelation", "Loss", "CompositeEvalMetric",
-           "CustomMetric", "np", "create"]
+           "CustomMetric", "MApMetric", "VOC07MApMetric", "np", "create"]
 
 _METRIC_REGISTRY = {}
 
@@ -370,6 +370,116 @@ class CompositeEvalMetric(EvalMetric):
             names.append(name)
             values.append(value)
         return (names, values)
+
+
+@register
+class MApMetric(EvalMetric):
+    """Mean average precision for detection (BASELINE config 5 eval;
+    reference: example/ssd/evaluate/eval_metric.py MApMetric).
+
+    update(labels, preds): labels (B, M, 5) rows [cls, x0, y0, x1, y1]
+    (-1-padded); preds (B, N, 6) rows [cls, score, x0, y0, x1, y1] with
+    suppressed rows' cls = -1 (MultiBoxDetection output). AP integration:
+    precision-envelope area (VOC 2010+); VOC07MApMetric does the 11-point
+    interpolation."""
+
+    def __init__(self, ovp_thresh=0.5, class_names=None, name="mAP",
+                 **kwargs):
+        self._thresh = float(ovp_thresh)
+        self._class_names = class_names
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self._n_pos = {}
+        self._records = {}       # cls -> list of (score, is_tp)
+
+    @staticmethod
+    def _iou(box, boxes):
+        x0 = _np.maximum(box[0], boxes[:, 0])
+        y0 = _np.maximum(box[1], boxes[:, 1])
+        x1 = _np.minimum(box[2], boxes[:, 2])
+        y1 = _np.minimum(box[3], boxes[:, 3])
+        inter = _np.clip(x1 - x0, 0, None) * _np.clip(y1 - y0, 0, None)
+        a = (box[2] - box[0]) * (box[3] - box[1])
+        b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / _np.maximum(a + b - inter, 1e-12)
+
+    def update(self, labels, preds):
+        for lab, det in zip(labels, preds):
+            lab = _as_numpy(lab)
+            det = _as_numpy(det)
+            if lab.ndim == 2:
+                lab, det = lab[None], det[None]
+            for b in range(lab.shape[0]):
+                gts = lab[b][lab[b, :, 0] >= 0]
+                dets = det[b][det[b, :, 0] >= 0]
+                order = _np.argsort(-dets[:, 1]) if len(dets) else []
+                classes = set(gts[:, 0].astype(int)) | \
+                    set(dets[:, 0].astype(int))
+                for c in classes:
+                    gt_c = gts[gts[:, 0].astype(int) == c][:, 1:5]
+                    self._n_pos[c] = self._n_pos.get(c, 0) + len(gt_c)
+                    used = _np.zeros(len(gt_c), bool)
+                    recs = self._records.setdefault(c, [])
+                    for i in order:
+                        if int(dets[i, 0]) != c:
+                            continue
+                        score, box = dets[i, 1], dets[i, 2:6]
+                        if len(gt_c):
+                            ious = self._iou(box, gt_c)
+                            j = int(_np.argmax(ious))
+                            if ious[j] >= self._thresh and not used[j]:
+                                used[j] = True
+                                recs.append((score, 1))
+                                continue
+                        recs.append((score, 0))
+        self.num_inst = 1   # get() computes the aggregate directly
+
+    def _ap(self, rec, prec):
+        # precision-envelope area (VOC 2010+)
+        mrec = _np.concatenate([[0.0], rec, [1.0]])
+        mpre = _np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = _np.where(mrec[1:] != mrec[:-1])[0]
+        return float(_np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def get(self):
+        aps = []
+        for c, npos in self._n_pos.items():
+            if npos == 0:
+                continue
+            recs = sorted(self._records.get(c, []), key=lambda r: -r[0])
+            if not recs:
+                aps.append(0.0)
+                continue
+            tp = _np.cumsum([r[1] for r in recs]).astype(float)
+            fp = _np.cumsum([1 - r[1] for r in recs]).astype(float)
+            rec = tp / npos
+            prec = tp / _np.maximum(tp + fp, 1e-12)
+            aps.append(self._ap(rec, prec))
+        if not aps:
+            return (self.name, float("nan"))
+        return (self.name, float(_np.mean(aps)))
+
+
+@register
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (the VOC2007 protocol the reference's SSD
+    tables use — example/ssd/evaluate/eval_metric.py VOC07MApMetric)."""
+
+    def __init__(self, ovp_thresh=0.5, class_names=None, name="VOC07_mAP",
+                 **kwargs):
+        super().__init__(ovp_thresh, class_names, name=name, **kwargs)
+
+    def _ap(self, rec, prec):
+        ap = 0.0
+        for t in _np.arange(0.0, 1.1, 0.1):
+            sel = prec[rec >= t]
+            ap += (float(sel.max()) if len(sel) else 0.0) / 11.0
+        return ap
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

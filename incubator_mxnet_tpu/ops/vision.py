@@ -110,12 +110,14 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
                        force_suppress=False,
                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
     """Decode SSD predictions to (B, N, 6) rows [cls_id, score, x0,y0,x1,y1]
-    with suppressed/invalid rows set to -1 (reference output format)."""
-    anchors = anchor.reshape(-1, 4)
-    aw = anchors[:, 2] - anchors[:, 0]
-    ah = anchors[:, 3] - anchors[:, 1]
-    ax = (anchors[:, 0] + anchors[:, 2]) / 2
-    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    with suppressed/invalid rows set to -1 (reference output format).
+    anchor: (1, N, 4) shared, or (B, N, 4) per-image (the pre-NMS top-k
+    path gathers a different anchor subset per image)."""
+    anchors = anchor if anchor.ndim == 3 else anchor.reshape(1, -1, 4)
+    aw = anchors[..., 2] - anchors[..., 0]                   # (1|B, N)
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) / 2
+    ay = (anchors[..., 1] + anchors[..., 3]) / 2
 
     loc = loc_pred.reshape(loc_pred.shape[0], -1, 4)         # (B, N, 4)
     cx = loc[..., 0] * variances[0] * aw + ax

@@ -1,0 +1,112 @@
+"""SSD detection end-to-end (BASELINE config 5; VERDICT r3 #3).
+
+Reference bar: example/ssd/train.py trains a real SSD and publishes mAP
+(evaluate/eval_metric.py). Here: the SSDDetector zoo model trains on
+synthetic-but-nontrivial detection data (colored rectangles on noise) to a
+VOC07 mAP threshold, through the same ShardedTrainer step as every other
+model; decode runs through the jit-compatible MultiBoxDetection path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.models.ssd import (ssd_toy, ssd_512_resnet50_v1,
+                                            ssd_targets, ssd_decode,
+                                            synthetic_detection_data
+                                            as _make_detection_data)
+from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+
+def test_ssd_toy_trains_to_map():
+    """Train ssd_toy to VOC07 mAP >= 0.5 on held-out synthetic data."""
+    np.random.seed(0)
+    Xtr, Ytr = _make_detection_data(256, seed=1)
+    Xte, Yte = _make_detection_data(64, seed=2)
+
+    net = ssd_toy(num_classes=2)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(Xtr[0:1]))
+
+    def det_loss(out, labels):
+        cls, loc, anchors = out
+        return ssd_targets(cls, loc, anchors, labels)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr = ShardedTrainer(net, det_loss, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": 2e-3},
+                        data_specs=P(), label_spec=P())
+    B = 32
+    first = last = None
+    for epoch in range(10):
+        order = np.random.permutation(len(Xtr))
+        for i in range(0, len(Xtr) - B + 1, B):
+            idx = order[i:i + B]
+            loss = tr.step(Xtr[idx], Ytr[idx])
+        last = float(loss)
+        if first is None:
+            first = last
+    assert last < first, (first, last)
+    tr.sync_to_block()
+
+    metric = mx.metric.create("VOC07MApMetric", ovp_thresh=0.5)
+    cls, loc, anchors = net(nd.array(Xte))
+    det = ssd_decode(cls._data, loc._data, anchors._data,
+                     nms_threshold=0.45, threshold=0.2)
+    metric.update([Yte], [np.asarray(det)])
+    name, val = metric.get()
+    print("ssd_toy held-out %s = %.4f (loss %.3f -> %.3f)"
+          % (name, val, first, last))
+    assert val >= 0.5, "mAP too low: %.4f" % val
+
+
+def test_ssd_resnet50_builds_and_steps():
+    """The flagship ssd_512_resnet50_v1 wires up (6 scales, resnet-50
+    trunk) and runs one train step + decode at a reduced input size."""
+    np.random.seed(0)
+    net = ssd_512_resnet50_v1(num_classes=3)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(1, 3, 256, 256).astype(np.float32))
+    cls, loc, anchors = net(x)
+    n_anchor = anchors.shape[1]
+    assert cls.shape == (1, 4, n_anchor)
+    assert loc.shape == (1, n_anchor * 4)
+
+    labels = np.full((1, 3, 5), -1.0, np.float32)
+    labels[0, 0] = [1, 0.2, 0.2, 0.7, 0.7]
+
+    def det_loss(out, lab):
+        c, l, a = out
+        return ssd_targets(c, l, a, lab)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr = ShardedTrainer(net, det_loss, mesh, optimizer="sgd",
+                        optimizer_params={"learning_rate": 1e-3,
+                                          "momentum": 0.9},
+                        data_specs=P(), label_spec=P())
+    loss = float(tr.step(np.asarray(x._data), labels))
+    assert np.isfinite(loss)
+
+    det = ssd_decode(cls._data, loc._data, anchors._data)
+    # decode pre-selects top-400 anchors before NMS (the SSD recipe)
+    assert np.asarray(det).shape == (1, min(400, n_anchor), 6)
+
+
+def test_map_metric_known_values():
+    """Hand-checkable mAP: one TP at IoU 1.0 + one FP -> VOC07 AP 1.0 for
+    the matched class, 0 for a class with a missed gt."""
+    m = mx.metric.create("MApMetric")
+    lab = np.array([[[0, .1, .1, .5, .5],
+                     [1, .6, .6, .9, .9]]], np.float32)
+    pred = np.array([[[0, .9, .1, .1, .5, .5],       # exact TP cls 0
+                      [0, .5, .7, .7, .9, .9],       # FP cls 0 (low score)
+                      [-1, -1, -1, -1, -1, -1]]], np.float32)
+    m.update([lab], [pred])
+    _, val = m.get()
+    # cls 0: AP 1.0 (TP ranked above FP); cls 1: no det -> AP 0
+    np.testing.assert_allclose(val, 0.5, atol=1e-6)
