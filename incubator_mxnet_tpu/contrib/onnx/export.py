@@ -2,8 +2,11 @@
 
 Reference parity: python/mxnet/contrib/onnx/mx2onnx/_op_translations.py
 (~90 per-op converters) per SURVEY §2.6. The graph is produced as an
-ONNX-shaped dict (node/input/initializer/output, opset-13 op names and
-attribute spellings); parameter tensors are embedded base64(float32) in
+ONNX-shaped dict (node/input/initializer/output, opset-10 attribute
+spellings — attributes-not-inputs for Reshape/Squeeze/Clip/TopK/Pad —
+plus a few later-opset convenience op names; the emitted ``dialect`` key
+marks it as this repo's JSON interchange format, not wire-compatible
+ONNX protobuf); parameter tensors are embedded base64(float32) in
 the initializers so an exported file is self-contained. Multi-node
 translations (scalar ops -> Constant + binary op) follow the reference's
 converter structure.
@@ -313,7 +316,15 @@ def symbol_to_onnx_graph(sym, params=None, embed_params=True):
             emitted[n._name] = outs
         name_of[id(n)] = outs[n._out_index or 0]
     outputs = [{"name": name_of[id(nodes[-1])]}]
-    return {"ir_version": 8, "opset": 13,
+    # opset 10: the attribute spellings emitted here (Reshape shape,
+    # Squeeze/Unsqueeze axes, ReduceSum axes, Clip min/max, TopK k, Pad
+    # pads, Dropout ratio as ATTRIBUTES) are the opset-10 forms — later
+    # opsets moved them to inputs. `dialect` flags that this is the
+    # JSON-dict interchange format, not wire-compatible ONNX protobuf
+    # (a few convenience ops — Gelu, LayerNormalization — come from later
+    # opsets; the matching importer in import_.py accepts them).
+    return {"ir_version": 5, "opset": 10,
+            "dialect": "incubator_mxnet_tpu_json",
             "graph": {"node": onnx_nodes, "input": inputs,
                       "initializer": initializers, "output": outputs}}
 

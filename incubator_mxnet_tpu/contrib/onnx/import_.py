@@ -30,10 +30,27 @@ def _reduce(a):
     return out
 
 
+def _gemm_attrs(a):
+    """FullyConnected covers exactly the form this exporter emits
+    (y = x·Wᵀ + b): transB=1, no transA, unit alpha/beta. Any other Gemm
+    (e.g. transB=0, the ONNX default in externally produced graphs) has
+    DIFFERENT weight semantics — refuse rather than silently import a
+    transposed weight."""
+    if (a.get("transA", 0) != 0 or a.get("transB", 0) != 1
+            or a.get("alpha", 1.0) != 1.0 or a.get("beta", 1.0) != 1.0):
+        raise NotImplementedError(
+            "Gemm with transA=%r transB=%r alpha=%r beta=%r has no "
+            "FullyConnected equivalent (only transB=1, alpha=beta=1 "
+            "imports; transpose the weight initializer externally)"
+            % (a.get("transA", 0), a.get("transB", 0),
+               a.get("alpha", 1.0), a.get("beta", 1.0)))
+    return {}
+
+
 # ONNX op -> (mx op, attr translation)
 ONNX2MX_OPS = {
     # --- layers
-    "Gemm": ("FullyConnected", lambda a: {}),
+    "Gemm": ("FullyConnected", _gemm_attrs),
     "MatMul": ("dot", lambda a: {}),
     "Conv": ("Convolution", lambda a: {
         "kernel": tuple(a.get("kernel_shape", ())),

@@ -16,6 +16,7 @@ __all__ = ["optimizer_to_spec", "optimizer_from_spec"]
 # runtime bookkeeping that must not travel / is rebuilt server-side
 _SKIP_KEYS = {"param_dict", "_index_update_count"}
 _INT_DICT = "__int_keys__"
+_STR_DICT = "__str_keys__"
 
 
 def _clean(value, path):
@@ -26,18 +27,30 @@ def _clean(value, path):
     if isinstance(value, dict):
         if all(isinstance(k, str) for k in value):
             return {k: _clean(v, path) for k, v in value.items()}
-        if all(isinstance(k, int) for k in value):
-            # idx2name / lr_mult key by parameter index
-            return {_INT_DICT: {str(k): _clean(v, path)
-                                for k, v in value.items()}}
+        if all(isinstance(k, (int, str)) for k in value):
+            # idx2name / lr_mult key by parameter index; folding param_dict
+            # multipliers can leave a MIXED int+str keyed dict when the user
+            # also set name-keyed mults — split into tagged sub-dicts so
+            # the state stays on the no-code-execution spec path
+            out = {_INT_DICT: {str(k): _clean(v, path)
+                               for k, v in value.items()
+                               if isinstance(k, int)}}
+            strs = {k: _clean(v, path) for k, v in value.items()
+                    if isinstance(k, str)}
+            if strs:
+                out[_STR_DICT] = strs
+            return out
     raise TypeError("optimizer attribute %r is not JSON-clean (%r)"
                     % (path, type(value).__name__))
 
 
 def _restore(value):
     if isinstance(value, dict):
-        if set(value) == {_INT_DICT}:
-            return {int(k): _restore(v) for k, v in value[_INT_DICT].items()}
+        if _INT_DICT in value and set(value) <= {_INT_DICT, _STR_DICT}:
+            out = {int(k): _restore(v) for k, v in value[_INT_DICT].items()}
+            out.update({k: _restore(v)
+                        for k, v in value.get(_STR_DICT, {}).items()})
+            return out
         return {k: _restore(v) for k, v in value.items()}
     if isinstance(value, list):
         return [_restore(v) for v in value]

@@ -272,12 +272,20 @@ def unpack_img(s, iscolor=-1):
             img = cv2.imdecode(_np.frombuffer(raw, dtype=_np.uint8), iscolor)
         except ImportError:
             # PIL decode fallback, mirroring pack_img's PIL encode path
-            # (BGR array convention on both sides, matching cv2)
+            # (BGR array convention on both sides, matching cv2) — honors
+            # iscolor the way cv2.imdecode does: 0 -> 2D grayscale,
+            # >0 -> 3-channel, <0 -> as-stored
             try:
                 from PIL import Image
                 import io as _io
-                img = _np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
-                img = img[:, :, ::-1].copy()            # RGB -> BGR
+                im = Image.open(_io.BytesIO(raw))
+                if iscolor == 0:
+                    img = _np.asarray(im.convert("L"))
+                elif iscolor > 0 or im.mode not in ("L", "I;16", "1"):
+                    img = _np.asarray(im.convert("RGB"))
+                    img = img[:, :, ::-1].copy()        # RGB -> BGR
+                else:                                   # as-stored grayscale
+                    img = _np.asarray(im.convert("L"))
             except ImportError:
                 raise IOError("neither cv2 nor PIL available to decode "
                               "compressed image records")
