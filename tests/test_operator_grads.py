@@ -1,0 +1,414 @@
+"""Finite-difference gradient sweep over the FULL op registry (VERDICT r3
+#9: "the ledger should fail on a differentiable op with forward-only
+coverage").
+
+Every registered op is accounted for in exactly one way:
+  * GRAD_AUTO   — probed with generic small float inputs; FD vs autodiff
+                  checked right here (includes zero-gradient-a.e. ops like
+                  comparisons/floor, where both sides must agree at 0).
+  * GRAD_SPECS  — ops needing specific shapes/attrs; explicit invocation,
+                  FD vs autodiff checked here.
+  * NON_DIFF    — op -> reason (integer/index outputs, RNG draws,
+                  optimizer state-update kernels, target-assignment /
+                  NMS decode inference ops, creation ops with no float
+                  inputs). The reason string is the audit trail.
+``test_gradient_ledger_is_complete`` FAILS when an op is in none of the
+three — a new differentiable op cannot land with forward-only coverage.
+Reference analogue: python/mxnet/test_utils.py:801 check_numeric_gradient
+applied per-op in tests/python/unittest/test_operator.py.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ops.registry import list_ops
+from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState(7)
+
+
+@pytest.fixture(autouse=True)
+def _reseed_module_rng():
+    """Spec lambdas draw from the shared RNG at call time; reseeding per
+    test makes every case's inputs order-independent (a -k filtered run
+    sees the same numbers as the full sweep)."""
+    RNG.seed(7)
+
+
+def _sum_all(res):
+    if isinstance(res, (tuple, list)):
+        out = res[0].sum()
+        for r in res[1:]:
+            out = out + r.sum()
+        return out
+    return res
+
+
+def _op_fn(name, attrs=None, n_outputs_summed=True):
+    attrs = attrs or {}
+
+    def fn(*xs):
+        res = getattr(nd, name)(*xs, **attrs)
+        return _sum_all(res)
+    return fn
+
+
+def _pos(*shape):
+    return RNG.rand(*shape).astype(np.float32) + 0.5
+
+
+def _sym(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+def _pd(n):
+    a = RNG.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# AUTO: generic (2,3)-float invocations discovered by probing the registry.
+# arity -> op names. Zero-gradient ops (comparisons, floor, argmax, ...)
+# stay here deliberately: FD and autodiff must BOTH be ~0.
+# ---------------------------------------------------------------------------
+
+GRAD_AUTO_1 = [
+    "Activation", "Concat", "Flatten",
+    "IdentityAttachKLSparseReg", "L2Normalization", "LRN", "LeakyReLU",
+    "Pooling", "Reshape", "SVMOutput", "SequenceLast", "SequenceMask",
+    "SequenceReverse", "SoftmaxActivation", "SoftmaxOutput", "_histogram",
+    "_rnn_param_concat", "_slice_assign_scalar", "_square_sum",
+    "abs", "add_n", "arcsinh", "arctan", "argmax", "argmax_channel",
+    "argmin", "argsort", "broadcast_axis", "cbrt", "ceil", "cos", "cosh",
+    "degrees", "diag", "erf", "exp", "expm1", "fft", "fix",
+    "floor", "gamma", "gammaln", "gelu", "gradient_multiplier",
+    "hard_sigmoid", "identity", "image_flip_left_right", "image_normalize",
+    "khatri_rao", "linalg_extractdiag", "linalg_makediag",
+    "linalg_maketrian", "linalg_syrk", "log", "log10", "log1p", "log2",
+    "log_softmax", "logical_not", "make_loss", "max", "mean", "min",
+    "nanprod", "nansum", "negative", "norm", "ones_like", "prod",
+    "quadratic", "radians", "rcbrt", "reciprocal", "relu", "rint", "round",
+    "rsqrt", "sigmoid", "sign", "sin", "sinh", "smooth_l1", "softmax",
+    "softmin", "softsign", "sort", "sqrt", "square", "squeeze", "stack",
+    "sum", "swapaxes", "swish", "tan", "tanh", "topk", "transpose",
+    "trunc", "zeros_like",
+]
+
+GRAD_AUTO_2 = [
+    "FullyConnected", "_div_scalar", "_equal_scalar", "_grad_add",
+    "_greater_equal_scalar", "_greater_scalar", "_hypot_scalar",
+    "_identity_with_attr_like_rhs", "_lesser_equal_scalar",
+    "_lesser_scalar", "_logical_and_scalar", "_logical_or_scalar",
+    "_logical_xor_scalar", "_maximum_scalar", "_minimum_scalar",
+    "_minus_scalar", "_mod_scalar", "_mul_scalar", "_not_equal_scalar",
+    "_plus_scalar", "_power_scalar", "_rdiv_scalar", "_rminus_scalar",
+    "_rmod_scalar", "_rpower_scalar", "_scatter_elemwise_div",
+    "_scatter_minus_scalar", "_scatter_plus_scalar", "allclose", "box_iou",
+    "broadcast_add", "broadcast_arctan2", "broadcast_divide",
+    "broadcast_equal", "broadcast_greater", "broadcast_greater_equal",
+    "broadcast_hypot", "broadcast_lesser", "broadcast_lesser_equal",
+    "broadcast_like", "broadcast_logical_and", "broadcast_logical_or",
+    "broadcast_logical_xor", "broadcast_maximum", "broadcast_minimum",
+    "broadcast_mod", "broadcast_multiply", "broadcast_not_equal",
+    "broadcast_power", "broadcast_subtract", "reshape_like", "slice_like",
+]
+
+GRAD_AUTO_3 = ["clip", "where"]
+
+
+@pytest.mark.parametrize("name", sorted(GRAD_AUTO_1), ids=sorted(GRAD_AUTO_1))
+def test_grad_auto_unary(name):
+    check_numeric_gradient(_op_fn(name), [_pos(2, 3)], rtol=5e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("name", sorted(GRAD_AUTO_2), ids=sorted(GRAD_AUTO_2))
+def test_grad_auto_binary(name):
+    check_numeric_gradient(_op_fn(name), [_pos(2, 3), _pos(2, 3)],
+                           rtol=5e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("name", sorted(GRAD_AUTO_3), ids=sorted(GRAD_AUTO_3))
+def test_grad_auto_ternary(name):
+    check_numeric_gradient(_op_fn(name),
+                           [_pos(2, 3), _pos(2, 3), _pos(2, 3)],
+                           rtol=5e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# SPECS: (inputs builder, attrs, grad_nodes or None) per op that needs a
+# real shape/attr contract. grad_nodes restricts FD to the float inputs
+# (index/label operands get no FD pass).
+# ---------------------------------------------------------------------------
+
+def _rnn_spec():
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    T_, N_, C, H = 3, 2, 3, 4
+    n = rnn_param_size(C, H, 1, "lstm")
+    return ([_sym(T_, N_, C), _sym(n) * 0.2, np.zeros((1, N_, H), np.float32),
+             np.zeros((1, N_, H), np.float32)],
+            {"state_size": H, "num_layers": 1, "mode": "lstm"}, [0, 1])
+
+
+GRAD_SPECS = {
+    "Convolution": lambda: ([_sym(1, 2, 5, 5), _sym(3, 2, 3, 3) * 0.4,
+                             _sym(3) * 0.1],
+                            {"kernel": (3, 3), "num_filter": 3}, None),
+    "Deconvolution": lambda: ([_sym(1, 2, 4, 4), _sym(2, 3, 3, 3) * 0.4,
+                               _sym(3) * 0.1],
+                              {"kernel": (3, 3), "num_filter": 3}, None),
+    "BatchNorm": lambda: ([_sym(2, 3, 4, 4), _pos(3), _sym(3),
+                           np.zeros(3, np.float32), np.ones(3, np.float32)],
+                          {"fix_gamma": False}, [0, 1, 2]),
+    "LayerNorm": lambda: ([_sym(2, 6), _pos(6), _sym(6)], {}, None),
+    "InstanceNorm": lambda: ([_sym(2, 3, 5), _pos(3), _sym(3)], {}, None),
+    "AdaptiveAvgPooling2D": lambda: ([_sym(1, 2, 6, 6)],
+                                     {"output_size": (2, 2)}, None),
+    "BilinearResize2D": lambda: ([_sym(1, 2, 4, 4)],
+                                 {"height": 7, "width": 7}, None),
+    "BilinearSampler": lambda: ([_sym(1, 2, 5, 5),
+                                 np.clip(_sym(1, 2, 4, 4) * 0.4, -0.9, 0.9)],
+                                {}, None),
+    "GridGenerator": lambda: ([_sym(1, 6) * 0.3],
+                              {"transform_type": "affine",
+                               "target_shape": (4, 4)}, None),
+    "SpatialTransformer": lambda: ([_sym(1, 2, 5, 5), _sym(1, 6) * 0.2],
+                                   {"target_shape": (4, 4),
+                                    "transform_type": "affine",
+                                    "sampler_type": "bilinear"}, None),
+    "CTCLoss": lambda: ([_sym(4, 2, 5),
+                         np.array([[1, 2], [2, 1]], np.float32)], {}, [0]),
+    "Correlation": lambda: ([_sym(1, 2, 5, 5), _sym(1, 2, 5, 5)],
+                            {"kernel_size": 1, "max_displacement": 1,
+                             "stride1": 1, "stride2": 1}, None),
+    "Crop": lambda: ([_sym(1, 2, 6, 6)],
+                     {"h_w": (4, 4), "offset": (1, 1)}, None),
+    "SliceChannel": lambda: ([_sym(2, 6)],
+                             {"num_outputs": 3, "axis": 1}, None),
+    "UpSampling": lambda: ([_sym(1, 2, 3, 3)],
+                           {"scale": 2, "sample_type": "nearest"}, None),
+    "RNN": _rnn_spec,
+    "ROIAlign": lambda: ([_sym(1, 2, 6, 6),
+                          np.array([[0, 0.5, 0.5, 4.5, 4.5]], np.float32)],
+                         {"pooled_size": (2, 2), "spatial_scale": 1.0}, [0]),
+    "ROIPooling": lambda: ([_sym(1, 2, 6, 6),
+                            np.array([[0, 0, 0, 4, 4]], np.float32)],
+                           {"pooled_size": (2, 2), "spatial_scale": 1.0},
+                           [0]),
+    "DeformableConvolution": lambda: (
+        [_sym(1, 2, 5, 5), _sym(1, 18, 5, 5) * 0.1, _sym(3, 2, 3, 3) * 0.3],
+        {"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)}, [0, 2]),
+    "DeformablePSROIPooling": lambda: (
+        [_sym(1, 8, 6, 6), np.array([[0, 0, 0, 4, 4]], np.float32),
+         _sym(1, 2, 2, 2) * 0.05],
+        {"spatial_scale": 1.0, "output_dim": 2, "group_size": 2,
+         "pooled_size": 2, "trans_std": 0.1}, [0, 2]),
+    "batch_dot": lambda: ([_sym(2, 3, 4), _sym(2, 4, 2)], {}, None),
+    "dot": lambda: ([_sym(3, 4), _sym(4, 2)], {}, None),
+    "linalg_gemm": lambda: ([_sym(3, 4), _sym(4, 2), _sym(3, 2)], {}, None),
+    "linalg_gemm2": lambda: ([_sym(3, 4), _sym(4, 2)], {}, None),
+    "linalg_det": lambda: ([_pd(3)], {}, None),
+    "linalg_slogdet": lambda: ([_pd(3)], {}, None),
+    "linalg_inverse": lambda: ([_pd(3)], {}, None),
+    "linalg_potrf": lambda: ([_pd(3)], {}, None),
+    "linalg_potri": lambda: ([_pd(3)], {}, None),
+    "linalg_trmm": lambda: ([np.tril(_pd(3)).astype(np.float32),
+                             _sym(3, 3)], {}, None),
+    "linalg_trsm": lambda: ([(np.tril(_pd(3)) + 3 * np.eye(3))
+                             .astype(np.float32), _sym(3, 3)], {}, None),
+    "linalg_extracttrian": lambda: ([_sym(3, 3)], {}, None),
+    "linalg_sumlogdiag": lambda: ([_pd(3)], {}, None),
+    "linalg_gelqf": lambda: ([_sym(2, 4)], {}, "skip_fd"),
+    "linalg_syevd": lambda: ([_pd(3)], {}, "skip_fd"),
+    "pad": lambda: ([_sym(1, 2, 3, 3)],
+                    {"mode": "constant",
+                     "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}, None),
+    "slice": lambda: ([_sym(3, 4)], {"begin": (0, 1), "end": (2, 3)}, None),
+    "slice_axis": lambda: ([_sym(3, 4)],
+                           {"axis": 1, "begin": 1, "end": 3}, None),
+    "expand_dims": lambda: ([_sym(2, 3)], {"axis": 1}, None),
+    "flip": lambda: ([_sym(2, 3)], {"axis": 1}, None),
+    "repeat": lambda: ([_sym(2, 3)], {"repeats": 2, "axis": 1}, None),
+    "tile": lambda: ([_sym(2, 3)], {"reps": (2, 2)}, None),
+    "broadcast_to": lambda: ([_sym(1, 3)], {"shape": (4, 3)}, None),
+    "depth_to_space": lambda: ([_sym(1, 4, 2, 2)], {"block_size": 2}, None),
+    "space_to_depth": lambda: ([_sym(1, 1, 4, 4)], {"block_size": 2}, None),
+    "batch_take": lambda: ([_sym(3, 4),
+                            np.array([0, 2, 1], np.int32)], {}, [0]),
+    "take": lambda: ([_sym(4, 3), np.array([0, 2], np.int32)], {}, [0]),
+    "pick": lambda: ([_sym(3, 4), np.array([0, 2, 1], np.float32)],
+                     {"axis": 1}, [0]),
+    "choose_element_0index": lambda: ([_sym(3, 4),
+                                       np.array([0, 2, 1], np.float32)],
+                                      {}, [0]),
+    "fill_element_0index": lambda: ([_sym(3, 4),
+                                     np.array([0.5, 0.2, 0.1], np.float32),
+                                     np.array([0, 2, 1], np.float32)],
+                                    {}, [0, 1]),
+    "index_copy": lambda: ([_sym(4, 3), np.array([1, 3], np.int32),
+                            _sym(2, 3)], {}, [0, 2]),
+    "scatter_nd": lambda: ([_sym(3), np.array([[0, 2, 1]], np.int32)],
+                           {"shape": (4,)}, [0]),
+    "_scatter_set_nd": lambda: ([_sym(4), _sym(2)],
+                                {"indices": np.array([[0, 2]], np.int32)},
+                                None),
+    "_slice_assign": lambda: ([_sym(3, 4), _sym(2, 2)],
+                              {"begin": (0, 1), "end": (2, 3)}, None),
+    "gather_nd": lambda: ([_sym(3, 4),
+                           np.array([[0, 2], [1, 3]], np.int32)], {}, [0]),
+    "Embedding": lambda: ([np.array([[0, 2], [1, 1]], np.float32),
+                           _sym(4, 3)],
+                          {"input_dim": 4, "output_dim": 3}, [1]),
+    "softmax_cross_entropy": lambda: ([_sym(3, 4),
+                                       np.array([0, 2, 1], np.float32)],
+                                      {}, [0]),
+    "ifft": lambda: ([_sym(2, 8)], {}, None),
+    "count_sketch": lambda: ([_sym(2, 4),
+                              np.array([0, 2, 1, 3], np.float32),
+                              np.array([1, -1, 1, -1], np.float32)],
+                             {"out_dim": 4}, [0]),
+    "image_to_tensor": lambda: ([_pos(4, 4, 3)], {}, None),
+    "image_adjust_hue": lambda: ([_pos(4, 4, 3)], {"alpha": 0.1}, None),
+    "image_resize": lambda: ([_pos(4, 4, 3)], {"size": (6, 6)}, None),
+    "image_rotate": lambda: ([_pos(1, 4, 4)],
+                             {"angle": 30.0}, None),
+    "image_crop": lambda: ([_pos(5, 5, 3)],
+                           {"x": 1, "y": 1, "width": 3, "height": 3}, None),
+    "image_flip_top_bottom": lambda: ([_pos(4, 4, 3)], {}, None),
+    "Cast": lambda: ([_sym(2, 3)], {"dtype": "float32"}, None),
+    "boolean_mask": lambda: ([_sym(4, 3),
+                              np.array([1, 0, 1, 1], np.float32)], {}, [0]),
+    # domain-restricted inverse/hyperbolic functions: inputs inside the
+    # open domain, away from the branch points where FD blows up
+    "arccos": lambda: ([np.clip(_sym(2, 3) * 0.4, -0.8, 0.8)], {}, None),
+    "arcsin": lambda: ([np.clip(_sym(2, 3) * 0.4, -0.8, 0.8)], {}, None),
+    "arctanh": lambda: ([np.clip(_sym(2, 3) * 0.4, -0.8, 0.8)], {}, None),
+    "erfinv": lambda: ([np.clip(_sym(2, 3) * 0.4, -0.8, 0.8)], {}, None),
+    "arccosh": lambda: ([_pos(2, 3) + 1.0], {}, None),
+    "amp_cast": lambda: ([_sym(2, 3)], {"dtype": "float32"}, None),
+    "amp_multicast": lambda: ([_sym(2, 3), _sym(2, 3)],
+                              {"num_outputs": 2}, None),
+    "_split_v2": lambda: ([_sym(2, 6)],
+                          {"indices_or_sections": 3, "axis": 1}, None),
+}
+
+
+@pytest.mark.parametrize("name", sorted(GRAD_SPECS), ids=sorted(GRAD_SPECS))
+def test_grad_spec(name):
+    inputs, attrs, grad_nodes = GRAD_SPECS[name]()
+    if grad_nodes == "skip_fd":
+        # decomposition outputs (Q/LQ, eigenvectors) are sign/rotation
+        # ambiguous — FD on a sum over them is ill-defined; require only
+        # that autodiff produces finite grads through the op
+        from incubator_mxnet_tpu import autograd
+        arrays = [nd.array(x) for x in inputs]
+        for a in arrays:
+            a.attach_grad()
+        with autograd.record():
+            loss = _sum_all(getattr(nd, name)(*arrays, **attrs))
+        loss.backward()
+        for a in arrays:
+            assert np.isfinite(a.grad.asnumpy()).all()
+        return
+    check_numeric_gradient(_op_fn(name, attrs), inputs,
+                           rtol=5e-2, atol=2e-3, grad_nodes=grad_nodes)
+
+
+# ---------------------------------------------------------------------------
+# NON_DIFF: op -> audited reason for having no gradient check
+# ---------------------------------------------------------------------------
+
+_OPT_UPDATE = ("optimizer state-update kernel — consumed outside autodiff "
+               "graphs; formula exactness tested in "
+               "test_operator_sweep.py::test_optimizer_update_op_formulas")
+_RANDOM = ("RNG draw — output is not a deterministic function of the "
+           "float inputs; statistics tested in test_operator_sweep.py")
+_CREATION = "creation/shape op with no differentiable float input"
+_INT = "integer/index semantics — no float cotangent exists"
+_INFER = ("inference-only decode/assignment (argsort/NMS/matching) — "
+          "forward behavior tested in test_ssd.py / test_operator_sweep.py")
+_QUANT = "int8 path — no float cotangent; numerics in test_quantization*"
+
+NON_DIFF = {
+    "BlockGrad": ("gradient barrier (stop_gradient) — zero backward BY "
+                  "CONTRACT; identity forward tested in the sweep"),
+    "Dropout": _RANDOM, "shuffle": _RANDOM, "bernoulli": _RANDOM,
+    "random_exponential": _RANDOM, "random_gamma": _RANDOM,
+    "random_generalized_negative_binomial": _RANDOM,
+    "random_negative_binomial": _RANDOM, "random_normal": _RANDOM,
+    "random_poisson": _RANDOM, "random_randint": _RANDOM,
+    "random_uniform": _RANDOM, "sample_exponential_multi": _RANDOM,
+    "sample_gamma_multi": _RANDOM,
+    "sample_generalized_negative_binomial_multi": _RANDOM,
+    "sample_multinomial": _RANDOM, "sample_negative_binomial_multi": _RANDOM,
+    "sample_normal_multi": _RANDOM, "sample_poisson_multi": _RANDOM,
+    "sample_uniform_multi": _RANDOM,
+    "image_random_brightness": _RANDOM, "image_random_contrast": _RANDOM,
+    "image_random_hue": _RANDOM, "image_random_lighting": _RANDOM,
+    "image_random_rotate": _RANDOM, "image_random_saturation": _RANDOM,
+    "adam_update": _OPT_UPDATE, "_adamw_update": _OPT_UPDATE,
+    "_mp_adamw_update": _OPT_UPDATE, "ftml_update": _OPT_UPDATE,
+    "ftrl_update": _OPT_UPDATE, "mp_nag_mom_update": _OPT_UPDATE,
+    "mp_sgd_mom_update": _OPT_UPDATE, "mp_sgd_update": _OPT_UPDATE,
+    "multi_mp_sgd_mom_update": _OPT_UPDATE, "multi_mp_sgd_update": _OPT_UPDATE,
+    "multi_sgd_mom_update": _OPT_UPDATE, "multi_sgd_update": _OPT_UPDATE,
+    "nag_mom_update": _OPT_UPDATE, "rmsprop_update": _OPT_UPDATE,
+    "rmspropalex_update": _OPT_UPDATE, "sgd_mom_update": _OPT_UPDATE,
+    "sgd_update": _OPT_UPDATE, "signsgd_update": _OPT_UPDATE,
+    "signum_update": _OPT_UPDATE, "_sparse_adagrad_update": _OPT_UPDATE,
+    "_contrib_group_adagrad_update": _OPT_UPDATE,
+    "zeros": _CREATION, "ones": _CREATION, "full": _CREATION,
+    "eye": _CREATION, "arange": _CREATION, "_zeros_without_dtype": _CREATION,
+    "shape_array": _CREATION, "size_array": _CREATION,
+    "one_hot": _INT, "_ravel_multi_index": _INT, "_unravel_index": _INT,
+    "MultiBoxPrior": _CREATION, "MultiBoxTarget": _INFER,
+    "MultiBoxDetection": _INFER, "MultiProposal": _INFER,
+    "Proposal": _INFER, "box_nms": _INFER,
+    "quantize_v2": _QUANT, "dequantize": _QUANT, "requantize": _QUANT,
+    "quantized_conv": _QUANT, "quantized_flatten": _QUANT,
+    "quantized_fully_connected": _QUANT, "quantized_pooling": _QUANT,
+}
+
+
+# reference loss-layer contract: the backward is (out - label) REGARDLESS
+# of the forward value or upstream cotangent (regression_output.cc), so FD
+# of the forward cannot match autodiff by design — assert the contract.
+CUSTOM_BWD = ["LinearRegressionOutput", "LogisticRegressionOutput",
+              "MAERegressionOutput"]
+
+
+@pytest.mark.parametrize("name", CUSTOM_BWD, ids=CUSTOM_BWD)
+def test_regression_output_backward_contract(name):
+    from incubator_mxnet_tpu import autograd
+    data = nd.array(_sym(3, 4))
+    label = nd.array(_sym(3, 4))
+    data.attach_grad()
+    with autograd.record():
+        out = getattr(nd, name)(data, label)
+        loss = out.sum()
+    loss.backward()
+    o = out.asnumpy()
+    lab = label.asnumpy()
+    if name == "MAERegressionOutput":
+        want = np.sign(o - lab)
+    else:
+        want = o - lab
+    np.testing.assert_allclose(data.grad.asnumpy(), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_ledger_is_complete():
+    """Every registered op is gradient-checked here or has an audited
+    non-differentiability reason — forward-only coverage of a
+    differentiable op FAILS this test."""
+    covered = (set(GRAD_AUTO_1) | set(GRAD_AUTO_2) | set(GRAD_AUTO_3)
+               | set(GRAD_SPECS) | set(NON_DIFF) | set(CUSTOM_BWD))
+    missing = sorted(set(list_ops()) - covered)
+    assert not missing, (
+        "ops with no gradient check and no audited non-diff reason: %s"
+        % missing)
+    # and nothing is double-booked as both checked and non-diff
+    both = (set(GRAD_AUTO_1) | set(GRAD_AUTO_2) | set(GRAD_AUTO_3)
+            | set(GRAD_SPECS)) & set(NON_DIFF)
+    assert not both, "ops both checked and declared non-diff: %s" % sorted(both)
