@@ -19,7 +19,7 @@ import time
 
 import numpy as np
 
-from .rpc import Server, request, Connection
+from .rpc import Server, request, Connection, ProtocolError
 from .compression import GradientCompression
 
 __all__ = ["run_scheduler", "run_server", "SchedulerClient"]
@@ -230,8 +230,8 @@ class SchedulerClient:
                 try:
                     conn.call({"op": "heartbeat", "role": role, "rank": rank},
                               timeout=10)
-                except (OSError, ConnectionError):
-                    pass    # scheduler gone: shutdown path handles it
+                except (OSError, ConnectionError, ProtocolError):
+                    pass    # scheduler gone/mid-frame: shutdown handles it
             conn.close()
 
         self._hb_thread = threading.Thread(target=loop, daemon=True)
@@ -246,7 +246,7 @@ class SchedulerClient:
         try:
             self._conn.call({"op": "bye", "role": role, "rank": rank},
                             timeout=10)
-        except (OSError, ConnectionError):
+        except (OSError, ConnectionError, ProtocolError):
             pass
 
     def num_dead_nodes(self, timeout=_DEAD_TIMEOUT):
@@ -257,7 +257,7 @@ class SchedulerClient:
         self.stop_heartbeats()
         try:
             request(self.addr, {"op": "shutdown"}, timeout=5)
-        except OSError:
+        except (OSError, ProtocolError):
             pass
         self._conn.close()
 

@@ -38,17 +38,26 @@ def send_msg(sock, obj, payload=b""):
 
 
 def recv_msg(sock):
+    """(meta, payload), or (None, None) on a clean close at a frame
+    boundary. A peer dying MID-frame (partial header, truncated meta or
+    payload) raises ProtocolError — the connection is unusable, but the
+    caller decides whether that kills anything beyond this socket."""
     hdr = _recv_exact(sock, 8)
     if hdr is None:
         return None, None
+    if len(hdr) < 8:
+        raise ProtocolError("connection closed mid-header (%d/8 bytes)"
+                            % len(hdr))
     meta_len, payload_len = _HDR.unpack(hdr[:4])[0], _HDR.unpack(hdr[4:])[0]
     if meta_len > _MAX_META or payload_len > _MAX_PAYLOAD:
         raise ProtocolError("frame size out of bounds (%d, %d)"
                             % (meta_len, payload_len))
     meta_raw = _recv_exact(sock, meta_len)
-    if meta_raw is None:
-        return None, None
+    if meta_raw is None or len(meta_raw) < meta_len:
+        raise ProtocolError("connection closed mid-metadata")
     payload = _recv_exact(sock, payload_len) if payload_len else b""
+    if payload is None or len(payload) < payload_len:
+        raise ProtocolError("connection closed mid-payload")
     try:
         meta = json.loads(meta_raw.decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -59,6 +68,8 @@ def recv_msg(sock):
 
 
 def _recv_exact(sock, n):
+    """Read exactly n bytes; None on clean close BEFORE any byte, the
+    short prefix if the peer dies mid-read (caller distinguishes)."""
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
@@ -140,6 +151,8 @@ class Server:
         self._srv.listen(64)
         self.addr = self._srv.getsockname()
         self._stop = threading.Event()
+        self._conns = set()
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
 
     def start(self):
@@ -160,6 +173,8 @@ class Server:
                              daemon=True).start()
 
     def _serve_conn(self, conn):
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             peer = conn.getpeername()[0]
         except OSError:
@@ -180,10 +195,25 @@ class Server:
             pass
         finally:
             conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def stop(self):
+        """Stop accepting AND drop live connections (the reference van's
+        shutdown: peers observe a closed socket, not a silent zombie)."""
         self._stop.set()
         try:
             self._srv.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass

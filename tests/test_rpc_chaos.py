@@ -1,0 +1,206 @@
+"""Wire-chaos tests for the parameter-server transport (VERDICT r3 weak
+#7: partial frames, slow peers, reconnects on kvstore/rpc.py).
+
+Reference analogue: ps-lite's van survives malformed peers and timeouts
+without taking the whole process down. Every scenario here asserts BOTH
+the failure surface (the right exception, nothing hangs) and that the
+server keeps serving well-formed clients afterwards.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu.kvstore.rpc import (Connection, ProtocolError,
+                                             Server, recv_msg)
+
+
+def _echo_server():
+    def handler(meta, payload):
+        if meta.get("op") == "sleep":
+            time.sleep(float(meta["seconds"]))
+        return {"op": "ok", "echo": meta.get("x")}, payload
+    return Server(handler).start()
+
+
+def _assert_alive(srv):
+    conn = Connection(srv.addr)
+    meta, data = conn.call({"op": "ping", "x": 42}, b"abc")
+    assert meta["echo"] == 42 and data == b"abc"
+    conn.close()
+
+
+def test_partial_header_then_close_leaves_server_alive():
+    srv = _echo_server()
+    try:
+        with socket.create_connection(srv.addr) as s:
+            s.sendall(b"\x05\x00\x00")          # 3 of 8 header bytes
+        time.sleep(0.1)
+        _assert_alive(srv)
+    finally:
+        srv.stop()
+
+
+def test_truncated_metadata_frame_leaves_server_alive():
+    srv = _echo_server()
+    try:
+        with socket.create_connection(srv.addr) as s:
+            # header promises 100 metadata bytes; send 10 and die
+            s.sendall(struct.pack("<II", 100, 0) + b"0123456789")
+        time.sleep(0.1)
+        _assert_alive(srv)
+    finally:
+        srv.stop()
+
+
+def test_garbage_header_sizes_rejected():
+    srv = _echo_server()
+    try:
+        with socket.create_connection(srv.addr) as s:
+            s.sendall(struct.pack("<II", 1 << 31, 1 << 31) + b"x" * 64)
+            # server must DROP the connection without replying (clean
+            # FIN or RST both count — never a reply frame)
+            s.settimeout(2.0)
+            try:
+                assert s.recv(1) == b""
+            except ConnectionResetError:
+                pass
+        _assert_alive(srv)
+    finally:
+        srv.stop()
+
+
+def test_non_dict_metadata_rejected():
+    srv = _echo_server()
+    try:
+        with socket.create_connection(srv.addr) as s:
+            meta = b"[1, 2, 3]"
+            s.sendall(struct.pack("<II", len(meta), 0) + meta)
+            s.settimeout(2.0)
+            assert s.recv(1) == b""
+        _assert_alive(srv)
+    finally:
+        srv.stop()
+
+
+def test_recv_msg_mid_frame_raises_protocol_error():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<II", 50, 0) + b"short")
+        a.close()
+        with pytest.raises(ProtocolError):
+            recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_slow_peer_times_out_then_reconnects():
+    """A call that outlives its timeout surfaces the error, drops the
+    socket, and the NEXT call transparently reconnects."""
+    srv = _echo_server()
+    try:
+        conn = Connection(srv.addr)
+        with pytest.raises(OSError):
+            conn.call({"op": "sleep", "seconds": 2.0}, timeout=0.3)
+        # the connection object recovers on the next call
+        meta, _ = conn.call({"op": "ping", "x": 7})
+        assert meta["echo"] == 7
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_reconnect_after_server_restart():
+    srv = _echo_server()
+    host, port = srv.addr
+    conn = Connection((host, port))
+    assert conn.call({"op": "ping", "x": 1})[0]["echo"] == 1
+    srv.stop()
+    time.sleep(0.2)
+    with pytest.raises((OSError, ConnectionError)):
+        conn.call({"op": "ping", "x": 2})
+    # new server on the SAME port (SO_REUSEADDR); client reconnects.
+    # the old listener's teardown can lag a moment — retry the bind
+    def handler(meta, payload):
+        return {"op": "ok", "echo": meta.get("x")}, payload
+    deadline = time.time() + 5
+    while True:
+        try:
+            srv2 = Server(handler, host=host, port=port).start()
+            break
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+    try:
+        deadline = time.time() + 5
+        while True:
+            try:
+                meta, _ = conn.call({"op": "ping", "x": 3})
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        assert meta["echo"] == 3
+        conn.close()
+    finally:
+        srv2.stop()
+
+
+def test_handler_exception_becomes_error_reply_not_disconnect():
+    def handler(meta, payload):
+        raise ValueError("boom")
+    srv = Server(handler).start()
+    try:
+        conn = Connection(srv.addr)
+        meta, _ = conn.call({"op": "anything"})
+        assert "boom" in meta["error"]
+        # connection still usable for the next request
+        meta2, _ = conn.call({"op": "again"})
+        assert "boom" in meta2["error"]
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_interleaved_chaos_and_real_traffic():
+    """Several malformed peers hammering the server while a well-formed
+    client keeps making calls — none may fail."""
+    srv = _echo_server()
+    try:
+        stop = threading.Event()
+
+        def chaos():
+            frames = [b"\x01", struct.pack("<II", 100, 0) + b"x",
+                      struct.pack("<II", 1 << 30, 0),
+                      struct.pack("<II", 4, 0) + b"nope"]
+            i = 0
+            while not stop.is_set():
+                try:
+                    with socket.create_connection(srv.addr, timeout=1) as s:
+                        s.sendall(frames[i % len(frames)])
+                        i += 1
+                except OSError:
+                    pass
+
+        threads = [threading.Thread(target=chaos, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        conn = Connection(srv.addr)
+        for k in range(50):
+            meta, data = conn.call({"op": "ping", "x": k},
+                                   np.arange(k, dtype=np.int32).tobytes())
+            assert meta["echo"] == k
+            assert np.frombuffer(data, np.int32).size == k
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        conn.close()
+    finally:
+        srv.stop()
