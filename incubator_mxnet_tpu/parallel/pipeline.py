@@ -37,7 +37,7 @@ def stack_stage_params(per_stage_params, mesh=None, axis="pp"):
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
-                   n_microbatch=None):
+                   n_microbatch=None, remat=False):
     """Run `x` through S pipelined stages of `stage_fn`.
 
     stage_fn : (stage_params, activations) -> activations, same shape
@@ -47,10 +47,20 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
         `axis` (see stack_stage_params).
     x : (B, ...) global batch; split into `n_microbatch` microbatches
         (default: the pp degree) along axis 0.
+    remat : rematerialize each (stage, tick) in the backward instead of
+        storing its internals. The 1F1B schedule's POINT on GPU pipelines
+        is bounding live activations at ~S microbatches instead of M; in
+        the scanned SPMD formulation the same memory profile falls out of
+        remat (scan saves only the per-tick carry, stage internals are
+        recomputed) while raising n_microbatch shrinks the bubble
+        (S-1)/(M+S-1) — the TPU-idiomatic trade (compute is cheap on the
+        MXU, HBM is not) rather than a hand-scheduled interleaving.
     Returns (B, ...) outputs. Differentiable end to end.
     """
     S = mesh.shape[axis]
     M = int(n_microbatch or S)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     B = x.shape[0]
     if B % M:
         raise ValueError("batch %d not divisible into %d microbatches"
@@ -147,10 +157,11 @@ class PipelineStack(HybridBlock):
     """
 
     def __init__(self, stage_factory, n_stages, pp_axis="pp",
-                 n_microbatch=None, **kwargs):
+                 n_microbatch=None, remat=False, **kwargs):
         super().__init__(**kwargs)
         self._pp_axis = pp_axis
         self._n_micro = n_microbatch
+        self._remat = bool(remat)
         self._stage_blocks = []
         with self.name_scope():
             for i in range(n_stages):
@@ -194,4 +205,5 @@ class PipelineStack(HybridBlock):
                 _trace_state.ctx = prev
 
         return pipeline_apply(stage_fn, stacked, x, mesh, axis=axis,
-                              n_microbatch=self._n_micro)
+                              n_microbatch=self._n_micro,
+                              remat=self._remat)
