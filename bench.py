@@ -70,7 +70,8 @@ def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
     net = _BertPretrainStep(BERTForPretrain(
         bert=mx.models.bert_base(vocab_size=V, dropout=0.0,
                                  max_length=max(512, T)),
-        vocab_size=V))
+        vocab_size=V,
+        tie_decoder=os.environ.get("BENCH_BERT_TIE", "1") == "1"))
     net.initialize(mx.init.Normal(0.02))
     ids = np.random.randint(0, V, (B, T)).astype(np.int32)
     types = np.zeros((B, T), np.int32)

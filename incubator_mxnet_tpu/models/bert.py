@@ -107,9 +107,19 @@ class TransformerEncoderLayer(HybridBlock):
 
 
 class BERTEncoder(HybridBlock):
+    """remat: rematerialize each layer in the backward (per-layer
+    jax.checkpoint) — trades MXU recompute for activation HBM; a win for
+    long-context memory, a measured loss at T=128 (BENCHMARKS.md).
+    Resolved at CONSTRUCTION (None -> the MXTPU_BERT_REMAT env var), so
+    the setting is a property of the model, not of whichever trace
+    compiled first."""
+
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
-                 **kwargs):
+                 remat=None, **kwargs):
         super().__init__(**kwargs)
+        import os as _os
+        self._remat = (bool(remat) if remat is not None
+                       else _os.environ.get("MXTPU_BERT_REMAT", "0") == "1")
         with self.name_scope():
             self.layers = nn.HybridSequential(prefix="layers_")
             for i in range(num_layers):
@@ -118,8 +128,12 @@ class BERTEncoder(HybridBlock):
                     prefix="layer%d_" % i))
 
     def hybrid_forward(self, F, x, mask=None):
+        from .block_remat import maybe_remat_layer
         for layer in self.layers._children.values():
-            x = layer(x, mask)
+            if self._remat:
+                x = maybe_remat_layer(layer, x, mask)
+            else:
+                x = layer(x, mask)
         return x
 
 
@@ -128,7 +142,7 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 token_type_vocab=2, dropout=0.1, **kwargs):
+                 token_type_vocab=2, dropout=0.1, remat=None, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         with self.name_scope():
@@ -139,7 +153,8 @@ class BERTModel(HybridBlock):
             self.embed_ln = nn.LayerNorm(prefix="embln_")
             self.embed_dropout = nn.Dropout(dropout)
             self.encoder = BERTEncoder(num_layers, units, hidden_size,
-                                       num_heads, dropout, prefix="enc_")
+                                       num_heads, dropout, remat=remat,
+                                       prefix="enc_")
             self.pooler = nn.Dense(units, activation="tanh", flatten=False,
                                    prefix="pooler_")
 
@@ -171,15 +186,27 @@ class BERTForPretrain(HybridBlock):
     full-sequence logits are returned (the fine-tune / scoring path).
     """
 
-    def __init__(self, bert=None, vocab_size=30522, **kwargs):
+    def __init__(self, bert=None, vocab_size=30522, tie_decoder=False,
+                 **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.bert = bert or BERTModel(vocab_size=vocab_size, **{})
             self.mlm_dense = nn.Dense(self.bert._units, activation="tanh",
                                       flatten=False, prefix="mlmd_")
             self.mlm_ln = nn.LayerNorm(prefix="mlmln_")
-            self.mlm_decoder = nn.Dense(vocab_size, flatten=False,
-                                        prefix="decoder_")
+            if tie_decoder:
+                # share the word-embedding matrix as the decoder weight
+                # (GluonNLP BERT ties them; (V, units) serves both roles).
+                # The absolute prefix aliases the decoder's "weight" slot
+                # to the embedding's parameter.
+                self.mlm_decoder = nn.Dense(
+                    vocab_size, flatten=False,
+                    in_units=self.bert._units,
+                    params=self.bert.word_embed.params,
+                    prefix=self.bert.word_embed.prefix)
+            else:
+                self.mlm_decoder = nn.Dense(vocab_size, flatten=False,
+                                            prefix="decoder_")
             self.nsp = nn.Dense(2, prefix="nsp_")
 
     def hybrid_forward(self, F, token_ids, token_types=None,
@@ -229,6 +256,9 @@ def bert_sharding_rules(tp_axis="tp"):
         (r"(query|key|value)_bias$", P(tp_axis)),
         (r"ffn1_bias$", P(tp_axis)),
         (r"word_weight$", P(tp_axis, None)),
+        # untied decoder params; with tie_decoder=True the decoder weight
+        # IS word_weight (rule above) and its bias lands under the
+        # embedding prefix as word_bias — cover both namings
         (r"decoder_weight$", P(tp_axis, None)),
-        (r"decoder_bias$", P(tp_axis)),
+        (r"(decoder|word)_bias$", P(tp_axis)),
     ]

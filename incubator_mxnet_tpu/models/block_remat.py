@@ -1,0 +1,24 @@
+"""Per-layer rematerialization for traced gluon blocks.
+
+``jax.checkpoint`` around one encoder layer drops its internal
+activations after the forward and recomputes them in the backward —
+trading MXU FLOPs (cheap) for HBM traffic (the measured bottleneck of
+the BERT step, BENCHMARKS.md roofline). Under a trace the layer reads
+its parameters from the ambient trace context, so the checkpointed
+function closes over them; only the activations are arguments.
+"""
+
+import jax
+
+__all__ = ["maybe_remat_layer"]
+
+
+def maybe_remat_layer(layer, x, mask=None):
+    """Run ``layer(x, mask)`` under jax.checkpoint when tracing; plain
+    call on the eager path (nothing to rematerialize outside a grad)."""
+    from ..gluon.block import current_trace
+    if current_trace() is None:
+        return layer(x, mask)
+    if mask is None:
+        return jax.checkpoint(lambda a: layer(a))(x)
+    return jax.checkpoint(lambda a, m: layer(a, m))(x, mask)
