@@ -7,7 +7,10 @@ Run anywhere (no TPU pod needed):
 
 Shows: dp+tp+sp via ShardedTrainer (GSPMD collectives), ZeRO-1 with
 gradient accumulation (reduce-scatter data parallelism), GPipe pipeline
-over a pp axis, and a switch-MoE layer with ep-sharded experts.
+over a pp axis — standalone AND composed with dp inside one train step
+via PipelineStack — top-k MoE with ep-sharded experts and drop
+telemetry, and ring attention over a sequence-parallel axis (flash
+kernel per KV shard on TPU, dense fallback here on CPU).
 """
 
 import os
@@ -31,7 +34,9 @@ import incubator_mxnet_tpu as mx                            # noqa: E402
 from incubator_mxnet_tpu import nd, gluon                   # noqa: E402
 from incubator_mxnet_tpu.parallel import (                  # noqa: E402
     make_mesh, ShardedTrainer, pipeline_apply, stack_stage_params,
-    moe_apply)
+    moe_apply, PipelineStack)
+from incubator_mxnet_tpu.parallel.ring_attention import (   # noqa: E402
+    make_ring_attention)
 
 
 def dp_tp_zero1():
@@ -82,8 +87,53 @@ def pipeline():
                       for l in jax.tree_util.tree_leaves(grads))))
 
 
+def pipeline_in_trainer():
+    """pp COMPOSED with dp in ONE ShardedTrainer step: embed/head outside
+    the pipelined trunk, GPipe PipelineStack inside (remat available for
+    the 1F1B activation-memory bound)."""
+    np.random.seed(2)
+    net = gluon.nn.HybridSequential(prefix="ppnet_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu", in_units=16,
+                               prefix="embed_"))
+        net.add(PipelineStack(
+            lambda i: gluon.nn.Dense(32, activation="tanh", in_units=32,
+                                     prefix="body%d_" % i),
+            n_stages=4, n_microbatch=8, prefix="trunk_"))
+        net.add(gluon.nn.Dense(4, in_units=32, prefix="head_"))
+    net.initialize(mx.init.Xavier())
+
+    def loss_fn(out, label):
+        logp = jax.nn.log_softmax(out, axis=-1)
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), 4, dtype=logp.dtype)
+        return -(logp * onehot).sum(-1).mean()
+
+    mesh = make_mesh({"dp": 2, "pp": 4}, devices=jax.devices()[:8])
+    tr = ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1},
+                        data_specs=P("dp"), label_spec=P("dp"))
+    X = np.random.rand(16, 16).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.float32)
+    for _ in range(3):
+        loss = tr.step(X, y)
+    print("dp2 x pp4 composed train step: loss %.4f"
+          % float(jax.device_get(loss)))
+
+
+def ring():
+    """Sequence-parallel attention: KV shards rotate around the ring via
+    ppermute; on TPU each hop runs the Pallas flash kernel."""
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 64, 16).astype(np.float32))
+               for _ in range(3))
+    fn = make_ring_attention(mesh, seq_axis="sp")    # auto: flash on TPU
+    out = jax.jit(fn)(q, k, v)
+    print("ring attention sp4: out %s" % (out.shape,))
+
+
 def experts():
-    """Switch-MoE with ep-sharded experts."""
+    """Top-k MoE with ep-sharded experts and observable drops."""
     mesh = make_mesh({"ep": 4}, devices=jax.devices()[:4])
     from jax.sharding import NamedSharding
     rng = np.random.RandomState(1)
@@ -95,13 +145,17 @@ def experts():
     w2 = jax.device_put(jnp.asarray(rng.randn(E, h, d).astype(np.float32)
                                     * 0.2), shard3)
     x = jnp.asarray(rng.randn(128, d).astype(np.float32))
-    out, aux = jax.jit(lambda x: moe_apply(
+    out, aux, stats = jax.jit(lambda x: moe_apply(
         x, gw, w1, jnp.zeros((E, h)), w2, jnp.zeros((E, d)),
-        capacity_factor=2.0, ep_sharding=(mesh, "ep")))(x)
-    print("moe ep4: out %s, balance aux %.4f" % (out.shape, float(aux)))
+        capacity_factor=1.5, top_k=2, ep_sharding=(mesh, "ep"),
+        return_stats=True))(x)
+    print("moe ep4 top-2: out %s, balance aux %.4f, dropped routes %.3f"
+          % (out.shape, float(aux), float(stats["dropped_route_frac"])))
 
 
 if __name__ == "__main__":
     dp_tp_zero1()
     pipeline()
+    pipeline_in_trainer()
+    ring()
     experts()
