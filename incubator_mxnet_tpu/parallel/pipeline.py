@@ -267,9 +267,11 @@ class PipelineStack(HybridBlock):
 
     Contract: stages are single-input/single-output with matching
     shapes; use LayerNorm rather than BatchNorm inside stages (batch
-    aux-state updates do not cross the pipelined region); stage
-    dropout must be 0 (microbatch RNG streams are not threaded
-    through the schedule).
+    aux-state updates do not cross the pipelined region); dropout must
+    be 0 in stages AND in the in-region ``embed``/``head`` blocks
+    (microbatch RNG streams are not threaded through the schedule — a
+    Dropout there would reuse one trace-time mask every tick under a pp
+    mesh while getting fresh masks on the off-mesh path).
     """
 
     def __init__(self, stage_factory, n_stages, pp_axis="pp",

@@ -51,6 +51,16 @@ class ShardedTrainer:
         1/dp param shard with its 1/dp optimizer-state shard, and the fresh
         params are all-gathered back. Memory for optimizer state drops by
         the dp degree; collective bytes match all-reduce (RS + AG).
+        Two formulations: "manual" (dp as an explicit shard_map axis with
+        hand-placed psum_scatter/all_gather — the audited default, RS
+        guaranteed in the HLO) and "auto" (with_sharding_constraint on
+        grads/opt-state/params — composes with a PipelineStack's inner pp
+        shard_map, which cannot nest under a manual dp region). In auto
+        the partitioner may emit reduce-scatter directly or the
+        pre-canonicalized all-reduce + dynamic-slice form (what the CPU
+        virtual mesh shows); either way the update and optimizer state
+        run on 1/dp shards. True picks manual, or auto when the model
+        carries a live pipeline axis; pass the string to force one.
     grad_accum : number of microbatches to accumulate per step. The batch's
         leading dim splits into `grad_accum` slices consumed by a lax.scan;
         the optimizer applies once on the mean gradient.
@@ -92,25 +102,32 @@ class ShardedTrainer:
                             for n in self._diff_names + self._aux_names}
         self._dp_axis = dp_axis
         self._dp_size = dict(mesh.shape).get(dp_axis, 1)
-        self._zero1 = bool(zero1) and self._dp_size > 1
-        if self._zero1:
-            # ZeRO-1 runs dp as a MANUAL shard_map axis; a PipelineStack's
-            # inner pp shard_map cannot nest under it (Shardy rejects
-            # re-binding an already-manual mesh) — pipeline composition
-            # rides the GSPMD-auto dp path instead. Detect by the model's
-            # actual pipeline axes, not a hardcoded name.
-            pp_axes = self._pipeline_axes(block)
-            live = [a for a in pp_axes
-                    if dict(mesh.shape).get(a, 1) > 1]
-            if live:
-                raise NotImplementedError(
-                    "zero1=True cannot compose with pipeline axis %r in "
-                    "one step; use zero1=False (GSPMD-auto dp) with "
-                    "pipeline parallelism" % live[0])
+        if zero1 not in (False, True, "manual", "auto"):
+            raise ValueError("zero1 must be False/True/'manual'/'auto', "
+                             "got %r" % (zero1,))
+        live_pp = [a for a in self._pipeline_axes(block)
+                   if dict(mesh.shape).get(a, 1) > 1]
+        if zero1 and self._dp_size > 1:
+            if zero1 is True:
+                # the manual formulation's dp shard_map cannot nest over a
+                # PipelineStack's inner pp shard_map (Shardy rejects
+                # re-binding an already-manual mesh); auto-select the
+                # constraint formulation there
+                self._zero1_mode = "auto" if live_pp else "manual"
+            else:
+                self._zero1_mode = zero1
+        else:
+            self._zero1_mode = None
+        self._zero1 = self._zero1_mode == "manual"
+        if self._zero1 and live_pp:
+            raise NotImplementedError(
+                "zero1='manual' cannot compose with pipeline axis %r in "
+                "one step; use zero1='auto' (with_sharding_constraint "
+                "formulation) with pipeline parallelism" % live_pp[0])
         self._accum = int(grad_accum)
         if self._accum < 1:
             raise ValueError("grad_accum must be >= 1")
-        if self._zero1:
+        if self._zero1_mode:
             self._zero_axes = {n: self._zero_axis_for(n)
                                for n in self._diff_names}
             self._zero_shardings = {n: self._zero_sharding(n)
@@ -283,6 +300,7 @@ class ShardedTrainer:
             return self._build_raw_zero1(n_data_args)
         diff_names = self._diff_names
         grads_of = self._make_grad_stage(n_data_args)
+        auto_zero = self._zero1_mode == "auto"
 
         def step_fn(param_vals, aux_vals, opt_state, t, key, *batch):
             data, label = batch[:n_data_args], batch[n_data_args:]
@@ -291,8 +309,25 @@ class ShardedTrainer:
             new_params, new_opt = {}, {}
             for n in diff_names:
                 st = opt_state.get(n, ())
-                new_params[n], new_st = self._apply_opt(
-                    param_vals[n], grads[n], st, t)
+                p, g = param_vals[n], grads[n]
+                if auto_zero and self._zero_axes[n] is not None:
+                    # ZeRO-1, constraint formulation: pin the grad, the
+                    # param copy the optimizer reads, and the opt state to
+                    # the dp-sharded layout — GSPMD lowers the dp grad
+                    # reduction to reduce-scatter, runs the update on 1/dp
+                    # shards, and all-gathers the fresh params back to the
+                    # replicated layout pinned on the output
+                    zsh = self._zero_shardings[n]
+                    g = jax.lax.with_sharding_constraint(g, zsh)
+                    p = jax.lax.with_sharding_constraint(p, zsh)
+                    st = tuple(jax.lax.with_sharding_constraint(s, zsh)
+                               for s in st)
+                    newp, new_st = self._apply_opt(p, g, st, t)
+                    newp = jax.lax.with_sharding_constraint(
+                        newp, self._param_shardings[n])
+                else:
+                    newp, new_st = self._apply_opt(p, g, st, t)
+                new_params[n] = newp
                 if new_st:
                     new_opt[n] = new_st
             return new_params, new_aux, new_opt, loss

@@ -299,11 +299,69 @@ def test_trainer_pp_tp_composed_runs():
     assert losses[1] < losses[0] + 1.0
 
 
-def test_trainer_zero1_pp_raises():
+def test_trainer_zero1_manual_pp_raises_auto_composes():
+    """zero1='manual' cannot nest a pp shard_map under its dp region and
+    says so; zero1=True auto-selects the constraint formulation, which
+    composes — sharded optimizer state AND pipeline collective-permutes
+    in one audited program, loss parity vs single device (VERDICT r3 #5
+    stretch: zero1 + pp in one step)."""
     mesh = make_mesh({"dp": 2, "pp": 4}, devices=jax.devices()[:8])
     with pytest.raises(NotImplementedError):
         ShardedTrainer(_pp_model(5), _xent, mesh, optimizer="adam",
-                       zero1=True)
+                       zero1="manual")
+
+    rng = np.random.RandomState(9)
+    X = rng.rand(16, 16).astype(np.float32)
+    Y = rng.randint(0, 4, (16,)).astype(np.float32)
+    tr = ShardedTrainer(_pp_model(5), _xent, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": 1e-2},
+                        data_specs=P("dp"), label_spec=P("dp"), zero1=True)
+    assert tr._zero1_mode == "auto"
+    counts = collective_counts(tr.lowered(X, Y).compile().as_text())
+    # pipeline shifts plus the dp gradient reduction (reduce-scatter when
+    # the backend canonicalizes, all-reduce + dynamic-slice otherwise)
+    assert counts["collective-permute"] >= 2, counts
+    assert counts["reduce-scatter"] >= 1 or counts["all-reduce"] >= 1, counts
+    # optimizer state is genuinely dp-sharded
+    n_sharded = 0
+    for n, st in tr._opt_state.items():
+        if tr._zero_axes.get(n) is None:
+            continue
+        n_sharded += 1
+        for s in st:
+            assert "dp" in str(s.sharding.spec), (n, s.sharding)
+    assert n_sharded > 0
+
+    tr1 = ShardedTrainer(_pp_model(5), _xent,
+                         make_mesh({"dp": 1}, devices=jax.devices()[:1]),
+                         optimizer="adam",
+                         optimizer_params={"learning_rate": 1e-2},
+                         data_specs=P(), label_spec=P())
+    l1 = [float(tr1.step(X, Y)) for _ in range(3)]
+    l2 = [float(tr.step(X, Y)) for _ in range(3)]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-5)
+
+
+def test_trainer_zero1_auto_matches_manual():
+    """The two ZeRO-1 formulations are the same optimizer: identical loss
+    trajectories on a pure-dp mesh."""
+    rng = np.random.RandomState(13)
+    X = rng.rand(16, 16).astype(np.float32)
+    Y = rng.randint(0, 4, (16,)).astype(np.float32)
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+
+    def mk(mode):
+        return ShardedTrainer(_pp_model(17), _xent, mesh, optimizer="adam",
+                              optimizer_params={"learning_rate": 1e-2},
+                              data_specs=P("dp"), label_spec=P("dp"),
+                              zero1=mode)
+    # _pp_model carries a PipelineStack but pp is absent from this mesh,
+    # so manual mode is legal (the stack runs sequentially); 4 steps so
+    # the dp-sharded adam state (zero at step 1) actually gets consumed
+    tm, ta = mk("manual"), mk("auto")
+    lm = [float(tm.step(X, Y)) for _ in range(4)]
+    la = [float(ta.step(X, Y)) for _ in range(4)]
+    np.testing.assert_allclose(lm, la, rtol=2e-4, atol=2e-5)
 
 
 def test_pipeline_stack_sequential_off_mesh():
