@@ -1,0 +1,81 @@
+"""Matrix-factorization recommender (reference:
+example/recommenders/matrix_fact.py on MovieLens-100k).
+
+Hermetic by default: synthetic low-rank ratings; pass --data with a
+whitespace-separated "user item rating" file (MovieLens u.data format)
+for real use. --deep switches to the two-tower DeepMF variant.
+"""
+
+import argparse
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+def load_data(args, rng):
+    if args.data:
+        raw = np.loadtxt(args.data, usecols=(0, 1, 2))
+        users = raw[:, 0].astype(np.int32) - raw[:, 0].min().astype(np.int32)
+        items = raw[:, 1].astype(np.int32) - raw[:, 1].min().astype(np.int32)
+        ratings = raw[:, 2].astype(np.float32)
+    else:
+        n_u, n_i, k = 200, 150, 6
+        U, V = rng.randn(n_u, k), rng.randn(n_i, k)
+        users = rng.randint(0, n_u, (20000,)).astype(np.int32)
+        items = rng.randint(0, n_i, (20000,)).astype(np.int32)
+        ratings = ((U[users] * V[items]).sum(-1)
+                   + 0.1 * rng.randn(len(users))).astype(np.float32)
+    return users, items, ratings
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", help="MovieLens-style 'user item rating' file")
+    ap.add_argument("--factors", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--deep", action="store_true")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    users, items, ratings = load_data(args, rng)
+    n_users, n_items = int(users.max()) + 1, int(items.max()) + 1
+    split = int(0.9 * len(users))
+    order = rng.permutation(len(users))
+    tr_idx, te_idx = order[:split], order[split:]
+
+    cls = mx.models.DeepMFBlock if args.deep else mx.models.MFBlock
+    net = cls(n_users, n_items, factors=args.factors,
+              mean=float(ratings[tr_idx].mean()))
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    l2 = gluon.loss.L2Loss()
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(tr_idx)
+        total, count = 0.0, 0
+        for s in range(0, len(perm) - args.batch + 1, args.batch):
+            b = perm[s:s + args.batch]
+            u = nd.array(users[b], dtype="int32")
+            i = nd.array(items[b], dtype="int32")
+            r = nd.array(ratings[b])
+            with autograd.record():
+                loss = l2(net(u, i), r).mean()
+            loss.backward()
+            trainer.step(args.batch)
+            total += float(loss.asnumpy())
+            count += 1
+        pred = net(nd.array(users[te_idx], dtype="int32"),
+                   nd.array(items[te_idx], dtype="int32")).asnumpy()
+        rmse = float(np.sqrt(((pred - ratings[te_idx]) ** 2).mean()))
+        print("epoch %2d  train_l2 %.4f  test_rmse %.4f"
+              % (epoch, total / max(count, 1), rmse))
+
+
+if __name__ == "__main__":
+    main()

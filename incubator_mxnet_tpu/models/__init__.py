@@ -2,6 +2,9 @@
 
 from .lenet import lenet5, mlp
 from .lstm_lm import RNNModel, lstm_lm_ptb
+from .dcgan import DCGANGenerator, DCGANDiscriminator, dcgan
+from .matrix_fact import MFBlock, DeepMFBlock
+from .seq2seq import Seq2SeqAttn
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
                    bert_sharding_rules, MultiHeadAttention,
                    TransformerEncoderLayer, BERTEncoder)

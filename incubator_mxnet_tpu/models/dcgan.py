@@ -1,0 +1,99 @@
+"""DCGAN generator/discriminator (reference family:
+`example/gluon/dc_gan/dcgan.py` — netG ConvTranspose stack z->image,
+netD strided-Conv stack with LeakyReLU + BatchNorm, sigmoid-BCE game).
+
+TPU notes: both nets are pure Conv/ConvTranspose stacks that XLA maps
+straight onto the MXU; train both players inside ONE jitted step (the
+gluon Trainer path or ShardedTrainer with dp) rather than alternating
+host-driven sub-steps.
+"""
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["DCGANGenerator", "DCGANDiscriminator", "dcgan"]
+
+
+def _n_doublings(size):
+    """size -> k with size == 4 * 2**k; raises unless exactly that form
+    (the ladder doubles spatial dims from a 4x4 seed)."""
+    n, s = 0, size
+    while s > 4 and s % 2 == 0:
+        s //= 2
+        n += 1
+    if s != 4:
+        raise ValueError("size must be 4 * 2**k (16, 32, 64, ...); got %d"
+                         % size)
+    return n
+
+
+class DCGANGenerator(HybridBlock):
+    """z (N, latent, 1, 1) -> image (N, channels, size, size).
+
+    size must be a multiple of 8 and >= 16; the stack is the standard
+    project-then-upsample-by-2 ladder with BN + ReLU, tanh output.
+    """
+
+    def __init__(self, size=64, channels=3, latent=100, base_filters=64,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if size < 16:
+            raise ValueError("size must be >= 16")
+        n_up = _n_doublings(size)
+        with self.name_scope():
+            self._net = nn.HybridSequential(prefix="g_")
+            f = base_filters * (2 ** (n_up - 1))
+            # 1x1 -> 4x4 projection
+            self._net.add(nn.Conv2DTranspose(f, 4, 1, 0, use_bias=False,
+                                             in_channels=latent))
+            self._net.add(nn.BatchNorm(in_channels=f))
+            self._net.add(nn.Activation("relu"))
+            for _ in range(n_up - 1):
+                self._net.add(nn.Conv2DTranspose(f // 2, 4, 2, 1,
+                                                 use_bias=False,
+                                                 in_channels=f))
+                f //= 2
+                self._net.add(nn.BatchNorm(in_channels=f))
+                self._net.add(nn.Activation("relu"))
+            self._net.add(nn.Conv2DTranspose(channels, 4, 2, 1,
+                                             use_bias=False, in_channels=f))
+            self._net.add(nn.Activation("tanh"))
+
+    def hybrid_forward(self, F, z):
+        return self._net(z)
+
+
+class DCGANDiscriminator(HybridBlock):
+    """image (N, channels, size, size) -> real/fake logit (N,)."""
+
+    def __init__(self, size=64, channels=3, base_filters=64, **kwargs):
+        super().__init__(**kwargs)
+        n_down = _n_doublings(size)
+        with self.name_scope():
+            self._net = nn.HybridSequential(prefix="d_")
+            f = base_filters
+            self._net.add(nn.Conv2D(f, 4, 2, 1, use_bias=False,
+                                    in_channels=channels))
+            self._net.add(nn.LeakyReLU(0.2))
+            for _ in range(n_down - 1):
+                self._net.add(nn.Conv2D(f * 2, 4, 2, 1, use_bias=False,
+                                        in_channels=f))
+                f *= 2
+                self._net.add(nn.BatchNorm(in_channels=f))
+                self._net.add(nn.LeakyReLU(0.2))
+            # 4x4 -> 1x1 logit head
+            self._net.add(nn.Conv2D(1, 4, 1, 0, use_bias=False,
+                                    in_channels=f))
+
+    def hybrid_forward(self, F, x):
+        out = self._net(x)
+        return out.reshape((out.shape[0],)) if hasattr(out, "reshape") \
+            else out.reshape(out.shape[0])
+
+
+def dcgan(size=64, channels=3, latent=100, base_filters=64):
+    """(generator, discriminator) pair with matched geometry."""
+    return (DCGANGenerator(size, channels, latent, base_filters,
+                           prefix="gen_"),
+            DCGANDiscriminator(size, channels, base_filters,
+                               prefix="disc_"))

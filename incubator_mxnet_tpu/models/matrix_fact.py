@@ -1,0 +1,60 @@
+"""Matrix-factorization recommenders (reference family:
+`example/recommenders/matrix_fact.py` — user/item embedding dot with
+biases on MovieLens; `demo2-dssm` deep variant).
+
+TPU notes: embeddings are gathers + one batched dot — bandwidth-bound
+host-side, trivial on-chip; the sparse-gradient path (rows touched per
+batch) rides the framework's row-sparse embedding grads, matching the
+reference's `sparse_embedding` usage.
+"""
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["MFBlock", "DeepMFBlock"]
+
+
+class MFBlock(HybridBlock):
+    """rating_hat(u, i) = <e_u, e_i> + b_u + b_i + mu."""
+
+    def __init__(self, n_users, n_items, factors=32, mean=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._mean = float(mean)
+        with self.name_scope():
+            self.user_embed = nn.Embedding(n_users, factors)
+            self.item_embed = nn.Embedding(n_items, factors)
+            self.user_bias = nn.Embedding(n_users, 1)
+            self.item_bias = nn.Embedding(n_items, 1)
+
+    def hybrid_forward(self, F, users, items):
+        p = self.user_embed(users)
+        q = self.item_embed(items)
+        dot = (p * q).sum(-1)
+        return (dot + self.user_bias(users).reshape(dot.shape)
+                + self.item_bias(items).reshape(dot.shape) + self._mean)
+
+
+class DeepMFBlock(HybridBlock):
+    """Two-tower deep variant: MLP over [e_u ; e_i] plus the dot term."""
+
+    def __init__(self, n_users, n_items, factors=32, hidden=(64, 32),
+                 mean=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._mean = float(mean)
+        with self.name_scope():
+            self.user_embed = nn.Embedding(n_users, factors)
+            self.item_embed = nn.Embedding(n_items, factors)
+            self.mlp = nn.HybridSequential(prefix="mlp_")
+            in_units = 2 * factors
+            for h in hidden:
+                self.mlp.add(nn.Dense(h, activation="relu",
+                                      in_units=in_units))
+                in_units = h
+            self.mlp.add(nn.Dense(1, in_units=in_units))
+
+    def hybrid_forward(self, F, users, items):
+        p = self.user_embed(users)
+        q = self.item_embed(items)
+        dot = (p * q).sum(-1)
+        mlp = self.mlp(F.concat(p, q, dim=-1))
+        return dot + mlp.reshape(dot.shape) + self._mean

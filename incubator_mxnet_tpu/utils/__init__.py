@@ -1,1 +1,2 @@
 from . import test_utils  # noqa: F401
+from .checkpoint import CheckpointManager  # noqa: F401

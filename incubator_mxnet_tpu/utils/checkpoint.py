@@ -1,0 +1,285 @@
+"""Preemption-aware checkpoint management.
+
+The reference's recovery story is "checkpoint/resume + restart via the
+launcher" (SURVEY §5: ps-lite heartbeats surface dead nodes, recovery =
+`model.py save_checkpoint` + `callback.do_checkpoint` re-run from
+`begin_epoch`; reference `python/mxnet/model.py`,
+`python/mxnet/callback.py:do_checkpoint`). On TPU pods the failure mode
+that actually matters is PREEMPTION: the coordinator gets a SIGTERM with a
+grace window, and the job must persist a consistent state and resume from
+it on restart. This module is that modern equivalent:
+
+- atomic checkpoints (write to a temp dir, fsync, rename) — a killed
+  writer never leaves a half-readable checkpoint, and `restore()` simply
+  ignores leftover temp dirs;
+- async saves — device arrays are snapshotted to host synchronously (so
+  the checkpoint is a consistent cut even while training continues), the
+  disk write happens on a background thread off the step path;
+- keep-last-k pruning, done only after the new checkpoint is durable;
+- `install_preemption_handler()` — SIGTERM triggers one final synchronous
+  save before the process dies;
+- `latest_step()`/`restore()` for coordinator-restart resume.
+"""
+
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+import warnings
+import weakref
+
+import numpy as _np
+
+from .. import ndarray as nd
+
+__all__ = ["CheckpointManager"]
+
+_TMP_SUFFIX = ".tmp"
+
+
+def _drain_writer(cell, directory):
+    """Exit/gc finalizer: join an in-flight async write so a clean process
+    exit never truncates the final checkpoint (daemon threads would be
+    killed mid-write otherwise)."""
+    t = cell.get("thread")
+    if t is not None and t.is_alive():
+        warnings.warn("CheckpointManager(%s): draining in-flight "
+                      "checkpoint write at exit" % directory)
+        t.join()
+
+
+class CheckpointManager:
+    """Manage a directory of step-numbered checkpoints.
+
+    Parameters
+    ----------
+    directory : str
+        Root directory (created if missing). Each checkpoint is a
+        subdirectory ``ckpt-{step:08d}/`` holding ``params`` (nd.save
+        format), optional ``trainer`` states, and ``meta.json``.
+    keep : int
+        Number of most-recent complete checkpoints to retain (older ones
+        are pruned after each durable save). ``None`` keeps everything.
+    async_save : bool
+        Write on a background thread. The device->host snapshot always
+        happens synchronously in `save()`, so training may mutate params
+        immediately after it returns; `wait()` (or the next `save()`)
+        joins the writer and re-raises any write error.
+    """
+
+    def __init__(self, directory, keep=3, async_save=True, prefix="ckpt"):
+        if keep is not None and keep < 1:
+            raise ValueError("keep must be >= 1 (or None for unlimited); "
+                             "keep=%r would prune every checkpoint" % keep)
+        self._dir = directory
+        self._keep = keep
+        self._async = bool(async_save)
+        self._prefix = prefix
+        # thread handle lives in a shared cell so the exit finalizer can
+        # drain an in-flight write without keeping the manager alive
+        self._cell = {"thread": None}
+        self._error = None
+        self._sig_state = None
+        os.makedirs(directory, exist_ok=True)
+        weakref.finalize(self, _drain_writer, self._cell, directory)
+
+    @property
+    def _thread(self):
+        return self._cell["thread"]
+
+    @_thread.setter
+    def _thread(self, t):
+        self._cell["thread"] = t
+
+    # ------------------------------------------------------------- naming
+    def _name(self, step):
+        return "%s-%08d" % (self._prefix, int(step))
+
+    def _path(self, step):
+        return os.path.join(self._dir, self._name(step))
+
+    def steps(self):
+        """Sorted list of steps with COMPLETE checkpoints on disk."""
+        out = []
+        pat = self._prefix + "-"
+        for e in os.listdir(self._dir):
+            if not e.startswith(pat) or e.endswith(_TMP_SUFFIX):
+                continue
+            if not os.path.exists(os.path.join(self._dir, e, "meta.json")):
+                continue   # interrupted pre-atomic-rename artifact
+            try:
+                out.append(int(e[len(pat):]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def latest_step(self):
+        """Newest complete step number, or None."""
+        s = self.steps()
+        return s[-1] if s else None
+
+    # -------------------------------------------------------------- save
+    @staticmethod
+    def _snapshot(params):
+        """Device/NDArray dict -> host numpy dict (the consistent cut)."""
+        snap = {}
+        for k, v in params.items():
+            if hasattr(v, "asnumpy"):
+                snap[k] = v.asnumpy()
+            else:
+                snap[k] = _np.asarray(v)
+        return snap
+
+    def save(self, step, params, trainer=None, extra=None):
+        """Checkpoint `params` (dict name -> NDArray/array) at `step`.
+
+        trainer : object with ``save_states(fname)`` (gluon Trainer) or a
+            raw bytes payload to store alongside.
+        extra : JSON-able dict merged into meta.json (e.g. epoch, rng
+            seed, data-iterator position).
+        """
+        self.wait()   # surface any previous writer error before snapshot
+        snap = self._snapshot(params)
+        trainer_payload = None
+        if trainer is not None:
+            if isinstance(trainer, (bytes, bytearray)):
+                trainer_payload = bytes(trainer)
+            else:
+                tmp = os.path.join(self._dir, ".trainer%s.%d"
+                                   % (_TMP_SUFFIX, os.getpid()))
+                trainer.save_states(tmp)
+                with open(tmp, "rb") as f:
+                    trainer_payload = f.read()
+                os.remove(tmp)
+        meta = {"step": int(step), "time": time.time(),
+                "param_names": sorted(snap)}
+        if extra:
+            meta.update(extra)
+
+        if self._async:
+            self._thread = threading.Thread(
+                target=self._write, args=(step, snap, trainer_payload, meta),
+                daemon=True)
+            self._thread.start()
+        else:
+            self._write(step, snap, trainer_payload, meta)
+            self._raise_pending()
+
+    def _write(self, step, snap, trainer_payload, meta):
+        try:
+            final = self._path(step)
+            tmp = "%s%s.%d" % (final, _TMP_SUFFIX, os.getpid())
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            nd.save(os.path.join(tmp, "params"),
+                    {k: nd.array(v) for k, v in snap.items()})
+            if trainer_payload is not None:
+                with open(os.path.join(tmp, "trainer"), "wb") as f:
+                    f.write(trainer_payload)
+            # meta.json last: its presence marks the payload complete
+            # (steps() requires it), and the dir rename publishes it
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            self._prune()
+        except BaseException as e:   # re-raised on the caller thread
+            self._error = e
+
+    def _prune(self):
+        if self._keep is None:
+            return
+        for s in self.steps()[:-self._keep]:
+            shutil.rmtree(self._path(s), ignore_errors=True)
+
+    def wait(self):
+        """Join any in-flight async write; re-raise its error."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        self._raise_pending()
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    # ------------------------------------------------------------ restore
+    def restore(self, step=None):
+        """Load checkpoint `step` (default: latest). Returns
+        (step, params_dict, trainer_bytes_or_None, meta_dict); params come
+        back as NDArrays. Raises FileNotFoundError when nothing complete
+        exists."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    "no complete checkpoint under %s" % self._dir)
+        path = self._path(step)
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        params = nd.load(os.path.join(path, "params"))
+        trainer_payload = None
+        tpath = os.path.join(path, "trainer")
+        if os.path.exists(tpath):
+            with open(tpath, "rb") as f:
+                trainer_payload = f.read()
+        return int(step), params, trainer_payload, meta
+
+    def restore_trainer(self, trainer, payload):
+        """Feed a restored trainer-states payload back into a Trainer."""
+        tmp = os.path.join(self._dir, ".restore%s.%d"
+                           % (_TMP_SUFFIX, os.getpid()))
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        try:
+            trainer.load_states(tmp)
+        finally:
+            os.remove(tmp)
+
+    # --------------------------------------------------------- preemption
+    def install_preemption_handler(self, get_state, signals=(signal.SIGTERM,)):
+        """On SIGTERM (preemption notice), run ONE final synchronous save
+        and chain to the previous handler.
+
+        get_state : callable() -> (step, params_dict[, trainer[, extra]])
+            invoked inside the handler; must not start new device work.
+        Returns the uninstall callable.
+        """
+        prev = {}
+
+        def handler(signum, frame):
+            try:
+                state = get_state()
+                step, params = state[0], state[1]
+                trainer = state[2] if len(state) > 2 else None
+                extra = dict(state[3]) if len(state) > 3 else {}
+                extra["preempted"] = True
+                was_async, self._async = self._async, False
+                try:
+                    self.save(step, params, trainer=trainer, extra=extra)
+                finally:
+                    self._async = was_async
+            finally:
+                old = prev.get(signum)
+                if callable(old):
+                    old(signum, frame)
+                elif old == signal.SIG_DFL:
+                    signal.signal(signum, signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+        for s in signals:
+            prev[s] = signal.signal(s, handler)
+
+        def uninstall():
+            for s, old in prev.items():
+                signal.signal(s, old if old is not None else signal.SIG_DFL)
+        return uninstall

@@ -66,3 +66,167 @@ def test_module_checkpoint_roundtrip(tmp_path):
     out = sym2.eval(data=mx.nd.array(x), **{k: v for k, v in arg2.items()})
     want = x @ arg2["fc_weight"].asnumpy().T + arg2["fc_bias"].asnumpy()
     np.testing.assert_allclose(out[0].asnumpy(), want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: preemption-aware checkpointing (SURVEY §5 "modern
+# equivalent: preemption-aware checkpointing + coordinator restart")
+# ---------------------------------------------------------------------------
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+from incubator_mxnet_tpu.utils import CheckpointManager
+
+
+def _params(seed, n=3):
+    rng = np.random.RandomState(seed)
+    return {"w%d" % i: mx.nd.array(rng.rand(4, 4).astype(np.float32))
+            for i in range(n)}
+
+
+def test_ckpt_manager_roundtrip_and_keep(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2, async_save=False)
+    for step in (10, 20, 30, 40):
+        mgr.save(step, _params(step))
+    assert mgr.steps() == [30, 40]            # keep=2 pruned the rest
+    step, params, trainer, meta = mgr.restore()
+    assert step == 40 and meta["step"] == 40
+    want = _params(40)
+    for k in want:
+        np.testing.assert_array_equal(params[k].asnumpy(),
+                                      want[k].asnumpy())
+    # explicit older step still restorable
+    s30, p30, _, _ = mgr.restore(step=30)
+    np.testing.assert_array_equal(p30["w0"].asnumpy(),
+                                  _params(30)["w0"].asnumpy())
+
+
+def test_ckpt_manager_async_consistent_cut(tmp_path):
+    """The device->host snapshot happens inside save(): mutating the
+    params right after save() returns must not affect the checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    params = _params(1)
+    before = {k: v.asnumpy().copy() for k, v in params.items()}
+    mgr.save(100, params)
+    for k in params:                           # racing mutation
+        params[k] += 1000.0
+    mgr.wait()
+    _, restored, _, _ = mgr.restore(100)
+    for k in before:
+        np.testing.assert_array_equal(restored[k].asnumpy(), before[k])
+
+
+def test_ckpt_manager_ignores_partial(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(5, _params(5))
+    # a crashed writer leaves a temp dir and a renamed-but-empty dir
+    os.makedirs(str(tmp_path / "ckpt-00000009.tmp.1234"))
+    os.makedirs(str(tmp_path / "ckpt-00000007"))   # no meta.json
+    assert mgr.steps() == [5]
+    assert mgr.latest_step() == 5
+    step, _, _, _ = mgr.restore()
+    assert step == 5
+
+
+def test_ckpt_manager_trainer_states_roundtrip(tmp_path):
+    net = mx.gluon.nn.Dense(4, in_units=8, prefix="ck_")
+    net.initialize(mx.init.Xavier())
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.array(np.random.rand(2, 8).astype(np.float32))
+    from incubator_mxnet_tpu import autograd
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    tr.step(2)
+
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    params = {p.name: p.data() for p in net.collect_params().values()}
+    mgr.save(1, params, trainer=tr, extra={"epoch": 3})
+    step, restored, payload, meta = mgr.restore()
+    assert meta["epoch"] == 3 and payload is not None
+
+    # resume into a FRESH net+trainer: load the checkpointed params and
+    # optimizer states, then take one identical step on both — equal
+    # post-step params proves the momentum state actually round-tripped
+    # (a fresh trainer without restore diverges, checked last)
+    net2 = mx.gluon.nn.Dense(4, in_units=8, prefix="ck_")
+    net2.initialize(mx.init.Xavier())
+    for p in net2.collect_params().values():
+        p.set_data(restored[p.name])
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+    mgr.restore_trainer(tr2, payload)
+
+    def one_step(n, t):
+        with autograd.record():
+            loss = (n(x) ** 2).mean()
+        loss.backward()
+        t.step(2)
+        return {p.name: p.data().asnumpy()
+                for p in n.collect_params().values()}
+
+    after1 = one_step(net, tr)
+    after2 = one_step(net2, tr2)
+    for k in after1:
+        np.testing.assert_allclose(after2[k], after1[k], rtol=1e-6)
+
+    # control: WITHOUT restore the same step diverges (momentum at zero)
+    net3 = mx.gluon.nn.Dense(4, in_units=8, prefix="ck_")
+    net3.initialize(mx.init.Xavier())
+    for p in net3.collect_params().values():
+        p.set_data(restored[p.name])
+    tr3 = mx.gluon.Trainer(net3.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+    after3 = one_step(net3, tr3)
+    assert any(np.abs(after3[k] - after1[k]).max() > 1e-7 for k in after1)
+
+
+def test_ckpt_manager_keep_zero_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        CheckpointManager(str(tmp_path), keep=0)
+
+
+def test_ckpt_manager_sigterm_final_save(tmp_path):
+    """Preemption drill in a subprocess: SIGTERM triggers one final
+    synchronous save (marked preempted) before the default handler kills
+    the process; the parent then resumes from it."""
+    script = textwrap.dedent("""
+        import os, signal, sys, time
+        import numpy as np
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu.utils import CheckpointManager
+
+        mgr = CheckpointManager(sys.argv[1], async_save=True)
+        params = {"w": mx.nd.array(np.full((2, 2), 7.0, np.float32))}
+        state = {"step": 0}
+        mgr.install_preemption_handler(
+            lambda: (state["step"], params, None, {"note": "drill"}))
+        mgr.save(1, params)
+        mgr.wait()
+        state["step"] = 2
+        params["w"] += 1.0
+        print("READY", flush=True)
+        time.sleep(30)
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORM_NAME": "cpu",
+             "PYTHONPATH": os.getcwd()})
+    assert proc.stdout.readline().strip() == "READY", proc.stderr.read()
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=60)
+    assert proc.returncode != 0                # died by signal, not exit 0
+
+    mgr = CheckpointManager(str(tmp_path))
+    step, params, _, meta = mgr.restore()
+    assert step == 2 and meta["preempted"] is True and meta["note"] == "drill"
+    np.testing.assert_array_equal(params["w"].asnumpy(),
+                                  np.full((2, 2), 8.0, np.float32))

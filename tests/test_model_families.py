@@ -1,0 +1,137 @@
+"""New model families (reference examples coverage): DCGAN
+(`example/gluon/dc_gan`), matrix-factorization recommender
+(`example/recommenders/matrix_fact.py`), attention seq2seq
+(`example/bi-lstm-sort`). Convergence smoke tests in the reference's
+tests/python/train style: small synthetic data, hard thresholds."""
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+def test_dcgan_shapes_and_adversarial_step():
+    """G/D geometries line up at 32x32; one adversarial round moves BOTH
+    players' losses in the expected direction on a fixed batch."""
+    np.random.seed(0)
+    G, D = mx.models.dcgan(size=32, channels=1, latent=16, base_filters=8)
+    G.initialize(mx.init.Normal(0.02))
+    D.initialize(mx.init.Normal(0.02))
+    z = nd.array(np.random.randn(4, 16, 1, 1).astype(np.float32))
+    fake = G(z)
+    assert fake.shape == (4, 1, 32, 32)
+    logit = D(fake)
+    assert logit.shape == (4,)
+
+    real = nd.array((np.random.rand(4, 1, 32, 32) * 2 - 1)
+                    .astype(np.float32))
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    trD = gluon.Trainer(D.collect_params(), "adam",
+                        {"learning_rate": 2e-3})
+    trG = gluon.Trainer(G.collect_params(), "adam",
+                        {"learning_rate": 2e-3})
+    ones, zeros = nd.ones((4,)), nd.zeros((4,))
+
+    # compare training-mode losses (the BN running stats barely move in
+    # 10 steps, so an eval-mode re-measure would test the wrong thing)
+    d_losses = []
+    for _ in range(10):
+        with autograd.record():
+            L = (bce(D(real), ones) + bce(D(G(z)), zeros)).mean()
+        L.backward()
+        trD.step(4)
+        d_losses.append(float(L.asnumpy()))
+    assert d_losses[-1] < d_losses[0], d_losses   # D learns to separate
+
+    g_losses = []
+    for _ in range(10):
+        with autograd.record():
+            L = bce(D(G(z)), ones).mean()
+        L.backward()
+        trG.step(4)
+        g_losses.append(float(L.asnumpy()))
+    assert g_losses[-1] < g_losses[0], g_losses   # G fools the frozen D
+
+
+def test_matrix_fact_converges_on_low_rank():
+    """MF recovers a synthetic rank-4 rating matrix: RMSE well under the
+    ratings' spread."""
+    rng = np.random.RandomState(1)
+    n_u, n_i, k = 40, 30, 4
+    U, V = rng.randn(n_u, k), rng.randn(n_i, k)
+    users = rng.randint(0, n_u, (2000,))
+    items = rng.randint(0, n_i, (2000,))
+    ratings = (U[users] * V[items]).sum(-1).astype(np.float32)
+
+    net = mx.models.MFBlock(n_u, n_i, factors=8, mean=float(ratings.mean()))
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 2e-2})
+    l2 = gluon.loss.L2Loss()
+    u_nd = nd.array(users.astype(np.int32), dtype="int32")
+    i_nd = nd.array(items.astype(np.int32), dtype="int32")
+    r_nd = nd.array(ratings)
+    for _ in range(150):
+        with autograd.record():
+            loss = l2(net(u_nd, i_nd), r_nd).mean()
+        loss.backward()
+        tr.step(len(users))
+    pred = net(u_nd, i_nd).asnumpy()
+    rmse = float(np.sqrt(((pred - ratings) ** 2).mean()))
+    assert rmse < 0.5 * ratings.std(), rmse
+
+
+def test_deep_mf_forward_and_grads():
+    net = mx.models.DeepMFBlock(10, 12, factors=4, hidden=(8,))
+    net.initialize(mx.init.Xavier())
+    u = nd.array(np.array([0, 3, 9], np.int32), dtype="int32")
+    i = nd.array(np.array([1, 5, 11], np.int32), dtype="int32")
+    with autograd.record():
+        out = net(u, i)
+        L = (out ** 2).mean()
+    L.backward()
+    assert out.shape == (3,)
+    g = net.user_embed.weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_seq2seq_learns_to_sort():
+    """The bi-lstm-sort task: input a sequence of digit tokens, emit them
+    sorted. Token accuracy must clear 90% on held-out sequences."""
+    rng = np.random.RandomState(2)
+    V, T, B = 12, 5, 64            # tokens 2..11, 0=pad 1=bos
+    BOS = 1
+
+    def batch(n):
+        src = rng.randint(2, V, (n, T)).astype(np.int32)
+        tgt = np.sort(src, axis=1)
+        tgt_in = np.concatenate(
+            [np.full((n, 1), BOS, np.int32), tgt[:, :-1]], axis=1)
+        return src, tgt_in, tgt
+
+    net = mx.models.Seq2SeqAttn(V, V, embed=32, hidden=64)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 5e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    for step in range(220):
+        src, tgt_in, tgt = batch(B)
+        with autograd.record():
+            logits = net(nd.array(src, dtype="int32"),
+                         nd.array(tgt_in, dtype="int32"))
+            loss = sce(logits.reshape((-1, V)),
+                       nd.array(tgt.reshape(-1).astype(np.float32))).mean()
+        loss.backward()
+        tr.step(B)
+    # teacher-forced accuracy on fresh data
+    src, tgt_in, tgt = batch(128)
+    logits = net(nd.array(src, dtype="int32"),
+                 nd.array(tgt_in, dtype="int32"))
+    acc = float((logits.asnumpy().argmax(-1) == tgt).mean())
+    assert acc > 0.9, acc
+    # greedy decode actually sorts at least some full sequences
+    out = net.translate(nd.array(src[:16], dtype="int32"), BOS, T)
+    seq_acc = float((out == tgt[:16]).all(axis=1).mean())
+    assert seq_acc > 0.3, seq_acc
