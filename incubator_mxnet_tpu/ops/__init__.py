@@ -11,6 +11,8 @@ from . import tensor, nn, random, rnn, image, contrib, vision, control_flow, \
 from .tensor import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .rnn import rnn_forward, unpack_rnn_params, rnn_param_size  # noqa: F401
+from .sampled import (log_uniform_candidates, sampled_softmax_loss,  # noqa: F401
+                      nce_loss)
 
 
 def __getattr__(name):

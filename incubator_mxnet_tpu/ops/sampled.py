@@ -1,0 +1,121 @@
+"""Sampled-softmax and NCE losses for huge-vocabulary output layers.
+
+Reference family: `example/rnn/large_word_lm/model.py:sampled_softmax`
+(importance-sampled softmax with log-uniform candidates and
+accidental-hit removal, sparse row-gathered output weights) and
+`example/nce-loss/nce.py` (noise-contrastive estimation).
+
+TPU redesign: the reference gathers candidate rows through
+`sparse.Embedding` so only touched rows carry gradients; here the gather
+is one `take` (XLA keeps the backward a scatter-add into the big table)
+and the (n, num_sampled) logits are a single MXU matmul. Everything is
+batched, static-shaped, and key-explicit (`jax.random`), so the whole
+loss jits into the training step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["log_uniform_candidates", "sampled_softmax_loss", "nce_loss"]
+
+
+def log_uniform_candidates(key, num_sampled, range_max):
+    """Draw `num_sampled` candidate classes ~ log-uniform (Zipfian) over
+    [0, range_max), the distribution of a frequency-sorted vocabulary.
+
+    P(c) = log((c+2)/(c+1)) / log(range_max+1)  (TF/candidate-sampling
+    convention, what the reference's LogUniformGenerator draws).
+    Returns (samples (num_sampled,) int32, log_prob_fn) where
+    log_prob_fn(classes) gives the per-class log expected probability.
+    Sampling is WITH replacement (unbiased importance weights)."""
+    log_range = jnp.log(float(range_max) + 1.0)
+
+    def log_prob(classes):
+        c = classes.astype(jnp.float32)
+        return jnp.log(jnp.log1p(1.0 / (c + 1.0)) / log_range)
+
+    u = jax.random.uniform(key, (num_sampled,), minval=0.0, maxval=1.0)
+    # inverse CDF: c = floor(exp(u * log(range_max+1)) - 1)
+    samples = jnp.floor(jnp.exp(u * log_range) - 1.0).astype(jnp.int32)
+    samples = jnp.clip(samples, 0, range_max - 1)
+    return samples, log_prob
+
+
+def _gather_logits(weight, bias, hidden, labels, samples, log_prob,
+                   subtract_log_q):
+    """Shared candidate-logit plumbing.
+
+    weight (V, D), bias (V,), hidden (N, D), labels (N,),
+    samples (S,) -> true_logits (N,), sampled_logits (N, S)."""
+    labels = labels.astype(jnp.int32).reshape(-1)
+    w_true = jnp.take(weight, labels, axis=0)          # (N, D)
+    b_true = jnp.take(bias, labels)                    # (N,)
+    true_logits = (w_true * hidden).sum(-1) + b_true
+    w_samp = jnp.take(weight, samples, axis=0)         # (S, D)
+    b_samp = jnp.take(bias, samples)                   # (S,)
+    sampled_logits = hidden @ w_samp.T + b_samp        # (N, S) — MXU
+    if subtract_log_q:
+        # importance correction: logit -= log E[count] (with-replacement
+        # expected count ~ num_sampled * P(c); the constant log(S) shifts
+        # all logits equally and cancels in the softmax, so P alone works)
+        true_logits = true_logits - log_prob(labels)
+        sampled_logits = sampled_logits - log_prob(samples)[None, :]
+    return labels, true_logits, sampled_logits
+
+
+def sampled_softmax_loss(weight, bias, hidden, labels, key, num_sampled,
+                         remove_accidental_hits=True, consistent=False):
+    """Importance-sampled softmax CE (training-only estimator of the full
+    softmax; evaluate with the full projection).
+
+    weight (V, D), bias (V,), hidden (N, D), labels (N,) -> loss (N,).
+
+    consistent=False (default) is the reference/TF convention — subtract
+    log(expected count) from BOTH the true and sampled logits
+    (`example/rnn/large_word_lm/model.py:120-124`); a biased objective
+    whose argmin still tracks the full softmax. consistent=True keeps the
+    true logit exact and corrects sampled logits by log(S * q) — the
+    importance-sampling partition estimate (Jean et al.), whose VALUE
+    converges to the full-softmax CE as S grows (requires
+    remove_accidental_hits so the true class is not double-counted).
+    """
+    V = weight.shape[0]
+    samples, log_prob = log_uniform_candidates(key, num_sampled, V)
+    labels, true_logits, sampled_logits = _gather_logits(
+        weight, bias, hidden, labels, samples, log_prob,
+        subtract_log_q=not consistent)
+    if consistent:
+        sampled_logits = sampled_logits \
+            - log_prob(samples)[None, :] - jnp.log(float(num_sampled))
+    if remove_accidental_hits or consistent:
+        hit = labels[:, None] == samples[None, :]
+        sampled_logits = jnp.where(hit, -1e30, sampled_logits)
+    logits = jnp.concatenate([true_logits[:, None], sampled_logits], axis=1)
+    # label is always column 0 of the candidate set
+    return -jax.nn.log_softmax(logits, axis=-1)[:, 0]
+
+
+def nce_loss(weight, bias, hidden, labels, key, num_sampled,
+             remove_accidental_hits=False):
+    """Noise-contrastive estimation: binary logistic discrimination of the
+    true class against `num_sampled` noise classes (reference
+    `example/nce-loss`). Returns per-example loss (N,) summed over the
+    1 + num_sampled binary terms."""
+    V = weight.shape[0]
+    samples, log_prob = log_uniform_candidates(key, num_sampled, V)
+    labels, true_logits, sampled_logits = _gather_logits(
+        weight, bias, hidden, labels, samples, log_prob, subtract_log_q=True)
+    # NCE discriminator logit is s(c) - log(k * q(c)); _gather_logits
+    # handled the log q part, and unlike the softmax path the log(k)
+    # constant does NOT cancel across independent sigmoid terms — it is
+    # what makes exp(s) self-normalized at the optimum
+    log_k = jnp.log(float(num_sampled))
+    true_logits = true_logits - log_k
+    sampled_logits = sampled_logits - log_k
+    if remove_accidental_hits:
+        hit = labels[:, None] == samples[None, :]
+        sampled_logits = jnp.where(hit, -1e30, sampled_logits)
+    # log-loss of sigmoid discriminators: true -> 1, noise -> 0
+    true_term = jax.nn.softplus(-true_logits)
+    noise_term = jax.nn.softplus(sampled_logits).sum(-1)
+    return true_term + noise_term
