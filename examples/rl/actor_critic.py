@@ -1,0 +1,120 @@
+"""Actor-critic policy gradient (reference family:
+`example/gluon/actor_critic.py` and `example/reinforcement-learning` —
+REINFORCE with a value baseline).
+
+Hermetic: no gym in this environment, so the env is a built-in numpy
+"cliff corridor" — the agent walks a 1-D corridor, +1 for reaching the
+goal, -1 for stepping off, discounted returns. The policy/value net is
+one gluon block; the update is a single jitted fwd/bwd per episode batch.
+"""
+
+import argparse
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+class PolicyValue(gluon.HybridBlock):
+    def __init__(self, n_states, n_actions, hidden=64, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = gluon.nn.Dense(hidden, activation="relu",
+                                       in_units=n_states)
+            self.policy = gluon.nn.Dense(n_actions, in_units=hidden)
+            self.value = gluon.nn.Dense(1, in_units=hidden)
+
+    def hybrid_forward(self, F, obs):
+        h = self.body(obs)
+        return self.policy(h), self.value(h)
+
+
+class Corridor:
+    """States 0..n-1; start middle; action 0 = left, 1 = right. Reaching
+    n-1 gives +1; falling off 0 gives -1; step cost -0.01."""
+
+    def __init__(self, n=9):
+        self.n = n
+
+    def reset(self):
+        self.pos = self.n // 2
+        return self.pos
+
+    def step(self, action):
+        self.pos += 1 if action == 1 else -1
+        if self.pos >= self.n - 1:
+            return self.pos, 1.0, True
+        if self.pos <= 0:
+            return self.pos, -1.0, True
+        return self.pos, -0.01, False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=300)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--n", type=int, default=9)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    env = Corridor(args.n)
+    net = PolicyValue(args.n, 2)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+
+    def onehot(s):
+        v = np.zeros(args.n, np.float32)
+        v[s] = 1
+        return v
+
+    rewards_hist = []
+    for ep in range(args.episodes):
+        s, done = env.reset(), False
+        obs, acts, rews = [], [], []
+        while not done and len(acts) < 50:
+            logits, _ = net(nd.array(onehot(s)[None]))
+            p = np.exp(logits.asnumpy()[0])
+            p = p / p.sum()
+            a = rng.choice(2, p=p)
+            obs.append(onehot(s))
+            acts.append(a)
+            s, r, done = env.step(a)
+            rews.append(r)
+        # discounted returns
+        G, ret = 0.0, []
+        for r in reversed(rews):
+            G = r + args.gamma * G
+            ret.append(G)
+        ret = np.array(ret[::-1], np.float32)
+        rewards_hist.append(sum(rews))
+
+        ob = nd.array(np.stack(obs))
+        ac = np.array(acts)
+        with autograd.record():
+            logits, values = net(ob)
+            logp = nd.log_softmax(logits, axis=-1)
+            chosen = nd.array(
+                np.eye(2, dtype=np.float32)[ac])
+            adv = nd.array(ret) - values.reshape((-1,))
+            # policy gradient with value baseline + value regression
+            pg = -((logp * chosen).sum(-1)
+                   * nd.array(np.asarray(adv.asnumpy()))).mean()
+            vloss = (adv ** 2).mean()
+            loss = pg + 0.5 * vloss
+        loss.backward()
+        tr.step(len(acts))
+        if ep % 50 == 0:
+            avg = np.mean(rewards_hist[-50:])
+            print("episode %4d  avg reward(50) % .3f" % (ep, avg))
+    print("final avg reward(50): %.3f" % np.mean(rewards_hist[-50:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,12 @@ import argparse
 
 import numpy as np
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import autograd, gluon, nd
 

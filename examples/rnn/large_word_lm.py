@@ -16,6 +16,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import autograd, gluon, nd
 from incubator_mxnet_tpu.ops import sampled_softmax_loss
@@ -75,6 +81,11 @@ def main():
                             {"learning_rate": 2e-3})
 
     def batch(data):
+        if len(data) < args.bptt + 2:
+            raise ValueError(
+                "corpus split has %d tokens but --bptt %d needs at least "
+                "%d; use a longer corpus or a smaller --bptt"
+                % (len(data), args.bptt, args.bptt + 2))
         idx = rng.randint(0, len(data) - args.bptt - 1, args.batch)
         x = np.stack([data[i:i + args.bptt] for i in idx])
         y = np.stack([data[i + 1:i + args.bptt + 1] for i in idx])
@@ -98,11 +109,25 @@ def main():
         # backprop through the encoder with the hidden-state cotangent
         hid.backward(out_grad=nd.array(np.asarray(grads[2])))
         trainer.step(args.batch)
-        # SGD-with-momentum on the big table (sampled rows only touched)
-        for i, g in enumerate(grads[:2]):
-            opt_state[i] = 0.9 * opt_state[i] - 0.1 * g
-        Wout = Wout + opt_state[0]
-        bout = bout + opt_state[1]
+        # LAZY row-sparse momentum on the big table: grads are zero
+        # outside the candidate rows, so decay+update touch only those
+        # rows (the reference's sgd lazy_update semantics) — O(rows * D)
+        # per step instead of O(V * D)
+        from incubator_mxnet_tpu.ops import log_uniform_candidates
+        samples, _ = log_uniform_candidates(key, args.num_sampled,
+                                            args.vocab)
+        # pad slots point past the table and are dropped by the scatters
+        rows = jnp.unique(jnp.concatenate(
+            [samples, jnp.asarray(y)]), size=args.num_sampled + len(y),
+            fill_value=args.vocab)
+        mW = 0.9 * jnp.take(opt_state[0], rows, axis=0, mode="clip") \
+            - 0.1 * jnp.take(grads[0], rows, axis=0, mode="clip")
+        mb = 0.9 * jnp.take(opt_state[1], rows, mode="clip") \
+            - 0.1 * jnp.take(grads[1], rows, mode="clip")
+        opt_state[0] = opt_state[0].at[rows].set(mW, mode="drop")
+        opt_state[1] = opt_state[1].at[rows].set(mb, mode="drop")
+        Wout = Wout.at[rows].add(mW, mode="drop")
+        bout = bout.at[rows].add(mb, mode="drop")
         if step % 50 == 0:
             print("step %4d  sampled-CE %.4f" % (step, float(loss_j)))
 

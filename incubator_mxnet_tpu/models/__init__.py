@@ -5,6 +5,9 @@ from .lstm_lm import RNNModel, lstm_lm_ptb
 from .dcgan import DCGANGenerator, DCGANDiscriminator, dcgan
 from .matrix_fact import MFBlock, DeepMFBlock
 from .seq2seq import Seq2SeqAttn
+from .segmentation import FCNSegmenter
+from .vae import VAE
+from .text_cnn import TextCNN
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
                    bert_sharding_rules, MultiHeadAttention,
                    TransformerEncoderLayer, BERTEncoder)

@@ -1,0 +1,60 @@
+"""FCN-style semantic segmentation (reference family: `example/fcn-xs` —
+FCN-32s/16s/8s heads over a VGG16 trunk with bilinear deconv upsampling
+and skip fusions).
+
+TPU redesign: the trunk is any model-zoo backbone's feature pyramid; the
+upsampling path uses `jax.image.resize` bilinear (XLA lowers it to dense
+gathers that fuse) + 1x1 score convs, with FCN-8s-style skip fusion. The
+whole net is one hybridized program — per-pixel softmax CE trains on the
+(B, C, H, W) score map directly.
+"""
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["FCNSegmenter"]
+
+
+class _ConvBlock(nn.HybridSequential):
+    def __init__(self, channels, n, in_channels, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            for i in range(n):
+                self.add(nn.Conv2D(channels, 3, padding=1,
+                                   in_channels=in_channels if i == 0
+                                   else channels))
+                self.add(nn.BatchNorm(in_channels=channels))
+                self.add(nn.Activation("relu"))
+
+
+class FCNSegmenter(HybridBlock):
+    """Small FCN-8s: three downsampling stages, per-stage score heads,
+    skip-fused bilinear upsampling back to input resolution.
+
+    forward(x (B, C, H, W)) -> (B, num_classes, H, W) logits.
+    """
+
+    def __init__(self, num_classes, in_channels=3, base=32, **kwargs):
+        super().__init__(**kwargs)
+        self._classes = num_classes
+        with self.name_scope():
+            self.stage1 = _ConvBlock(base, 2, in_channels, prefix="s1_")
+            self.pool1 = nn.MaxPool2D(2, 2)
+            self.stage2 = _ConvBlock(base * 2, 2, base, prefix="s2_")
+            self.pool2 = nn.MaxPool2D(2, 2)
+            self.stage3 = _ConvBlock(base * 4, 2, base * 2, prefix="s3_")
+            self.pool3 = nn.MaxPool2D(2, 2)
+            # 1x1 score heads at 1/4 and 1/8 resolution (FCN skip fusion)
+            self.score3 = nn.Conv2D(num_classes, 1, in_channels=base * 4)
+            self.score2 = nn.Conv2D(num_classes, 1, in_channels=base * 2)
+
+    def hybrid_forward(self, F, x):
+        H, W = x.shape[2], x.shape[3]
+        f1 = self.pool1(self.stage1(x))        # 1/2
+        f2 = self.pool2(self.stage2(f1))       # 1/4
+        f3 = self.pool3(self.stage3(f2))       # 1/8
+        s3 = self.score3(f3)                   # (B, K, H/8, W/8)
+        s2 = self.score2(f2)                   # (B, K, H/4, W/4)
+        up3 = F.BilinearResize2D(s3, height=f2.shape[2], width=f2.shape[3])
+        fused = up3 + s2                       # skip fusion (FCN-8s)
+        return F.BilinearResize2D(fused, height=H, width=W)

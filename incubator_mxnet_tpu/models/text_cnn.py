@@ -1,0 +1,43 @@
+"""Text CNN classifier (reference family:
+`example/cnn_text_classification` — Kim-2014 multi-width convolutions
+over embedded token sequences, max-over-time pooling, dense softmax).
+
+TPU notes: the parallel kernel widths run as independent Conv1D channels
+over the same (B, E, T) embedding — XLA batches them onto the MXU; the
+max-over-time reduction fuses into the conv epilogue.
+"""
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["TextCNN"]
+
+
+class TextCNN(HybridBlock):
+    """forward(tokens (B, T) int) -> (B, num_classes) logits."""
+
+    def __init__(self, vocab, num_classes, embed=64, widths=(3, 4, 5),
+                 channels=64, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._widths = tuple(widths)
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab, embed)
+            self.convs = nn.HybridSequential(prefix="convs_")
+            for w in self._widths:
+                self.convs.add(nn.Conv1D(channels, w, in_channels=embed,
+                                         layout="NCW"))
+            self.dropout = nn.Dropout(dropout) if dropout else None
+            self.out = nn.Dense(num_classes,
+                                in_units=channels * len(self._widths))
+
+    def hybrid_forward(self, F, tokens):
+        e = self.embed(tokens)                       # (B, T, E)
+        e = F.transpose(e, axes=(0, 2, 1))           # (B, E, T) for NCW
+        pooled = []
+        for conv in self.convs._children.values():
+            c = conv(e)                              # (B, C, T-w+1)
+            pooled.append(F.max(F.relu(c), axis=2))  # max over time
+        h = F.concat(*pooled, dim=-1) if len(pooled) > 1 else pooled[0]
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.out(h)

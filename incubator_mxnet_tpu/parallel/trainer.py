@@ -560,6 +560,46 @@ class ShardedTrainer:
         return fn.lower(pv, aux_vals, self._opt_state, jnp.float32(1), key,
                         *datas, *labels)
 
+    # ------------------------------------------------------- checkpointing
+    def state_dict(self):
+        """Flat name -> array dict of the FULL training state (params,
+        aux, optimizer slots, step counter) for utils.CheckpointManager.
+        Arrays may be device-sharded; the manager's host snapshot gathers
+        them (a jax.Array materializes as one global ndarray)."""
+        flat = {"param/" + n: v for n, v in self._param_vals.items()}
+        for n, st in self._opt_state.items():
+            for i, s in enumerate(st):
+                flat["opt%d/%s" % (i, n)] = s
+        flat["step"] = jnp.int32(self._step_count)
+        return flat
+
+    def load_state_dict(self, flat):
+        """Restore state_dict() output (arrays or NDArrays, e.g. from
+        CheckpointManager.restore). Every array is device_put back under
+        its proper sharding — params replicated/tp-ruled, optimizer slots
+        ZeRO-sharded when the trainer is zero1."""
+        def raw(v):
+            return v._data if hasattr(v, "_data") else v
+        for n in self._diff_names + self._aux_names:
+            key = "param/" + n
+            if key not in flat:
+                raise KeyError("checkpoint missing %s" % key)
+            self._param_vals[n] = jax.device_put(
+                raw(flat[key]), self._param_shardings[n])
+        new_opt = {}
+        for n, st in self._opt_state.items():
+            sh = self._zero_shardings.get(n, self._param_shardings[n]) \
+                if self._zero1_mode else self._param_shardings[n]
+            slots = []
+            for i in range(len(st)):
+                key = "opt%d/%s" % (i, n)
+                if key not in flat:
+                    raise KeyError("checkpoint missing %s" % key)
+                slots.append(jax.device_put(raw(flat[key]), sh))
+            new_opt[n] = tuple(slots)
+        self._opt_state = new_opt
+        self._step_count = int(jax.device_get(raw(flat["step"])))
+
     def sync_to_block(self):
         """Copy sharded params back into the gluon block's NDArrays."""
         for n in self._diff_names + self._aux_names:

@@ -230,3 +230,68 @@ def test_ckpt_manager_sigterm_final_save(tmp_path):
     assert step == 2 and meta["preempted"] is True and meta["note"] == "drill"
     np.testing.assert_array_equal(params["w"].asnumpy(),
                                   np.full((2, 2), 8.0, np.float32))
+
+
+def test_sharded_trainer_checkpoint_resume(tmp_path):
+    """Distributed checkpoint/resume: a zero1 ShardedTrainer's full state
+    (params + dp-sharded adam slots + step) round-trips through
+    CheckpointManager; the resumed trainer's loss trajectory continues
+    EXACTLY as the uninterrupted run."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    def build():
+        np.random.seed(21)
+        net = gluon.nn.HybridSequential(prefix="sc_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(16, activation="relu", in_units=8,
+                                   prefix="a_"))
+            net.add(gluon.nn.Dense(4, in_units=16, prefix="b_"))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    def xent(out, label):
+        logp = jax.nn.log_softmax(out, axis=-1)
+        return -jnp.take_along_axis(
+            logp, label.astype(jnp.int32)[:, None], axis=-1).mean()
+
+    rng = np.random.RandomState(22)
+    X = rng.rand(16, 8).astype(np.float32)
+    Y = rng.randint(0, 4, (16,)).astype(np.float32)
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+
+    def mk():
+        return ShardedTrainer(build(), xent, mesh, optimizer="adam",
+                              optimizer_params={"learning_rate": 1e-2},
+                              data_specs=P("dp"), label_spec=P("dp"),
+                              zero1=True)
+
+    # uninterrupted run: 6 steps
+    ref = mk()
+    ref_losses = [float(ref.step(X, Y)) for _ in range(6)]
+
+    # interrupted run: 3 steps, checkpoint, fresh trainer, resume 3 more
+    tr = mk()
+    for _ in range(3):
+        tr.step(X, Y)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(3, tr.state_dict())
+    _, flat, _, _ = mgr.restore()
+
+    tr2 = mk()
+    tr2.load_state_dict(flat)
+    resumed = [float(tr2.step(X, Y)) for _ in range(3)]
+    np.testing.assert_allclose(resumed, ref_losses[3:], rtol=1e-5,
+                               atol=1e-6)
+    # optimizer slots really are dp-sharded after restore
+    n_sh = 0
+    for n, st in tr2._opt_state.items():
+        if tr2._zero_axes.get(n) is None:
+            continue
+        n_sh += 1
+        for s in st:
+            assert "dp" in str(s.sharding.spec), (n, s.sharding)
+    assert n_sh > 0

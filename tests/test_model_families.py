@@ -135,3 +135,106 @@ def test_seq2seq_learns_to_sort():
     out = net.translate(nd.array(src[:16], dtype="int32"), BOS, T)
     seq_acc = float((out == tgt[:16]).all(axis=1).mean())
     assert seq_acc > 0.3, seq_acc
+
+
+def test_fcn_segmenter_overfits_shapes():
+    """FCN-8s head: per-pixel logits at input resolution; overfits a tiny
+    synthetic box-segmentation task to high pixel accuracy."""
+    rng = np.random.RandomState(5)
+    B, H, W = 8, 32, 32
+    x = np.zeros((B, 3, H, W), np.float32)
+    y = np.zeros((B, H, W), np.int64)
+    for b in range(B):                         # one bright box per image
+        r0, c0 = rng.randint(2, 16, 2)
+        r1, c1 = r0 + rng.randint(6, 12), c0 + rng.randint(6, 12)
+        x[b, :, r0:r1, c0:c1] = 1.0
+        y[b, r0:r1, c0:c1] = 1
+    x += 0.1 * rng.randn(*x.shape).astype(np.float32)
+
+    net = mx.models.FCNSegmenter(num_classes=2, base=8)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    xd, yd = nd.array(x), nd.array(y.astype(np.float32))
+    for _ in range(60):
+        with autograd.record():
+            loss = sce(net(xd), yd).mean()
+        loss.backward()
+        tr.step(B)
+    out = net(xd)
+    assert out.shape == (B, 2, H, W)
+    pred = out.asnumpy().argmax(1)
+    acc = float((pred == y).mean())
+    assert acc > 0.9, acc
+
+
+def test_vae_learns_structure():
+    """ELBO falls and reconstructions beat the init by a wide margin on
+    two-cluster data; the KL term stays finite and positive."""
+    import jax
+    rng = np.random.RandomState(6)
+    D, N = 16, 256
+    centers = np.stack([np.full(D, 2.0), np.full(D, -2.0)])
+    x = (centers[rng.randint(0, 2, N)]
+         + 0.3 * rng.randn(N, D)).astype(np.float32)
+
+    net = mx.models.VAE(D, latent=4, hidden=(32,))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    xd = nd.array(x)
+
+    def elbo():
+        recon, mu, logvar = net(xd)
+        return mx.models.VAE.elbo_loss(nd, recon, mu, logvar, xd)
+
+    e0 = float(elbo().mean().asnumpy())
+    for _ in range(120):
+        with autograd.record():
+            recon, mu, logvar = net(xd)
+            loss = mx.models.VAE.elbo_loss(nd, recon, mu, logvar,
+                                           xd).mean()
+        loss.backward()
+        tr.step(N)
+    e1 = float(elbo().mean().asnumpy())
+    assert e1 < 0.5 * e0, (e0, e1)
+    # KL finite and positive (posterior differs from prior)
+    _, mu, logvar = net(xd)
+    kl = float((-0.5 * (1 + logvar - mu ** 2 - logvar.exp())
+                ).sum(-1).mean().asnumpy())
+    assert 0 < kl < 1e3, kl
+
+
+def test_text_cnn_learns_keywords():
+    """Kim-CNN: classify by planted keyword n-grams; >90% held-out."""
+    rng = np.random.RandomState(7)
+    V, T, C = 50, 20, 3
+    keys = [(5, 6, 7), (11, 12, 13), (21, 22, 23)]   # class trigrams
+
+    def batch(n):
+        xs = rng.randint(25, V, (n, T))
+        ys = rng.randint(0, C, n)
+        pos = rng.randint(0, T - 3, n)
+        for i in range(n):
+            xs[i, pos[i]:pos[i] + 3] = keys[ys[i]]
+        return xs.astype(np.int32), ys
+
+    net = mx.models.TextCNN(V, C, embed=32, widths=(2, 3), channels=16)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(80):
+        xs, ys = batch(64)
+        with autograd.record():
+            loss = sce(net(nd.array(xs, dtype="int32")),
+                       nd.array(ys.astype(np.float32))).mean()
+        loss.backward()
+        tr.step(64)
+    xs, ys = batch(256)
+    pred = net(nd.array(xs, dtype="int32")).asnumpy().argmax(-1)
+    acc = float((pred == ys).mean())
+    assert acc > 0.9, acc
