@@ -1,0 +1,96 @@
+"""BiLSTM-CRF sequence tagger (reference: example/gluon/lstm_crf —
+per-sequence Python-loop CRF; here the CRF forward/Viterbi are batched
+lax.scans, see incubator_mxnet_tpu/ops/crf.py).
+
+Toy NER task in the reference's spirit: tag entity spans (B/I/O) in
+synthetic sentences where span-interior words are ambiguous — the CRF's
+learned transitions carry the structure.
+"""
+
+import argparse
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+class BiLSTMCRF(gluon.HybridBlock):
+    def __init__(self, vocab, num_tags, embed=32, hidden=32, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.embed = gluon.nn.Embedding(vocab, embed)
+            self.lstm = gluon.rnn.LSTM(hidden, layout="NTC",
+                                       bidirectional=True,
+                                       input_size=embed)
+            self.proj = gluon.nn.Dense(num_tags, flatten=False,
+                                       in_units=2 * hidden)
+            self.crf = gluon.contrib.nn.CRF(num_tags, prefix="crf_")
+
+    def emissions(self, tokens):
+        return self.proj(self.lstm(self.embed(tokens)))
+
+    def hybrid_forward(self, F, tokens, tags):
+        return self.crf(self.emissions(tokens), tags)
+
+    def tag(self, tokens):
+        return self.crf.decode(self.emissions(tokens))
+
+
+def make_data(rng, n, T=10, vocab=20):
+    xs = np.zeros((n, T), np.int64)
+    ys = np.zeros((n, T), np.int64)          # 0=O 1=B 2=I
+    for i in range(n):
+        t = 0
+        while t < T:
+            if rng.rand() < 0.35 and t + 1 < T:
+                ys[i, t] = 1
+                xs[i, t] = rng.randint(1, 4)          # entity-start words
+                ln = rng.randint(1, 3)
+                for j in range(1, ln + 1):
+                    if t + j < T:
+                        ys[i, t + j] = 2
+                        xs[i, t + j] = rng.randint(4, 12)   # ambiguous
+                t += ln + 1
+            else:
+                ys[i, t] = 0
+                xs[i, t] = rng.randint(4, vocab)            # ambiguous
+                t += 1
+    return xs.astype(np.int32), ys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+
+    net = BiLSTMCRF(vocab=20, num_tags=3)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    for step in range(args.steps):
+        xs, ys = make_data(rng, args.batch)
+        with autograd.record():
+            loss = net(nd.array(xs, dtype="int32"),
+                       nd.array(ys.astype(np.float32))).mean()
+        loss.backward()
+        trainer.step(args.batch)
+        if step % 30 == 0:
+            print("step %4d  crf-nll %.4f" % (step, float(loss.asnumpy())))
+
+    xs, ys = make_data(rng, 256)
+    paths = net.tag(nd.array(xs, dtype="int32"))
+    paths = paths.asnumpy() if hasattr(paths, "asnumpy") else np.asarray(paths)
+    print("viterbi tag accuracy: %.3f" % float((paths == ys).mean()))
+
+
+if __name__ == "__main__":
+    main()

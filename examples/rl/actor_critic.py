@@ -109,7 +109,9 @@ def main():
             vloss = (adv ** 2).mean()
             loss = pg + 0.5 * vloss
         loss.backward()
-        tr.step(len(acts))
+        # loss is already a per-step mean; step(1) avoids a second 1/L
+        # rescale that would over-weight short episodes
+        tr.step(1)
         if ep % 50 == 0:
             avg = np.mean(rewards_hist[-50:])
             print("episode %4d  avg reward(50) % .3f" % (ep, avg))

@@ -5,7 +5,9 @@ from ... import nn as _nn
 from ...model_zoo.vision.squeezenet import HybridConcurrent
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
-           "SyncBatchNorm", "PixelShuffle2D"]
+           "SyncBatchNorm", "PixelShuffle2D", "CRF"]
+
+from .crf import CRF  # noqa: E402,F401
 
 
 class Concurrent(Block):

@@ -1,0 +1,45 @@
+"""Linear-chain CRF layer (reference family:
+`example/gluon/lstm_crf/lstm_crf.py`). Thin parameter-owning wrapper over
+the batched `crf_nll`/`crf_decode` ops (ops/crf.py) — calling the block
+computes the NLL loss, `decode` runs Viterbi; both go through the op
+dispatch so eager calls are tape-recorded and hybridized calls trace."""
+
+from ...block import HybridBlock
+
+__all__ = ["CRF"]
+
+
+class CRF(HybridBlock):
+    """Linear-chain CRF over `num_tags` tags.
+
+    loss = crf(emissions (B,T,K), tags (B,T)[, mask (B,T)]) -> (B,) NLL.
+    paths = crf.decode(emissions[, mask]) -> (B, T) int32 Viterbi tags.
+    """
+
+    def __init__(self, num_tags, **kwargs):
+        super().__init__(**kwargs)
+        self._K = num_tags
+        with self.name_scope():
+            self.transitions = self.params.get(
+                "transitions", shape=(num_tags, num_tags), init="zeros")
+            self.start = self.params.get("start", shape=(num_tags,),
+                                         init="zeros")
+            self.end = self.params.get("end", shape=(num_tags,),
+                                       init="zeros")
+
+    def hybrid_forward(self, F, emissions, tags, mask=None,
+                       transitions=None, start=None, end=None):
+        return F.crf_nll(emissions, tags, transitions, start, end,
+                         mask=mask)
+
+    def decode(self, emissions, mask=None):
+        from ...block import current_trace
+        ctx = current_trace()
+        if ctx is not None:
+            from ....ops.crf import crf_decode as _dec
+            return _dec(emissions, ctx.param_map[self.transitions.name],
+                        ctx.param_map[self.start.name],
+                        ctx.param_map[self.end.name], mask=mask)
+        from .... import ndarray as nd
+        return nd.crf_decode(emissions, self.transitions.data(),
+                             self.start.data(), self.end.data(), mask=mask)

@@ -34,7 +34,7 @@ class TextCNN(HybridBlock):
         e = self.embed(tokens)                       # (B, T, E)
         e = F.transpose(e, axes=(0, 2, 1))           # (B, E, T) for NCW
         pooled = []
-        for conv in self.convs._children.values():
+        for conv in self.convs:
             c = conv(e)                              # (B, C, T-w+1)
             pooled.append(F.max(F.relu(c), axis=2))  # max over time
         h = F.concat(*pooled, dim=-1) if len(pooled) > 1 else pooled[0]

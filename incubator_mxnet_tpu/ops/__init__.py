@@ -7,7 +7,7 @@ to one XLA program), and by name through the Symbol/JSON layer.
 
 from .registry import register, get_op, list_ops, alias, OpInfo
 from . import tensor, nn, random, rnn, image, contrib, vision, control_flow, \
-    optimizer_ops, legacy  # noqa: F401 - populate registry
+    optimizer_ops, legacy, crf  # noqa: F401 - populate registry
 from .tensor import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .rnn import rnn_forward, unpack_rnn_params, rnn_param_size  # noqa: F401

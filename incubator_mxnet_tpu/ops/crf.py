@@ -1,0 +1,91 @@
+"""Linear-chain CRF ops (reference family:
+`example/gluon/lstm_crf/lstm_crf.py` — BiLSTM-CRF whose forward
+algorithm and Viterbi run as per-sequence Python loops of NDArray ops).
+
+TPU redesign: both recursions are batched `lax.scan`s over time — the
+partition function, gold-path score, and Viterbi backtrack jit into the
+surrounding step with no host loop. Tags ride as int arrays; masks are
+contiguous-prefix {0,1} floats (bucketing's static-shape replacement).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["crf_nll", "crf_decode"]
+
+
+def _partition(emis, mask, trans, start, end):
+    """log Z per sequence; emis (B,T,K), mask (B,T)."""
+    alpha0 = start[None, :] + emis[:, 0]
+
+    def step(alpha, xs):
+        e_t, m_t = xs
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None],
+                               axis=1) + e_t
+        return jnp.where(m_t[:, None] > 0, nxt, alpha), None
+
+    xs = (jnp.moveaxis(emis[:, 1:], 1, 0), jnp.moveaxis(mask[:, 1:], 1, 0))
+    alpha, _ = jax.lax.scan(step, alpha0, xs)
+    return jax.nn.logsumexp(alpha + end[None, :], axis=-1)
+
+
+def _gold_score(emis, tags, mask, trans, start, end):
+    tags = tags.astype(jnp.int32)
+    e_scores = jnp.take_along_axis(emis, tags[:, :, None],
+                                   axis=2)[..., 0] * mask
+    t_scores = trans[tags[:, :-1], tags[:, 1:]] * mask[:, 1:]
+    lengths = jnp.maximum(mask.sum(-1).astype(jnp.int32), 1)
+    last = jnp.take_along_axis(tags, (lengths - 1)[:, None], axis=1)[:, 0]
+    return (start[tags[:, 0]] + e_scores.sum(-1) + t_scores.sum(-1)
+            + end[last])
+
+
+@register("crf_nll", aliases=("_contrib_crf_nll",))
+def crf_nll(emissions, tags, transitions, start, end, mask=None):
+    """Per-sequence negative log-likelihood of a linear-chain CRF.
+
+    emissions (B, T, K) float logits · tags (B, T) int ·
+    transitions (K, K) [i, j] = score(i -> j) · start/end (K,) ·
+    mask (B, T) contiguous-prefix {0,1} (default all-ones) -> (B,).
+    """
+    emis = jnp.asarray(emissions)
+    m = jnp.ones(emis.shape[:2], emis.dtype) if mask is None \
+        else jnp.asarray(mask).astype(emis.dtype)
+    return _partition(emis, m, transitions, start, end) \
+        - _gold_score(emis, jnp.asarray(tags), m, transitions, start, end)
+
+
+@register("crf_decode", aliases=("_contrib_crf_decode",))
+def crf_decode(emissions, transitions, start, end, mask=None):
+    """Viterbi decode -> (B, T) int32 best-path tags (masked steps repeat
+    the path state; apply the mask downstream)."""
+    emis = jnp.asarray(emissions)
+    B, T, K = emis.shape
+    m = jnp.ones((B, T), emis.dtype) if mask is None \
+        else jnp.asarray(mask).astype(emis.dtype)
+    alpha0 = start[None, :] + emis[:, 0]
+
+    def fwd(alpha, xs):
+        e_t, m_t = xs
+        scores = alpha[:, :, None] + transitions[None]   # (B, from, to)
+        ptr = jnp.argmax(scores, axis=1)
+        nxt = jnp.max(scores, axis=1) + e_t
+        alpha_new = jnp.where(m_t[:, None] > 0, nxt, alpha)
+        # masked ticks point each state at itself so backtrack passes
+        # through them unchanged
+        ptr = jnp.where(m_t[:, None] > 0, ptr, jnp.arange(K)[None, :])
+        return alpha_new, ptr
+
+    xs = (jnp.moveaxis(emis[:, 1:], 1, 0), jnp.moveaxis(m[:, 1:], 1, 0))
+    alpha, ptrs = jax.lax.scan(fwd, alpha0, xs)          # (T-1, B, K)
+    best_last = jnp.argmax(alpha + end[None, :], axis=-1)
+
+    def back(tag, ptr_t):
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, rev = jax.lax.scan(back, best_last, ptrs, reverse=True)
+    path = jnp.concatenate([rev, best_last[None]], axis=0)
+    return jnp.moveaxis(path, 0, 1).astype(jnp.int32)

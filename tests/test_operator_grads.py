@@ -179,6 +179,10 @@ GRAD_SPECS = {
                                     "sampler_type": "bilinear"}, None),
     "CTCLoss": lambda: ([_sym(4, 2, 5),
                          np.array([[1, 2], [2, 1]], np.float32)], {}, [0]),
+    "crf_nll": lambda: ([_sym(2, 4, 3),
+                         np.array([[0, 1, 2, 0], [2, 1, 0, 1]], np.float32),
+                         _sym(3, 3) * 0.4, _sym(3) * 0.3, _sym(3) * 0.3],
+                        {}, [0, 2, 3, 4]),
     "Correlation": lambda: ([_sym(1, 2, 5, 5), _sym(1, 2, 5, 5)],
                             {"kernel_size": 1, "max_displacement": 1,
                              "stride1": 1, "stride2": 1}, None),
@@ -365,6 +369,7 @@ NON_DIFF = {
     "MultiBoxPrior": _CREATION, "MultiBoxTarget": _INFER,
     "MultiBoxDetection": _INFER, "MultiProposal": _INFER,
     "Proposal": _INFER, "box_nms": _INFER,
+    "crf_decode": _INFER,
     "quantize_v2": _QUANT, "dequantize": _QUANT, "requantize": _QUANT,
     "quantized_conv": _QUANT, "quantized_flatten": _QUANT,
     "quantized_fully_connected": _QUANT, "quantized_pooling": _QUANT,

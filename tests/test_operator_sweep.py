@@ -962,6 +962,8 @@ TESTED_ELSEWHERE = {
     # sparse/optimizer — tests/test_loss_optim_metric.py, test_sparse.py
     "_sparse_adagrad_update": "test_loss_optim_metric.py",
     "_contrib_group_adagrad_update": "test_loss_optim_metric.py",
+    # CRF — tests/test_crf.py (brute-force enumeration oracle)
+    "crf_nll": "test_crf.py", "crf_decode": "test_crf.py",
 }
 
 
