@@ -6,6 +6,7 @@ from .dcgan import DCGANGenerator, DCGANDiscriminator, dcgan
 from .matrix_fact import MFBlock, DeepMFBlock
 from .seq2seq import Seq2SeqAttn
 from .segmentation import FCNSegmenter
+from .faster_rcnn import FasterRCNN
 from .vae import VAE
 from .text_cnn import TextCNN
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,

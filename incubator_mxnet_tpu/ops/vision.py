@@ -147,16 +147,10 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 # Faster-RCNN proposal
 # ---------------------------------------------------------------------------
 
-@register("Proposal", aliases=("_contrib_Proposal", "proposal"))
-def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
-             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
-             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16):
-    """RPN proposals (B, post_nms, 5) rows [batch_idx, x0, y0, x1, y1].
-
-    Static top-k + padded NMS replace the reference's dynamic CUDA path.
-    """
-    n_anchor = len(scales) * len(ratios)
-    b, _, h, w = cls_prob.shape
+def rpn_anchor_grid(h, w, feature_stride, scales, ratios):
+    """The RPN anchor grid (H*W*A, 4) — single source of truth shared by
+    the Proposal op and models.faster_rcnn's anchor-target assignment
+    (consistency between the two is load-bearing for training)."""
     base = []
     cx = cy = (feature_stride - 1) / 2.0
     for r in ratios:
@@ -171,7 +165,20 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     sy = jnp.arange(h) * feature_stride
     shift = jnp.stack(jnp.meshgrid(sx, sy, indexing="xy"), axis=-1)
     shift = jnp.concatenate([shift, shift], axis=-1).reshape(-1, 4)
-    anchors = (base[None] + shift[:, None]).reshape(-1, 4)  # (H*W*A, 4)
+    return (base[None] + shift[:, None]).reshape(-1, 4)   # (H*W*A, 4)
+
+
+@register("Proposal", aliases=("_contrib_Proposal", "proposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16):
+    """RPN proposals (B, post_nms, 5) rows [batch_idx, x0, y0, x1, y1].
+
+    Static top-k + padded NMS replace the reference's dynamic CUDA path.
+    """
+    n_anchor = len(scales) * len(ratios)
+    b, _, h, w = cls_prob.shape
+    anchors = rpn_anchor_grid(h, w, feature_stride, scales, ratios)
 
     def one(probs, deltas, info):
         score = probs[n_anchor:].reshape(n_anchor, h, w)     # fg scores
