@@ -1,0 +1,104 @@
+"""Child-sum Tree-LSTM on a compositional task (reference:
+example/gluon/tree_lstm/main.py — SICK semantic relatedness).
+
+Hermetic stand-in for SICK: the "negation sign" task.  Leaves carry
+sentiment words (+1 / -1 / neutral); the internal word NOT flips the
+sign of its whole subtree; the label is the sign of the root value.
+Getting this right REQUIRES recursive composition — a bag-of-words
+model cannot exceed chance on trees whose polarity is flipped an odd
+number of levels up.  The tree recursion runs as one lax.scan
+(models/tree_lstm.py docstring has the TPU formulation).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.tree_lstm import (ChildSumTreeLSTM,
+                                                  flatten_trees)
+
+# vocabulary: 0 pad, 1 NOT, 2..6 positive words, 7..11 negative words
+NOT, POS, NEG = 1, list(range(2, 7)), list(range(7, 12))
+
+
+def rand_tree(rng, depth):
+    """Random sentiment tree; returns (tree, value in {-1,+1})."""
+    if depth == 0 or rng.rand() < 0.3:
+        if rng.rand() < 0.5:
+            return (int(rng.choice(POS)), []), 1
+        return (int(rng.choice(NEG)), []), -1
+    kids, vals = [], []
+    for _ in range(rng.randint(1, 3)):
+        t, v = rand_tree(rng, depth - 1)
+        kids.append(t)
+        vals.append(v)
+    total = sum(vals) if sum(vals) != 0 else vals[0]
+    if rng.rand() < 0.4:                       # NOT node flips its subtree
+        return (NOT, kids), -int(np.sign(total))
+    return (int(rng.choice(POS + NEG)), kids), int(np.sign(
+        total + (1 if rng.rand() < 0.5 else -1)))
+
+
+def make_data(rng, n, max_nodes=24, max_children=4):
+    trees, labels = [], []
+    while len(trees) < n:
+        t, v = rand_tree(rng, 3)
+        try:
+            flatten_trees([t], max_nodes, max_children)
+        except ValueError:
+            continue
+        trees.append(t)
+        labels.append(0 if v < 0 else 1)
+    words, children, roots = flatten_trees(trees, max_nodes, max_children)
+    return words, children, roots, np.asarray(labels, np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    words, children, roots, y = make_data(rng, 2400)
+    split = 2000
+
+    encoder = ChildSumTreeLSTM(12, embed_size=32, hidden_size=args.hidden)
+    head = gluon.nn.Dense(2, in_units=args.hidden)
+    for blk in (encoder, head):
+        blk.initialize(mx.init.Xavier())
+    encoder.hybridize()
+    params = {**encoder.collect_params(), **head.collect_params()}
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        order = rng.permutation(split)
+        total = 0.0
+        for i in range(0, split - args.batch + 1, args.batch):
+            b = order[i:i + args.batch]
+            with autograd.record():
+                enc = encoder(nd.array(words[b]), nd.array(children[b]),
+                              nd.array(roots[b]))
+                loss = loss_fn(head(enc), nd.array(y[b]))
+            loss.backward()
+            trainer.step(args.batch)
+            total += float(loss.mean().asscalar())
+        enc = encoder(nd.array(words[split:]), nd.array(children[split:]),
+                      nd.array(roots[split:]))
+        acc = (head(enc).asnumpy().argmax(-1) == y[split:]).mean()
+        print("epoch %d  loss %.4f  held-out acc %.4f"
+              % (epoch, total / max(1, split // args.batch), acc))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,9 @@ from .segmentation import FCNSegmenter
 from .faster_rcnn import FasterRCNN
 from .vae import VAE
 from .text_cnn import TextCNN
+from .sparse_ctr import (FactorizationMachine, WideDeep, SparseLinear,
+                         pad_csr_batch)
+from .tree_lstm import ChildSumTreeLSTM, TreeSimilarity, flatten_trees
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
                    bert_sharding_rules, MultiHeadAttention,
                    TransformerEncoderLayer, BERTEncoder)

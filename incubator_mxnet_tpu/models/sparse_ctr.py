@@ -1,0 +1,153 @@
+"""Sparse CTR / large-feature-space models (reference family:
+`example/sparse/factorization_machine/model.py`,
+`example/sparse/wide_deep/model.py`,
+`example/sparse/linear_classification/linear_model.py`).
+
+TPU notes: the reference keeps the batch as a CSR matrix and runs
+`sparse.dot(csr, row_sparse_weight)` on CPU.  Data-dependent sparsity
+does not map onto the MXU, so the TPU-first formulation keeps CSR as
+the *host-side* storage format and converts each batch to a padded
+fixed-width (indices, values) pair: every example carries at most
+``max_nnz`` active features, padding slots use index 0 with value 0.0
+so their contribution vanishes algebraically.  On chip everything is
+then static-shape gathers + einsums — exactly the layout real TPU CTR
+stacks (DLRM-style) use.  On the eager tape, gradients w.r.t. the
+feature tables are row-sparse (`sparse_grad=True`) and lazy optimizers
+update only touched rows, matching the reference's row_sparse weight
+semantics; under ``hybridize()``/jit the grad is a dense scatter-add
+inside the XLA program (the documented trace-path behavior of
+``nn.Embedding``) — on TPU that fused scatter is the fast path anyway.
+"""
+
+import numpy as _np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["pad_csr_batch", "FactorizationMachine", "WideDeep",
+           "SparseLinear"]
+
+
+def pad_csr_batch(csr, max_nnz=None):
+    """CSR batch -> padded ``(indices, values)`` int32/float32 arrays.
+
+    The device-side contract of every model in this family.  ``max_nnz``
+    defaults to the densest row in the batch; rows with fewer active
+    features are padded with (index 0, value 0.0).  Rows denser than
+    ``max_nnz`` raise — silently dropping features would corrupt the
+    model, the caller must pick a bound that covers its data.
+    """
+    indptr = _np.asarray(csr.indptr.asnumpy() if hasattr(csr.indptr, "asnumpy")
+                         else csr.indptr, dtype=_np.int64)
+    col = _np.asarray(csr.indices.asnumpy() if hasattr(csr.indices, "asnumpy")
+                      else csr.indices, dtype=_np.int64)
+    val = _np.asarray(csr.data.asnumpy() if hasattr(csr.data, "asnumpy")
+                      else csr.data, dtype=_np.float32)
+    counts = indptr[1:] - indptr[:-1]
+    if max_nnz is None:
+        max_nnz = int(counts.max()) if len(counts) else 1
+    if (counts > max_nnz).any():
+        raise ValueError("row with %d features exceeds max_nnz=%d"
+                         % (int(counts.max()), max_nnz))
+    n = len(counts)
+    idx = _np.zeros((n, max_nnz), dtype=_np.int32)
+    v = _np.zeros((n, max_nnz), dtype=_np.float32)
+    for i in range(n):
+        lo, hi = indptr[i], indptr[i + 1]
+        idx[i, : hi - lo] = col[lo:hi]
+        v[i, : hi - lo] = val[lo:hi]
+    return idx, v
+
+
+class FactorizationMachine(HybridBlock):
+    """Rendle FM: ``y = w0 + <w, x> + 0.5 * (||Vx||^2 - sum_i ||v_i x_i||^2)``
+    (reference formulation: example/sparse/factorization_machine/model.py:24-48
+    — linear term via sparse dot, pair term via the square_sum trick).
+
+    Inputs are the padded ``(indices, values)`` pair from
+    :func:`pad_csr_batch`; returns the raw logit ``(B,)``.
+    """
+
+    def __init__(self, num_features, factor_size=16, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            # v: (N, k) factor table; w: (N, 1) linear table — both with
+            # row-sparse gradients like the reference's stype='row_sparse'.
+            self.v = nn.Embedding(num_features, factor_size,
+                                  weight_initializer=None, sparse_grad=True)
+            self.w = nn.Embedding(num_features, 1, sparse_grad=True)
+            self.w0 = self.params.get("w0", shape=(1,), init="zeros")
+
+    def hybrid_forward(self, F, indices, values, w0):
+        vx = self.v(indices) * F.expand_dims(values, axis=-1)   # (B, F, k)
+        s = vx.sum(axis=1)                                      # (B, k)
+        pair = 0.5 * ((s * s).sum(axis=-1) - (vx * vx).sum(axis=(1, 2)))
+        linear = (self.w(indices).reshape(values.shape) * values).sum(axis=-1)
+        return linear + pair + w0.reshape((1,))
+
+
+class WideDeep(HybridBlock):
+    """Wide & Deep (reference: example/sparse/wide_deep/model.py:22-57 —
+    wide = sparse linear over the hashed/cross features, deep = per-column
+    embeddings + continuous features through an MLP, summed logits).
+
+    forward(indices, values, embed_cols, cont) where
+      * ``indices``/``values``: padded wide features (pad_csr_batch),
+      * ``embed_cols``: (B, num_embed_features) int32 — one categorical id
+        per column, each with its own vocabulary ``input_dims[i]``,
+      * ``cont``: (B, num_cont_features) float continuous features.
+    Returns (B, num_classes) logits.
+    """
+
+    def __init__(self, num_linear_features, input_dims, num_cont_features,
+                 embed_size=16, hidden_units=(32, 32), num_classes=2,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._input_dims = tuple(int(d) for d in input_dims)
+        with self.name_scope():
+            self.linear_w = nn.Embedding(num_linear_features, num_classes,
+                                         sparse_grad=True)
+            self.linear_bias = self.params.get("linear_bias",
+                                               shape=(num_classes,),
+                                               init="zeros")
+            self.embeds = nn.HybridSequential(prefix="embed_")
+            for d in self._input_dims:
+                self.embeds.add(nn.Embedding(d, embed_size, sparse_grad=True))
+            self.mlp = nn.HybridSequential(prefix="deep_")
+            in_units = embed_size * len(self._input_dims) + num_cont_features
+            for h in hidden_units:
+                self.mlp.add(nn.Dense(h, activation="relu", in_units=in_units))
+                in_units = h
+            self.mlp.add(nn.Dense(num_classes, in_units=in_units))
+
+    def hybrid_forward(self, F, indices, values, embed_cols, cont, linear_bias):
+        wide = (self.linear_w(indices)
+                * F.expand_dims(values, axis=-1)).sum(axis=1)   # (B, C)
+        wide = F.broadcast_add(wide, linear_bias.reshape((1, -1)))
+        feats = [cont]
+        for i, emb in enumerate(self.embeds):
+            feats.append(emb(F.slice_axis(embed_cols, axis=1,
+                                          begin=i, end=i + 1).reshape((-1,))))
+        deep = self.mlp(F.concat(*feats, dim=-1))
+        return wide + deep
+
+
+class SparseLinear(HybridBlock):
+    """Sparse linear classifier (reference:
+    example/sparse/linear_classification/linear_model.py — sparse dot of a
+    CSR batch with a row_sparse weight, trained with dist_async on criteo).
+    Padded-gather formulation; returns (B, num_classes) logits.
+    """
+
+    def __init__(self, num_features, num_classes=2, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.weight = nn.Embedding(num_features, num_classes,
+                                       sparse_grad=True)
+            self.bias = self.params.get("bias", shape=(num_classes,),
+                                        init="zeros")
+
+    def hybrid_forward(self, F, indices, values, bias):
+        out = (self.weight(indices)
+               * F.expand_dims(values, axis=-1)).sum(axis=1)
+        return F.broadcast_add(out, bias.reshape((1, -1)))

@@ -1,0 +1,150 @@
+"""Child-sum Tree-LSTM (reference family: `example/gluon/tree_lstm` —
+Tai et al. Tree-LSTM on SICK semantic relatedness, with the
+``Similarity`` regression head of `tree_lstm/main.py`).
+
+TPU notes: the reference recurses over Python tree objects node by
+node (`tree_lstm/tree_lstm.py` ChildSumLSTMCell.forward walks
+children recursively) — host-bound, unjittable.  Here trees are
+flattened host-side to topological order (children before parents,
+slot 0 = null) and the recursion becomes ONE ``lax.scan`` over node
+steps (via the framework's `foreach` control-flow op).  Child-state
+gathers and the node-state write both lower to one-hot matmuls
+(batch_dot), so the whole tree is a static-shape MXU program — no
+per-node host dispatch, any tree shape batches together.
+"""
+
+import numpy as _np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["flatten_trees", "ChildSumTreeLSTM", "TreeSimilarity"]
+
+
+def flatten_trees(trees, max_nodes, max_children, vocab_pad=0):
+    """Nested ``(word, [children...])`` tuples -> padded arrays.
+
+    Returns ``(words, children, root)``:
+      * ``words`` (B, N) int32 — word id per node slot (topological
+        order, children before parents; slot index = position + 1,
+        slot 0 is the null child),
+      * ``children`` (B, N, C) int32 — child *slot* indices, 0 = none,
+      * ``root`` (B,) int32 — slot index of each tree's root.
+    """
+    B = len(trees)
+    words = _np.full((B, max_nodes), vocab_pad, _np.int32)
+    children = _np.zeros((B, max_nodes, max_children), _np.int32)
+    roots = _np.zeros((B,), _np.int32)
+
+    for b, tree in enumerate(trees):
+        order = []          # (word, [child positions in order])
+
+        def visit(node):
+            word, kids = node
+            kid_pos = [visit(k) for k in kids]
+            order.append((word, kid_pos))
+            return len(order) - 1
+
+        root_pos = visit(tree)
+        if len(order) > max_nodes:
+            raise ValueError("tree with %d nodes exceeds max_nodes=%d"
+                             % (len(order), max_nodes))
+        if any(len(k) > max_children for _, k in order):
+            raise ValueError("node fan-out exceeds max_children=%d"
+                             % max_children)
+        for pos, (word, kid_pos) in enumerate(order):
+            words[b, pos] = word
+            for j, kp in enumerate(kid_pos):
+                children[b, pos, j] = kp + 1        # slot = position + 1
+        roots[b] = root_pos + 1
+    return words, children, roots
+
+
+class ChildSumTreeLSTM(HybridBlock):
+    """Encode batched flattened trees; returns the root hidden state.
+
+    forward(words (B,N), children (B,N,C), root (B,)) -> (B, hidden).
+    """
+
+    def __init__(self, vocab_size, embed_size=64, hidden_size=64, **kwargs):
+        super().__init__(**kwargs)
+        self._h = int(hidden_size)
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, embed_size)
+            # i, o, u gates from (x, h_sum); f gate per child from (x, h_k)
+            self.iou_x = nn.Dense(3 * hidden_size, in_units=embed_size,
+                                  flatten=False)
+            self.iou_h = nn.Dense(3 * hidden_size, in_units=hidden_size,
+                                  use_bias=False, flatten=False)
+            self.f_x = nn.Dense(hidden_size, in_units=embed_size,
+                                flatten=False)
+            self.f_h = nn.Dense(hidden_size, in_units=hidden_size,
+                                use_bias=False, flatten=False)
+
+    def hybrid_forward(self, F, words, children, root):
+        h = self._h
+        B, N = words.shape[0], words.shape[1]
+        C = children.shape[2]
+        xs = self.embed(words)                               # (B, N, e)
+
+        # scan axis must lead: (N, B, ...)
+        xs_t = xs.transpose((1, 0, 2))
+        ch_t = children.transpose((1, 0, 2))
+        # one-hot write vector per step t targets slot t+1 of N+1 slots
+        write = F.one_hot(F.arange(1, N + 1), N + 1)         # (N, N+1)
+
+        def body(data, buf):
+            x_t, ch_i, w_t = data                            # (B,e) (B,C) (N+1,)
+            hbuf = F.slice_axis(buf, axis=2, begin=0, end=h)
+            cbuf = F.slice_axis(buf, axis=2, begin=h, end=2 * h)
+            sel = F.one_hot(ch_i, N + 1)                     # (B, C, N+1)
+            child_h = F.batch_dot(sel, hbuf)                 # (B, C, h)
+            child_c = F.batch_dot(sel, cbuf)
+            h_sum = child_h.sum(axis=1)                      # (B, h)
+
+            iou = self.iou_x(x_t) + self.iou_h(h_sum)        # (B, 3h)
+            i = F.sigmoid(F.slice_axis(iou, axis=1, begin=0, end=h))
+            o = F.sigmoid(F.slice_axis(iou, axis=1, begin=h, end=2 * h))
+            u = F.tanh(F.slice_axis(iou, axis=1, begin=2 * h, end=3 * h))
+            f = F.sigmoid(F.expand_dims(self.f_x(x_t), axis=1)
+                          + self.f_h(child_h))               # (B, C, h)
+            # null children (slot 0) carry zero c, so masking is free
+            c_new = i * u + (f * child_c).sum(axis=1)
+            h_new = o * F.tanh(c_new)
+
+            hc = F.concat(h_new, c_new, dim=-1)              # (B, 2h)
+            keep = 1.0 - w_t.reshape((1, -1, 1))
+            buf = buf * keep + F.expand_dims(hc, axis=1) * w_t.reshape(
+                (1, -1, 1))
+            return h_new, buf
+
+        from ..ndarray import contrib as _ndc
+        buf0 = F.zeros((B, N + 1, 2 * h))
+        _, buf = _ndc.foreach(body, [xs_t, ch_t, write], buf0)
+        hbuf = F.slice_axis(buf, axis=2, begin=0, end=h)
+        root_sel = F.one_hot(root.reshape((-1, 1)), N + 1)   # (B, 1, N+1)
+        return F.batch_dot(root_sel, hbuf).reshape((B, h))
+
+
+class TreeSimilarity(HybridBlock):
+    """Sentence-pair relatedness head (reference:
+    tree_lstm/main.py Similarity — h_mul = h_l*h_r, h_sub = |h_l-h_r|,
+    MLP -> distribution over 1..num_classes rating bins, KL-trained).
+    """
+
+    def __init__(self, vocab_size, embed_size=64, hidden_size=64,
+                 sim_hidden=32, num_classes=5, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.encoder = ChildSumTreeLSTM(vocab_size, embed_size,
+                                            hidden_size)
+            self.wh = nn.Dense(sim_hidden, in_units=2 * hidden_size,
+                               activation="sigmoid")
+            self.wp = nn.Dense(num_classes, in_units=sim_hidden)
+
+    def hybrid_forward(self, F, lw, lc, lr, rw, rc, rr):
+        hl = self.encoder(lw, lc, lr)
+        hr = self.encoder(rw, rc, rr)
+        mul = hl * hr
+        sub = F.abs(hl - hr)
+        return F.log_softmax(self.wp(self.wh(F.concat(mul, sub, dim=-1))))

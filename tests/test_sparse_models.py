@@ -1,0 +1,187 @@
+"""Sparse CTR model family (reference: example/sparse/*) — FM oracle,
+padded-CSR contract, row-sparse gradient flow, and convergence."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.sparse_ctr import (FactorizationMachine,
+                                                   SparseLinear, WideDeep,
+                                                   pad_csr_batch)
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def _random_csr(rng, n_rows, n_cols, active):
+    dense = np.zeros((n_rows, n_cols), np.float32)
+    for i in range(n_rows):
+        cols = rng.choice(n_cols, active, replace=False)
+        dense[i, cols] = rng.randn(active)
+    return sparse.csr_matrix(dense), dense
+
+
+# ---------------------------------------------------------------- pad contract
+def test_pad_csr_batch_round_trip():
+    rng = np.random.RandomState(0)
+    csr, dense = _random_csr(rng, 6, 50, 4)
+    idx, val = pad_csr_batch(csr)
+    assert idx.shape == (6, 4) and val.shape == (6, 4)
+    rebuilt = np.zeros_like(dense)
+    for i in range(6):
+        for j in range(4):
+            rebuilt[i, idx[i, j]] += val[i, j]
+    np.testing.assert_allclose(rebuilt, dense, rtol=1e-6)
+
+
+def test_pad_csr_batch_refuses_overflow():
+    rng = np.random.RandomState(1)
+    csr, _ = _random_csr(rng, 4, 30, 5)
+    with pytest.raises(ValueError):
+        pad_csr_batch(csr, max_nnz=3)
+
+
+def test_pad_csr_batch_ragged_rows():
+    dense = np.zeros((3, 10), np.float32)
+    dense[0, [1, 2, 3]] = 1.0
+    dense[2, [7]] = 2.0        # row 1 is empty
+    idx, val = pad_csr_batch(sparse.csr_matrix(dense))
+    assert idx.shape == (3, 3)
+    np.testing.assert_allclose(val[1], 0.0)
+
+
+# ------------------------------------------------------------------- FM oracle
+def test_fm_matches_dense_formula():
+    """Padded-gather FM == the textbook dense formulation (reference
+    formulation: example/sparse/factorization_machine/model.py:24-48)."""
+    rng = np.random.RandomState(2)
+    N, B, k = 300, 8, 6
+    csr, dense = _random_csr(rng, B, N, 5)
+    idx, val = pad_csr_batch(csr)
+    fm = FactorizationMachine(N, factor_size=k)
+    fm.initialize(mx.init.Normal(0.1))
+    out = fm(nd.array(idx), nd.array(val)).asnumpy()
+
+    w0 = fm.w0.data().asnumpy()
+    w = fm.w.weight.data().asnumpy()[:, 0]
+    v = fm.v.weight.data().asnumpy()
+    s = dense @ v
+    pair = 0.5 * ((s * s).sum(-1)
+                  - ((dense[:, :, None] * v[None]) ** 2).sum((1, 2)))
+    ref = w0 + dense @ w + pair
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fm_factor_grads_are_row_sparse():
+    rng = np.random.RandomState(3)
+    N = 100_000                      # dense grad would be 6.4 MB/step
+    idx = rng.choice(N, (4, 6), replace=False).astype(np.int32)
+    val = np.ones((4, 6), np.float32)
+    fm = FactorizationMachine(N, factor_size=16)
+    fm.initialize(mx.init.Normal(0.1))
+    with autograd.record():
+        loss = (fm(nd.array(idx), nd.array(val)) ** 2).sum()
+    loss.backward()
+    for table in (fm.v, fm.w):
+        g = table.weight.grad()
+        assert g.stype == "row_sparse"
+        assert g.indices.shape[0] <= idx.size + 1    # touched rows + pad row 0
+
+
+def test_fm_learns_planted_interactions():
+    """FM recovers a planted second-order model a linear model cannot."""
+    rng = np.random.RandomState(4)
+    N, n, active, rank = 200, 3000, 6, 3
+    w_true = rng.randn(N) * 0.5
+    v_true = rng.randn(N, rank) * 0.7
+    idx = np.stack([rng.choice(N, active, replace=False)
+                    for _ in range(n)]).astype(np.int32)
+    val = np.ones((n, active), np.float32)
+    vx = v_true[idx]
+    s = vx.sum(1)
+    logits = (w_true[idx].sum(-1)
+              + 0.5 * ((s * s).sum(-1) - (vx * vx).sum((1, 2))))
+    y = (logits > np.median(logits)).astype(np.float32)
+
+    net = FactorizationMachine(N, factor_size=8)
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    split = 2700
+    for epoch in range(8):
+        order = rng.permutation(split)
+        for i in range(0, split - 128 + 1, 128):
+            b = order[i:i + 128]
+            with autograd.record():
+                loss = loss_fn(net(nd.array(idx[b]), nd.array(val[b])),
+                               nd.array(y[b]))
+            loss.backward()
+            trainer.step(128)
+    out = net(nd.array(idx[split:]), nd.array(val[split:])).asnumpy()
+    acc = ((out > 0) == (y[split:] > 0.5)).mean()
+    assert acc > 0.75, acc
+
+
+# ------------------------------------------------------------------ wide&deep
+def test_wide_deep_learns_both_towers():
+    rng = np.random.RandomState(5)
+    input_dims, n_cont, n_wide, active, n = (8, 12), 3, 150, 4, 3000
+    ec = np.stack([rng.randint(0, d, n) for d in input_dims],
+                  axis=1).astype(np.int32)
+    cont = rng.randn(n, n_cont).astype(np.float32)
+    wi = np.stack([rng.choice(n_wide, active, replace=False)
+                   for _ in range(n)]).astype(np.int32)
+    wv = np.ones((n, active), np.float32)
+    w_wide = rng.randn(n_wide)
+    col_w = [rng.randn(d) for d in input_dims]
+    logit = (w_wide[wi].sum(-1)
+             + sum(w[c] for w, c in zip(col_w, ec.T))
+             + cont @ rng.randn(n_cont))
+    y = (logit > np.median(logit)).astype(np.int64)
+
+    net = WideDeep(n_wide, input_dims, n_cont, embed_size=8,
+                   hidden_units=(16, 16))
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    split = 2700
+    for epoch in range(8):
+        order = rng.permutation(split)
+        for i in range(0, split - 256 + 1, 256):
+            b = order[i:i + 256]
+            with autograd.record():
+                out = net(nd.array(wi[b]), nd.array(wv[b]),
+                          nd.array(ec[b]), nd.array(cont[b]))
+                loss = loss_fn(out, nd.array(y[b]))
+            loss.backward()
+            trainer.step(256)
+    out = net(nd.array(wi[split:]), nd.array(wv[split:]),
+              nd.array(ec[split:]), nd.array(cont[split:])).asnumpy()
+    acc = (out.argmax(-1) == y[split:]).mean()
+    assert acc > 0.8, acc
+
+
+# -------------------------------------------------------------- sparse linear
+def test_sparse_linear_touched_rows_only():
+    """Lazy row-sparse update: untouched weight rows stay at init."""
+    rng = np.random.RandomState(6)
+    N = 5000
+    net = SparseLinear(N, 2)
+    net.initialize(mx.init.Zero())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    idx = np.array([[3, 17, 99]], np.int32)
+    val = np.ones((1, 3), np.float32)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = loss_fn(net(nd.array(idx), nd.array(val)),
+                       nd.array(np.array([1], np.int64)))
+    loss.backward()
+    trainer.step(1)
+    w = net.weight.weight.data().asnumpy()
+    touched = np.where(np.abs(w).sum(-1) > 0)[0]
+    assert set(touched) <= {0, 3, 17, 99}
+    assert {3, 17, 99} <= set(touched)

@@ -1,0 +1,152 @@
+"""Tree-LSTM family (reference: example/gluon/tree_lstm) — flattening
+contract, single-node oracle, child-order invariance, hybrid parity,
+and compositional convergence."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.tree_lstm import (ChildSumTreeLSTM,
+                                                  TreeSimilarity,
+                                                  flatten_trees)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ------------------------------------------------------------------ flattening
+def test_flatten_trees_topological():
+    tree = (5, [(3, []), (7, [(2, []), (1, [])])])
+    words, children, roots = flatten_trees([tree], 8, 3)
+    w = words[0]
+    # children appear before parents; root is last real node
+    root_slot = roots[0]
+    assert w[root_slot - 1] == 5
+    # every child slot index < parent's own slot index
+    for pos in range(8):
+        for c in children[0, pos]:
+            assert c <= pos            # child slot = child pos + 1 <= pos
+
+
+def test_flatten_trees_overflow_raises():
+    deep = (1, [])
+    for _ in range(10):
+        deep = (1, [deep])
+    with pytest.raises(ValueError):
+        flatten_trees([deep], 5, 2)
+    wide = (1, [(2, [])] * 6)
+    with pytest.raises(ValueError):
+        flatten_trees([wide], 16, 3)
+
+
+# ---------------------------------------------------------------- node oracle
+def test_single_leaf_matches_hand_math():
+    """One-node tree == childsum equations with zero child state."""
+    enc = ChildSumTreeLSTM(6, embed_size=4, hidden_size=3)
+    enc.initialize(mx.init.Normal(0.3))
+    words, children, roots = flatten_trees([(2, [])], 2, 2)
+    out = enc(nd.array(words), nd.array(children),
+              nd.array(roots)).asnumpy()[0]
+
+    x = enc.embed.weight.data().asnumpy()[2]
+    W = enc.iou_x.weight.data().asnumpy()
+    b = enc.iou_x.bias.data().asnumpy()
+    iou = W @ x + b
+    h = 3
+    i, o, u = (_sigmoid(iou[:h]), _sigmoid(iou[h:2 * h]),
+               np.tanh(iou[2 * h:]))
+    c = i * u
+    ref = o * np.tanh(c)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_child_order_invariance():
+    """Child-sum cell is order-invariant over children (Tai et al. eq. 2)."""
+    t_a = (1, [(2, []), (3, [(4, [])]), (5, [])])
+    t_b = (1, [(5, []), (2, []), (3, [(4, [])])])
+    enc = ChildSumTreeLSTM(8, embed_size=8, hidden_size=8)
+    enc.initialize(mx.init.Normal(0.2))
+    outs = []
+    for t in (t_a, t_b):
+        w, c, r = flatten_trees([t], 8, 3)
+        outs.append(enc(nd.array(w), nd.array(c), nd.array(r)).asnumpy())
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+
+
+def test_eager_hybrid_parity_and_grads():
+    trees = [(5, [(3, []), (7, [(2, []), (1, [])])]), (4, [(1, [])])]
+    words, children, roots = flatten_trees(trees, 8, 3)
+    enc = ChildSumTreeLSTM(10, 16, 16)
+    enc.initialize(mx.init.Normal(0.1))
+    eager = enc(nd.array(words), nd.array(children), nd.array(roots))
+    with autograd.record():
+        loss = (enc(nd.array(words), nd.array(children),
+                    nd.array(roots)) ** 2).sum()
+    loss.backward()
+    g = enc.embed.weight.grad().asnumpy()
+    used = set(words.ravel()) - {0}
+    assert set(np.where(np.abs(g).sum(-1) > 0)[0]) <= used | {0}
+    enc.hybridize()
+    hybrid = enc(nd.array(words), nd.array(children), nd.array(roots))
+    np.testing.assert_allclose(eager.asnumpy(), hybrid.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_similarity_head_is_log_distribution():
+    sim = TreeSimilarity(10, embed_size=8, hidden_size=8, num_classes=5)
+    sim.initialize(mx.init.Normal(0.1))
+    w, c, r = flatten_trees([(2, [(3, [])])], 4, 2)
+    out = sim(nd.array(w), nd.array(c), nd.array(r),
+              nd.array(w), nd.array(c), nd.array(r)).asnumpy()
+    np.testing.assert_allclose(np.exp(out).sum(-1), 1.0, rtol=1e-4)
+
+
+# ---------------------------------------------------------------- composition
+def test_learns_negation_composition():
+    """NOT-flip sign task: requires recursion, bag-of-words is ~chance."""
+    rng = np.random.RandomState(0)
+    NOT, POS, NEG = 1, [2, 3], [4, 5]
+
+    def rand_tree(depth):
+        if depth == 0 or rng.rand() < 0.35:
+            if rng.rand() < 0.5:
+                return (int(rng.choice(POS)), []), 1
+            return (int(rng.choice(NEG)), []), -1
+        t, v = rand_tree(depth - 1)
+        if rng.rand() < 0.5:
+            return (NOT, [t]), -v
+        return (int(rng.choice(POS + NEG)), [t]), v
+
+    trees, labels = [], []
+    for _ in range(900):
+        t, v = rand_tree(3)
+        trees.append(t)
+        labels.append(0 if v < 0 else 1)
+    words, children, roots = flatten_trees(trees, 8, 2)
+    y = np.asarray(labels, np.int64)
+
+    enc = ChildSumTreeLSTM(6, embed_size=16, hidden_size=16)
+    head = gluon.nn.Dense(2, in_units=16)
+    for blk in (enc, head):
+        blk.initialize(mx.init.Xavier())
+    enc.hybridize()
+    params = {**enc.collect_params(), **head.collect_params()}
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    split = 800
+    for epoch in range(12):
+        order = rng.permutation(split)
+        for i in range(0, split - 64 + 1, 64):
+            b = order[i:i + 64]
+            with autograd.record():
+                h = enc(nd.array(words[b]), nd.array(children[b]),
+                        nd.array(roots[b]))
+                loss = loss_fn(head(h), nd.array(y[b]))
+            loss.backward()
+            trainer.step(64)
+    h = enc(nd.array(words[split:]), nd.array(children[split:]),
+            nd.array(roots[split:]))
+    acc = (head(h).asnumpy().argmax(-1) == y[split:]).mean()
+    assert acc > 0.85, acc
