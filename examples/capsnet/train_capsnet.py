@@ -1,0 +1,70 @@
+"""CapsNet with dynamic routing (reference: example/capsnet/capsnet.py
+— MNIST, margin loss + reconstruction).  Hermetic: sklearn's bundled
+8x8 digits with a small-capsule config (models/capsnet.py docstring
+has the TPU routing formulation)."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.capsnet import CapsNet, margin_loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--recon-weight", type=float, default=0.0005)
+    args = ap.parse_args()
+
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = (d.images / 16.0).astype(np.float32)[:, None]     # (N, 1, 8, 8)
+    y = d.target.astype(np.int64)
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(y))
+    X, y = X[order], y[order]
+    split = 1500
+
+    net = CapsNet(num_classes=10, input_size=(8, 8), conv_channels=32,
+                  kernel=3, prim_channels=8, prim_dim=4, prim_kernel=3,
+                  prim_stride=2, out_dim=8, recon_hidden=(64,),
+                  recon_size=64, use_bn=True)
+    net.initialize(mx.init.Xavier(magnitude=2))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    eye = np.eye(10, dtype=np.float32)
+
+    for epoch in range(args.epochs):
+        order = rng.permutation(split)
+        total = 0.0
+        for i in range(0, split - args.batch + 1, args.batch):
+            b = order[i:i + args.batch]
+            xb, onehot = nd.array(X[b]), nd.array(eye[y[b]])
+            with autograd.record():
+                v_norm, caps = net(xb)
+                rec = net.reconstruct(caps, onehot)
+                loss = (margin_loss(nd, v_norm, onehot).mean()
+                        + args.recon_weight
+                        * ((rec - xb.reshape((len(b), -1))) ** 2)
+                        .sum(-1).mean())
+            loss.backward()
+            trainer.step(args.batch)
+            total += float(loss.asscalar())
+        v_norm, _ = net(nd.array(X[split:]))
+        acc = (v_norm.asnumpy().argmax(-1) == y[split:]).mean()
+        print("epoch %d  loss %.4f  held-out acc %.4f"
+              % (epoch, total / max(1, split // args.batch), acc))
+
+
+if __name__ == "__main__":
+    main()

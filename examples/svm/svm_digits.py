@@ -1,0 +1,74 @@
+"""L2-SVM output layer via the Module API (reference:
+example/svm_mnist/svm_mnist.py — MLP + SVMOutput trained with
+Module.fit, compared against softmax).
+
+Hermetic: bundled 8x8 digits.  Shows the symbolic frontend end-to-end:
+build an mx.sym graph ending in SVMOutput (hinge-loss gradient,
+identity forward), bind it through mx.mod.Module, and Module.fit with
+an NDArrayIter — same call stack as the reference.  --softmax swaps
+the output layer to compare, like the reference's two configurations.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+
+
+def build(use_softmax, margin):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    if use_softmax:
+        return mx.sym.SoftmaxOutput(h, label, name="softmax")
+    return mx.sym.SVMOutput(h, label, margin=margin,
+                            regularization_coefficient=1.0, name="svm")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--margin", type=float, default=1.0)
+    ap.add_argument("--softmax", action="store_true")
+    args = ap.parse_args()
+
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.float32)
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(y))
+    X, y = X[order], y[order]
+    split = 1500
+
+    train = mx.io.NDArrayIter(X[:split], y[:split], args.batch,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(X[split:], y[split:], args.batch)
+
+    mod = mx.mod.Module(build(args.softmax, args.margin),
+                        data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, eval_metric="acc",
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+            num_epoch=args.epochs,
+            batch_end_callback=None)
+    score = mod.score(val, "acc")
+    print("final %s accuracy: %.4f"
+          % ("softmax" if args.softmax else "L2-SVM", dict(score)["accuracy"]))
+
+
+if __name__ == "__main__":
+    main()

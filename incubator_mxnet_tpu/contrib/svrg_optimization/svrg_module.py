@@ -20,10 +20,18 @@ class SVRGModule(Module):
                        force_init=False):
         from .svrg_optimizer import SVRGOptimizer
         from ... import optimizer as opt
-        base = opt.create(optimizer, **dict(optimizer_params)) \
-            if isinstance(optimizer, str) else optimizer
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params)
+            # same SUM-over-batch normalization Module.init_optimizer
+            # applies — the base optimizer performs the actual update
+            if "rescale_grad" not in params and self._batch_size:
+                params["rescale_grad"] = 1.0 / self._batch_size
+            base = opt.create(optimizer, **params)
+        else:
+            base = optimizer
         svrg = SVRGOptimizer(default_optimizer=base,
-                             learning_rate=base.lr)
+                             learning_rate=base.lr,
+                             rescale_grad=base.rescale_grad)
         super().init_optimizer(kvstore, svrg, (), force_init)
 
     def update_full_grads(self, train_data):

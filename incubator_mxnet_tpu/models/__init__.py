@@ -12,6 +12,7 @@ from .text_cnn import TextCNN
 from .sparse_ctr import (FactorizationMachine, WideDeep, SparseLinear,
                          pad_csr_batch)
 from .tree_lstm import ChildSumTreeLSTM, TreeSimilarity, flatten_trees
+from .capsnet import CapsNet, margin_loss
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
                    bert_sharding_rules, MultiHeadAttention,
                    TransformerEncoderLayer, BERTEncoder)

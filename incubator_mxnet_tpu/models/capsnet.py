@@ -1,0 +1,122 @@
+"""CapsNet with dynamic routing (reference family: `example/capsnet` —
+Sabour et al. capsule network on MNIST: conv stem, PrimaryCaps,
+DigitCaps with routing-by-agreement, margin loss + masked
+reconstruction decoder).
+
+TPU notes: the reference expresses routing with tiled/broadcast NDArray
+ops per iteration on GPU (`example/capsnet/capsulelayers.py`).  Here the
+prediction vectors are ONE batched matmul per forward — primary-capsule
+axis as the batch dimension of `batch_dot`, so the (P, d_in, C*d_out)
+transform rides the MXU — and the fixed 3 routing iterations unroll
+statically inside the jit trace (no host loop, no dynamic shapes).
+Everything downstream (squash, agreement logits, margin loss, masked
+decoder) is fused elementwise by XLA.
+"""
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["CapsNet", "margin_loss"]
+
+
+def _squash(F, s, eps=1e-7):
+    """squash(s) = (|s|^2 / (1 + |s|^2)) * s / |s| along the last axis."""
+    sq = (s * s).sum(axis=-1, keepdims=True)
+    return s * (sq / (1.0 + sq) / F.sqrt(sq + eps))
+
+
+def margin_loss(F, v_norm, onehot, m_pos=0.9, m_neg=0.1, lam=0.5):
+    """Sabour et al. eq. 4 (reference: example/capsnet/capsnet.py margin
+    loss): L = T max(0, m+ - |v|)^2 + lam (1-T) max(0, |v| - m-)^2."""
+    pos = F.relu(m_pos - v_norm) ** 2
+    neg = F.relu(v_norm - m_neg) ** 2
+    return (onehot * pos + lam * (1.0 - onehot) * neg).sum(axis=-1)
+
+
+class CapsNet(HybridBlock):
+    """forward(x) -> (v_norm (B, C), caps (B, C, out_dim)).
+
+    ``reconstruct(caps, onehot)`` runs the masked decoder head.
+    MNIST-scale defaults; shrink kernels/channels for small inputs.
+    """
+
+    def __init__(self, num_classes=10, input_size=(28, 28), conv_channels=256,
+                 kernel=9, prim_channels=32, prim_dim=8, prim_kernel=9,
+                 prim_stride=2, out_dim=16, routing_iters=3,
+                 recon_hidden=(512, 1024), recon_size=784, use_bn=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._C = int(num_classes)
+        self._prim_dim = int(prim_dim)
+        self._out_dim = int(out_dim)
+        self._iters = int(routing_iters)
+        if self._iters < 1:
+            raise ValueError("routing_iters must be >= 1 (got %d)"
+                             % self._iters)
+        # primary-capsule count from the (valid-padded) conv geometry
+        h1 = input_size[0] - kernel + 1
+        w1 = input_size[1] - kernel + 1
+        h2 = (h1 - prim_kernel) // prim_stride + 1
+        w2 = (w1 - prim_kernel) // prim_stride + 1
+        if h2 <= 0 or w2 <= 0:
+            raise ValueError("input %s too small for kernels %d/%d"
+                             % (input_size, kernel, prim_kernel))
+        num_primary = prim_channels * h2 * w2
+        with self.name_scope():
+            self.conv1 = nn.Conv2D(conv_channels, kernel, activation="relu")
+            self.prim = nn.Conv2D(prim_channels * prim_dim, prim_kernel,
+                                  strides=prim_stride)
+            # small inputs starve the double squash (|squash(s)| ~ |s|^2 for
+            # |s| << 1 twice in series collapses v to 0); BN on the primary
+            # pre-activations restores O(1) capsule norms at any input scale
+            self.prim_bn = nn.BatchNorm() if use_bn else None
+            # routing transform W: (P, d_in, C*d_out); init follows the
+            # net-level initializer (Xavier keeps u_hat on the squash knee)
+            self.w = self.params.get("routing_weight",
+                                     shape=(num_primary, prim_dim,
+                                            num_classes * out_dim))
+            self.decoder = nn.HybridSequential(prefix="decoder_")
+            in_units = num_classes * out_dim
+            for h in recon_hidden:
+                self.decoder.add(nn.Dense(h, activation="relu",
+                                          in_units=in_units))
+                in_units = h
+            self.decoder.add(nn.Dense(recon_size, activation="sigmoid",
+                                      in_units=in_units))
+
+    def hybrid_forward(self, F, x, w):
+        C, d_out = self._C, self._out_dim
+        u = self.prim(self.conv1(x))                     # (B, pc*pd, H, W)
+        if self.prim_bn is not None:
+            u = self.prim_bn(u)
+        B = u.shape[0]
+        u = u.reshape((B, -1, self._prim_dim,
+                       u.shape[2] * u.shape[3]))         # (B, pc, pd, HW)
+        u = u.transpose((0, 1, 3, 2)).reshape((B, -1, self._prim_dim))
+        u = _squash(F, u)                                # (B, P, d_in)
+        P = u.shape[1]
+
+        # u_hat[b,p,c,:] = W[p]^T u[b,p] — P as the batch_dot batch axis
+        u_t = u.transpose((1, 0, 2))                     # (P, B, d_in)
+        u_hat = F.batch_dot(u_t, w)                      # (P, B, C*d_out)
+        u_hat = u_hat.reshape((P, B, C, d_out)).transpose((1, 0, 2, 3))
+
+        # routing by agreement — fixed iterations, statically unrolled
+        b_logit = F.zeros((B, P, C))
+        u_hat_ng = F.stop_gradient(u_hat)
+        for it in range(self._iters):
+            c = F.softmax(b_logit, axis=-1)              # (B, P, C)
+            uh = u_hat if it == self._iters - 1 else u_hat_ng
+            s = (F.expand_dims(c, axis=-1) * uh).sum(axis=1)
+            v = _squash(F, s)                            # (B, C, d_out)
+            if it < self._iters - 1:
+                b_logit = b_logit + (u_hat_ng
+                                     * F.expand_dims(v, axis=1)).sum(axis=-1)
+        v_norm = F.sqrt((v * v).sum(axis=-1) + 1e-9)     # (B, C)
+        return v_norm, v
+
+    def reconstruct(self, caps, onehot):
+        """Masked reconstruction (reference: decoder on the true class's
+        capsule during training)."""
+        masked = caps * onehot.reshape(onehot.shape + (1,))
+        return self.decoder(masked.reshape((caps.shape[0], -1)))

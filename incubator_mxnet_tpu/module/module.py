@@ -35,6 +35,7 @@ class Module(BaseModule):
         self._aux_params = None
         self._grad_req = "write"
         self._output_shapes = None
+        self._batch_size = None
 
     @property
     def data_names(self):
@@ -87,6 +88,10 @@ class Module(BaseModule):
                 else grad_req
             grads.append(nd_zeros(shape_of[name]) if req != "null" else None)
         aux = [nd_zeros(s) for s in aux_shapes]
+        if self._data_names and self._data_names[0] in shape_feed:
+            self._batch_size = shape_feed[self._data_names[0]][0]
+        else:
+            self._batch_size = None
         self._exec = self._symbol.bind(None, dict(zip(arg_names, args)),
                                        dict(zip(arg_names, grads)),
                                        {n: ("null" if (n in self._data_names
@@ -123,7 +128,20 @@ class Module(BaseModule):
             return
         assert self.binded and self.params_initialized
         if isinstance(optimizer, str):
-            optimizer = opt.create(optimizer, **dict(optimizer_params))
+            params = dict(optimizer_params)
+            # loss-layer backwards (SoftmaxOutput etc.) emit SUM-over-batch
+            # gradients; the reference normalizes in the optimizer
+            # (module.py init_optimizer: rescale_grad = 1/batch_size)
+            if "rescale_grad" not in params and self._batch_size:
+                params["rescale_grad"] = 1.0 / self._batch_size
+            optimizer = opt.create(optimizer, **params)
+        elif self._batch_size and abs(
+                getattr(optimizer, "rescale_grad", 0.0)
+                - 1.0 / self._batch_size) > 1e-12:
+            self.logger.warning(
+                "optimizer instance has rescale_grad=%s with batch size %d;"
+                " set rescale_grad=1/batch for reference-equivalent updates",
+                getattr(optimizer, "rescale_grad", None), self._batch_size)
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
         self.optimizer_initialized = True

@@ -1,0 +1,153 @@
+"""CapsNet (reference: example/capsnet) and the Module.fit
+gradient-normalization regression (reference module.py init_optimizer
+rescale_grad = 1/batch_size)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.capsnet import CapsNet, margin_loss
+
+
+def _tiny_caps():
+    net = CapsNet(num_classes=4, input_size=(8, 8), conv_channels=16,
+                  kernel=3, prim_channels=4, prim_dim=4, prim_kernel=3,
+                  prim_stride=2, out_dim=6, recon_hidden=(32,),
+                  recon_size=64, use_bn=True)
+    net.initialize(mx.init.Xavier(magnitude=2))
+    return net
+
+
+# --------------------------------------------------------------------- capsnet
+def test_capsule_norms_bounded():
+    """squash maps every capsule into the open unit ball."""
+    net = _tiny_caps()
+    x = nd.array(np.random.RandomState(0).rand(6, 1, 8, 8).astype(np.float32))
+    v_norm, caps = net(x)
+    vn = v_norm.asnumpy()
+    assert vn.shape == (6, 4) and caps.shape == (6, 4, 6)
+    assert (vn > 0).all() and (vn < 1).all()
+    # v_norm = sqrt(|caps|^2 + 1e-9): identity up to the stabilizer eps
+    np.testing.assert_allclose(np.linalg.norm(caps.asnumpy(), axis=-1), vn,
+                               atol=1e-4)
+
+
+def test_margin_loss_oracle():
+    """Hand-computed Sabour eq. 4 on a fixed case."""
+    v = nd.array(np.array([[0.95, 0.5, 0.05]], np.float32))
+    onehot = nd.array(np.array([[1.0, 0.0, 0.0]], np.float32))
+    got = float(margin_loss(nd, v, onehot).asnumpy()[0])
+    want = (max(0, 0.9 - 0.95) ** 2
+            + 0.5 * (max(0, 0.5 - 0.1) ** 2 + max(0, 0.05 - 0.1) ** 2))
+    assert abs(got - want) < 1e-6
+
+
+def test_routing_grads_reach_all_params():
+    net = _tiny_caps()
+    x = nd.array(np.random.RandomState(1).rand(4, 1, 8, 8).astype(np.float32))
+    onehot = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+    with autograd.record():
+        v_norm, caps = net(x)
+        rec = net.reconstruct(caps, nd.array(onehot))
+        loss = (margin_loss(nd, v_norm, nd.array(onehot)).mean()
+                + 0.0005 * ((rec - x.reshape((4, -1))) ** 2).sum(-1).mean())
+    loss.backward()
+    for name, p in net.collect_params().items():
+        if p.grad_req == "null" or not getattr(p, "_differentiable", True):
+            continue
+        g = p.grad().asnumpy()
+        assert np.abs(g).sum() > 0, "zero grad for %s" % name
+
+
+def test_capsnet_learns_digits():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = (d.images / 16.0).astype(np.float32)[:, None]
+    y = d.target.astype(np.int64)
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(y))
+    X, y = X[order], y[order]
+    keep = y < 4                     # 4-class subset keeps the test fast
+    X, y = X[keep], y[keep]
+    split = 600
+    net = _tiny_caps()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    eye = np.eye(4, dtype=np.float32)
+    for epoch in range(5):
+        order = rng.permutation(split)
+        for i in range(0, split - 64 + 1, 64):
+            b = order[i:i + 64]
+            with autograd.record():
+                v_norm, _ = net(nd.array(X[b]))
+                loss = margin_loss(nd, v_norm, nd.array(eye[y[b]])).mean()
+            loss.backward()
+            trainer.step(64)
+    v_norm, _ = net(nd.array(X[split:]))
+    acc = (v_norm.asnumpy().argmax(-1) == y[split:]).mean()
+    assert acc > 0.85, acc
+
+
+# ------------------------------------------------------------ module.fit scale
+def _mlp_symbol(svm=False):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=8, name="fc2")
+    if svm:
+        return mx.sym.SVMOutput(h, label, margin=1.0, name="svm")
+    return mx.sym.SoftmaxOutput(h, label, name="softmax")
+
+
+def _toy_iter(rng, n=512, dim=16, classes=8, batch=64):
+    X = rng.rand(n, dim).astype(np.float32)
+    W = rng.randn(dim, classes).astype(np.float32)
+    y = (X @ W).argmax(-1).astype(np.float32)
+    return (mx.io.NDArrayIter(X, y, batch, shuffle=True),
+            mx.io.NDArrayIter(X, y, batch))
+
+
+def test_fit_rescales_sum_gradients():
+    """Regression: loss layers emit SUM-over-batch grads; fit must set
+    rescale_grad=1/batch or deep MLPs diverge at textbook lrs
+    (reference: module.py init_optimizer batch-size normalization)."""
+    rng = np.random.RandomState(0)
+    train, val = _toy_iter(rng)
+    mod = mx.mod.Module(_mlp_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_metric="acc", initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=10)
+    assert abs(mod._optimizer.rescale_grad - 1.0 / 64) < 1e-9
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    assert acc > 0.8, acc
+
+
+def test_fit_respects_explicit_rescale():
+    rng = np.random.RandomState(1)
+    train, _ = _toy_iter(rng)
+    mod = mx.mod.Module(_mlp_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_metric="acc", initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "rescale_grad": 0.5},
+            num_epoch=1)
+    assert mod._optimizer.rescale_grad == 0.5
+
+
+def test_svm_output_fit_end_to_end():
+    """reference example/svm_mnist: L2-SVM head trains through Module.fit."""
+    rng = np.random.RandomState(2)
+    train, val = _toy_iter(rng)
+    mod = mx.mod.Module(_mlp_symbol(svm=True), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_metric="acc", initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+            num_epoch=10)
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    assert acc > 0.8, acc
