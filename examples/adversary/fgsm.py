@@ -28,14 +28,11 @@ def main():
                     default=[0.0, 0.05, 0.1, 0.2])
     args = ap.parse_args()
 
-    from sklearn.datasets import load_digits
-    d = load_digits()
-    X = (d.images / 16.0).astype(np.float32)[:, None]
-    y = d.target.astype(np.int64)
+    from incubator_mxnet_tpu.test_utils import load_digits_split
+    Xtr, ytr, Xte, yte = load_digits_split()
+    X = np.concatenate([Xtr, Xte]); y = np.concatenate([ytr, yte])
     rng = np.random.RandomState(0)
-    order = rng.permutation(len(y))
-    X, y = X[order], y[order]
-    split = 1500
+    split = len(ytr)
 
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Conv2D(16, 3, activation="relu"),

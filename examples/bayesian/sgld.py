@@ -47,12 +47,12 @@ def main():
 
     net = gluon.nn.Dense(1, use_bias=False, in_units=dim)
     net.initialize(mx.init.Normal(0.1))
-    # SGLD targets the posterior when grads are scaled to the FULL dataset
-    # negative log-lik; lr plays the step-size role. wd = 1/(n p^2) gives
-    # the prior term under the n-scaled objective.
+    # SGLD kernel: w -= lr/2 (grad + wd w) + sqrt(lr) N(0,1).  The loss
+    # below is scaled to the FULL-dataset NLL, so grad = dU_lik/dw; the
+    # Gaussian prior contributes dU_prior/dw = w/p^2, i.e. wd = 1/p^2.
     trainer = gluon.Trainer(net.collect_params(), "sgld",
                             {"learning_rate": 3e-5,
-                             "wd": s2 / (n * p2)})
+                             "wd": 1.0 / p2})
     samples = []
     for step in range(args.steps):
         b = rng.randint(0, n, args.batch)

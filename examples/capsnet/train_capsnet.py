@@ -25,14 +25,11 @@ def main():
     ap.add_argument("--recon-weight", type=float, default=0.0005)
     args = ap.parse_args()
 
-    from sklearn.datasets import load_digits
-    d = load_digits()
-    X = (d.images / 16.0).astype(np.float32)[:, None]     # (N, 1, 8, 8)
-    y = d.target.astype(np.int64)
+    from incubator_mxnet_tpu.test_utils import load_digits_split
+    Xtr, ytr, Xte, yte = load_digits_split()
+    X = np.concatenate([Xtr, Xte]); y = np.concatenate([ytr, yte])
     rng = np.random.RandomState(0)
-    order = rng.permutation(len(y))
-    X, y = X[order], y[order]
-    split = 1500
+    split = len(ytr)
 
     net = CapsNet(num_classes=10, input_size=(8, 8), conv_channels=32,
                   kernel=3, prim_channels=8, prim_dim=4, prim_kernel=3,
@@ -58,7 +55,7 @@ def main():
                         * ((rec - xb.reshape((len(b), -1))) ** 2)
                         .sum(-1).mean())
             loss.backward()
-            trainer.step(args.batch)
+            trainer.step(1)   # loss is already batch-averaged
             total += float(loss.asscalar())
         v_norm, _ = net(nd.array(X[split:]))
         acc = (v_norm.asnumpy().argmax(-1) == y[split:]).mean()

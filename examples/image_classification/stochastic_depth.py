@@ -1,0 +1,81 @@
+"""Stochastic-depth residual training (reference:
+example/stochastic-depth/sd_cifar10.py — Huang et al., residual blocks
+dropped with linearly-decayed survival probability).
+
+Hermetic: bundled 8x8 digits with a small residual stack.  Survival
+decays linearly from 1.0 to --final-survival across depth, exactly the
+reference's death_mode='linear_decay'; at eval every branch is scaled
+by its survival (models in gluon/contrib/nn/regularized.py).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon.contrib.nn import StochasticDepthResidual
+
+
+def residual_body(channels):
+    body = gluon.nn.HybridSequential()
+    body.add(gluon.nn.Conv2D(channels, 3, padding=1, in_channels=channels),
+             gluon.nn.BatchNorm(),
+             gluon.nn.Activation("relu"),
+             gluon.nn.Conv2D(channels, 3, padding=1, in_channels=channels),
+             gluon.nn.BatchNorm())
+    return body
+
+
+def build(depth, final_survival):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"))
+    for i in range(depth):
+        # linear decay: p_l = 1 - l/L * (1 - p_final)
+        p = 1.0 - (i + 1) / depth * (1.0 - final_survival)
+        net.add(StochasticDepthResidual(residual_body(16), survival_p=p))
+    net.add(gluon.nn.Activation("relu"),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--final-survival", type=float, default=0.5)
+    args = ap.parse_args()
+
+    from incubator_mxnet_tpu.test_utils import load_digits_split
+    Xtr, ytr, Xte, yte = load_digits_split()
+    X = np.concatenate([Xtr, Xte]); y = np.concatenate([ytr, yte])
+    rng = np.random.RandomState(0)
+    split = len(ytr)
+
+    net = build(args.depth, args.final_survival)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        order = rng.permutation(split)
+        for i in range(0, split - 64 + 1, 64):
+            b = order[i:i + 64]
+            with autograd.record():
+                loss = loss_fn(net(nd.array(X[b])), nd.array(y[b]))
+            loss.backward()
+            trainer.step(64)
+        pred = net(nd.array(X[split:])).asnumpy().argmax(-1)
+        print("epoch %d  held-out acc %.4f" % (epoch, (pred == y[split:]).mean()))
+
+
+if __name__ == "__main__":
+    main()

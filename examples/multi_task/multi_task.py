@@ -42,15 +42,12 @@ def main():
     ap.add_argument("--parity-weight", type=float, default=1.0)
     args = ap.parse_args()
 
-    from sklearn.datasets import load_digits
-    d = load_digits()
-    X = (d.images / 16.0).astype(np.float32)[:, None]
-    y = d.target.astype(np.int64)
+    from incubator_mxnet_tpu.test_utils import load_digits_split
+    Xtr, ytr, Xte, yte = load_digits_split()
+    X = np.concatenate([Xtr, Xte]); y = np.concatenate([ytr, yte])
     rng = np.random.RandomState(0)
-    order = rng.permutation(len(y))
-    X, y = X[order], y[order]
     y2 = y % 2
-    split = 1500
+    split = len(ytr)
 
     net = MultiTaskNet()
     net.initialize(mx.init.Xavier())

@@ -43,14 +43,11 @@ def main():
     ap.add_argument("--softmax", action="store_true")
     args = ap.parse_args()
 
-    from sklearn.datasets import load_digits
-    d = load_digits()
-    X = (d.data / 16.0).astype(np.float32)
-    y = d.target.astype(np.float32)
-    rng = np.random.RandomState(0)
-    order = rng.permutation(len(y))
-    X, y = X[order], y[order]
-    split = 1500
+    from incubator_mxnet_tpu.test_utils import load_digits_split
+    Xtr, ytr, Xte, yte = load_digits_split(flat=True)
+    X = np.concatenate([Xtr, Xte]).astype(np.float32)
+    y = np.concatenate([ytr, yte]).astype(np.float32)
+    split = len(ytr)
 
     train = mx.io.NDArrayIter(X[:split], y[:split], args.batch,
                               shuffle=True)

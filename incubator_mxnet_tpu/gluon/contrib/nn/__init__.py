@@ -5,9 +5,12 @@ from ... import nn as _nn
 from ...model_zoo.vision.squeezenet import HybridConcurrent
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
-           "SyncBatchNorm", "PixelShuffle2D", "CRF"]
+           "SyncBatchNorm", "PixelShuffle2D", "CRF",
+           "StochasticDepthResidual", "SNDense", "SNConv2D"]
 
 from .crf import CRF  # noqa: E402,F401
+from .regularized import (StochasticDepthResidual, SNDense,  # noqa: E402,F401
+                          SNConv2D)
 
 
 class Concurrent(Block):

@@ -1,0 +1,117 @@
+"""Regularized/structured layers (reference families:
+`example/stochastic-depth/sd_module.py` — Huang et al. stochastic
+depth; `example/gluon/sn_gan/model.py` — Miyato et al. spectral
+normalization).
+
+TPU notes: the reference's stochastic-depth uses per-block host-side
+coin flips wired through Module callbacks; here the gate is one
+Dropout draw INSIDE the traced program (scalar bernoulli broadcast, so
+train/eval switch on the same compiled graph).  Spectral norm keeps
+the reference's one-step power iteration, but the singular-vector
+state `u` rides the framework's aux side-channel (the same mechanism
+as BatchNorm running stats) so it updates correctly under hybridize.
+"""
+
+from ...block import HybridBlock
+from ...nn import basic_layers as _bl
+from ... import nn as _nn
+
+__all__ = ["StochasticDepthResidual", "SNDense", "SNConv2D"]
+
+
+class StochasticDepthResidual(HybridBlock):
+    """out = shortcut(x) + gate * body(x); gate ~ Bernoulli(survival_p)
+    per batch at train time, the constant ``survival_p`` at eval
+    (Huang et al. eq. 5-6; reference example/stochastic-depth trains
+    ResNets with linearly-decayed survival).
+
+    ``body`` is any block mapping x -> same-shape residual;
+    ``shortcut`` defaults to identity (pass a downsample block when
+    the body changes shape).
+    """
+
+    def __init__(self, body, survival_p=0.8, shortcut=None, **kwargs):
+        super().__init__(**kwargs)
+        if not 0.0 < survival_p <= 1.0:
+            raise ValueError("survival_p must be in (0, 1], got %s"
+                             % survival_p)
+        self._p = float(survival_p)
+        with self.name_scope():
+            self.body = body
+            self.shortcut = shortcut
+
+    def hybrid_forward(self, F, x):
+        res = self.body(x)
+        base = self.shortcut(x) if self.shortcut is not None else x
+        if self._p >= 1.0:
+            return base + res
+        # Dropout(ones, p=1-p) = bernoulli(p)/p at train, 1 at eval;
+        # times p => bernoulli(p) at train, p at eval — the exact
+        # stochastic-depth semantics from one expression.
+        gate = self._p * F.Dropout(F.ones((1,) * len(res.shape)),
+                                   p=1.0 - self._p,
+                                   training=_bl._train_flag(),
+                                   key=_bl._maybe_key())
+        return base + gate * res
+
+
+def _spectral_sigma(F, weight, u, eps=1e-12):
+    """One power-iteration step (Miyato et al. alg. 1).
+
+    Returns (sigma, new_u) with stop-gradient on the iterates — only
+    sigma's dependence through ``weight`` itself carries gradient.
+    """
+    w2d = weight.reshape((weight.shape[0], -1))          # (out, in*)
+    wu = F.stop_gradient(F.dot(w2d, u, transpose_a=True))   # (in*,)
+    v = wu / F.sqrt((wu * wu).sum() + eps)
+    wv = F.stop_gradient(F.dot(w2d, v))                  # (out,)
+    new_u = wv / F.sqrt((wv * wv).sum() + eps)
+    # sigma = u^T W v: u, v constants (stop-grad), grad flows via W
+    sigma = F.dot(new_u, F.dot(w2d, v))
+    return sigma, new_u
+
+
+class _SNMixin:
+    """Shared: u aux param + weight_bar computation + aux update."""
+
+    def _init_u(self, out_units):
+        self.u = self.params.get("u", shape=(out_units,), init="normal",
+                                 differentiable=False, aux=True)
+
+    def _w_bar(self, F, weight, u):
+        sigma, new_u = _spectral_sigma(F, weight, u)
+        if _bl._train_flag():
+            ctx = _bl.current_trace()
+            if ctx is not None:
+                ctx.aux_updates[self.u.name] = new_u
+            else:
+                from .... import autograd as _ag
+                with _ag.pause():
+                    self.u.data()._data = new_u._data \
+                        if hasattr(new_u, "_data") else new_u
+        return weight / (sigma + 1e-12)
+
+
+class SNDense(_nn.Dense, _SNMixin):
+    """Dense with spectrally-normalized weight (reference:
+    example/gluon/sn_gan/model.py SNConv2D, dense analogue)."""
+
+    def __init__(self, units, **kwargs):
+        super().__init__(units, **kwargs)
+        with self.name_scope():
+            self._init_u(units)
+
+    def hybrid_forward(self, F, x, weight, bias=None, u=None):
+        return super().hybrid_forward(F, x, self._w_bar(F, weight, u), bias)
+
+
+class SNConv2D(_nn.Conv2D, _SNMixin):
+    """Conv2D with spectrally-normalized weight."""
+
+    def __init__(self, channels, kernel_size, **kwargs):
+        super().__init__(channels, kernel_size, **kwargs)
+        with self.name_scope():
+            self._init_u(channels)
+
+    def hybrid_forward(self, F, x, weight, bias=None, u=None):
+        return super().hybrid_forward(F, x, self._w_bar(F, weight, u), bias)

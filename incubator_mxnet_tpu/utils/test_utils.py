@@ -16,7 +16,8 @@ from .. import autograd
 __all__ = ["default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
            "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
-           "check_consistency", "simple_forward", "default_dtype"]
+           "check_consistency", "simple_forward", "default_dtype",
+           "load_digits_split"]
 
 _default_ctx = None
 
@@ -199,3 +200,22 @@ def check_symbolic_backward(sym, inputs, out_grads, expected_grads,
                             names=("grad_%d" % i, "expected_grad_%d" % i))
         got.append(_as_np(g))
     return got
+
+
+def load_digits_split(split=1500, seed=0, flat=False, scale=16.0):
+    """sklearn's bundled 8x8 digit scans as a seeded train/test split
+    (the hermetic stand-in the examples use for MNIST-class demos;
+    reference examples download MNIST — zero-egress environments can't).
+
+    Returns ``(X_train, y_train, X_test, y_test)`` with images scaled to
+    [0, 1]; shape (N, 1, 8, 8), or (N, 64) with ``flat=True``.
+    """
+    import numpy as np
+    from sklearn.datasets import load_digits as _ld
+    d = _ld()
+    X = (d.images / scale).astype(np.float32)
+    X = X.reshape(len(X), -1) if flat else X[:, None]
+    y = d.target.astype(np.int64)
+    order = np.random.RandomState(seed).permutation(len(y))
+    X, y = X[order], y[order]
+    return X[:split], y[:split], X[split:], y[split:]

@@ -84,7 +84,7 @@ def test_capsnet_learns_digits():
                 v_norm, _ = net(nd.array(X[b]))
                 loss = margin_loss(nd, v_norm, nd.array(eye[y[b]])).mean()
             loss.backward()
-            trainer.step(64)
+            trainer.step(1)   # batch-averaged loss
     v_norm, _ = net(nd.array(X[split:]))
     acc = (v_norm.asnumpy().argmax(-1) == y[split:]).mean()
     assert acc > 0.85, acc

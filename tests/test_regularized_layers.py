@@ -1,0 +1,126 @@
+"""StochasticDepthResidual + spectral norm (references:
+example/stochastic-depth, example/gluon/sn_gan)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon.contrib.nn import (SNConv2D, SNDense,
+                                                  StochasticDepthResidual)
+
+
+# ------------------------------------------------------------ stochastic depth
+def test_sd_eval_is_survival_scaled():
+    body = gluon.nn.Dense(8, in_units=8)
+    blk = StochasticDepthResidual(body, survival_p=0.7)
+    blk.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    out = blk(x).asnumpy()
+    ref = x.asnumpy() + 0.7 * body(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # deterministic at eval
+    np.testing.assert_allclose(out, blk(x).asnumpy())
+
+
+def test_sd_train_gate_is_bernoulli():
+    body = gluon.nn.Dense(8, in_units=8)
+    blk = StochasticDepthResidual(body, survival_p=0.6)
+    blk.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(1).rand(4, 8).astype(np.float32))
+    full = body(x).asnumpy()
+    kept = 0
+    for _ in range(40):
+        with autograd.record():
+            out = blk(x)
+        d = out.asnumpy() - x.asnumpy()
+        if np.abs(d).max() > 1e-6:         # gate == 1: full residual added
+            np.testing.assert_allclose(d, full, rtol=1e-5, atol=1e-6)
+            kept += 1
+        else:                              # gate == 0: identity
+            np.testing.assert_allclose(d, 0.0, atol=1e-6)
+    assert 10 <= kept <= 36                # ~Bernoulli(0.6) over 40 draws
+
+
+def test_sd_survival_one_is_plain_residual():
+    body = gluon.nn.Dense(4, in_units=4)
+    blk = StochasticDepthResidual(body, survival_p=1.0)
+    blk.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(2).rand(2, 4).astype(np.float32))
+    with autograd.record():
+        out = blk(x)
+    np.testing.assert_allclose(out.asnumpy(),
+                               x.asnumpy() + body(x).asnumpy(), rtol=1e-5)
+
+
+def test_sd_rejects_bad_p():
+    with pytest.raises(ValueError):
+        StochasticDepthResidual(gluon.nn.Dense(4), survival_p=0.0)
+
+
+# -------------------------------------------------------------- spectral norm
+def test_sn_dense_sigma_converges_to_top_singular_value():
+    sn = SNDense(8, in_units=16)
+    sn.initialize(mx.init.Normal(2.0))
+    x = nd.array(np.random.RandomState(1).rand(4, 16).astype(np.float32))
+    for _ in range(12):                    # power iterations via fwd passes
+        with autograd.record():
+            sn(x)
+    W = sn.weight.data().asnumpy()
+    u = sn.u.data().asnumpy()
+    v = W.T @ u
+    v /= np.linalg.norm(v)
+    est = float(u @ (W @ v))
+    true = np.linalg.svd(W, compute_uv=False)[0]
+    assert abs(est - true) / true < 1e-3, (est, true)
+    # eval forward equals x @ (W/sigma)^T + b
+    out = sn(x).asnumpy()
+    ref = x.asnumpy() @ (W / est).T + sn.bias.data().asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-5)
+
+
+def test_sn_conv_lipschitz_bounded():
+    """After normalization the conv's weight matrix has top sv ~ 1."""
+    sn = SNConv2D(6, 3, in_channels=2)
+    sn.initialize(mx.init.Normal(1.5))
+    x = nd.array(np.random.RandomState(2).rand(2, 2, 8, 8).astype(np.float32))
+    for _ in range(12):
+        with autograd.record():
+            sn(x)
+    W = sn.weight.data().asnumpy().reshape(6, -1)
+    u = sn.u.data().asnumpy()
+    v = W.T @ u
+    v /= np.linalg.norm(v)
+    sigma = float(u @ (W @ v))
+    top = np.linalg.svd(W, compute_uv=False)[0]
+    assert abs(sigma - top) / top < 1e-2
+    np.testing.assert_allclose(np.linalg.svd(W / sigma,
+                                             compute_uv=False)[0],
+                               1.0, rtol=1e-2)
+
+
+def test_sn_updates_u_under_hybridize():
+    """u rides the aux side-channel inside the jit trace (same path as
+    BatchNorm running stats)."""
+    sn = SNDense(4, in_units=8)
+    sn.initialize(mx.init.Normal(1.0))
+    sn.hybridize()
+    x = nd.array(np.random.RandomState(3).rand(2, 8).astype(np.float32))
+    u0 = sn.u.data().asnumpy().copy()
+    with autograd.record():
+        out = sn(x)
+    out.backward()
+    u1 = sn.u.data().asnumpy()
+    assert np.abs(u1 - u0).max() > 1e-6
+    np.testing.assert_allclose(np.linalg.norm(u1), 1.0, rtol=1e-5)
+
+
+def test_sn_gradient_flows_through_normalized_weight():
+    sn = SNDense(4, in_units=8, use_bias=False)
+    sn.initialize(mx.init.Normal(1.0))
+    x = nd.array(np.random.RandomState(4).rand(2, 8).astype(np.float32))
+    with autograd.record():
+        loss = (sn(x) ** 2).sum()
+    loss.backward()
+    g = sn.weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
