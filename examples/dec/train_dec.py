@@ -1,0 +1,62 @@
+"""Deep Embedded Clustering (reference:
+example/deep-embedded-clustering/dec.py — Xie et al. on MNIST).
+
+Hermetic: bundled digits.  Three paper stages: autoencoder pretrain,
+k-means centroid init on the embedding, joint KL(P||Q) refinement
+(models/dec.py).  Reports NMI and clustering accuracy (best cluster ->
+label assignment) before and after refinement.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from incubator_mxnet_tpu.models.dec import DECModel
+from incubator_mxnet_tpu.test_utils import load_digits_split
+
+
+def cluster_accuracy(y, pred, k):
+    """Greedy cluster->label map (the reference uses Hungarian; greedy is
+    within a point or two at k=10 and keeps scipy optional)."""
+    acc = 0
+    for c in range(k):
+        members = y[pred == c]
+        if len(members):
+            acc += np.bincount(members).max()
+    return acc / len(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pretrain-epochs", type=int, default=25)
+    ap.add_argument("--refine-epochs", type=int, default=12)
+    ap.add_argument("--clusters", type=int, default=10)
+    args = ap.parse_args()
+
+    from sklearn.metrics import normalized_mutual_info_score as nmi
+    Xtr, ytr, Xte, yte = load_digits_split(flat=True)
+    X = np.concatenate([Xtr, Xte])
+    y = np.concatenate([ytr, yte])
+
+    dec = DECModel((64, 96, 32, 8), n_clusters=args.clusters, seed=0)
+    print("stage 1: autoencoder pretrain (%d epochs)" % args.pretrain_epochs)
+    dec.pretrain(X, epochs=args.pretrain_epochs)
+    print("stage 2: k-means centroid init")
+    dec.init_centroids(X, n_init=5)
+    pre = dec.predict(X)
+    print("  k-means on embedding: NMI %.3f  acc %.3f"
+          % (nmi(y, pre), cluster_accuracy(y, pre, args.clusters)))
+    print("stage 3: KL(P||Q) refinement (%d epochs)" % args.refine_epochs)
+    dec.refine(X, epochs=args.refine_epochs)
+    post = dec.predict(X)
+    print("  after refinement:     NMI %.3f  acc %.3f"
+          % (nmi(y, post), cluster_accuracy(y, post, args.clusters)))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,8 @@ from .sparse_ctr import (FactorizationMachine, WideDeep, SparseLinear,
                          pad_csr_batch)
 from .tree_lstm import ChildSumTreeLSTM, TreeSimilarity, flatten_trees
 from .capsnet import CapsNet, margin_loss
+from .rbm import BernoulliRBM
+from .dec import DECModel
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
                    bert_sharding_rules, MultiHeadAttention,
                    TransformerEncoderLayer, BERTEncoder)

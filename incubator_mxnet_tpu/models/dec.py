@@ -1,0 +1,161 @@
+"""Deep Embedded Clustering (reference family:
+`example/deep-embedded-clustering/dec.py` — Xie et al.: stacked
+autoencoder pretrain, then joint refinement of encoder + cluster
+centroids under the KL(P||Q) self-training objective).
+
+TPU notes: the reference alternates a host-side solver loop with
+per-batch NDArray ops and a hand-written gradient for the t-student
+assignment layer.  Here the assignment layer is an ordinary
+HybridBlock whose centroids are a Parameter — q is computed inside
+the autograd graph, the KL pulls gradients through encoder AND
+centroids automatically (no custom gradient code), and the target
+distribution P refreshes on the host every ``update_interval`` epochs
+exactly as the paper prescribes.
+"""
+
+import numpy as _np
+
+from .. import autograd as _ag
+from .. import initializer as _init
+from .. import nd
+from ..gluon import Trainer, nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["DECModel"]
+
+
+class _AutoEncoder(HybridBlock):
+    def __init__(self, dims, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.encoder = nn.HybridSequential(prefix="enc_")
+            for i, d in enumerate(dims[1:]):
+                act = "relu" if i < len(dims) - 2 else None
+                self.encoder.add(nn.Dense(d, activation=act,
+                                          in_units=dims[i]))
+            rev = list(reversed(dims))
+            self.decoder = nn.HybridSequential(prefix="dec_")
+            for i, d in enumerate(rev[1:]):
+                act = "relu" if i < len(rev) - 2 else None
+                self.decoder.add(nn.Dense(d, activation=act,
+                                          in_units=rev[i]))
+
+    def hybrid_forward(self, F, x):
+        z = self.encoder(x)
+        return z, self.decoder(z)
+
+
+class _Assignment(HybridBlock):
+    """Student-t soft assignment q_ij (paper eq. 1); centroids are a
+    Parameter so KL gradients update them alongside the encoder."""
+
+    def __init__(self, n_clusters, dim, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = float(alpha)
+        with self.name_scope():
+            self.mu = self.params.get("centroids", shape=(n_clusters, dim))
+
+    def hybrid_forward(self, F, z, mu):
+        d2 = ((F.expand_dims(z, axis=1)
+               - F.expand_dims(mu, axis=0)) ** 2).sum(axis=-1)
+        q = (1.0 + d2 / self._alpha) ** (-(self._alpha + 1.0) / 2.0)
+        return q / q.sum(axis=-1, keepdims=True)
+
+
+class DECModel:
+    """dims e.g. (64, 128, 32, 8): input -> ... -> embedding."""
+
+    def __init__(self, dims, n_clusters, alpha=1.0, seed=0):
+        self.ae = _AutoEncoder(list(dims))
+        self.ae.initialize(_init.Xavier())
+        self.assign = _Assignment(n_clusters, dims[-1], alpha)
+        self.n_clusters = int(n_clusters)
+        self._rng = _np.random.RandomState(seed)
+
+    # ----------------------------------------------------------------- stage 1
+    def pretrain(self, X, epochs=20, batch=128, lr=1e-3):
+        trainer = Trainer(self.ae.collect_params(), "adam",
+                          {"learning_rate": lr})
+        n = len(X)
+        batch = min(batch, n)          # small datasets still train
+        for _ in range(epochs):
+            order = self._rng.permutation(n)
+            for i in range(0, n - batch + 1, batch):
+                xb = nd.array(X[order[i:i + batch]])
+                with _ag.record():
+                    _, rec = self.ae(xb)
+                    loss = ((rec - xb) ** 2).mean()
+                loss.backward()
+                trainer.step(1)
+        return self
+
+    def embed(self, X, batch=512):
+        outs = []
+        for i in range(0, len(X), batch):
+            z, _ = self.ae(nd.array(X[i:i + batch]))
+            outs.append(z.asnumpy())
+        return _np.concatenate(outs)
+
+    # ----------------------------------------------------------------- stage 2
+    def init_centroids(self, X, n_init=10, iters=50):
+        """Host-side k-means on the embeddings (paper init)."""
+        Z = self.embed(X)
+        best, best_inertia = None, _np.inf
+        for _ in range(n_init):
+            c = Z[self._rng.choice(len(Z), self.n_clusters, replace=False)]
+            for _ in range(iters):
+                d = ((Z[:, None] - c[None]) ** 2).sum(-1)
+                a = d.argmin(-1)
+                newc = _np.stack([
+                    Z[a == k].mean(0) if (a == k).any() else c[k]
+                    for k in range(self.n_clusters)])
+                if _np.allclose(newc, c):
+                    break
+                c = newc
+            d = ((Z[:, None] - c[None]) ** 2).sum(-1)
+            inertia = d.min(-1).sum()
+            if inertia < best_inertia:
+                best, best_inertia = c, inertia
+        self.assign.initialize(_init.Zero(), force_reinit=True)
+        self.assign.mu.set_data(nd.array(best.astype(_np.float32)))
+        return self
+
+    @staticmethod
+    def target_distribution(q):
+        """p_ij = q^2/f_j, normalized (paper eq. 3) — host-side refresh."""
+        w = q ** 2 / q.sum(0, keepdims=True)
+        return (w / w.sum(-1, keepdims=True)).astype(_np.float32)
+
+    def refine(self, X, epochs=10, batch=256, lr=2e-4, update_interval=1,
+               tol=1e-3):
+        """Joint KL(P||Q) training; stops when assignments move < tol."""
+        params = {**self.ae.encoder.collect_params(),
+                  **self.assign.collect_params()}
+        trainer = Trainer(params, "adam", {"learning_rate": lr})
+        n = len(X)
+        batch = min(batch, n)          # small datasets still train
+        last = None
+        p_all = None
+        for epoch in range(epochs):
+            if epoch % update_interval == 0:
+                q_all = self.assign(nd.array(self.embed(X))).asnumpy()
+                p_all = self.target_distribution(q_all)
+                a = q_all.argmax(-1)
+                if last is not None and (a != last).mean() < tol:
+                    break
+                last = a
+            order = self._rng.permutation(n)
+            for i in range(0, n - batch + 1, batch):
+                b = order[i:i + batch]
+                xb, pb = nd.array(X[b]), nd.array(p_all[b])
+                with _ag.record():
+                    z, _ = self.ae(xb)
+                    q = self.assign(z)
+                    kl = (pb * ((pb + 1e-10).log() - (q + 1e-10).log())) \
+                        .sum(-1).mean()
+                kl.backward()
+                trainer.step(1)
+        return self
+
+    def predict(self, X):
+        return self.assign(nd.array(self.embed(X))).asnumpy().argmax(-1)

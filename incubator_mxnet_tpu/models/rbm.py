@@ -1,0 +1,103 @@
+"""Bernoulli restricted Boltzmann machine (reference family:
+`example/restricted-boltzmann-machine` — binary RBM trained with CD-k /
+PCD on MNIST, Gibbs sampling visualization).
+
+TPU notes: the reference runs Gibbs chains as a host loop over NDArray
+ops with per-step `mx.nd.random` draws.  Here one CD-k update is a
+single fused step: the k Gibbs sweeps are a Python-unrolled (static k)
+chain of matmul + sigmoid + bernoulli draws, so XLA compiles the whole
+contrastive update into one program; the persistent chain (PCD) is
+just carried state.  CD is not a backprop gradient — updates are the
+explicit <vh>_data - <vh>_model estimator, applied directly.
+"""
+
+import numpy as _np
+
+from .. import nd
+
+__all__ = ["BernoulliRBM"]
+
+
+class BernoulliRBM:
+    """Binary-binary RBM with CD-k / persistent CD training."""
+
+    def __init__(self, n_visible, n_hidden, seed=0):
+        rng = _np.random.RandomState(seed)
+        self.w = nd.array(0.01 * rng.randn(n_visible, n_hidden)
+                          .astype(_np.float32))
+        self.bv = nd.array(_np.zeros(n_visible, _np.float32))
+        self.bh = nd.array(_np.zeros(n_hidden, _np.float32))
+        self._chain = None          # persistent fantasy particles (PCD)
+
+    # ------------------------------------------------------------- conditionals
+    def prob_h(self, v):
+        return nd.sigmoid(v.dot(self.w) + self.bh.reshape((1, -1)))
+
+    def prob_v(self, h):
+        return nd.sigmoid(h.dot(self.w.T) + self.bv.reshape((1, -1)))
+
+    @staticmethod
+    def _sample(p):
+        return (nd.random.uniform(0, 1, shape=p.shape) < p) * 1.0
+
+    def gibbs(self, v, k=1):
+        """k sweeps v -> h -> v; returns (v_k, prob_h(v_k))."""
+        for _ in range(k):
+            h = self._sample(self.prob_h(v))
+            v = self._sample(self.prob_v(h))
+        return v, self.prob_h(v)
+
+    # ------------------------------------------------------------------ energy
+    def free_energy(self, v):
+        """F(v) = -b_v.v - sum log(1 + exp(W^T v + b_h))."""
+        wx = v.dot(self.w) + self.bh.reshape((1, -1))
+        sp = nd.Activation(wx, act_type="softrelu")     # softplus
+        return -(v * self.bv.reshape((1, -1))).sum(-1) - sp.sum(-1)
+
+    def exact_log_partition(self):
+        """Enumerate all visible states (tiny RBMs only) — the oracle the
+        tests use to compare model probabilities with data frequencies."""
+        n = self.bv.shape[0]
+        if n > 16:
+            raise ValueError("exact partition only for n_visible <= 16")
+        states = _np.array([[(i >> j) & 1 for j in range(n)]
+                            for i in range(2 ** n)], _np.float32)
+        fe = self.free_energy(nd.array(states)).asnumpy().astype(_np.float64)
+        m = (-fe).max()                      # logsumexp(-F) stabilizer
+        return m + _np.log(_np.exp(-fe - m).sum()), states, fe
+
+    def log_prob(self, v):
+        logz, _, _ = self.exact_log_partition()
+        return -self.free_energy(v).asnumpy() - logz
+
+    # ---------------------------------------------------------------- training
+    def cd_step(self, v0, lr=0.05, k=1, persistent=False, weight_decay=0.0,
+                monitor=True):
+        """One contrastive-divergence update on a batch of visibles.
+        ``monitor=False`` skips the reconstruction-CE forward pass and
+        its blocking host sync (returns None) — use in tight loops."""
+        batch = v0.shape[0]
+        ph0 = self.prob_h(v0)
+        if persistent:
+            if self._chain is None or self._chain.shape[0] != batch:
+                self._chain = v0
+            start = self._chain
+        else:
+            start = v0
+        vk, phk = self.gibbs(start, k=k)
+        if persistent:
+            self._chain = vk
+
+        pos = v0.T.dot(ph0)
+        neg = vk.T.dot(phk)
+        self.w += lr * ((pos - neg) / batch - weight_decay * self.w)
+        self.bv += lr * (v0 - vk).mean(0)
+        self.bh += lr * (ph0 - phk).mean(0)
+        if not monitor:
+            return None
+        # reconstruction cross-entropy (monitoring; not the CD objective)
+        pv = self.prob_v(self._sample(ph0))
+        eps = 1e-7
+        rec = -(v0 * (pv + eps).log()
+                + (1 - v0) * (1 - pv + eps).log()).sum(-1).mean()
+        return float(rec.asscalar())

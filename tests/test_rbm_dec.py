@@ -1,0 +1,120 @@
+"""RBM (reference: example/restricted-boltzmann-machine) and DEC
+(reference: example/deep-embedded-clustering) — exact-enumeration
+oracles plus end-to-end learning."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.models.dec import DECModel
+from incubator_mxnet_tpu.models.rbm import BernoulliRBM
+from incubator_mxnet_tpu.test_utils import load_digits_split
+
+
+# ----------------------------------------------------------------------- RBM
+def test_free_energy_matches_brute_force():
+    """F(v) = -log sum_h exp(-E(v,h)) enumerated over all hidden states."""
+    rbm = BernoulliRBM(3, 4, seed=1)
+    rbm.w = nd.array(np.random.RandomState(0).randn(3, 4)
+                     .astype(np.float32))
+    rbm.bv = nd.array(np.array([0.3, -0.2, 0.1], np.float32))
+    rbm.bh = nd.array(np.array([0.1, 0.4, -0.3, 0.2], np.float32))
+    W, bv, bh = (rbm.w.asnumpy().astype(np.float64),
+                 rbm.bv.asnumpy().astype(np.float64),
+                 rbm.bh.asnumpy().astype(np.float64))
+    hs = np.array([[(i >> j) & 1 for j in range(4)] for i in range(16)],
+                  np.float64)
+    for v in ([0, 0, 0], [1, 0, 1], [1, 1, 1]):
+        v = np.asarray(v, np.float64)
+        energies = -(v @ bv + hs @ bh + (v @ W) @ hs.T)
+        brute = -np.log(np.exp(-energies).sum())
+        got = float(rbm.free_energy(nd.array(v[None].astype(np.float32)))
+                    .asnumpy()[0])
+        assert abs(got - brute) < 1e-4, (got, brute)
+
+
+def test_exact_partition_normalizes():
+    rbm = BernoulliRBM(6, 5, seed=2)
+    logz, states, fe = rbm.exact_log_partition()
+    p = np.exp(-fe - logz)
+    assert states.shape == (64, 6)
+    np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-10)
+
+
+def _bars_and_stripes(n=3):
+    pats = set()
+    for bits in range(2 ** n):
+        row = [(bits >> i) & 1 for i in range(n)]
+        pats.add(tuple(np.repeat([row], n, axis=0).ravel()))
+        pats.add(tuple(np.repeat(np.array(row)[:, None], n, axis=1).ravel()))
+    return np.array(sorted(pats), np.float32)
+
+
+def test_cd_learns_bars_and_stripes():
+    """After CD-2 training, most probability mass (exact partition)
+    sits on the 14 BAS patterns out of 512 visible states."""
+    data = _bars_and_stripes(3)
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    rbm = BernoulliRBM(9, 12, seed=0)
+    for step in range(2600):
+        batch = data[rng.randint(0, len(data), 16)]
+        rbm.cd_step(nd.array(batch), lr=0.1, k=2)
+    logz, states, fe = rbm.exact_log_partition()
+    p = np.exp(-fe - logz)
+    support = {tuple(s) for s in data.astype(int)}
+    mass = sum(pi for s, pi in zip(states.astype(int), p)
+               if tuple(s) in support)
+    assert mass > 0.3, mass           # uniform baseline: 14/512 = 0.027
+
+
+def test_pcd_persistent_chain_carries():
+    data = _bars_and_stripes(3)
+    mx.random.seed(1)
+    rbm = BernoulliRBM(9, 8, seed=3)
+    rbm.cd_step(nd.array(data[:8]), persistent=True)
+    c1 = rbm._chain.asnumpy().copy()
+    rbm.cd_step(nd.array(data[:8]), persistent=True)
+    c2 = rbm._chain.asnumpy()
+    assert c1.shape == (8, 9)
+    assert not np.array_equal(c1, c2)      # chain evolved, not reset
+
+
+# ----------------------------------------------------------------------- DEC
+def test_target_distribution_sharpens():
+    q = np.array([[0.6, 0.3, 0.1], [0.34, 0.33, 0.33]], np.float32)
+    p = DECModel.target_distribution(q)
+    np.testing.assert_allclose(p.sum(-1), 1.0, rtol=1e-5)
+    def entropy(x):
+        return -(x * np.log(x + 1e-12)).sum(-1)
+    assert (entropy(p) <= entropy(q) + 1e-6).all()
+    assert p[0, 0] > q[0, 0]               # dominant assignment reinforced
+
+
+def test_assignment_rows_sum_to_one_and_grads_flow():
+    from incubator_mxnet_tpu import autograd
+    dec = DECModel((8, 6, 4), n_clusters=3, seed=0)
+    X = np.random.RandomState(0).rand(32, 8).astype(np.float32)
+    dec.init_centroids(X, n_init=2, iters=10)
+    z, _ = dec.ae(nd.array(X))
+    with autograd.record():
+        q = dec.assign(z)
+        loss = (q ** 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(q.asnumpy().sum(-1), 1.0, rtol=1e-5)
+    assert np.abs(dec.assign.mu.grad().asnumpy()).sum() > 0
+
+
+def test_dec_clusters_digits():
+    from sklearn.metrics import normalized_mutual_info_score as nmi
+    Xtr, ytr, _, _ = load_digits_split(flat=True)
+    X, y = Xtr[:1000], ytr[:1000]
+    dec = DECModel((64, 96, 32, 8), n_clusters=10, seed=0)
+    dec.pretrain(X, epochs=15)
+    dec.init_centroids(X, n_init=4)
+    pre = nmi(y, dec.predict(X))
+    dec.refine(X, epochs=6)
+    post = nmi(y, dec.predict(X))
+    assert post > 0.5, (pre, post)
+    assert post >= pre - 0.03, (pre, post)   # refinement must not wreck init
