@@ -1,0 +1,119 @@
+"""Neural style transfer by input optimization (reference:
+example/neural-style/nstyle.py — Gatys et al.: optimize the image so
+deep features match the content image and feature Gram matrices match
+the style image).
+
+Zero-egress twist: no pretrained VGG weights are available, so the
+feature extractor is a FIXED random-weight conv pyramid — random
+shallow conv features are a known-workable basis for texture/Gram
+matching (they span oriented edges/colors); content structure comes
+from matching a deeper layer.  The optimization loop is the reference
+algorithm unchanged: gradients flow to the INPUT via attach_grad, the
+network weights never move.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+class FeaturePyramid(gluon.HybridBlock):
+    """Four fixed random conv stages; returns all four feature maps."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stages = gluon.nn.HybridSequential()
+            for ch in (16, 32, 64, 64):
+                blk = gluon.nn.HybridSequential()
+                blk.add(gluon.nn.Conv2D(ch, 3, padding=1),
+                        gluon.nn.Activation("relu"),
+                        gluon.nn.AvgPool2D(2))
+                self.stages.add(blk)
+
+    def hybrid_forward(self, F, x):
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats
+
+
+def gram(feat):
+    B, C = feat.shape[0], feat.shape[1]
+    f = feat.reshape((B, C, -1))
+    n = f.shape[2]
+    return nd.batch_dot(f, f.transpose((0, 2, 1))) / n
+
+
+def make_images(rng, size=32):
+    """Content: a blocky 'building' silhouette; style: diagonal stripes."""
+    content = np.zeros((1, 3, size, size), np.float32)
+    content[:, :, 8:28, 6:14] = 0.8
+    content[:, :, 14:28, 18:27] = 0.5
+    content[:, 0] *= 1.2
+    xx, yy = np.meshgrid(np.arange(size), np.arange(size))
+    stripes = (np.sin((xx + yy) * 0.8) > 0).astype(np.float32)
+    style = np.stack([stripes, 0.3 * stripes, 1 - stripes])[None]
+    return content, style.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--style-weight", type=float, default=50.0)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    content_img, style_img = make_images(rng)
+
+    net = FeaturePyramid()
+    net.initialize(mx.init.Xavier(magnitude=2))
+
+    content_feats = [f.detach() for f in net(nd.array(content_img))]
+    style_grams = [gram(f).detach() for f in net(nd.array(style_img))]
+
+    x = nd.array(content_img + 0.1 * rng.randn(*content_img.shape)
+                 .astype(np.float32))
+    x.attach_grad()
+    # adam on the image
+    m = np.zeros_like(content_img)
+    v = np.zeros_like(content_img)
+    for it in range(args.iters):
+        with autograd.record():
+            feats = net(x)
+            c_loss = ((feats[2] - content_feats[2]) ** 2).mean()
+            s_loss = sum(((gram(f) - g) ** 2).mean()
+                         for f, g in zip(feats, style_grams))
+            loss = c_loss + args.style_weight * s_loss
+        loss.backward()
+        g = x.grad.asnumpy()
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mhat = m / (1 - 0.9 ** (it + 1))
+        vhat = v / (1 - 0.999 ** (it + 1))
+        step = args.lr * mhat / (np.sqrt(vhat) + 1e-8)
+        x = nd.array(np.clip(x.asnumpy() - step, 0, 1.2))
+        x.attach_grad()
+        if it % 30 == 0 or it == args.iters - 1:
+            print("iter %3d  content %.4f  style %.5f"
+                  % (it, float(c_loss.asscalar()), float(s_loss.asscalar())))
+
+    out = x.asnumpy()[0]
+    np.save("/tmp/neural_style_out.npy", out)
+    print("saved stylized image -> /tmp/neural_style_out.npy "
+          "(mean %.3f, std %.3f)" % (out.mean(), out.std()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""CTC acoustic model (reference families: `example/speech_recognition`
+— deepspeech.cfg BiLSTM + warp-CTC training on LibriSpeech;
+`example/ctc` — LSTM + CTC OCR on captchas).
+
+Hermetic stand-in for speech data: each "phoneme" label emits a
+characteristic spectral template over 3-5 frames with jittered
+duration and additive noise, so utterances are variable-length frame
+sequences whose alignment is unknown — exactly the problem CTC solves.
+A BiLSTM tags frames, CTCLoss (the framework's log-domain DP scan)
+trains without alignments, and greedy blank-collapse decoding reports
+full-sequence accuracy and token error rate.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+N_PHONES = 6        # labels 1..6; 0 is the CTC blank
+N_MELS = 12
+
+
+def synth_utterances(rng, n, min_len=3, max_len=6, max_frames=40):
+    """Each phoneme: a fixed random spectral template, 3-5 frames."""
+    templates = rng.randn(N_PHONES + 1, N_MELS).astype(np.float32) * 2.0
+    X = np.zeros((n, max_frames, N_MELS), np.float32)
+    X_len = np.zeros((n,), np.int32)
+    Y = np.zeros((n, max_len), np.float32)      # 0-padded labels
+    Y_len = np.zeros((n,), np.int32)
+    for i in range(n):
+        L = rng.randint(min_len, max_len + 1)
+        labels = rng.randint(1, N_PHONES + 1, L)
+        t = 0
+        for lab in labels:
+            dur = rng.randint(3, 6)
+            if t + dur > max_frames:
+                break
+            X[i, t:t + dur] = templates[lab] + 0.5 * rng.randn(dur, N_MELS)
+            t += dur
+        X_len[i] = t
+        Y[i, :L] = labels
+        Y_len[i] = L
+    return X, X_len, Y, Y_len
+
+
+def greedy_decode(logits, length):
+    """argmax -> collapse repeats -> drop blanks (CTC best path)."""
+    path = logits[:length].argmax(-1)
+    out, prev = [], -1
+    for p in path:
+        if p != prev and p != 0:
+            out.append(int(p))
+        prev = p
+    return out
+
+
+def edit_distance(a, b):
+    dp = np.arange(len(b) + 1, dtype=np.int32)
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (ca != cb))
+    return int(dp[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=48)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, X_len, Y, Y_len = synth_utterances(rng, 2400)
+    split = 2000
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.rnn.LSTM(args.hidden, layout="NTC", bidirectional=True,
+                           input_size=N_MELS),
+            gluon.nn.Dense(N_PHONES + 1, flatten=False,
+                           in_units=2 * args.hidden))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+
+    for epoch in range(args.epochs):
+        order = rng.permutation(split)
+        total, nb = 0.0, 0
+        for i in range(0, split - args.batch + 1, args.batch):
+            b = order[i:i + args.batch]
+            with autograd.record():
+                logits = net(nd.array(X[b]))
+                loss = ctc(logits, nd.array(Y[b]),
+                           nd.array(X_len[b].astype(np.float32)),
+                           nd.array(Y_len[b].astype(np.float32))).mean()
+            loss.backward()
+            trainer.step(1)
+            total += float(loss.asscalar())
+            nb += 1
+
+        logits = net(nd.array(X[split:])).asnumpy()
+        exact, errs, toks = 0, 0, 0
+        for j in range(len(logits)):
+            ref = [int(v) for v in Y[split + j][:Y_len[split + j]]]
+            hyp = greedy_decode(logits[j], X_len[split + j])
+            exact += int(hyp == ref)
+            errs += edit_distance(hyp, ref)
+            toks += len(ref)
+        print("epoch %d  ctc loss %.3f  seq acc %.3f  TER %.3f"
+              % (epoch, total / max(1, nb),
+                 exact / len(logits), errs / max(1, toks)))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,3 +6,4 @@ from . import onnx
 from . import text
 from . import svrg_optimization
 from . import tensorboard
+from . import dsd

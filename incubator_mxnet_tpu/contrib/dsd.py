@@ -1,0 +1,64 @@
+"""Dense-Sparse-Dense training utilities (reference family:
+`example/dsd` — Han et al. DSD: train dense, prune to a sparsity mask
+and retrain sparse, then release the mask and retrain dense).
+
+The reference implements pruning as a custom SGD variant with an
+NDArray mask baked into the update.  Here the mask is framework-level
+data: :func:`magnitude_masks` computes per-parameter binary masks and
+:func:`apply_masks` re-zeroes weights after any optimizer step, so DSD
+composes with EVERY optimizer (adam, momentum, ...) instead of one
+patched SGD.
+"""
+
+import numpy as _np
+
+from .. import nd
+
+__all__ = ["magnitude_masks", "apply_masks", "sparsity"]
+
+
+def magnitude_masks(params, sparsity, skip_bias=True):
+    """Binary keep-masks zeroing the lowest-|w| fraction per parameter.
+
+    ``params``: dict name -> Parameter (e.g. ``net.collect_params()``).
+    Returns dict name -> nd mask (same shape as the weight).
+    """
+    if not 0.0 <= sparsity < 1.0:
+        raise ValueError("sparsity must be in [0, 1), got %s" % sparsity)
+    masks = {}
+    for name, p in params.items():
+        if getattr(p, "grad_req", "write") == "null":
+            continue
+        if skip_bias and p.shape is not None and len(p.shape) <= 1:
+            continue
+        w = p.data().asnumpy()
+        k = int(round(sparsity * w.size))
+        if k == 0:
+            masks[name] = nd.array(_np.ones_like(w))
+            continue
+        # prune exactly k entries (stable argsort breaks magnitude ties
+        # deterministically — a plain threshold would wipe out every tie,
+        # e.g. all existing zeros when re-pruning an already-sparse net)
+        order = _np.argsort(_np.abs(w).ravel(), kind="stable")
+        mask = _np.ones(w.size, w.dtype)
+        mask[order[:k]] = 0
+        masks[name] = nd.array(mask.reshape(w.shape))
+    return masks
+
+
+def apply_masks(params, masks):
+    """Re-zero pruned weights (call after each optimizer step)."""
+    for name, mask in masks.items():
+        p = params[name]
+        p.set_data(p.data() * mask)
+
+
+def sparsity(params, masks=None):
+    """Measured zero-fraction over the masked parameters."""
+    names = masks.keys() if masks is not None else params.keys()
+    zeros = total = 0
+    for name in names:
+        w = params[name].data().asnumpy()
+        zeros += (w == 0).sum()
+        total += w.size
+    return zeros / max(1, total)

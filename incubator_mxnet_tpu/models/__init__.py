@@ -15,6 +15,7 @@ from .tree_lstm import ChildSumTreeLSTM, TreeSimilarity, flatten_trees
 from .capsnet import CapsNet, margin_loss
 from .rbm import BernoulliRBM
 from .dec import DECModel
+from .lstnet import LSTNet
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
                    bert_sharding_rules, MultiHeadAttention,
                    TransformerEncoderLayer, BERTEncoder)

@@ -1,0 +1,93 @@
+"""LSTNet multivariate time-series forecaster (reference family:
+`example/multivariate_time_series/src/lstnet.py` — Lai et al.: temporal
+conv -> GRU + skip-GRU -> dense, plus a parallel autoregressive
+highway; electricity-consumption forecasting).
+
+TPU notes: the reference builds the skip connection by slicing the
+conv output per phase in a Python loop over symbols.  Here the skip
+path is one reshape — (B, T, C) -> (B*p, T/p, C) puts every phase in
+the batch axis, so ONE fused GRU pass covers all p phase-chains and
+the MXU sees a p-times-larger batch instead of p small sequential
+calls.  The AR highway is a single matmul over the last q steps.
+"""
+
+from ..gluon import nn, rnn
+from ..gluon.block import HybridBlock
+
+__all__ = ["LSTNet"]
+
+
+class LSTNet(HybridBlock):
+    """forward(x (B, T, D)) -> (B, D) next-step forecast.
+
+    ``skip`` must divide the post-conv length ``T - kernel + 1``
+    (valid convolution; the constructor raises otherwise — pick the
+    kernel so the skip period lines up, e.g. window 76 / kernel 5 /
+    skip 24).
+    """
+
+    def __init__(self, num_series, window, conv_channels=32, kernel=6,
+                 rnn_hidden=32, skip=4, skip_hidden=8, ar_window=8,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._D = int(num_series)
+        self._T = int(window)
+        self._kernel = int(kernel)
+        self._skip = int(skip)
+        self._ar = int(ar_window)
+        conv_len = self._T - self._kernel + 1
+        if self._skip > 0 and conv_len % self._skip != 0:
+            raise ValueError("skip=%d must divide conv length %d"
+                             % (self._skip, conv_len))
+        self._conv_len = conv_len
+        with self.name_scope():
+            # temporal conv: kernel spans `kernel` steps x all D series
+            self.conv = nn.Conv1D(conv_channels, kernel,
+                                  in_channels=num_series,
+                                  activation="relu")
+            self.drop = nn.Dropout(dropout) if dropout > 0 else None
+            self.gru = rnn.GRU(rnn_hidden, layout="TNC",
+                               input_size=conv_channels)
+            if self._skip > 0:
+                self.skip_gru = rnn.GRU(skip_hidden, layout="TNC",
+                                        input_size=conv_channels)
+                fc_in = rnn_hidden + self._skip * skip_hidden
+            else:
+                self.skip_gru = None
+                fc_in = rnn_hidden
+            self.fc = nn.Dense(num_series, in_units=fc_in)
+            if self._ar > 0:
+                # per-series shared AR weights over the last q steps
+                self.ar_fc = nn.Dense(1, in_units=self._ar, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        B = x.shape[0]
+        # conv over time: (B, T, D) -> (B, D, T) -> (B, C, T')
+        c = self.conv(x.transpose((0, 2, 1)))
+        if self.drop is not None:
+            c = self.drop(c)
+        seq = c.transpose((2, 0, 1))                     # (T', B, C)
+
+        out = self.gru(seq)                              # (T', B, H)
+        h_last = out[-1]                                 # (B, H)
+        feats = h_last
+
+        if self.skip_gru is not None:
+            p, Tc = self._skip, self._conv_len
+            # phase-major fold: (T', B, C) -> (T'/p, p, B, C) -> (T'/p, p*B, C)
+            sk = seq.reshape((Tc // p, p, B, -1)).reshape((Tc // p, p * B, -1))
+            sk_out = self.skip_gru(sk)[-1]               # (p*B, Hs)
+            sk_out = sk_out.reshape((p, B, -1)) \
+                           .transpose((1, 0, 2)).reshape((B, -1))
+            feats = F.concat(feats, sk_out, dim=-1)
+
+        pred = self.fc(feats)                            # (B, D)
+
+        if self._ar > 0:
+            # AR highway: last q raw values per series, shared linear
+            tail = F.slice_axis(x, axis=1, begin=self._T - self._ar,
+                                end=self._T)             # (B, q, D)
+            tail = tail.transpose((0, 2, 1))             # (B, D, q)
+            ar = self.ar_fc(tail).reshape((B, self._D))
+            pred = pred + ar
+        return pred

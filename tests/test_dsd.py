@@ -1,0 +1,73 @@
+"""Dense-Sparse-Dense utilities (reference: example/dsd)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.contrib import dsd
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+            gluon.nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_magnitude_masks_prune_smallest():
+    net = _net()
+    params = net.collect_params()
+    masks = dsd.magnitude_masks(params, 0.5)
+    for name, mask in masks.items():
+        w = np.abs(params[name].data().asnumpy())
+        m = mask.asnumpy()
+        kept, dropped = w[m == 1], w[m == 0]
+        assert abs(m.mean() - 0.5) < 0.1         # ~half pruned
+        if len(kept) and len(dropped):
+            assert kept.min() >= dropped.max() - 1e-7
+
+
+def test_masks_skip_biases():
+    net = _net()
+    masks = dsd.magnitude_masks(net.collect_params(), 0.5)
+    assert all("bias" not in name for name in masks)
+
+
+def test_apply_masks_zeroes_and_sparsity_measures():
+    net = _net()
+    params = net.collect_params()
+    masks = dsd.magnitude_masks(params, 0.3)
+    dsd.apply_masks(params, masks)
+    s = dsd.sparsity(params, masks)
+    assert 0.2 < s < 0.4, s
+    for name, mask in masks.items():
+        w = params[name].data().asnumpy()
+        assert (w[mask.asnumpy() == 0] == 0).all()
+
+
+def test_masked_training_preserves_sparsity():
+    rng = np.random.RandomState(0)
+    net = _net()
+    params = net.collect_params()
+    masks = dsd.magnitude_masks(params, 0.5)
+    dsd.apply_masks(params, masks)
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-2})
+    X = rng.rand(64, 8).astype(np.float32)
+    y = rng.randint(0, 4, 64)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(10):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(y)).mean()
+        loss.backward()
+        trainer.step(1)
+        dsd.apply_masks(params, masks)
+    s = dsd.sparsity(params, masks)
+    assert s > 0.45, s                           # sparsity held through training
+
+
+def test_rejects_bad_sparsity():
+    net = _net()
+    with pytest.raises(ValueError):
+        dsd.magnitude_masks(net.collect_params(), 1.0)

@@ -1,0 +1,159 @@
+"""LSTNet (reference: example/multivariate_time_series) and the CTC
+acoustic-model pipeline (reference: example/speech_recognition,
+example/ctc)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.lstnet import LSTNet
+
+
+# --------------------------------------------------------------------- LSTNet
+def test_lstnet_shapes_and_hybrid_parity():
+    net = LSTNet(num_series=5, window=29, kernel=6, skip=4, ar_window=8)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(3, 29, 5).astype(np.float32))
+    out = net(x)
+    assert out.shape == (3, 5)
+    net.hybridize()
+    np.testing.assert_allclose(out.asnumpy(), net(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lstnet_rejects_bad_skip():
+    with pytest.raises(ValueError):
+        LSTNet(num_series=3, window=20, kernel=6, skip=4)  # 15 % 4 != 0
+
+
+def test_lstnet_ar_highway_dominates_linear_series():
+    """On a pure AR(1) process the AR highway alone can fit; check the
+    model reaches near-AR error on it (sanity of the highway wiring)."""
+    rng = np.random.RandomState(1)
+    mx.random.seed(1)
+    n, d = 1500, 3
+    series = np.zeros((n, d), np.float32)
+    for t in range(1, n):
+        series[t] = 0.95 * series[t - 1] + 0.1 * rng.randn(d)
+    W = 24
+    X = np.stack([series[i:i + W] for i in range(n - W)])
+    Y = np.stack([series[i + W] for i in range(n - W)])
+    split = 1200
+    net = LSTNet(num_series=d, window=W, kernel=5, skip=4, ar_window=8,
+                 conv_channels=8, rnn_hidden=8, skip_hidden=4)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.L2Loss()
+    for epoch in range(6):
+        order = rng.permutation(split)
+        for i in range(0, split - 128 + 1, 128):
+            b = order[i:i + 128]
+            with autograd.record():
+                loss = loss_fn(net(nd.array(X[b])), nd.array(Y[b])).mean()
+            loss.backward()
+            trainer.step(1)
+    pred = net(nd.array(X[split:])).asnumpy()
+    mse = ((pred - Y[split:]) ** 2).mean()
+    best = ((0.95 * X[split:, -1] - Y[split:]) ** 2).mean()  # true AR(1)
+    assert mse < 5.0 * best, (mse, best)
+
+
+def test_lstnet_skip_fold_matches_per_phase_loop():
+    """Grey-box oracle for the one novel piece: the (T',B,C) ->
+    (T'/p, p*B, C) phase-major fold.  Recompute the prediction with an
+    EXPLICIT python loop over phases (seq[j::p] through the same
+    skip_gru), concat in phase order, through the same fc — must equal
+    the model's fused forward exactly."""
+    rng = np.random.RandomState(2)
+    p = 4
+    net = LSTNet(num_series=2, window=21, kernel=6, skip=p, ar_window=0,
+                 conv_channels=4, rnn_hidden=4, skip_hidden=3)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rng.rand(2, 21, 2).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 2)
+
+    # independent per-phase reference using the model's own sub-blocks
+    c = net.conv(x.transpose((0, 2, 1)))
+    seq = c.transpose((2, 0, 1))                       # (T', B, C)
+    h_last = net.gru(seq)[-1]
+    seq_np = seq.asnumpy()
+    phase_feats = []
+    for j in range(p):
+        chain = nd.array(seq_np[j::p])                 # (T'/p, B, C)
+        phase_feats.append(net.skip_gru(chain)[-1])    # (B, Hs)
+    sk = nd.concat(*phase_feats, dim=-1)               # (B, p*Hs) j-major
+    ref = net.fc(nd.concat(h_last, sk, dim=-1))
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------------- CTC ASR
+def _synth_utts(rng, n, n_phones=4, n_mels=8, max_frames=24, max_len=4):
+    templates = rng.randn(n_phones + 1, n_mels).astype(np.float32) * 2.0
+    X = np.zeros((n, max_frames, n_mels), np.float32)
+    X_len = np.zeros((n,), np.int32)
+    Y = np.zeros((n, max_len), np.float32)
+    Y_len = np.zeros((n,), np.int32)
+    for i in range(n):
+        L = rng.randint(2, max_len + 1)
+        labels = rng.randint(1, n_phones + 1, L)
+        t = 0
+        for lab in labels:
+            dur = rng.randint(3, 5)
+            if t + dur > max_frames:
+                break
+            X[i, t:t + dur] = templates[lab] + 0.4 * rng.randn(dur, n_mels)
+            t += dur
+        X_len[i] = t
+        Y[i, :L] = labels
+        Y_len[i] = L
+    return X, X_len, Y, Y_len
+
+
+def _greedy(logits, length):
+    path = logits[:length].argmax(-1)
+    out, prev = [], -1
+    for p in path:
+        if p != prev and p != 0:
+            out.append(int(p))
+        prev = p
+    return out
+
+
+def test_bilstm_ctc_learns_unaligned_labels():
+    """End-to-end: variable-duration spectral patterns, no alignment,
+    BiLSTM + CTC reaches high exact-sequence accuracy."""
+    rng = np.random.RandomState(0)
+    X, X_len, Y, Y_len = _synth_utts(rng, 700)
+    split = 600
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.rnn.LSTM(32, layout="NTC", bidirectional=True,
+                           input_size=8),
+            gluon.nn.Dense(5, flatten=False, in_units=64))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    for epoch in range(8):
+        order = rng.permutation(split)
+        for i in range(0, split - 32 + 1, 32):
+            b = order[i:i + 32]
+            with autograd.record():
+                logits = net(nd.array(X[b]))
+                loss = ctc(logits, nd.array(Y[b]),
+                           nd.array(X_len[b].astype(np.float32)),
+                           nd.array(Y_len[b].astype(np.float32))).mean()
+            loss.backward()
+            trainer.step(1)
+    logits = net(nd.array(X[split:])).asnumpy()
+    exact = 0
+    for j in range(len(logits)):
+        ref = [int(v) for v in Y[split + j][:Y_len[split + j]]]
+        exact += int(_greedy(logits[j], X_len[split + j]) == ref)
+    acc = exact / len(logits)
+    assert acc > 0.7, acc
