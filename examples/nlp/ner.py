@@ -21,30 +21,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import autograd, gluon, nd
+from lstm_crf import BiLSTMCRF        # shared tagger (same directory)
 
 O, BPER, IPER, BLOC, ILOC = range(5)
-
-
-class BiLSTMCRF(gluon.HybridBlock):
-    def __init__(self, vocab, num_tags, embed=32, hidden=48, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.embed = gluon.nn.Embedding(vocab, embed)
-            self.lstm = gluon.rnn.LSTM(hidden, layout="NTC",
-                                       bidirectional=True,
-                                       input_size=embed)
-            self.proj = gluon.nn.Dense(num_tags, flatten=False,
-                                       in_units=2 * hidden)
-            self.crf = gluon.contrib.nn.CRF(num_tags, prefix="crf_")
-
-    def emissions(self, tokens):
-        return self.proj(self.lstm(self.embed(tokens)))
-
-    def hybrid_forward(self, F, tokens, tags):
-        return self.crf(self.emissions(tokens), tags)
-
-    def tag(self, tokens):
-        return self.crf.decode(self.emissions(tokens))
 
 
 def make_data(rng, n, T=12, vocab=30):
@@ -113,7 +92,7 @@ def main():
     args = ap.parse_args()
     rng = np.random.RandomState(0)
 
-    net = BiLSTMCRF(vocab=30, num_tags=5)
+    net = BiLSTMCRF(vocab=30, num_tags=5, hidden=48)
     net.initialize(mx.init.Xavier())
     net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "adam",

@@ -38,11 +38,12 @@ def main():
                             {"learning_rate": 0.05})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
-    # warmup (compile) outside the profile window
+    # warmup (compile) outside the profile window — drain before starting
     with autograd.record():
         loss = loss_fn(net(nd.array(X)), nd.array(y))
     loss.backward()
     trainer.step(64)
+    loss.mean().asscalar()
 
     mx.profiler.set_config(filename=args.out, aggregate_stats=True)
     if args.xplane:

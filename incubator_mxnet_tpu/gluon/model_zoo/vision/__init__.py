@@ -12,6 +12,8 @@ from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,
                         MobileNetV2)
 from .densenet import densenet121, densenet161, densenet169, densenet201, DenseNet
 from .inception import inception_v3, Inception3
+from .resnext import (resnext50_32x4d, resnext101_32x4d, resnext101_64x4d,
+                      ResNeXt, get_resnext)
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
@@ -31,6 +33,9 @@ _models = {
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
     "inceptionv3": inception_v3,
+    "resnext50_32x4d": resnext50_32x4d,
+    "resnext101_32x4d": resnext101_32x4d,
+    "resnext101_64x4d": resnext101_64x4d,
 }
 
 

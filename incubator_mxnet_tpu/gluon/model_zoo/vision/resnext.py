@@ -1,0 +1,102 @@
+"""ResNeXt family (reference:
+`example/image-classification/symbols/resnext.py` — Xie et al.
+aggregated-transformation bottlenecks; the BASELINE quality table's
+imagenet1k-resnext-101-64x4d row comes from this family).
+
+The aggregated transform is expressed as ONE grouped 3x3 convolution
+(num_group=cardinality) — on TPU the grouped conv lowers to a single
+batched-feature dot_general, so cardinality costs nothing extra in
+dispatch; no per-branch splits like the paper's figure 3(a).
+"""
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["ResNeXt", "resnext50_32x4d", "resnext101_32x4d",
+           "resnext101_64x4d", "get_resnext"]
+
+
+class _ResNeXtUnit(HybridBlock):
+    """v1-ordered bottleneck with grouped middle conv: width follows
+    torchvision/reference arithmetic mid = C*W*(out/256)."""
+
+    def __init__(self, channels, stride, cardinality, bottleneck_width,
+                 downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        mid = cardinality * bottleneck_width * channels // 256
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(
+            nn.Conv2D(mid, 1, use_bias=False),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(mid, 3, stride, 1, groups=cardinality,
+                      use_bias=False, in_channels=mid),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, 1, use_bias=False),
+            nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(
+                nn.Conv2D(channels, 1, stride, use_bias=False,
+                          in_channels=in_channels),
+                nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        shortcut = self.downsample(x) if self.downsample else x
+        return F.relu(self.body(x) + shortcut)
+
+
+class ResNeXt(HybridBlock):
+    def __init__(self, layers, cardinality, bottleneck_width, classes=1000,
+                 **kwargs):
+        super().__init__(**kwargs)
+        channels = [256, 512, 1024, 2048]
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(64, 7, 2, 3, use_bias=False),
+                              nn.BatchNorm(), nn.Activation("relu"),
+                              nn.MaxPool2D(3, 2, 1))
+            in_c = 64
+            for i, (n_units, out_c) in enumerate(zip(layers, channels)):
+                stage = nn.HybridSequential(prefix="stage%d_" % (i + 1))
+                with stage.name_scope():
+                    for j in range(n_units):
+                        stride = 2 if (i > 0 and j == 0) else 1
+                        stage.add(_ResNeXtUnit(
+                            out_c, stride, cardinality, bottleneck_width,
+                            downsample=(j == 0), in_channels=in_c,
+                            prefix=""))
+                        in_c = out_c
+                self.features.add(stage)
+            self.features.add(nn.GlobalAvgPool2D())
+            self.output = nn.Dense(classes, in_units=channels[-1])
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+resnext_spec = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3]}
+
+
+def get_resnext(num_layers, cardinality=32, bottleneck_width=4,
+                pretrained=False, **kwargs):
+    if num_layers not in resnext_spec:
+        raise ValueError("no resnext spec for depth %r" % (num_layers,))
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable in this "
+                           "zero-egress environment; load_parameters manually")
+    return ResNeXt(resnext_spec[num_layers], cardinality, bottleneck_width,
+                   **kwargs)
+
+
+def resnext50_32x4d(**kwargs):
+    return get_resnext(50, 32, 4, **kwargs)
+
+
+def resnext101_32x4d(**kwargs):
+    return get_resnext(101, 32, 4, **kwargs)
+
+
+def resnext101_64x4d(**kwargs):
+    return get_resnext(101, 64, 4, **kwargs)

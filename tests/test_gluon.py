@@ -317,7 +317,7 @@ def test_lambda_blocks():
 
 def test_model_zoo_builds():
     for name in ["resnet18_v1", "resnet18_v2", "mobilenet0.25",
-                 "squeezenet1.1", "densenet121"]:
+                 "squeezenet1.1", "densenet121", "resnext50_32x4d"]:
         net = gluon.model_zoo.vision.get_model(name, classes=10)
         net.initialize(mx.init.Xavier())
         out = net(nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32)))
@@ -328,7 +328,8 @@ def test_model_zoo_canonical_param_counts():
     """Architecture fidelity: learnable-parameter counts must equal the
     published models' (torchvision/gluon reference values, classes=1000)."""
     want = {"resnet18_v1": 11689512, "resnet50_v2": 25549480,
-            "densenet121": 7978856}
+            "densenet121": 7978856, "resnext50_32x4d": 25028904,
+            "resnext101_64x4d": 83455272}
     for name, expect in want.items():
         net = gluon.model_zoo.vision.get_model(name, classes=1000)
         net.initialize(mx.init.Xavier())
