@@ -715,16 +715,41 @@ def waitall():
     jax.effects_barrier()
 
 
+_BF16_TAG = "::bf16"      # npz has no ml_dtypes support: bf16 rides as u16
+
+
+def _to_npz(v):
+    """(key_suffix, numpy array) — bfloat16 is bit-cast to uint16 since
+    numpy's npz writer degrades ml_dtypes to raw '|V2' (unloadable)."""
+    a = _np.asarray(v._data)
+    if str(a.dtype) == "bfloat16":
+        return _BF16_TAG, a.view(_np.uint16)
+    return "", a
+
+
+def _from_npz(key, a):
+    if key.endswith(_BF16_TAG):
+        import ml_dtypes
+        return key[: -len(_BF16_TAG)], array(a.view(ml_dtypes.bfloat16))
+    return key, array(a)
+
+
 def save(fname, data):
     """Save NDArrays (list or dict) — reference: mx.nd.save binary format
-    (here: npz container, same capability)."""
+    (here: npz container, same capability; bfloat16 round-trips)."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
-        arrays = {k: _np.asarray(v._data) for k, v in data.items()}
+        arrays = {}
+        for k, v in data.items():
+            tag, a = _to_npz(v)
+            arrays[k + tag] = a
         _np.savez(fname, __mxtpu_format__="dict", **arrays)
     else:
-        arrays = {"arr_%d" % i: _np.asarray(v._data) for i, v in enumerate(data)}
+        arrays = {}
+        for i, v in enumerate(data):
+            tag, a = _to_npz(v)
+            arrays["arr_%d%s" % (i, tag)] = a
         _np.savez(fname, __mxtpu_format__="list", **arrays)
     import os
     if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
@@ -735,7 +760,11 @@ def load(fname):
     f = _np.load(fname, allow_pickle=False)
     fmt = str(f["__mxtpu_format__"]) if "__mxtpu_format__" in f else "dict"
     keys = [k for k in f.files if k != "__mxtpu_format__"]
+    out = {}
+    for k in keys:
+        name, arr = _from_npz(k, f[k])
+        out[name] = arr
     if fmt == "list":
-        keys.sort(key=lambda k: int(k.split("_")[1]))
-        return [array(f[k]) for k in keys]
-    return {k: array(f[k]) for k in keys}
+        names = sorted(out, key=lambda k: int(k.split("_")[1]))
+        return [out[k] for k in names]
+    return out

@@ -68,7 +68,8 @@ class ShardedTrainer:
 
     def __init__(self, block, loss, mesh, rules=None, optimizer="sgd",
                  optimizer_params=None, data_specs=None, label_spec=None,
-                 dp_axis="dp", compute_dtype=None, zero1=False, grad_accum=1):
+                 dp_axis="dp", compute_dtype=None, zero1=False, grad_accum=1,
+                 opt_state_dtype=None):
         self._block = block
         self._loss = loss
         self._mesh = mesh
@@ -79,6 +80,13 @@ class ShardedTrainer:
         # full rate with no loss-scaling needed.
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
+        # low-precision optimizer state (bf16 moments): halves the Adam
+        # m/v HBM traffic — the dominant non-activation term of a large
+        # model's step (BENCHMARKS.md BERT roofline). Update math still
+        # runs in fp32; only the STORED moments round. Master weights
+        # stay fp32 regardless.
+        self._opt_state_dtype = (jnp.dtype(opt_state_dtype)
+                                 if opt_state_dtype is not None else None)
         hp = dict(optimizer_params or {})
         self._lr = float(hp.get("learning_rate", 0.01))
         self._momentum = float(hp.get("momentum", 0.0))
@@ -191,14 +199,17 @@ class ShardedTrainer:
         state = {}
         if self._opt == "sgd" and self._momentum == 0.0:
             return state
+        sdt = self._opt_state_dtype
         for n in self._diff_names:
             sh = self._zero_shardings.get(n, self._param_shardings[n])
-            z = jax.device_put(jnp.zeros_like(self._param_vals[n]), sh)
+            ref = self._param_vals[n]
+            z = jax.device_put(
+                jnp.zeros(ref.shape, sdt or ref.dtype), sh)
             if self._opt == "sgd":
                 state[n] = (z,)
             else:
                 state[n] = (z, jax.device_put(
-                    jnp.zeros_like(self._param_vals[n]), sh))
+                    jnp.zeros(ref.shape, sdt or ref.dtype), sh))
         return state
 
     def _apply_opt(self, p, g, st, t):
@@ -207,10 +218,16 @@ class ShardedTrainer:
             if self._momentum == 0.0:
                 return p - lr * (g + wd * p), st
             (mom,) = st
-            mom = self._momentum * mom - lr * (g + wd * p)
-            return p + mom, (mom,)
+            sdt = mom.dtype
+            mom = (self._momentum * mom.astype(p.dtype)
+                   - lr * (g + wd * p))
+            return p + mom, (mom.astype(sdt),)
         if self._opt in ("adam", "adamw"):
             m, v = st
+            sdt = m.dtype
+            if sdt != p.dtype:                 # low-precision stored state:
+                m = m.astype(p.dtype)          # math in master precision,
+                v = v.astype(p.dtype)          # storage rounds on the way out
             if self._opt == "adam":
                 g = g + wd * p
             m = self._beta1 * m + (1 - self._beta1) * g
@@ -220,7 +237,7 @@ class ShardedTrainer:
             upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
             if self._opt == "adamw":
                 upd = upd + lr * wd * p
-            return p - upd, (m, v)
+            return p - upd, (m.astype(sdt), v.astype(sdt))
         raise ValueError(self._opt)
 
     # ----------------------------------------------------------------- step
@@ -595,7 +612,13 @@ class ShardedTrainer:
                 key = "opt%d/%s" % (i, n)
                 if key not in flat:
                     raise KeyError("checkpoint missing %s" % key)
-                slots.append(jax.device_put(raw(flat[key]), sh))
+                v = jnp.asarray(raw(flat[key]))
+                # restored slots follow the trainer's CONFIGURED state
+                # precision (a bf16-state trainer stays bf16 even from an
+                # fp32 checkpoint, and vice versa — no silent retrace)
+                if v.dtype != st[i].dtype:
+                    v = v.astype(st[i].dtype)
+                slots.append(jax.device_put(v, sh))
             new_opt[n] = tuple(slots)
         self._opt_state = new_opt
         self._step_count = int(jax.device_get(raw(flat["step"])))

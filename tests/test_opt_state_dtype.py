@@ -1,0 +1,143 @@
+"""Low-precision optimizer state in ShardedTrainer (opt_state_dtype):
+bf16-stored Adam moments, fp32 update math — the standard TPU trick for
+halving the optimizer's HBM traffic (BENCHMARKS.md BERT roofline names
+the AdamW state traffic as the step's dominant non-activation term)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+
+def _loss(out, lab):
+    lp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+    return -jnp.take_along_axis(lp, lab[:, None], axis=-1).mean()
+
+
+def _fresh_net(X):
+    net = mx.models.lenet5()
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    net(nd.array(X[:2]))                     # resolve deferred shapes
+    return net
+
+
+def _clone_params(src, dst):
+    # fresh blocks differ only in the auto prefix counter; align by order
+    sps = sorted(src.collect_params().values(), key=lambda p: p.name)
+    dps = sorted(dst.collect_params().values(), key=lambda p: p.name)
+    for s, d in zip(sps, dps):
+        d.set_data(nd.array(s.data().asnumpy()))
+
+
+def _run(net, X, y, osd, steps=15, optimizer="adamw"):
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr = ShardedTrainer(net, _loss, mesh, optimizer=optimizer,
+                        optimizer_params={"learning_rate": 1e-3,
+                                          "momentum": 0.9},
+                        data_specs=[P()], label_spec=P(),
+                        opt_state_dtype=osd)
+    losses = [float(tr.step([nd.array(X)], nd.array(y)))
+              for _ in range(steps)]
+    return losses, tr
+
+
+def test_bf16_state_tracks_fp32_trajectory():
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.int32)
+    net_a = _fresh_net(X)
+    net_b = _fresh_net(X)
+    _clone_params(net_a, net_b)
+
+    l32, tr32 = _run(net_a, X, y, None)
+    lb16, trb = _run(net_b, X, y, "bfloat16")
+    # identical starting point; state storage is the only difference
+    assert abs(l32[0] - lb16[0]) < 1e-5, (l32[0], lb16[0])
+    assert lb16[-1] < lb16[0]                       # still converges
+    drift = max(abs(a - b) for a, b in zip(l32, lb16))
+    assert drift < 0.05, drift                      # tracks closely
+
+    m, v = next(iter(trb._opt_state.values()))
+    assert m.dtype == jnp.bfloat16 and v.dtype == jnp.bfloat16
+    m32, v32 = next(iter(tr32._opt_state.values()))
+    assert m32.dtype == jnp.float32
+
+
+def test_bf16_state_sgd_momentum():
+    rng = np.random.RandomState(1)
+    X = rng.rand(32, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, 32).astype(np.int32)
+    net = _fresh_net(X)
+    losses, tr = _run(net, X, y, "bfloat16", optimizer="sgd")
+    (mom,) = next(iter(tr._opt_state.values()))
+    assert mom.dtype == jnp.bfloat16
+    assert losses[-1] < losses[0]
+
+
+def _remap(flat, src_tr, dst_tr):
+    """Translate state-dict keys between two structurally-identical nets
+    that differ only in the auto prefix counter."""
+    mapping = dict(zip(sorted(src_tr._diff_names + src_tr._aux_names),
+                       sorted(dst_tr._diff_names + dst_tr._aux_names)))
+    out = {}
+    for k, v in flat.items():
+        for tag in ("param/", "opt0/", "opt1/"):
+            if k.startswith(tag) and k[len(tag):] in mapping:
+                k = tag + mapping[k[len(tag):]]
+                break
+        out[k] = v
+    return out
+
+
+def test_bf16_state_checkpoint_round_trip(tmp_path):
+    """nd.save/load must round-trip bfloat16 (npz bit-casts via uint16),
+    and a restored trainer keeps its CONFIGURED state precision."""
+    rng = np.random.RandomState(2)
+    X = rng.rand(32, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, 32).astype(np.int32)
+    net = _fresh_net(X)
+    net2 = _fresh_net(X)
+    net3 = _fresh_net(X)
+    _clone_params(net, net2)        # clone BEFORE training: the jitted
+    _clone_params(net, net3)        # step donates the captured buffers
+    _, tr = _run(net, X, y, "bfloat16", steps=3)
+
+    # raw nd bf16 round-trip
+    arr = nd.array(np.array([1.5, -2.25], np.float32)).astype("bfloat16")
+    path = str(tmp_path / "bf16.npz")
+    mx.nd.save(path, {"a": arr})
+    back = mx.nd.load(path)["a"]
+    assert str(back.dtype) == "bfloat16"
+    np.testing.assert_allclose(back.asnumpy().astype(np.float32),
+                               [1.5, -2.25])
+
+    # full trainer state dict through save/load
+    sd = tr.state_dict()
+    ck = str(tmp_path / "trainer.npz")
+    mx.nd.save(ck, {k: nd.array(np.asarray(v)) if not hasattr(v, "_data")
+                    else v for k, v in sd.items()})
+    flat = mx.nd.load(ck)
+    _, tr2 = _run(net2, X, y, "bfloat16", steps=0)
+    flat = _remap(flat, tr, tr2)
+    tr2.load_state_dict(flat)
+    m, v = next(iter(tr2._opt_state.values()))
+    assert m.dtype == jnp.bfloat16
+    m1, v1 = next(iter(tr._opt_state.values()))
+    np.testing.assert_array_equal(np.asarray(m).view(np.uint16),
+                                  np.asarray(m1).view(np.uint16))
+
+    # fp32 checkpoint into a bf16-configured trainer follows the config
+    _, tr32 = _run(net3, X, y, None, steps=3)
+    sd32 = tr32.state_dict()
+    ck32 = str(tmp_path / "trainer32.npz")
+    mx.nd.save(ck32, {k: v if hasattr(v, "_data")
+                      else nd.array(np.asarray(v))
+                      for k, v in sd32.items()})
+    tr2.load_state_dict(_remap(mx.nd.load(ck32), tr32, tr2))
+    m, v = next(iter(tr2._opt_state.values()))
+    assert m.dtype == jnp.bfloat16          # configured precision wins
