@@ -50,6 +50,9 @@ from . import kvstore as kv
 from . import kvstore
 from . import symbol
 from . import symbol as sym
+from . import attribute
+from .attribute import AttrScope
+from . import name
 from . import subgraph
 from . import rtc
 from . import parallel

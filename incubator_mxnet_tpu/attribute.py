@@ -1,0 +1,54 @@
+"""Attribute scoping for the symbolic API (reference surface:
+python/mxnet/attribute.py AttrScope — attributes set on every symbol
+created inside a ``with mx.AttrScope(...)`` block, e.g. ctx_group for
+model parallelism or lr_mult on a subgraph)."""
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    """``with AttrScope(k=v, ...):`` — symbols created inside pick up the
+    attributes; nesting merges, inner scopes win on conflicts."""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes must be strings, got %r" % (v,))
+        self._attr = kwargs
+        self._old = None
+
+    def get(self, attr=None):
+        """Merge scope attributes under explicit ones.
+
+        Scope keys are stored dunder-wrapped (``ctx_group`` ->
+        ``__ctx_group__``): the executor treats non-dunder node attrs as
+        operator keyword arguments, so metadata must not collide.
+        ``Symbol.attr`` transparently falls back to the wrapped key.
+        """
+        out = {}
+        for k, v in self._attr.items():
+            out[k if k.startswith("__") else "__%s__" % k] = v
+        out.update(attr or {})
+        return out
+
+    def __enter__(self):
+        self._old = current()
+        merged = dict(self._old._attr)
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old is not None
+        AttrScope._current.value = self._old
+
+
+def current():
+    if not hasattr(AttrScope._current, "value"):
+        AttrScope._current.value = AttrScope()
+    return AttrScope._current.value

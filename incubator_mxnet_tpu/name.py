@@ -1,0 +1,54 @@
+"""Automatic naming for the symbolic API (reference surface:
+python/mxnet/name.py — NameManager assigns ``hint%d`` names to unnamed
+symbols; Prefix prepends a fixed prefix, the building block the Gluon
+name_scope machinery mirrors)."""
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """``with NameManager():`` — scoped automatic naming; subclass and
+    override :meth:`get` to change the policy."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        """User-specified name wins; otherwise ``hint%d``."""
+        if name:
+            return name
+        c = self._counter.get(hint, 0)
+        self._counter[hint] = c + 1
+        return "%s%d" % (hint, c)
+
+    def __enter__(self):
+        self._old = current()
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old is not None
+        NameManager._current.value = self._old
+
+
+class Prefix(NameManager):
+    """Auto-names carry a fixed prefix (reference: mx.name.Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current():
+    if not hasattr(NameManager._current, "value"):
+        NameManager._current.value = NameManager()
+    return NameManager._current.value

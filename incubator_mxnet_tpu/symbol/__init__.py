@@ -62,7 +62,11 @@ class Symbol:
         return self._name
 
     def attr(self, key):
-        return self._attrs.get(key)
+        v = self._attrs.get(key)
+        if v is None and not key.startswith("__"):
+            # AttrScope metadata rides dunder-wrapped (see attribute.py)
+            v = self._attrs.get("__%s__" % key)
+        return v
 
     def list_attr(self):
         return dict(self._attrs)
@@ -381,13 +385,11 @@ class Symbol:
         idx = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []
         for n in nodes:
-            # __shape__/__dtype__ var metadata round-trips (the reference
-            # serializes these via nnvm node attrs so infer_shape/infer_type
-            # work on loaded graphs); other dunder attrs stay process-local.
+            # __shape__/__dtype__ var metadata AND AttrScope metadata
+            # (__ctx_group__ etc.) round-trip like the reference's nnvm
+            # node attrs; only graph-wiring internals stay process-local.
             attrs = {k: v for k, v in n._attrs.items()
-                     if not k.startswith("__") or k in ("__shape__",
-                                                        "__dtype__",
-                                                        "__aux__")}
+                     if k not in ("__kwarg_inputs__",)}
             jnodes.append({
                 "op": "null" if n._op is None else n._op,
                 "name": n._name,
@@ -523,18 +525,22 @@ _DTYPE_RULES = {
 }
 
 
-_name_counter = {}
+from .. import name as _name_mod
+from .. import attribute as _attr_mod
+
+# DEPRECATED read-only alias of the default NameManager's counter dict
+# (in-place mutation on the import thread still observes auto-naming;
+# rebinding this module attribute is a no-op — use mx.name.NameManager)
+_name_counter = _name_mod.current()._counter
 
 
 def _auto_name(hint):
-    c = _name_counter.get(hint, 0)
-    _name_counter[hint] = c + 1
-    return "%s%d" % (hint, c)
+    return _name_mod.current().get(None, hint)
 
 
 def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
         **kwargs):
-    attrs = dict(attr or {})
+    attrs = _attr_mod.current().get(attr)
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
@@ -559,6 +565,9 @@ def ones(shape, dtype="float32", **kwargs):
 
 
 def _make_apply(opname, input_syms, attrs, name=None):
+    scope = _attr_mod.current()
+    if scope._attr:
+        attrs = scope.get(attrs)
     info = get_op(opname)
     if callable(info.num_outputs):
         nout = int(info.num_outputs(attrs))
@@ -701,7 +710,9 @@ def load_json(json_str):
         inputs = [built[i[0]][i[1]] if i[1] else built[i[0]]
                   for i in n.get("inputs", [])]
         if n["op"] == "null":
-            built.append(var(n["name"], attr=attrs))
+            # deserialization is scope-neutral: the checkpoint's attrs are
+            # reproduced EXACTLY, never merged with an ambient AttrScope
+            built.append(Symbol(None, n["name"], [], attrs))
         elif n["op"] == "_group":
             built.append(Group(inputs))
         else:

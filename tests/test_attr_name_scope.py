@@ -1,0 +1,98 @@
+"""AttrScope / NameManager (reference: python/mxnet/attribute.py,
+python/mxnet/name.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import name as nm
+
+
+def test_attr_scope_applies_to_vars_and_ops():
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):
+        a = mx.sym.Variable("a")
+        b = mx.sym.FullyConnected(a, num_hidden=4, name="fc")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("lr_mult") == "0.1"
+    assert mx.sym.Variable("c").attr("ctx_group") is None
+
+
+def test_attr_scope_nesting_inner_wins_and_restores():
+    with mx.AttrScope(ctx_group="g1", other="x"):
+        with mx.AttrScope(ctx_group="g2"):
+            d = mx.sym.Variable("d")
+            assert d.attr("other") == "x"      # outer attrs inherited
+        e = mx.sym.Variable("e")
+    assert d.attr("ctx_group") == "g2"
+    assert e.attr("ctx_group") == "g1"
+    assert mx.sym.Variable("f").attr("ctx_group") is None
+
+
+def test_attr_scope_rejects_non_string():
+    with pytest.raises(ValueError):
+        mx.AttrScope(lr_mult=0.1)
+
+
+def test_attr_scope_does_not_break_execution():
+    """Scope metadata must not leak into operator kwargs."""
+    with mx.AttrScope(ctx_group="dev1"):
+        x = mx.sym.Variable("x")
+        y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+        z = mx.sym.Activation(y, act_type="relu")
+    exe = z.bind(None, {
+        "x": mx.nd.array(np.ones((2, 3), np.float32)),
+        "fc_weight": mx.nd.array(np.ones((4, 3), np.float32)),
+        "fc_bias": mx.nd.array(np.zeros(4, np.float32))})
+    out = exe.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), 3.0)
+
+
+def test_explicit_attr_beats_scope():
+    with mx.AttrScope(lr_mult="1.0"):
+        v = mx.sym.Variable("v", attr={"__lr_mult__": "2.0"})
+    assert v.attr("lr_mult") == "2.0"
+
+
+def test_name_manager_counts_and_prefix():
+    with nm.NameManager():
+        t1 = mx.sym.FullyConnected(mx.sym.Variable("y"), num_hidden=2)
+        t2 = mx.sym.FullyConnected(mx.sym.Variable("z"), num_hidden=2)
+    assert t1.name == "fullyconnected0"
+    assert t2.name == "fullyconnected1"
+    with nm.Prefix("mynet_"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=2)
+    assert s.name == "mynet_fullyconnected0"
+
+
+def test_name_manager_restores_outer_counter():
+    base = mx.sym.FullyConnected(mx.sym.Variable("q"), num_hidden=2).name
+    with nm.NameManager():
+        mx.sym.FullyConnected(mx.sym.Variable("r"), num_hidden=2)
+    nxt = mx.sym.FullyConnected(mx.sym.Variable("s"), num_hidden=2).name
+    # global counter resumes where it left off (scoped one was separate)
+    b = int(base.replace("fullyconnected", ""))
+    n = int(nxt.replace("fullyconnected", ""))
+    assert n == b + 1, (base, nxt)
+
+
+def test_json_round_trip_preserves_scope_attrs():
+    with mx.AttrScope(ctx_group="dev2"):
+        x = mx.sym.Variable("x")
+        y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    js = y.tojson()
+    back = mx.sym.load_json(js)
+    nodes = {n._name: n for n in back._topo()}
+    assert nodes["x"].attr("ctx_group") == "dev2"
+    assert nodes["fc"].attr("ctx_group") == "dev2"
+
+
+def test_load_json_is_scope_neutral():
+    """Deserializing inside an active scope must NOT inject its attrs."""
+    y = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4, name="fc")
+    js = y.tojson()
+    with mx.AttrScope(ctx_group="dev9"):
+        back = mx.sym.load_json(js)
+    for n in back._topo():
+        assert n.attr("ctx_group") is None, (n._name, n.list_attr())
+    # and the re-serialized graph is unchanged
+    assert "__ctx_group__" not in back.tojson()
