@@ -10,7 +10,9 @@ __all__ = ["AttrScope", "current"]
 
 class AttrScope:
     """``with AttrScope(k=v, ...):`` — symbols created inside pick up the
-    attributes; nesting merges, inner scopes win on conflicts."""
+    attributes; nesting merges, inner scopes win on conflicts.  Scope
+    objects are reusable and re-entrant: entry/exit keeps a stack, and
+    the constructor kwargs are never mutated."""
 
     _current = threading.local()
 
@@ -18,8 +20,9 @@ class AttrScope:
         for v in kwargs.values():
             if not isinstance(v, str):
                 raise ValueError("attributes must be strings, got %r" % (v,))
-        self._attr = kwargs
-        self._old = None
+        self._base_attr = dict(kwargs)   # immutable constructor attrs
+        self._attr = dict(kwargs)        # effective (merged) view when active
+        self._saved = []                 # (outer current, prior _attr) stack
 
     def get(self, attr=None):
         """Merge scope attributes under explicit ones.
@@ -36,16 +39,18 @@ class AttrScope:
         return out
 
     def __enter__(self):
-        self._old = current()
-        merged = dict(self._old._attr)
-        merged.update(self._attr)
+        outer = current()
+        self._saved.append((outer, self._attr))
+        merged = dict(outer._attr)
+        merged.update(self._base_attr)   # always merge from the base attrs
         self._attr = merged
         AttrScope._current.value = self
         return self
 
     def __exit__(self, ptype, value, trace):
-        assert self._old is not None
-        AttrScope._current.value = self._old
+        outer, prior = self._saved.pop()
+        self._attr = prior
+        AttrScope._current.value = outer
 
 
 def current():

@@ -111,16 +111,18 @@ class TapeNode:
     """One recorded op: parents + the vjp closure produced by jax.vjp."""
 
     __slots__ = ("parents", "vjp_fn", "n_outputs", "out_templates", "op_name",
-                 "fn")
+                 "fn", "device")
 
     def __init__(self, parents, vjp_fn, n_outputs, out_templates, op_name="",
-                 fn=None):
+                 fn=None, device=None):
         self.parents = parents          # list of NDArray inputs (diff'able slots)
         self.vjp_fn = vjp_fn            # cotangents(outs) -> cotangents(parents)
         self.n_outputs = n_outputs
         self.out_templates = out_templates  # list of (shape, dtype) per output
         self.op_name = op_name
         self.fn = fn                    # primal fn — create_graph re-vjps it
+        self.device = device            # forward device (group2ctx placement):
+        #                                 cotangents move here before the vjp
 
 
 def record_op(fn, arrays, op_name=""):
@@ -137,8 +139,13 @@ def record_op(fn, arrays, op_name=""):
     out, vjp_fn = jax.vjp(fn, *vals)
     outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
     templates = [(o.shape, o.dtype) for o in outs]
+    try:                       # committed forward device, for multi-device
+        devs = outs[0].devices()       # graphs (group2ctx); tracers have none
+        dev = next(iter(devs)) if len(devs) == 1 else None
+    except Exception:
+        dev = None
     node = TapeNode(list(arrays), vjp_fn, len(outs), templates, op_name,
-                    fn=fn)
+                    fn=fn, device=dev)
     return outs, node
 
 
@@ -208,12 +215,22 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
     leaf_ct = {}     # id(array) -> ct (jax array)
     leaf_map = {}    # id(array) -> array
 
+    def same_dev(a, b):
+        try:
+            return a.devices() == b.devices()
+        except Exception:
+            return True
+
     def add_ct(store, key, ct, slot=None):
         if slot is None:
             cur = store.get(key)
+            if cur is not None and not same_dev(cur, ct):
+                ct = jax.device_put(ct, next(iter(cur.devices())))
             store[key] = ct if cur is None else cur + ct
         else:
             lst = store[key]
+            if lst[slot] is not None and not same_dev(lst[slot], ct):
+                ct = jax.device_put(ct, next(iter(lst[slot].devices())))
             lst[slot] = ct if lst[slot] is None else lst[slot] + ct
 
     for i, h in enumerate(heads):
@@ -235,6 +252,12 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
             continue
         full = [c if c is not None else jnp.zeros(shape, dtype)
                 for c, (shape, dtype) in zip(cts, node.out_templates)]
+        if node.device is not None:
+            # group2ctx: the vjp closure's residuals live on the forward
+            # device — move the cotangent there before applying it
+            full = [c if (not hasattr(c, "devices")
+                          or c.devices() == {node.device})
+                    else jax.device_put(c, node.device) for c in full]
         arg = tuple(full) if node.n_outputs > 1 else full[0]
         in_cts = node.vjp_fn(arg)
         for parent, ict in zip(node.parents, in_cts):

@@ -23,9 +23,12 @@ class Executor:
     """Bound symbolic graph (reference: graph_executor.cc GraphExecutor)."""
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None):
+                 grad_req="write", aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        # ctx_group name -> Context (reference: bind(..., group2ctx) —
+        # ops whose AttrScope set ctx_group run on the mapped device)
+        self._group2ctx = dict(group2ctx or {})
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
 
@@ -64,6 +67,33 @@ class Executor:
 
         self.outputs = []
         self._monitor_callback = None
+        if self._group2ctx:
+            self._place_args_by_group()
+
+    def _place_args_by_group(self):
+        """Bind-time placement (reference: GraphExecutor assigns each arg
+        to its consumer group's device): every arg consumed exclusively
+        by ops of ONE mapped ctx_group moves there once, so forward never
+        re-transfers parameters."""
+        consumers = {}                   # arg name -> set of group names
+        for n in self._symbol._topo():
+            if n._op is None or n._op == "_group":
+                continue
+            grp = n._attrs.get("__ctx_group__")
+            for i in n._inputs:
+                if i._op is None:
+                    consumers.setdefault(i._name, set()).add(grp)
+        for name, groups in consumers.items():
+            if len(groups) != 1:
+                continue
+            ctx = self._group2ctx.get(next(iter(groups)))
+            if ctx is None:
+                continue
+            for store in (self.arg_dict, self.aux_dict, self.grad_dict):
+                arr = store.get(name)
+                if arr is not None and \
+                        ctx.jax_device not in arr._data.devices():
+                    arr._data = jax.device_put(arr._data, ctx.jax_device)
 
     def forward(self, is_train=False, **kwargs):
         for name, value in kwargs.items():
@@ -72,11 +102,17 @@ class Executor:
                     else jax.numpy.asarray(value)
         feed = dict(self.arg_dict)
         feed.update(self.aux_dict)
+        placement = self._group2ctx or None
+        if placement:
+            # re-assert residency: init_params / set_params overwrite
+            # arrays on the default device; this is a no-op device check
+            # when everything already lives where it belongs
+            self._place_args_by_group()
         if is_train:
             with _ag.record():
-                out = executor_eval(self._symbol, feed)
+                out = executor_eval(self._symbol, feed, placement=placement)
         else:
-            out = executor_eval(self._symbol, feed)
+            out = executor_eval(self._symbol, feed, placement=placement)
         self.outputs = out if isinstance(out, list) else [out]
         if self._monitor_callback is not None:
             for i, o in enumerate(self.outputs):

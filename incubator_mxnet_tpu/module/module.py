@@ -21,6 +21,10 @@ class Module(BaseModule):
                  fixed_param_names=None, state_names=None, group2ctxs=None,
                  compression_params=None):
         super().__init__(logger)
+        # reference surface spells it group2ctxs (list for multi-device DP);
+        # a single dict places ctx_group'd subgraphs like bind(group2ctx=)
+        self._group2ctx = (group2ctxs[0] if isinstance(group2ctxs, list)
+                           and group2ctxs else group2ctxs) or None
         self._symbol = symbol
         self.symbol = symbol
         self._data_names = list(data_names)
@@ -98,7 +102,7 @@ class Module(BaseModule):
                                                        or n in self._label_names
                                                        or n in self._fixed_param_names)
                                             else grad_req) for n in arg_names},
-                                       aux)
+                                       aux, group2ctx=self._group2ctx)
         self.binded = True
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,

@@ -16,7 +16,7 @@ class NameManager:
 
     def __init__(self):
         self._counter = {}
-        self._old = None
+        self._prev = []                  # stack: reusable and re-entrant
 
     def get(self, name, hint):
         """User-specified name wins; otherwise ``hint%d``."""
@@ -27,13 +27,12 @@ class NameManager:
         return "%s%d" % (hint, c)
 
     def __enter__(self):
-        self._old = current()
+        self._prev.append(current())
         NameManager._current.value = self
         return self
 
     def __exit__(self, ptype, value, trace):
-        assert self._old is not None
-        NameManager._current.value = self._old
+        NameManager._current.value = self._prev.pop()
 
 
 class Prefix(NameManager):

@@ -364,9 +364,10 @@ class Symbol:
         return outs if isinstance(outs, list) else [outs]
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, **kwargs):
+             aux_states=None, group2ctx=None, **kwargs):
         from ..executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
         from ..executor import Executor
@@ -575,7 +576,9 @@ def _make_apply(opname, input_syms, attrs, name=None):
         nout = info.num_outputs
     else:
         nout = int(attrs.get(info.num_outputs, 1))
-    return Symbol(info.name, name or _auto_name(opname.lower().strip("_")),
+    if name is None:
+        name = _auto_name(opname.lower().strip("_"))
+    return Symbol(info.name, name,
                   list(input_syms), attrs, num_outputs=nout)
 
 
@@ -595,7 +598,11 @@ def __getattr__(opname):
 
     def sym_fn(*args, **kwargs):
         import inspect
-        name = kwargs.pop("name", None)
+        # resolve the node name exactly ONCE through the NameManager
+        # (reference: Prefix applies to explicit names too; the default
+        # manager passes explicit names through unchanged)
+        name = _name_mod.current().get(kwargs.pop("name", None),
+                                       opname.lower().strip("_"))
         try:
             sig_params = [p for p in
                           inspect.signature(info.fn).parameters.values()
@@ -627,7 +634,6 @@ def __getattr__(opname):
             missing = [p.name for p in sig_params
                        if p.name in _AUTO_PARAM_SLOTS and p.name not in provided]
             if missing:
-                name = name or _auto_name(opname.lower().strip("_"))
                 for pname in missing:
                     if pname == "bias" and (attrs.get("no_bias") or
                                             attrs.get("use_bias") is False):
@@ -650,11 +656,38 @@ def __getattr__(opname):
 # through the same registered ops, jit-compilable as one program)
 # ---------------------------------------------------------------------------
 
-def _eval_symbol(sym, feed, wrap=True):
-    """Evaluate a Symbol given name->NDArray (wrap=True) or name->jax value."""
+def _to_ctx(val, ctx):
+    """Tape-aware device transfer (reference: the _copyto nodes the
+    GraphExecutor inserts at ctx_group boundaries). Backward moves the
+    cotangent back through jax.device_put's identity vjp."""
+    from ..ndarray.ndarray import NDArray, _invoke_simple
+    import jax as _jax
+    dev = ctx.jax_device
+    if isinstance(val, NDArray):
+        if dev in val._data.devices():
+            return val
+        return _invoke_simple(lambda x: _jax.device_put(x, dev), val,
+                              op_name="_copyto")
+    return val
+
+
+def _eval_symbol(sym, feed, wrap=True, placement=None):
+    """Evaluate a Symbol given name->NDArray (wrap=True) or name->jax
+    value. ``placement``: ctx_group name -> Context (bind's group2ctx);
+    op nodes carrying a matching ``__ctx_group__`` attr run on that
+    device, with tape-aware transfers at group boundaries."""
     from .. import ndarray as nd
+    import contextlib
+    import jax as _jax
 
     results = {}  # id(node) -> tuple of outputs
+    moved = {}    # (id(producer), out_index, ctx id) -> transferred value
+
+    def to_ctx_cached(producer, val, ctx):
+        key = (id(producer), producer._out_index or 0, id(ctx))
+        if key not in moved:
+            moved[key] = _to_ctx(val, ctx)
+        return moved[key]
 
     nodes = sym._topo()
     for n in nodes:
@@ -668,16 +701,26 @@ def _eval_symbol(sym, feed, wrap=True):
             attrs = {k: v for k, v in n._attrs.items() if not k.startswith("__")}
             kw_inputs = n._attrs.get("__kwarg_inputs__", [])
             in_vals = [results[id(i)][i._out_index or 0] for i in n._inputs]
+            tgt = None
+            if placement:
+                grp = n._attrs.get("__ctx_group__")
+                tgt = placement.get(grp) if grp else None
+            if tgt is not None and wrap:
+                in_vals = [to_ctx_cached(i, v, tgt)
+                           for i, v in zip(n._inputs, in_vals)]
             kw = {}
             for (k, pos) in kw_inputs:
                 kw[k] = in_vals[pos]
             pos_vals = [v for j, v in enumerate(in_vals)
                         if j not in [p for _, p in kw_inputs]]
-            if wrap:
-                from ..ndarray.ndarray import _invoke_op
-                out = _invoke_op(n._op, tuple(pos_vals), {**attrs, **kw})
-            else:
-                out = get_op(n._op).fn(*pos_vals, **{**attrs, **kw})
+            dev_cm = (_jax.default_device(tgt.jax_device)
+                      if tgt is not None else contextlib.nullcontext())
+            with dev_cm:
+                if wrap:
+                    from ..ndarray.ndarray import _invoke_op
+                    out = _invoke_op(n._op, tuple(pos_vals), {**attrs, **kw})
+                else:
+                    out = get_op(n._op).fn(*pos_vals, **{**attrs, **kw})
             results[id(n)] = out if isinstance(out, tuple) else (out,)
 
     if sym._op == "_group":
@@ -690,8 +733,8 @@ def _eval_symbol(sym, feed, wrap=True):
     return list(outs)
 
 
-def executor_eval(sym, feed):
-    return _eval_symbol(sym, feed, wrap=True)
+def executor_eval(sym, feed, placement=None):
+    return _eval_symbol(sym, feed, wrap=True, placement=placement)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,122 @@
+"""group2ctx model-parallel placement (reference:
+tests/python/unittest/test_model_parallel.py — ctx_group attributes +
+bind(group2ctx=...) on two CPU contexts; no GPUs needed, same here with
+the virtual-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _graph():
+    with mx.AttrScope(ctx_group="dev1"):
+        x = mx.sym.Variable("x")
+        h = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        y = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return y
+
+
+def _bindings(rng):
+    args = {"x": mx.nd.array(rng.rand(2, 6).astype(np.float32)),
+            "fc1_weight": mx.nd.array(rng.randn(8, 6).astype(np.float32)),
+            "fc1_bias": mx.nd.array(np.zeros(8, np.float32)),
+            "fc2_weight": mx.nd.array(rng.randn(4, 8).astype(np.float32)),
+            "fc2_bias": mx.nd.array(np.zeros(4, np.float32))}
+    grads = {k: mx.nd.array(np.zeros(v.shape, np.float32))
+             for k, v in args.items()}
+    return args, grads
+
+
+def test_group2ctx_matches_single_device():
+    """Placed forward AND backward are bit-identical to unplaced."""
+    import jax
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >= 3 devices (virtual CPU mesh)")
+    y = _graph()
+    rng = np.random.RandomState(0)
+    args, grads = _bindings(rng)
+    exe_mp = y.bind(None, dict(args), grads,
+                    group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    out_mp = exe_mp.forward(is_train=True)
+    exe_mp.backward(mx.nd.array(np.ones((2, 4), np.float32)))
+
+    args2 = {k: mx.nd.array(v.asnumpy()) for k, v in args.items()}
+    grads2 = {k: mx.nd.array(np.zeros(v.shape, np.float32))
+              for k, v in args.items()}
+    exe = y.bind(None, args2, grads2)
+    out = exe.forward(is_train=True)
+    exe.backward(mx.nd.array(np.ones((2, 4), np.float32)))
+
+    np.testing.assert_array_equal(out[0].asnumpy(), out_mp[0].asnumpy())
+    for k, g in exe_mp.grad_dict.items():
+        if g is not None:
+            np.testing.assert_array_equal(exe.grad_dict[k].asnumpy(),
+                                          g.asnumpy(), err_msg=k)
+
+
+def test_group2ctx_places_outputs():
+    import jax
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >= 3 devices (virtual CPU mesh)")
+    y = _graph()
+    rng = np.random.RandomState(1)
+    args, grads = _bindings(rng)
+    exe = y.bind(None, args, grads,
+                 group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    out = exe.forward()
+    assert out[0]._data.devices() == {jax.devices()[2]}
+
+
+def test_unmapped_groups_stay_default():
+    """ctx_group names absent from group2ctx run on the default device."""
+    y = _graph()
+    rng = np.random.RandomState(2)
+    args, grads = _bindings(rng)
+    exe = y.bind(None, args, grads, group2ctx={})
+    out = exe.forward(is_train=True)
+    exe.backward(mx.nd.array(np.ones((2, 4), np.float32)))
+    assert np.isfinite(out[0].asnumpy()).all()
+
+
+def test_module_group2ctxs_trains():
+    """reference test_model_parallel.py via the Module API: ctx_group'd
+    symbol + Module(group2ctxs=...) trains to accuracy on two contexts."""
+    import jax
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >= 3 devices (virtual CPU mesh)")
+    from incubator_mxnet_tpu.io.io import DataBatch
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+        out = mx.sym.SoftmaxOutput(h, mx.sym.Variable("softmax_label"),
+                                   name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, 6).astype(np.float32)
+    w = rng.randn(6, 3).astype(np.float32)
+    y = (X @ w).argmax(-1).astype(np.float32)
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",),
+                        group2ctxs={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    mod.bind(data_shapes=[("data", (64, 6))],
+             label_shapes=[("softmax_label", (64,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.2})
+    for step in range(60):
+        b = rng.randint(0, 256, 64)
+        mod.forward_backward(DataBatch(data=[mx.nd.array(X[b])],
+                                       label=[mx.nd.array(y[b])]))
+        mod.update()
+    mod.forward(DataBatch(data=[mx.nd.array(X[:64])],
+                          label=[mx.nd.array(y[:64])]), is_train=False)
+    acc = (mod.get_outputs()[0].asnumpy().argmax(-1) == y[:64]).mean()
+    assert acc > 0.85, acc
+    # params were placed at bind time: fc1 weight lives on cpu(1)
+    assert mod._exec.arg_dict["fc1_weight"]._data.devices() == \
+        {jax.devices()[1]}
