@@ -12,7 +12,7 @@ Layer map (TPU-first redesign of the reference; see SURVEY.md):
   * ``mx.parallel`` — Mesh/pjit sharding: dp/tp/sp/pp (net-new superset)
 """
 
-__version__ = "0.1.0"
+from .libinfo import __version__
 
 from . import base
 from .base import MXNetError
@@ -53,6 +53,8 @@ from . import symbol as sym
 from . import attribute
 from .attribute import AttrScope
 from . import name
+from . import log
+from . import libinfo
 from . import subgraph
 from . import rtc
 from . import parallel

@@ -4,3 +4,4 @@ VariationalDropoutCell (subset)."""
 
 from . import nn
 from . import rnn
+from . import data

@@ -1,0 +1,87 @@
+"""gluon.contrib.data (reference: python/mxnet/gluon/contrib/data —
+IntervalSampler + the WikiText language-modeling datasets).
+
+The reference's WikiText classes download from S3; this environment has
+zero egress, so the datasets here load from a LOCAL copy of the same
+files (pass ``root`` pointing at the extracted ``wiki.{train,valid,
+test}.tokens``) and raise a clear error otherwise.
+"""
+
+import os as _os
+
+import numpy as _np
+
+from ...data import dataset as _dataset
+from ...data import sampler as _sampler
+
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103"]
+
+
+class IntervalSampler(_sampler.Sampler):
+    """Samples [0, length) at fixed ``interval`` strides (reference:
+    contrib/data/sampler.py — e.g. interval=3 over 13 yields
+    0,3,6,9,12,1,4,... with rollover)."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise ValueError("interval %d must be <= length %d"
+                             % (interval, length))
+        self._length = int(length)
+        self._interval = int(interval)
+        self._rollover = bool(rollover)
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            for j in range(i, self._length, self._interval):
+                yield j
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        # without rollover only the stride-0 pass is yielded
+        return (self._length + self._interval - 1) // self._interval
+
+
+class _WikiText(_dataset.Dataset):
+    """Line-level LM dataset over a local WikiText tokens file: each
+    sample is ``seq_len + 1`` token ids (input window + next-token
+    target), exactly the reference's batchified layout."""
+
+    _namespace = None
+    _file = {"train": "wiki.train.tokens", "validation": "wiki.valid.tokens",
+             "test": "wiki.test.tokens"}
+
+    def __init__(self, root, segment="train", seq_len=35, vocab=None):
+        path = _os.path.join(root, self._file[segment])
+        if not _os.path.exists(path):
+            raise FileNotFoundError(
+                "%s not found. This zero-egress build cannot download %s; "
+                "place the extracted WikiText files under %r."
+                % (path, self._namespace, root))
+        with open(path, encoding="utf-8") as f:
+            tokens = f.read().replace("\n", " <eos> ").split()
+        if vocab is None:
+            vocab = {}
+            for t in tokens:
+                if t not in vocab:
+                    vocab[t] = len(vocab)
+        self.vocabulary = vocab
+        unk = vocab.get("<unk>", 0)
+        ids = _np.asarray([vocab.get(t, unk) for t in tokens], _np.int32)
+        n = (len(ids) - 1) // seq_len
+        self._x = ids[: n * seq_len].reshape(n, seq_len)
+        self._y = ids[1: n * seq_len + 1].reshape(n, seq_len)
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._y[idx]
+
+    def __len__(self):
+        return len(self._x)
+
+
+class WikiText2(_WikiText):
+    _namespace = "wikitext-2"
+
+
+class WikiText103(_WikiText):
+    _namespace = "wikitext-103"

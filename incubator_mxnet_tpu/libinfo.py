@@ -1,0 +1,36 @@
+"""Library discovery + version (reference surface:
+python/mxnet/libinfo.py — ``find_lib_path`` for libmxnet.so; here the
+native runtime libraries are libmxtpu.so / libmxtpu_predict.so built
+under ``native/``)."""
+
+import os
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+__version__ = "0.1.0"      # single source: the package __init__ imports this
+
+from .native import _NATIVE_DIR
+
+
+def find_lib_path():
+    """Paths of the built native runtime libraries.
+
+    Honors ``MXTPU_LIBRARY_PATH`` (reference: MXNET_LIBRARY_PATH), else
+    looks in the in-tree ``native/`` build directory. Returns only the
+    libraries that exist; [] when the native runtime isn't built yet
+    (``make -C native`` builds it on first use — see native.py).
+    """
+    env = os.environ.get("MXTPU_LIBRARY_PATH")
+    if env and os.path.isfile(env):
+        return [env]
+    out = []
+    for lib in ("libmxtpu.so", "libmxtpu_predict.so"):
+        p = os.path.join(_NATIVE_DIR, lib)
+        if os.path.isfile(p):
+            out.append(p)
+    return out
+
+
+def find_include_path():
+    """The native C headers directory (predict ABI etc.)."""
+    return os.path.join(_NATIVE_DIR, "src")

@@ -1,0 +1,61 @@
+"""Logging utilities (reference surface: python/mxnet/log.py —
+``get_logger`` with the single-letter-level colored formatter)."""
+
+import logging
+import sys
+
+__all__ = ["get_logger", "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG",
+           "NOTSET"]
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+_LABELS = {logging.CRITICAL: "C", logging.ERROR: "E",
+           logging.WARNING: "W", logging.INFO: "I", logging.DEBUG: "D"}
+
+
+class _Formatter(logging.Formatter):
+    """``L MMDD HH:MM:SS pid file:line] msg`` with ANSI colors on ttys."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno // 10 * 10, "U")
+        head = "%s %s %s:%d]" % (label, self.formatTime(record, self.datefmt),
+                                 record.filename, record.lineno)
+        if self._colored:
+            color = ("\x1b[31m" if record.levelno >= logging.WARNING
+                     else "\x1b[32m" if record.levelno >= logging.INFO
+                     else "\x1b[34m")
+            head = color + head + "\x1b[0m"
+        msg = "%s %s" % (head, record.getMessage())
+        if record.exc_info and record.exc_info[0] is not None:
+            msg += "\n" + self.formatException(record.exc_info)
+        if record.stack_info:
+            msg += "\n" + self.formatStack(record.stack_info)
+        return msg
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Reference-parity logger factory: stream (colored when a tty) or
+    file handler with the single-letter-level formatter, idempotent per
+    name."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxtpu_init", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        handler.setFormatter(_Formatter(colored=False))
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_Formatter(colored=sys.stderr.isatty()))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_init = True
+    return logger

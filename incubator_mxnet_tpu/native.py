@@ -41,10 +41,13 @@ def get_lib():
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
+        # prebuilt override (reference: MXNET_LIBRARY_PATH) — same env var
+        # libinfo.find_lib_path reports
+        path = os.environ.get("MXTPU_LIBRARY_PATH") or _LIB_PATH
         try:
-            if not os.path.exists(_LIB_PATH):
+            if path == _LIB_PATH and not os.path.exists(_LIB_PATH):
                 _build()
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(path)
             _declare(lib)
             _lib = lib
         except (OSError, subprocess.CalledProcessError):
