@@ -1,0 +1,45 @@
+"""Checkpoint-format backward compatibility (reference:
+tests/nightly/model_backwards_compatibility_check — old checkpoints must
+keep loading).  ``tests/data/golden_checkpoint_v1.npz`` was written by
+the v1 ``nd.save`` format (npz container, ``arg:``/``aux:`` prefixed
+keys, bf16 bit-cast with the ``::bf16`` tag) and is COMMITTED — any
+format change that breaks loading it breaks every user checkpoint."""
+
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                      "golden_checkpoint_v1.npz")
+
+
+def test_golden_checkpoint_loads_exactly():
+    back = mx.nd.load(GOLDEN)
+    assert sorted(back) == ["arg:fc_bias", "arg:fc_weight",
+                            "aux:bn_moving_mean", "bf16_slot", "int_ids"]
+    np.testing.assert_array_equal(
+        back["arg:fc_weight"].asnumpy(),
+        np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_array_equal(back["arg:fc_bias"].asnumpy(),
+                                  [0.5, -1.5, 2.0])
+    assert str(back["bf16_slot"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(
+        back["bf16_slot"].asnumpy().astype(np.float32), [1.5, -2.25])
+    assert back["int_ids"].asnumpy().dtype == np.int32
+    np.testing.assert_array_equal(back["int_ids"].asnumpy(),
+                                  [[1, 2], [3, 4]])
+
+
+def test_current_save_round_trips_same_shape_of_data(tmp_path):
+    """Whatever the current writer emits, the current reader loads —
+    with key set and values preserved (list format too)."""
+    arrs = [mx.nd.array(np.ones((2, 2), np.float32)),
+            mx.nd.array(np.array([7], np.int64))]
+    p = str(tmp_path / "x.npz")
+    mx.nd.save(p, arrs)
+    back = mx.nd.load(p)
+    assert isinstance(back, list) and len(back) == 2
+    np.testing.assert_array_equal(back[0].asnumpy(), np.ones((2, 2)))
+    assert back[1].asnumpy()[0] == 7
