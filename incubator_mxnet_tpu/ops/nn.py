@@ -186,6 +186,7 @@ def _deconv_one(data, weight, stride, dilate, pads):
 def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
             pad=None, pooling_convention="valid", count_include_pad=True,
             p_value=2, **_ignored):
+    """Max/avg/sum/lp pooling, N-D NCHW (reference: pooling.cc)."""
     sd = data.ndim - 2
     if global_pool:
         kernel = data.shape[2:]
@@ -379,6 +380,7 @@ def _make_bn_train(axis, eps):
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **_ignored):
+    """Layer normalization over `axis` (reference: layer_norm.cc)."""
     if axis in (-1, data.ndim - 1):
         from .pallas import fused_layer_norm, fused_norm_available
         if fused_norm_available():
@@ -395,6 +397,7 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **_ignored):
 
 @register("InstanceNorm")
 def instance_norm(data, gamma, beta, eps=1e-3, **_ignored):
+    """Instance normalization over spatial dims (reference: instance_norm.cc)."""
     red = tuple(range(2, data.ndim))
     mean = jnp.mean(data, axis=red, keepdims=True)
     var = jnp.var(data, axis=red, keepdims=True)
@@ -404,6 +407,7 @@ def instance_norm(data, gamma, beta, eps=1e-3, **_ignored):
 
 @register("L2Normalization")
 def l2_normalization(data, eps=1e-10, mode="instance"):
+    """L2-normalize per instance/channel/spatial (reference: l2_normalization.cc)."""
     if mode == "instance":
         red = tuple(range(1, data.ndim))
         n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
@@ -417,6 +421,7 @@ def l2_normalization(data, eps=1e-10, mode="instance"):
 
 @register("LRN")
 def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across channels (reference: lrn.cc)."""
     sq = jnp.square(data)
     c = data.shape[1]
     half = nsize // 2
@@ -431,6 +436,7 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
 
 @register("Activation")
 def activation(data, act_type="relu"):
+    """relu/sigmoid/tanh/softrelu/softsign by act_type (reference: activation.cc)."""
     if act_type == "relu":
         return jax.nn.relu(data)
     if act_type == "sigmoid":
@@ -455,6 +461,7 @@ register("gelu")(lambda data: jax.nn.gelu(data, approximate=False))
 @register("LeakyReLU")
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
                lower_bound=0.125, upper_bound=0.334, key=None):
+    """leaky/prelu/elu/selu/gelu/rrelu family (reference: leaky_relu.cc)."""
     if act_type == "leaky":
         return jnp.where(data >= 0, data, slope * data)
     if act_type == "prelu":
@@ -581,6 +588,7 @@ def softmax_cross_entropy(data, label):
 
 @register("Dropout")
 def dropout(data, p=0.5, mode="training", axes=(), training=False, key=None):
+    """Inverted dropout; identity at inference (reference: dropout.cc)."""
     if (not training and mode != "always") or p <= 0:
         return data
     if key is None:

@@ -61,6 +61,7 @@ def clip(data, a_min, a_max):
 
 @register("Cast", aliases=("cast",))
 def cast(data, dtype):
+    """Elementwise dtype cast (reference: Cast)."""
     return data.astype(jnp.dtype(dtype))
 
 
@@ -324,6 +325,7 @@ def linalg_extracttrian(A, offset=0, lower=True):
 
 @register("Reshape", aliases=("reshape",))
 def reshape(data, shape=None, reverse=False, **_ignored):
+    """Reshape with the reference's 0/-1/-2/-3/-4 special codes (matrix_op.cc)."""
     if shape is None:
         return data
     shape = tuple(shape)
@@ -390,6 +392,7 @@ def broadcast_axis(data, axis=(), size=()):
 
 @register("Concat", aliases=("concat",))
 def concat(*args, dim=1):
+    """Concatenate along `dim` (reference: concat.cc)."""
     return jnp.concatenate(args, axis=dim)
 
 
@@ -540,6 +543,7 @@ def scatter_nd(data, indices, shape):
 @register("Embedding")
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
+    """Integer-id row gather from `weight` (reference: indexing_op.cc Embedding)."""
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 
