@@ -4,7 +4,7 @@ DigitCaps with routing-by-agreement, margin loss + masked
 reconstruction decoder).
 
 TPU notes: the reference expresses routing with tiled/broadcast NDArray
-ops per iteration on GPU (`example/capsnet/capsulelayers.py`).  Here the
+ops per iteration on GPU (`example/capsnet/capsulelayers.py:21-120`).  Here the
 prediction vectors are ONE batched matmul per forward — primary-capsule
 axis as the batch dimension of `batch_dot`, so the (P, d_in, C*d_out)
 transform rides the MXU — and the fixed 3 routing iterations unroll

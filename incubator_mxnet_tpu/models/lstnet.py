@@ -1,5 +1,5 @@
 """LSTNet multivariate time-series forecaster (reference family:
-`example/multivariate_time_series/src/lstnet.py` — Lai et al.: temporal
+`example/multivariate_time_series/src/lstnet.py:121` sym_gen — Lai et al.: temporal
 conv -> GRU + skip-GRU -> dense, plus a parallel autoregressive
 highway; electricity-consumption forecasting).
 

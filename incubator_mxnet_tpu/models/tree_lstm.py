@@ -3,7 +3,7 @@ Tai et al. Tree-LSTM on SICK semantic relatedness, with the
 ``Similarity`` regression head of `tree_lstm/main.py`).
 
 TPU notes: the reference recurses over Python tree objects node by
-node (`tree_lstm/tree_lstm.py` ChildSumLSTMCell.forward walks
+node (`tree_lstm/tree_lstm.py:22-63` ChildSumLSTMCell.forward walks
 children recursively) — host-bound, unjittable.  Here trees are
 flattened host-side to topological order (children before parents,
 slot 0 = null) and the recursion becomes ONE ``lax.scan`` over node
