@@ -3,12 +3,13 @@
 PCD on MNIST, Gibbs sampling visualization).
 
 TPU notes: the reference runs Gibbs chains as a host loop over NDArray
-ops with per-step `mx.nd.random` draws.  Here one CD-k update is a
-single fused step: the k Gibbs sweeps are a Python-unrolled (static k)
-chain of matmul + sigmoid + bernoulli draws, so XLA compiles the whole
-contrastive update into one program; the persistent chain (PCD) is
-just carried state.  CD is not a backprop gradient — updates are the
-explicit <vh>_data - <vh>_model estimator, applied directly.
+ops with per-step `mx.nd.random` draws; this implementation keeps the
+same eager NDArray formulation (each op dispatches as its own XLA
+call) with the k Gibbs sweeps statically unrolled in Python and the
+persistent chain (PCD) carried as state — simple, and fast enough for
+the CD workloads the reference example targets.  CD is not a backprop
+gradient — updates are the explicit <vh>_data - <vh>_model estimator,
+applied directly.
 """
 
 import numpy as _np
