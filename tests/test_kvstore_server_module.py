@@ -1,0 +1,40 @@
+"""kvstore_server bootstrap shim (reference: python/mxnet/kvstore_server.py
+role dispatch)."""
+
+import pytest
+
+from incubator_mxnet_tpu import kvstore_server
+
+
+def test_worker_role_falls_through(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    assert kvstore_server._init_kvstore_server_module() is None
+
+
+def test_server_role_runs_server_and_exits(monkeypatch):
+    calls = {}
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "10.0.0.5")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9191")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "3")
+    monkeypatch.setenv("MXNET_KVSTORE_MODE", "dist_async")
+    monkeypatch.setattr(kvstore_server._ds, "run_server",
+                        lambda addr, nw, sync_mode=True:
+                        calls.update(addr=addr, nw=nw, sync=sync_mode))
+    with pytest.raises(SystemExit):
+        kvstore_server._init_kvstore_server_module()
+    assert calls == {"addr": ("10.0.0.5", 9191), "nw": 3, "sync": False}
+
+
+def test_scheduler_role_runs_scheduler(monkeypatch):
+    calls = {}
+    monkeypatch.setenv("DMLC_ROLE", "scheduler")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9292")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+    monkeypatch.setattr(kvstore_server._ds, "run_scheduler",
+                        lambda port, nw, ns: calls.update(port=port, nw=nw,
+                                                          ns=ns))
+    with pytest.raises(SystemExit):
+        kvstore_server._init_kvstore_server_module()
+    assert calls == {"port": 9292, "nw": 2, "ns": 2}
