@@ -17,30 +17,20 @@ __all__ = ["KVStoreServer", "_init_kvstore_server_module"]
 
 class KVStoreServer:
     """Runs this process as a parameter-server node until shutdown
-    (reference: KVStoreServer.run — blocks serving push/pull)."""
+    (reference: KVStoreServer.run — blocks serving push/pull).  All env
+    parsing lives in ONE place: dist_server.role_main."""
 
     def __init__(self, kvstore=None):
         self.kvstore = kvstore
 
     def run(self):
-        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
-        nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-        sync = os.environ.get("MXNET_KVSTORE_MODE",
-                              "dist_sync") != "dist_async"
-        _ds.run_server((uri, port), nw, sync_mode=sync)
+        _ds.role_main()
 
 
 def _init_kvstore_server_module():
     """Reference behavior: when DMLC_ROLE says this process is a server
     (or scheduler), run that role's loop and exit; workers fall through."""
     role = os.environ.get("DMLC_ROLE", "worker")
-    if role == "server":
-        KVStoreServer().run()
-        raise SystemExit(0)
-    if role == "scheduler":
-        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
-        nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-        ns = int(os.environ.get("DMLC_NUM_SERVER", "1"))
-        _ds.run_scheduler(port, nw, ns)
+    if role in ("server", "scheduler"):
+        _ds.role_main()
         raise SystemExit(0)
