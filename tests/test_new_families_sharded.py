@@ -86,3 +86,97 @@ def test_wide_deep_trains_on_dp_mesh():
             [nd.array(wi[b]), nd.array(wv[b]), nd.array(ec[b]),
              nd.array(cont[b])], nd.array(y[b]))))
     assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
+
+
+def test_tree_lstm_trains_on_dp_mesh():
+    """The foreach/scan tree recursion must compose with pjit sharding."""
+    _needs(2)
+    from incubator_mxnet_tpu.models.tree_lstm import (ChildSumTreeLSTM,
+                                                      flatten_trees)
+    from incubator_mxnet_tpu.gluon import nn as gnn
+    rng = np.random.RandomState(2)
+    NOT, POS, NEG = 1, [2, 3], [4, 5]
+
+    def rand_tree(depth):
+        if depth == 0 or rng.rand() < 0.4:
+            if rng.rand() < 0.5:
+                return (int(rng.choice(POS)), []), 1
+            return (int(rng.choice(NEG)), []), -1
+        t, v = rand_tree(depth - 1)
+        if rng.rand() < 0.5:
+            return (NOT, [t]), -v
+        return (int(rng.choice(POS + NEG)), [t]), v
+
+    trees, labels = [], []
+    for _ in range(256):
+        t, v = rand_tree(2)
+        trees.append(t)
+        labels.append(0 if v < 0 else 1)
+    words, children, roots = flatten_trees(trees, 6, 2)
+    y = np.asarray(labels, np.int32)
+
+    class TreeClf(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.enc = ChildSumTreeLSTM(6, embed_size=8, hidden_size=8)
+                self.head = gnn.Dense(2, in_units=8)
+
+        def hybrid_forward(self, F, w, c, r):
+            return self.head(self.enc(w, c, r))
+
+    net = TreeClf()
+    net.initialize(mx.init.Xavier())
+    net(nd.array(words[:2]), nd.array(children[:2]), nd.array(roots[:2]))
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+
+    def loss(out, lab):
+        lp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+        return -jnp.take_along_axis(lp, lab[:, None], axis=-1).mean()
+
+    tr = ShardedTrainer(net, loss, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": 2e-2},
+                        data_specs=[P("dp")] * 3, label_spec=P("dp"))
+    losses = []
+    for step in range(40):
+        b = rng.randint(0, 256, 64)
+        losses.append(float(tr.step(
+            [nd.array(words[b]), nd.array(children[b]), nd.array(roots[b])],
+            nd.array(y[b]))))
+    assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
+
+
+def test_capsnet_trains_on_dp_mesh():
+    """Tuple-output forward (v_norm, caps) + margin loss under pjit."""
+    _needs(2)
+    from incubator_mxnet_tpu.models.capsnet import CapsNet
+    rng = np.random.RandomState(3)
+    n = 256
+    X = rng.rand(n, 1, 8, 8).astype(np.float32)
+    y = (X[:, 0, 2:6, 2:6].mean((1, 2)) > X[:, 0].mean((1, 2))) \
+        .astype(np.int32)
+    eye = np.eye(2, dtype=np.float32)
+
+    net = CapsNet(num_classes=2, input_size=(8, 8), conv_channels=8,
+                  kernel=3, prim_channels=4, prim_dim=4, prim_kernel=3,
+                  prim_stride=2, out_dim=4, recon_hidden=(16,),
+                  recon_size=64, use_bn=True)
+    net.initialize(mx.init.Xavier(magnitude=2))
+    net(nd.array(X[:2]))
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+
+    def loss(out, onehot):
+        v_norm, _ = out
+        pos = jax.nn.relu(0.9 - v_norm) ** 2
+        neg = jax.nn.relu(v_norm - 0.1) ** 2
+        return (onehot * pos + 0.5 * (1 - onehot) * neg).sum(-1).mean()
+
+    tr = ShardedTrainer(net, loss, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": 3e-3},
+                        data_specs=[P("dp")], label_spec=P("dp"))
+    losses = []
+    for step in range(40):
+        b = rng.randint(0, n, 64)
+        losses.append(float(tr.step([nd.array(X[b])],
+                                    nd.array(eye[y[b]]))))
+    assert losses[-1] < 0.8 * losses[0], (losses[0], losses[-1])
