@@ -250,7 +250,11 @@ def bench_lstm(steps, dtype):
                         data_specs=data_specs, label_spec=P(),
                         compute_dtype=None if dtype == "float32" else dtype)
     label = mx.nd.array(labels)
-    chunk = int(os.environ.get("BENCH_SCAN_CHUNK", "10"))
+    # tiny per-step compute (~2.5 ms): 50-step scan units amortize the
+    # tunnel dispatch gap that 10-step units leave exposed (measured
+    # 426k vs 122-175k tok/s under a slow tunnel; resnet/bert steps are
+    # long enough that 10 suffices)
+    chunk = int(os.environ.get("BENCH_SCAN_CHUNK", "50"))
     losses = tr.step_scan(data, label, chunk, per_step_batches=False)
     float(losses[-1])
     n_chunks = max(1, steps // chunk)
