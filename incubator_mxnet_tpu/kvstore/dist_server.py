@@ -463,19 +463,33 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
     return rank
 
 
+def server_main():
+    """Run THIS process as a parameter-server node from the DMLC env vars
+    (the single home of the env parsing; kvstore_server.KVStoreServer.run
+    delegates here)."""
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    sync = os.environ.get("MXNET_KVSTORE_MODE", "dist_sync") != "dist_async"
+    run_server((uri, port), nw, sync_mode=sync)
+
+
+def scheduler_main():
+    """Run THIS process as the scheduler from the DMLC env vars."""
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    ns = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+    run_scheduler(port, nw, ns)
+
+
 def role_main():
     """Entry used by tools/launch.py: role from DMLC_ROLE (reference: ps-lite
     env bootstrap — DMLC_ROLE/DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/...)."""
     role = os.environ["DMLC_ROLE"]
-    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
-    nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    ns = int(os.environ.get("DMLC_NUM_SERVER", "1"))
     if role == "scheduler":
-        run_scheduler(port, nw, ns)
+        scheduler_main()
     elif role == "server":
-        sync = os.environ.get("MXNET_KVSTORE_MODE", "dist_sync") != "dist_async"
-        run_server((uri, port), nw, sync_mode=sync)
+        server_main()
     else:
         raise SystemExit("worker role runs user code, not role_main")
 

@@ -17,20 +17,23 @@ __all__ = ["KVStoreServer", "_init_kvstore_server_module"]
 
 class KVStoreServer:
     """Runs this process as a parameter-server node until shutdown
-    (reference: KVStoreServer.run — blocks serving push/pull).  All env
-    parsing lives in ONE place: dist_server.role_main."""
+    (reference: KVStoreServer.run — blocks serving push/pull regardless
+    of DMLC_ROLE).  Env parsing lives in dist_server.server_main."""
 
     def __init__(self, kvstore=None):
         self.kvstore = kvstore
 
     def run(self):
-        _ds.role_main()
+        _ds.server_main()
 
 
 def _init_kvstore_server_module():
     """Reference behavior: when DMLC_ROLE says this process is a server
     (or scheduler), run that role's loop and exit; workers fall through."""
     role = os.environ.get("DMLC_ROLE", "worker")
-    if role in ("server", "scheduler"):
-        _ds.role_main()
+    if role == "server":
+        _ds.server_main()
+        raise SystemExit(0)
+    if role == "scheduler":
+        _ds.scheduler_main()
         raise SystemExit(0)

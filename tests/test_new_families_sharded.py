@@ -149,7 +149,8 @@ def test_tree_lstm_trains_on_dp_mesh():
 def test_capsnet_trains_on_dp_mesh():
     """Tuple-output forward (v_norm, caps) + margin loss under pjit."""
     _needs(2)
-    from incubator_mxnet_tpu.models.capsnet import CapsNet
+    from incubator_mxnet_tpu.models.capsnet import (CapsNet,
+                                                     margin_loss)
     rng = np.random.RandomState(3)
     n = 256
     X = rng.rand(n, 1, 8, 8).astype(np.float32)
@@ -167,9 +168,7 @@ def test_capsnet_trains_on_dp_mesh():
 
     def loss(out, onehot):
         v_norm, _ = out
-        pos = jax.nn.relu(0.9 - v_norm) ** 2
-        neg = jax.nn.relu(v_norm - 0.1) ** 2
-        return (onehot * pos + 0.5 * (1 - onehot) * neg).sum(-1).mean()
+        return margin_loss(jax.nn, v_norm, onehot).mean()
 
     tr = ShardedTrainer(net, loss, mesh, optimizer="adam",
                         optimizer_params={"learning_rate": 3e-3},
