@@ -11,18 +11,35 @@ __all__ = ["AttrScope", "current"]
 class AttrScope:
     """``with AttrScope(k=v, ...):`` — symbols created inside pick up the
     attributes; nesting merges, inner scopes win on conflicts.  Scope
-    objects are reusable and re-entrant: entry/exit keeps a stack, and
-    the constructor kwargs are never mutated."""
+    objects are reusable, re-entrant AND thread-safe: all merged state
+    lives on a per-thread stack (the scope instance itself is immutable
+    after construction, so entering the same object concurrently from two
+    threads cannot corrupt either thread's view)."""
 
-    _current = threading.local()
+    _tls = threading.local()    # .stack = [(scope, merged_dict), ...]
 
     def __init__(self, **kwargs):
         for v in kwargs.values():
             if not isinstance(v, str):
                 raise ValueError("attributes must be strings, got %r" % (v,))
         self._base_attr = dict(kwargs)   # immutable constructor attrs
-        self._attr = dict(kwargs)        # effective (merged) view when active
-        self._saved = []                 # (outer current, prior _attr) stack
+
+    @staticmethod
+    def _stack():
+        st = getattr(AttrScope._tls, "stack", None)
+        if st is None:
+            st = AttrScope._tls.stack = []
+        return st
+
+    @property
+    def _attr(self):
+        """Effective merged attribute view for THIS thread: the merged
+        dict when this scope is the thread's innermost active scope,
+        otherwise the constructor attrs."""
+        st = AttrScope._stack()
+        if st and st[-1][0] is self:
+            return st[-1][1]
+        return self._base_attr
 
     def get(self, attr=None):
         """Merge scope attributes under explicit ones.
@@ -39,21 +56,19 @@ class AttrScope:
         return out
 
     def __enter__(self):
-        outer = current()
-        self._saved.append((outer, self._attr))
-        merged = dict(outer._attr)
+        st = AttrScope._stack()
+        merged = dict(st[-1][1]) if st else {}
         merged.update(self._base_attr)   # always merge from the base attrs
-        self._attr = merged
-        AttrScope._current.value = self
+        st.append((self, merged))
         return self
 
     def __exit__(self, ptype, value, trace):
-        outer, prior = self._saved.pop()
-        self._attr = prior
-        AttrScope._current.value = outer
+        AttrScope._stack().pop()
+
+
+_DEFAULT = AttrScope()
 
 
 def current():
-    if not hasattr(AttrScope._current, "value"):
-        AttrScope._current.value = AttrScope()
-    return AttrScope._current.value
+    st = AttrScope._stack()
+    return st[-1][0] if st else _DEFAULT

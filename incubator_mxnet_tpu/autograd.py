@@ -125,6 +125,26 @@ class TapeNode:
         #                                 cotangents move here before the vjp
 
 
+_capture_tls = threading.local()   # .depth > 0 while a placement-aware
+#                                    Executor evaluates ON THIS THREAD
+#                                    (per-thread: concurrent evals in other
+#                                    threads cannot flip capture mid-record)
+
+
+class _DeviceCapture:
+    """Enable per-op forward-device capture on the tape. Only group2ctx
+    placement needs node.device (cotangent re-alignment); the single-device
+    hot path skips the .devices() probe entirely."""
+
+    def __enter__(self):
+        _capture_tls.depth = getattr(_capture_tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _capture_tls.depth -= 1
+        return False
+
+
 def record_op(fn, arrays, op_name=""):
     """Execute ``fn(*vals)`` (vals = unwrapped jax arrays), recording a tape
     node if recording is active. Returns (outputs_tuple, node_or_None).
@@ -139,11 +159,13 @@ def record_op(fn, arrays, op_name=""):
     out, vjp_fn = jax.vjp(fn, *vals)
     outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
     templates = [(o.shape, o.dtype) for o in outs]
-    try:                       # committed forward device, for multi-device
-        devs = outs[0].devices()       # graphs (group2ctx); tracers have none
-        dev = next(iter(devs)) if len(devs) == 1 else None
-    except Exception:
-        dev = None
+    dev = None
+    if getattr(_capture_tls, "depth", 0):
+        try:                   # committed forward device, for multi-device
+            devs = outs[0].devices()   # graphs (group2ctx); tracers have none
+            dev = next(iter(devs)) if len(devs) == 1 else None
+        except Exception:
+            dev = None
     node = TapeNode(list(arrays), vjp_fn, len(outs), templates, op_name,
                     fn=fn, device=dev)
     return outs, node

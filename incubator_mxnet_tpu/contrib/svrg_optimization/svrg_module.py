@@ -53,7 +53,10 @@ class SVRGModule(Module):
         for i, name in enumerate(self._symbol.list_arguments()):
             if name in accum:
                 from ...ndarray import array
-                opt.full_grads[i] = array(accum[name] / max(nbatch, 1))
+                # keyed by BOTH the raw argument index (the kv-free
+                # updater's key) and the name (the kvstore path's key)
+                opt.full_grads[i] = opt.full_grads[name] = \
+                    array(accum[name] / max(nbatch, 1))
         # snapshot current weights for per-batch snapshot gradients
         self._snapshot_params = {n: NDArray(a._data)
                                  for n, a in self._exec.arg_dict.items()}
@@ -69,7 +72,8 @@ class SVRGModule(Module):
         for i, name in enumerate(self._symbol.list_arguments()):
             g = self._exec.grad_dict.get(name)
             if g is not None:
-                opt.snapshot_grads[i] = NDArray(g._data)
+                opt.snapshot_grads[i] = opt.snapshot_grads[name] = \
+                    NDArray(g._data)
         for n, a in self._exec.arg_dict.items():
             a._data = current[n]._data
 

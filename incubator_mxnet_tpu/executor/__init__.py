@@ -74,7 +74,9 @@ class Executor:
         """Bind-time placement (reference: GraphExecutor assigns each arg
         to its consumer group's device): every arg consumed exclusively
         by ops of ONE mapped ctx_group moves there once, so forward never
-        re-transfers parameters."""
+        re-transfers parameters. The arg-name -> ctx map is computed ONCE
+        here (a full topo walk); per-forward re-assertion only runs the
+        cheap device check over the cached map."""
         consumers = {}                   # arg name -> set of group names
         for n in self._symbol._topo():
             if n._op is None or n._op == "_group":
@@ -83,12 +85,21 @@ class Executor:
             for i in n._inputs:
                 if i._op is None:
                     consumers.setdefault(i._name, set()).add(grp)
+        self._arg_placement = {}         # arg name -> Context
         for name, groups in consumers.items():
             if len(groups) != 1:
                 continue
             ctx = self._group2ctx.get(next(iter(groups)))
             if ctx is None:
                 continue
+            self._arg_placement[name] = ctx
+        self._assert_arg_residency()
+
+    def _assert_arg_residency(self):
+        """Move any arg/aux/grad array whose device drifted (init_params /
+        set_params overwrite on the default device) back to its cached
+        placement — a no-op device check in the steady state."""
+        for name, ctx in self._arg_placement.items():
             for store in (self.arg_dict, self.aux_dict, self.grad_dict):
                 arr = store.get(name)
                 if arr is not None and \
@@ -104,10 +115,7 @@ class Executor:
         feed.update(self.aux_dict)
         placement = self._group2ctx or None
         if placement:
-            # re-assert residency: init_params / set_params overwrite
-            # arrays on the default device; this is a no-op device check
-            # when everything already lives where it belongs
-            self._place_args_by_group()
+            self._assert_arg_residency()
         if is_train:
             with _ag.record():
                 out = executor_eval(self._symbol, feed, placement=placement)

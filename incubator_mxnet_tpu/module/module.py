@@ -125,29 +125,72 @@ class Module(BaseModule):
                 initializer(_initmod.InitDesc(name), arr)
         self.params_initialized = True
 
+    def _resolve_kvstore(self, kvstore):
+        """Reference _create_kvstore semantics on one device: non-dist
+        string stores collapse to pure-local updates (single device needs
+        no aggregation); dist strings open the PS connection; KVStore
+        OBJECTS are used as given (the test/multi-process path)."""
+        if not kvstore:
+            return None
+        if isinstance(kvstore, str):
+            if "dist" not in kvstore:
+                return None
+            from .. import kvstore as _kvs
+            return _kvs.create(kvstore)
+        return kvstore
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         if self.optimizer_initialized and not force_init:
             return
         assert self.binded and self.params_initialized
+        kv = self._resolve_kvstore(kvstore)
+        # under dist_sync the server sums every worker's push, so the
+        # reference scales the normalization denominator by num_workers
+        batch = self._batch_size
+        if batch:
+            kv_type = getattr(kv, "type", "")
+            if "dist" in kv_type and "_sync" in kv_type:
+                batch *= getattr(kv, "num_workers", 1)
         if isinstance(optimizer, str):
             params = dict(optimizer_params)
             # loss-layer backwards (SoftmaxOutput etc.) emit SUM-over-batch
             # gradients; the reference normalizes in the optimizer
-            # (module.py init_optimizer: rescale_grad = 1/batch_size)
-            if "rescale_grad" not in params and self._batch_size:
-                params["rescale_grad"] = 1.0 / self._batch_size
+            # (module.py init_optimizer: rescale_grad = 1/batch_size x
+            # 1/num_workers under dist_sync)
+            if "rescale_grad" not in params and batch:
+                params["rescale_grad"] = 1.0 / batch
             optimizer = opt.create(optimizer, **params)
-        elif self._batch_size and abs(
-                getattr(optimizer, "rescale_grad", 0.0)
-                - 1.0 / self._batch_size) > 1e-12:
+        elif batch and abs(getattr(optimizer, "rescale_grad", 0.0)
+                           - 1.0 / batch) > 1e-12:
             self.logger.warning(
-                "optimizer instance has rescale_grad=%s with batch size %d;"
-                " set rescale_grad=1/batch for reference-equivalent updates",
-                getattr(optimizer, "rescale_grad", None), self._batch_size)
+                "optimizer instance has rescale_grad=%s with effective "
+                "batch size %d; set rescale_grad=1/batch (x1/num_workers "
+                "under dist_sync) for reference-equivalent updates",
+                getattr(optimizer, "rescale_grad", None), batch)
         self._optimizer = optimizer
-        self._updater = opt.get_updater(optimizer)
+        self._kvstore = kv
+        import os as _os
+        self._update_on_kvstore = kv is not None and \
+            _os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1") == "1"
+        self._updater = None if self._update_on_kvstore \
+            else opt.get_updater(optimizer)
+        if kv is not None:
+            # parameter-NAME keys (the reference's string key scheme):
+            # two Modules sharing one store (SequentialModule) cannot
+            # collide the way compacted integer keys would
+            self._kv_names = []
+            for name in self._symbol.list_arguments():
+                if name in self._data_names or name in self._label_names \
+                        or name in self._fixed_param_names:
+                    continue
+                if self._exec.grad_dict.get(name) is None:
+                    continue
+                self._kv_names.append(name)
+                kv.init(name, self._exec.arg_dict[name])
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
@@ -168,6 +211,21 @@ class Module(BaseModule):
 
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        if self._kvstore is not None:
+            # reference _update_params[_on_kvstore]: push grads; pull the
+            # updated weight (server-side optimizer) or the aggregated
+            # grad for the local updater
+            for name in self._kv_names:
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._kvstore.push(name, grad)
+                if self._update_on_kvstore:
+                    self._kvstore.pull(name, out=self._exec.arg_dict[name])
+                else:
+                    self._kvstore.pull(name, out=grad)
+                    self._updater(name, grad, self._exec.arg_dict[name])
+            return
         for i, name in enumerate(self._symbol.list_arguments()):
             if name in self._data_names or name in self._label_names or \
                     name in self._fixed_param_names:

@@ -743,6 +743,14 @@ def save(fname, data):
         arrays = {}
         for k, v in data.items():
             tag, a = _to_npz(v)
+            if not tag and k.endswith(_BF16_TAG):
+                # load() would strip the suffix and bit-cast the value to
+                # bfloat16 — reject rather than corrupt. (A bf16 value
+                # with such a key is fine: load strips exactly one tag.)
+                raise ValueError(
+                    "key %r ends with the reserved %r suffix but its value "
+                    "is %s, not bfloat16 — rename the key" %
+                    (k, _BF16_TAG, a.dtype))
             arrays[k + tag] = a
         _np.savez(fname, __mxtpu_format__="dict", **arrays)
     else:

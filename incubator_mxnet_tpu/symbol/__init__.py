@@ -677,8 +677,13 @@ def _eval_symbol(sym, feed, wrap=True, placement=None):
     op nodes carrying a matching ``__ctx_group__`` attr run on that
     device, with tape-aware transfers at group boundaries."""
     from .. import ndarray as nd
+    from .. import autograd as _ag
     import contextlib
     import jax as _jax
+
+    # placement-aware evaluation records forward devices on the tape so
+    # backward can re-align cotangents; the plain path skips the probe
+    cap_cm = _ag._DeviceCapture() if placement else contextlib.nullcontext()
 
     results = {}  # id(node) -> tuple of outputs
     moved = {}    # (id(producer), out_index, ctx id) -> transferred value
@@ -690,38 +695,43 @@ def _eval_symbol(sym, feed, wrap=True, placement=None):
         return moved[key]
 
     nodes = sym._topo()
-    for n in nodes:
-        if n._op is None:
-            if n._name not in feed:
-                raise ValueError("Missing input %r for symbolic evaluation" % n._name)
-            results[id(n)] = (feed[n._name],)
-        elif n._op == "_group":
-            continue
-        else:
-            attrs = {k: v for k, v in n._attrs.items() if not k.startswith("__")}
-            kw_inputs = n._attrs.get("__kwarg_inputs__", [])
-            in_vals = [results[id(i)][i._out_index or 0] for i in n._inputs]
-            tgt = None
-            if placement:
-                grp = n._attrs.get("__ctx_group__")
-                tgt = placement.get(grp) if grp else None
-            if tgt is not None and wrap:
-                in_vals = [to_ctx_cached(i, v, tgt)
-                           for i, v in zip(n._inputs, in_vals)]
-            kw = {}
-            for (k, pos) in kw_inputs:
-                kw[k] = in_vals[pos]
-            pos_vals = [v for j, v in enumerate(in_vals)
-                        if j not in [p for _, p in kw_inputs]]
-            dev_cm = (_jax.default_device(tgt.jax_device)
-                      if tgt is not None else contextlib.nullcontext())
-            with dev_cm:
-                if wrap:
-                    from ..ndarray.ndarray import _invoke_op
-                    out = _invoke_op(n._op, tuple(pos_vals), {**attrs, **kw})
-                else:
-                    out = get_op(n._op).fn(*pos_vals, **{**attrs, **kw})
-            results[id(n)] = out if isinstance(out, tuple) else (out,)
+    with cap_cm:
+        for n in nodes:
+            if n._op is None:
+                if n._name not in feed:
+                    raise ValueError(
+                        "Missing input %r for symbolic evaluation" % n._name)
+                results[id(n)] = (feed[n._name],)
+            elif n._op == "_group":
+                continue
+            else:
+                attrs = {k: v for k, v in n._attrs.items()
+                         if not k.startswith("__")}
+                kw_inputs = n._attrs.get("__kwarg_inputs__", [])
+                in_vals = [results[id(i)][i._out_index or 0]
+                           for i in n._inputs]
+                tgt = None
+                if placement:
+                    grp = n._attrs.get("__ctx_group__")
+                    tgt = placement.get(grp) if grp else None
+                if tgt is not None and wrap:
+                    in_vals = [to_ctx_cached(i, v, tgt)
+                               for i, v in zip(n._inputs, in_vals)]
+                kw = {}
+                for (k, pos) in kw_inputs:
+                    kw[k] = in_vals[pos]
+                pos_vals = [v for j, v in enumerate(in_vals)
+                            if j not in [p for _, p in kw_inputs]]
+                dev_cm = (_jax.default_device(tgt.jax_device)
+                          if tgt is not None else contextlib.nullcontext())
+                with dev_cm:
+                    if wrap:
+                        from ..ndarray.ndarray import _invoke_op
+                        out = _invoke_op(n._op, tuple(pos_vals),
+                                         {**attrs, **kw})
+                    else:
+                        out = get_op(n._op).fn(*pos_vals, **{**attrs, **kw})
+                results[id(n)] = out if isinstance(out, tuple) else (out,)
 
     if sym._op == "_group":
         return [results[id(s)][s._out_index or 0] for s in sym._inputs]

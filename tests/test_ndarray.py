@@ -182,3 +182,52 @@ def test_waitall_and_wait_to_read():
     b.wait_to_read()
     nd.waitall()
     assert b.asnumpy()[0, 0] == 2
+
+
+def test_save_rejects_reserved_bf16_key_suffix(tmp_path):
+    """A non-bf16 value whose key naturally ends with the '::bf16' wire tag
+    must be rejected at save time — load() would otherwise truncate the key
+    and bit-cast the value (ADVICE r4). A bf16 value under such a key still
+    round-trips (load strips exactly one tag)."""
+    import pytest
+    f = str(tmp_path / "x.npz")
+    with pytest.raises(ValueError):
+        nd.save(f, {"scale::bf16": nd.ones((2,))})
+    bf = nd.ones((3,)).astype("bfloat16")
+    nd.save(f, {"w::bf16": bf})
+    back = nd.load(f)
+    assert list(back) == ["w::bf16"]
+    assert str(back["w::bf16"].dtype) == "bfloat16"
+
+
+def test_attr_scope_thread_isolation():
+    """Entering the SAME AttrScope object concurrently from two threads
+    keeps each thread's merged view isolated (ADVICE r4: merged state
+    lives on a per-thread stack, not the instance)."""
+    import threading
+    import incubator_mxnet_tpu as mx
+
+    shared = mx.AttrScope(ctx_group="g0")
+    errs = []
+    barrier = threading.Barrier(2, timeout=10)
+
+    def worker(extra_key, extra_val):
+        try:
+            with mx.AttrScope(**{extra_key: extra_val}):
+                with shared:
+                    barrier.wait()   # both threads inside `shared` now
+                    from incubator_mxnet_tpu import attribute
+                    view = attribute.current().get()
+                    assert view["__ctx_group__"] == "g0"
+                    assert view["__%s__" % extra_key] == extra_val
+                    other = ("lr_mult" if extra_key == "wd_mult"
+                             else "wd_mult")
+                    assert ("__%s__" % other) not in view
+                    barrier.wait()
+        except Exception as e:       # pragma: no cover
+            errs.append(e)
+
+    t1 = threading.Thread(target=worker, args=("lr_mult", "2.0"))
+    t2 = threading.Thread(target=worker, args=("wd_mult", "0.5"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert not errs, errs
