@@ -29,6 +29,129 @@ import time
 import numpy as np
 
 
+# --------------------------------------------------------------------------
+# measurement discipline (VERDICT r4 #2): every metric is the MEDIAN of
+# BENCH_REPEATS (>=3) timed windows and its JSON line carries the spread;
+# a tunnel-health preflight runs first so a degraded chip/tunnel day is
+# DETECTED at measurement time, not discovered post-hoc.
+# --------------------------------------------------------------------------
+
+def _timed_rate(run, units, repeats=None):
+    """Run the timed window `run()` (must block until all device work is
+    done, e.g. by a host transfer of the final loss) `repeats` times;
+    return units/sec stats: median + min/max + spread."""
+    n = repeats if repeats is not None else max(
+        1, int(os.environ.get("BENCH_REPEATS", "3")))
+    rates = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        run()
+        rates.append(units / (time.perf_counter() - t0))
+    rates.sort()
+    med = rates[n // 2] if n % 2 else 0.5 * (rates[n // 2 - 1]
+                                             + rates[n // 2])
+    return {"value": med, "repeats": n, "min": rates[0], "max": rates[-1],
+            "spread_pct": round(100.0 * (rates[-1] - rates[0]) / med, 1)}
+
+
+def _emit(metric, unit, stats, baseline=None, baseline_desc=None, **extra):
+    """One JSON line per metric: median value + repeat/spread fields, and
+    an explicit statement of WHAT vs_baseline divides by (r4 weak #6:
+    unit-tagged denominators, no silent apples-to-oranges)."""
+    line = {"metric": metric, "value": round(stats["value"], 2),
+            "unit": unit}
+    if baseline:
+        line["vs_baseline"] = round(stats["value"] / baseline, 2)
+        if baseline_desc:
+            line["baseline_desc"] = baseline_desc
+    line.update({"repeats": stats["repeats"],
+                 "min": round(stats["min"], 2),
+                 "max": round(stats["max"], 2),
+                 "spread_pct": stats["spread_pct"]})
+    line.update(extra)
+    print(json.dumps(line))
+    return line
+
+
+# healthy-session calibrations for this part through this tunnel
+# (BENCHMARKS.md): a long 4096^3 bf16 matmul chain sustains ~149-166
+# TFLOP/s (84% of v5e peak), and a tiny jitted call syncs in ~9 ms.
+# The preflight measures BOTH — chip compute health and tunnel dispatch
+# health — because they fail independently (r4's SSD 59.6-vs-12.9 swing
+# was a dispatch-condition change, invisible to any compute probe).
+_PREFLIGHT_NOMINAL_TFLOPS = 166.0
+_PREFLIGHT_TFLOPS_FLOOR = 120.0
+_PREFLIGHT_NOMINAL_RTT_MS = 9.0
+_PREFLIGHT_RTT_CEIL_MS = 30.0
+
+
+def preflight(quiet=False):
+    """Tunnel/chip health gate, two JSON lines:
+
+    1. sustained bf16 matmul TFLOP/s (4096^3 chain of 512, scalar-out
+       sync) — the MXU/compute health number;
+    2. dispatch round-trip ms (tiny jitted call + host transfer, median
+       of 10) — the tunnel-latency health number. Scan-unit benches
+       amortize this, but a degraded tunnel day is DETECTED here rather
+       than discovered post-hoc in a model row.
+    Each line carries degraded=true when outside its healthy band.
+    Returns None on CPU-only sessions. BENCH_PREFLIGHT=0 skips."""
+    if os.environ.get("BENCH_PREFLIGHT", "1") != "1":
+        return None
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return None
+    n, chain = 4096, 512
+    key = jax.random.PRNGKey(0)
+    a = jax.device_put(jax.random.normal(key, (n, n), jnp.bfloat16) * 0.01,
+                       dev)
+
+    @jax.jit
+    def matmul_chain(x):
+        def body(i, y):
+            return y @ a
+        return jax.lax.fori_loop(0, chain, body, x).sum()
+
+    float(matmul_chain(a))                   # compile + sync
+    flops = 2.0 * n * n * n * chain
+
+    def run():
+        float(matmul_chain(a))
+
+    stats = _timed_rate(run, flops / 1e12, repeats=3)
+    _emit("tunnel_preflight_matmul_tflops",
+          "TFLOP/s sustained, 512x 4096^3 bf16 chain (healthy %.0f; "
+          "DEGRADED below %.0f)" % (_PREFLIGHT_NOMINAL_TFLOPS,
+                                    _PREFLIGHT_TFLOPS_FLOOR),
+          stats, baseline=_PREFLIGHT_NOMINAL_TFLOPS,
+          baseline_desc="healthy-session matmul calibration on this part",
+          degraded=bool(stats["value"] < _PREFLIGHT_TFLOPS_FLOOR))
+
+    tiny = jax.device_put(jnp.float32(1.0), dev)
+    bump = jax.jit(lambda v: v + 1.0)
+    float(bump(tiny))
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        float(bump(tiny))
+        rtts.append((time.perf_counter() - t0) * 1e3)
+    rtts.sort()
+    rtt = {"value": rtts[len(rtts) // 2], "repeats": len(rtts),
+           "min": rtts[0], "max": rtts[-1],
+           "spread_pct": round(100.0 * (rtts[-1] - rtts[0])
+                               / max(rtts[len(rtts) // 2], 1e-9), 1)}
+    return _emit(
+        "tunnel_preflight_dispatch_rtt_ms",
+        "ms per tiny jitted call + host sync, median of 10 (healthy ~%.0f;"
+        " DEGRADED above %.0f)" % (_PREFLIGHT_NOMINAL_RTT_MS,
+                                   _PREFLIGHT_RTT_CEIL_MS),
+        rtt, baseline=_PREFLIGHT_NOMINAL_RTT_MS,
+        baseline_desc="healthy-session dispatch round-trip on this tunnel",
+        degraded=bool(rtt["value"] > _PREFLIGHT_RTT_CEIL_MS))
+
+
 def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
     """BERT-base PRETRAIN throughput, tokens/sec/chip (BASELINE config 4).
     Runs the complete objective: MLM cross-entropy on masked positions
@@ -115,19 +238,23 @@ def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
     losses = tr.step_scan(data, label, chunk, per_step_batches=False)
     float(losses[-1])                        # compile + sync
     n_chunks = max(1, steps // chunk)
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        losses = tr.step_scan(data, label, chunk, per_step_batches=False)
-    final = float(losses[-1])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final)
-    tps = B * T * n_chunks * chunk / dt
-    print(json.dumps({
-        "metric": metric or "bert_base_pretrain_tokens_per_sec_per_chip",
-        "value": round(tps, 2),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(tps / (baseline or 47000.0), 2),
-    }))
+
+    def run():
+        for _ in range(n_chunks):
+            losses = tr.step_scan(data, label, chunk,
+                                  per_step_batches=False)
+        assert np.isfinite(float(losses[-1]))
+
+    stats = _timed_rate(run, B * T * n_chunks * chunk)
+    if metric:          # bert_long: vs the XLA dense-attention arm
+        bdesc = ("XLA dense-einsum attention at the identical config "
+                 "(MXTPU_DISABLE_FLASH=1), same chip")
+    else:
+        bdesc = ("this repo's own r1 fp32 encoder-only first light "
+                 "(47k tok/s; r1 omitted the MLM head, this row does not)")
+    _emit(metric or "bert_base_pretrain_tokens_per_sec_per_chip",
+          "tokens/sec/chip", stats, baseline=baseline or 47000.0,
+          baseline_desc=bdesc)
 
 
 def bench_lstm(steps, dtype):
@@ -258,23 +385,31 @@ def bench_lstm(steps, dtype):
     losses = tr.step_scan(data, label, chunk, per_step_batches=False)
     float(losses[-1])
     n_chunks = max(1, steps // chunk)
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        losses = tr.step_scan(data, label, chunk, per_step_batches=False)
-    final = float(losses[-1])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final)
-    tps = B * T * n_chunks * chunk / dt
-    default_base = tps if unrolled else 266366.0
-    base = float(os.environ.get("BENCH_LSTM_AB_BASELINE", "0")) \
-        or default_base
-    print(json.dumps({
-        "metric": "lstm_lm_%s_tokens_per_sec_per_chip"
-                  % ("unrolled" if unrolled else "train"),
-        "value": round(tps, 2),
-        "unit": "tokens/sec/chip (word LM 650x2 bptt %d)" % T,
-        "vs_baseline": round(tps / base, 2),
-    }))
+
+    def run():
+        for _ in range(n_chunks):
+            losses = tr.step_scan(data, label, chunk,
+                                  per_step_batches=False)
+        assert np.isfinite(float(losses[-1]))
+
+    stats = _timed_rate(run, B * T * n_chunks * chunk)
+    env_base = float(os.environ.get("BENCH_LSTM_AB_BASELINE", "0"))
+    if unrolled:
+        base, bdesc = stats["value"], "self (this IS the unrolled arm)"
+    elif env_base:
+        base = env_base
+        bdesc = ("unrolled-arm rate supplied via BENCH_LSTM_AB_BASELINE "
+                 "(same-session A/B)")
+    else:
+        base = 266366.0
+        bdesc = ("HISTORICAL unrolled-arm rate (266,366 tok/s, r4 "
+                 "measurement on this part) — re-measure with "
+                 "BENCH_LSTM_UNROLL=1 and pass BENCH_LSTM_AB_BASELINE "
+                 "for a same-session A/B")
+    _emit("lstm_lm_%s_tokens_per_sec_per_chip"
+          % ("unrolled" if unrolled else "train"),
+          "tokens/sec/chip (word LM 650x2 bptt %d)" % T, stats,
+          baseline=base, baseline_desc=bdesc)
 
 
 def bench_consistency():
@@ -367,24 +502,43 @@ def bench_ssd(steps, dtype):
                                           "momentum": 0.9},
                         data_specs=P(), label_spec=P(),
                         compute_dtype=None if dtype == "float32" else dtype)
+    # roofline accounting (r4 weak #2: the SSD row had none): XLA cost
+    # analysis of the compiled single train step -> GF + GB per step,
+    # bounds on v5e (197 bf16 TFLOP/s, 819 GB/s), MFU at the measured rate
+    roofline = {}
+    try:
+        ca = tr.lowered(X, Y).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        gf = float(ca.get("flops", 0.0)) / 1e9
+        gb = float(ca.get("bytes accessed", 0.0)) / 1e9
+        if gf > 0:
+            roofline = {"gflops_per_step": round(gf, 1),
+                        "gb_per_step": round(gb, 2),
+                        "compute_bound_ms": round(gf / 197.0, 2),
+                        "hbm_bound_ms": round(gb / 819.0 * 1000.0, 2)}
+    except Exception:
+        pass
     chunk = int(os.environ.get("BENCH_SCAN_CHUNK", "5"))
     losses = tr.step_scan(X, Y, chunk, per_step_batches=False)
     float(losses[-1])
     n_chunks = max(1, steps // chunk)
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        losses = tr.step_scan(X, Y, chunk, per_step_batches=False)
-    final = float(losses[-1])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final)
-    rate = B * n_chunks * chunk / dt
+
+    def run():
+        for _ in range(n_chunks):
+            losses = tr.step_scan(X, Y, chunk, per_step_batches=False)
+        assert np.isfinite(float(losses[-1]))
+
+    stats = _timed_rate(run, B * n_chunks * chunk)
+    if roofline and roofline.get("gflops_per_step"):
+        roofline["mfu_pct"] = round(
+            100.0 * roofline["gflops_per_step"] * stats["value"]
+            / B / 197000.0, 1)
     base = float(os.environ.get("BENCH_SSD_BASELINE", "25.0"))
-    print(json.dumps({
-        "metric": "ssd512_resnet50_train_imgs_per_sec_per_chip",
-        "value": round(rate, 2),
-        "unit": "imgs/sec/chip (%dx%d, bs %d)" % (size, size, B),
-        "vs_baseline": round(rate / base, 2),
-    }))
+    _emit("ssd512_resnet50_train_imgs_per_sec_per_chip",
+          "imgs/sec/chip (%dx%d, bs %d)" % (size, size, B), stats,
+          baseline=base,
+          baseline_desc="reference-era SSD-512 single-GPU TRAINING figure "
+          "(~25 imgs/s, GTX1080-class)", **roofline)
 
 
 def bench_int8():
@@ -439,18 +593,21 @@ def bench_int8():
     def rate(fn, params, x):
         out = fn(params, x)
         out.block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(params, x)
-        out.block_until_ready()
-        return B * steps / (time.perf_counter() - t0), out
+
+        def run():
+            o = None
+            for _ in range(steps):
+                o = fn(params, x)
+            o.block_until_ready()
+
+        return _timed_rate(run, B * steps), out
 
     dev = jax.devices()[0]
     x = jax.device_put(jnp.asarray(x_np), dev)
 
     net_f = build()
     fn32, p32 = jit_forward(net_f)
-    r32, out32 = rate(fn32, p32, x)
+    r32, out32 = rate(fn32, p32, x)           # stats dicts (median rate)
     fn16, p16 = jit_forward(net_f, cast=jnp.bfloat16)
     r16, out16 = rate(fn16, p16, x.astype(jnp.bfloat16))
 
@@ -465,13 +622,12 @@ def bench_int8():
     o8 = np.asarray(out8, np.float32)
     agree = float((o32.argmax(-1) == o8.argmax(-1)).mean())
     err = float(np.abs(o8 - o32).max() / (np.abs(o32).max() + 1e-9))
-    print(json.dumps({
-        "metric": "resnet50_int8_infer_imgs_per_sec_per_chip",
-        "value": round(r8, 2),
-        "unit": "imgs/sec (fp32 %.0f, bf16 %.0f; top1 agree %.3f, "
-                "rel logit err %.4f)" % (r32, r16, agree, err),
-        "vs_baseline": round(r8 / r16, 2),
-    }))
+    _emit("resnet50_int8_infer_imgs_per_sec_per_chip",
+          "imgs/sec (fp32 %.0f, bf16 %.0f; top1 agree %.3f, "
+          "rel logit err %.4f)" % (r32["value"], r16["value"], agree, err),
+          r8, baseline=r16["value"],
+          baseline_desc="the bf16 inference arm measured in this run "
+          "(fastest path on v5e through XLA)")
 
 
 def bench_pipeline_fed(dtype):
@@ -591,19 +747,19 @@ def _bench_pipeline_fed(dtype, tmp):
             float(jax.device_get(losses[-1]))
         return n
 
-    run_epochs(1)       # warm + compile the K-step program
-    t0 = time.perf_counter()
-    n = run_epochs(3)
-    fed_rate = n / (time.perf_counter() - t0)
+    n_per_epoch = run_epochs(1)       # warm + compile the K-step program
 
+    def run():
+        run_epochs(1)
+
+    stats = _timed_rate(run, n_per_epoch)
     bound = min(pipe_rate, train_rate)
-    print(json.dumps({
-        "metric": "resnet50_native_pipeline_fed_imgs_per_sec",
-        "value": round(fed_rate, 2),
-        "unit": "imgs/sec (feed-chain %.0f, train %.0f)" % (pipe_rate,
-                                                            train_rate),
-        "vs_baseline": round(fed_rate / bound, 3),
-    }))
+    _emit("resnet50_native_pipeline_fed_imgs_per_sec",
+          "imgs/sec (feed-chain %.0f, train %.0f)" % (pipe_rate,
+                                                      train_rate),
+          stats, baseline=bound,
+          baseline_desc="the binding resource alone (min of feed-chain "
+          "and train-alone rates measured in this run)")
 
 
 def bench_resnet50(batch, steps, dtype):
@@ -638,21 +794,20 @@ def bench_resnet50(batch, steps, dtype):
     float(losses[-1])   # full sync
 
     n_chunks = max(1, steps // chunk)
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        losses = trainer.step_scan(data, label, chunk, per_step_batches=False)
-    final = float(losses[-1])   # host transfer: waits for the whole queue
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final), "training diverged: loss=%r" % final
-    imgs_per_sec = batch * n_chunks * chunk / dt
 
-    baseline = 109.0
-    print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_per_chip",
-        "value": round(imgs_per_sec, 2),
-        "unit": "imgs/sec/chip",
-        "vs_baseline": round(imgs_per_sec / baseline, 2),
-    }))
+    def run():
+        for _ in range(n_chunks):
+            losses = trainer.step_scan(data, label, chunk,
+                                       per_step_batches=False)
+        final = float(losses[-1])   # host transfer: drains the queue
+        assert np.isfinite(final), "training diverged: loss=%r" % final
+
+    stats = _timed_rate(run, batch * n_chunks * chunk)
+    _emit("resnet50_train_imgs_per_sec_per_chip", "imgs/sec/chip", stats,
+          baseline=109.0,
+          baseline_desc="reference resnet-50 single-GPU INFERENCE figure "
+          "(example/image-classification/README.md:149-155); this row "
+          "measures TRAINING fwd+bwd+SGD")
 
 
 def main():
@@ -660,6 +815,7 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "100"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     model = os.environ.get("BENCH_MODEL", "all")
+    preflight()          # tunnel-health gate, its own JSON line (first)
     if model == "resnet50":
         return bench_resnet50(batch, steps, dtype)
     if model == "bert":

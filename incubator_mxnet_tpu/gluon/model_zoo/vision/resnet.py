@@ -39,9 +39,15 @@ class _ResidualUnit(HybridBlock):
     downsample path branches from the ACTIVATED input)."""
 
     def __init__(self, kind, channels, stride, downsample=False,
-                 in_channels=0, preact=False, **kwargs):
+                 in_channels=0, preact=False, remat=False,
+                 remat_policy="full", **kwargs):
         super().__init__(**kwargs)
         self._preact = preact
+        # rematerialize this unit in the backward: trades MXU recompute
+        # (4x under the bandwidth bound on v5e at bs 128 — BENCHMARKS.md
+        # roofline) for the unit's internal activation HBM traffic
+        self._remat = bool(remat)
+        self._remat_policy = remat_policy
         plan = _conv_plan(kind, channels, stride, preact)
         self.body = nn.HybridSequential(prefix="")
         for i, (c, k, s, p, bias) in enumerate(plan):
@@ -66,6 +72,15 @@ class _ResidualUnit(HybridBlock):
                 nn.BatchNorm())
 
     def hybrid_forward(self, F, x):
+        if self._remat:
+            from ....models.block_remat import remat_call
+            from ...block import current_trace
+            if current_trace() is not None:
+                return remat_call(lambda a: self._unit_forward(F, a), x,
+                                  policy=self._remat_policy)
+        return self._unit_forward(F, x)
+
+    def _unit_forward(self, F, x):
         if self._preact:
             # v2: the first BN-relu of the body also feeds the shortcut.
             # list(self.body) iterates children directly — slicing a
@@ -105,15 +120,35 @@ class _ResNet(HybridBlock):
     (scale/center off) and a final BN-relu before pooling."""
 
     def __init__(self, kind, layers, channels, preact, classes=1000,
-                 thumbnail=False, unit_factory=None, **kwargs):
+                 thumbnail=False, unit_factory=None, remat_stages=None,
+                 remat_policy=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._preact = preact
+        # selective activation remat (VERDICT r4 #1a): rematerialize the
+        # units of the named stages in the backward. Default from
+        # MXTPU_RESNET_REMAT ("stage1,stage2" or "" = off), policy from
+        # MXTPU_RESNET_REMAT_POLICY (full|dots) — resolved at CONSTRUCTION
+        # so the setting is a property of the model instance.
+        import os as _os
+        if remat_stages is None:
+            env = _os.environ.get("MXTPU_RESNET_REMAT", "")
+            remat_stages = {s.strip() for s in env.split(",") if s.strip()}
+        remat_stages = set(remat_stages or ())
+        remat_policy = remat_policy or _os.environ.get(
+            "MXTPU_RESNET_REMAT_POLICY", "full")
+        self._remat_stages, self._remat_policy = remat_stages, remat_policy
         if unit_factory is None:
-            def unit_factory(out_c, stride, downsample, in_c):
+            def unit_factory(out_c, stride, downsample, in_c, remat=False):
                 return _ResidualUnit(kind, out_c, stride, downsample,
                                      in_channels=in_c, preact=preact,
+                                     remat=remat, remat_policy=remat_policy,
                                      prefix="")
+        else:
+            _user_factory = unit_factory
+
+            def unit_factory(out_c, stride, downsample, in_c, remat=False):
+                return _user_factory(out_c, stride, downsample, in_c)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             if preact:
@@ -128,12 +163,14 @@ class _ResNet(HybridBlock):
                                   nn.MaxPool2D(3, 2, 1))
             in_c = channels[0]
             for i, (n_units, out_c) in enumerate(zip(layers, channels[1:])):
-                stage = nn.HybridSequential(prefix="stage%d_" % (i + 1))
+                stage_name = "stage%d" % (i + 1)
+                stage = nn.HybridSequential(prefix=stage_name + "_")
                 with stage.name_scope():
                     for j in range(n_units):
                         stride = 2 if (i > 0 and j == 0) else 1
                         stage.add(unit_factory(
-                            out_c, stride, j == 0 and out_c != in_c, in_c))
+                            out_c, stride, j == 0 and out_c != in_c, in_c,
+                            remat=stage_name in remat_stages))
                         in_c = out_c
                 self.features.add(stage)
             if preact:

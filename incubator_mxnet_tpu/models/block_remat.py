@@ -10,7 +10,7 @@ function closes over them; only the activations are arguments.
 
 import jax
 
-__all__ = ["maybe_remat_layer"]
+__all__ = ["maybe_remat_layer", "remat_call"]
 
 
 def maybe_remat_layer(layer, x, mask=None):
@@ -22,3 +22,44 @@ def maybe_remat_layer(layer, x, mask=None):
     if mask is None:
         return jax.checkpoint(lambda a: layer(a))(x)
     return jax.checkpoint(lambda a, m: layer(a, m))(x, mask)
+
+
+_POLICIES = {
+    "full": None,                       # save only the region's inputs
+    # save matmul/conv outputs, recompute the elementwise tail (BN/ReLU
+    # copies) — recompute cost ~0, still drops the epilogue activations
+    "dots": "dots_saveable",
+    "nothing": "nothing_saveable",
+}
+
+
+def remat_call(fn, *args, policy="full"):
+    """jax.checkpoint around ``fn(*args)`` where fn runs gluon blocks that
+    may carry BatchNorm running-stat updates: the inner trace context's
+    ``aux_updates`` are threaded OUT of the checkpointed region as explicit
+    outputs (a tracer written into the outer dict from inside the remat
+    trace would leak), then merged into the ambient trace. RNG: one subkey
+    is split off the outer stream so the recompute replays identically."""
+    from ..gluon.block import current_trace, _TraceCtx, _trace_state
+    outer = current_trace()
+    if outer is None:
+        return fn(*args)
+    sub = outer.take_key()
+    pol = _POLICIES.get(policy, policy)
+    if isinstance(pol, str):
+        pol = getattr(jax.checkpoint_policies, pol)
+
+    def inner_fn(key, *xs):
+        inner = _TraceCtx(outer.param_map, key, outer.training,
+                          mesh_ctx=outer.mesh_ctx)
+        prev = getattr(_trace_state, "ctx", None)
+        _trace_state.ctx = inner
+        try:
+            out = fn(*xs)
+        finally:
+            _trace_state.ctx = prev
+        return out, inner.aux_updates
+
+    out, aux = jax.checkpoint(inner_fn, policy=pol)(sub, *args)
+    outer.aux_updates.update(aux)
+    return out

@@ -64,6 +64,15 @@ class BucketingModule(BaseModule):
                 base = self._buckets[self._default_bucket_key]
                 module._optimizer = base._optimizer
                 module._updater = base._updater
+                # kvstore update path rides along (push/pull aggregation
+                # would otherwise be silently skipped — or update() would
+                # hit a None updater — on non-default buckets)
+                module._kvstore = base._kvstore
+                module._update_on_kvstore = getattr(
+                    base, "_update_on_kvstore", False)
+                if base._kvstore is not None:
+                    # buckets share arguments; reuse the base key list
+                    module._kv_names = list(base._kv_names)
                 module.optimizer_initialized = True
         else:
             # share latest parameters
