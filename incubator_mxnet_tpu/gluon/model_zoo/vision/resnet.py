@@ -73,11 +73,11 @@ class _ResidualUnit(HybridBlock):
 
     def hybrid_forward(self, F, x):
         if self._remat:
+            # remat_call gates itself: pass-through on eager AND on
+            # symbolic-export traces (jax.checkpoint over Symbols crashes)
             from ....models.block_remat import remat_call
-            from ...block import current_trace
-            if current_trace() is not None:
-                return remat_call(lambda a: self._unit_forward(F, a), x,
-                                  policy=self._remat_policy)
+            return remat_call(lambda a: self._unit_forward(F, a), x,
+                              policy=self._remat_policy)
         return self._unit_forward(F, x)
 
     def _unit_forward(self, F, x):
@@ -145,6 +145,13 @@ class _ResNet(HybridBlock):
                                      remat=remat, remat_policy=remat_policy,
                                      prefix="")
         else:
+            if remat_stages:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "remat_stages=%s ignored: a custom unit_factory "
+                    "builds the units, which do not take the remat flag "
+                    "(set remat on the custom block instead)",
+                    sorted(remat_stages))
             _user_factory = unit_factory
 
             def unit_factory(out_c, stride, downsample, in_c, remat=False):

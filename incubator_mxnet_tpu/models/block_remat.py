@@ -13,11 +13,20 @@ import jax
 __all__ = ["maybe_remat_layer", "remat_call"]
 
 
+def _jax_trace():
+    """The ambient trace iff it is a JAX one (key set). Symbolic-export
+    traces carry key=None and flow Symbol objects — jax.checkpoint over
+    those would crash, so remat helpers pass through there."""
+    from ..gluon.block import current_trace
+    ctx = current_trace()
+    return ctx if ctx is not None and ctx.key is not None else None
+
+
 def maybe_remat_layer(layer, x, mask=None):
     """Run ``layer(x, mask)`` under jax.checkpoint when tracing; plain
-    call on the eager path (nothing to rematerialize outside a grad)."""
-    from ..gluon.block import current_trace
-    if current_trace() is None:
+    call on the eager/export path (nothing to rematerialize outside a
+    grad)."""
+    if _jax_trace() is None:
         return layer(x, mask)
     if mask is None:
         return jax.checkpoint(lambda a: layer(a))(x)
@@ -40,8 +49,8 @@ def remat_call(fn, *args, policy="full"):
     outputs (a tracer written into the outer dict from inside the remat
     trace would leak), then merged into the ambient trace. RNG: one subkey
     is split off the outer stream so the recompute replays identically."""
-    from ..gluon.block import current_trace, _TraceCtx, _trace_state
-    outer = current_trace()
+    from ..gluon.block import _TraceCtx, _trace_state
+    outer = _jax_trace()
     if outer is None:
         return fn(*args)
     sub = outer.take_key()

@@ -276,12 +276,26 @@ class PipelineStack(HybridBlock):
 
     def __init__(self, stage_factory, n_stages, pp_axis="pp",
                  n_microbatch=None, remat=False, interleave=1,
-                 embed=None, head=None, head_batched=True, **kwargs):
+                 embed=None, head=None, head_batched=True,
+                 stage_rules=None, **kwargs):
         super().__init__(**kwargs)
         self._pp_axis = pp_axis
         self._n_micro = n_microbatch
         self._remat = bool(remat)
         self._interleave = int(interleave)
+        # tensor parallelism INSIDE the pipelined stages (dp x tp x pp —
+        # the standard large-model composition): [(regex, PartitionSpec)]
+        # over a stage's OWN param dims; the stacked leaf gets the spec
+        # shifted right of the pp stage axis, pp stays the shard_map
+        # manual axis and tp rides GSPMD-auto through the stage matmuls.
+        # Pass the SAME rules to ShardedTrainer so resting params and
+        # optimizer state shard over tp too.
+        self._stage_rules = stage_rules
+        if stage_rules is not None:
+            from .trainer import sharding_rules
+            self._stage_matcher = sharding_rules(stage_rules)
+        else:
+            self._stage_matcher = None
         # head_batched=False declares a batch-reducing head (per-microbatch
         # outputs); requires n_microbatch so the off-mesh fallback can
         # reproduce the same (M, ...) result shape
@@ -367,6 +381,19 @@ class PipelineStack(HybridBlock):
                                              for s in range(S)])
                                   for r in range(v)])
                        for k in range(len(names[0]))]
+        if self._stage_matcher is not None:
+            # pin tp (or any non-pp) shardings onto the stacked leaves:
+            # lead with the stage axis ((None,) pp for v>1), then the
+            # user's per-stage-param spec
+            lead = (None, axis) if v > 1 else (axis,)
+            pinned = []
+            for k, leaf in enumerate(stacked):
+                spec = tuple(self._stage_matcher(names[0][k]))
+                if spec and any(ax is not None for ax in spec):
+                    leaf = jax.lax.with_sharding_constraint(
+                        leaf, NamedSharding(mesh, P(*lead, *spec)))
+                pinned.append(leaf)
+            stacked = pinned
         outer = ctx
         stage_fn, _ = self._block_runner(stages[0], outer)
 

@@ -577,6 +577,27 @@ class ShardedTrainer:
         return fn.lower(pv, aux_vals, self._opt_state, jnp.float32(1), key,
                         *datas, *labels)
 
+    def audit_step(self, data, label, key=None):
+        """Compile the full train step WITHOUT donation, run it on the
+        current state WITHOUT mutating the trainer, and return
+        ``(collective_counts, loss)`` — the collective-placement +
+        semantics audit primitive used by dryrun_multichip and the
+        parallelism tests (single-sources the compiled-step calling
+        convention)."""
+        from .collectives import collective_counts
+        datas, labels = self._prep_batch(data, label)
+        fn = jax.jit(self._build_raw(len(datas)))   # no donation
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        pv = {n: self._param_vals[n] for n in self._diff_names}
+        av = {n: self._param_vals[n] for n in self._aux_names}
+        args = (pv, av, self._opt_state, jnp.float32(1), key,
+                *datas, *labels)
+        compiled = fn.lower(*args).compile()
+        counts = collective_counts(compiled.as_text())
+        loss = float(jax.device_get(compiled(*args)[3]))
+        return counts, loss
+
     # ------------------------------------------------------- checkpointing
     def state_dict(self):
         """Flat name -> array dict of the FULL training state (params,

@@ -285,3 +285,25 @@ def test_flash_kv_bias_causal_gradient():
     np.testing.assert_allclose(np.asarray(jax.grad(flash_loss)(bias)),
                                np.asarray(jax.grad(dense_loss)(bias)),
                                rtol=2e-2, atol=2e-3)
+
+
+def test_fused_bottleneck_matches_xla_reference():
+    """Pallas fully-fused stage-1 bottleneck (interpret mode) == the XLA
+    conv-stack arm, fp32 (VERDICT r5 #1b experiment's numerics gate)."""
+    from incubator_mxnet_tpu.ops.pallas.fused_bottleneck import (
+        fused_bottleneck, bottleneck_reference)
+    rng = np.random.RandomState(0)
+    B, H, W, C, M = 2, 8, 8, 32, 8
+    x = jnp.asarray(rng.randn(B, H, W, C).astype(np.float32) * 0.5)
+    w1 = jnp.asarray(rng.randn(C, M).astype(np.float32) * 0.2)
+    w2 = jnp.asarray(rng.randn(9, M, M).astype(np.float32) * 0.2)
+    w3 = jnp.asarray(rng.randn(M, C).astype(np.float32) * 0.2)
+    mkv = lambda n: (jnp.asarray(rng.rand(n).astype(np.float32) + 0.5),
+                     jnp.asarray(rng.randn(n).astype(np.float32) * 0.1))
+    s1, b1 = mkv(M); s2, b2 = mkv(M); s3, b3 = mkv(C)
+    out_p = fused_bottleneck(x, w1, s1, b1, w2, s2, b2, w3, s3, b3,
+                             interpret=True)
+    out_r = bottleneck_reference(x, w1, s1, b1, w2, s2, b2, w3, s3, b3)
+    np.testing.assert_allclose(np.asarray(out_p, np.float32),
+                               np.asarray(out_r, np.float32),
+                               rtol=1e-5, atol=1e-5)

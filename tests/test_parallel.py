@@ -560,22 +560,11 @@ def test_sp_axis_routes_through_ring_attention(monkeypatch):
                               data_specs=P("dp", "sp"),
                               label_spec=P("dp", "sp"))
 
-    def one_loss(tr):
-        datas, labs = tr._prep_batch(tokens, labels)
-        pv = {n: tr._param_vals[n] for n in tr._diff_names}
-        av = {n: tr._param_vals[n] for n in tr._aux_names}
-        lowered = tr.lowered(tokens, labels)
-        comp = lowered.compile()
-        counts = collective_counts(comp.as_text())
-        out = comp(pv, av, tr._opt_state, jnp.float32(1),
-                   jax.random.PRNGKey(0), *datas, *labs)
-        return counts, float(jax.device_get(out[3]))
-
     monkeypatch.delenv("MXTPU_DISABLE_RING", raising=False)
-    counts_ring, loss_ring = one_loss(build())
+    counts_ring, loss_ring = build().audit_step(tokens, labels)
     assert counts_ring["collective-permute"] >= 1, counts_ring
     monkeypatch.setenv("MXTPU_DISABLE_RING", "1")
-    counts_ag, loss_ag = one_loss(build())
+    counts_ag, loss_ag = build().audit_step(tokens, labels)
     assert counts_ag["collective-permute"] == 0, counts_ag
     assert abs(loss_ring - loss_ag) < 1e-5 * max(1.0, abs(loss_ag)), \
         (loss_ring, loss_ag)
