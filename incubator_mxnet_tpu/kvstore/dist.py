@@ -137,6 +137,21 @@ class KVStoreDist(KVStore):
         for f in futs:
             f.result()
 
+    def _server_profiler_command(self, action, params=None):
+        """Broadcast a profiler command to every server (reference:
+        kvstore.h:385 SetServerProfilerCommand): set_config / state /
+        pause / resume / dump. Returns [(meta, payload), ...] per server
+        — dump replies carry each server's chrome-trace bytes, which
+        profiler.dump(profile_process='server') writes on this worker."""
+        self._flush()                     # commands see a settled store
+        out = []
+        for conn in self._servers:
+            out.append(self._checked_call(
+                conn, {"op": "command", "command": "profiler",
+                       "action": action, "params": params or {},
+                       "rank": self._rank}))
+        return out
+
     # -- key -> server placement (reference: EncodeDefaultKey) ---------------
     def _shards_for(self, key, shape):
         if key in self._key_shard:
@@ -356,3 +371,9 @@ class KVStoreDist(KVStore):
                 self._io.shutdown(wait=True)
             for conn in self._servers:
                 conn.close()
+            # drop the server-profiling handle if it points at this store:
+            # a later profile_process="server" call must get the clean
+            # "requires a dist kvstore" error, not a dead-socket OSError
+            from .. import profiler as _prof
+            if getattr(_prof, "_kvstore_handle", None) is self:
+                _prof.set_kvstore_handle(None)

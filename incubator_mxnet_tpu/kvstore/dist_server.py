@@ -21,6 +21,7 @@ import numpy as np
 
 from .rpc import Server, request, Connection, ProtocolError
 from .compression import GradientCompression
+from .. import profiler as _server_profiler
 
 __all__ = ["run_scheduler", "run_server", "SchedulerClient"]
 
@@ -156,7 +157,10 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
             return {"ok": True}, b""
         return {"error": "unknown op %s" % op}, b""
 
-    srv = Server(handler, port=port).start()
+    # DMLC_NODE_HOST (reference: ps-lite van bind host): the bind/advertise
+    # address for multi-host topologies; default stays loopback
+    srv = Server(handler, port=port,
+                 host=os.environ.get("DMLC_NODE_HOST", "127.0.0.1")).start()
     if ready_event is not None:
         ready_event.set()
     state.done.wait()
@@ -314,7 +318,52 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
         else:
             state.store[key] = agg.copy()
 
+    def _profiler_command(meta):
+        """Server-side profiler control (reference: kvstore.h:385
+        SetServerProfilerCommand + ps-lite kController handling;
+        nightly/test_server_profiling.py). Runs THIS process's profiler;
+        'dump' writes the server-local trace file and ships its bytes
+        back to the calling worker."""
+        from .. import profiler as _prof
+        action = meta.get("action")
+        params = meta.get("params") or {}
+        if action == "set_config":
+            _prof.set_config(**params)
+        elif action == "state":
+            if params.get("state") == "run":
+                _prof.start()
+            else:
+                _prof.stop()
+        elif action == "pause":
+            _prof.pause()
+        elif action == "resume":
+            _prof.resume()
+        elif action == "dump":
+            _prof.dump()
+            path = _prof._config.get("filename", "")
+            try:
+                with open(path, "rb") as f:
+                    return {"ok": True, "file": path}, f.read()
+            except OSError as e:
+                return {"error": "dump: %s" % e}, b""
+        else:
+            return {"error": "unknown profiler action %r" % action}, b""
+        return {"ok": True}, b""
+
     def handler(meta, payload):
+        op = meta["op"]
+        if op in ("push", "pull", "init"):
+            _oprec = _server_profiler.record_op("server_" + op)
+            _oprec.__enter__()
+        else:
+            _oprec = None
+        try:
+            return _handle(meta, payload)
+        finally:
+            if _oprec is not None:
+                _oprec.__exit__(None, None, None)
+
+    def _handle(meta, payload):
         op = meta["op"]
         if op == "init":
             with state.lock:
@@ -444,13 +493,16 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             state.compression = GradientCompression(**meta["params"])
             return {"ok": True}, b""
         if op == "command":
+            if meta.get("command") == "profiler":
+                return _profiler_command(meta)
             return {"ok": True}, b""
         if op == "shutdown":
             state.done.set()
             return {"ok": True}, b""
         return {"error": "unknown op %s" % op}, b""
 
-    srv = Server(handler, port=port).start()
+    srv = Server(handler, port=port,
+                 host=os.environ.get("DMLC_NODE_HOST", "127.0.0.1")).start()
     sched = SchedulerClient(tuple(scheduler_addr))
     rank = sched.register("server", srv.addr)
     sched.start_heartbeats("server", rank)

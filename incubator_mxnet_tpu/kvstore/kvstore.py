@@ -30,7 +30,12 @@ def create(name="local"):
         return KVStoreDevice("device")
     if name.startswith("dist"):
         from .dist import create_dist
-        return create_dist(name)
+        kv = create_dist(name)
+        # register for profile_process="server" routing (reference:
+        # kvstore.py create -> profiler.set_kvstore_handle)
+        from .. import profiler as _prof
+        _prof.set_kvstore_handle(kv)
+        return kv
     raise ValueError("unknown kvstore type %r" % name)
 
 

@@ -75,6 +75,10 @@ def fused_bottleneck(x, w1, s1, b1, w2, s2, b2, w3, s3, b3,
     w3 (M, C); s*/b* folded BN scale/bias per channel (fp32).
     Returns relu(bn3(conv3(relu(bn2(conv2(relu(bn1(conv1(x)))))))) + x).
     One grid step per image; all intermediates VMEM-resident."""
+    if not _PALLAS_OK:
+        raise RuntimeError(
+            "Pallas unavailable in this environment — "
+            "use bottleneck_reference (check fused_bottleneck_available())")
     B, H, W, C = x.shape
     M = w1.shape[1]
     spec_w = lambda shape: pl.BlockSpec(shape, lambda b: (0,) * len(shape))
@@ -109,17 +113,16 @@ def bottleneck_reference(x, w1, s1, b1, w2, s2, b2, w3, s3, b3):
                                         ("NHWC", "HWIO", "NHWC"))
     C, M = w1.shape
 
-    def conv(h, w, window, pad):
+    def conv(h, w, pad):
         return jax.lax.conv_general_dilated(
             h, w, window_strides=(1, 1), padding=pad,
             dimension_numbers=dn,
             preferred_element_type=jnp.float32)
 
-    h = conv(x, w1.reshape(1, 1, C, M), (1, 1), "VALID")
+    h = conv(x, w1.reshape(1, 1, C, M), "VALID")
     h = jnp.maximum(h * s1 + b1, 0.0).astype(x.dtype)
-    w2hwio = w2.reshape(3, 3, M, M)
-    h = conv(h, w2hwio, (3, 3), "SAME")
+    h = conv(h, w2.reshape(3, 3, M, M), "SAME")
     h = jnp.maximum(h * s2 + b2, 0.0).astype(x.dtype)
-    h = conv(h, w3.reshape(1, 1, M, C), (1, 1), "VALID")
+    h = conv(h, w3.reshape(1, 1, M, C), "VALID")
     h = h * s3 + b3
     return jnp.maximum(h + x.astype(jnp.float32), 0.0).astype(x.dtype)

@@ -1,0 +1,77 @@
+"""Reusable parallelism-composition audits.
+
+Single source for the toy-model composition checks that BOTH
+``__graft_entry__.dryrun_multichip`` and the test suite run — the audit
+the driver executes is byte-for-byte the audit the tests pin.
+"""
+
+__all__ = ["three_axis_pipeline_audit"]
+
+
+def three_axis_pipeline_audit(devices):
+    """dp x tp x pp in ONE pjit step (VERDICT r4 #5): tp INSIDE the
+    PipelineStack stages (stage_rules), dp gradient reduction outside.
+
+    Asserts: pipeline collective-permutes AND a dp all-reduce in the
+    compiled program, tp-sharded optimizer state on the stage weights,
+    and loss parity vs the tp-off formulation on the same mesh. Returns
+    the tp-active program's collective counts (for the dryrun line).
+    Requires 8 devices.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from .. import gluon
+    from . import make_mesh, PipelineStack, ShardedTrainer
+
+    mesh3 = make_mesh({"dp": 2, "tp": 2, "pp": 2}, devices=devices[:8])
+    rng = np.random.RandomState(2)
+    x3 = mx.nd.array(rng.rand(8, 32).astype("float32"))
+    y3 = mx.nd.array(rng.randint(0, 4, (8,)).astype("float32"))
+
+    def loss_fn(out, lab):
+        logp = jax.nn.log_softmax(out, axis=-1)
+        return -jnp.take_along_axis(logp, lab.astype(jnp.int32)[:, None],
+                                    axis=-1).mean()
+
+    def build(with_tp):
+        np.random.seed(3)
+        stage_rules = [(r"weight$", P("tp", None)), (r"bias$", P("tp"))]
+        net = gluon.nn.HybridSequential(prefix="net3_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(32, activation="relu", in_units=32,
+                                   prefix="embed_"))
+            net.add(PipelineStack(
+                lambda i: gluon.nn.Dense(32, activation="tanh", in_units=32,
+                                         prefix="body%d_" % i),
+                n_stages=2,
+                stage_rules=stage_rules if with_tp else None,
+                prefix="trunk_"))
+            net.add(gluon.nn.Dense(4, in_units=32, prefix="head_"))
+        net.initialize(mx.init.Xavier())
+        rules = [(r"body\d+_.*weight$", P("tp", None)),
+                 (r"body\d+_.*bias$", P("tp"))] if with_tp else None
+        return ShardedTrainer(net, loss_fn, mesh3, rules=rules,
+                              optimizer="adamw",
+                              optimizer_params={"learning_rate": 1e-3},
+                              data_specs=P("dp"), label_spec=P("dp"))
+
+    tr3 = build(with_tp=True)
+    counts, loss_tp = tr3.audit_step(x3, y3)
+    assert counts["collective-permute"] >= 1, counts
+    assert counts["all-reduce"] >= 1, counts
+    n_tp = 0
+    for pname, st in tr3._opt_state.items():
+        if "body" in pname and "weight" in pname:
+            for s in st:
+                assert "tp" in str(s.sharding.spec), (pname, s.sharding)
+            n_tp += 1
+    assert n_tp > 0, "no tp-sharded optimizer state in dp x tp x pp"
+    _, loss_plain = build(with_tp=False).audit_step(x3, y3)
+    assert abs(loss_tp - loss_plain) < 1e-4 * max(1.0, abs(loss_plain)), \
+        (loss_tp, loss_plain)
+    # end-to-end: one REAL (donating) step with the 3-axis sharding
+    assert np.isfinite(float(jax.device_get(tr3.step(x3, y3))))
+    return counts

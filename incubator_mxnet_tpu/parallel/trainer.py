@@ -562,37 +562,35 @@ class ShardedTrainer:
         self._opt_state = new_opt if new_opt else self._opt_state
         return loss
 
-    def lowered(self, data, label, key=None):
-        """Lower (but do not run) the full sharded train step for this batch
-        and return the jax ``Lowered`` object — `.compile().as_text()` gives
-        the post-GSPMD HLO, the supported way to AUDIT collective placement
-        (which all-reduces/all-gathers the partitioner inserted and where).
-        Does not mutate trainer state."""
-        datas, labels = self._prep_batch(data, label)
-        fn = jax.jit(self._build_raw(len(datas)))   # no donation: inspection
-        if key is None:
-            key = jax.random.PRNGKey(0)
-        pv = {n: self._param_vals[n] for n in self._diff_names}
-        aux_vals = {n: self._param_vals[n] for n in self._aux_names}
-        return fn.lower(pv, aux_vals, self._opt_state, jnp.float32(1), key,
-                        *datas, *labels)
-
-    def audit_step(self, data, label, key=None):
-        """Compile the full train step WITHOUT donation, run it on the
-        current state WITHOUT mutating the trainer, and return
-        ``(collective_counts, loss)`` — the collective-placement +
-        semantics audit primitive used by dryrun_multichip and the
-        parallelism tests (single-sources the compiled-step calling
-        convention)."""
-        from .collectives import collective_counts
+    def _inspection_step(self, data, label, key=None):
+        """Shared no-donation prep: the compiled-step calling convention
+        lives HERE and only here. Returns (jitted_fn, args)."""
         datas, labels = self._prep_batch(data, label)
         fn = jax.jit(self._build_raw(len(datas)))   # no donation
         if key is None:
             key = jax.random.PRNGKey(0)
         pv = {n: self._param_vals[n] for n in self._diff_names}
         av = {n: self._param_vals[n] for n in self._aux_names}
-        args = (pv, av, self._opt_state, jnp.float32(1), key,
-                *datas, *labels)
+        return fn, (pv, av, self._opt_state, jnp.float32(1), key,
+                    *datas, *labels)
+
+    def lowered(self, data, label, key=None):
+        """Lower (but do not run) the full sharded train step for this batch
+        and return the jax ``Lowered`` object — `.compile().as_text()` gives
+        the post-GSPMD HLO, the supported way to AUDIT collective placement
+        (which all-reduces/all-gathers the partitioner inserted and where).
+        Does not mutate trainer state."""
+        fn, args = self._inspection_step(data, label, key)
+        return fn.lower(*args)
+
+    def audit_step(self, data, label, key=None):
+        """Compile the full train step WITHOUT donation, run it on the
+        current state WITHOUT mutating the trainer, and return
+        ``(collective_counts, loss)`` — the collective-placement +
+        semantics audit primitive used by dryrun_multichip and the
+        parallelism tests."""
+        from .collectives import collective_counts
+        fn, args = self._inspection_step(data, label, key)
         compiled = fn.lower(*args).compile()
         counts = collective_counts(compiled.as_text())
         loss = float(jax.device_get(compiled(*args)[3]))

