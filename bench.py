@@ -702,6 +702,58 @@ def bench_fused_block():
           "same run")
 
 
+def bench_int8_matmul():
+    """int8 silicon probe (VERDICT r4 #8): Mosaic int8 x int8 -> s32
+    matmul vs the XLA int8 dot_general vs the bf16 matmul calibration,
+    same 4096^3 geometry. Each timed window runs ITERS matmuls inside
+    one program (operand perturbed per iteration to defeat CSE) so the
+    degraded-tunnel RTT is amortized."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.pallas.int8_matmul import int8_matmul
+
+    n = 4096
+    ITERS = int(os.environ.get("BENCH_INT8_ITERS", "64"))
+    rng = np.random.RandomState(0)
+    dev = jax.devices()[0]
+    a8 = jax.device_put(jnp.asarray(
+        rng.randint(-127, 128, (n, n), np.int64).astype(np.int8)), dev)
+    b8 = jax.device_put(jnp.asarray(
+        rng.randint(-127, 128, (n, n), np.int64).astype(np.int8)), dev)
+    a16 = a8.astype(jnp.bfloat16)
+    b16 = b8.astype(jnp.bfloat16)
+
+    def chain(mm, a, b):
+        @jax.jit
+        def run(a, b):
+            def body(i, acc):
+                ai = (a + i.astype(a.dtype))     # defeat CSE, ~free on VPU
+                return acc + mm(ai, b).astype(jnp.float32).sum()
+            return jax.lax.fori_loop(0, ITERS, body, jnp.float32(0.0))
+        return lambda: float(run(a, b))
+
+    arms = {
+        "pallas_int8_s32": chain(lambda x, y: int8_matmul(x, y), a8, b8),
+        "xla_int8_s32": chain(
+            lambda x, y: jax.lax.dot_general(
+                x, y, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32), a8, b8),
+        "xla_bf16": chain(lambda x, y: x @ y, a16, b16),
+    }
+    flops = 2.0 * n * n * n * ITERS / 1e12
+    res = {}
+    for name, fn in arms.items():
+        fn()    # compile + warm
+        res[name] = _timed_rate(fn, flops)
+    _emit("int8_matmul_pallas_tops_per_sec",
+          "TOP/s, 4096^3 int8->s32 Mosaic kernel (XLA int8 %.0f, "
+          "bf16 %.0f TFLOP/s)" % (res["xla_int8_s32"]["value"],
+                                  res["xla_bf16"]["value"]),
+          res["pallas_int8_s32"], baseline=res["xla_bf16"]["value"],
+          baseline_desc="the bf16 matmul calibration arm, same geometry, "
+          "same run")
+
+
 def bench_pipeline_fed(dtype):
     import shutil
     import tempfile
@@ -900,6 +952,8 @@ def main():
         return bench_int8()
     if model == "fused_block":
         return bench_fused_block()
+    if model == "int8_matmul":
+        return bench_int8_matmul()
     if model == "ssd":
         return bench_ssd(int(os.environ.get("BENCH_STEPS", "30")), dtype)
     if model == "consistency":

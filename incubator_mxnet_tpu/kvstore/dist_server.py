@@ -351,17 +351,13 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
         return {"ok": True}, b""
 
     def handler(meta, payload):
+        import contextlib
         op = meta["op"]
-        if op in ("push", "pull", "init"):
-            _oprec = _server_profiler.record_op("server_" + op)
-            _oprec.__enter__()
-        else:
-            _oprec = None
-        try:
+        rec = (_server_profiler.record_op("server_" + op)
+               if op in ("push", "pull", "init")
+               else contextlib.nullcontext())
+        with rec:
             return _handle(meta, payload)
-        finally:
-            if _oprec is not None:
-                _oprec.__exit__(None, None, None)
 
     def _handle(meta, payload):
         op = meta["op"]

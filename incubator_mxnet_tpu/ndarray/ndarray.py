@@ -821,7 +821,7 @@ def _load_reference_binary(buf):
         a = _np.frombuffer(buf, dtype=dt, count=cnt,
                            offset=off).reshape(shape)
         off += cnt * dt.itemsize
-        arrays.append(array(a.copy()))
+        arrays.append(array(a))     # array() copies via jnp.asarray
     (nk,) = struct.unpack_from("<Q", buf, off)
     off += 8
     keys = []
@@ -831,6 +831,10 @@ def _load_reference_binary(buf):
         keys.append(buf[off:off + ln].decode())
         off += ln
     if keys:
+        if len(keys) != len(arrays):
+            raise ValueError(
+                "corrupt reference .params: %d names for %d arrays"
+                % (len(keys), len(arrays)))
         return dict(zip(keys, arrays))
     return arrays
 

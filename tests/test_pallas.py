@@ -307,3 +307,16 @@ def test_fused_bottleneck_matches_xla_reference():
     np.testing.assert_allclose(np.asarray(out_p, np.float32),
                                np.asarray(out_r, np.float32),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_int8_matmul_kernel_numerics():
+    """Mosaic int8 x int8 -> s32 kernel (interpret mode) == numpy int32
+    matmul exactly (VERDICT r5 #8 probe's numerics gate)."""
+    from incubator_mxnet_tpu.ops.pallas.int8_matmul import int8_matmul
+    rng = np.random.RandomState(0)
+    a = rng.randint(-127, 128, (64, 96)).astype(np.int8)
+    b = rng.randint(-127, 128, (96, 32)).astype(np.int8)
+    out = int8_matmul(jnp.asarray(a), jnp.asarray(b), block_m=32,
+                      block_n=32, interpret=True)
+    want = a.astype(np.int32) @ b.astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(out), want)

@@ -160,7 +160,13 @@ def test_zoo_resnet18_fixed_input_logit_golden():
     net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
     x = np.random.RandomState(7).rand(2, 3, 64, 64).astype(np.float32)
     out = net(nd.array(x)).asnumpy()
-    if not os.path.exists(golden_path):       # first run commits the pin
-        np.savez(golden_path, logits=out)
+    if not os.path.exists(golden_path):
+        if os.environ.get("MXTPU_REGEN_GOLDEN") == "1":
+            np.savez(golden_path, logits=out)
+        else:
+            raise AssertionError(
+                "committed golden %s is missing — a self-comparison would "
+                "be vacuous; restore it from git or regenerate DELIBERATELY "
+                "with MXTPU_REGEN_GOLDEN=1" % golden_path)
     want = np.load(golden_path)["logits"]
     np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
