@@ -54,6 +54,27 @@ def _timed_rate(run, units, repeats=None):
             "spread_pct": round(100.0 * (rates[-1] - rates[0]) / med, 1)}
 
 
+def _train_rate(tr, data, label, batch, steps, chunk_default=10):
+    """Shared train-throughput window for every ShardedTrainer bench:
+    warm-compile the scanned multi-step program, then time n_chunks
+    step_scan calls per window (the final float() drains the queue so
+    pipelined dispatch is charged honestly). Returns _timed_rate stats
+    in units/sec where one unit = one sample."""
+    chunk = int(os.environ.get("BENCH_SCAN_CHUNK", str(chunk_default)))
+    losses = tr.step_scan(data, label, chunk, per_step_batches=False)
+    float(losses[-1])
+    n_chunks = max(1, steps // chunk)
+
+    def run():
+        for _ in range(n_chunks):
+            losses = tr.step_scan(data, label, chunk,
+                                  per_step_batches=False)
+        final = float(losses[-1])   # host transfer: drains the queue
+        assert np.isfinite(final), "training diverged: loss=%r" % final
+
+    return _timed_rate(run, batch * n_chunks * chunk)
+
+
 def _emit(metric, unit, stats, baseline=None, baseline_desc=None, **extra):
     """One JSON line per metric: median value + repeat/spread fields, and
     an explicit statement of WHAT vs_baseline divides by (r4 weak #6:
@@ -234,18 +255,7 @@ def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
     data = [mx.nd.array(ids_masked), mx.nd.array(types),
             mx.nd.array(mlm_pos.astype(np.int32))]
     label = [mx.nd.array(mlm_lab), mx.nd.array(nsp_lab)]
-    chunk = int(os.environ.get("BENCH_SCAN_CHUNK", "10"))
-    losses = tr.step_scan(data, label, chunk, per_step_batches=False)
-    float(losses[-1])                        # compile + sync
-    n_chunks = max(1, steps // chunk)
-
-    def run():
-        for _ in range(n_chunks):
-            losses = tr.step_scan(data, label, chunk,
-                                  per_step_batches=False)
-        assert np.isfinite(float(losses[-1]))
-
-    stats = _timed_rate(run, B * T * n_chunks * chunk)
+    stats = _train_rate(tr, data, label, B * T, steps)  # units = tokens
     if metric:          # bert_long: vs the XLA dense-attention arm
         bdesc = ("XLA dense-einsum attention at the identical config "
                  "(MXTPU_DISABLE_FLASH=1), same chip")
@@ -381,18 +391,8 @@ def bench_lstm(steps, dtype):
     # tunnel dispatch gap that 10-step units leave exposed (measured
     # 426k vs 122-175k tok/s under a slow tunnel; resnet/bert steps are
     # long enough that 10 suffices)
-    chunk = int(os.environ.get("BENCH_SCAN_CHUNK", "50"))
-    losses = tr.step_scan(data, label, chunk, per_step_batches=False)
-    float(losses[-1])
-    n_chunks = max(1, steps // chunk)
-
-    def run():
-        for _ in range(n_chunks):
-            losses = tr.step_scan(data, label, chunk,
-                                  per_step_batches=False)
-        assert np.isfinite(float(losses[-1]))
-
-    stats = _timed_rate(run, B * T * n_chunks * chunk)
+    stats = _train_rate(tr, data, label, B * T, steps,  # units = tokens
+                        chunk_default=50)
     env_base = float(os.environ.get("BENCH_LSTM_AB_BASELINE", "0"))
     if unrolled:
         base, bdesc = stats["value"], "self (this IS the unrolled arm)"
@@ -518,25 +518,14 @@ def bench_ssd(steps, dtype):
                         "hbm_bound_ms": round(gb / 819.0 * 1000.0, 2)}
     except Exception:
         pass
-    # device-place the fixed batch ONCE before the timed window: the train
-    # step is what this row measures (input transfer is the io benches'
-    # job), and numpy inputs would re-ship the ~100.7 MB batch per scan
-    # chunk through the tunnel — exactly the artifact that produced the
-    # r4/early-r5 12.9-59.6 imgs/s readings.
-    dev = jax.devices()[0]
-    X = jax.device_put(jnp.asarray(X, jnp.float32), dev)
-    Y = jax.device_put(jnp.asarray(Y, jnp.float32), dev)
-    chunk = int(os.environ.get("BENCH_SCAN_CHUNK", "5"))
-    losses = tr.step_scan(X, Y, chunk, per_step_batches=False)
-    float(losses[-1])
-    n_chunks = max(1, steps // chunk)
-
-    def run():
-        for _ in range(n_chunks):
-            losses = tr.step_scan(X, Y, chunk, per_step_batches=False)
-        assert np.isfinite(float(losses[-1]))
-
-    stats = _timed_rate(run, B * n_chunks * chunk)
+    # make the fixed batch device-resident ONCE before the timed window:
+    # the train step is what this row measures (input transfer is the io
+    # benches' job), and numpy inputs would re-ship the ~100.7 MB batch per
+    # scan chunk through the tunnel — exactly the artifact that produced
+    # the r4/early-r5 12.9-59.6 imgs/s readings.
+    X = jnp.asarray(X, jnp.float32)
+    Y = jnp.asarray(Y, jnp.float32)
+    stats = _train_rate(tr, X, Y, B, steps, chunk_default=5)
     if roofline and roofline.get("gflops_per_step"):
         roofline["mfu_pct"] = round(
             100.0 * roofline["gflops_per_step"] * stats["value"]
@@ -920,26 +909,61 @@ def bench_resnet50(batch, steps, dtype):
                              data_specs=P(), label_spec=P(),
                              compute_dtype=None if dtype == "float32" else dtype)
 
-    chunk = int(os.environ.get("BENCH_SCAN_CHUNK", "10"))
-    # warmup/compile the scanned multi-step program
-    losses = trainer.step_scan(data, label, chunk, per_step_batches=False)
-    float(losses[-1])   # full sync
-
-    n_chunks = max(1, steps // chunk)
-
-    def run():
-        for _ in range(n_chunks):
-            losses = trainer.step_scan(data, label, chunk,
-                                       per_step_batches=False)
-        final = float(losses[-1])   # host transfer: drains the queue
-        assert np.isfinite(final), "training diverged: loss=%r" % final
-
-    stats = _timed_rate(run, batch * n_chunks * chunk)
+    stats = _train_rate(trainer, data, label, batch, steps)
     _emit("resnet50_train_imgs_per_sec_per_chip", "imgs/sec/chip", stats,
           baseline=109.0,
           baseline_desc="reference resnet-50 single-GPU INFERENCE figure "
           "(example/image-classification/README.md:149-155); this row "
           "measures TRAINING fwd+bwd+SGD")
+
+
+def bench_zoo_scaling(steps, dtype):
+    """The reference dp-scaling table's models, single chip (BASELINE
+    'Training throughput' — example/image-classification/README.md:290-319):
+    AlexNet bs 512/GPU, Inception-v3 bs 32/GPU, ResNet-152 bs 32/GPU,
+    sync SGD. One JSON line per model; vs_baseline = the reference's
+    published 1-GPU K80 figure for that exact model/batch config."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    configs = [
+        # (zoo name, batch, input size, reference 1-GPU imgs/s, metric)
+        ("alexnet", 512, 224, 457.07, "alexnet_train_imgs_per_sec_per_chip"),
+        ("inception_v3", 32, 299, 30.4,
+         "inceptionv3_train_imgs_per_sec_per_chip"),
+        ("resnet152_v1", 32, 224, 20.08,
+         "resnet152_train_imgs_per_sec_per_chip"),
+    ]
+
+    def loss_fn(out, lab):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(
+            logp, lab.astype(jnp.int32)[:, None], axis=-1).mean()
+
+    for name, batch, size, ref, metric in configs:
+        np.random.seed(0)
+        net = mx.gluon.model_zoo.vision.get_model(name)
+        net.initialize(mx.init.Xavier())
+        data = mx.nd.array(
+            np.random.rand(batch, 3, size, size).astype(np.float32))
+        label = mx.nd.array(
+            np.random.randint(0, 1000, (batch,)).astype(np.float32))
+        net(data[0:1])
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        tr = ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1,
+                                              "momentum": 0.9},
+                            data_specs=P(), label_spec=P(),
+                            compute_dtype=None if dtype == "float32"
+                            else dtype)
+        stats = _train_rate(tr, data, label, batch, steps)
+        _emit(metric, "imgs/sec/chip (bs %d, %dx%d)" % (batch, size, size),
+              stats, baseline=ref,
+              baseline_desc="reference 1-GPU K80 TRAINING figure for this "
+              "model/batch (example/image-classification/README.md:290-319)")
 
 
 def main():
@@ -966,6 +990,9 @@ def main():
         return bench_ssd(int(os.environ.get("BENCH_STEPS", "30")), dtype)
     if model == "consistency":
         return bench_consistency()
+    if model == "zoo_scaling":
+        return bench_zoo_scaling(int(os.environ.get("BENCH_STEPS", "30")),
+                                 dtype)
     if model == "bert_long":
         # T=2048: the Pallas flash-attention path. vs_baseline = the best
         # XLA dense-einsum attention figure at T=2048 on the same chip
