@@ -141,13 +141,13 @@ def test_sharded_trainer_sync_to_block():
 
 
 def test_collectives_in_shard_map():
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from incubator_mxnet_tpu.parallel import collectives as C
     import functools
     mesh = make_mesh({"x": 8})
 
     @functools.partial(shard_map, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
-                       check_rep=False)
+                       check_vma=False)
     def f(v):
         s = C.all_reduce(v, "x")
         return v * 0 + s
