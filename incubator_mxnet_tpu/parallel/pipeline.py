@@ -321,8 +321,12 @@ class PipelineStack(HybridBlock):
         names = sorted(p.name for p in block.collect_params().values())
 
         def run(leaves, act):
+            # mesh_ctx rides into the stage trace so mesh-aware blocks
+            # (ring attention over sp, MoE ep constraints) can bind their
+            # OWN manual axes nested inside the pp region
             inner = _TraceCtx({**outer.param_map, **dict(zip(names, leaves))},
-                              None, outer.training)
+                              None, outer.training,
+                              mesh_ctx=outer.mesh_ctx)
             prev = getattr(_trace_state, "ctx", None)
             _trace_state.ctx = inner
             try:

@@ -746,3 +746,17 @@ def test_dp_tp_pp_three_axis_composition():
     from incubator_mxnet_tpu.parallel.audits import three_axis_pipeline_audit
     counts = three_axis_pipeline_audit(jax.devices())
     assert counts["collective-permute"] >= 1 and counts["all-reduce"] >= 1
+
+
+def test_dp_sp_pp_ring_in_pipeline_composition():
+    """r5 stretch: RING attention (sp bound manual, KV rotated by
+    ppermute) nested INSIDE the scanned GPipe stages (pp bound manual)
+    on a dp x sp x pp mesh — engagement-audited (the ring path must be
+    reached in the pipelined trace and silent under MXTPU_DISABLE_RING),
+    loss parity vs the all-gather formulation, one real donating step.
+    The audit body is shared with dryrun_multichip (parallel/audits.py)."""
+    import jax
+    from incubator_mxnet_tpu.parallel.audits import (
+        four_axis_ring_pipeline_audit)
+    counts = four_axis_ring_pipeline_audit(jax.devices())
+    assert counts["collective-permute"] >= 8
