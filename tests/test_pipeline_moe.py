@@ -760,3 +760,16 @@ def test_dp_sp_pp_ring_in_pipeline_composition():
         four_axis_ring_pipeline_audit)
     counts = four_axis_ring_pipeline_audit(jax.devices())
     assert counts["collective-permute"] >= 8
+
+
+def test_dp_ep_pp_moe_in_pipeline_composition():
+    """r5 stretch #2: Switch-MoE blocks AS pipeline stages on a
+    dp x ep x pp mesh — ep-sharded expert weights/optimizer state
+    (stage_rules on the stacked leaves) and the ep all-to-all dispatch
+    constraint engaged through the stage trace ctx, loss parity vs the
+    constraint-off arm. The audit body is shared with dryrun_multichip
+    (parallel/audits.py)."""
+    import jax
+    from incubator_mxnet_tpu.parallel.audits import moe_pipeline_audit
+    counts = moe_pipeline_audit(jax.devices())
+    assert counts["all-to-all"] >= 1
