@@ -148,6 +148,66 @@ def test_symbolblock_imports_reference_artifact(ref_checkpoint):
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
 
 
+def _bert_flagship():
+    """BERT-base (the north-star flagship config): pin the encoder
+    sequence output + pooled output on fixed ids/types."""
+    net = mx.models.bert_base(vocab_size=30522, dropout=0.0)
+    net.initialize(mx.init.Normal(0.02))
+    rs = np.random.RandomState(11)
+    ids = nd.array(rs.randint(0, 30522, (2, 8)).astype(np.int32),
+                   dtype="int32")
+    types = nd.array(np.zeros((2, 8), np.int32), dtype="int32")
+    seq, pooled = net(ids, types)
+    return np.concatenate([seq.asnumpy().reshape(2, -1),
+                           pooled.asnumpy()], axis=1)
+
+
+def _lstm_wordlm_trunk():
+    """The word-LM fused-scan LSTM trunk (BASELINE config 3 geometry,
+    narrowed): pin the lax.scan recurrence numerics."""
+    from incubator_mxnet_tpu.gluon import rnn as grnn
+    net = grnn.LSTM(64, num_layers=2, prefix="lmgold_")
+    net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+    x = nd.array(np.random.RandomState(13).rand(5, 2, 32)
+                 .astype(np.float32))
+    return net(x).asnumpy().reshape(2, -1)
+
+
+# The two north-star architectures, pinned the same way the vision zoo
+# is: fixed seed, fixed input, committed golden. Covers the transformer
+# stack (embeddings/attention/LN/gelu/pooler) and the fused-scan RNN
+# path that the convnet goldens cannot reach.
+_FLAGSHIP_GOLDEN_CONFIGS = [
+    ("bert_base_encoder", _bert_flagship),
+    ("lstm_wordlm_trunk", _lstm_wordlm_trunk),
+]
+
+
+def _assert_matches_golden(fname, out, key):
+    """Shared golden ritual: committed fixture required (regen only via
+    MXTPU_REGEN_GOLDEN=1 — a self-comparison would be vacuous)."""
+    golden_path = os.path.join(os.path.dirname(__file__), "data", fname)
+    assert np.isfinite(out).all()
+    if not os.path.exists(golden_path):
+        if os.environ.get("MXTPU_REGEN_GOLDEN") == "1":
+            np.savez(golden_path, **{key: out.astype(np.float32)})
+        else:
+            raise AssertionError(
+                "committed golden %s is missing — a self-comparison would "
+                "be vacuous; restore it from git or regenerate DELIBERATELY "
+                "with MXTPU_REGEN_GOLDEN=1" % golden_path)
+    want = np.load(golden_path)[key]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,builder", _FLAGSHIP_GOLDEN_CONFIGS,
+                         ids=[c[0] for c in _FLAGSHIP_GOLDEN_CONFIGS])
+def test_flagship_fixed_input_golden(name, builder):
+    np.random.seed(1234)
+    _assert_matches_golden("flagship_golden_%s.npz" % name, builder(),
+                           "out")
+
+
 # Fixed-seed, fixed-input logit goldens across EVERY zoo family (VERDICT
 # r4 weak #5): the committed goldens pin the numerical behavior of each
 # family's forward across rounds — any silent change to conv/BN/pool/
@@ -175,22 +235,10 @@ def test_zoo_fixed_input_logit_golden(name, size):
     # committed r5 filename rather than a duplicate golden
     fname = ("resnet18_logit_golden.npz" if name == "resnet18_v1"
              else "zoo_logit_golden_%s.npz" % name.replace(".", "_"))
-    golden_path = os.path.join(os.path.dirname(__file__), "data", fname)
     np.random.seed(1234)
     net = mx.gluon.model_zoo.vision.get_model(name)
     net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
     x = np.random.RandomState(7).rand(2, 3, size, size).astype(np.float32)
-    out = net(nd.array(x)).asnumpy()
-    assert np.isfinite(out).all()
-    if not os.path.exists(golden_path):
-        if os.environ.get("MXTPU_REGEN_GOLDEN") == "1":
-            np.savez(golden_path, logits=out)
-        else:
-            raise AssertionError(
-                "committed golden %s is missing — a self-comparison would "
-                "be vacuous; restore it from git or regenerate DELIBERATELY "
-                "with MXTPU_REGEN_GOLDEN=1" % golden_path)
-    want = np.load(golden_path)["logits"]
-    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    _assert_matches_golden(fname, net(nd.array(x)).asnumpy(), "logits")
 
 
