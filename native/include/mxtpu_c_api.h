@@ -1,0 +1,131 @@
+/* MXTPU C API — the compute-surface C ABI of the TPU-native framework.
+ *
+ * Reference parity: include/mxnet/c_api.h (the 207-function MX* surface).
+ * This header covers the reference's most-used groups with the same
+ * handle-based calling conventions and error contract:
+ *
+ *   - error handling            (MXGetLastError)
+ *   - operator discovery        (MXListAllOpNames)
+ *   - NDArray lifecycle + IO    (MXNDArrayCreateEx / SyncCopy* / Save / Load)
+ *   - imperative op invocation  (MXImperativeInvoke, by registry name)
+ *   - Symbol from/to JSON       (MXSymbolCreateFromJSON / SaveToJSON / List*)
+ *   - Executor bind/fwd/bwd     (MXExecutorBind / Forward / Backward / Outputs)
+ *   - RNG seeding               (MXRandomSeed)
+ *
+ * The reference backs these with its C++ engine; the TPU-native build's
+ * compute path is XLA via Python, so libmxtpu_capi.so embeds CPython and
+ * drives the same registries the Python frontend uses (ops/registry.py,
+ * symbol/, executor/). The C surface and semantics match the reference;
+ * the engine underneath is jit/XLA. Built separately from libmxtpu.so so
+ * the host runtime library carries no Python dependency.
+ *
+ * Conventions (identical to the reference):
+ *   - every function returns 0 on success, -1 on failure;
+ *     MXTPUGetLastError() returns the failure message
+ *   - handles are opaque void*; free with the matching *Free call
+ *   - returned const char** / handle arrays are library-owned,
+ *     valid until the next call on the same thread
+ *   - dtype codes: 0=float32 1=float64 2=float16 3=uint8 4=int32
+ *     5=int8 6=int64 (the reference's mshadow codes)
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXTPU_MAX_NDIM 8
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+/* ----------------------------------------------------------------- error */
+const char *MXTPUGetLastError(void);
+
+/* ------------------------------------------------------------- operators */
+/* All registered operator names (canonical + aliases), sorted. */
+int MXTPUListAllOpNames(int *out_size, const char ***out_names);
+
+/* --------------------------------------------------------------- ndarray */
+/* Zero-initialised array (reference: MXNDArrayCreateEx). */
+int MXTPUNDArrayCreate(const int *shape, int ndim, int dtype,
+                       NDArrayHandle *out);
+/* Create + synchronous copy from a host buffer
+ * (reference: MXNDArrayCreateEx + MXNDArraySyncCopyFromCPU). */
+int MXTPUNDArrayCreateFromData(const int *shape, int ndim, int dtype,
+                               const void *data, NDArrayHandle *out);
+/* Synchronous copy to a host buffer of `nbytes` (must match exactly). */
+int MXTPUNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t nbytes);
+/* shape_out must hold >= MXTPU_MAX_NDIM ints. */
+int MXTPUNDArrayGetShape(NDArrayHandle h, int *out_ndim, int *shape_out);
+int MXTPUNDArrayGetDType(NDArrayHandle h, int *out_dtype);
+int MXTPUNDArrayFree(NDArrayHandle h);
+/* keys may be NULL => positional list file (reference: MXNDArraySave). */
+int MXTPUNDArraySave(const char *fname, int num, NDArrayHandle *handles,
+                     const char **keys);
+/* out_keys entries are "" for positional files (reference: MXNDArrayLoad). */
+int MXTPUNDArrayLoad(const char *fname, int *out_size,
+                     NDArrayHandle **out_handles, const char ***out_keys);
+
+/* ------------------------------------------------------------ imperative */
+/* Invoke a registered operator by name on input arrays with string-encoded
+ * scalar/tuple keyword parameters (reference: MXImperativeInvoke).
+ * `*out_size` returns the number of outputs; `*outputs` the handle array. */
+int MXTPUImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
+                          int num_inputs, const char **param_keys,
+                          const char **param_vals, int num_params,
+                          int *out_size, NDArrayHandle **outputs);
+
+/* ---------------------------------------------------------------- symbol */
+int MXTPUSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXTPUSymbolCreateFromFile(const char *path, SymbolHandle *out);
+/* Returned string is library-owned, valid until the next call. */
+int MXTPUSymbolSaveToJSON(SymbolHandle h, const char **out_json);
+int MXTPUSymbolListArguments(SymbolHandle h, int *out_size,
+                             const char ***out_names);
+int MXTPUSymbolListOutputs(SymbolHandle h, int *out_size,
+                           const char ***out_names);
+int MXTPUSymbolListAuxiliaryStates(SymbolHandle h, int *out_size,
+                                   const char ***out_names);
+int MXTPUSymbolFree(SymbolHandle h);
+
+/* -------------------------------------------------------------- executor */
+/* Bind a symbol with named argument arrays (reference: MXExecutorBindEX).
+ * grad_req: "write" | "add" | "null". Gradient buffers are allocated
+ * internally; auxiliary states (BatchNorm running stats etc.) are
+ * zero-initialised at their inferred shapes — models with trained aux
+ * state must use BindEX and supply them. */
+int MXTPUExecutorBind(SymbolHandle sym, int num_args, const char **arg_names,
+                      NDArrayHandle *arg_handles, const char *grad_req,
+                      ExecutorHandle *out);
+/* Bind with caller-supplied auxiliary states by name; any aux the caller
+ * omits is zero-initialised. aux_names/aux_handles may be NULL when
+ * num_aux is 0. */
+int MXTPUExecutorBindEX(SymbolHandle sym, int num_args,
+                        const char **arg_names, NDArrayHandle *arg_handles,
+                        int num_aux, const char **aux_names,
+                        NDArrayHandle *aux_handles, const char *grad_req,
+                        ExecutorHandle *out);
+int MXTPUExecutorForward(ExecutorHandle h, int is_train);
+int MXTPUExecutorOutputs(ExecutorHandle h, int *out_size,
+                         NDArrayHandle **out_handles);
+/* head_grads may be NULL for default ones-like heads. */
+int MXTPUExecutorBackward(ExecutorHandle h, NDArrayHandle *head_grads,
+                          int num_grads);
+/* Gradient buffer for one bound argument (after Backward). */
+int MXTPUExecutorArgGrad(ExecutorHandle h, const char *arg_name,
+                         NDArrayHandle *out);
+int MXTPUExecutorFree(ExecutorHandle h);
+
+/* ------------------------------------------------------------------- rng */
+int MXTPURandomSeed(int seed);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

@@ -1,0 +1,588 @@
+// MXTPU C API — compute-surface C ABI (see include/mxtpu_c_api.h).
+//
+// Reference parity: include/mxnet/c_api.h + src/c_api/c_api.cc. The
+// reference marshals every call onto its C++ engine; here the compute
+// path is XLA via the Python frontend, so this library embeds CPython
+// (same pattern as predict.cc) and drives the op registry, symbol layer
+// and executor directly. Objects live Python-side in an id table; the C
+// handles carry the ids. Per-thread return storage mirrors the
+// reference's MXAPIThreadLocalEntry so returned string/handle arrays
+// stay valid until the next call on the same thread.
+
+#include <Python.h>
+
+#include <unistd.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mxtpu_c_api.h"
+#include "py_embed.h"
+
+namespace {
+
+using mxtpu::GIL;
+using mxtpu::ensure_python;
+using mxtpu::safe_utf8;
+using mxtpu::set_err;
+using mxtpu::set_err_from_py;
+
+// Python-side helper: an id-table of live objects (ndarrays, symbols,
+// executors). Data crosses the boundary as raw bytes; params as strings
+// decoded with literal_eval (the reference's C API passes op params as
+// strings the same way).
+const char *kHelper = R"PY(
+import ast as _ast
+import numpy as _np
+
+# Platform selection follows standard JAX env (JAX_PLATFORMS etc.): a C
+# client on a TPU host computes on the TPU; tests pin JAX_PLATFORMS=cpu.
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd as _nd
+from incubator_mxnet_tpu import symbol as _sym
+from incubator_mxnet_tpu.ops import registry as _registry
+from incubator_mxnet_tpu.ops import random as _random
+
+_objs = {}
+_next = [1]
+
+_DTYPE_OF_CODE = {0: "float32", 1: "float64", 2: "float16",
+                  3: "uint8", 4: "int32", 5: "int8", 6: "int64"}
+_CODE_OF_DTYPE = {v: k for k, v in _DTYPE_OF_CODE.items()}
+
+
+def _put(o):
+    h = _next[0]
+    _next[0] += 1
+    _objs[h] = o
+    return h
+
+
+def free(h):
+    _objs.pop(h, None)
+
+
+def nd_create(shape, dtype_code):
+    return _put(_nd.zeros(tuple(shape), dtype=_DTYPE_OF_CODE[dtype_code]))
+
+
+def nd_from_bytes(shape, dtype_code, buf):
+    dt = _np.dtype(_DTYPE_OF_CODE[dtype_code])
+    arr = _np.frombuffer(buf, dtype=dt).reshape(tuple(shape)).copy()
+    return _put(_nd.array(arr, dtype=dt))
+
+
+def nd_to_bytes(h):
+    return _np.ascontiguousarray(_objs[h].asnumpy()).tobytes()
+
+
+def nd_shape(h):
+    return list(_objs[h].shape)
+
+
+def nd_dtype(h):
+    return _CODE_OF_DTYPE[_np.dtype(_objs[h].dtype).name]
+
+
+def nd_save(fname, handles, keys):
+    arrs = [_objs[h] for h in handles]
+    _nd.save(fname, dict(zip(keys, arrs)) if keys else arrs)
+
+
+def nd_load(fname):
+    data = _nd.load(fname)
+    if isinstance(data, dict):
+        keys = sorted(data.keys())
+        return [_put(data[k]) for k in keys], keys
+    return [_put(a) for a in data], ["" for _ in data]
+
+
+def list_op_names():
+    return sorted(_registry._OP_REGISTRY.keys())
+
+
+def _coerce(v):
+    try:
+        return _ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def imperative_invoke(op_name, in_handles, keys, vals):
+    from incubator_mxnet_tpu.ndarray.ndarray import _invoke_op
+    _registry.get_op(op_name)            # unknown names raise here
+    args = tuple(_objs[h] for h in in_handles)
+    kwargs = {k: _coerce(v) for k, v in zip(keys, vals)}
+    out = _invoke_op(op_name, args, kwargs)
+    if not isinstance(out, (list, tuple)):
+        out = [out]
+    return [_put(o) for o in out]
+
+
+def symbol_from_json(js):
+    return _put(_sym.load_json(js))
+
+
+def symbol_from_file(path):
+    return _put(_sym.load(path))
+
+
+def symbol_to_json(h):
+    return _objs[h].tojson()
+
+
+def symbol_list(h, which):
+    s = _objs[h]
+    if which == "arguments":
+        return list(s.list_arguments())
+    if which == "outputs":
+        return list(s.list_outputs())
+    return list(s.list_auxiliary_states())
+
+
+def executor_bind(sym_h, arg_names, arg_handles, aux_names_in,
+                  aux_handles, grad_req):
+    s = _objs[sym_h]
+    args = {n: _objs[h] for n, h in zip(arg_names, arg_handles)}
+    missing = [n for n in s.list_arguments() if n not in args]
+    if missing:
+        raise ValueError("executor_bind: missing args %s" % missing)
+    args_grad = None
+    if grad_req != "null":
+        args_grad = {n: _nd.zeros(a.shape, dtype=a.dtype)
+                     for n, a in args.items()}
+    # caller-supplied auxiliary states (BatchNorm running stats etc.);
+    # any aux the caller omits is zero-initialised at its inferred shape
+    supplied = {n: _objs[h] for n, h in zip(aux_names_in, aux_handles)}
+    aux = None
+    aux_names = s.list_auxiliary_states()
+    if aux_names:
+        shapes = {n: tuple(a.shape) for n, a in args.items()}
+        _, _, aux_shapes = s.infer_shape(**shapes)
+        aux = [supplied[n] if n in supplied else _nd.zeros(sh)
+               for n, sh in zip(aux_names, aux_shapes)]
+    ex = s.bind(args=args, args_grad=args_grad, grad_req=grad_req,
+                aux_states=aux)
+    return _put(ex)
+
+
+def executor_forward(h, is_train):
+    return len(_objs[h].forward(is_train=bool(is_train)))
+
+
+def executor_outputs(h):
+    return [_put(o) for o in _objs[h].outputs]
+
+
+def executor_backward(h, grad_handles):
+    grads = [_objs[g] for g in grad_handles] if grad_handles else None
+    _objs[h].backward(out_grads=grads)
+
+
+def executor_arg_grad(h, name):
+    g = _objs[h].grad_dict.get(name)
+    if g is None:
+        raise KeyError("no gradient bound for argument %r" % name)
+    return _put(g)
+
+
+def random_seed(seed):
+    _random.seed(int(seed))
+)PY";
+
+mxtpu::HelperModule g_helper("__mxtpu_capi__", kHelper);
+
+// Calls a helper function; returns a new reference or nullptr (error set).
+PyObject *helper_call(const char *fn, PyObject *args) {
+  return g_helper.call(fn, args);
+}
+
+// Handles carry the python-side object id. Kind is only for diagnostics;
+// the id table is shared, mirroring the reference's opaque handles.
+struct Handle {
+  long id;
+};
+
+void *make_handle(long id) { return new Handle{id}; }
+long handle_id(void *h) { return static_cast<Handle *>(h)->id; }
+
+// Per-thread return storage (reference: MXAPIThreadLocalEntry) — keeps
+// returned string/handle arrays alive until the next call on this thread.
+struct ThreadLocalEntry {
+  std::vector<std::string> strings;
+  std::vector<const char *> cstrs;
+  std::vector<void *> handles;
+  std::string json;
+};
+thread_local ThreadLocalEntry tls;
+
+// Converts a python list[str] into tls-backed const char** storage.
+bool strings_to_tls(PyObject *list, int *out_size, const char ***out_names) {
+  Py_ssize_t n = PyList_Size(list);
+  tls.strings.clear();
+  tls.strings.reserve(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls.strings.push_back(safe_utf8(PyList_GetItem(list, i)));
+  tls.cstrs.clear();
+  for (const auto &s : tls.strings) tls.cstrs.push_back(s.c_str());
+  *out_size = static_cast<int>(n);
+  *out_names = tls.cstrs.data();
+  return true;
+}
+
+// Converts a python list[int] of object ids into tls-backed handles.
+void ids_to_tls(PyObject *list, int *out_size, void ***out_handles) {
+  Py_ssize_t n = PyList_Size(list);
+  tls.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls.handles.push_back(
+        make_handle(PyLong_AsLong(PyList_GetItem(list, i))));
+  *out_size = static_cast<int>(n);
+  *out_handles = tls.handles.data();
+}
+
+PyObject *id_list(void **handles, int n) {
+  PyObject *list = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SetItem(list, i, PyLong_FromLong(handle_id(handles[i])));
+  return list;
+}
+
+PyObject *str_list(const char **strs, int n) {
+  PyObject *list = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SetItem(list, i, PyUnicode_FromString(strs[i]));
+  return list;
+}
+
+// Frees a handle both C- and python-side.
+int free_handle(void *h) {
+  if (!h) return 0;
+  if (Py_IsInitialized()) {
+    GIL gil;
+    PyObject *args = Py_BuildValue("(l)", handle_id(h));
+    PyObject *res = helper_call("free", args);
+    Py_DECREF(args);
+    Py_XDECREF(res);
+  }
+  delete static_cast<Handle *>(h);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPUGetLastError() { return mxtpu::last_error(); }
+
+int MXTPUListAllOpNames(int *out_size, const char ***out_names) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = helper_call("list_op_names", nullptr);
+  if (!res) return -1;
+  strings_to_tls(res, out_size, out_names);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayCreate(const int *shape, int ndim, int dtype, void **out) {
+  ensure_python();
+  GIL gil;
+  PyObject *shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(shp, i, PyLong_FromLong(shape[i]));
+  PyObject *args = Py_BuildValue("(Oi)", shp, dtype);
+  Py_DECREF(shp);
+  PyObject *res = helper_call("nd_create", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = make_handle(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayCreateFromData(const int *shape, int ndim, int dtype,
+                               const void *data, void **out) {
+  ensure_python();
+  GIL gil;
+  size_t n = 1;
+  PyObject *shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= static_cast<size_t>(shape[i]);
+    PyList_SetItem(shp, i, PyLong_FromLong(shape[i]));
+  }
+  static const size_t kItemSize[] = {4, 8, 2, 1, 4, 1, 8};
+  if (dtype < 0 || dtype > 6) {
+    Py_DECREF(shp);
+    set_err("unknown dtype code");
+    return -1;
+  }
+  PyObject *buf = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data),
+      static_cast<Py_ssize_t>(n * kItemSize[dtype]));
+  PyObject *args = Py_BuildValue("(OiO)", shp, dtype, buf);
+  Py_DECREF(shp);
+  Py_DECREF(buf);
+  PyObject *res = helper_call("nd_from_bytes", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = make_handle(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArraySyncCopyToCPU(void *h, void *data, size_t nbytes) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", handle_id(h));
+  PyObject *res = helper_call("nd_to_bytes", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(res, &buf, &len);
+  if (static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(res);
+    set_err("size mismatch in SyncCopyToCPU");
+    return -1;
+  }
+  std::memcpy(data, buf, nbytes);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayGetShape(void *h, int *out_ndim, int *shape_out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", handle_id(h));
+  PyObject *res = helper_call("nd_shape", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  Py_ssize_t nd = PyList_Size(res);
+  if (nd > MXTPU_MAX_NDIM) {
+    Py_DECREF(res);
+    set_err("array rank exceeds MXTPU_MAX_NDIM");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < nd; ++i)
+    shape_out[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, i)));
+  *out_ndim = static_cast<int>(nd);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayGetDType(void *h, int *out_dtype) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", handle_id(h));
+  PyObject *res = helper_call("nd_dtype", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayFree(void *h) { return free_handle(h); }
+
+int MXTPUNDArraySave(const char *fname, int num, void **handles,
+                     const char **keys) {
+  GIL gil;
+  PyObject *ids = id_list(handles, num);
+  PyObject *pykeys = keys ? str_list(keys, num) : PyList_New(0);
+  PyObject *args = Py_BuildValue("(sOO)", fname, ids, pykeys);
+  Py_DECREF(ids);
+  Py_DECREF(pykeys);
+  PyObject *res = helper_call("nd_save", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayLoad(const char *fname, int *out_size, void ***out_handles,
+                     const char ***out_keys) {
+  ensure_python();
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", fname);
+  PyObject *res = helper_call("nd_load", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  PyObject *ids = PyTuple_GetItem(res, 0);
+  PyObject *keys = PyTuple_GetItem(res, 1);
+  ids_to_tls(ids, out_size, out_handles);
+  int nkeys = 0;
+  strings_to_tls(keys, &nkeys, out_keys);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUImperativeInvoke(const char *op_name, void **inputs, int num_inputs,
+                          const char **param_keys, const char **param_vals,
+                          int num_params, int *out_size, void ***outputs) {
+  ensure_python();
+  GIL gil;
+  PyObject *ids = id_list(inputs, num_inputs);
+  PyObject *keys = str_list(param_keys, num_params);
+  PyObject *vals = str_list(param_vals, num_params);
+  PyObject *args = Py_BuildValue("(sOOO)", op_name, ids, keys, vals);
+  Py_DECREF(ids);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  PyObject *res = helper_call("imperative_invoke", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  ids_to_tls(res, out_size, outputs);
+  Py_DECREF(res);
+  return 0;
+}
+
+static int symbol_create(const char *fn, const char *arg, void **out) {
+  ensure_python();
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", arg);
+  PyObject *res = helper_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = make_handle(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUSymbolCreateFromJSON(const char *json, void **out) {
+  return symbol_create("symbol_from_json", json, out);
+}
+
+int MXTPUSymbolCreateFromFile(const char *path, void **out) {
+  return symbol_create("symbol_from_file", path, out);
+}
+
+int MXTPUSymbolSaveToJSON(void *h, const char **out_json) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", handle_id(h));
+  PyObject *res = helper_call("symbol_to_json", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  tls.json = safe_utf8(res);
+  *out_json = tls.json.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+static int symbol_list(void *h, const char *which, int *out_size,
+                       const char ***out_names) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(ls)", handle_id(h), which);
+  PyObject *res = helper_call("symbol_list", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  strings_to_tls(res, out_size, out_names);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUSymbolListArguments(void *h, int *out_size, const char ***out) {
+  return symbol_list(h, "arguments", out_size, out);
+}
+
+int MXTPUSymbolListOutputs(void *h, int *out_size, const char ***out) {
+  return symbol_list(h, "outputs", out_size, out);
+}
+
+int MXTPUSymbolListAuxiliaryStates(void *h, int *out_size,
+                                   const char ***out) {
+  return symbol_list(h, "auxiliary", out_size, out);
+}
+
+int MXTPUSymbolFree(void *h) { return free_handle(h); }
+
+int MXTPUExecutorBindEX(void *sym, int num_args, const char **arg_names,
+                        void **arg_handles, int num_aux,
+                        const char **aux_names, void **aux_handles,
+                        const char *grad_req, void **out) {
+  GIL gil;
+  PyObject *names = str_list(arg_names, num_args);
+  PyObject *ids = id_list(arg_handles, num_args);
+  PyObject *anames = aux_names ? str_list(aux_names, num_aux)
+                               : PyList_New(0);
+  PyObject *aids = aux_handles ? id_list(aux_handles, num_aux)
+                               : PyList_New(0);
+  PyObject *args = Py_BuildValue("(lOOOOs)", handle_id(sym), names, ids,
+                                 anames, aids,
+                                 grad_req ? grad_req : "write");
+  Py_DECREF(names);
+  Py_DECREF(ids);
+  Py_DECREF(anames);
+  Py_DECREF(aids);
+  PyObject *res = helper_call("executor_bind", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = make_handle(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorBind(void *sym, int num_args, const char **arg_names,
+                      void **arg_handles, const char *grad_req, void **out) {
+  return MXTPUExecutorBindEX(sym, num_args, arg_names, arg_handles, 0,
+                             nullptr, nullptr, grad_req, out);
+}
+
+int MXTPUExecutorForward(void *h, int is_train) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(li)", handle_id(h), is_train);
+  PyObject *res = helper_call("executor_forward", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorOutputs(void *h, int *out_size, void ***out_handles) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", handle_id(h));
+  PyObject *res = helper_call("executor_outputs", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  ids_to_tls(res, out_size, out_handles);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorBackward(void *h, void **head_grads, int num_grads) {
+  GIL gil;
+  PyObject *ids = head_grads ? id_list(head_grads, num_grads)
+                             : PyList_New(0);
+  PyObject *args = Py_BuildValue("(lO)", handle_id(h), ids);
+  Py_DECREF(ids);
+  PyObject *res = helper_call("executor_backward", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorArgGrad(void *h, const char *arg_name, void **out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(ls)", handle_id(h), arg_name);
+  PyObject *res = helper_call("executor_arg_grad", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = make_handle(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorFree(void *h) { return free_handle(h); }
+
+int MXTPURandomSeed(int seed) {
+  ensure_python();
+  GIL gil;
+  PyObject *args = Py_BuildValue("(i)", seed);
+  PyObject *res = helper_call("random_seed", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

@@ -17,34 +17,13 @@
 #include <string>
 #include <vector>
 
+#include "py_embed.h"
+
 namespace {
 
-std::mutex g_mu;
-std::string g_last_error;
-bool g_owns_interp = false;
-
-void set_err(const std::string &e) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  g_last_error = e;
-}
-
-void set_err_from_py() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value) {
-    PyObject *s = PyObject_Str(value);
-    if (s) {
-      msg = PyUnicode_AsUTF8(s);
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  set_err(msg);
-}
+using mxtpu::GIL;
+using mxtpu::ensure_python;
+using mxtpu::set_err;
 
 // Python-side helper: a tiny module managing predictors by id. Data crosses
 // the boundary as raw float32 bytes; shapes as int lists.
@@ -98,45 +77,10 @@ def free(pid):
     _predictors.pop(pid, None)
 )PY";
 
-PyObject *g_helper = nullptr;  // helper module namespace (dict)
-
-bool ensure_python() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    g_owns_interp = true;
-    // release the GIL acquired by Py_Initialize so PyGILState_Ensure works
-    // uniformly from any caller thread
-    PyEval_SaveThread();
-  }
-  return true;
-}
-
-struct GIL {
-  PyGILState_STATE st;
-  GIL() { st = PyGILState_Ensure(); }
-  ~GIL() { PyGILState_Release(st); }
-};
-
-bool ensure_helper() {
-  if (g_helper) return true;
-  PyObject *mod = PyImport_AddModule("__mxtpu_predict__");  // borrowed
-  if (!mod) return false;
-  PyObject *dict = PyModule_GetDict(mod);  // borrowed
-  PyObject *res = PyRun_String(kHelper, Py_file_input, dict, dict);
-  if (!res) return false;
-  Py_DECREF(res);
-  g_helper = dict;
-  Py_INCREF(g_helper);
-  return true;
-}
+mxtpu::HelperModule g_helper("__mxtpu_predict__", kHelper);
 
 PyObject *helper_call(const char *fn, PyObject *args) {
-  PyObject *f = PyDict_GetItemString(g_helper, fn);  // borrowed
-  if (!f) {
-    set_err(std::string("helper missing: ") + fn);
-    return nullptr;
-  }
-  return PyObject_CallObject(f, args);
+  return g_helper.call(fn, args);
 }
 
 struct Predictor {
@@ -149,10 +93,7 @@ struct Predictor {
 
 extern "C" {
 
-const char *MXTPUPredGetLastError() {
-  std::lock_guard<std::mutex> lk(g_mu);
-  return g_last_error.c_str();
-}
+const char *MXTPUPredGetLastError() { return mxtpu::last_error(); }
 
 // symbol_file: path to exported symbol JSON; param_file: path to exported
 // params (empty/NULL = uninitialized); input_names: model input names.
@@ -160,10 +101,6 @@ int MXTPUPredCreate(const char *symbol_file, const char *param_file,
                     const char **input_names, int num_inputs, void **out) {
   ensure_python();
   GIL gil;
-  if (!ensure_helper()) {
-    set_err_from_py();
-    return -1;
-  }
   PyObject *names = PyList_New(num_inputs);
   for (int i = 0; i < num_inputs; ++i)
     PyList_SetItem(names, i, PyUnicode_FromString(input_names[i]));
@@ -172,10 +109,7 @@ int MXTPUPredCreate(const char *symbol_file, const char *param_file,
   Py_DECREF(names);
   PyObject *res = helper_call("create", args);
   Py_DECREF(args);
-  if (!res) {
-    set_err_from_py();
-    return -1;
-  }
+  if (!res) return -1;
   auto *p = new Predictor();
   p->pid = PyLong_AsLong(res);
   Py_DECREF(res);
@@ -201,10 +135,7 @@ int MXTPUPredSetInput(void *handle, const char *name, const float *data,
   Py_DECREF(shp);
   PyObject *res = helper_call("set_input", args);
   Py_DECREF(args);
-  if (!res) {
-    set_err_from_py();
-    return -1;
-  }
+  if (!res) return -1;
   Py_DECREF(res);
   return 0;
 }
@@ -215,10 +146,7 @@ int MXTPUPredForward(void *handle) {
   PyObject *args = Py_BuildValue("(l)", p->pid);
   PyObject *res = helper_call("forward", args);
   Py_DECREF(args);
-  if (!res) {
-    set_err_from_py();
-    return -1;
-  }
+  if (!res) return -1;
   p->num_outputs = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   p->out_shapes.assign(p->num_outputs, {});
@@ -226,10 +154,7 @@ int MXTPUPredForward(void *handle) {
     PyObject *a = Py_BuildValue("(li)", p->pid, i);
     PyObject *s = helper_call("output_shape", a);
     Py_DECREF(a);
-    if (!s) {
-      set_err_from_py();
-      return -1;
-    }
+    if (!s) return -1;
     Py_ssize_t nd = PyList_Size(s);
     for (Py_ssize_t d = 0; d < nd; ++d)
       p->out_shapes[i].push_back(
@@ -261,10 +186,7 @@ int MXTPUPredGetOutput(void *handle, int index, float *out, size_t size) {
   PyObject *args = Py_BuildValue("(li)", p->pid, index);
   PyObject *res = helper_call("output_bytes", args);
   Py_DECREF(args);
-  if (!res) {
-    set_err_from_py();
-    return -1;
-  }
+  if (!res) return -1;
   char *buf = nullptr;
   Py_ssize_t len = 0;
   PyBytes_AsStringAndSize(res, &buf, &len);

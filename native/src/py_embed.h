@@ -1,0 +1,150 @@
+// Shared CPython-embedding scaffolding for the C ABI libraries
+// (predict.cc, c_api.cc). Each .so gets its own copy of the inline
+// variables (separate interpreters states are impossible — CPython is a
+// process singleton — but error storage and helper-module state are
+// per-library).
+//
+// Contracts provided here:
+//   - per-thread last-error storage (mxtpu_set_err / mxtpu_last_error)
+//   - safe_utf8: PyUnicode_AsUTF8 that can't construct std::string(nullptr)
+//   - GIL: RAII PyGILState_Ensure/Release
+//   - ensure_python: race-free one-time interpreter init
+//   - HelperModule: boots a python helper source into a dedicated module
+//     exactly once, with a GIL-releasing wait so a second thread arriving
+//     mid-init (the helper's imports release the GIL) cannot re-exec the
+//     source and reset the helper's live state.
+#ifndef MXTPU_PY_EMBED_H_
+#define MXTPU_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <unistd.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu {
+
+// Per-thread error storage, like the reference's MXAPIThreadLocalEntry:
+// the pointer returned by last_error() stays valid until this thread's
+// next failing call.
+inline thread_local std::string tl_last_error;
+
+inline void set_err(const std::string &e) { tl_last_error = e; }
+
+inline const char *last_error() { return tl_last_error.c_str(); }
+
+// PyUnicode_AsUTF8 can return nullptr (with an exception set);
+// degrade to a placeholder instead of constructing std::string(nullptr).
+inline std::string safe_utf8(PyObject *unicode) {
+  const char *s = unicode ? PyUnicode_AsUTF8(unicode) : nullptr;
+  if (!s) {
+    PyErr_Clear();
+    return "<non-utf8>";
+  }
+  return s;
+}
+
+inline void set_err_from_py() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      msg = safe_utf8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_err(msg);
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() { st = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(st); }
+};
+
+inline std::once_flag py_once;
+
+inline void ensure_python() {
+  std::call_once(py_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works uniformly from any caller thread
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// One python helper module per library. Call ensure() with the GIL held;
+// on success dict() is the module namespace.
+class HelperModule {
+ public:
+  HelperModule(const char *module_name, const char *source)
+      : name_(module_name), source_(source) {}
+
+  // Both flags are guarded by the GIL (only mutated while holding it).
+  // The helper's imports release the GIL internally, so a second thread
+  // can arrive mid-init: it must WAIT (releasing the GIL so the first
+  // thread's imports can finish) rather than exec the source again.
+  bool ensure() {
+    while (!dict_) {
+      if (!started_) {
+        started_ = true;
+        PyObject *mod = PyImport_AddModule(name_);  // borrowed
+        if (!mod) {
+          started_ = false;
+          return false;
+        }
+        PyObject *dict = PyModule_GetDict(mod);  // borrowed
+        PyObject *res = PyRun_String(source_, Py_file_input, dict, dict);
+        if (!res) {
+          started_ = false;
+          return false;
+        }
+        Py_DECREF(res);
+        Py_INCREF(dict);
+        dict_ = dict;
+        return true;
+      }
+      Py_BEGIN_ALLOW_THREADS
+      usleep(1000);
+      Py_END_ALLOW_THREADS
+    }
+    return true;
+  }
+
+  // Calls a helper function; returns a new reference or nullptr with the
+  // per-thread error set.
+  PyObject *call(const char *fn, PyObject *args) {
+    ensure_python();
+    if (!ensure()) {
+      set_err_from_py();
+      return nullptr;
+    }
+    PyObject *f = PyDict_GetItemString(dict_, fn);  // borrowed
+    if (!f) {
+      set_err(std::string("helper missing: ") + fn);
+      return nullptr;
+    }
+    PyObject *res = PyObject_CallObject(f, args);
+    if (!res) set_err_from_py();
+    return res;
+  }
+
+ private:
+  const char *name_;
+  const char *source_;
+  PyObject *dict_ = nullptr;
+  bool started_ = false;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PY_EMBED_H_
