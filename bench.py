@@ -251,7 +251,15 @@ def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
                         # halve the m/v HBM term: +2.5% measured;
                         # BENCH_OPT_STATE=float32 opts out
                         opt_state_dtype=os.environ.get("BENCH_OPT_STATE",
-                                                       "bfloat16"))
+                                                       "bfloat16"),
+                        # BENCH_PARAM_DTYPE=bfloat16: bf16-STORED params
+                        # with stochastic-rounding write-back (no fp32
+                        # master copy) — removes the fp32 weight
+                        # read+write HBM term entirely (opt-in; see
+                        # tests/test_opt_state_dtype.py trajectory pins)
+                        param_dtype=(
+                            lambda pd: pd if pd and pd != "float32" else None
+                        )(os.environ.get("BENCH_PARAM_DTYPE")))
     data = [mx.nd.array(ids_masked), mx.nd.array(types),
             mx.nd.array(mlm_pos.astype(np.int32))]
     label = [mx.nd.array(mlm_lab), mx.nd.array(nsp_lab)]

@@ -20,6 +20,24 @@ from ..ndarray import NDArray
 __all__ = ["ShardedTrainer", "sharding_rules"]
 
 
+def _stochastic_round(x32, dtype, key):
+    """Stochastically round float32 -> bfloat16 (unbiased: E[out] == x).
+
+    Adds uniform noise over the 16 truncated mantissa bits, then
+    truncates — the standard trick that lets bf16-STORED weights train
+    like fp32 masters: per-step updates smaller than one bf16 ulp still
+    move the weight in expectation instead of vanishing to
+    round-to-nearest. (Reference keeps fp16 training unbiased the other
+    way round, with fp32 master copies: src/operator/optimizer_op.cc
+    mp_sgd_update.)"""
+    assert jnp.dtype(dtype) == jnp.bfloat16, "SR implemented for bf16 only"
+    bits = jax.lax.bitcast_convert_type(x32.astype(jnp.float32), jnp.uint32)
+    noise = jax.random.bits(key, x32.shape, dtype=jnp.uint32) \
+        & jnp.uint32(0xFFFF)
+    bits = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(dtype)
+
+
 def sharding_rules(rules):
     """Compile [(regex, PartitionSpec), ...] into a matcher; first match wins."""
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
@@ -69,7 +87,7 @@ class ShardedTrainer:
     def __init__(self, block, loss, mesh, rules=None, optimizer="sgd",
                  optimizer_params=None, data_specs=None, label_spec=None,
                  dp_axis="dp", compute_dtype=None, zero1=False, grad_accum=1,
-                 opt_state_dtype=None):
+                 opt_state_dtype=None, param_dtype=None):
         self._block = block
         self._loss = loss
         self._mesh = mesh
@@ -87,6 +105,21 @@ class ShardedTrainer:
         # stay fp32 regardless.
         self._opt_state_dtype = (jnp.dtype(opt_state_dtype)
                                  if opt_state_dtype is not None else None)
+        # bf16-STORED parameters with stochastic-rounding write-back: no
+        # fp32 master copy at all — halves the weight read+write HBM
+        # traffic the BERT roofline names as the largest remaining
+        # non-activation term. Update math still runs fp32; the rounding
+        # is unbiased (see _stochastic_round), so sub-ulp updates
+        # accumulate in expectation. Aux (BN running stats) stay fp32.
+        self._param_dtype = (jnp.dtype(param_dtype)
+                             if param_dtype is not None else None)
+        if self._param_dtype is not None and \
+                self._param_dtype != jnp.bfloat16:
+            raise ValueError("param_dtype supports bfloat16 only")
+        if self._param_dtype is not None and self._compute_dtype is None:
+            # bf16-stored weights imply bf16 compute (the data batch must
+            # match the weights' dtype inside convs/matmuls)
+            self._compute_dtype = self._param_dtype
         hp = dict(optimizer_params or {})
         self._lr = float(hp.get("learning_rate", 0.01))
         self._momentum = float(hp.get("momentum", 0.0))
@@ -105,8 +138,16 @@ class ShardedTrainer:
         matcher = sharding_rules(rules or [])
         self._param_shardings = {n: NamedSharding(mesh, matcher(n))
                                  for n in self._diff_names + self._aux_names}
-        self._param_vals = {n: jax.device_put(params[n]._data._data,
-                                              self._param_shardings[n])
+        pdt = self._param_dtype
+
+        def _stored(n):
+            arr = params[n]._data._data
+            if pdt is not None and n in self._diff_names and \
+                    jnp.issubdtype(arr.dtype, jnp.floating):
+                arr = arr.astype(pdt)
+            return jax.device_put(arr, self._param_shardings[n])
+
+        self._param_vals = {n: _stored(n)
                             for n in self._diff_names + self._aux_names}
         self._dp_axis = dp_axis
         self._dp_size = dict(mesh.shape).get(dp_axis, 1)
@@ -199,20 +240,38 @@ class ShardedTrainer:
         state = {}
         if self._opt == "sgd" and self._momentum == 0.0:
             return state
-        sdt = self._opt_state_dtype
+        # bf16-stored params do NOT imply bf16 opt state: unless the user
+        # asked for low-precision state explicitly, slots stay fp32
+        # (state has no SR; nearest-rounded bf16 state is a separate,
+        # opt-in precision decision)
+        fallback = (jnp.float32 if self._param_dtype is not None else None)
         for n in self._diff_names:
             sh = self._zero_shardings.get(n, self._param_shardings[n])
             ref = self._param_vals[n]
-            z = jax.device_put(
-                jnp.zeros(ref.shape, sdt or ref.dtype), sh)
+            sdt = self._opt_state_dtype or fallback or ref.dtype
+            z = jax.device_put(jnp.zeros(ref.shape, sdt), sh)
             if self._opt == "sgd":
                 state[n] = (z,)
             else:
                 state[n] = (z, jax.device_put(
-                    jnp.zeros(ref.shape, sdt or ref.dtype), sh))
+                    jnp.zeros(ref.shape, sdt), sh))
         return state
 
-    def _apply_opt(self, p, g, st, t):
+    def _apply_opt(self, p, g, st, t, key=None):
+        # bf16-stored params: lift to fp32 for the update math, write back
+        # with unbiased stochastic rounding (or nearest if no key given)
+        sr = (self._param_dtype is not None and p.dtype == self._param_dtype)
+        if sr:
+            pdt = p.dtype
+            p = p.astype(jnp.float32)
+            g = g.astype(jnp.float32)
+        newp, new_st = self._apply_opt_fp(p, g, st, t)
+        if sr:
+            newp = (_stochastic_round(newp, pdt, key) if key is not None
+                    else newp.astype(pdt))
+        return newp, new_st
+
+    def _apply_opt_fp(self, p, g, st, t):
         lr, wd = self._lr, self._wd
         if self._opt == "sgd":
             if self._momentum == 0.0:
@@ -304,7 +363,13 @@ class ShardedTrainer:
                 g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g)
                 return (g_sum, new_aux, loss_sum + loss), None
 
-            g0 = jax.tree_util.tree_map(jnp.zeros_like, param_vals)
+            # accumulate in fp32 even when params are stored bf16 —
+            # microbatch contributions below one bf16 ulp must not vanish
+            g0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape,
+                                    jnp.float32 if jnp.issubdtype(
+                                        p.dtype, jnp.floating) else p.dtype),
+                param_vals)
             (grads, new_aux, loss), _ = jax.lax.scan(
                 body, (g0, aux_vals, jnp.float32(0)), (keys,) + mb)
             grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
@@ -323,8 +388,13 @@ class ShardedTrainer:
             data, label = batch[:n_data_args], batch[n_data_args:]
             grads, new_aux, loss = grads_of(param_vals, aux_vals, data,
                                             label, key)
+            # decorrelated key stream for stochastic-rounding write-back
+            upd_key = (jax.random.fold_in(key, 0x51A57)
+                       if self._param_dtype is not None else None)
             new_params, new_opt = {}, {}
-            for n in diff_names:
+            for i, n in enumerate(diff_names):
+                k_n = (jax.random.fold_in(upd_key, i)
+                       if upd_key is not None else None)
                 st = opt_state.get(n, ())
                 p, g = param_vals[n], grads[n]
                 if auto_zero and self._zero_axes[n] is not None:
@@ -339,11 +409,11 @@ class ShardedTrainer:
                     p = jax.lax.with_sharding_constraint(p, zsh)
                     st = tuple(jax.lax.with_sharding_constraint(s, zsh)
                                for s in st)
-                    newp, new_st = self._apply_opt(p, g, st, t)
+                    newp, new_st = self._apply_opt(p, g, st, t, key=k_n)
                     newp = jax.lax.with_sharding_constraint(
                         newp, self._param_shardings[n])
                 else:
-                    newp, new_st = self._apply_opt(p, g, st, t)
+                    newp, new_st = self._apply_opt(p, g, st, t, key=k_n)
                 new_params[n] = newp
                 if new_st:
                     new_opt[n] = new_st
@@ -372,6 +442,11 @@ class ShardedTrainer:
 
         def manual_step(param_vals, aux_vals, opt_state, t, key, *batch):
             data, label = batch[:n_data_args], batch[n_data_args:]
+            # SR keys must derive from the PRE-rank-fold key: replicated
+            # (ax-is-None) params apply identical rounding noise on every
+            # rank, keeping the replicas bit-identical
+            upd_key = (jax.random.fold_in(key, 0x51A57)
+                       if self._param_dtype is not None else None)
             # per-rank dropout/noise streams
             key = jax.random.fold_in(key, jax.lax.axis_index(dp))
             grads, new_aux, loss = grads_of(param_vals, aux_vals, data,
@@ -381,14 +456,16 @@ class ShardedTrainer:
                            if jnp.issubdtype(v.dtype, jnp.inexact) else v)
                        for n, v in new_aux.items()}
             new_params, new_opt = {}, {}
-            for n in diff_names:
+            for i, n in enumerate(diff_names):
+                k_n = (jax.random.fold_in(upd_key, i)
+                       if upd_key is not None else None)
                 st = opt_state.get(n, ())
                 p, g = param_vals[n], grads[n]
                 ax = zero_axes[n]
                 if ax is None:
                     # no dp-divisible dim: plain all-reduce + full update
                     g = jax.lax.pmean(g, dp)
-                    newp, new_st = self._apply_opt(p, g, st, t)
+                    newp, new_st = self._apply_opt(p, g, st, t, key=k_n)
                 else:
                     # grad mean arrives SHARDED (reduce-scatter), each rank
                     # updates only its 1/dp slice of param + opt state,
@@ -399,7 +476,8 @@ class ShardedTrainer:
                     start = jax.lax.axis_index(dp) * size
                     p_sh = jax.lax.dynamic_slice_in_dim(p, start, size,
                                                         axis=ax)
-                    newp_sh, new_st = self._apply_opt(p_sh, g, st, t)
+                    newp_sh, new_st = self._apply_opt(p_sh, g, st, t,
+                                                      key=k_n)
                     newp = jax.lax.all_gather(newp_sh, dp, axis=ax,
                                               tiled=True)
                 new_params[n] = newp
@@ -620,8 +698,17 @@ class ShardedTrainer:
             key = "param/" + n
             if key not in flat:
                 raise KeyError("checkpoint missing %s" % key)
+            v = raw(flat[key])
+            # restored params follow the trainer's CONFIGURED storage
+            # precision (a bf16-param trainer stays bf16 even from an
+            # fp32 checkpoint — no silent retrace); when no param_dtype
+            # is configured the host array goes straight to device_put
+            # (single transfer)
+            if self._param_dtype is not None and n in self._diff_names \
+                    and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
+                v = jnp.asarray(v).astype(self._param_dtype)
             self._param_vals[n] = jax.device_put(
-                raw(flat[key]), self._param_shardings[n])
+                v, self._param_shardings[n])
         new_opt = {}
         for n, st in self._opt_state.items():
             sh = self._zero_shardings.get(n, self._param_shardings[n]) \

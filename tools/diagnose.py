@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Environment diagnostics for bug reports and support.
+
+Reference parity: tools/diagnose.py (platform/python/deps/build-flags
+dump). TPU-native additions: the JAX backend and device inventory, the
+XLA virtual-device flags, whether the native C++ runtime library is
+built, and the framework's runtime feature flags (runtime.Features).
+
+Usage: python tools/diagnose.py
+"""
+
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def section(title):
+    print("----------" + title + "----------")
+
+
+def main():
+    section("Platform Info")
+    print("Platform     :", platform.platform())
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor() or "n/a")
+
+    section("Python Info")
+    print("version      :", sys.version.replace("\n", " "))
+    print("executable   :", sys.executable)
+
+    section("Dependency Versions")
+    for mod in ("numpy", "jax", "jaxlib"):
+        try:
+            m = __import__(mod)
+            print("%-12s : %s" % (mod, getattr(m, "__version__", "?")))
+        except ImportError:
+            print("%-12s : NOT INSTALLED" % mod)
+
+    section("JAX Backend")
+    try:
+        import jax
+        print("backend      :", jax.default_backend())
+        devs = jax.devices()
+        print("devices      : %d x %s" % (len(devs), devs[0].platform))
+        for d in devs[:8]:
+            print("  -", d)
+        print("XLA_FLAGS    :", os.environ.get("XLA_FLAGS", "(unset)"))
+        print("JAX_PLATFORMS:", os.environ.get("JAX_PLATFORMS", "(unset)"))
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("jax unavailable:", e)
+
+    section("Framework")
+    try:
+        import incubator_mxnet_tpu as mx
+        print("version      :", getattr(mx, "__version__", "?"))
+        from incubator_mxnet_tpu import native
+        print("native lib   :", "built" if native.available() else "NOT built"
+              " (run `make -C native`)")
+        from incubator_mxnet_tpu import runtime
+        feats = runtime.Features()
+        on = [f for f in feats.keys() if feats.is_enabled(f)]
+        print("features on  :", ", ".join(sorted(on)) or "(none)")
+    except Exception as e:  # noqa: BLE001
+        print("framework import failed:", e)
+
+    section("Environment Variables (MXTPU_*/BENCH_*)")
+    hits = {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(("MXTPU_", "BENCH_", "MXNET_"))}
+    for k, v in hits.items():
+        print("%-28s = %s" % (k, v))
+    if not hits:
+        print("(none set)")
+
+
+if __name__ == "__main__":
+    main()

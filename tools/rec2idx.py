@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Build the .idx sidecar for an existing RecordIO file.
+
+Reference parity: tools/rec2idx.py — scan a .rec once and write
+``key\toffset`` lines so MXIndexedRecordIO (and the native image
+pipeline's shuffling reader) can seek records randomly. Uses the native
+C++ scanner when the runtime library is built (native/src/recordio.cc
+scan_record_index), falling back to the python reader.
+
+Usage: python tools/rec2idx.py data.rec [data.idx]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_index(rec_path, idx_path):
+    from incubator_mxnet_tpu import native
+    if native.available():
+        offsets = [int(o) for o in native.scan_record_index(rec_path)]
+    else:
+        from incubator_mxnet_tpu.recordio import MXRecordIO
+        reader = MXRecordIO(rec_path, "r")
+        offsets = []
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            offsets.append(pos)
+        reader.close()
+    with open(idx_path, "w") as out:
+        for i, off in enumerate(offsets):
+            out.write("%d\t%d\n" % (i, off))
+    return len(offsets)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create a .idx index for a RecordIO .rec file")
+    parser.add_argument("record", help="path to the .rec file")
+    parser.add_argument("index", nargs="?", default=None,
+                        help="output .idx path (default: alongside the .rec)")
+    args = parser.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = build_index(args.record, idx)
+    print("wrote %d record offsets to %s" % (n, idx))
+
+
+if __name__ == "__main__":
+    main()
