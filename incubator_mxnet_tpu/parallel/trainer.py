@@ -704,9 +704,11 @@ class ShardedTrainer:
             # fp32 checkpoint — no silent retrace); when no param_dtype
             # is configured the host array goes straight to device_put
             # (single transfer)
+            host_dtype = getattr(v, "dtype", None)  # host-side, no transfer
             if self._param_dtype is not None and n in self._diff_names \
-                    and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
-                v = jnp.asarray(v).astype(self._param_dtype)
+                    and host_dtype is not None \
+                    and jnp.issubdtype(host_dtype, jnp.floating):
+                v = jnp.asarray(v, dtype=self._param_dtype)
             self._param_vals[n] = jax.device_put(
                 v, self._param_shardings[n])
         new_opt = {}

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Sparse operator micro-benchmarks.
+
+Reference parity: benchmark/python/sparse/{dot.py, sparse_op.py,
+cast_storage.py} — CSR x dense dot, sparse elementwise, and
+storage-cast throughput across densities. TPU-first: the CSR x dense
+dot here is the framework's static-shape gather + segment-sum SpMM
+(`ndarray/sparse.py`), timed against the dense matmul of the same
+logical shape, so the output is the density break-even point on the
+current backend rather than a cuSPARSE/MKL comparison.
+
+Usage: python tools/benchmark_sparse.py [--m 2048] [--k 2048] [--n 256]
+       [--densities 0.01,0.05,0.25] [--iters 10]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time(fn, iters):
+    fn()                                        # compile / warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _sync(out):
+    try:
+        out._data.block_until_ready()
+    except AttributeError:
+        np.asarray(out.asnumpy() if hasattr(out, "asnumpy") else out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--m", type=int, default=2048)
+    ap.add_argument("--k", type=int, default=2048)
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--densities", default="0.01,0.05,0.25")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    from incubator_mxnet_tpu import nd
+
+    m, k, n = args.m, args.k, args.n
+    rng = np.random.RandomState(0)
+    dense_rhs = nd.array(rng.rand(k, n).astype(np.float32))
+
+    print("CSR x dense dot, (%d x %d) @ (%d x %d), %d iters/point"
+          % (m, k, k, n, args.iters))
+    print("%-10s %-14s %-14s %-10s" % ("density", "sparse ms", "dense ms",
+                                       "ratio"))
+    for dens in [float(d) for d in args.densities.split(",")]:
+        lhs = rng.rand(m, k).astype(np.float32)
+        lhs[rng.rand(m, k) >= dens] = 0.0
+        lhs_csr = nd.sparse.csr_matrix(nd.array(lhs))
+        lhs_dense = nd.array(lhs)
+        t_sp = _time(lambda: nd.sparse.dot(lhs_csr, dense_rhs), args.iters)
+        t_dn = _time(lambda: nd.dot(lhs_dense, dense_rhs), args.iters)
+        print("%-10.3f %-14.3f %-14.3f %-10.2f"
+              % (dens, t_sp * 1e3, t_dn * 1e3, t_dn / t_sp))
+
+    # storage cast (reference cast_storage.py)
+    print("\ncast_storage round trips, %d x %d at 5%% density" % (m, k))
+    lhs = rng.rand(m, k).astype(np.float32)
+    lhs[rng.rand(m, k) >= 0.05] = 0.0
+    d = nd.array(lhs)
+    t_to = _time(lambda: nd.sparse.csr_matrix(d), args.iters)
+    csr = nd.sparse.csr_matrix(d)
+    t_back = _time(lambda: csr.tostype("default"), args.iters)
+    print("dense->csr %.3f ms   csr->dense %.3f ms"
+          % (t_to * 1e3, t_back * 1e3))
+
+    # row-sparse updater (reference updater.py): lazy row update vs full
+    print("\nrow-sparse SGD update, %d x %d table, 1%% rows touched" % (m, k))
+    from incubator_mxnet_tpu import optimizer as opt
+    table = nd.array(rng.rand(m, k).astype(np.float32))
+    nrows = max(1, m // 100)
+    rows = np.unique(rng.randint(0, m, nrows)).astype(np.int64)
+    grad_rows = nd.array(rng.rand(len(rows), k).astype(np.float32))
+    grad_rs = nd.sparse.row_sparse_array((grad_rows, nd.array(rows)),
+                                         shape=(m, k))
+    sgd = opt.SGD(learning_rate=0.1)
+    state = sgd.create_state(0, table)
+
+    def upd():
+        sgd.update(0, table, grad_rs, state)
+        return table
+    t_rs = _time(upd, args.iters)
+    grad_full = nd.array(np.zeros((m, k), np.float32))
+    t_full = _time(lambda: sgd.update(0, table, grad_full, state) or table,
+                   args.iters)
+    print("row-sparse %.3f ms   dense %.3f ms   ratio %.2f"
+          % (t_rs * 1e3, t_full * 1e3, t_full / max(t_rs, 1e-9)))
+
+
+if __name__ == "__main__":
+    main()
