@@ -74,6 +74,7 @@ from . import visualization as viz
 from .executor import CachedOp
 from . import module as mod
 from . import module
+from . import rnn
 from .model import save_checkpoint, load_checkpoint
 from . import model
 from . import executor_manager

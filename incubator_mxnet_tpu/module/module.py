@@ -111,18 +111,21 @@ class Module(BaseModule):
             return
         assert self.binded
         initializer = initializer or _initmod.Uniform(0.01)
+        # graph attrs carry per-variable overrides (__init__ from
+        # sym.var(init=...)); InitDesc hands them to the initializer
+        attrs = self._symbol.attr_dict()
         for name, arr in self._exec.arg_dict.items():
             if name in self._data_names or name in self._label_names:
                 continue
             if arg_params is not None and name in arg_params:
                 arr._data = arg_params[name]._data
             else:
-                initializer(_initmod.InitDesc(name), arr)
+                initializer(_initmod.InitDesc(name, attrs.get(name)), arr)
         for name, arr in self._exec.aux_dict.items():
             if aux_params is not None and name in aux_params:
                 arr._data = aux_params[name]._data
             else:
-                initializer(_initmod.InitDesc(name), arr)
+                initializer(_initmod.InitDesc(name, attrs.get(name)), arr)
         self.params_initialized = True
 
     def _resolve_kvstore(self, kvstore):

@@ -1,0 +1,16 @@
+"""Legacy symbolic RNN API (reference: python/mxnet/rnn/).
+
+The Module-era RNN surface: symbol-building cells with explicit
+``unroll``, shared-parameter containers, and the bucketing sentence
+iterator. The gluon cell zoo (``gluon.rnn``) is the modern path; this
+package exists so reference bucketing/Module workflows port directly.
+"""
+
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BucketSentenceIter"]

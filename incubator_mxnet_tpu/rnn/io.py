@@ -1,0 +1,120 @@
+"""Bucketing sentence iterator (reference: python/mxnet/rnn/io.py).
+
+Groups variable-length integer sequences into length buckets, pads each
+sentence to its bucket's length, and yields fixed-shape batches tagged
+with ``bucket_key`` — the contract BucketingModule switches executors
+on. TPU-first: every bucket is one static shape, so each bucket compiles
+exactly one XLA program.
+"""
+
+import numpy as np
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter(DataIter):
+    """reference: rnn/io.py BucketSentenceIter.
+
+    Parameters
+    ----------
+    sentences : list of list/array of int token ids
+    batch_size : int
+    buckets : sorted list of bucket lengths (default: auto from data —
+        every distinct length with enough sentences to fill a batch)
+    invalid_label : padding id (also the label for padded positions)
+    data_name, label_name : names for provide_data/provide_label
+    label : optional per-sentence label sequences; default is the input
+        shifted left by one (language modeling)
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT", label=None, shuffle=True,
+                 seed=0):
+        super().__init__(batch_size)
+        if buckets is None:
+            lens = np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(lens)
+                       if n >= batch_size and i > 0]
+        buckets = sorted(buckets)
+        assert buckets, "no buckets (each needs >= batch_size sentences)"
+        self.buckets = buckets
+        self.batch_size = batch_size
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+        # assign each sentence to the smallest bucket that fits; drop
+        # sentences longer than the largest bucket (reference behavior)
+        self.data = [[] for _ in buckets]
+        self.label_data = [[] for _ in buckets]
+        for idx, s in enumerate(sentences):
+            buck = np.searchsorted(buckets, len(s))
+            if buck == len(buckets):
+                continue
+            padded = np.full((buckets[buck],), invalid_label, np.int32)
+            padded[:len(s)] = s
+            self.data[buck].append(padded)
+            if label is not None:
+                lab = np.full((buckets[buck],), invalid_label, np.int32)
+                lab[:len(label[idx])] = label[idx]
+            else:
+                lab = np.full((buckets[buck],), invalid_label, np.int32)
+                lab[:len(s) - 1] = s[1:]
+            self.label_data[buck].append(lab)
+        self.data = [np.asarray(d, np.int32) for d in self.data]
+        self.label_data = [np.asarray(d, np.int32) for d in self.label_data]
+
+        self.layout = layout
+        if layout not in ("NT", "TN"):
+            raise ValueError("layout must be 'NT' or 'TN', got %r" % layout)
+        self.default_bucket_key = max(buckets)
+        self.provide_data = [DataDesc(data_name,
+                                      self._shape(self.default_bucket_key),
+                                      dtype)]
+        self.provide_label = [DataDesc(label_name,
+                                       self._shape(self.default_bucket_key),
+                                       dtype)]
+        self.reset()
+
+    def _shape(self, seq_len):
+        return ((self.batch_size, seq_len) if self.layout == "NT"
+                else (seq_len, self.batch_size))
+
+    def reset(self):
+        self._plan = []
+        for buck, d in enumerate(self.data):
+            order = np.arange(len(d))
+            if self._shuffle:
+                self._rng.shuffle(order)
+            for start in range(0, len(d) - self.batch_size + 1,
+                               self.batch_size):
+                self._plan.append((buck, order[start:start + self.batch_size]))
+        if self._shuffle:
+            self._rng.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        buck, rows = self._plan[self._cursor]
+        self._cursor += 1
+        from .. import nd
+        T = self.buckets[buck]
+        data_np = self.data[buck][rows].astype(self.dtype)
+        lab_np = self.label_data[buck][rows].astype(self.dtype)
+        if self.layout == "TN":
+            data_np, lab_np = data_np.T, lab_np.T
+        data = nd.array(data_np)
+        lab = nd.array(lab_np)
+        return DataBatch(
+            data=[data], label=[lab], bucket_key=T,
+            provide_data=[DataDesc(self.data_name, self._shape(T),
+                                   self.dtype)],
+            provide_label=[DataDesc(self.label_name, self._shape(T),
+                                    self.dtype)])

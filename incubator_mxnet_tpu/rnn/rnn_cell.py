@@ -1,0 +1,396 @@
+"""Symbolic RNN cells (reference: python/mxnet/rnn/rnn_cell.py).
+
+Each cell is a Symbol factory: ``cell(x_t, states)`` appends one step's
+subgraph and returns ``(output, new_states)``; ``unroll`` lays out T
+steps. Parameters are shared ``sym.Variable``s handed out by an
+``RNNParams`` container, so every step (and every bucket in a
+BucketingModule) binds the same arrays.
+
+TPU-first departures from the reference:
+
+- ``begin_state`` takes an explicit ``batch_size`` and emits static-shape
+  ``sym.zeros`` — XLA wants static shapes; the reference's 0-as-unknown
+  placeholder shape is not supported. Callers that need externally-fed
+  states pass their own begin_state symbols.
+- There is no cuDNN "fused" variant to fall back from: an unrolled graph
+  jits into one XLA program, and the truly fused path is the gluon
+  ``ops/rnn.py`` lax.scan kernel.
+"""
+
+from .. import symbol as sym
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams(object):
+    """Container handing out shared weight Variables by name
+    (reference: rnn_cell.py RNNParams)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell(object):
+    """Abstract symbolic cell (reference: rnn_cell.py BaseRNNCell)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._counter = 0
+
+    @property
+    def params(self):
+        return self._params
+
+    @property
+    def state_info(self):
+        """List of dicts: one {'shape': (0, n), '__layout__': 'NC'} per
+        state. The leading 0 is documentation only — begin_state fills in
+        the real batch size."""
+        raise NotImplementedError
+
+    def begin_state(self, batch_size, func=None, **kwargs):
+        """Initial-state symbols at a STATIC batch size (see module
+        docstring for why the reference's deferred shape is not kept)."""
+        states = []
+        for i, info in enumerate(self.state_info):
+            shape = (batch_size,) + tuple(info["shape"][1:])
+            name = "%sbegin_state_%d" % (self._prefix, i)
+            if func is None:
+                states.append(sym.zeros(shape=shape, name=name, **kwargs))
+            else:
+                states.append(func(shape=shape, name=name, **kwargs))
+        return states
+
+    def reset(self):
+        self._counter = 0
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- unroll
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll T steps (reference: rnn_cell.py BaseRNNCell.unroll).
+
+        inputs: one Symbol of layout ``layout`` (sliced internally) or a
+        list of per-step Symbols. Returns (outputs, states) where outputs
+        is a list, or one merged Symbol of layout ``layout`` when
+        merge_outputs=True."""
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = list(sym.SliceChannel(inputs, num_outputs=length,
+                                           axis=axis, squeeze_axis=1))
+        assert len(inputs) == length
+        if begin_state is None:
+            raise ValueError(
+                "begin_state is required: call cell.begin_state(batch_size)"
+                " (static shapes; see rnn_cell.py docstring)")
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            out, states = self(inputs[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            expanded = [sym.expand_dims(o, axis=axis) for o in outputs]
+            outputs = sym.concat(*expanded, dim=axis)
+        return outputs, states
+
+    # ------------------------------------------------------------ helpers
+    def _get_activation(self, x, activation, **kwargs):
+        if isinstance(activation, str):
+            return sym.Activation(x, act_type=activation, **kwargs)
+        return activation(x, **kwargs)
+
+
+class RNNCell(BaseRNNCell):
+    """Elman cell: h' = act(W x + R h + b) (reference: rnn_cell.py
+    RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=self._num_hidden,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=self._num_hidden,
+                                 name="%sh2h" % name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM (reference: rnn_cell.py LSTMCell; gate order i, f, c, o).
+    States: [h, c]."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        from ..initializer import LSTMBias
+        self._iW = self.params.get("i2h_weight")
+        # reference semantics: forget_bias lives in the TRAINABLE bias's
+        # initial value (init.LSTMBias), NOT as a permanent in-graph
+        # constant — so checkpoints round-trip with the reference
+        self._iB = self.params.get("i2h_bias",
+                                   init=LSTMBias(forget_bias=forget_bias))
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name="%sh2h" % name)
+        gates = i2h + h2h
+        sliced = sym.SliceChannel(gates, num_outputs=4, axis=1,
+                                  name="%sslice" % name)
+        in_gate = sym.sigmoid(sliced[0])
+        forget_gate = sym.sigmoid(sliced[1])
+        in_transform = sym.tanh(sliced[2])
+        out_gate = sym.sigmoid(sliced[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * sym.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU (reference: rnn_cell.py GRUCell; gate order r, z, n)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name="%sh2h" % name)
+        i_r, i_z, i_n = tuple(sym.SliceChannel(i2h, num_outputs=3, axis=1))
+        h_r, h_z, h_n = tuple(sym.SliceChannel(h2h, num_outputs=3, axis=1))
+        reset = sym.sigmoid(i_r + h_r)
+        update = sym.sigmoid(i_z + h_z)
+        new = sym.tanh(i_n + reset * h_n)
+        next_h = update * states[0] + (1.0 - update) * new
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells applied in sequence per step (reference:
+    rnn_cell.py SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return sum((c.state_info for c in self._cells), [])
+
+    def begin_state(self, batch_size, func=None, **kwargs):
+        return sum((c.begin_state(batch_size, func=func, **kwargs)
+                    for c in self._cells), [])
+
+    def reset(self):
+        for c in self._cells:
+            c.reset()
+
+    def __call__(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            inputs, st = cell(inputs, states[pos:pos + n])
+            next_states.extend(st)
+            pos += n
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on the step output; stateless (reference: rnn_cell.py
+    DropoutCell)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self._dropout > 0:
+            inputs = sym.Dropout(inputs, p=self._dropout)
+        return inputs, states
+
+
+class ZoneoutCell(BaseRNNCell):
+    """Zoneout wrapper: randomly keep previous states (reference:
+    rnn_cell.py ZoneoutCell). Output zoneout applies to state 0."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(prefix=base_cell._prefix + "zoneout_",
+                         params=base_cell.params)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, batch_size, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func=func, **kwargs)
+
+    def reset(self):
+        self.base_cell.reset()
+
+    def _mask(self, p, like):
+        # dropout of ones = keep-mask scaled by 1/(1-p); rescale back
+        return sym.Dropout(sym.ones_like(like), p=p) * (1.0 - p)
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        if self._zs > 0:
+            mixed = []
+            for prev, new in zip(states, next_states):
+                m = self._mask(self._zs, new)
+                mixed.append(m * new + (1.0 - m) * prev)
+            next_states = mixed
+        if self._zo > 0:
+            m = self._mask(self._zo, out)
+            out = m * out + (1.0 - m) * states[0]
+        return out, next_states
+
+
+class ResidualCell(BaseRNNCell):
+    """Residual wrapper: output = cell(x) + x (reference: rnn_cell.py
+    ResidualCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell._prefix + "residual_",
+                         params=base_cell.params)
+        self.base_cell = base_cell
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, batch_size, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func=func, **kwargs)
+
+    def reset(self):
+        self.base_cell.reset()
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        return out + inputs, next_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Run one cell forward and one backward over the sequence; only
+    meaningful through unroll (reference: rnn_cell.py
+    BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self._l_cell = l_cell
+        self._r_cell = r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l_cell.state_info + self._r_cell.state_info
+
+    def begin_state(self, batch_size, func=None, **kwargs):
+        return (self._l_cell.begin_state(batch_size, func=func, **kwargs)
+                + self._r_cell.begin_state(batch_size, func=func, **kwargs))
+
+    def reset(self):
+        self._l_cell.reset()
+        self._r_cell.reset()
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell can only be unrolled (reference raises the "
+            "same way: per-step calls cannot see the future)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = list(sym.SliceChannel(inputs, num_outputs=length,
+                                           axis=axis, squeeze_axis=1))
+        if begin_state is None:
+            raise ValueError("begin_state is required (static shapes)")
+        nl = len(self._l_cell.state_info)
+        l_out, l_states = self._l_cell.unroll(
+            length, inputs, begin_state=begin_state[:nl], layout=layout,
+            merge_outputs=False)
+        r_out, r_states = self._r_cell.unroll(
+            length, list(reversed(inputs)), begin_state=begin_state[nl:],
+            layout=layout, merge_outputs=False)
+        outputs = [sym.concat(f, b, dim=1,
+                              name="%st%d" % (self._output_prefix, t))
+                   for t, (f, b) in enumerate(zip(l_out,
+                                                  reversed(r_out)))]
+        if merge_outputs:
+            expanded = [sym.expand_dims(o, axis=axis) for o in outputs]
+            outputs = sym.concat(*expanded, dim=axis)
+        return outputs, l_states + r_states

@@ -71,6 +71,24 @@ class Symbol:
     def list_attr(self):
         return dict(self._attrs)
 
+    def attr_dict(self):
+        """Per-node attribute map over the whole graph
+        (reference: symbol.py attr_dict) — {node_name: {attr: value}}."""
+        out = {}
+        seen = set()
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for inp in s._inputs:
+                walk(inp)
+            if s._attrs:
+                out.setdefault(s._name, {}).update(s._attrs)
+
+        walk(self)
+        return out
+
     def __repr__(self):
         return "<Symbol %s>" % self._name
 
@@ -546,6 +564,11 @@ def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
         attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        # per-variable initializer override (reference: symbol.py var's
+        # init= → __init__ attr, honored by Initializer.__call__)
+        attrs["__init__"] = init.dumps() if hasattr(init, "dumps") \
+            else str(init)
     attrs.update(kwargs)
     return Symbol(None, name, [], attrs)
 

@@ -1,0 +1,267 @@
+"""Legacy symbolic RNN API (mx.rnn — reference python/mxnet/rnn/):
+cell numerics vs numpy oracles, wrappers, BucketSentenceIter contract,
+and an end-to-end BucketingModule training run over two buckets."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _sig(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _bind_forward(out_sym, args):
+    ex = out_sym.bind(args={k: nd.array(v) for k, v in args.items()})
+    return ex.forward()[0].asnumpy()
+
+
+def test_lstm_cell_unroll_matches_numpy():
+    np.random.seed(0)
+    B, T, C, H = 2, 4, 3, 5
+    cell = mx.rnn.LSTMCell(H, prefix="l0_", forget_bias=0.0)
+    data = mx.sym.Variable("data")
+    outs, states = cell.unroll(T, data, begin_state=cell.begin_state(B),
+                               merge_outputs=True)
+    x = np.random.randn(B, T, C).astype(np.float32)
+    wi = np.random.randn(4 * H, C).astype(np.float32) * 0.3
+    bi = np.random.randn(4 * H).astype(np.float32) * 0.1
+    wh = np.random.randn(4 * H, H).astype(np.float32) * 0.3
+    bh = np.random.randn(4 * H).astype(np.float32) * 0.1
+    got = _bind_forward(outs, {"data": x, "l0_i2h_weight": wi,
+                               "l0_i2h_bias": bi, "l0_h2h_weight": wh,
+                               "l0_h2h_bias": bh})
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    ref = []
+    for t in range(T):
+        g = x[:, t] @ wi.T + bi + h @ wh.T + bh
+        i, f, n, o = np.split(g, 4, axis=1)
+        c = _sig(f) * c + _sig(i) * np.tanh(n)
+        h = _sig(o) * np.tanh(c)
+        ref.append(h)
+    np.testing.assert_allclose(got, np.stack(ref, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_gru_cell_unroll_matches_numpy():
+    np.random.seed(1)
+    B, T, C, H = 3, 3, 4, 6
+    cell = mx.rnn.GRUCell(H, prefix="g0_")
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(T, data, begin_state=cell.begin_state(B),
+                          merge_outputs=True)
+    x = np.random.randn(B, T, C).astype(np.float32)
+    wi = np.random.randn(3 * H, C).astype(np.float32) * 0.3
+    bi = np.random.randn(3 * H).astype(np.float32) * 0.1
+    wh = np.random.randn(3 * H, H).astype(np.float32) * 0.3
+    bh = np.random.randn(3 * H).astype(np.float32) * 0.1
+    got = _bind_forward(outs, {"data": x, "g0_i2h_weight": wi,
+                               "g0_i2h_bias": bi, "g0_h2h_weight": wh,
+                               "g0_h2h_bias": bh})
+    h = np.zeros((B, H), np.float32)
+    ref = []
+    for t in range(T):
+        gi = x[:, t] @ wi.T + bi
+        gh = h @ wh.T + bh
+        i_r, i_z, i_n = np.split(gi, 3, axis=1)
+        h_r, h_z, h_n = np.split(gh, 3, axis=1)
+        r = _sig(i_r + h_r)
+        z = _sig(i_z + h_z)
+        n = np.tanh(i_n + r * h_n)
+        h = z * h + (1 - z) * n
+        ref.append(h)
+    np.testing.assert_allclose(got, np.stack(ref, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_sequential_residual_dropout_shapes():
+    B, T, C, H = 2, 3, 5, 5          # residual needs C == H
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(H, prefix="s0_"))
+    stack.add(mx.rnn.DropoutCell(0.0))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.RNNCell(H, prefix="s1_")))
+    data = mx.sym.Variable("data")
+    outs, states = stack.unroll(T, data,
+                                begin_state=stack.begin_state(B),
+                                merge_outputs=True)
+    assert len(states) == 3          # lstm h,c + rnn h
+    rng = np.random.RandomState(0)
+    args = {"data": rng.randn(B, T, C).astype(np.float32)}
+    for n in outs.list_arguments():
+        if n == "data":
+            continue
+        shp = {"s0_i2h_weight": (4 * H, C), "s0_i2h_bias": (4 * H,),
+               "s0_h2h_weight": (4 * H, H), "s0_h2h_bias": (4 * H,),
+               "s1_i2h_weight": (H, H), "s1_i2h_bias": (H,),
+               "s1_h2h_weight": (H, H), "s1_h2h_bias": (H,)}[n]
+        args[n] = (rng.randn(*shp) * 0.1).astype(np.float32)
+    out = _bind_forward(outs, args)
+    assert out.shape == (B, T, H)
+
+
+def test_bidirectional_cell_concats_directions():
+    B, T, C, H = 2, 3, 4, 5
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(H, prefix="f_"),
+                                  mx.rnn.RNNCell(H, prefix="b_"))
+    data = mx.sym.Variable("data")
+    outs, states = bi.unroll(T, data, begin_state=bi.begin_state(B),
+                             merge_outputs=True)
+    rng = np.random.RandomState(2)
+    args = {"data": rng.randn(B, T, C).astype(np.float32)}
+    for pre in ("f_", "b_"):
+        args[pre + "i2h_weight"] = (rng.randn(H, C) * 0.1).astype(np.float32)
+        args[pre + "i2h_bias"] = np.zeros(H, np.float32)
+        args[pre + "h2h_weight"] = (rng.randn(H, H) * 0.1).astype(np.float32)
+        args[pre + "h2h_bias"] = np.zeros(H, np.float32)
+    out = _bind_forward(outs, args)
+    assert out.shape == (B, T, 2 * H)
+    with pytest.raises(NotImplementedError):
+        bi(data, bi.begin_state(B))
+
+
+def test_shared_params_across_unrolls():
+    """Two unrolls from ONE params container share weight Variables —
+    the property bucketing relies on."""
+    params = mx.rnn.RNNParams("shared_")
+    c1 = mx.rnn.LSTMCell(4, prefix="shared_", params=params)
+    data = mx.sym.Variable("data")
+    o3, _ = c1.unroll(3, data, begin_state=c1.begin_state(2))
+    c1.reset()
+    o5, _ = c1.unroll(5, data, begin_state=c1.begin_state(2))
+    a3 = set(o3[-1].list_arguments()) - {"data"}
+    a5 = set(o5[-1].list_arguments()) - {"data"}
+    assert a3 == a5 and len(a3) == 4
+
+
+def test_bucket_sentence_iter_contract():
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 20, L)) for L in
+                 [3] * 8 + [5] * 8 + [9] * 3]      # 9s: too few for a batch
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[3, 5],
+                                   invalid_label=0)
+    assert it.default_bucket_key == 5
+    seen = set()
+    n_batches = 0
+    for batch in it:
+        n_batches += 1
+        seen.add(batch.bucket_key)
+        assert batch.data[0].shape == (4, batch.bucket_key)
+        assert batch.provide_data[0].shape == (4, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        lab = batch.label[0].asnumpy()
+        # label is data shifted left; final position padded
+        np.testing.assert_array_equal(lab[:, :-1], d[:, 1:])
+        assert (lab[:, -1] == 0).all()
+    assert seen == {3, 5}
+    assert n_batches == 4                      # 8/4 per bucket
+    it.reset()
+    assert sum(1 for _ in it) == 4
+
+
+def test_bucketing_module_trains_with_rnn_cells():
+    """End-to-end: sym_gen builds an Embedding+LSTM+SoftmaxOutput graph
+    per bucket with SHARED cell params; BucketingModule fit switches
+    executors per batch and the next-token accuracy on a deterministic
+    pattern task beats chance by a wide margin."""
+    V, H, B = 12, 32, 8
+    rng = np.random.RandomState(0)
+    # deterministic cyclic "language": next token = (t + 2) % 10 + 1
+    sentences = []
+    for L in [4] * 24 + [6] * 24:
+        start = rng.randint(1, 11)
+        sentences.append([(start + k) % 10 + 1 for k in range(L)])
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=B, buckets=[4, 6],
+                                   invalid_label=0)
+
+    cell = mx.rnn.LSTMCell(H, prefix="lm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, mx.sym.Variable("embed_weight"),
+                                 input_dim=V, output_dim=H, name="embed")
+        cell.reset()
+        outs, _ = cell.unroll(seq_len, embed,
+                              begin_state=cell.begin_state(B),
+                              merge_outputs=True)
+        pred = mx.sym.reshape(outs, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, mx.sym.Variable("cls_weight"),
+                                     mx.sym.Variable("cls_bias"),
+                                     num_hidden=V, name="cls")
+        label_flat = mx.sym.reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label_flat, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    class FlatAcc(mx.metric.EvalMetric):
+        """Next-token accuracy with (B*T, V) preds vs (B, T) labels,
+        ignoring padding id 0."""
+
+        def __init__(self):
+            super().__init__("flat_acc")
+
+        def update(self, labels, preds):
+            lab = labels[0].asnumpy().reshape(-1).astype(np.int64)
+            pred = preds[0].asnumpy().argmax(1)
+            keep = lab != 0
+            self.sum_metric += float((pred[keep] == lab[keep]).sum())
+            self.num_inst += int(keep.sum())
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.fit(it, num_epoch=15,
+            initializer=mx.init.Xavier(),
+            optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            eval_metric=FlatAcc())
+    # evaluate next-token accuracy over both buckets, ignoring padding
+    correct, total = 0, 0
+    it.reset()
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()     # (B*T, V)
+        lab = batch.label[0].asnumpy().reshape(-1)
+        keep = lab != 0
+        correct += (out.argmax(1)[keep] == lab[keep]).sum()
+        total += keep.sum()
+    acc = correct / total
+    assert acc > 0.8, acc                        # chance ~= 0.1
+
+
+def test_bucket_iter_layout_and_dtype():
+    rng = np.random.RandomState(1)
+    sentences = [list(rng.randint(1, 9, 4)) for _ in range(8)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4],
+                                   invalid_label=0, layout="TN",
+                                   dtype="int32")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 4)        # (T, N)
+    assert str(batch.data[0].dtype) == "int32"
+    assert batch.provide_data[0].shape == (4, 4)
+    # emitted dtype matches the advertised DataDesc dtype
+    it2 = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4],
+                                    invalid_label=0)
+    b2 = next(iter(it2))
+    assert str(b2.data[0].dtype) == str(np.dtype(it2.provide_data[0].dtype))
+    with pytest.raises(ValueError):
+        mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4],
+                                  layout="TNC")
+
+
+def test_lstm_forget_bias_initializes_trainable_bias():
+    """Reference semantics: forget_bias is the INITIAL VALUE of the
+    forget slice of i2h_bias (init.LSTMBias via the variable's __init__
+    attr), not an in-graph constant — so checkpoints round-trip."""
+    H, B, T, C = 4, 2, 2, 3
+    cell = mx.rnn.LSTMCell(H, prefix="fb_", forget_bias=2.5)
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(T, data, begin_state=cell.begin_state(B),
+                          merge_outputs=True)
+    mod = mx.mod.Module(outs, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (B, T, C))], for_training=False)
+    mod.init_params(initializer=mx.init.Xavier())
+    args, _ = mod.get_params()
+    bias = args["fb_i2h_bias"].asnumpy()
+    np.testing.assert_allclose(bias[H:2 * H], 2.5)      # forget slice
+    np.testing.assert_allclose(bias[:H], 0.0)
+    np.testing.assert_allclose(bias[2 * H:], 0.0)
