@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Bucketing LSTM language model via the legacy symbolic API.
+
+Reference parity: example/rnn/bucketing/lstm_bucketing.py — the
+Module-era workflow: `mx.rnn.BucketSentenceIter` groups sentences into
+length buckets, `sym_gen(seq_len)` unrolls a shared-parameter
+`mx.rnn.LSTMCell` stack per bucket, and `BucketingModule.fit` switches
+executors per batch. On TPU each bucket is exactly one static-shape XLA
+program; parameters are shared across buckets through the same arrays.
+
+Zero-egress stand-in for PTB: sentences drawn from this repo's own docs
+(word-level), like examples/rnn/word_lm.py.
+"""
+
+import argparse
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+
+def load_corpus_sentences(max_vocab=2000):
+    """Word-level sentences from the repo docs (zero-egress corpus)."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    text = []
+    for fn in ("README.md", "SURVEY.md", "BENCHMARKS.md",
+               os.path.join("docs", "ARCHITECTURE.md")):
+        path = os.path.join(root, fn)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                text.append(f.read().lower())
+    sents = []
+    for line in "\n".join(text).split("\n"):
+        words = re.findall(r"[a-z']+", line)
+        if len(words) >= 4:
+            sents.append(words)
+    from collections import Counter
+    counts = Counter(w for s in sents for w in s)
+    vocab = {w: i + 1 for i, (w, _) in
+             enumerate(counts.most_common(max_vocab - 1))}  # 0 = pad
+    ids = [[vocab.get(w, len(vocab)) for w in s] for s in sents]
+    return ids, len(vocab) + 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-hidden", type=int, default=128)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--buckets", default="8,16,24,32")
+    ap.add_argument("--lr", type=float, default=0.005)
+    args = ap.parse_args()
+
+    sentences, vocab_size = load_corpus_sentences()
+    buckets = [int(b) for b in args.buckets.split(",")]
+    it = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                   buckets=buckets, invalid_label=0)
+    print("vocab %d, %d sentences, buckets %s"
+          % (vocab_size, len(sentences), buckets))
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(args.num_hidden, prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, mx.sym.Variable("embed_weight"),
+                                 input_dim=vocab_size,
+                                 output_dim=args.num_hidden, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, embed,
+                                  begin_state=stack.begin_state(
+                                      args.batch_size),
+                                  merge_outputs=True)
+        pred = mx.sym.reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, mx.sym.Variable("cls_weight"),
+                                     mx.sym.Variable("cls_bias"),
+                                     num_hidden=vocab_size, name="pred")
+        label_flat = mx.sym.reshape(label, shape=(-1,))
+        return (mx.sym.SoftmaxOutput(pred, label_flat, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    class MaskedPerplexity(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("masked_ppl")
+
+        def update(self, labels, preds):
+            lab = labels[0].asnumpy().reshape(-1).astype(np.int64)
+            p = preds[0].asnumpy()
+            keep = lab != 0
+            probs = p[np.arange(len(lab)), lab][keep]
+            self.sum_metric += float(-np.log(np.maximum(probs, 1e-10)).sum())
+            self.num_inst += int(keep.sum())
+
+        def get(self):
+            name, val = super().get()
+            return name, float(np.exp(val))
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.fit(it, num_epoch=args.num_epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric=MaskedPerplexity(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+
+if __name__ == "__main__":
+    main()
