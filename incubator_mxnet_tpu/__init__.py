@@ -75,6 +75,8 @@ from .executor import CachedOp
 from . import module as mod
 from . import module
 from . import rnn
+from . import util
+from . import registry
 from .model import save_checkpoint, load_checkpoint
 from . import model
 from . import executor_manager

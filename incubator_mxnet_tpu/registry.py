@@ -1,0 +1,90 @@
+"""Generic class-registry machinery (reference: python/mxnet/registry.py).
+
+The reference exposes factory helpers that build ``register``/``alias``/
+``create`` functions for a base class (used by Optimizer, Initializer,
+EvalMetric). The framework's own registries predate this module, so it
+serves user-defined class families: call ``get_register_func`` /
+``get_alias_func`` / ``get_create_func`` on your own base class and get
+the same register-by-name + create-from-name-or-JSON protocol.
+"""
+
+import json
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def _registry(base_class):
+    return _REGISTRIES.setdefault(base_class, {})
+
+
+def get_register_func(base_class, nickname):
+    """Build a decorator registering subclasses of ``base_class`` by
+    lowercase name (reference: registry.py get_register_func)."""
+    registry = _registry(base_class)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "%s must subclass %s" % (klass, base_class.__name__)
+        key = (name or klass.__name__).lower()
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (
+        base_class.__name__, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Build a decorator adding alias names for a registered class
+    (reference: registry.py get_alias_func; routes through register so
+    the subclass check applies to aliases too)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for a in aliases:
+                register(klass, name=a)
+            return klass
+        return reg
+
+    alias.__doc__ = "Alias names for registered %s" % nickname
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build a create() accepting an instance, a name (+kwargs), or the
+    '["name", {kwargs}]' JSON form (reference: registry.py
+    get_create_func)."""
+    registry = _registry(base_class)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            assert len(args) == 1 and not kwargs
+            return args[0]
+        if not args:
+            # kwargs-only form: create(sampler="name", other_kwarg=...)
+            # (reference: create pops the nickname keyword)
+            if nickname not in kwargs:
+                raise ValueError(
+                    "create needs a name argument or %s= keyword"
+                    % nickname)
+            args = (kwargs.pop(nickname),)
+        name = args[0]
+        if not isinstance(name, str):
+            raise ValueError(
+                "%s name must be a string or %s instance, got %r"
+                % (nickname, base_class.__name__, name))
+        args = args[1:]
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+        key = name.lower()
+        if key not in registry:
+            raise ValueError("%s is not registered as a %s (have: %s)"
+                             % (name, nickname, sorted(registry)))
+        return registry[key](*args, **kwargs)
+
+    create.__doc__ = "Create a %s instance by name" % nickname
+    return create

@@ -1,0 +1,24 @@
+"""General utilities (reference: python/mxnet/util.py)."""
+
+import os
+
+__all__ = ["makedirs", "get_gpu_count", "get_tpu_count"]
+
+
+def makedirs(d):
+    """Create directory recursively if it does not exist
+    (reference: util.py makedirs)."""
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    """Number of visible GPU devices (reference: util.py get_gpu_count;
+    0 on TPU/CPU hosts)."""
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_tpu_count():
+    """Number of visible TPU devices (TPU-native addition)."""
+    from .context import num_tpus
+    return num_tpus()

@@ -121,6 +121,27 @@ int MXTPUExecutorArgGrad(ExecutorHandle h, const char *arg_name,
                          NDArrayHandle *out);
 int MXTPUExecutorFree(ExecutorHandle h);
 
+/* --------------------------------------------------------------- kvstore */
+typedef void *KVStoreHandle;
+
+/* type: "local" | "device" | "dist_sync" | "dist_async"
+ * (reference: MXKVStoreCreate). */
+int MXTPUKVStoreCreate(const char *type, KVStoreHandle *out);
+/* String-keyed init/push/pull (reference: MXKVStoreInitEx/PushEx/PullEx;
+ * the int-key forms are the same calls with stringified keys). Pull
+ * writes INTO the provided arrays. */
+int MXTPUKVStoreInitEx(KVStoreHandle h, int num, const char **keys,
+                       NDArrayHandle *vals);
+int MXTPUKVStorePushEx(KVStoreHandle h, int num, const char **keys,
+                       NDArrayHandle *vals, int priority);
+int MXTPUKVStorePullEx(KVStoreHandle h, int num, const char **keys,
+                       NDArrayHandle *outs, int priority);
+/* Returned string is library-owned, valid until the next call. */
+int MXTPUKVStoreGetType(KVStoreHandle h, const char **out_type);
+int MXTPUKVStoreGetRank(KVStoreHandle h, int *out_rank);
+int MXTPUKVStoreGetGroupSize(KVStoreHandle h, int *out_size);
+int MXTPUKVStoreFree(KVStoreHandle h);
+
 /* ------------------------------------------------------------------- rng */
 int MXTPURandomSeed(int seed);
 

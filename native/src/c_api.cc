@@ -190,6 +190,34 @@ def executor_arg_grad(h, name):
 
 def random_seed(seed):
     _random.seed(int(seed))
+
+
+def kv_create(type_str):
+    from incubator_mxnet_tpu import kvstore as _kvmod
+    return _put(_kvmod.create(type_str))
+
+
+def kv_init(h, keys, val_handles):
+    _objs[h].init(list(keys), [_objs[v] for v in val_handles])
+
+
+def kv_push(h, keys, val_handles, priority):
+    _objs[h].push(list(keys), [_objs[v] for v in val_handles],
+                  priority=priority)
+
+
+def kv_pull(h, keys, out_handles, priority):
+    _objs[h].pull(list(keys), out=[_objs[v] for v in out_handles],
+                  priority=priority)
+
+
+def kv_attr(h, which):
+    kv = _objs[h]
+    if which == "type":
+        return kv.type
+    if which == "rank":
+        return kv.rank
+    return kv.num_workers
 )PY";
 
 mxtpu::HelperModule g_helper("__mxtpu_capi__", kHelper);
@@ -573,6 +601,81 @@ int MXTPUExecutorArgGrad(void *h, const char *arg_name, void **out) {
 }
 
 int MXTPUExecutorFree(void *h) { return free_handle(h); }
+
+int MXTPUKVStoreCreate(const char *type, void **out) {
+  return symbol_create("kv_create", type ? type : "local", out);
+}
+
+static int kv_call3(const char *fn, void *h, int num, const char **keys,
+                    void **handles, int priority, bool with_priority) {
+  GIL gil;
+  PyObject *pykeys = str_list(keys, num);
+  PyObject *ids = id_list(handles, num);
+  PyObject *args = with_priority
+      ? Py_BuildValue("(lOOi)", handle_id(h), pykeys, ids, priority)
+      : Py_BuildValue("(lOO)", handle_id(h), pykeys, ids);
+  Py_DECREF(pykeys);
+  Py_DECREF(ids);
+  PyObject *res = helper_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreInitEx(void *h, int num, const char **keys, void **vals) {
+  return kv_call3("kv_init", h, num, keys, vals, 0, false);
+}
+
+int MXTPUKVStorePushEx(void *h, int num, const char **keys, void **vals,
+                       int priority) {
+  return kv_call3("kv_push", h, num, keys, vals, priority, true);
+}
+
+int MXTPUKVStorePullEx(void *h, int num, const char **keys, void **outs,
+                       int priority) {
+  return kv_call3("kv_pull", h, num, keys, outs, priority, true);
+}
+
+// callers hold the GIL
+static int kv_attr(void *h, const char *which, PyObject **out) {
+  PyObject *args = Py_BuildValue("(ls)", handle_id(h), which);
+  PyObject *res = helper_call("kv_attr", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXTPUKVStoreGetType(void *h, const char **out_type) {
+  GIL gil;
+  PyObject *res = nullptr;
+  if (kv_attr(h, "type", &res) != 0) return -1;
+  tls.json = safe_utf8(res);
+  *out_type = tls.json.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreGetRank(void *h, int *out_rank) {
+  GIL gil;
+  PyObject *res = nullptr;
+  if (kv_attr(h, "rank", &res) != 0) return -1;
+  *out_rank = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreGetGroupSize(void *h, int *out_size) {
+  GIL gil;
+  PyObject *res = nullptr;
+  if (kv_attr(h, "num_workers", &res) != 0) return -1;
+  *out_size = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreFree(void *h) { return free_handle(h); }
 
 int MXTPURandomSeed(int seed) {
   ensure_python();

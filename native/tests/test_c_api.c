@@ -209,6 +209,45 @@ int main(int argc, char **argv) {
   CHECK(write_f32(grad_file, g_buf, g_elems) == 0);
   printf("executor=ok grad_arg=%s grad_elems=%zu\n", names[0], g_elems);
 
+  /* 5. kvstore group: create/init/push/pull/attrs from C; the pulled
+     result goes to a file for the python harness's in-process mirror */
+  KVStoreHandle kv = NULL;
+  CHECK(MXTPUKVStoreCreate("local", &kv) == 0);
+  const char *kv_type = NULL;
+  CHECK(MXTPUKVStoreGetType(kv, &kv_type) == 0);
+  CHECK(strcmp(kv_type, "local") == 0);
+  int rank = -1, group = -1;
+  CHECK(MXTPUKVStoreGetRank(kv, &rank) == 0);
+  CHECK(MXTPUKVStoreGetGroupSize(kv, &group) == 0);
+  CHECK(rank == 0 && group == 1);
+
+  int kshape[2] = {2, 3};
+  float init_vals[6] = {1, 2, 3, 4, 5, 6};
+  float push_vals[6] = {10, 20, 30, 40, 50, 60};
+  NDArrayHandle kv_init_arr = NULL, kv_push_arr = NULL, kv_out_arr = NULL;
+  CHECK(MXTPUNDArrayCreateFromData(kshape, 2, 0, init_vals,
+                                   &kv_init_arr) == 0);
+  CHECK(MXTPUNDArrayCreateFromData(kshape, 2, 0, push_vals,
+                                   &kv_push_arr) == 0);
+  CHECK(MXTPUNDArrayCreate(kshape, 2, 0, &kv_out_arr) == 0);
+  const char *kv_keys[1] = {"w0"};
+  CHECK(MXTPUKVStoreInitEx(kv, 1, kv_keys, &kv_init_arr) == 0);
+  CHECK(MXTPUKVStorePullEx(kv, 1, kv_keys, &kv_out_arr, 0) == 0);
+  float pulled[6];
+  CHECK(MXTPUNDArraySyncCopyToCPU(kv_out_arr, pulled, sizeof(pulled)) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(pulled[i] == init_vals[i]);
+  CHECK(MXTPUKVStorePushEx(kv, 1, kv_keys, &kv_push_arr, 0) == 0);
+  CHECK(MXTPUKVStorePullEx(kv, 1, kv_keys, &kv_out_arr, 0) == 0);
+  CHECK(MXTPUNDArraySyncCopyToCPU(kv_out_arr, pulled, sizeof(pulled)) == 0);
+  char kv_path[4096];
+  snprintf(kv_path, sizeof(kv_path), "%s/kv_pulled.f32", tmpdir);
+  CHECK(write_f32(kv_path, pulled, 6) == 0);
+  CHECK(MXTPUNDArrayFree(kv_init_arr) == 0);
+  CHECK(MXTPUNDArrayFree(kv_push_arr) == 0);
+  CHECK(MXTPUNDArrayFree(kv_out_arr) == 0);
+  CHECK(MXTPUKVStoreFree(kv) == 0);
+  printf("kvstore=ok\n");
+
   /* error contract: a bad op name fails with a message, not a crash */
   NDArrayHandle *bad_out = NULL;
   int bad_n = 0;

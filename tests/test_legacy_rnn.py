@@ -265,3 +265,4 @@ def test_lstm_forget_bias_initializes_trainable_bias():
     np.testing.assert_allclose(bias[H:2 * H], 2.5)      # forget slice
     np.testing.assert_allclose(bias[:H], 0.0)
     np.testing.assert_allclose(bias[2 * H:], 0.0)
+

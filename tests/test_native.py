@@ -260,6 +260,16 @@ def test_c_api_abi_full_surface(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
     assert "PASS" in r.stdout
     assert "ops=" in r.stdout and "error_contract=ok" in r.stdout
+    assert "kvstore=ok" in r.stdout
+
+    # kvstore mirror: identical init/push/pull sequence in-process
+    kv = mx.kv.create("local")
+    kv.init("w0", nd.array(np.arange(1, 7, dtype=np.float32).reshape(2, 3)))
+    kv.push("w0", nd.array((np.arange(1, 7, dtype=np.float32) * 10)
+                           .reshape(2, 3)))
+    want_kv = kv.pull("w0").asnumpy()
+    got_kv = np.fromfile(str(tmp_path / "kv_pulled.f32"), dtype=np.float32)
+    np.testing.assert_allclose(got_kv.reshape(2, 3), want_kv)
 
     got_out = np.fromfile(out_file, dtype=np.float32)
     np.testing.assert_allclose(got_out.reshape(want_out.shape), want_out,

@@ -1,0 +1,62 @@
+"""mx.util and the generic mx.registry factory module (reference:
+python/mxnet/util.py, python/mxnet/registry.py)."""
+
+import os
+import tempfile
+
+import pytest
+
+from incubator_mxnet_tpu import registry as reg
+from incubator_mxnet_tpu import util
+
+
+class Sampler:
+    def __init__(self, k=1):
+        self.k = k
+
+
+register = reg.get_register_func(Sampler, "sampler")
+alias = reg.get_alias_func(Sampler, "sampler")
+create = reg.get_create_func(Sampler, "sampler")
+
+
+@alias("unif")
+@register
+class UniformSampler(Sampler):
+    pass
+
+
+def test_register_create_roundtrip():
+    assert isinstance(create("uniformsampler"), UniformSampler)
+    assert create("unif", k=3).k == 3
+    s = UniformSampler(k=9)
+    assert create(s) is s
+    assert isinstance(create('["unif", {"k": 2}]'), UniformSampler)
+    # kwargs-only reference form: create(sampler="name")
+    assert isinstance(create(sampler="unif"), UniformSampler)
+
+
+def test_create_error_contract():
+    with pytest.raises(ValueError):
+        create("nope")
+    with pytest.raises(ValueError):
+        create(3)
+    with pytest.raises(ValueError):
+        create(other_kwarg=1)
+
+
+def test_alias_enforces_subclass():
+    class NotASampler:
+        pass
+
+    with pytest.raises(AssertionError):
+        alias("bad")(NotASampler)
+
+
+def test_util_makedirs_and_counts():
+    d = os.path.join(tempfile.mkdtemp(), "a", "b")
+    util.makedirs(d)
+    util.makedirs(d)                 # idempotent
+    assert os.path.isdir(d)
+    assert util.get_gpu_count() >= 0
+    assert util.get_tpu_count() >= 0
