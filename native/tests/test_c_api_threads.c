@@ -1,0 +1,81 @@
+/* Multithreaded client for the MXTPU compute C ABI: validates the
+ * per-thread contracts the header advertises — thread-local error
+ * storage and thread-local return buffers — plus first-use init from
+ * concurrent threads (HelperModule's GIL-releasing wait).
+ *
+ * Each of 4 threads runs an independent imperative pipeline; two also
+ * trigger errors, whose messages must not bleed across threads.
+ *
+ * Usage: test_c_api_threads
+ */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu_c_api.h"
+
+static int failures = 0;
+static pthread_mutex_t fail_mu = PTHREAD_MUTEX_INITIALIZER;
+
+#define TCHECK(cond)                                                    \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      pthread_mutex_lock(&fail_mu);                                     \
+      fprintf(stderr, "FAIL %s:%d: %s — %s\n", __FILE__, __LINE__,     \
+              #cond, MXTPUGetLastError());                              \
+      ++failures;                                                       \
+      pthread_mutex_unlock(&fail_mu);                                   \
+      return NULL;                                                      \
+    }                                                                   \
+  } while (0)
+
+static void *worker(void *arg) {
+  long tid = (long)arg;
+  int shape[2] = {4, 4};
+  float vals[16];
+  for (int i = 0; i < 16; ++i) vals[i] = (float)(tid * 100 + i);
+
+  for (int iter = 0; iter < 8; ++iter) {
+    NDArrayHandle a = NULL;
+    TCHECK(MXTPUNDArrayCreateFromData(shape, 2, 0, vals, &a) == 0);
+
+    /* per-thread tls: the handle array returned here must stay valid
+       while other threads run their own invokes */
+    int n_out = 0;
+    NDArrayHandle *outs = NULL;
+    TCHECK(MXTPUImperativeInvoke("broadcast_add", (NDArrayHandle[]){a, a},
+                                 2, NULL, NULL, 0, &n_out, &outs) == 0);
+    TCHECK(n_out == 1);
+    float got[16];
+    TCHECK(MXTPUNDArraySyncCopyToCPU(outs[0], got, sizeof(got)) == 0);
+    for (int i = 0; i < 16; ++i) TCHECK(got[i] == 2.0f * vals[i]);
+
+    /* thread-local error contract: this thread's distinctive error
+       message survives other threads' successes/failures */
+    char opname[64];
+    snprintf(opname, sizeof(opname), "no_such_op_thread_%ld", tid);
+    NDArrayHandle *bad = NULL;
+    int bad_n = 0;
+    TCHECK(MXTPUImperativeInvoke(opname, &a, 1, NULL, NULL, 0, &bad_n,
+                                 &bad) == -1);
+    TCHECK(strstr(MXTPUGetLastError(), opname) != NULL);
+
+    TCHECK(MXTPUNDArrayFree(outs[0]) == 0);
+    TCHECK(MXTPUNDArrayFree(a) == 0);
+  }
+  return NULL;
+}
+
+int main(void) {
+  pthread_t threads[4];
+  for (long t = 0; t < 4; ++t)
+    pthread_create(&threads[t], NULL, worker, (void *)t);
+  for (int t = 0; t < 4; ++t) pthread_join(threads[t], NULL);
+  if (failures) {
+    fprintf(stderr, "%d failures\n", failures);
+    return 1;
+  }
+  printf("PASS threads\n");
+  return 0;
+}

@@ -277,3 +277,28 @@ def test_c_api_abi_full_surface(tmp_path):
     got_grad = np.fromfile(grad_file, dtype=np.float32)
     np.testing.assert_allclose(got_grad.reshape(want_grad.shape), want_grad,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_c_api_thread_contracts(tmp_path):
+    """4 concurrent pthreads drive the C ABI: thread-local errors must
+    not bleed across threads, tls return buffers must be per-thread,
+    and concurrent first-use init must not re-exec the helper."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native_dir = os.path.join(root, "native")
+    r = subprocess.run(["make", "-C", native_dir, "libmxtpu_capi.so"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    exe = str(tmp_path / "test_c_api_threads")
+    r = subprocess.run(
+        ["gcc", "-O2", "-I", os.path.join(native_dir, "include"),
+         os.path.join(native_dir, "tests", "test_c_api_threads.c"),
+         "-o", exe, "-L", native_dir, "-lmxtpu_capi", "-lpthread",
+         "-Wl,-rpath," + native_dir], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ, PYTHONPATH=root, JAX_PLATFORM_NAME="cpu",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert "PASS threads" in r.stdout
