@@ -48,9 +48,12 @@ class Context:
     # -- jax interop ---------------------------------------------------------
     @property
     def jax_device(self):
-        """The concrete jax.Device backing this context."""
+        """The concrete jax.Device backing this context. Device ids are
+        PER-PROCESS (reference semantics: mx.gpu(0) is this host's
+        device 0) — under a multi-process mesh jax.devices() is global,
+        so index the local list; single-process local == global."""
         plat = self._platform()
-        devs = jax.devices(plat)
+        devs = jax.local_devices(backend=plat)
         if self.device_id >= len(devs):
             raise ValueError("%s: device_id %d out of range (%d %s devices)"
                              % (self, self.device_id, len(devs), plat))

@@ -93,15 +93,19 @@ def random_saturation(data, min_factor, max_factor, key=None):
 # src/io/image_aug_default.cc:40-120)
 import numpy as _np
 
-_TYIQ = jnp.asarray([[0.299, 0.587, 0.114],
+# NOTE: kept as numpy at module scope — a module-level jnp.asarray would
+# initialise the XLA backend at import time, which breaks
+# jax.distributed.initialize() (multihost.py requires init BEFORE any
+# backend touch). jnp conversion happens inside the traced functions.
+_TYIQ = _np.asarray([[0.299, 0.587, 0.114],
                      [0.596, -0.274, -0.321],
-                     [0.211, -0.523, 0.311]])
-_TYIQ_INV = jnp.asarray(_np.linalg.inv(_np.asarray(_TYIQ, _np.float64)),
-                        jnp.float32)
+                     [0.211, -0.523, 0.311]], _np.float32)
+_TYIQ_INV = _np.linalg.inv(_np.asarray(_TYIQ, _np.float64)).astype(
+    _np.float32)
 
 # AlexNet-style PCA lighting statistics (reference image_aug_default.cc)
-_PCA_EIGVAL = jnp.asarray([55.46, 4.794, 1.148])
-_PCA_EIGVEC = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+_PCA_EIGVAL = _np.asarray([55.46, 4.794, 1.148], _np.float32)
+_PCA_EIGVEC = _np.asarray([[-0.5675, 0.7192, 0.4009],
                            [-0.5808, -0.0045, -0.8140],
                            [-0.5836, -0.6948, 0.4203]])
 
@@ -119,7 +123,7 @@ def adjust_hue(data, alpha):
                                 jnp.zeros_like(u)]),
                      jnp.stack([jnp.zeros_like(u), u, -w]),
                      jnp.stack([jnp.zeros_like(u), w, u])])
-    m = (_TYIQ_INV @ rot @ _TYIQ).astype(jnp.float32)
+    m = jnp.asarray(_TYIQ_INV @ rot @ _TYIQ, jnp.float32)
     out = jnp.einsum("...c,dc->...d", data.astype(jnp.float32), m)
     return out.astype(data.dtype)
 
@@ -143,7 +147,7 @@ def random_lighting(data, alpha_std=0.05, key=None):
     eigvec @ (eigval * alpha) to every pixel. Channels-last RGB."""
     key = key if key is not None else _rnd.next_key()
     alpha = jax.random.normal(key, (3,)) * alpha_std
-    noise = _PCA_EIGVEC @ (_PCA_EIGVAL * alpha)
+    noise = jnp.asarray(_PCA_EIGVEC) @ (jnp.asarray(_PCA_EIGVAL) * alpha)
     return (data.astype(jnp.float32) + noise).astype(data.dtype)
 
 

@@ -21,8 +21,10 @@ from .ring_attention import ring_flash_attention
 from .pipeline import pipeline_apply, stack_stage_params, PipelineStack
 from .moe import MoEBlock, moe_apply
 from . import collectives
+from . import multihost
 
 __all__ = ["make_mesh", "replicate", "shard_like", "P", "ShardedTrainer",
            "sharding_rules", "ring_attention", "ring_flash_attention",
            "local_attention", "pipeline_apply", "stack_stage_params",
-           "PipelineStack", "MoEBlock", "moe_apply", "collectives"]
+           "PipelineStack", "MoEBlock", "moe_apply", "collectives",
+           "multihost"]

@@ -1,0 +1,96 @@
+"""Multi-process (multi-host) mesh bring-up.
+
+Reference parity: the reference scales past one host with ps-lite
+(kvstore dist_*) or NCCL/MPI (tools/launch.py, horovod); the TPU-native
+equivalent is ONE global SPMD program over a mesh spanning every
+process's devices — `jax.distributed` forms the process group (TPU pods
+auto-detect; CPU/GPU groups take an explicit coordinator), and the same
+`ShardedTrainer` then runs unchanged: every process executes the same
+jitted step, XLA routes collectives over ICI within a host/slice and
+DCN across (Gloo on CPU test fabrics).
+
+Environment contract (what `tools/launch.py --launcher mesh` sets):
+
+- ``MXTPU_COORDINATOR``  host:port of process 0
+- ``MXTPU_NUM_PROCS``    world size
+- ``MXTPU_PROC_ID``      this process's rank
+
+`initialize()` with no arguments uses these, falling back to
+`jax.distributed`'s own auto-detection (real TPU pods need none of
+them).
+"""
+
+import os
+
+import jax
+
+__all__ = ["initialize", "global_mesh", "process_count", "process_index",
+           "local_data_to_global"]
+
+_initialized = False
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None, **kwargs):
+    """Join (or form) the multi-process group. Idempotent.
+
+    On TPU pod slices all three arguments auto-detect; on CPU/GPU
+    fabrics they come from the arguments or the MXTPU_* env the
+    launcher sets. Single-process runs (nothing configured) are a
+    no-op, so library code can call this unconditionally."""
+    global _initialized
+    if _initialized:
+        return
+    auto = kwargs.pop("auto", False)
+    coordinator_address = coordinator_address or \
+        os.environ.get("MXTPU_COORDINATOR")
+    if num_processes is None and "MXTPU_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["MXTPU_NUM_PROCS"])
+    if process_id is None and "MXTPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["MXTPU_PROC_ID"])
+    if coordinator_address is None and num_processes is None and not auto:
+        # nothing configured: single-process no-op (auto=True forces
+        # jax.distributed's own detection, e.g. on TPU pod slices)
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+    _initialized = True
+
+
+def process_count():
+    return jax.process_count()
+
+
+def process_index():
+    return jax.process_index()
+
+
+def global_mesh(axes, devices=None):
+    """Mesh over ALL processes' devices (jax.devices() is global after
+    initialize()). ``axes``: dict name -> size, row-major over the
+    device list; sizes must multiply to the global device count."""
+    import numpy as np
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    names = tuple(axes.keys())
+    shape = tuple(int(axes[n]) for n in names)
+    want = int(np.prod(shape))
+    if want != len(devices):
+        raise ValueError("mesh axes %r need %d devices, have %d global"
+                         % (axes, want, len(devices)))
+    return Mesh(np.array(devices).reshape(shape), names)
+
+
+def local_data_to_global(local_batch, sharding, global_shape=None):
+    """Assemble a global jax.Array from each process's LOCAL shard
+    (the standard per-host input pipeline: every host loads only its
+    slice). ``global_shape`` defaults to scaling dim 0 by the process
+    count."""
+    import numpy as np
+    local = np.asarray(local_batch)
+    if global_shape is None:
+        global_shape = (local.shape[0] * jax.process_count(),) + \
+            local.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, local,
+                                                  global_shape)

@@ -10,6 +10,8 @@ collectives over ICI automatically (GSPMD).
 
 import re
 
+import numpy as _np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -18,6 +20,19 @@ from ..gluon.block import _TraceCtx, _trace_state
 from ..ndarray import NDArray
 
 __all__ = ["ShardedTrainer", "sharding_rules"]
+
+
+def _gput(arr, sharding):
+    """device_put that also works on MULTI-PROCESS meshes: a committed
+    jax.Array cannot be re-placed onto a sharding that spans other
+    processes' devices (jax rejects non-addressable targets for device
+    arrays), so detour through host numpy — jax's multi-process
+    device_put path accepts host arrays and verifies cross-process
+    consistency. Init/feed paths only; nothing moves inside the jitted
+    step."""
+    if isinstance(arr, jax.Array) and not sharding.is_fully_addressable:
+        arr = _np.asarray(arr)
+    return jax.device_put(arr, sharding)
 
 
 def _stochastic_round(x32, dtype, key):
@@ -145,7 +160,7 @@ class ShardedTrainer:
             if pdt is not None and n in self._diff_names and \
                     jnp.issubdtype(arr.dtype, jnp.floating):
                 arr = arr.astype(pdt)
-            return jax.device_put(arr, self._param_shardings[n])
+            return _gput(arr, self._param_shardings[n])
 
         self._param_vals = {n: _stored(n)
                             for n in self._diff_names + self._aux_names}
@@ -249,12 +264,11 @@ class ShardedTrainer:
             sh = self._zero_shardings.get(n, self._param_shardings[n])
             ref = self._param_vals[n]
             sdt = self._opt_state_dtype or fallback or ref.dtype
-            z = jax.device_put(jnp.zeros(ref.shape, sdt), sh)
+            z = _gput(jnp.zeros(ref.shape, sdt), sh)
             if self._opt == "sgd":
                 state[n] = (z,)
             else:
-                state[n] = (z, jax.device_put(
-                    jnp.zeros(ref.shape, sdt), sh))
+                state[n] = (z, _gput(jnp.zeros(ref.shape, sdt), sh))
         return state
 
     def _apply_opt(self, p, g, st, t, key=None):
@@ -578,12 +592,12 @@ class ShardedTrainer:
                 raise ValueError("data_specs has %d entries but step_scan got "
                                  "%d data arrays" % (len(self._data_shardings),
                                                      len(datas)))
-            datas = [jax.device_put(d, _shard(s))
+            datas = [_gput(d, _shard(s))
                      for d, s in zip(datas, self._data_shardings)]
         else:
-            datas = [jax.device_put(d, _shard(self._data_shardings))
+            datas = [_gput(d, _shard(self._data_shardings))
                      for d in datas]
-        labels = [jax.device_put(l, _shard(self._label_sharding))
+        labels = [_gput(l, _shard(self._label_sharding))
                   for l in labels]
         cache_key = (len(datas), n_steps, scan_over_batch)
         if getattr(self, "_scan_cache", None) is None:
@@ -615,11 +629,11 @@ class ShardedTrainer:
                 raise ValueError("data_specs has %d entries but step got %d "
                                  "data arrays" % (len(self._data_shardings),
                                                   len(datas)))
-            datas = [jax.device_put(d, s)
+            datas = [_gput(d, s)
                      for d, s in zip(datas, self._data_shardings)]
         else:
-            datas = [jax.device_put(d, self._data_shardings) for d in datas]
-        labels = [jax.device_put(l, self._label_sharding) for l in labels]
+            datas = [_gput(d, self._data_shardings) for d in datas]
+        labels = [_gput(l, self._label_sharding) for l in labels]
         return datas, labels
 
     def step(self, data, label, key=None):
@@ -709,8 +723,7 @@ class ShardedTrainer:
                     and host_dtype is not None \
                     and jnp.issubdtype(host_dtype, jnp.floating):
                 v = jnp.asarray(v, dtype=self._param_dtype)
-            self._param_vals[n] = jax.device_put(
-                v, self._param_shardings[n])
+            self._param_vals[n] = _gput(v, self._param_shardings[n])
         new_opt = {}
         for n, st in self._opt_state.items():
             sh = self._zero_shardings.get(n, self._param_shardings[n]) \
@@ -726,7 +739,7 @@ class ShardedTrainer:
                 # fp32 checkpoint, and vice versa — no silent retrace)
                 if v.dtype != st[i].dtype:
                     v = v.astype(st[i].dtype)
-                slots.append(jax.device_put(v, sh))
+                slots.append(_gput(v, sh))
             new_opt[n] = tuple(slots)
         self._opt_state = new_opt
         self._step_count = int(jax.device_get(raw(flat["step"])))

@@ -1,0 +1,66 @@
+"""Worker for tests/test_multihost.py: joins a 2-process jax.distributed
+group (2 virtual CPU devices per process), trains an MLP through
+ShardedTrainer on the GLOBAL dp=4 mesh for 5 steps, and prints the loss
+trajectory. Launched via tools/launch.py --launcher mesh, so rank/env
+comes from MXTPU_* exactly as a real deployment would."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd  # noqa: E402
+from incubator_mxnet_tpu.parallel import ShardedTrainer, multihost  # noqa: E402
+
+
+def build_net(X):
+    from incubator_mxnet_tpu import gluon
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential(prefix="mh_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier(rnd_type="uniform", magnitude=2.0))
+    net(nd.array(X[:2]))
+    return net
+
+
+def loss_fn(out, lab):
+    import jax.numpy as jnp
+    lp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+    return -jnp.take_along_axis(lp, lab[:, None], axis=-1).mean()
+
+
+def main():
+    multihost.initialize()          # env-driven (MXTPU_* from launch.py)
+    assert jax.process_count() == int(os.environ["MXTPU_NUM_PROCS"])
+    mesh = multihost.global_mesh({"dp": 4})
+
+    rng = np.random.RandomState(42)
+    X = rng.rand(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.int32)
+
+    net = build_net(X)
+    tr = ShardedTrainer(net, loss_fn, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": 0.05})
+    losses = []
+    for _ in range(5):
+        losses.append(float(jax.device_get(tr.step(nd.array(X),
+                                                   nd.array(y)))))
+    print("LOSSES rank=%d %s" % (jax.process_index(),
+                                 ",".join("%.6f" % l for l in losses)))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,12 @@ def main():
     parser = argparse.ArgumentParser(description="Launch a distributed job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("-s", "--num-servers", type=int, default=None)
-    parser.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    parser.add_argument("--launcher", choices=["local", "ssh", "mesh"],
+                        default="local",
+                        help="local/ssh = parameter-server fabric; mesh = "
+                        "one global SPMD mesh via jax.distributed (the "
+                        "command runs once per process with MXTPU_* rank "
+                        "env set; see parallel/multihost.py)")
     parser.add_argument("-H", "--hostfile", default=None,
                         help="hostfile for ssh launcher")
     parser.add_argument("--sync-dst-dir", default=None)
@@ -47,6 +52,44 @@ def main():
         args.num_servers = args.num_workers
     if not args.command:
         parser.error("no command given")
+
+    if args.launcher == "mesh":
+        # multi-process SPMD: every process runs the SAME command and
+        # joins one jax.distributed group; multihost.initialize() picks
+        # these up (reference analogue: the horovod/NCCL path)
+        import time
+        port = _free_port()
+        procs = []
+        for i in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({"MXTPU_COORDINATOR": "127.0.0.1:%d" % port,
+                        "MXTPU_NUM_PROCS": str(args.num_workers),
+                        "MXTPU_PROC_ID": str(i)})
+            procs.append(subprocess.Popen(args.command, env=env))
+
+        def mesh_terminate(*_a):
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            sys.exit(1)
+
+        signal.signal(signal.SIGINT, mesh_terminate)
+        signal.signal(signal.SIGTERM, mesh_terminate)
+        # poll: one dead rank hangs the others in collectives — kill the
+        # stragglers as soon as any rank exits nonzero
+        rc = 0
+        while any(p.poll() is None for p in procs):
+            for p in procs:
+                code = p.poll()
+                if code is not None and code != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    sys.exit(code)
+            time.sleep(0.2)
+        for p in procs:
+            rc = max(rc, p.returncode)
+        sys.exit(rc)
 
     port = _free_port()
     base_env = dict(os.environ)
