@@ -159,7 +159,18 @@ def rnn_param_size(input_size, state_size, num_layers, mode, bidirectional=False
     return total
 
 
-@register("RNN")
+def _rnn_num_outputs(attrs):
+    """Symbolic output arity of the RNN op (depends on attrs like the
+    reference's FNumOutputs): out [, h_n [, c_n]]."""
+    so = attrs.get("state_outputs", True)
+    if isinstance(so, str):
+        so = so.lower() in ("true", "1")
+    if not so:
+        return 1
+    return 3 if str(attrs.get("mode", "lstm")) == "lstm" else 2
+
+
+@register("RNN", num_outputs=_rnn_num_outputs)
 def rnn(data, parameters, state, state_cell=None, state_size=None,
         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
         state_outputs=True, training=False, key=None, **_ignored):

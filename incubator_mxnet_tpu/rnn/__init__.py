@@ -7,10 +7,11 @@ package exists so reference bucketing/Module workflows port directly.
 """
 
 from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, BidirectionalCell, DropoutCell,
-                       ZoneoutCell, ResidualCell)
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ZoneoutCell, ResidualCell)
 from .io import BucketSentenceIter
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
-           "ZoneoutCell", "ResidualCell", "BucketSentenceIter"]
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BucketSentenceIter"]

@@ -20,8 +20,8 @@ TPU-first departures from the reference:
 from .. import symbol as sym
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
-           "ZoneoutCell", "ResidualCell"]
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell"]
 
 
 class RNNParams(object):
@@ -225,6 +225,205 @@ class GRUCell(BaseRNNCell):
         new = sym.tanh(i_n + reset * h_n)
         next_h = update * states[0] + (1.0 - update) * new
         return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-sequence fused RNN (reference: rnn_cell.py FusedRNNCell —
+    there backed by cuDNN descriptors; here by the framework's packed-
+    parameter ``RNN`` op, i.e. one lax.scan per layer/direction compiled
+    into a single XLA program). Sequence-level only: per-step ``__call__``
+    raises, exactly like the reference.
+
+    Weights live in ONE flat ``{prefix}parameters`` vector with the
+    reference rnn-inl.h layout (all wx/wh per layer/direction, then all
+    biases); ``unpack_weights``/``pack_weights`` convert to/from the
+    per-layer ``l%d_i2h_weight``-style dicts of ``unfuse()``'s cell
+    stack (gate orders match: i,f,g,o LSTM / r,z,n GRU)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 prefix=None, params=None):
+        prefix = "%s_" % mode if prefix is None else prefix
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._parameters = self.params.get("parameters")
+
+    @property
+    def _dirs(self):
+        return 2 if self._bidirectional else 1
+
+    @property
+    def state_info(self):
+        shape = (self._num_layers * self._dirs, 0, self._num_hidden)
+        info = [{"shape": shape, "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append({"shape": shape, "__layout__": "LNC"})
+        return info
+
+    def begin_state(self, batch_size, func=None, **kwargs):
+        states = []
+        for i, info in enumerate(self.state_info):
+            shape = (info["shape"][0], batch_size, info["shape"][2])
+            name = "%sbegin_state_%d" % (self._prefix, i)
+            if func is None:
+                states.append(sym.zeros(shape=shape, name=name, **kwargs))
+            else:
+                states.append(func(shape=shape, name=name, **kwargs))
+        return states
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped — only unroll() "
+            "(reference raises the same way)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            assert len(inputs) == length
+            inputs = sym.concat(*[sym.expand_dims(x, axis=0)
+                                  for x in inputs], dim=0)   # (T, N, C)
+        elif axis == 1:                                      # NTC -> TNC
+            inputs = sym.transpose(inputs, axes=(1, 0, 2))
+        if begin_state is None:
+            raise ValueError(
+                "begin_state is required: call cell.begin_state(batch_size)"
+                " (static shapes; see rnn_cell.py docstring)")
+        args = [inputs, self._parameters, begin_state[0]]
+        if self._mode == "lstm":
+            args.append(begin_state[1])
+        rnn_out = sym.RNN(*args, state_size=self._num_hidden,
+                          num_layers=self._num_layers, mode=self._mode,
+                          bidirectional=self._bidirectional,
+                          p=self._dropout,
+                          state_outputs=self._get_next_state,
+                          name="%srnn" % self._prefix)
+        if self._get_next_state:
+            outputs = rnn_out[0]
+            states = [rnn_out[i]
+                      for i in range(1, 3 if self._mode == "lstm" else 2)]
+        else:
+            outputs = rnn_out
+            states = []
+        if axis == 1:
+            outputs = sym.transpose(outputs, axes=(1, 0, 2))   # -> NTC
+        if not merge_outputs:
+            outputs = list(sym.SliceChannel(outputs, num_outputs=length,
+                                            axis=axis, squeeze_axis=1))
+        return outputs, states
+
+    # ------------------------------------------------- weight interchange
+    def _slices(self, input_size):
+        """(name, shape, offset) triples of the packed vector, reference
+        rnn-inl.h layout (mirrors ops/rnn.py unpack_rnn_params)."""
+        from ..ops.rnn import _GATES
+        g = _GATES[self._mode]
+        H = self._num_hidden
+        out = []
+        off = 0
+        for li in range(self._num_layers):
+            in_sz = input_size if li == 0 else H * self._dirs
+            for d in range(self._dirs):
+                pre = "l%d_" % li if self._dirs == 1 else \
+                    "%s%d_" % ("lr"[d], li)
+                for nm, shp in (("i2h_weight", (g * H, in_sz)),
+                                ("h2h_weight", (g * H, H))):
+                    n = shp[0] * shp[1]
+                    out.append((pre + nm, shp, off))
+                    off += n
+        for li in range(self._num_layers):
+            for d in range(self._dirs):
+                pre = "l%d_" % li if self._dirs == 1 else \
+                    "%s%d_" % ("lr"[d], li)
+                for nm in ("i2h_bias", "h2h_bias"):
+                    out.append((pre + nm, (g * H,), off))
+                    off += g * H
+        return out
+
+    def unpack_weights(self, args):
+        """{prefix}parameters -> per-layer weight dict (reference:
+        FusedRNNCell.unpack_weights). ``args`` values may be NDArray or
+        numpy; returns the same kind."""
+        import numpy as np
+        from .. import nd
+        args = dict(args)
+        packed = args.pop(self._prefix + "parameters")
+        is_nd = hasattr(packed, "asnumpy")
+        flat = packed.asnumpy() if is_nd else np.asarray(packed)
+        input_size = self._infer_input_size(flat)
+        for name, shp, off in self._slices(input_size):
+            n = int(np.prod(shp))
+            val = flat[off:off + n].reshape(shp)
+            args[self._prefix + name] = nd.array(val) if is_nd else val
+        return args
+
+    def pack_weights(self, args):
+        """Per-layer dict -> {prefix}parameters (reference:
+        FusedRNNCell.pack_weights)."""
+        import numpy as np
+        from .. import nd
+        args = dict(args)
+        first = args[self._prefix + "l0_i2h_weight"]
+        is_nd = hasattr(first, "asnumpy")
+        input_size = first.shape[1]
+        slices = self._slices(input_size)
+        total = slices[-1][2] + int(np.prod(slices[-1][1]))
+        flat = np.zeros((total,), np.float32)
+        for name, shp, off in slices:
+            v = args.pop(self._prefix + name)
+            v = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+            flat[off:off + int(np.prod(shp))] = v.reshape(-1)
+        args[self._prefix + "parameters"] = nd.array(flat) if is_nd else flat
+        return args
+
+    def _infer_input_size(self, flat):
+        """Solve input_size from the packed vector's length (reference
+        does the same via the cached unfused shapes)."""
+        from ..ops.rnn import _GATES, rnn_param_size
+        g = _GATES[self._mode]
+        H, L, dirs = self._num_hidden, self._num_layers, self._dirs
+        # total = dirs*g*H*in + (everything independent of in)
+        rest = rnn_param_size(0, H, L, self._mode, self._bidirectional)
+        per_in = dirs * g * H
+        in_sz = (len(flat) - rest) // per_in
+        assert rnn_param_size(in_sz, H, L, self._mode,
+                              self._bidirectional) == len(flat), \
+            "packed vector length %d does not match any input size" \
+            % len(flat)
+        return in_sz
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (reference:
+        FusedRNNCell.unfuse) whose parameter names line up with
+        unpack_weights output. Bidirectional unfusing is not provided
+        (use the fused form), same practical scope as the reference's
+        warning-laden path."""
+        if self._bidirectional:
+            raise NotImplementedError("unfuse() of a bidirectional "
+                                      "FusedRNNCell is not supported")
+        stack = SequentialRNNCell()
+        for li in range(self._num_layers):
+            pre = "%sl%d_" % (self._prefix, li)
+            if self._mode == "lstm":
+                cell = LSTMCell(self._num_hidden, prefix=pre)
+            elif self._mode == "gru":
+                cell = GRUCell(self._num_hidden, prefix=pre)
+            else:
+                cell = RNNCell(self._num_hidden,
+                               activation="relu" if self._mode == "rnn_relu"
+                               else "tanh", prefix=pre)
+            stack.add(cell)
+            if self._dropout > 0 and li < self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix="%sdrop%d_" % (self._prefix,
+                                                            li)))
+        return stack
 
 
 class SequentialRNNCell(BaseRNNCell):

@@ -266,3 +266,59 @@ def test_lstm_forget_bias_initializes_trainable_bias():
     np.testing.assert_allclose(bias[:H], 0.0)
     np.testing.assert_allclose(bias[2 * H:], 0.0)
 
+
+
+def test_fused_rnn_cell_matches_unfused_stack():
+    """FusedRNNCell (packed-parameter RNN op) must agree with its
+    unfuse() cell stack when weights cross via unpack_weights — the
+    reference's fused/unfused interchange contract."""
+    np.random.seed(5)
+    B, T, C, H, L = 2, 5, 3, 4, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm",
+                                prefix="f_", get_next_state=False)
+    data = mx.sym.Variable("data")
+    fout, _ = fused.unroll(T, data, begin_state=fused.begin_state(B),
+                           merge_outputs=True)
+
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    n_params = rnn_param_size(C, H, L, "lstm")
+    packed = (np.random.randn(n_params) * 0.3).astype(np.float32)
+    x = np.random.randn(B, T, C).astype(np.float32)
+    got_fused = _bind_forward(fout, {"data": x, "f_parameters": packed})
+    assert got_fused.shape == (B, T, H)
+
+    # cross the weights into the unfused stack
+    unfused = fused.unfuse()
+    uout, _ = unfused.unroll(T, data, begin_state=unfused.begin_state(B),
+                             merge_outputs=True)
+    weights = fused.unpack_weights({"f_parameters": packed})
+    got_unfused = _bind_forward(uout, {"data": x, **weights})
+    np.testing.assert_allclose(got_fused, got_unfused, rtol=2e-5,
+                               atol=2e-5)
+
+    # pack_weights inverts unpack_weights exactly
+    repacked = fused.pack_weights(weights)
+    np.testing.assert_array_equal(repacked["f_parameters"], packed)
+
+
+def test_fused_rnn_cell_state_outputs_and_gru():
+    np.random.seed(6)
+    B, T, C, H = 3, 4, 5, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="gru", prefix="g_",
+                                get_next_state=True)
+    data = mx.sym.Variable("data")
+    outs, states = fused.unroll(T, data,
+                                begin_state=fused.begin_state(B),
+                                merge_outputs=True)
+    assert len(states) == 1
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    packed = (np.random.randn(rnn_param_size(C, H, 1, "gru")) * 0.3) \
+        .astype(np.float32)
+    x = np.random.randn(B, T, C).astype(np.float32)
+    out = _bind_forward(outs, {"data": x, "g_parameters": packed})
+    h_n = _bind_forward(states[0], {"data": x, "g_parameters": packed})
+    assert out.shape == (B, T, H) and h_n.shape == (1, B, H)
+    # final state == last output step
+    np.testing.assert_allclose(h_n[0], out[:, -1], rtol=1e-6)
+    with pytest.raises(NotImplementedError):
+        fused(data, fused.begin_state(B))
