@@ -120,7 +120,11 @@ class Executor:
             with _ag.record():
                 out = executor_eval(self._symbol, feed, placement=placement)
         else:
-            out = executor_eval(self._symbol, feed, placement=placement)
+            # force predict mode: an enclosing autograd.record()/
+            # train_mode() scope must not leak training=True into
+            # training-aware ops when the caller asked for inference
+            with _ag.predict_mode():
+                out = executor_eval(self._symbol, feed, placement=placement)
         self.outputs = out if isinstance(out, list) else [out]
         if self._monitor_callback is not None:
             for i, o in enumerate(self.outputs):

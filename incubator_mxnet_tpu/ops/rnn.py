@@ -106,45 +106,44 @@ def rnn_forward(data, layer_params, init_h, init_c=None, mode="lstm",
     return x, h_n, c_n
 
 
-def unpack_rnn_params(parameters, input_size, state_size, num_layers, mode,
-                      bidirectional=False, projection_size=None):
-    """Unpack the reference's flat parameter vector (all weights for every
-    layer/direction first, then all biases; reference rnn-inl.h layout)."""
+def rnn_param_slices(input_size, state_size, num_layers, mode,
+                     bidirectional=False):
+    """THE packed-vector layout (reference rnn-inl.h: all weights for
+    every layer/direction first, then all biases) as
+    (role, layer, direction, shape, offset) tuples — the single source
+    of truth for unpack_rnn_params and FusedRNNCell's weight
+    interchange."""
     g = _GATES[mode]
     dirs = 2 if bidirectional else 1
     H = state_size
-    layers = []
+    out = []
     off = 0
-    shapes = []
     for li in range(num_layers):
         in_sz = input_size if li == 0 else H * dirs
-        for _ in range(dirs):
-            shapes.append(("wx", (g * H, in_sz)))
-            shapes.append(("wh", (g * H, H)))
+        for d in range(dirs):
+            for role, shp in (("wx", (g * H, in_sz)), ("wh", (g * H, H))):
+                out.append((role, li, d, shp, off))
+                off += shp[0] * shp[1]
     for li in range(num_layers):
-        for _ in range(dirs):
-            shapes.append(("bx", (g * H,)))
-            shapes.append(("bh", (g * H,)))
-    vals = []
-    for name, shp in shapes:
+        for d in range(dirs):
+            for role in ("bx", "bh"):
+                out.append((role, li, d, (g * H,), off))
+                off += g * H
+    return out
+
+
+def unpack_rnn_params(parameters, input_size, state_size, num_layers, mode,
+                      bidirectional=False, projection_size=None):
+    """Unpack the reference's flat parameter vector into per-layer/
+    direction dicts (layout: rnn_param_slices)."""
+    dirs = 2 if bidirectional else 1
+    layers = [[{} for _ in range(dirs)] for _ in range(num_layers)]
+    for role, li, d, shp, off in rnn_param_slices(
+            input_size, state_size, num_layers, mode, bidirectional):
         n = 1
         for s in shp:
             n *= s
-        vals.append((name, parameters[off:off + n].reshape(shp)))
-        off += n
-    # stitch into per-layer/direction dicts
-    n_ld = num_layers * dirs
-    layers = []
-    for li in range(num_layers):
-        dir_list = []
-        for d in range(dirs):
-            k = (li * dirs + d) * 2
-            wx = vals[k][1]
-            wh = vals[k + 1][1]
-            bx = vals[2 * n_ld + k][1]
-            bh = vals[2 * n_ld + k + 1][1]
-            dir_list.append({"wx": wx, "wh": wh, "bx": bx, "bh": bh})
-        layers.append(dir_list)
+        layers[li][d][role] = parameters[off:off + n].reshape(shp)
     return layers
 
 
@@ -179,6 +178,11 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
     data: (T, N, C); state: (L*dirs, N, H); lstm also takes state_cell.
     Returns out or (out, h_n[, c_n]) depending on state_outputs.
     """
+    if key is None and training and p > 0 and num_layers > 1:
+        # inter-layer dropout needs randomness: draw from the global
+        # stream like ops/nn.py dropout does
+        from . import random as _rnd
+        key = _rnd.next_key()
     layer_params = unpack_rnn_params(parameters, data.shape[2], state_size,
                                      num_layers, mode, bidirectional)
     out, h_n, c_n = rnn_forward(data, layer_params, state, state_cell, mode,

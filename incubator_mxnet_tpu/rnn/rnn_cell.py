@@ -319,31 +319,20 @@ class FusedRNNCell(BaseRNNCell):
         return outputs, states
 
     # ------------------------------------------------- weight interchange
+    _ROLE_NAMES = {"wx": "i2h_weight", "wh": "h2h_weight",
+                   "bx": "i2h_bias", "bh": "h2h_bias"}
+
     def _slices(self, input_size):
-        """(name, shape, offset) triples of the packed vector, reference
-        rnn-inl.h layout (mirrors ops/rnn.py unpack_rnn_params)."""
-        from ..ops.rnn import _GATES
-        g = _GATES[self._mode]
-        H = self._num_hidden
+        """(name, shape, offset) triples of the packed vector — derived
+        from ops/rnn.py rnn_param_slices (the layout's single source of
+        truth), with the unfused per-layer parameter names attached."""
+        from ..ops.rnn import rnn_param_slices
         out = []
-        off = 0
-        for li in range(self._num_layers):
-            in_sz = input_size if li == 0 else H * self._dirs
-            for d in range(self._dirs):
-                pre = "l%d_" % li if self._dirs == 1 else \
-                    "%s%d_" % ("lr"[d], li)
-                for nm, shp in (("i2h_weight", (g * H, in_sz)),
-                                ("h2h_weight", (g * H, H))):
-                    n = shp[0] * shp[1]
-                    out.append((pre + nm, shp, off))
-                    off += n
-        for li in range(self._num_layers):
-            for d in range(self._dirs):
-                pre = "l%d_" % li if self._dirs == 1 else \
-                    "%s%d_" % ("lr"[d], li)
-                for nm in ("i2h_bias", "h2h_bias"):
-                    out.append((pre + nm, (g * H,), off))
-                    off += g * H
+        for role, li, d, shp, off in rnn_param_slices(
+                input_size, self._num_hidden, self._num_layers, self._mode,
+                self._bidirectional):
+            pre = "l%d_" % li if self._dirs == 1 else "%s%d_" % ("lr"[d], li)
+            out.append((pre + self._ROLE_NAMES[role], shp, off))
         return out
 
     def unpack_weights(self, args):
@@ -374,7 +363,9 @@ class FusedRNNCell(BaseRNNCell):
         input_size = first.shape[1]
         slices = self._slices(input_size)
         total = slices[-1][2] + int(np.prod(slices[-1][1]))
-        flat = np.zeros((total,), np.float32)
+        # preserve the weights' dtype (a bf16/fp16 checkpoint must
+        # round-trip, not silently widen to fp32)
+        flat = np.zeros((total,), first.dtype)
         for name, shp, off in slices:
             v = args.pop(self._prefix + name)
             v = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)

@@ -512,6 +512,29 @@ def _embed_shapes(ins, attrs):
     return [data, (attrs.get("input_dim"), attrs.get("output_dim"))]
 
 
+def _rnn_shapes(ins, attrs):
+    """RNN (packed-parameter fused op): data (T,N,C) determines the flat
+    parameter-vector length and the (L*dirs, N, H) state shapes
+    (reference: rnn-inl.h FInferShape)."""
+    data = ins[0]
+    if data is None or attrs.get("state_size") is None:
+        return None
+    from ..ops.rnn import rnn_param_size
+    T, N, C = data
+    H = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1))
+    mode = str(attrs.get("mode", "lstm"))
+    bd = attrs.get("bidirectional", False)
+    if isinstance(bd, str):
+        bd = bd.lower() in ("true", "1")
+    dirs = 2 if bd else 1
+    out = [tuple(data), (rnn_param_size(C, H, L, mode, bd),),
+           (L * dirs, N, H)]
+    if len(ins) > 3:
+        out.append((L * dirs, N, H))
+    return out
+
+
 _PARAM_SHAPE_RULES = {
     "FullyConnected": _fc_shapes,
     "Convolution": _conv_shapes,
@@ -520,6 +543,7 @@ _PARAM_SHAPE_RULES = {
     "LayerNorm": _ln_shapes,
     "InstanceNorm": _ln_shapes,
     "Embedding": _embed_shapes,
+    "RNN": _rnn_shapes,
 }
 
 
@@ -694,6 +718,23 @@ def _to_ctx(val, ctx):
     return val
 
 
+_TRAIN_AWARE = {}
+
+
+def _accepts_training(opname):
+    """Whether the registered op fn takes a ``training`` kwarg (cached) —
+    the executor injects the ambient train mode into those (reference:
+    is_train threads into stateful ops via the op context)."""
+    if opname not in _TRAIN_AWARE:
+        import inspect
+        try:
+            _TRAIN_AWARE[opname] = "training" in \
+                inspect.signature(get_op(opname).fn).parameters
+        except (ValueError, TypeError):
+            _TRAIN_AWARE[opname] = False
+    return _TRAIN_AWARE[opname]
+
+
 def _eval_symbol(sym, feed, wrap=True, placement=None):
     """Evaluate a Symbol given name->NDArray (wrap=True) or name->jax
     value. ``placement``: ctx_group name -> Context (bind's group2ctx);
@@ -730,6 +771,12 @@ def _eval_symbol(sym, feed, wrap=True, placement=None):
             else:
                 attrs = {k: v for k, v in n._attrs.items()
                          if not k.startswith("__")}
+                # ambient train mode reaches training-aware ops (Dropout/
+                # BatchNorm/RNN run their training formulation under
+                # forward(is_train=True), reference is_train semantics)
+                if "training" not in attrs and _ag.is_training() \
+                        and _accepts_training(n._op):
+                    attrs["training"] = True
                 kw_inputs = n._attrs.get("__kwarg_inputs__", [])
                 in_vals = [results[id(i)][i._out_index or 0]
                            for i in n._inputs]

@@ -322,3 +322,85 @@ def test_fused_rnn_cell_state_outputs_and_gru():
     np.testing.assert_allclose(h_n[0], out[:, -1], rtol=1e-6)
     with pytest.raises(NotImplementedError):
         fused(data, fused.begin_state(B))
+
+
+def test_fused_rnn_infer_shape_and_simple_bind():
+    """The RNN op carries a backward shape rule: FusedRNNCell graphs
+    shape-infer the packed parameter vector (reference FInferShape) so
+    simple_bind/Module workflows work."""
+    B, T, C, H, L = 2, 5, 3, 4, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm", prefix="s_")
+    data = mx.sym.Variable("data")
+    out, _ = fused.unroll(T, data, begin_state=fused.begin_state(B),
+                          merge_outputs=True)
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(B, T, C))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["s_parameters"] == (rnn_param_size(C, H, L, "lstm"),)
+    assert out_shapes[0] == (B, T, H)
+    ex = out.simple_bind(data=(B, T, C))
+    assert ex.arg_dict["s_parameters"].shape == \
+        (rnn_param_size(C, H, L, "lstm"),)
+
+
+def test_fused_rnn_dropout_active_in_training():
+    """Inter-layer dropout must FIRE under forward(is_train=True) — the
+    executor injects the ambient train mode into training-aware ops —
+    and stay off at inference."""
+    np.random.seed(7)
+    B, T, C, H, L = 2, 4, 3, 8, 3
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm",
+                                prefix="d_", dropout=0.5)
+    data = mx.sym.Variable("data")
+    out, _ = fused.unroll(T, data, begin_state=fused.begin_state(B),
+                          merge_outputs=True)
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    packed = nd.array((np.random.randn(rnn_param_size(C, H, L, "lstm"))
+                       * 0.3).astype(np.float32))
+    x = nd.array(np.random.randn(B, T, C).astype(np.float32))
+    ex = out.bind(args={"data": x, "d_parameters": packed})
+    e1 = ex.forward(is_train=False)[0].asnumpy()
+    e2 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(e1, e2)          # eval: deterministic
+    t1 = ex.forward(is_train=True)[0].asnumpy()
+    t2 = ex.forward(is_train=True)[0].asnumpy()
+    assert np.abs(t1 - t2).max() > 1e-6            # train: stochastic
+    assert np.abs(t1 - e1).max() > 1e-6
+
+
+def test_fused_rnn_pack_preserves_dtype():
+    fused = mx.rnn.FusedRNNCell(4, num_layers=1, mode="gru", prefix="p_")
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    packed = np.random.randn(rnn_param_size(3, 4, 1, "gru")) \
+        .astype(np.float16)
+    weights = fused.unpack_weights({"p_parameters": packed})
+    assert all(v.dtype == np.float16 for v in weights.values())
+    repacked = fused.pack_weights(weights)
+    assert repacked["p_parameters"].dtype == np.float16
+    np.testing.assert_array_equal(repacked["p_parameters"], packed)
+
+
+def test_forward_is_train_false_inside_record_stays_inference():
+    """forward(is_train=False) must force predict mode even inside an
+    enclosing autograd.record() scope — ambient train state must not
+    leak into training-aware ops during explicit inference."""
+    from incubator_mxnet_tpu import autograd
+    np.random.seed(8)
+    B, T, C, H = 2, 3, 3, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode="gru", prefix="r_",
+                                dropout=0.5)
+    data = mx.sym.Variable("data")
+    out, _ = fused.unroll(T, data, begin_state=fused.begin_state(B),
+                          merge_outputs=True)
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    packed = nd.array((np.random.randn(rnn_param_size(C, H, 2, "gru"))
+                       * 0.3).astype(np.float32))
+    x = nd.array(np.random.randn(B, T, C).astype(np.float32))
+    ex = out.bind(args={"data": x, "r_parameters": packed})
+    base = ex.forward(is_train=False)[0].asnumpy()
+    with autograd.record():
+        inside = ex.forward(is_train=False)[0].asnumpy()
+    # identical up to ulp noise (the recorded path runs through jax.vjp,
+    # whose forward may fuse slightly differently); dropout firing would
+    # change values at O(1) scale
+    np.testing.assert_allclose(base, inside, rtol=1e-6, atol=1e-7)
