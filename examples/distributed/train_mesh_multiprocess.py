@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Multi-process SPMD training over one global mesh.
+
+Reference parity: the reference's multi-machine path is
+`tools/launch.py` + kvstore dist_sync (ps-lite) or horovod/NCCL; here
+EVERY process runs this same script, `multihost.initialize()` joins the
+jax.distributed group, and ShardedTrainer's ordinary jitted step
+executes as one global XLA program — collectives ride ICI within a
+host and DCN across.
+
+Run (single machine, 2 processes x this host's devices):
+
+    python tools/launch.py -n 2 --launcher mesh \
+        python examples/distributed/train_mesh_multiprocess.py
+
+On a real TPU pod slice, run one process per host with no launcher env
+— `multihost.initialize(auto=True)` auto-detects the slice topology.
+
+NOTE: call `multihost.initialize()` BEFORE anything touches the XLA
+backend — import the framework after it (framework import itself is
+backend-free).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+# initialize() must run before the first backend touch
+from incubator_mxnet_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon, nd  # noqa: E402
+from incubator_mxnet_tpu.parallel import ShardedTrainer  # noqa: E402
+
+
+def main():
+    rank = jax.process_index()
+    n_dev = len(jax.devices())
+    print("rank %d/%d: %d global devices" % (rank, jax.process_count(),
+                                             n_dev))
+    mesh = multihost.global_mesh({"dp": n_dev})
+
+    # identical model on every rank (same seed); batches in SPMD style:
+    # every rank supplies the same global batch, the dp sharding splits it
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu"))
+        net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    X = np.random.rand(128, 32).astype(np.float32)
+    y = np.random.randint(0, 10, (128,)).astype(np.int32)
+    net(nd.array(X[:2]))
+
+    def loss_fn(out, lab):
+        import jax.numpy as jnp
+        lp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+        return -jnp.take_along_axis(lp, lab[:, None], axis=-1).mean()
+
+    tr = ShardedTrainer(net, loss_fn, mesh, optimizer="adam",
+                        optimizer_params={"learning_rate": 0.01})
+    for epoch in range(5):
+        loss = float(jax.device_get(tr.step(nd.array(X), nd.array(y))))
+        if rank == 0:
+            print("epoch %d loss %.4f" % (epoch, loss))
+
+
+if __name__ == "__main__":
+    main()
