@@ -13,6 +13,8 @@ from .ndarray import (NDArray, array, empty, zeros, ones, full, arange, eye,
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
 from . import contrib  # noqa: F401
+from . import linalg  # noqa: F401
+from . import image  # noqa: F401
 from ..operator import Custom  # noqa: F401  (reference: nd.Custom)
 from .register import _init_op_functions
 

@@ -1,0 +1,16 @@
+"""The ``mx.nd.image`` namespace (reference: python/mxnet/ndarray/
+image.py — wrappers over the ``image_*`` ops).
+``mx.nd.image.resize(...)`` == the registered ``image_resize`` op."""
+
+from ..ops.registry import get_op, list_ops
+from .register import make_op_func
+
+__all__ = sorted(n[len("image_"):] for n in list_ops()
+                 if n.startswith("image_"))
+
+
+def __getattr__(name):
+    try:
+        return make_op_func(get_op("image_" + name))
+    except KeyError:
+        raise AttributeError("mx.nd.image has no op %r" % name)

@@ -1,0 +1,16 @@
+"""The ``mx.nd.linalg`` namespace (reference: python/mxnet/ndarray/
+linalg.py — auto-generated wrappers over the ``linalg_*`` ops).
+``mx.nd.linalg.gemm2(...)`` == ``mx.nd.linalg_gemm2(...)``."""
+
+from ..ops.registry import get_op, list_ops
+from .register import make_op_func
+
+__all__ = sorted(n[len("linalg_"):] for n in list_ops()
+                 if n.startswith("linalg_"))
+
+
+def __getattr__(name):
+    try:
+        return make_op_func(get_op("linalg_" + name))
+    except KeyError:
+        raise AttributeError("mx.nd.linalg has no op %r" % name)

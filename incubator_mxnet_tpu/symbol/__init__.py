@@ -927,3 +927,5 @@ def block_to_json(block, input_names=("data",)):
     return out.tojson()
 
 from . import contrib  # noqa: E402,F401  (mx.sym.contrib — control flow)
+from . import linalg  # noqa: E402,F401  (mx.sym.linalg)
+from . import image  # noqa: E402,F401  (mx.sym.image)

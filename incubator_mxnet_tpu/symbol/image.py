@@ -1,0 +1,15 @@
+"""The ``mx.sym.image`` namespace (reference: python/mxnet/symbol/
+image.py) — symbol-building wrappers over the ``image_*`` ops."""
+
+from ..ops.registry import list_ops
+
+__all__ = sorted(n[len("image_"):] for n in list_ops()
+                 if n.startswith("image_"))
+
+
+def __getattr__(name):
+    from .. import symbol as _sym
+    try:
+        return getattr(_sym, "image_" + name)
+    except AttributeError:
+        raise AttributeError("mx.sym.image has no op %r" % name)

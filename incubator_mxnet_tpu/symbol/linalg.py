@@ -1,0 +1,15 @@
+"""The ``mx.sym.linalg`` namespace (reference: python/mxnet/symbol/
+linalg.py) — symbol-building wrappers over the ``linalg_*`` ops."""
+
+from ..ops.registry import list_ops
+
+__all__ = sorted(n[len("linalg_"):] for n in list_ops()
+                 if n.startswith("linalg_"))
+
+
+def __getattr__(name):
+    from .. import symbol as _sym
+    try:
+        return getattr(_sym, "linalg_" + name)
+    except AttributeError:
+        raise AttributeError("mx.sym.linalg has no op %r" % name)

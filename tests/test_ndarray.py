@@ -231,3 +231,26 @@ def test_attr_scope_thread_isolation():
     t2 = threading.Thread(target=worker, args=("wd_mult", "0.5"))
     t1.start(); t2.start(); t1.join(); t2.join()
     assert not errs, errs
+
+
+def test_linalg_image_namespaces():
+    """nd.linalg / nd.image / sym.linalg / sym.image namespaces
+    (reference: python/mxnet/{ndarray,symbol}/{linalg,image}.py) expose
+    the prefixed registry ops under their reference names."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    a = mx.nd.array(np.eye(3, dtype=np.float32) * 4.0)
+    L = mx.nd.linalg.potrf(a)
+    np.testing.assert_allclose(L.asnumpy(), np.eye(3) * 2.0, atol=1e-6)
+    assert "gemm2" in mx.nd.linalg.__all__ and "resize" in mx.nd.image.__all__
+
+    img = mx.nd.array(np.random.rand(8, 8, 3).astype(np.float32))
+    assert mx.nd.image.resize(img, size=(4, 4)).shape == (4, 4, 3)
+
+    x = mx.sym.Variable("x")
+    s = mx.sym.linalg.gemm2(x, x, transpose_b=True)
+    ex = s.bind(args={"x": mx.nd.array(np.ones((2, 3), np.float32))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), np.full((2, 2), 3.0))
+    import pytest
+    with pytest.raises(AttributeError):
+        mx.nd.linalg.not_an_op
