@@ -929,3 +929,4 @@ def block_to_json(block, input_names=("data",)):
 from . import contrib  # noqa: E402,F401  (mx.sym.contrib — control flow)
 from . import linalg  # noqa: E402,F401  (mx.sym.linalg)
 from . import image  # noqa: E402,F401  (mx.sym.image)
+from . import random  # noqa: E402,F401  (mx.sym.random)

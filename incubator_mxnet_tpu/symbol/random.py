@@ -1,0 +1,18 @@
+"""The ``mx.sym.random`` namespace (reference: python/mxnet/symbol/
+random.py) — symbol-building wrappers over the ``_random_*`` /
+``random_*`` sampling ops (uniform/normal/gamma/...)."""
+
+from ..ops.registry import list_ops
+
+__all__ = sorted({n[len("random_"):] for n in list_ops()
+                  if n.startswith("random_")})
+
+
+def __getattr__(name):
+    from .. import symbol as _sym
+    for cand in ("random_" + name, "_random_" + name, name):
+        try:
+            return getattr(_sym, cand)
+        except AttributeError:
+            continue
+    raise AttributeError("mx.sym.random has no op %r" % name)

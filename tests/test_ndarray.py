@@ -254,3 +254,14 @@ def test_linalg_image_namespaces():
     import pytest
     with pytest.raises(AttributeError):
         mx.nd.linalg.not_an_op
+
+
+def test_sym_random_namespace():
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    s = mx.sym.random.uniform(low=0.0, high=1.0, shape=(2, 3))
+    out = s.bind(args={}).forward()[0].asnumpy()
+    assert out.shape == (2, 3) and (out >= 0).all() and (out <= 1).all()
+    n = mx.sym.random.normal(loc=0.0, scale=1.0, shape=(64,))
+    v = n.bind(args={}).forward()[0].asnumpy()
+    assert abs(v.mean()) < 1.0
