@@ -1,18 +1,76 @@
 """The ``mx.sym.random`` namespace (reference: python/mxnet/symbol/
-random.py) — symbol-building wrappers over the ``_random_*`` /
-``random_*`` sampling ops (uniform/normal/gamma/...)."""
+random.py) — symbol-building samplers with the SAME signatures as
+``mx.nd.random`` (the reference keeps the two namespaces identical;
+e.g. ``exponential`` takes ``scale``, mapped to the op's ``lam``)."""
 
-from ..ops.registry import list_ops
+__all__ = ["uniform", "normal", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial",
+           "randint", "multinomial", "shuffle"]
 
-__all__ = sorted({n[len("random_"):] for n in list_ops()
-                  if n.startswith("random_")})
 
-
-def __getattr__(name):
+def _build(opname, kwargs):
     from .. import symbol as _sym
-    for cand in ("random_" + name, "_random_" + name, name):
-        try:
-            return getattr(_sym, cand)
-        except AttributeError:
-            continue
-    raise AttributeError("mx.sym.random has no op %r" % name)
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    return getattr(_sym, opname)(**kwargs)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None,
+            name=None, **kw):
+    return _build("random_uniform", dict(low=low, high=high, shape=shape,
+                                         dtype=dtype, name=name))
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+           name=None, **kw):
+    return _build("random_normal", dict(loc=loc, scale=scale, shape=shape,
+                                        dtype=dtype, name=name))
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None,
+          name=None, **kw):
+    return _build("random_gamma", dict(alpha=alpha, beta=beta, shape=shape,
+                                       dtype=dtype, name=name))
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None,
+                name=None, **kw):
+    return _build("random_exponential", dict(lam=1.0 / scale, shape=shape,
+                                             dtype=dtype, name=name))
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, name=None,
+            **kw):
+    return _build("random_poisson", dict(lam=lam, shape=shape, dtype=dtype,
+                                         name=name))
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                      name=None, **kw):
+    return _build("random_negative_binomial",
+                  dict(k=k, p=p, shape=shape, dtype=dtype, name=name))
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, name=None,
+                                  **kw):
+    return _build("random_generalized_negative_binomial",
+                  dict(mu=mu, alpha=alpha, shape=shape, dtype=dtype,
+                       name=name))
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, name=None,
+            **kw):
+    return _build("random_randint", dict(low=low, high=high, shape=shape,
+                                         dtype=dtype, name=name))
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", name=None,
+                **kw):
+    from .. import symbol as _sym
+    return _sym.sample_multinomial(data, shape=shape, get_prob=get_prob,
+                                   dtype=dtype, name=name)
+
+
+def shuffle(data, name=None, **kw):
+    from .. import symbol as _sym
+    return _sym.shuffle(data, name=name)

@@ -142,6 +142,30 @@ int MXTPUKVStoreGetRank(KVStoreHandle h, int *out_rank);
 int MXTPUKVStoreGetGroupSize(KVStoreHandle h, int *out_size);
 int MXTPUKVStoreFree(KVStoreHandle h);
 
+/* ----------------------------------------------------------------- io */
+typedef void *DataIterHandle;
+
+/* Registered iterator class names (NDArrayIter, CSVIter,
+ * ImageRecordIter, ...) — reference: MXListDataIters. */
+int MXTPUListDataIters(int *out_size, const char ***out_names);
+/* Create an iterator by class name with string-encoded kwargs
+ * (reference: MXDataIterCreateIter). For NDArrayIter-style classes the
+ * data/label arrays come in as handles; file-driven iterators take
+ * their paths via the string params and pass 0/NULL here. */
+int MXTPUDataIterCreate(const char *name, int num_params,
+                        const char **keys, const char **vals,
+                        int num_data, NDArrayHandle *data,
+                        int num_label, NDArrayHandle *label,
+                        DataIterHandle *out);
+int MXTPUDataIterBeforeFirst(DataIterHandle h);           /* reset */
+/* Advance; *out_has_next = 0 at end of epoch. */
+int MXTPUDataIterNext(DataIterHandle h, int *out_has_next);
+/* Current batch's first data/label array (new handles — free them). */
+int MXTPUDataIterGetData(DataIterHandle h, NDArrayHandle *out);
+int MXTPUDataIterGetLabel(DataIterHandle h, NDArrayHandle *out);
+int MXTPUDataIterGetPadNum(DataIterHandle h, int *out_pad);
+int MXTPUDataIterFree(DataIterHandle h);
+
 /* ------------------------------------------------------------------- rng */
 int MXTPURandomSeed(int seed);
 

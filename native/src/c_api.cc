@@ -218,6 +218,71 @@ def kv_attr(h, which):
     if which == "rank":
         return kv.rank
     return kv.num_workers
+
+
+_ITER_CLASSES = ("NDArrayIter", "CSVIter", "LibSVMIter", "MNISTIter",
+                 "ImageRecordIter", "ImageDetRecordIter")
+_iter_batches = {}
+
+
+def io_list():
+    return list(_ITER_CLASSES)
+
+
+def io_create(name, keys, vals, data_handles, label_handles):
+    import incubator_mxnet_tpu.io as _io
+    if name not in _ITER_CLASSES:
+        raise ValueError("unknown DataIter %r (have %s)"
+                         % (name, list(_ITER_CLASSES)))
+    kwargs = {k: _coerce(v) for k, v in zip(keys, vals)}
+    if data_handles:
+        d = [_objs[h] for h in data_handles]
+        kwargs["data"] = d[0] if len(d) == 1 else d
+    if label_handles:
+        l = [_objs[h] for h in label_handles]
+        kwargs["label"] = l[0] if len(l) == 1 else l
+    return _put(getattr(_io, name)(**kwargs))
+
+
+def io_reset(h):
+    _iter_batches.pop(h, None)
+    _objs[h].reset()
+
+
+def io_next(h):
+    try:
+        _iter_batches[h] = _objs[h].next()
+        return 1
+    except StopIteration:
+        _iter_batches.pop(h, None)
+        return 0
+
+
+def _io_batch(h):
+    if h not in _iter_batches:
+        raise RuntimeError("no current batch: call DataIterNext first")
+    return _iter_batches[h]
+
+
+def io_getdata(h):
+    return _put(_io_batch(h).data[0])
+
+
+def io_getlabel(h):
+    batch = _io_batch(h)
+    if not batch.label:
+        raise RuntimeError("iterator has no label arrays "
+                           "(created without label)")
+    return _put(batch.label[0])
+
+
+def io_pad(h):
+    return int(_io_batch(h).pad or 0)
+
+
+def io_free(h):
+    _iter_batches.pop(h, None)
+    free(h)
 )PY";
 
 mxtpu::HelperModule g_helper("__mxtpu_capi__", kHelper);
@@ -285,13 +350,15 @@ PyObject *str_list(const char **strs, int n) {
   return list;
 }
 
-// Frees a handle both C- and python-side.
-int free_handle(void *h) {
+// Frees a handle both C- and python-side; fn selects the python-side
+// release hook ("free" for plain objects, "io_free" for iterators,
+// which also drops the current-batch slot).
+int free_handle(void *h, const char *fn = "free") {
   if (!h) return 0;
   if (Py_IsInitialized()) {
     GIL gil;
     PyObject *args = Py_BuildValue("(l)", handle_id(h));
-    PyObject *res = helper_call("free", args);
+    PyObject *res = helper_call(fn, args);
     Py_DECREF(args);
     Py_XDECREF(res);
   }
@@ -676,6 +743,83 @@ int MXTPUKVStoreGetGroupSize(void *h, int *out_size) {
 }
 
 int MXTPUKVStoreFree(void *h) { return free_handle(h); }
+
+int MXTPUListDataIters(int *out_size, const char ***out_names) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = helper_call("io_list", nullptr);
+  if (!res) return -1;
+  strings_to_tls(res, out_size, out_names);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterCreate(const char *name, int num_params, const char **keys,
+                        const char **vals, int num_data, void **data,
+                        int num_label, void **label, void **out) {
+  ensure_python();
+  GIL gil;
+  PyObject *pykeys = str_list(keys, num_params);
+  PyObject *pyvals = str_list(vals, num_params);
+  PyObject *dids = data ? id_list(data, num_data) : PyList_New(0);
+  PyObject *lids = label ? id_list(label, num_label) : PyList_New(0);
+  PyObject *args = Py_BuildValue("(sOOOO)", name, pykeys, pyvals, dids,
+                                 lids);
+  Py_DECREF(pykeys);
+  Py_DECREF(pyvals);
+  Py_DECREF(dids);
+  Py_DECREF(lids);
+  PyObject *res = helper_call("io_create", args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = make_handle(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+static int io_simple(const char *fn, void *h, int *out_int) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", handle_id(h));
+  PyObject *res = helper_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  if (out_int) *out_int = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterBeforeFirst(void *h) {
+  return io_simple("io_reset", h, nullptr);
+}
+
+int MXTPUDataIterNext(void *h, int *out_has_next) {
+  return io_simple("io_next", h, out_has_next);
+}
+
+static int io_array(const char *fn, void *h, void **out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(l)", handle_id(h));
+  PyObject *res = helper_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return -1;
+  *out = make_handle(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterGetData(void *h, void **out) {
+  return io_array("io_getdata", h, out);
+}
+
+int MXTPUDataIterGetLabel(void *h, void **out) {
+  return io_array("io_getlabel", h, out);
+}
+
+int MXTPUDataIterGetPadNum(void *h, int *out_pad) {
+  return io_simple("io_pad", h, out_pad);
+}
+
+int MXTPUDataIterFree(void *h) { return free_handle(h, "io_free"); }
 
 int MXTPURandomSeed(int seed) {
   ensure_python();

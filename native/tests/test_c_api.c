@@ -248,6 +248,66 @@ int main(int argc, char **argv) {
   CHECK(MXTPUKVStoreFree(kv) == 0);
   printf("kvstore=ok\n");
 
+  /* 6. io group: NDArrayIter over C-created arrays — batch count,
+     shapes, values, pad, and epoch reset all from C */
+  int n_iters = 0;
+  const char **iter_names = NULL;
+  CHECK(MXTPUListDataIters(&n_iters, &iter_names) == 0);
+  int found_ndarray_iter = 0;
+  for (int i = 0; i < n_iters; ++i)
+    if (strcmp(iter_names[i], "NDArrayIter") == 0) found_ndarray_iter = 1;
+  CHECK(found_ndarray_iter);
+
+  int dshape[2] = {10, 3};
+  int lshape[1] = {10};
+  float dvals[30], lvals[10];
+  for (int i = 0; i < 30; ++i) dvals[i] = (float)i;
+  for (int i = 0; i < 10; ++i) lvals[i] = (float)(i % 2);
+  NDArrayHandle iter_data = NULL, iter_label = NULL;
+  CHECK(MXTPUNDArrayCreateFromData(dshape, 2, 0, dvals, &iter_data) == 0);
+  CHECK(MXTPUNDArrayCreateFromData(lshape, 1, 0, lvals, &iter_label) == 0);
+  const char *io_keys[2] = {"batch_size", "shuffle"};
+  const char *io_vals[2] = {"4", "False"};
+  DataIterHandle it = NULL;
+  CHECK(MXTPUDataIterCreate("NDArrayIter", 2, io_keys, io_vals,
+                            1, &iter_data, 1, &iter_label, &it) == 0);
+  int epochs, batches = 0, has_next = 0;
+  for (epochs = 0; epochs < 2; ++epochs) {
+    CHECK(MXTPUDataIterBeforeFirst(it) == 0);
+    batches = 0;
+    while (1) {
+      CHECK(MXTPUDataIterNext(it, &has_next) == 0);
+      if (!has_next) break;
+      ++batches;
+      NDArrayHandle bd = NULL, bl = NULL;
+      CHECK(MXTPUDataIterGetData(it, &bd) == 0);
+      CHECK(MXTPUDataIterGetLabel(it, &bl) == 0);
+      int nd_b = 0, bshape[MXTPU_MAX_NDIM];
+      CHECK(MXTPUNDArrayGetShape(bd, &nd_b, bshape) == 0);
+      CHECK(nd_b == 2 && bshape[0] == 4 && bshape[1] == 3);
+      if (batches == 1) {
+        float buf[12];
+        CHECK(MXTPUNDArraySyncCopyToCPU(bd, buf, sizeof(buf)) == 0);
+        for (int i = 0; i < 12; ++i) CHECK(buf[i] == (float)i);
+        int pad = -1;
+        CHECK(MXTPUDataIterGetPadNum(it, &pad) == 0);
+        CHECK(pad == 0);
+      }
+      if (batches == 3) {            /* 10 rows / bs 4: last batch pads 2 */
+        int pad = -1;
+        CHECK(MXTPUDataIterGetPadNum(it, &pad) == 0);
+        CHECK(pad == 2);
+      }
+      CHECK(MXTPUNDArrayFree(bd) == 0);
+      CHECK(MXTPUNDArrayFree(bl) == 0);
+    }
+    CHECK(batches == 3);
+  }
+  CHECK(MXTPUDataIterFree(it) == 0);
+  CHECK(MXTPUNDArrayFree(iter_data) == 0);
+  CHECK(MXTPUNDArrayFree(iter_label) == 0);
+  printf("dataiter=ok\n");
+
   /* error contract: a bad op name fails with a message, not a crash */
   NDArrayHandle *bad_out = NULL;
   int bad_n = 0;

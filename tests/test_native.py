@@ -261,6 +261,7 @@ def test_c_api_abi_full_surface(tmp_path):
     assert "PASS" in r.stdout
     assert "ops=" in r.stdout and "error_contract=ok" in r.stdout
     assert "kvstore=ok" in r.stdout
+    assert "dataiter=ok" in r.stdout
 
     # kvstore mirror: identical init/push/pull sequence in-process
     kv = mx.kv.create("local")

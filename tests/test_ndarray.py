@@ -265,3 +265,10 @@ def test_sym_random_namespace():
     n = mx.sym.random.normal(loc=0.0, scale=1.0, shape=(64,))
     v = n.bind(args={}).forward()[0].asnumpy()
     assert abs(v.mean()) < 1.0
+    # reference signatures match nd.random (exponential takes scale)
+    e = mx.sym.random.exponential(scale=2.0, shape=(256,))
+    ev = e.bind(args={}).forward()[0].asnumpy()
+    assert 0.5 < ev.mean() < 8.0          # mean ~= scale = 2
+    import pytest
+    with pytest.raises(AttributeError):
+        mx.sym.random.exp                  # no bare-op fallback
