@@ -242,7 +242,8 @@ class FusedRNNCell(BaseRNNCell):
 
     def __init__(self, num_hidden, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, get_next_state=False,
-                 prefix=None, params=None):
+                 forget_bias=1.0, initializer=None, prefix=None,
+                 params=None):
         prefix = "%s_" % mode if prefix is None else prefix
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
@@ -251,7 +252,16 @@ class FusedRNNCell(BaseRNNCell):
         self._bidirectional = bidirectional
         self._dropout = dropout
         self._get_next_state = get_next_state
-        self._parameters = self.params.get("parameters")
+        # packed 1-D vector: plain initializers can't role-dispatch it,
+        # so attach init.FusedRNN (unpack -> inner init per matrix ->
+        # forget_bias on the LSTM forget slice -> repack) as the
+        # variable's __init__ attr — same chain as LSTMCell's LSTMBias
+        from ..initializer import FusedRNN as _FusedRNNInit
+        self._parameters = self.params.get(
+            "parameters",
+            init=_FusedRNNInit(initializer or "xavier", num_hidden,
+                               num_layers, mode, bidirectional,
+                               forget_bias=forget_bias))
 
     @property
     def _dirs(self):

@@ -404,3 +404,59 @@ def test_forward_is_train_false_inside_record_stays_inference():
     # whose forward may fuse slightly differently); dropout firing would
     # change values at O(1) scale
     np.testing.assert_allclose(base, inside, rtol=1e-6, atol=1e-7)
+
+
+def test_fused_rnn_cell_trains_through_module():
+    """FusedRNNCell end-to-end: Module.fit over a simple_bind-style
+    graph (packed parameters shape-inferred, initialized by the stock
+    initializer) learns the deterministic next-token task."""
+    V, H, B, T = 12, 32, 8, 5
+    rng = np.random.RandomState(0)
+    seqs = []
+    for _ in range(48):
+        start = rng.randint(1, 11)
+        seqs.append([(start + k) % 10 + 1 for k in range(T + 1)])
+    seqs = np.asarray(seqs, np.float32)
+    X, Y = seqs[:, :-1], seqs[:, 1:]
+
+    cell = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="fm_")
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, mx.sym.Variable("embed_weight"),
+                             input_dim=V, output_dim=H, name="embed")
+    outs, _ = cell.unroll(T, embed, begin_state=cell.begin_state(B),
+                          merge_outputs=True)
+    pred = mx.sym.reshape(outs, shape=(-1, H))
+    pred = mx.sym.FullyConnected(pred, mx.sym.Variable("cls_weight"),
+                                 mx.sym.Variable("cls_bias"),
+                                 num_hidden=V, name="cls")
+    out = mx.sym.SoftmaxOutput(pred, mx.sym.reshape(label, shape=(-1,)),
+                               name="softmax")
+
+    it = mx.io.NDArrayIter(X, Y, batch_size=B, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+
+    class FlatAcc(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("flat_acc")
+
+        def update(self, labels, preds):
+            lab = labels[0].asnumpy().reshape(-1).astype(np.int64)
+            pred_ids = preds[0].asnumpy().argmax(1)
+            self.sum_metric += float((pred_ids == lab).sum())
+            self.num_inst += len(lab)
+
+    mod.fit(it, num_epoch=15, initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            eval_metric=FlatAcc())
+    it.reset()
+    correct, total = 0, 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred_ids = mod.get_outputs()[0].asnumpy().argmax(1)
+        lab = batch.label[0].asnumpy().reshape(-1)
+        correct += (pred_ids == lab).sum()
+        total += len(lab)
+    assert correct / total > 0.8, correct / total
