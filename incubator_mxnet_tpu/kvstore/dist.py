@@ -30,6 +30,7 @@ from .kvstore import KVStore
 from .rpc import Connection
 from .dist_server import SchedulerClient
 from ..ndarray import NDArray
+from ..utils import failpoints as _fp
 
 __all__ = ["KVStoreDist", "create_dist"]
 
@@ -93,6 +94,10 @@ class KVStoreDist(KVStore):
         waits on strictly earlier-submitted tasks). Cross-key sends
         overlap freely."""
         if self._io is None:
+            d = _fp.failpoint("kv.push.delay")
+            if d:
+                import time
+                time.sleep(float(d))
             fn()
             return
         with self._pending_lock:
@@ -104,21 +109,45 @@ class KVStoreDist(KVStore):
                         _prev.result()
                     except Exception:
                         pass    # predecessor failure surfaces via _flush
+                d = _fp.failpoint("kv.push.delay")
+                if d:
+                    import time
+                    time.sleep(float(d))
                 return fn()
 
             fut = self._io.submit(run)
             self._chain[key] = fut
             self._pending.setdefault(key, []).append(fut)
 
-    @staticmethod
-    def _checked_call(conn, meta, payload=None):
-        """RPC call that surfaces server-reported failures. The server wraps
-        handler exceptions into {"error": ...} replies — without this check
-        an async push failure is silently swallowed (the gradient update is
-        dropped; in sync mode the round never completes and surfaces much
-        later as an unrelated pull timeout)."""
-        rmeta, rpayload = conn.call(meta, payload if payload is not None
-                                    else b"")
+    def _refresh_conn(self, conn):
+        """Between retries: re-resolve this server's address from the
+        scheduler — a replacement server re-registers under the dead
+        one's rank with a FRESH port, and the retry loop must follow it
+        instead of hammering a corpse."""
+        try:
+            sid = self._servers.index(conn)
+        except ValueError:
+            return
+        nodes = self._sched.get_nodes(timeout=10)
+        addr = nodes.get("servers", {}).get(sid)
+        if addr:
+            conn.set_addr(addr)
+
+    def _checked_call(self, conn, meta, payload=None):
+        """Idempotent RPC call that surfaces server-reported failures.
+
+        Mutating ops ride `call_idempotent`: seq-stamped, retried with
+        bounded backoff through transient transport faults AND server
+        restarts (the server's DedupCache replays the cached ack if the
+        original apply landed, so a retried push never double-applies).
+        The server wraps handler exceptions into {"error": ...} replies —
+        without the check an async push failure is silently swallowed
+        (the gradient update is dropped; in sync mode the round never
+        completes and surfaces much later as an unrelated pull
+        timeout)."""
+        rmeta, rpayload = conn.call_idempotent(
+            meta, payload if payload is not None else b"",
+            on_retry=self._refresh_conn)
         if isinstance(rmeta, dict) and rmeta.get("error"):
             raise RuntimeError("%s(%r): %s" % (
                 meta.get("op"), meta.get("key"), rmeta["error"]))
@@ -270,9 +299,12 @@ class KVStoreDist(KVStore):
         shape = tuple(ref.shape)
         parts = []
         for sid, lo, hi in self._shards_for(key, shape):
-            meta, payload = self._servers[sid].call(
+            # pull is a read — naturally idempotent, retried WITHOUT a
+            # dedup stamp (replies can be large; never cached server-side)
+            meta, payload = self._servers[sid].call_idempotent(
                 {"op": "pull", "key": self._part_key(key, lo),
-                 "rank": self._rank})
+                 "rank": self._rank},
+                dedup=False, on_retry=self._refresh_conn)
             if meta.get("error"):
                 raise RuntimeError("pull(%r): %s" % (key, meta["error"]))
             parts.append(np.frombuffer(payload, dtype=meta["dtype"])
@@ -301,10 +333,11 @@ class KVStoreDist(KVStore):
             if not mask.any():
                 continue
             local = rids[mask] - lo
-            meta, payload = self._servers[sid].call(
+            meta, payload = self._servers[sid].call_idempotent(
                 {"op": "pull", "key": self._part_key(key, lo),
                  "rows_n": int(local.size), "rank": self._rank},
-                np.ascontiguousarray(local, dtype=np.int64).tobytes())
+                np.ascontiguousarray(local, dtype=np.int64).tobytes(),
+                dedup=False, on_retry=self._refresh_conn)
             if meta.get("error"):
                 raise RuntimeError("row_sparse_pull(%r): %s"
                                    % (key, meta["error"]))

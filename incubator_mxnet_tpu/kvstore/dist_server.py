@@ -12,6 +12,7 @@ first beat). A node whose last beat is older than MXTPU_PS_DEAD_TIMEOUT
 hanging when a participant dies mid-wait (the reference's ps-lite hangs —
 VERDICT r1 called that out, so this build fails fast)."""
 
+import logging
 import os
 import pickle
 import threading
@@ -19,11 +20,14 @@ import time
 
 import numpy as np
 
-from .rpc import Server, request, Connection, ProtocolError
+from .rpc import Server, request, Connection, ProtocolError, DedupCache
 from .compression import GradientCompression
 from .. import profiler as _server_profiler
+from ..utils import failpoints as _fp
 
 __all__ = ["run_scheduler", "run_server", "SchedulerClient"]
+
+_log = logging.getLogger(__name__)
 
 _DEAD_TIMEOUT = float(os.environ.get("MXTPU_PS_DEAD_TIMEOUT", "30"))
 _BARRIER_POLL = 2.0
@@ -230,12 +234,32 @@ class SchedulerClient:
 
         def loop():
             conn = Connection(self.addr)   # dedicated socket
+            failures = 0
+            first_failure = None
+            warned = False
             while not self._hb_stop.wait(interval):
                 try:
                     conn.call({"op": "heartbeat", "role": role, "rank": rank},
                               timeout=10)
+                    failures, first_failure, warned = 0, None, False
                 except (OSError, ConnectionError, ProtocolError):
-                    pass    # scheduler gone/mid-frame: shutdown handles it
+                    # a transient miss is normal (scheduler busy, frame
+                    # lost); a streak past the dead-node timeout means the
+                    # scheduler will declare THIS node dead — say so once
+                    # instead of swallowing every error forever, so a hung
+                    # job is diagnosable from the logs
+                    failures += 1
+                    now = time.time()
+                    if first_failure is None:
+                        first_failure = now
+                    if not warned and now - first_failure > _DEAD_TIMEOUT:
+                        _log.warning(
+                            "%s rank %s: scheduler %s unreachable for "
+                            "%.0fs (%d consecutive heartbeat failures, "
+                            "dead-node timeout %.0fs) — peers will treat "
+                            "this node as dead", role, rank, self.addr,
+                            now - first_failure, failures, _DEAD_TIMEOUT)
+                        warned = True
             conn.close()
 
         self._hb_thread = threading.Thread(target=loop, daemon=True)
@@ -301,9 +325,155 @@ def _pickle_allowed(meta):
     return meta.get("_peer", "") in ("127.0.0.1", "::1", "localhost")
 
 
+class _ServerSnapshot:
+    """Durable server state via utils.checkpoint's atomic-rename writer.
+
+    Persists the key→value store, in-flight sync-round accumulators and
+    pending sets, the optimizer (registry spec when JSON-clean, pickle
+    otherwise), this server's RANK, and the idempotency dedup windows —
+    everything a replacement process needs to rejoin under the old rank
+    and keep retried pushes exactly-once.
+
+    Two modes (MXTPU_PS_SNAPSHOT_SYNC, default 1):
+    - sync: a snapshot is written after EVERY mutating op, before its
+      reply leaves — any acked mutation is durable, so a SIGKILL'd
+      server restarts with no lost update (the exact-recovery mode the
+      fault-tolerance tests assert). Costs a disk write per mutation.
+    - periodic: a background thread writes at most every
+      MXTPU_PS_SNAPSHOT_INTERVAL seconds (default 10) when dirty —
+      bounded loss, negligible steady-state cost.
+    """
+
+    def __init__(self, directory, state, dedup):
+        from ..utils.checkpoint import CheckpointManager
+        self._mgr = CheckpointManager(directory, keep=2, async_save=False,
+                                      prefix="psnap")
+        self._state = state
+        self._dedup = dedup
+        self._step = 0
+        self.sync = os.environ.get("MXTPU_PS_SNAPSHOT_SYNC", "1") != "0"
+        self.interval = float(
+            os.environ.get("MXTPU_PS_SNAPSHOT_INTERVAL", "10"))
+        self.rank = None
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._ticker = None
+
+    def save(self):
+        """Write one atomic snapshot. Caller must hold the mutation lock
+        (no mutating op may run between reading the dedup windows and the
+        store — a mutation landing in only one of them either loses an
+        acked update on restore or double-applies a retried one)."""
+        state = self._state
+        params = {}
+        extra = {"rank": self.rank, "sync_mode": state.sync_mode}
+        with state.lock:
+            for k, v in state.store.items():
+                params["store/%s" % k] = v.copy()
+            for k, v in state.accum.items():
+                if v is not None:
+                    params["accum/%s" % k] = v.copy()
+            extra["pending"] = {k: sorted(v)
+                                for k, v in state.pending.items() if v}
+            extra["push_gen"] = dict(state.push_gen)
+            opt = state.optimizer
+        trainer_payload = None
+        if opt is not None:
+            from .optimizer_spec import optimizer_to_spec
+            try:
+                extra["optimizer_spec"] = optimizer_to_spec(opt)
+            except TypeError:
+                trainer_payload = pickle.dumps(opt)
+        extra["dedup"] = self._dedup.state()
+        self._step += 1
+        self._mgr.save(self._step, params, trainer=trainer_payload,
+                       extra=extra)
+        self._dirty.clear()
+
+    def restore(self):
+        """Load the latest snapshot into the live state; returns the
+        restored rank (None when no snapshot exists — fresh start)."""
+        try:
+            step, params, trainer_payload, meta = self._mgr.restore()
+        except FileNotFoundError:
+            return None
+        state = self._state
+        with state.cv:
+            state.store = {}
+            state.accum = {}
+            for k, v in params.items():
+                arr = np.asarray(v.asnumpy())
+                if k.startswith("store/"):
+                    state.store[k[len("store/"):]] = arr
+                elif k.startswith("accum/"):
+                    state.accum[k[len("accum/"):]] = arr
+            state.pending = {k: set(v)
+                             for k, v in (meta.get("pending") or {}).items()}
+            state.push_gen = dict(meta.get("push_gen") or {})
+            opt = None
+            if meta.get("optimizer_spec"):
+                from .optimizer_spec import optimizer_from_spec
+                opt = optimizer_from_spec(meta["optimizer_spec"])
+            elif trainer_payload is not None:
+                opt = pickle.loads(trainer_payload)
+            if opt is not None:
+                from .. import optimizer as optmod
+                state.optimizer = opt
+                state.updater = optmod.get_updater(opt)
+            state.cv.notify_all()
+        self._dedup.load_state(meta.get("dedup"))
+        self._step = int(step)
+        self.rank = meta.get("rank")
+        return self.rank
+
+    def start_ticker(self, mut_lock):
+        """Periodic-mode writer (no-op in sync mode: every mutation
+        already snapshots inline)."""
+        if self.sync:
+            return
+
+        def tick():
+            while not self._stop.wait(self.interval):
+                if self._dirty.is_set():
+                    with mut_lock:
+                        try:
+                            self.save()
+                        except Exception:   # noqa: BLE001 — a failed
+                            _log.exception(  # snapshot must not kill serving
+                                "periodic parameter-server snapshot failed")
+
+        self._ticker = threading.Thread(target=tick, daemon=True)
+        self._ticker.start()
+
+    def mark_dirty(self):
+        self._dirty.set()
+
+    def stop(self, mut_lock):
+        self._stop.set()
+        if self._dirty.is_set():
+            with mut_lock:
+                try:
+                    self.save()
+                except Exception:   # noqa: BLE001
+                    _log.exception("final parameter-server snapshot failed")
+
+
+# ops that change server state and therefore participate in snapshotting
+# and must be stamped idempotent by clients
+_MUTATING_OPS = frozenset(["init", "push", "set_optimizer",
+                           "set_optimizer_spec", "set_compression",
+                           "command"])
+
+
 def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
-               port=0):
-    """Blocking server loop (own process). Registers with the scheduler."""
+               port=0, snapshot_dir=None):
+    """Blocking server loop (own process). Registers with the scheduler.
+
+    With `snapshot_dir` (or MXTPU_PS_SNAPSHOT_DIR) set, the server
+    persists its state there and a replacement process pointed at the
+    same directory restores it and re-registers under the SAME rank —
+    workers retrying through `call_idempotent` reconnect to the new
+    address from the scheduler and training continues."""
     state = _ServerState(num_workers, sync_mode)
 
     def apply_update(key, agg):
@@ -350,7 +520,7 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             return {"error": "unknown profiler action %r" % action}, b""
         return {"ok": True}, b""
 
-    def handler(meta, payload):
+    def _profiled(meta, payload):
         import contextlib
         op = meta["op"]
         rec = (_server_profiler.record_op("server_" + op)
@@ -358,6 +528,33 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                else contextlib.nullcontext())
         with rec:
             return _handle(meta, payload)
+
+    # idempotency: retried seq-stamped requests replay the cached reply
+    # instead of re-applying (the server half of call_idempotent)
+    dedup = DedupCache()
+    deduped = dedup.wrap(_profiled)
+    snap_dir = snapshot_dir or os.environ.get("MXTPU_PS_SNAPSHOT_DIR")
+    snap = _ServerSnapshot(snap_dir, state, dedup) if snap_dir else None
+    # one lock serializes {mutating op + its dedup entry} against snapshot
+    # writes: a snapshot can never see a dedup'd seq without its mutation
+    # (restore would then drop a retried-but-acked update) nor the
+    # reverse (restore would double-apply it)
+    mut_lock = threading.Lock()
+
+    def handler(meta, payload):
+        die = _fp.failpoint("server.die")
+        if die:
+            os._exit(int(die) if die is not True else 137)
+        if snap is not None and meta.get("op") in _MUTATING_OPS:
+            with mut_lock:
+                out = deduped(meta, payload)
+                if not (isinstance(out[0], dict) and out[0].get("error")):
+                    if snap.sync:
+                        snap.save()
+                    else:
+                        snap.mark_dirty()
+            return out
+        return deduped(meta, payload)
 
     def _handle(meta, payload):
         op = meta["op"]
@@ -371,6 +568,9 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                     state.store[meta["key"]] = _decode(meta, payload).copy()
             return {"ok": True}, b""
         if op == "push":
+            d = _fp.failpoint("server.push.delay")
+            if d:
+                time.sleep(float(d))
             key = meta["key"]
             rows = meta.get("rows")          # legacy JSON ids
             if meta.get("rows_n") is not None:
@@ -497,14 +697,25 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             return {"ok": True}, b""
         return {"error": "unknown op %s" % op}, b""
 
+    restored_rank = snap.restore() if snap is not None else None
     srv = Server(handler, port=port,
                  host=os.environ.get("DMLC_NODE_HOST", "127.0.0.1")).start()
     sched = SchedulerClient(tuple(scheduler_addr))
-    rank = sched.register("server", srv.addr)
+    # a replacement server claims its predecessor's rank: the scheduler
+    # updates that rank's address in place, so workers re-resolving via
+    # get_nodes find the new process where the old one lived
+    rank = sched.register("server", srv.addr, rank=restored_rank)
     sched.start_heartbeats("server", rank)
+    if snap is not None:
+        snap.rank = rank
+        with mut_lock:
+            snap.save()   # rank is durable before any traffic: a crash
+        snap.start_ticker(mut_lock)   # at ANY later point recovers it
     if ready_event is not None:
         ready_event.set()
     state.done.wait()
+    if snap is not None:
+        snap.stop(mut_lock)
     sched.bye("server", rank)
     time.sleep(0.2)
     srv.stop()

@@ -15,10 +15,16 @@ socket). The DCN path of a real pod would swap this transport for gRPC
 without touching the KVStore semantics layered above.
 """
 
+import itertools
 import json
+import os
 import socket
 import struct
 import threading
+import time
+import uuid
+
+from ..utils import failpoints as _fp
 
 _HDR = struct.Struct("<I")
 
@@ -88,6 +94,13 @@ def request(addr, obj, payload=b"", timeout=60.0):
         return recv_msg(s)
 
 
+def retry_window():
+    """Seconds a retryable call keeps retrying before surfacing the error
+    (MXTPU_PS_RETRY_WINDOW; 0 = fail fast, `call_idempotent` degrades to
+    exactly `call`)."""
+    return float(os.environ.get("MXTPU_PS_RETRY_WINDOW", "30"))
+
+
 class Connection:
     """Persistent connection with per-call locking and auto-reconnect."""
 
@@ -96,6 +109,11 @@ class Connection:
         self._timeout = timeout
         self._sock = None
         self._lock = threading.Lock()
+        # idempotency identity: servers dedup retried requests by
+        # (client token, seq). The token survives reconnects — a resend
+        # after a dropped socket must dedup against the original apply.
+        self._client_token = uuid.uuid4().hex
+        self._seq = itertools.count(1)
 
     def _ensure(self):
         if self._sock is None:
@@ -103,19 +121,41 @@ class Connection:
                                                   timeout=self._timeout)
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
+    def set_addr(self, addr):
+        """Repoint at a new peer address (a restarted server comes back on
+        a fresh port); the next call reconnects there. The dedup identity
+        is unchanged — retries still dedup server-side if the replacement
+        restored the original's state."""
+        addr = tuple(addr)
+        with self._lock:
+            if addr != self._addr:
+                self._addr = addr
+                self._close_locked()
+
     def call(self, obj, payload=b"", timeout=None):
         with self._lock:
             try:
                 self._ensure()
+                if _fp.failpoint("rpc.send.drop"):
+                    # request lost BEFORE hitting the wire: never applied
+                    self._close_locked()
+                    raise OSError("failpoint: rpc.send.drop")
                 if timeout is not None:
                     self._sock.settimeout(timeout)
                 send_msg(self._sock, obj, payload)
+                if _fp.failpoint("rpc.recv.drop"):
+                    # reply lost AFTER the request hit the wire: the server
+                    # applies it, this client never sees the ack
+                    self._close_locked()
+                    raise OSError("failpoint: rpc.recv.drop")
                 meta, data = recv_msg(self._sock)
             except (OSError, ProtocolError):
-                # NO automatic resend: the request may already have been
-                # applied server-side (push/register are not idempotent).
-                # Drop the socket so the NEXT call reconnects; surface the
-                # failure to the caller.
+                # NO automatic resend here: the request may already have
+                # been applied server-side (a raw push/register is not
+                # idempotent). Drop the socket so the NEXT call
+                # reconnects; surface the failure to the caller.
+                # `call_idempotent` layers safe retries on top by
+                # stamping requests with a dedupable sequence id.
                 self._close_locked()
                 raise
             finally:
@@ -126,6 +166,49 @@ class Connection:
                 raise ConnectionError("peer %s closed the connection"
                                       % (self._addr,))
             return meta, data
+
+    def call_idempotent(self, obj, payload=b"", timeout=None, window=None,
+                        dedup=True, on_retry=None):
+        """`call` wrapped in bounded exponential backoff with reconnect.
+
+        With ``dedup=True`` (mutating ops) the request is stamped with
+        this connection's client token and a monotonic sequence id; a
+        server running a `DedupCache` applies each seq at most once and
+        replays the cached reply for resends, so retrying after ANY
+        transport error is safe — including the ambiguous reply-lost
+        case the bare `call` refuses to retry. ``dedup=False`` is for
+        naturally idempotent reads (pull): retried verbatim, never
+        cached server-side.
+
+        `window` seconds of retrying (default MXTPU_PS_RETRY_WINDOW;
+        0 = fail fast with no retry and no timing overhead). `on_retry`
+        is called with this connection before each resend — the worker
+        uses it to re-resolve a restarted server's fresh address from
+        the scheduler.
+        """
+        if dedup:
+            obj = dict(obj)
+            obj["_client"] = self._client_token
+            obj["_seq"] = next(self._seq)
+        if window is None:
+            window = retry_window()
+        if window <= 0:
+            return self.call(obj, payload, timeout=timeout)
+        deadline = time.monotonic() + window
+        delay = 0.05
+        while True:
+            try:
+                return self.call(obj, payload, timeout=timeout)
+            except (OSError, ProtocolError):
+                if time.monotonic() + delay > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                if on_retry is not None:
+                    try:
+                        on_retry(self)
+                    except Exception:   # noqa: BLE001 — the resolver
+                        pass            # failing must not mask the retry
 
     def _close_locked(self):
         if self._sock is not None:
@@ -138,6 +221,74 @@ class Connection:
     def close(self):
         with self._lock:
             self._close_locked()
+
+
+class DedupCache:
+    """Per-client reply cache making seq-stamped requests idempotent.
+
+    ``wrap(handler)`` returns a handler that applies each (client token,
+    seq) at most once and replays the cached reply for resends — the
+    server half of `Connection.call_idempotent`. Requests without a seq
+    stamp pass straight through (reads are never cached). Calls from ONE
+    client serialize on that client's lock so a resend racing its
+    original never double-applies; distinct clients stay parallel.
+
+    The cache holds the last `window` replies per client — more than a
+    client can have outstanding (its calls serialize on the connection
+    lock), so a live retry always finds its entry. Mutating-op replies
+    are tiny acks; the window stays O(window) per client.
+    """
+
+    def __init__(self, window=128):
+        self._window = int(window)
+        self._lock = threading.Lock()
+        self._clients = {}   # token -> (client lock, {seq: (meta, payload)})
+
+    def _client(self, token):
+        with self._lock:
+            ent = self._clients.get(token)
+            if ent is None:
+                ent = (threading.Lock(), {})
+                self._clients[token] = ent
+            return ent
+
+    def wrap(self, handler):
+        def wrapped(meta, payload):
+            token, seq = meta.get("_client"), meta.get("_seq")
+            if token is None or seq is None:
+                return handler(meta, payload)
+            lock, cache = self._client(token)
+            with lock:
+                hit = cache.get(seq)
+                if hit is not None:
+                    return hit
+                out = handler(meta, payload)
+                cache[seq] = out
+                while len(cache) > self._window:
+                    cache.pop(min(cache))
+                return out
+        return wrapped
+
+    # ---- snapshot/restore (server recovery must not forget which seqs
+    # it already applied, or an in-flight retry double-applies) --------
+    def state(self):
+        with self._lock:
+            items = list(self._clients.items())
+        out = {}
+        for token, (lock, cache) in items:
+            with lock:
+                out[token] = {
+                    str(seq): [meta, payload.hex() if payload else ""]
+                    for seq, (meta, payload) in cache.items()}
+        return out
+
+    def load_state(self, state):
+        with self._lock:
+            self._clients = {
+                token: (threading.Lock(),
+                        {int(seq): (meta, bytes.fromhex(hexpay))
+                         for seq, (meta, hexpay) in cache.items()})
+                for token, cache in (state or {}).items()}
 
 
 class Server:
@@ -190,6 +341,14 @@ class Server:
                 except Exception as e:   # noqa: BLE001 — reply, don't die
                     out_meta, out_payload = (
                         {"error": "%s: %s" % (type(e).__name__, e)}, b"")
+                d = _fp.failpoint("rpc.reply.delay")
+                if d:
+                    time.sleep(float(d))
+                if _fp.failpoint("rpc.reply.drop"):
+                    # request applied, reply never sent: the client sees a
+                    # dead socket and must resolve the ambiguity by
+                    # retrying with a dedupable seq
+                    return
                 send_msg(conn, out_meta, out_payload)
         except (OSError, EOFError, ProtocolError):
             pass

@@ -185,9 +185,23 @@ class CheckpointManager:
                 json.dump(meta, f)
                 f.flush()
                 os.fsync(f.fileno())
+            # re-checkpoint of an existing step: rename the old dir ASIDE
+            # (atomic), publish the new one, THEN delete the old. The
+            # previous rmtree(final)-then-rename left an O(rmtree) window
+            # with no checkpoint at all for this step if the process died
+            # between the two; now the gap is two atomic renames and the
+            # old data still exists on disk until the new one is live.
+            # The aside name parses as no step (int() fails on the
+            # suffix), so steps()/restore() never see it.
+            old = None
             if os.path.exists(final):
-                shutil.rmtree(final)
+                old = "%s.old%s.%d" % (final, _TMP_SUFFIX, os.getpid())
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(final, old)
             os.rename(tmp, final)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
             self._prune()
         except BaseException as e:   # re-raised on the caller thread
             self._error = e

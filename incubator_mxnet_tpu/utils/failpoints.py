@@ -1,0 +1,165 @@
+"""Named failpoints for deterministic fault injection.
+
+The reference exercises ps-lite resilience with real multi-machine chaos
+(killed nodes, dropped links); this build needs the same faults to be
+injectable *deterministically* inside one test process. A failpoint is a
+named site threaded through the transport (`kvstore/rpc.py`), the worker
+client (`kvstore/dist.py`) and the server (`kvstore/dist_server.py`):
+
+    from incubator_mxnet_tpu.utils import failpoints
+    if failpoints.failpoint("rpc.send.drop"):
+        raise OSError("injected")
+    delay = failpoints.failpoint("rpc.reply.delay")
+    if delay:
+        time.sleep(delay)
+
+`failpoint(name)` returns a falsy value when the site is inactive and the
+site's configured ``value`` (default ``True``) when it fires — the SITE
+decides what firing means (drop a frame, sleep, exit). The check is a
+single module-dict truthiness test when no failpoint is active anywhere,
+so production traffic pays zero overhead.
+
+Activation:
+
+- programmatic: ``activate("rpc.send.drop", prob=1.0, count=2)`` /
+  ``deactivate(name)`` / ``reset()``, or the ``active(...)`` context
+  manager which restores the previous state on exit;
+- environment: ``MXTPU_FAILPOINTS=name[:prob[:count[:value]]],...``
+  parsed at import (subprocesses spawned with the var inherit the
+  failpoints with no code changes). ``prob`` is the firing probability
+  (default 1), ``count`` the number of times the site may fire before
+  deactivating itself (default unlimited), ``value`` what the site
+  receives when it fires (float if it parses, else the raw string).
+
+Known sites (grep for ``failpoint(`` to enumerate):
+
+- ``rpc.send.drop``     — Connection.call: fail before the request frame
+  is written (the request is never applied).
+- ``rpc.recv.drop``     — Connection.call: fail after the request frame
+  is written (the request IS applied; the reply is lost).
+- ``rpc.reply.delay``   — rpc.Server: sleep ``value`` seconds before
+  writing the reply (client-side timeouts fire mid-exchange).
+- ``rpc.reply.drop``    — rpc.Server: apply the request, drop the
+  connection instead of replying.
+- ``kv.push.delay``     — KVStoreDist: sleep ``value`` seconds before a
+  push RPC leaves the worker.
+- ``server.push.delay`` — dist_server: sleep ``value`` seconds inside
+  the push handler (before the reply, after the apply).
+- ``server.die``        — dist_server: ``os._exit(value or 137)`` inside
+  the handler — a crash indistinguishable from SIGKILL to peers.
+"""
+
+import os
+import random
+import threading
+
+__all__ = ["failpoint", "activate", "deactivate", "reset", "active",
+           "is_active", "load_env", "list_active"]
+
+_lock = threading.Lock()
+# name -> [prob, remaining_count_or_None, value]; the module-level dict
+# doubles as the fast-path gate: `if not _ACTIVE` costs one dict check.
+_ACTIVE = {}
+
+
+def failpoint(name):
+    """Return falsy when inactive; the configured value when firing."""
+    if not _ACTIVE:
+        return False
+    with _lock:
+        fp = _ACTIVE.get(name)
+        if fp is None:
+            return False
+        prob, count, value = fp
+        if prob < 1.0 and random.random() >= prob:
+            return False
+        if count is not None:
+            if count <= 0:
+                return False
+            fp[1] = count - 1
+            if fp[1] <= 0:
+                del _ACTIVE[name]
+        return value
+
+
+def activate(name, prob=1.0, count=None, value=True):
+    """Arm `name`: fire with probability `prob`, at most `count` times
+    (None = unlimited), handing `value` to the site."""
+    with _lock:
+        _ACTIVE[name] = [float(prob), count, value]
+
+
+def deactivate(name):
+    with _lock:
+        _ACTIVE.pop(name, None)
+
+
+def reset():
+    """Disarm every failpoint (returns the module to zero-overhead)."""
+    with _lock:
+        _ACTIVE.clear()
+
+
+def is_active(name):
+    return name in _ACTIVE
+
+
+def list_active():
+    with _lock:
+        return {k: tuple(v) for k, v in _ACTIVE.items()}
+
+
+class active:
+    """Context manager: arm on enter, restore the prior state on exit."""
+
+    def __init__(self, name, prob=1.0, count=None, value=True):
+        self._args = (name, prob, count, value)
+        self._prev = None
+
+    def __enter__(self):
+        name = self._args[0]
+        with _lock:
+            self._prev = _ACTIVE.get(name)
+        activate(*self._args)
+        return self
+
+    def __exit__(self, *exc):
+        name = self._args[0]
+        with _lock:
+            if self._prev is None:
+                _ACTIVE.pop(name, None)
+            else:
+                _ACTIVE[name] = self._prev
+        return False
+
+
+def load_env(spec=None):
+    """Parse ``MXTPU_FAILPOINTS=name[:prob[:count[:value]]],...`` (or an
+    explicit `spec` string) and arm the listed failpoints. Malformed
+    entries raise ValueError — silently ignoring a typo'd failpoint would
+    make a chaos run silently fault-free."""
+    if spec is None:
+        spec = os.environ.get("MXTPU_FAILPOINTS", "")
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        parts = entry.split(":")
+        name = parts[0]
+        if not name:
+            raise ValueError("MXTPU_FAILPOINTS entry with empty name: %r"
+                             % entry)
+        try:
+            prob = float(parts[1]) if len(parts) > 1 and parts[1] else 1.0
+            count = (int(parts[2]) if len(parts) > 2 and parts[2]
+                     else None)
+        except ValueError:
+            raise ValueError("bad MXTPU_FAILPOINTS entry %r "
+                             "(want name[:prob[:count[:value]]])" % entry)
+        value = True
+        if len(parts) > 3 and parts[3]:
+            try:
+                value = float(parts[3])
+            except ValueError:
+                value = parts[3]
+        activate(name, prob=prob, count=count, value=value)
+
+
+load_env()

@@ -24,6 +24,12 @@ import numpy as _np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: exceeds the tier-1 wall-clock budget "
+        "(deselected by -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Deterministic per-test seeding (reference: with_seed decorator;

@@ -133,6 +133,23 @@ def test_ckpt_manager_ignores_partial(tmp_path):
     assert step == 5
 
 
+def test_ckpt_manager_resave_step_replaces_without_window(tmp_path):
+    """Re-saving an existing step publishes the new content via
+    rename-aside (old dir moved out of the way, new dir renamed in, old
+    deleted) — never a delete-then-rename window with no checkpoint, and
+    no stale aside dirs left behind."""
+    from incubator_mxnet_tpu.utils import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(5, {"w": mx.nd.array(np.full((2,), 1.0, np.float32))})
+    mgr.save(5, {"w": mx.nd.array(np.full((2,), 2.0, np.float32))})
+    assert mgr.steps() == [5]
+    _, params, _, _ = mgr.restore(5)
+    np.testing.assert_array_equal(params["w"].asnumpy(),
+                                  np.full((2,), 2.0, np.float32))
+    leftovers = [e for e in os.listdir(str(tmp_path)) if ".old" in e]
+    assert leftovers == []
+
+
 def test_ckpt_manager_trainer_states_roundtrip(tmp_path):
     net = mx.gluon.nn.Dense(4, in_units=8, prefix="ck_")
     net.initialize(mx.init.Xavier())

@@ -1,0 +1,274 @@
+"""Parameter-server fault tolerance (ISSUE 1 tentpole).
+
+A real server PROCESS is SIGKILL'd in the middle of a dist_sync push/pull
+training loop; a replacement pointed at the same snapshot directory
+restores the store + optimizer + in-flight round + idempotency windows,
+re-registers under the dead server's rank, and the workers — retrying
+through `Connection.call_idempotent` and re-resolving the fresh address
+from the scheduler — finish with parameters IDENTICAL to an uninterrupted
+run: no hang, no lost update, no duplicate apply from a retried push.
+
+Exactness comes from the sync-snapshot mode (MXTPU_PS_SNAPSHOT_SYNC=1,
+the default when a snapshot dir is set): every mutating op is durable
+before its ack leaves, so whatever instant SIGKILL lands, acked state is
+recoverable and unacked requests are safely retried.
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _latest_snapshot_step(snap_dir):
+    if not os.path.isdir(snap_dir):
+        return 0
+    steps = []
+    for e in os.listdir(snap_dir):
+        if e.startswith("psnap-") and "." not in e:
+            if os.path.exists(os.path.join(snap_dir, e, "meta.json")):
+                try:
+                    steps.append(int(e[len("psnap-"):]))
+                except ValueError:
+                    pass
+    return max(steps, default=0)
+
+
+def _train_worker(rank, rounds, queue):
+    """R rounds of sync push/pull with a server-side SGD optimizer:
+    w starts at 0, every round w -= 0.1 * (sum of grads)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+        kv = KVStoreDist("dist_sync")
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        kv.set_optimizer(opt)
+        if kv.rank == 0:
+            kv.init("w", nd.zeros((4,)))
+        kv.barrier()
+        out = nd.zeros((4,))
+        for _ in range(rounds):
+            kv.push("w", nd.ones((4,)) * (kv.rank + 1))
+            kv.pull("w", out=out)
+        kv.barrier()
+        kv.close()
+        queue.put((rank, out.asnumpy().tolist()))
+    except Exception as e:   # surface failures to the test process
+        import traceback
+        queue.put((rank, "ERROR: %s\n%s" % (e, traceback.format_exc())))
+
+
+def _run_sigkill_drill(n_workers, rounds, tmp_path, kill_after_step):
+    """Spawn scheduler + 1 snapshotting server + workers, SIGKILL the
+    server once `kill_after_step` snapshots exist, start a replacement,
+    and return the workers' final pulled values."""
+    from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
+                                                         run_server,
+                                                         SchedulerClient)
+    snap_dir = str(tmp_path / "psnap")
+    port = _free_port()
+    env = {
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n_workers), "DMLC_NUM_SERVER": "1",
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+        "MXTPU_PS_SNAPSHOT_DIR": snap_dir,
+        "MXTPU_PS_RETRY_WINDOW": "180",     # ride through the restart
+        "MXTPU_PS_HEARTBEAT_INTERVAL": "1",
+    }
+    saved_env = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)     # spawned children inherit
+    ctx = mp.get_context("spawn")
+    procs = []
+    try:
+        sched = ctx.Process(target=run_scheduler,
+                            args=(port, n_workers, 1), daemon=True)
+        sched.start()
+        procs.append(sched)
+        time.sleep(0.3)
+        server = ctx.Process(
+            target=run_server, args=(("127.0.0.1", port), n_workers),
+            kwargs={"snapshot_dir": snap_dir}, daemon=True)
+        server.start()
+        procs.append(server)
+        queue = ctx.Queue()
+        workers = []
+        for r in range(n_workers):
+            w = ctx.Process(target=_train_worker,
+                            args=(r, rounds, queue), daemon=True)
+            w.start()
+            workers.append(w)
+            procs.append(w)
+
+        # let training make real progress (each mutating op snapshots),
+        # then kill the server mid-loop with no chance to clean up
+        deadline = time.time() + 120
+        while _latest_snapshot_step(snap_dir) < kill_after_step:
+            assert time.time() < deadline, \
+                "no training progress before kill (step %d)" \
+                % _latest_snapshot_step(snap_dir)
+            assert server.is_alive(), "server died on its own"
+            time.sleep(0.05)
+        os.kill(server.pid, signal.SIGKILL)
+        server.join(timeout=10)
+
+        # replacement: same snapshot dir, fresh port; restores state and
+        # re-registers under the dead server's rank
+        replacement = ctx.Process(
+            target=run_server, args=(("127.0.0.1", port), n_workers),
+            kwargs={"snapshot_dir": snap_dir}, daemon=True)
+        replacement.start()
+        procs.append(replacement)
+
+        results = {}
+        for _ in range(n_workers):
+            rank, res = queue.get(timeout=300)
+            results[rank] = res
+        for w in workers:
+            w.join(timeout=15)
+        SchedulerClient(("127.0.0.1", port)).shutdown()
+        return results
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_server_sigkill_mid_training_recovers_exactly(tmp_path):
+    """Single worker: SIGKILL the only server mid-loop; the replacement
+    restores and the worker finishes with the uninterrupted-run weights
+    (w = -0.1 * rounds, each round's aggregate gradient = 1)."""
+    rounds = 8
+    results = _run_sigkill_drill(1, rounds, tmp_path, kill_after_step=5)
+    res = results[0]
+    assert not (isinstance(res, str) and res.startswith("ERROR")), res
+    np.testing.assert_allclose(res, [-0.1 * rounds] * 4, rtol=1e-6)
+
+
+def test_server_sigkill_two_workers_mid_round_exact(tmp_path):
+    """Two workers: the kill can land mid-aggregation-round; the restored
+    accumulator + pending set + dedup windows make the round complete
+    exactly once (w = -0.1 * 3 * rounds, aggregate grad = 1 + 2)."""
+    rounds = 6
+    results = _run_sigkill_drill(2, rounds, tmp_path, kill_after_step=8)
+    for rank, res in results.items():
+        assert not (isinstance(res, str) and res.startswith("ERROR")), res
+        np.testing.assert_allclose(res, [-0.1 * 3 * rounds] * 4, rtol=1e-6)
+
+
+def test_snapshot_restore_roundtrip_in_process(tmp_path):
+    """Unit-level: a server snapshot written by one _ServerSnapshot is
+    fully restored by another — store, accumulators, pending ranks,
+    optimizer (spec path), rank, and dedup windows."""
+    from incubator_mxnet_tpu.kvstore.dist_server import (_ServerSnapshot,
+                                                         _ServerState)
+    from incubator_mxnet_tpu.kvstore.rpc import DedupCache
+    from incubator_mxnet_tpu import optimizer as optmod
+
+    snap_dir = str(tmp_path / "snap")
+    state = _ServerState(num_workers=2, sync_mode=True)
+    state.store = {"w@0": np.arange(4, dtype=np.float32)}
+    state.accum = {"w@0": np.ones(4, dtype=np.float32) * 2}
+    state.pending = {"w@0": {1}}
+    state.push_gen = {"w@0": 3}
+    state.optimizer = optmod.create("sgd", learning_rate=0.25)
+    dedup = DedupCache()
+    wrapped = dedup.wrap(lambda m, p: ({"ok": True}, b""))
+    wrapped({"op": "push", "_client": "c1", "_seq": 4}, b"")
+
+    snap = _ServerSnapshot(snap_dir, state, dedup)
+    snap.rank = 1
+    snap.save()
+
+    state2 = _ServerState(num_workers=2, sync_mode=True)
+    dedup2 = DedupCache()
+    snap2 = _ServerSnapshot(snap_dir, state2, dedup2)
+    assert snap2.restore() == 1
+    np.testing.assert_array_equal(state2.store["w@0"],
+                                  np.arange(4, dtype=np.float32))
+    np.testing.assert_array_equal(state2.accum["w@0"],
+                                  np.ones(4, dtype=np.float32) * 2)
+    assert state2.pending == {"w@0": {1}}
+    assert state2.push_gen == {"w@0": 3}
+    assert state2.optimizer.lr == 0.25
+    assert state2.updater is not None
+    # a replayed seq must hit the restored window, not re-apply
+    calls = {"n": 0}
+
+    def count(meta, payload):
+        calls["n"] += 1
+        return {"ok": True}, b""
+    wrapped2 = dedup2.wrap(count)
+    out = wrapped2({"op": "push", "_client": "c1", "_seq": 4}, b"")
+    assert out == ({"ok": True}, b"") and calls["n"] == 0
+
+
+# ---------------------------------------------------------------------------
+# launcher robustness (ISSUE 1 satellite: tools/launch.py teardown semantics)
+
+_LAUNCH = os.path.join(os.path.dirname(__file__), os.pardir,
+                       "tools", "launch.py")
+
+
+def test_launch_mesh_any_rank_exit_terminates_job():
+    """Mesh launcher: rank 0 finishing (code 0) must end the whole job —
+    rank 1 would otherwise sleep out its 60s and hang the launcher."""
+    cmd = [sys.executable, _LAUNCH, "-n", "2", "--launcher", "mesh",
+           sys.executable, "-c",
+           "import os, time; "
+           "time.sleep(0 if os.environ['MXTPU_PROC_ID'] == '0' else 60)"]
+    t0 = time.time()
+    r = subprocess.run(cmd, timeout=60)
+    assert r.returncode == 0
+    assert time.time() - t0 < 30, "launcher waited on the sleeping rank"
+
+
+def test_launch_mesh_propagates_max_exit_code():
+    """Mesh launcher: a rank failing with a nonzero code must surface it."""
+    cmd = [sys.executable, _LAUNCH, "-n", "2", "--launcher", "mesh",
+           sys.executable, "-c",
+           "import os, sys, time; "
+           "sys.exit(7) if os.environ['MXTPU_PROC_ID'] == '1' else "
+           "time.sleep(60)"]
+    r = subprocess.run(cmd, timeout=60)
+    assert r.returncode == 7
+
+
+def test_launch_ps_infra_death_tears_down_job(tmp_path):
+    """Local PS launcher: the server dying mid-job (server.die failpoint on
+    its first request) must tear the job down with a nonzero exit instead
+    of hanging until the 600s subprocess timeout."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu",
+                "MXTPU_FAILPOINTS": "server.die:1:1",
+                "MXTPU_PS_RETRY_WINDOW": "5"})
+    worker = ("from incubator_mxnet_tpu.kvstore.dist import KVStoreDist; "
+              "from incubator_mxnet_tpu import nd; "
+              "kv = KVStoreDist('dist_sync'); "
+              "kv.init('w', nd.ones((2,))); kv.barrier(); kv.close()")
+    cmd = [sys.executable, _LAUNCH, "-n", "1", "-s", "1",
+           "--launcher", "local", sys.executable, "-c", worker]
+    r = subprocess.run(cmd, env=env, timeout=120)
+    assert r.returncode != 0

@@ -15,8 +15,15 @@ import time
 import numpy as np
 import pytest
 
-from incubator_mxnet_tpu.kvstore.rpc import (Connection, ProtocolError,
-                                             Server, recv_msg)
+from incubator_mxnet_tpu.kvstore.rpc import (Connection, DedupCache,
+                                             ProtocolError, Server, recv_msg)
+from incubator_mxnet_tpu.utils import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _reset_failpoints():
+    yield
+    fp.reset()
 
 
 def _echo_server():
@@ -166,6 +173,195 @@ def test_handler_exception_becomes_error_reply_not_disconnect():
         conn.close()
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# failpoint-driven idempotent-retry scenarios (ISSUE 1 tentpole): every
+# ambiguous transport fault — request lost, reply lost, reply delayed past
+# the client timeout — must resolve to EXACTLY ONE server-side apply.
+# ---------------------------------------------------------------------------
+
+def _applying_server():
+    """Server whose handler counts applies, wrapped in the dedup layer the
+    real parameter server uses."""
+    calls = {"n": 0}
+
+    def handler(meta, payload):
+        calls["n"] += 1
+        return {"op": "ok", "applied": calls["n"], "echo": meta.get("x")}, \
+            payload
+    return Server(DedupCache().wrap(handler)).start(), calls
+
+
+def test_failpoints_env_spec_parsing():
+    fp.load_env("a:0.5:3,b,c:1:2:0.25,d:::oops")
+    assert fp.list_active() == {"a": (0.5, 3, True), "b": (1.0, None, True),
+                                "c": (1.0, 2, 0.25),
+                                "d": (1.0, None, "oops")}
+    fp.reset()
+    with pytest.raises(ValueError):
+        fp.load_env("bad:prob")
+    with pytest.raises(ValueError):
+        fp.load_env(":1:2")
+
+
+def test_failpoint_count_exhausts_and_context_restores():
+    with fp.active("site", count=2, value=1.5):
+        assert fp.failpoint("site") == 1.5
+        assert fp.failpoint("site") == 1.5
+        assert fp.failpoint("site") is False    # count exhausted
+    assert not fp.is_active("site")
+    assert fp.failpoint("site") is False        # zero-overhead path
+
+
+def test_retry_send_drop_applies_once():
+    """Request lost BEFORE the wire: the retry is the first apply."""
+    srv, calls = _applying_server()
+    try:
+        conn = Connection(srv.addr)
+        fp.activate("rpc.send.drop", count=1)
+        meta, _ = conn.call_idempotent({"op": "put", "x": 1}, window=10)
+        assert meta["applied"] == 1 and calls["n"] == 1
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_retry_after_reply_lost_dedups():
+    """Request applied, reply lost client-side: the retry must NOT apply
+    again — the server replays the cached reply for the same seq."""
+    srv, calls = _applying_server()
+    try:
+        conn = Connection(srv.addr)
+        fp.activate("rpc.recv.drop", count=1)
+        meta, _ = conn.call_idempotent({"op": "put", "x": 2}, window=10)
+        assert meta["applied"] == 1 and calls["n"] == 1
+        # a subsequent NEW request is a fresh seq and applies
+        meta2, _ = conn.call_idempotent({"op": "put", "x": 3}, window=10)
+        assert meta2["applied"] == 2 and calls["n"] == 2
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_retry_after_server_side_reply_drop_dedups():
+    """The server applies and then drops the connection instead of
+    replying (crash-after-apply): retry dedups."""
+    srv, calls = _applying_server()
+    try:
+        conn = Connection(srv.addr)
+        fp.activate("rpc.reply.drop", count=1)
+        meta, _ = conn.call_idempotent({"op": "put", "x": 4}, window=10)
+        assert meta["applied"] == 1 and calls["n"] == 1
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_delayed_reply_timeout_retry_no_duplicate_apply():
+    """Reply delayed past the client timeout: the client times out
+    mid-exchange and retries; the original WAS applied, so the retry must
+    hit the dedup window, not apply twice."""
+    srv, calls = _applying_server()
+    try:
+        conn = Connection(srv.addr)
+        fp.activate("rpc.reply.delay", count=1, value=1.5)
+        meta, _ = conn.call_idempotent({"op": "put", "x": 5}, timeout=0.3,
+                                       window=10)
+        assert meta["applied"] == 1 and calls["n"] == 1
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_unstamped_read_retry_reexecutes():
+    """dedup=False (pull-style reads): retried verbatim, re-executed —
+    and never cached server-side."""
+    srv, calls = _applying_server()
+    try:
+        conn = Connection(srv.addr)
+        fp.activate("rpc.recv.drop", count=1)
+        meta, _ = conn.call_idempotent({"op": "get", "x": 6}, window=10,
+                                       dedup=False)
+        assert calls["n"] == 2      # both executions ran
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_retry_window_zero_fails_fast(monkeypatch):
+    """MXTPU_PS_RETRY_WINDOW=0 strips the retry layer: first transport
+    error surfaces immediately (the strictly-opt-out contract)."""
+    monkeypatch.setenv("MXTPU_PS_RETRY_WINDOW", "0")
+    srv, calls = _applying_server()
+    try:
+        conn = Connection(srv.addr)
+        fp.activate("rpc.send.drop", count=1)
+        with pytest.raises(OSError):
+            conn.call_idempotent({"op": "put", "x": 7})
+        assert calls["n"] == 0
+        # failpoint consumed by the failed attempt; next call clean
+        meta, _ = conn.call_idempotent({"op": "put", "x": 8})
+        assert meta["applied"] == 1
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_retry_survives_server_restart_with_dedup_state():
+    """A replacement server that restored the dedup windows keeps retried
+    requests exactly-once across the restart (the transport half of the
+    parameter-server recovery story)."""
+    calls = {"n": 0}
+    cache = DedupCache()
+
+    def handler(meta, payload):
+        calls["n"] += 1
+        return {"op": "ok", "applied": calls["n"]}, b""
+
+    srv = Server(cache.wrap(handler)).start()
+    host, port = srv.addr
+    conn = Connection((host, port))
+    # the stamped wire form call_idempotent produces, driven by hand so
+    # the retry lands deterministically AFTER the restart
+    stamped = {"op": "put", "_client": "client-a", "_seq": 7}
+    meta, _ = conn.call(dict(stamped))
+    assert meta["applied"] == 1
+    # "kill" the server; carry the dedup state to a replacement on the
+    # same port, as a snapshot restore would
+    saved = cache.state()
+    srv.stop()
+    cache2 = DedupCache()
+    cache2.load_state(saved)
+    deadline = time.time() + 5
+    while True:
+        try:
+            srv2 = Server(cache2.wrap(handler), host=host, port=port).start()
+            break
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+    try:
+        # the reply-lost retry of seq 7 reaches the REPLACEMENT: it must
+        # replay the restored cached reply, not re-apply
+        deadline = time.time() + 5
+        while True:
+            try:
+                meta2, _ = conn.call(dict(stamped))
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        assert meta2["applied"] == 1 and calls["n"] == 1
+        # a genuinely new seq applies on the replacement
+        meta3, _ = conn.call({"op": "put", "_client": "client-a",
+                              "_seq": 8})
+        assert meta3["applied"] == 2 and calls["n"] == 2
+        conn.close()
+    finally:
+        srv2.stop()
 
 
 def test_interleaved_chaos_and_real_traffic():

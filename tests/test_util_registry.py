@@ -53,6 +53,17 @@ def test_alias_enforces_subclass():
         alias("bad")(NotASampler)
 
 
+def test_initializer_create_rejects_kwargs_with_json_spec():
+    """initializer.create('["name", {...}]', extra=...) used to silently
+    drop the extras (the JSON spec carries its own kwargs) — now raises."""
+    from incubator_mxnet_tpu import initializer
+
+    init = initializer.create('["uniform", {"scale": 0.5}]')
+    assert init.scale == 0.5
+    with pytest.raises(ValueError, match="alongside the JSON"):
+        initializer.create('["uniform", {"scale": 0.5}]', scale=0.9)
+
+
 def test_util_makedirs_and_counts():
     d = os.path.join(tempfile.mkdtemp(), "a", "b")
     util.makedirs(d)

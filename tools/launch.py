@@ -17,6 +17,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 # launched as `python tools/launch.py`: sys.path[0] is tools/, so the
 # package import for the shutdown hook needs the repo root
@@ -29,6 +30,31 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _drain(procs, grace=5.0):
+    """Give still-running ranks `grace` seconds to finish on their own,
+    then terminate stragglers; every process is reaped. Returns the max
+    exit code among ranks that exited by THEMSELVES (ranks we terminated
+    are victims, not failures; a self-exit by signal maps to 128+sig)."""
+    deadline = time.time() + grace
+    while any(p.poll() is None for p in procs) and time.time() < deadline:
+        time.sleep(0.1)
+    self_codes = [p.poll() for p in procs]
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+    rc = 0
+    for c in self_codes:
+        if c is not None:
+            rc = max(rc, 128 - c if c < 0 else c)
+    return rc
 
 
 def main():
@@ -57,7 +83,6 @@ def main():
         # multi-process SPMD: every process runs the SAME command and
         # joins one jax.distributed group; multihost.initialize() picks
         # these up (reference analogue: the horovod/NCCL path)
-        import time
         port = _free_port()
         procs = []
         for i in range(args.num_workers):
@@ -75,27 +100,17 @@ def main():
 
         signal.signal(signal.SIGINT, mesh_terminate)
         signal.signal(signal.SIGTERM, mesh_terminate)
-        # poll: one dead rank hangs the others in collectives — kill the
-        # stragglers as soon as any rank exits nonzero
-        rc = 0
-        while any(p.poll() is None for p in procs):
-            for p in procs:
-                code = p.poll()
-                if code is not None and code != 0:
-                    for q in procs:
-                        if q.poll() is None:
-                            q.terminate()
-                    sys.exit(code)
+        # ANY rank exiting — even with code 0 — ends the SPMD job: the
+        # survivors would hang forever in collectives waiting for it.
+        # Grace-drain the rest (the normal all-done case finishes within
+        # it), terminate stragglers, propagate the max self-exit code.
+        while all(p.poll() is None for p in procs):
             time.sleep(0.2)
-        for p in procs:
-            rc = max(rc, p.returncode)
-        sys.exit(rc)
+        sys.exit(_drain(procs))
 
-    port = _free_port()
     base_env = dict(os.environ)
     base_env.update({
         "DMLC_PS_ROOT_URI": "127.0.0.1",
-        "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": str(args.num_servers),
         "MXNET_KVSTORE_MODE": args.mode,
@@ -120,10 +135,27 @@ def main():
         procs.append(p)
         return p
 
-    spawn("scheduler")
+    # the free-port probe is pick-then-rebind: another process can grab
+    # the port between close() and the scheduler's bind. The scheduler
+    # fails fast on a taken port, so spawn it, watch it briefly, and
+    # retry on a fresh port until one sticks
+    for _attempt in range(10):
+        port = _free_port()
+        base_env["DMLC_PS_ROOT_PORT"] = str(port)
+        sched_proc = spawn("scheduler")
+        deadline = time.time() + 0.75
+        while time.time() < deadline and sched_proc.poll() is None:
+            time.sleep(0.05)
+        if sched_proc.poll() is None:
+            break       # bound and serving
+        procs.remove(sched_proc)
+    else:
+        sys.exit("scheduler failed to bind a port after 10 attempts")
+
     for _ in range(args.num_servers):
         spawn("server")
     workers = [spawn("worker") for _ in range(args.num_workers)]
+    infra = [p for p in procs if p not in workers]
 
     def terminate(*_a):
         for p in procs:
@@ -134,19 +166,23 @@ def main():
     signal.signal(signal.SIGINT, terminate)
     signal.signal(signal.SIGTERM, terminate)
 
+    # Wait for the WORKERS; but a scheduler/server rank exiting early —
+    # even with code 0 — strands them (pushes hang, barriers abort), so
+    # any rank exit tears the job down instead of hanging the launcher.
     code = 0
-    for w in workers:
-        code = max(code, w.wait())
-    # workers done: shut the group down
+    while any(w.poll() is None for w in workers):
+        dead_infra = [p for p in infra if p.poll() is not None]
+        if dead_infra:
+            code = max(max(p.returncode for p in dead_infra), 1)
+            break
+        time.sleep(0.2)
+    # shut the group down (workers done, or infra died under them)
     from incubator_mxnet_tpu.kvstore.dist_server import SchedulerClient
     try:
         SchedulerClient(("127.0.0.1", port)).shutdown()
     except Exception:
         pass
-    for p in procs:
-        if p.poll() is None:
-            p.terminate()
-    sys.exit(code)
+    sys.exit(max(code, _drain(procs)))
 
 
 if __name__ == "__main__":
