@@ -50,6 +50,7 @@ from . import kvstore as kv
 from . import kvstore
 from . import symbol
 from . import symbol as sym
+from . import analysis
 from . import attribute
 from .attribute import AttrScope
 from . import name

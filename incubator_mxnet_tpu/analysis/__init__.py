@@ -1,0 +1,22 @@
+"""Static analysis for the Symbol IR (level 1 of the graphlint subsystem).
+
+``analyze(symbol)`` / ``Symbol.lint()`` run a catalog of graph rules —
+unknown ops, duplicate/dangling arguments, unresolvable shapes/dtypes,
+float64 on TPU, MXU tiling diagnostics — over the existing ``_topo`` /
+``_infer_walk`` machinery and return ``Finding`` records. Level 2 (the
+AST linter over the framework's own Python) lives in ``tools/mxlint.py``
+and shares the same ``Finding`` type and suppression model.
+
+See docs/ANALYSIS.md for the rule catalog, suppression syntax
+(``__lint_disable__`` node attr / ``# mxlint: disable=...`` comments), and
+how to add a rule.
+"""
+
+from .core import (Finding, Pass, GraphContext, graph_rule, GRAPH_RULES,
+                   SEVERITIES, analyze, analyze_json, format_findings)
+from . import graph_rules  # noqa: F401 — populate GRAPH_RULES
+from .graph_rules import MXU_OPS, min_tile
+
+__all__ = ["Finding", "Pass", "GraphContext", "graph_rule", "GRAPH_RULES",
+           "SEVERITIES", "analyze", "analyze_json", "format_findings",
+           "MXU_OPS", "min_tile"]

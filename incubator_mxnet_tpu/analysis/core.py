@@ -1,0 +1,328 @@
+"""Static-analysis core: the ``Finding`` record shared by both analysis
+levels (graph rules here, AST rules in ``tools/mxlint.py``), the ``Pass``
+base class, the graph-rule registry, and the ``GraphContext`` a pass runs
+against.
+
+Reference parity: the role nnvm graph passes play pre-bind (shape/type
+checks before execution, SURVEY §2.2) — here reified as a user-facing rule
+framework instead of hard failures inside the executor.
+
+This module stays import-light on purpose (no jax at module level): the
+AST linter shares ``Finding`` without paying for an accelerator runtime.
+"""
+
+__all__ = ["Finding", "Pass", "GraphContext", "graph_rule", "GRAPH_RULES",
+           "SEVERITIES", "analyze", "analyze_json", "format_findings"]
+
+# severity ranks double as the sort order of reports: hard bind-time
+# failures first, perf diagnostics last
+SEVERITIES = ("error", "warning", "info")
+
+
+class Finding:
+    """One diagnostic. Graph findings carry ``node`` (the node name / path
+    in the Symbol IR); source findings carry ``path``/``line``. Both levels
+    of the subsystem emit this same type so reports and JSON compose."""
+
+    __slots__ = ("rule_id", "severity", "node", "message", "path", "line")
+
+    def __init__(self, rule_id, severity, node, message, path=None,
+                 line=None):
+        if severity not in SEVERITIES:
+            raise ValueError("severity must be one of %r" % (SEVERITIES,))
+        self.rule_id = rule_id
+        self.severity = severity
+        self.node = node
+        self.message = message
+        self.path = path
+        self.line = line
+
+    @property
+    def location(self):
+        if self.path is not None:
+            return "%s:%s" % (self.path, self.line if self.line else "?")
+        return "node %r" % (self.node,)
+
+    def format(self):
+        return "%s: %s [%s] %s" % (self.location, self.severity,
+                                   self.rule_id, self.message)
+
+    def to_dict(self):
+        d = {"rule": self.rule_id, "severity": self.severity,
+             "message": self.message}
+        if self.node is not None:
+            d["node"] = self.node
+        if self.path is not None:
+            d["path"] = self.path
+            d["line"] = self.line
+        return d
+
+    def __repr__(self):
+        return "<Finding %s>" % self.format()
+
+    def __eq__(self, other):
+        return isinstance(other, Finding) and all(
+            getattr(self, s) == getattr(other, s) for s in self.__slots__)
+
+    def __hash__(self):
+        return hash((self.rule_id, self.node, self.path, self.line,
+                     self.message))
+
+
+def _severity_rank(sev):
+    return SEVERITIES.index(sev)
+
+
+def format_findings(findings):
+    return "\n".join(f.format() for f in findings)
+
+
+class Pass:
+    """Base class for one analysis rule. Subclasses set ``id`` (kebab-case,
+    the suppression handle), ``severity`` (default for findings), and
+    ``description`` (one line, shown in the rule catalog), and implement
+    ``run(ctx)`` yielding ``Finding``s."""
+
+    id = None
+    severity = "warning"
+    description = ""
+
+    def run(self, ctx):
+        raise NotImplementedError
+
+    def finding(self, node, message, severity=None):
+        name = node if isinstance(node, str) or node is None \
+            else node._name
+        return Finding(self.id, severity or self.severity, name, message)
+
+
+GRAPH_RULES = {}   # rule id -> Pass subclass
+
+
+def graph_rule(cls):
+    """Class decorator adding a graph rule to the default-on catalog."""
+    if not cls.id:
+        raise ValueError("graph rule needs an id")
+    if cls.id in GRAPH_RULES:
+        raise ValueError("duplicate graph rule id %r" % cls.id)
+    GRAPH_RULES[cls.id] = cls
+    return cls
+
+
+def _node_key(n):
+    """Canonical identity of a logical graph node: multi-output views share
+    their base's ``_inputs`` list by reference (Symbol.__getitem__ passes it
+    through while ``__init__`` copies ``_attrs``), so keying on the list's
+    id collapses every view onto one key while keeping distinct same-named
+    nodes distinct (each ``var()`` call makes a fresh empty list)."""
+    return (n._name, n._op, id(n._inputs))
+
+
+class GraphContext:
+    """Everything the graph rules need, computed once per analyze() call:
+    the reachable topo order (views canonicalized), the head set, a
+    consumer map, lazily the shape/dtype resolution with per-node blame,
+    and per-node suppression sets (``__lint_disable__`` attr)."""
+
+    def __init__(self, symbol, known_shapes=None, declared_nodes=None):
+        self.symbol = symbol
+        self.known_shapes = {k: tuple(v)
+                             for k, v in (known_shapes or {}).items()}
+
+        raw = symbol._topo()
+        self.nodes = []          # canonical nodes, topo order, no _group
+        self._canon = {}         # node key -> canonical node
+        for n in raw:
+            if n._op == "_group":
+                continue
+            k = _node_key(n)
+            if k not in self._canon:
+                self._canon[k] = n
+                self.nodes.append(n)
+
+        # heads: (canonical node, output slot) actually exported
+        self.heads = []
+        if symbol._op == "_group":
+            members = symbol._inputs
+        else:
+            members = [symbol]
+        for m in members:
+            base = self._canon.get(_node_key(m), m)
+            if m._out_index is not None:
+                self.heads.append((base, m._out_index))
+            else:
+                for i in range(max(1, m._num_outputs)):
+                    self.heads.append((base, i))
+
+        # consumers: key -> list of (consumer node, slot consumed)
+        self.consumers = {}
+        for n in self.nodes:
+            for i in n._inputs:
+                self.consumers.setdefault(_node_key(i), []).append(
+                    (n, i._out_index or 0))
+
+        # full declared node set (JSON graphs can declare nodes no head
+        # reaches; in-memory graphs cannot, so declared == reachable)
+        self.declared = declared_nodes if declared_nodes is not None \
+            else list(self.nodes)
+
+        # shape info is opt-in: without a single known shape the resolver
+        # would blame every node, which is noise, not analysis
+        self.has_shape_info = bool(self.known_shapes) or any(
+            n._op is None and n._attrs.get("__shape__") is not None
+            for n in self.nodes)
+
+        self._resolution = None
+
+    # -- resolution --------------------------------------------------------
+    def resolve(self):
+        """Partial shape/dtype walk over the graph: returns
+        ``(out_info, failures)`` where ``out_info`` maps id(node) ->
+        (shapes, dtypes) with ``None`` for unresolved slots, and
+        ``failures`` lists (node, reason) for each ROOT failure."""
+        if self._resolution is None:
+            failures = []
+            res = self.symbol._infer_walk(
+                self.known_shapes, {},
+                on_fail=lambda n, r: failures.append((n, r)),
+                partial=True)
+            out_info = res[0] if res is not None else {}
+            self._resolution = (out_info, failures)
+        return self._resolution
+
+    def node_outputs(self, node):
+        """Resolved (shapes, dtypes) tuples for ``node`` or (None, None)."""
+        out_info, _ = self.resolve()
+        info = out_info.get(id(self._canon.get(_node_key(node), node)))
+        if info is None:
+            return None, None
+        return info
+
+    def reachable_keys(self):
+        return set(self._canon)
+
+    def is_head(self, node, slot=None):
+        for h, s in self.heads:
+            if h is node and (slot is None or slot == s):
+                return True
+        return False
+
+    def consumed_slots(self, node):
+        used = {s for _, s in self.consumers.get(_node_key(node), ())}
+        used.update(s for h, s in self.heads if h is node)
+        return used
+
+    # -- suppression -------------------------------------------------------
+    @staticmethod
+    def disabled_rules(node):
+        v = node._attrs.get("__lint_disable__")
+        if v is None:
+            return frozenset()
+        if isinstance(v, str):
+            v = v.split(",")
+        return frozenset(x.strip() for x in v if x.strip())
+
+    def suppressed(self, finding):
+        for n in self.declared:
+            if n._name == finding.node:
+                dis = self.disabled_rules(n)
+                if "all" in dis or finding.rule_id in dis:
+                    return True
+        return False
+
+
+def _select_rules(rules):
+    from . import graph_rules as _g  # noqa: F401 — populate the registry
+    if rules is None:
+        return [cls() for cls in GRAPH_RULES.values()]
+    out = []
+    for r in rules:
+        if isinstance(r, str):
+            if r not in GRAPH_RULES:
+                raise KeyError("unknown graph rule %r (have: %s)"
+                               % (r, ", ".join(sorted(GRAPH_RULES))))
+            out.append(GRAPH_RULES[r]())
+        elif isinstance(r, Pass):
+            out.append(r)
+        elif isinstance(r, type) and issubclass(r, Pass):
+            out.append(r())
+        else:
+            raise TypeError("rule must be an id, Pass, or Pass subclass")
+    return out
+
+
+def analyze(symbol, rules=None, disable=(), known_shapes=None,
+            _declared_nodes=None):
+    """Run graph rules over ``symbol`` and return sorted ``Finding``s.
+
+    ``rules`` selects a subset (ids / Pass objects; default: the full
+    catalog), ``disable`` mutes rule ids globally, ``known_shapes`` feeds
+    shape inference (same keys as ``infer_shape``). Per-node suppression:
+    a node attr ``__lint_disable__="rule-id[,rule-id]"`` (or ``"all"``)."""
+    ctx = GraphContext(symbol, known_shapes=known_shapes,
+                       declared_nodes=_declared_nodes)
+    disable = set(disable)
+    findings = []
+    for rule in _select_rules(rules):
+        for f in rule.run(ctx):
+            if f.rule_id in disable or ctx.suppressed(f):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (_severity_rank(f.severity),
+                                 str(f.node), f.rule_id, f.message))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JSON graphs (checkpoint -symbol.json files): unlike the in-memory IR,
+# serialized graphs CAN declare nodes that no head reaches — build every
+# declared node and hand analyze() the full set so dead-node/unused-arg
+# rules see them.
+# ---------------------------------------------------------------------------
+
+def analyze_json(json_str, rules=None, disable=()):
+    """Analyze a serialized symbol graph (``Symbol.tojson`` format)
+    without requiring every op to exist in this process's registry."""
+    import json as _json
+    from ..symbol import Symbol, Group, _parse_attr
+    from ..ops.registry import get_op
+
+    data = _json.loads(json_str)
+    raw = data["nodes"]
+
+    # an unknown op's output arity is recovered from the highest slot any
+    # consumer (or head) references — enough for the walk not to trip
+    max_slot = [0] * len(raw)
+    for n in raw:
+        for i in n.get("inputs", []):
+            max_slot[i[0]] = max(max_slot[i[0]], i[1])
+    for h in data.get("heads", []):
+        max_slot[h[0]] = max(max_slot[h[0]], h[1])
+
+    built = []
+    for j, n in enumerate(raw):
+        attrs = {k: _parse_attr(v)
+                 for k, v in (n.get("attrs") or n.get("param") or {}).items()}
+        inputs = [built[i[0]][i[1]] if i[1] else built[i[0]]
+                  for i in n.get("inputs", [])]
+        if n["op"] == "null":
+            built.append(Symbol(None, n["name"], inputs, attrs))
+            continue
+        try:
+            info = get_op(n["op"])
+            if callable(info.num_outputs):
+                nout = int(info.num_outputs(attrs))
+            elif isinstance(info.num_outputs, int):
+                nout = info.num_outputs
+            else:
+                nout = int(attrs.get(info.num_outputs, 1))
+        except KeyError:
+            nout = max_slot[j] + 1
+        built.append(Symbol(n["op"], n["name"], inputs, attrs,
+                            num_outputs=max(nout, max_slot[j] + 1)))
+
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    head_syms = [built[h[0]][h[1]] if h[1] else built[h[0]] for h in heads]
+    root = head_syms[0] if len(head_syms) == 1 else Group(head_syms)
+    return analyze(root, rules=rules, disable=disable,
+                   _declared_nodes=built)

@@ -1,0 +1,284 @@
+"""Graph-level rules over the Symbol IR.
+
+Each rule is a ``Pass`` with a stable kebab-case id (the suppression
+handle), walking the ``GraphContext`` built from ``Symbol._topo`` and the
+partial ``_infer_walk`` resolution. Catalog and examples: docs/ANALYSIS.md.
+"""
+
+import numpy as _np
+
+from .core import Pass, graph_rule, _node_key
+
+__all__ = ["MXU_OPS", "min_tile"]
+
+# ops that land on the MXU / feed the Pallas kernels in ops/pallas/
+# (fused_layer_norm / fused_softmax / flash attention): tiling of their
+# operands decides whether the systolic array runs full or padded
+MXU_OPS = frozenset((
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "linalg_gemm", "linalg_gemm2", "quantized_fully_connected",
+    "quantized_conv", "LayerNorm", "softmax", "log_softmax",
+))
+
+# min tile (sublane, lane) per dtype — pallas_guide.md "Tiling Constraints"
+_SUBLANE = {"float32": 8, "float64": 8, "bfloat16": 16, "float16": 16,
+            "int8": 32, "uint8": 32, "float8_e4m3fn": 32,
+            "float8_e5m2": 32}
+_LANE = 128
+
+
+def min_tile(dtype):
+    return (_SUBLANE.get(_np.dtype(dtype).name, 8), _LANE)
+
+
+def _op_known(opname):
+    from ..ops.registry import get_op
+    try:
+        get_op(opname)
+        return True
+    except KeyError:
+        return False
+
+
+def _node_path(ctx, node):
+    """Forward path from ``node`` to the first head it feeds — the
+    "where in the graph" breadcrumb attached to inference failures."""
+    path, cur, seen = [node._name], node, set()
+    while not ctx.is_head(cur) and id(cur) not in seen:
+        seen.add(id(cur))
+        cons = ctx.consumers.get(_node_key(cur))
+        if not cons:
+            break
+        cur = cons[0][0]
+        path.append(cur._name)
+    return " -> ".join(path)
+
+
+@graph_rule
+class UnknownOp(Pass):
+    id = "unknown-op"
+    severity = "error"
+    description = ("node's op is absent from the operator registry — "
+                   "bind would fail with KeyError")
+
+    def run(self, ctx):
+        for n in ctx.nodes:
+            if n._op and n._op != "_group" and not _op_known(n._op):
+                yield self.finding(
+                    n, "op %r is not in the operator registry; binding "
+                    "this graph raises KeyError at executor build"
+                    % (n._op,))
+
+
+@graph_rule
+class DuplicateArg(Pass):
+    id = "duplicate-arg"
+    severity = "error"
+    description = ("two distinct variable nodes share one argument name — "
+                   "feeds and inference key by name and silently alias")
+
+    def run(self, ctx):
+        by_name = {}
+        for n in ctx.declared:
+            if n._op is None:
+                by_name.setdefault(n._name, set()).add(_node_key(n))
+        for name, keys in sorted(by_name.items()):
+            if len(keys) > 1:
+                yield self.finding(
+                    name, "argument name %r is declared by %d distinct "
+                    "variable nodes; bind feeds and infer_shape kwargs key "
+                    "by name, so one array would silently serve both"
+                    % (name, len(keys)))
+
+
+@graph_rule
+class UnusedArg(Pass):
+    id = "unused-arg"
+    severity = "warning"
+    description = "argument is never consumed by any output"
+
+    def run(self, ctx):
+        reach = ctx.reachable_keys()
+        for n in ctx.declared:
+            if n._op is None and _node_key(n) not in reach:
+                yield self.finding(
+                    n, "argument %r is never consumed by any output; it "
+                    "would still demand an array at bind time" % (n._name,))
+
+
+@graph_rule
+class DeadNode(Pass):
+    id = "dead-node"
+    severity = "warning"
+    description = ("op node unreachable from any output (serialized "
+                   "graphs), or a multi-output slot nothing consumes")
+
+    def run(self, ctx):
+        reach = ctx.reachable_keys()
+        for n in ctx.declared:
+            if n._op and n._op != "_group" and _node_key(n) not in reach:
+                yield self.finding(
+                    n, "node %r (op %s) is unreachable from any output — "
+                    "dead code in the serialized graph" % (n._name, n._op))
+        for n in ctx.nodes:
+            if n._op and n._num_outputs > 1:
+                used = ctx.consumed_slots(n)
+                for s in range(n._num_outputs):
+                    if s not in used:
+                        yield self.finding(
+                            n, "output %d of %r (op %s) is never consumed; "
+                            "the symbolic executor still materializes it "
+                            "(XLA prunes it only under jit)"
+                            % (s, n._name, n._op), severity="info")
+
+
+@graph_rule
+class UnresolvedShape(Pass):
+    id = "unresolved-shape"
+    severity = "error"
+    description = ("shape inference cannot resolve this node — executor "
+                   "bind would fail later with less context")
+
+    _DTYPE_HINTS = ("dtype", "cannot be cast", "promot", "integer",
+                    "complex")
+
+    def classify(self, reason):
+        if reason.startswith("abstract evaluation failed"):
+            low = reason.lower()
+            if any(h in low for h in self._DTYPE_HINTS):
+                return "unresolved-dtype"
+        return "unresolved-shape"
+
+    def run(self, ctx):
+        if not ctx.has_shape_info:
+            return
+        _, failures = ctx.resolve()
+        for node, reason in failures:
+            if self.classify(reason) != self.id:
+                continue
+            yield self.finding(
+                node, "cannot resolve node %r (op %s) at path [%s]: %s"
+                % (node._name, node._op, _node_path(ctx, node), reason))
+
+
+@graph_rule
+class UnresolvedDtype(UnresolvedShape):
+    id = "unresolved-dtype"
+    severity = "warning"
+    description = ("dtype inference cannot resolve this node/output — "
+                   "the executor would guess at bind time")
+
+    def run(self, ctx):
+        # dtype-flavored abstract-eval failures (shape walk ran)
+        if ctx.has_shape_info:
+            _, failures = ctx.resolve()
+            for node, reason in failures:
+                if self.classify(reason) != self.id:
+                    continue
+                yield self.finding(
+                    node, "cannot resolve node %r (op %s) at path [%s]: %s"
+                    % (node._name, node._op, _node_path(ctx, node), reason))
+        # bare variable heads: the graph exports an argument directly and
+        # nothing (attr or inference) pins its dtype
+        for h, _slot in ctx.heads:
+            if h._op is None and h._attrs.get("__dtype__") is None:
+                yield self.finding(
+                    h, "output %r is a bare variable with no declared "
+                    "dtype; downstream consumers cannot type this graph "
+                    "statically — declare var(%r, dtype=...)"
+                    % (h._name, h._name))
+
+
+@graph_rule
+class Float64OnTPU(Pass):
+    id = "float64-tpu"
+    severity = "warning"
+    description = ("float64 in the graph: TPU MXU/VPU have no fp64 "
+                   "units, XLA software-emulates it")
+
+    _F64 = ("float64", "double")
+
+    def _is_f64(self, v):
+        if v is None:   # np.dtype(None) is float64 — don't fall for it
+            return False
+        try:
+            return _np.dtype(v) == _np.float64
+        except TypeError:
+            return False
+
+    def run(self, ctx):
+        resolved = {}
+        if ctx.has_shape_info:
+            resolved, _ = ctx.resolve()
+        for n in ctx.nodes:
+            introduces = False
+            if n._op is None:
+                dt = n._attrs.get("__dtype__")
+                introduces = dt is not None and self._is_f64(dt)
+            else:
+                info = resolved.get(id(n))
+                if info is not None and info[1] and \
+                        any(d is not None and _np.dtype(d) == _np.float64
+                            for d in info[1]):
+                    # blame only the node that INTRODUCES f64, not the
+                    # whole downstream cone it promotes
+                    in_f64 = False
+                    for i in n._inputs:
+                        pinfo = resolved.get(
+                            id(ctx._canon.get(_node_key(i), i)))
+                        if pinfo and any(
+                                d is not None and
+                                _np.dtype(d) == _np.float64
+                                for d in pinfo[1]):
+                            in_f64 = True
+                            break
+                    introduces = not in_f64
+                elif info is None and self._is_f64(n._attrs.get("dtype")):
+                    introduces = True
+            if introduces:
+                yield self.finding(
+                    n, "%r introduces float64 on TPU: the MXU/VPU have no "
+                    "fp64 units and XLA emulates it at a fraction of fp32 "
+                    "throughput — use float32 or bfloat16" % (n._name,))
+
+
+@graph_rule
+class TpuTiling(Pass):
+    id = "tpu-tiling"
+    severity = "info"
+    description = ("MXU-bound operand trailing dims not multiples of the "
+                   "dtype's min tile — the hardware pads silently")
+
+    # conv weights reach the MXU through im2col, not by their raw
+    # (H, W) trailing dims — only the data operand's layout is the
+    # programmer's to fix, so only it is checked
+    _DATA_ONLY = frozenset(("Convolution", "Deconvolution",
+                            "quantized_conv"))
+
+    def run(self, ctx):
+        if not ctx.has_shape_info:
+            return
+        for n in ctx.nodes:
+            if n._op not in MXU_OPS:
+                continue
+            for pos, i in enumerate(n._inputs):
+                if pos and n._op in self._DATA_ONLY:
+                    break
+                shapes, dtypes = ctx.node_outputs(i)
+                if not shapes:
+                    continue
+                slot = i._out_index or 0
+                s = shapes[slot] if slot < len(shapes) else None
+                d = dtypes[min(slot, len(dtypes) - 1)] if dtypes else None
+                if s is None or len(s) < 2 or d is None:
+                    continue
+                sub, lane = min_tile(d)
+                if s[-1] % lane or s[-2] % sub:
+                    yield self.finding(
+                        n, "input %d (%r) of %r (op %s) has trailing dims "
+                        "(%d, %d) not multiples of the %s min tile "
+                        "(%d, %d); the MXU pads each tile silently — pad "
+                        "or reshape to tile boundaries to use the paid "
+                        "FLOPs" % (pos, i._name, n._name, n._op,
+                                   s[-2], s[-1], _np.dtype(d).name, sub,
+                                   lane))

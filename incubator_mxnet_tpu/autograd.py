@@ -164,7 +164,10 @@ def record_op(fn, arrays, op_name=""):
         try:                   # committed forward device, for multi-device
             devs = outs[0].devices()   # graphs (group2ctx); tracers have none
             dev = next(iter(devs)) if len(devs) == 1 else None
-        except Exception:
+        except (AttributeError, TypeError, RuntimeError):
+            # tracers raise ConcretizationTypeError (a TypeError), foreign
+            # arrays lack .devices() (AttributeError), deleted buffers
+            # raise RuntimeError — anything else is a real bug, let it fly
             dev = None
     node = TapeNode(list(arrays), vjp_fn, len(outs), templates, op_name,
                     fn=fn, device=dev)
@@ -240,7 +243,9 @@ def _backward_impl(heads, head_grads, retain_graph, create_graph,
     def same_dev(a, b):
         try:
             return a.devices() == b.devices()
-        except Exception:
+        except (AttributeError, TypeError, RuntimeError):
+            # same taxonomy as record_op's device probe: tracers/foreign
+            # arrays can't answer — assume same device, don't transfer
             return True
 
     def add_ct(store, key, ct, slot=None):

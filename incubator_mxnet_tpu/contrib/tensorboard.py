@@ -19,7 +19,9 @@ class LogMetricsCallback:
         try:
             from torch.utils.tensorboard import SummaryWriter  # cpu torch is in-image
             self._writer = SummaryWriter(logging_dir)
-        except Exception:
+        except Exception:  # mxlint: disable=broad-except — optional
+            # dep probe: torch tensorboard may be absent OR fail to
+            # load its native libs; the jsonl sink always works
             self._jsonl = open(os.path.join(logging_dir, "metrics.jsonl"), "a")
         self._step = 0
 

@@ -55,7 +55,9 @@ class Engine:
                     if available():
                         cls._instance = NativeEngine(4)
                         return cls._instance
-                except Exception:
+                except Exception:  # mxlint: disable=broad-except
+                    # native-engine probe: ctypes load can fail any
+                    # number of ways; fall back to the Python engine
                     pass
             cls._instance = cls()
         return cls._instance

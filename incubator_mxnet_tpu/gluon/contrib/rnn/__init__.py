@@ -48,12 +48,15 @@ class VariationalDropoutCell(ModifierCell):
     def hybrid_forward(self, F, inputs, states):
         m = self._mask(self.drop_inputs, inputs, self._input_mask)
         if m is not None:
+            # mxlint: disable=impure-hybrid — reference parity:
+            # variational dropout reuses ONE mask across the
+            # sequence; caching it on the cell is the contract
             self._input_mask = m
             inputs = inputs * m
         out, next_states = self.base_cell(inputs, states)
         mo = self._mask(self.drop_outputs, out, self._output_mask)
         if mo is not None:
-            self._output_mask = mo
+            self._output_mask = mo  # mxlint: disable=impure-hybrid — same mask-reuse contract
             out = out * mo
         return out, next_states
 

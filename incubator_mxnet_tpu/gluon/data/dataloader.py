@@ -134,7 +134,9 @@ class _Segment:
     def close(self):
         try:
             self.buf.close()
-        except Exception:
+        except Exception:  # mxlint: disable=broad-except — best-effort
+            # cleanup: mmap close raises BufferError while views are
+            # exported; the segment dies with the process anyway
             pass
 
     def unlink(self):
@@ -351,7 +353,9 @@ class DataLoader:
             for result in pending:
                 try:
                     batch = result.get(self._timeout)
-                except Exception:
+                except Exception:  # mxlint: disable=broad-except
+                    # mid-epoch teardown: a worker may already be
+                    # gone; recycling what answered is all we need
                     continue
                 if (isinstance(batch, tuple) and batch
                         and isinstance(batch[0], str)

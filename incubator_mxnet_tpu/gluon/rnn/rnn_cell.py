@@ -392,6 +392,9 @@ class ZoneoutCell(ModifierCell):
                       for ns, s in zip(next_states, states)]
         else:
             states = next_states
+        # mxlint: disable=impure-hybrid — reference parity: zoneout
+        # keeps the previous output on the cell between unrolled
+        # steps (reset by reset()); hybridization re-traces per call
         self._prev_output = output
         return output, states
 

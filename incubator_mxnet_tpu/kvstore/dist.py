@@ -29,8 +29,11 @@ import numpy as np
 from .kvstore import KVStore
 from .rpc import Connection
 from .dist_server import SchedulerClient
+from ..log import get_logger
 from ..ndarray import NDArray
 from ..utils import failpoints as _fp
+
+_log = get_logger(__name__)
 
 __all__ = ["KVStoreDist", "create_dist"]
 
@@ -107,8 +110,14 @@ class KVStoreDist(KVStore):
                 if _prev is not None:
                     try:
                         _prev.result()
-                    except Exception:
-                        pass    # predecessor failure surfaces via _flush
+                    except Exception as e:  # mxlint: disable=broad-except
+                        # the predecessor's own future is also in _pending,
+                        # so its failure re-raises at _flush; here we only
+                        # preserve per-key ordering — log, don't die
+                        _log.debug("kvstore push chain: predecessor for "
+                                   "key %r failed (%s: %s); error will "
+                                   "surface at flush", key,
+                                   type(e).__name__, e)
                 d = _fp.failpoint("kv.push.delay")
                 if d:
                     import time

@@ -119,7 +119,8 @@ class MultiHeadAttention(HybridBlock):
             if ctx_mesh is not None and not ctx_mesh.empty \
                     and ctx_mesh.axis_names == mesh.axis_names:
                 use_mesh = ctx_mesh
-        except Exception:
+        except Exception:  # mxlint: disable=broad-except — abstract
+            # mesh probe across jax versions; concrete mesh fallback
             pass
         return jax.shard_map(fn, mesh=use_mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, axis_names={"sp"},

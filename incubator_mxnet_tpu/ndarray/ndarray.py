@@ -25,7 +25,9 @@ __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
 def _ctx_of(data):
     try:
         dev = list(data.devices())[0]
-    except Exception:
+    except (AttributeError, IndexError, TypeError, RuntimeError):
+        # foreign arrays lack .devices(), tracers raise a TypeError
+        # subclass, deleted buffers RuntimeError — default context
         return current_context()
     plat = dev.platform
     return Context("cpu" if plat == "cpu" else "tpu", dev.id)

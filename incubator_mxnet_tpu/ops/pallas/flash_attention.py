@@ -29,7 +29,7 @@ try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _PALLAS_OK = True
-except Exception:  # pragma: no cover
+except Exception:  # pragma: no cover — mxlint: disable=broad-except (pallas/TPU availability probe: any import or lowering failure means fall back to the XLA path)
     _PALLAS_OK = False
 
 _NEG_INF = -1e30

@@ -35,10 +35,28 @@ class OpInfo:
         return "OpInfo(%s)" % self.name
 
 
-def register(name=None, num_outputs=1, aliases=(), **attrs):
-    """Decorator registering a pure function as a framework operator."""
+def _check_collision(names, override):
+    if override:
+        return
+    taken = [n for n in names if n in _OP_REGISTRY]
+    if taken:
+        raise ValueError(
+            "op name(s) %s already registered (existing: %s); pass "
+            "override=True to replace deliberately" % (
+                ", ".join(repr(n) for n in taken),
+                ", ".join(repr(_OP_REGISTRY[n].name) for n in taken)))
+
+
+def register(name=None, num_outputs=1, aliases=(), override=False, **attrs):
+    """Decorator registering a pure function as a framework operator.
+
+    Collisions are errors: silently shadowing an existing op (the old
+    behavior) turns a duplicated name into an action-at-a-distance bug at
+    bind time. Re-registration must be explicit via ``override=True``.
+    """
     def deco(fn):
         opname = name or fn.__name__
+        _check_collision((opname,) + tuple(aliases), override)
         info = OpInfo(opname, fn, num_outputs=num_outputs, aliases=aliases, attrs=attrs)
         _OP_REGISTRY[opname] = info
         for a in aliases:
@@ -47,9 +65,10 @@ def register(name=None, num_outputs=1, aliases=(), **attrs):
     return deco
 
 
-def alias(existing, *names):
+def alias(existing, *names, override=False):
     """Register additional names for an already-registered op."""
     info = _OP_REGISTRY[existing]
+    _check_collision(names, override)
     for n in names:
         _OP_REGISTRY[n] = info
 

@@ -43,7 +43,9 @@ class MXRecordIO:
                     self.handle = True  # sentinel: open
                     self.writable = False
                     return
-            except Exception:
+            except Exception:  # mxlint: disable=broad-except
+                # native-reader probe: fall back to the pure-Python
+                # reader on any load/ABI failure
                 pass
             self._native = None
             self.handle = open(self.uri, "rb")

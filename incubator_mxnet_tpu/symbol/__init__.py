@@ -204,7 +204,8 @@ class Symbol:
         return order
 
     # --------------------------------------------------------------- shapes
-    def _infer_walk(self, known_shapes, known_dtypes):
+    def _infer_walk(self, known_shapes, known_dtypes, on_fail=None,
+                    partial=False):
         """Node-by-node abstract walk carrying BOTH shape and dtype through
         ``jax.eval_shape`` (the reference runs shape and type inference as
         two fixed-point passes over the same graph —
@@ -213,7 +214,14 @@ class Symbol:
         shapes missing from the feed are filled by per-op backward rules
         (FInferShape weight/bias/gamma slots); unknown parameter dtypes
         follow the op's first known floating input (FInferType's
-        ElemwiseType propagation). Returns None when inference fails."""
+        ElemwiseType propagation). Returns None when inference fails.
+
+        ``partial=True`` (the analysis layer's mode) never returns None:
+        a failing node records unknown outputs and the walk continues, so
+        one call surfaces every root failure. ``on_fail(node, reason)`` is
+        called at each ROOT failure — cascade failures (inputs already
+        unknown because a producer failed) stay silent, so the blame list
+        points at causes, not symptoms."""
         import jax
 
         known = {k: tuple(v) for k, v in known_shapes.items()}
@@ -228,6 +236,17 @@ class Symbol:
                 kdt[n._name] = dt
             return dt
 
+        def fail(n, reason, root=True):
+            """Record one failure; in partial mode poison n's outputs and
+            keep walking, else abort the walk (legacy contract)."""
+            if on_fail is not None and root:
+                on_fail(n, reason)
+            if not partial:
+                return None
+            nout = max(1, n._num_outputs)
+            out_info[id(n)] = ((None,) * nout, (None,) * nout)
+            return out_info[id(n)]
+
         for n in nodes:
             if n._op is None:
                 s = known.get(n._name)
@@ -239,18 +258,42 @@ class Symbol:
                 continue
             if n._op == "_group":
                 continue
+            if partial:
+                try:
+                    get_op(n._op)
+                except KeyError:
+                    # unknown op: the analyzer's own rule reports it — the
+                    # walk just treats its outputs as unknown (cascade)
+                    if fail(n, "", root=False) is None:
+                        return None
+                    continue
             in_shapes = [out_info[id(i)][0][i._out_index or 0]
                          for i in n._inputs]
             in_dtypes = [out_info[id(i)][1][min(i._out_index or 0,
                                                 len(out_info[id(i)][1]) - 1)]
                          for i in n._inputs]
             if any(s is None for s in in_shapes):
+                # root cause iff an unknown input is a shapeless VARIABLE;
+                # an unknown op-node input means the producer already failed
+                # — and then shapeless params (weight/bias) are NOT roots
+                # either: the backward fill would have covered them had the
+                # producer resolved
+                unknown_vars = [i._name for i, s in zip(n._inputs, in_shapes)
+                                if s is None and i._op is None]
+                if any(s is None and i._op is not None
+                       for i, s in zip(n._inputs, in_shapes)):
+                    unknown_vars = []
                 rule = _PARAM_SHAPE_RULES.get(n._op)
-                if rule is None:
-                    return None
-                filled = rule(in_shapes, n._attrs)
+                filled = rule(in_shapes, n._attrs) if rule is not None \
+                    else None
                 if filled is None or any(s is None for s in filled):
-                    return None
+                    reason = ("input shape unknown: variable(s) %s carry no "
+                              "shape and op %r has no parameter shape rule"
+                              % (", ".join(map(repr, unknown_vars)), n._op)
+                              if unknown_vars else "")
+                    if fail(n, reason, root=bool(unknown_vars)) is None:
+                        return None
+                    continue
                 for i, s in zip(n._inputs, filled):
                     if i._op is None and known.get(i._name) is None:
                         known[i._name] = tuple(s)
@@ -272,8 +315,13 @@ class Symbol:
                 out = jax.eval_shape(
                     lambda *a, **k: get_op(n._op).fn(*a, **{**attrs, **k}),
                     *pos, **kw)
-            except Exception:
-                return None
+            except Exception as e:  # mxlint: disable=broad-except — abstract
+                # eval failure IS the negative result this walk exists to
+                # detect; reason is surfaced via on_fail / None return
+                if fail(n, "abstract evaluation failed: %s: %s"
+                        % (type(e).__name__, e)) is None:
+                    return None
+                continue
             outs = out if isinstance(out, (list, tuple)) else [out]
             out_info[id(n)] = (tuple(tuple(o.shape) for o in outs),
                                tuple(_np.dtype(o.dtype) for o in outs))
@@ -304,7 +352,9 @@ class Symbol:
     def infer_shape_partial(self, **kwargs):
         try:
             return self.infer_shape(**kwargs)
-        except Exception:
+        except Exception:  # mxlint: disable=broad-except — partial
+            # inference's documented contract is (None, None, None)
+            # on ANY failure; Symbol.lint() surfaces the blame
             return None, None, None
 
     def infer_type(self, **kwargs):
@@ -375,6 +425,18 @@ class Symbol:
             outs = [sink[self._out_index]] if self._out_index is not None \
                 else list(sink)
         return arg_types, outs, aux_types
+
+    # ----------------------------------------------------------------- lint
+    def lint(self, rules=None, disable=(), **known_shapes):
+        """Static-analysis findings for this graph (see ``analysis``):
+        unknown ops, duplicate/dangling arguments, unresolvable shapes or
+        dtypes, float64 on TPU, MXU tiling diagnostics. ``known_shapes``
+        feed shape inference exactly like ``infer_shape(**kwargs)``;
+        ``rules``/``disable`` select or mute rule ids. Returns a list of
+        ``analysis.Finding`` — empty means the graph is clean."""
+        from ..analysis import analyze
+        return analyze(self, rules=rules, disable=disable,
+                       known_shapes=known_shapes)
 
     # ----------------------------------------------------------------- eval
     def eval(self, ctx=None, **kwargs):

@@ -187,7 +187,9 @@ def reregister_subgraph_op(opname, attrs):
         op_fn, nout = _build_cond_op(sub, sub_e, meta)
     else:
         raise ValueError("unknown subgraph kind %r" % kind)
-    _register_op(opname, num_outputs=nout)(op_fn)
+    # override: a re-load of the same checkpoint rebuilds the same closure
+    # op name — replacing it with the freshly-built equivalent is the intent
+    _register_op(opname, num_outputs=nout, override=True)(op_fn)
 
 
 # ---------------------------------------------------------------------------

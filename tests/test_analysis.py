@@ -1,0 +1,370 @@
+"""Graph-level static analysis (incubator_mxnet_tpu.analysis).
+
+Each rule gets one positive (fires) and one negative (clean) case, per
+the graphlint acceptance criteria; plus the framework surface itself:
+Symbol.lint(), analyze_json on serialized graphs, per-node and global
+suppression, rule selection, and report ordering.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.analysis import (
+    Finding, GRAPH_RULES, MXU_OPS, Pass, SEVERITIES, analyze, analyze_json,
+    format_findings, graph_rule, min_tile)
+from incubator_mxnet_tpu.symbol import Symbol
+
+sym = mx.sym
+
+
+def rule_ids(findings, rule=None):
+    ids = [f.rule_id for f in findings]
+    return [r for r in ids if r == rule] if rule else ids
+
+
+def clean_mlp():
+    x = sym.var("x", shape=(8, 128), dtype="float32")
+    fc1 = sym.FullyConnected(x, num_hidden=128, name="fc1")
+    act = sym.relu(fc1, name="act")
+    return sym.FullyConnected(act, num_hidden=128, name="fc2")
+
+
+# ---------------------------------------------------------------------------
+# the gate: a well-formed graph has zero findings
+# ---------------------------------------------------------------------------
+
+def test_clean_graph_no_findings():
+    assert clean_mlp().lint() == []
+
+
+def test_clean_graph_roundtrips_through_json():
+    assert analyze_json(clean_mlp().tojson()) == []
+
+
+# ---------------------------------------------------------------------------
+# unknown-op
+# ---------------------------------------------------------------------------
+
+def test_unknown_op_fires():
+    x = sym.var("x", dtype="float32")
+    bogus = Symbol("TotallyNotAnOp", "bogus", [x], {})
+    found = analyze(bogus)
+    assert rule_ids(found, "unknown-op")
+    f = [f for f in found if f.rule_id == "unknown-op"][0]
+    assert f.severity == "error" and f.node == "bogus"
+    assert "TotallyNotAnOp" in f.message
+
+
+def test_unknown_op_clean_for_registered_ops():
+    assert not rule_ids(clean_mlp().lint(), "unknown-op")
+
+
+# ---------------------------------------------------------------------------
+# duplicate-arg
+# ---------------------------------------------------------------------------
+
+def test_duplicate_arg_fires():
+    a = sym.var("x", dtype="float32")
+    b = sym.var("x", dtype="float32")   # distinct node, same name
+    found = (a + b).lint()
+    dups = [f for f in found if f.rule_id == "duplicate-arg"]
+    assert len(dups) == 1 and dups[0].severity == "error"
+    assert "'x'" in dups[0].message
+
+
+def test_duplicate_arg_not_fired_for_shared_node():
+    a = sym.var("x", dtype="float32")
+    assert not rule_ids((a + a).lint(), "duplicate-arg")
+
+
+# ---------------------------------------------------------------------------
+# unused-arg / dead-node (serialized graphs can declare unreachable nodes)
+# ---------------------------------------------------------------------------
+
+def _graph_json(nodes, heads):
+    return json.dumps({"nodes": nodes, "arg_nodes": [], "heads": heads})
+
+
+def _null(name):
+    return {"op": "null", "name": name, "attrs": {}, "inputs": []}
+
+
+def test_unused_arg_and_dead_node_fire_on_json_graph():
+    js = _graph_json(
+        [_null("x"), _null("y"),
+         {"op": "broadcast_add", "name": "out", "attrs": {},
+          "inputs": [[0, 0, 0], [0, 0, 0]]},
+         {"op": "broadcast_mul", "name": "orphan", "attrs": {},
+          "inputs": [[0, 0, 0], [1, 0, 0]]}],
+        heads=[[2, 0, 0]])
+    found = analyze_json(js)
+    assert [f.node for f in found if f.rule_id == "dead-node"] == ["orphan"]
+    assert [f.node for f in found if f.rule_id == "unused-arg"] == ["y"]
+
+
+def test_unused_arg_dead_node_clean_when_all_reachable():
+    found = analyze_json(clean_mlp().tojson())
+    assert not rule_ids(found, "unused-arg")
+    assert not rule_ids(found, "dead-node")
+
+
+def test_dead_output_slot_reported_as_info():
+    x = sym.var("x", shape=(8, 128), dtype="float32")
+    s = sym.SliceChannel(x, num_outputs=2, name="sp")
+    found = (s[0] * 2).lint()
+    dead = [f for f in found if f.rule_id == "dead-node"]
+    assert len(dead) == 1 and dead[0].severity == "info"
+    assert "output 1" in dead[0].message
+
+
+def test_no_dead_slot_when_all_outputs_consumed():
+    x = sym.var("x", shape=(8, 128), dtype="float32")
+    s = sym.SliceChannel(x, num_outputs=2, name="sp")
+    assert not rule_ids((s[0] + s[1]).lint(), "dead-node")
+
+
+# ---------------------------------------------------------------------------
+# unresolved-shape (opt-in: only with shape info present)
+# ---------------------------------------------------------------------------
+
+def test_unresolved_shape_blames_root_with_path():
+    a = sym.var("a", shape=(4, 8), dtype="float32")
+    b = sym.var("b", dtype="float32")           # shapeless
+    c = sym.broadcast_add(a, b, name="c")
+    d = sym.relu(c, name="d")
+    found = d.lint()
+    unres = [f for f in found if f.rule_id == "unresolved-shape"]
+    # only the ROOT (c) is blamed, not its downstream cascade (d)
+    assert [f.node for f in unres] == ["c"]
+    assert unres[0].severity == "error"
+    assert "c -> d" in unres[0].message   # the breadcrumb path
+
+
+def test_unresolved_shape_silent_without_shape_info():
+    a = sym.var("a", dtype="float32")
+    d = sym.relu(sym.broadcast_add(a, sym.var("b", dtype="float32")))
+    assert not rule_ids(d.lint(), "unresolved-shape")
+
+
+def test_unresolved_shape_clean_when_shapes_feed_in():
+    a = sym.var("a", dtype="float32")
+    b = sym.var("b", dtype="float32")
+    d = sym.relu(sym.broadcast_add(a, b, name="c"), name="d")
+    assert not rule_ids(d.lint(a=(4, 8), b=(4, 8)), "unresolved-shape")
+
+
+# ---------------------------------------------------------------------------
+# unresolved-dtype
+# ---------------------------------------------------------------------------
+
+def test_unresolved_dtype_fires_on_untyped_bare_head():
+    found = analyze(sym.var("x"))
+    f = [f for f in found if f.rule_id == "unresolved-dtype"]
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert "dtype" in f[0].message
+
+
+def test_unresolved_dtype_clean_when_declared():
+    assert not rule_ids(analyze(sym.var("x", dtype="float32")),
+                        "unresolved-dtype")
+
+
+# ---------------------------------------------------------------------------
+# float64-tpu
+# ---------------------------------------------------------------------------
+
+def test_float64_blames_introducer_only():
+    x = sym.var("x", shape=(8, 128), dtype="float64")
+    y = sym.relu(x, name="y")        # promoted, not introduced
+    found = y.lint()
+    f64 = [f for f in found if f.rule_id == "float64-tpu"]
+    assert [f.node for f in f64] == ["x"]
+    assert f64[0].severity == "warning"
+
+
+def test_float64_clean_on_float32_graph():
+    assert not rule_ids(clean_mlp().lint(), "float64-tpu")
+
+
+# ---------------------------------------------------------------------------
+# tpu-tiling
+# ---------------------------------------------------------------------------
+
+def test_tiling_fires_on_misaligned_mxu_operand():
+    x = sym.var("x", shape=(8, 100), dtype="float32")   # 100 % 128 != 0
+    fc = sym.FullyConnected(x, num_hidden=128, name="fc")
+    found = fc.lint()
+    til = [f for f in found if f.rule_id == "tpu-tiling"]
+    assert til and til[0].severity == "info"
+    assert "(8, 100)" in til[0].message and "float32" in til[0].message
+
+
+def test_tiling_clean_on_aligned_operand():
+    assert not rule_ids(clean_mlp().lint(), "tpu-tiling")
+
+
+def test_tiling_respects_dtype_sublane():
+    # bf16 min tile is (16, 128): an 8-row fp32-aligned operand misaligns
+    assert min_tile("bfloat16") == (16, 128)
+    x = sym.var("x", shape=(8, 128), dtype="bfloat16")
+    fc = sym.FullyConnected(x, num_hidden=128, name="fc")
+    assert rule_ids(fc.lint(), "tpu-tiling")
+    ok = sym.var("x", shape=(16, 128), dtype="bfloat16")
+    assert not rule_ids(
+        sym.FullyConnected(ok, num_hidden=128, name="fc").lint(),
+        "tpu-tiling")
+
+
+# ---------------------------------------------------------------------------
+# suppression + selection + ordering
+# ---------------------------------------------------------------------------
+
+def test_per_node_lint_disable_attr():
+    x = sym.var("x", shape=(8, 128), dtype="float64",
+                __lint_disable__="float64-tpu")
+    assert not rule_ids(sym.relu(x).lint(), "float64-tpu")
+
+
+def test_per_node_disable_all():
+    x = sym.var("x", shape=(8, 100), dtype="float64",
+                __lint_disable__="all")
+    y = sym.var("y", shape=(8, 100), dtype="float64")
+    found = sym.broadcast_add(x, y).lint()
+    assert [f.node for f in found if f.rule_id == "float64-tpu"] == ["y"]
+
+
+def test_global_disable_and_rule_subset():
+    x = sym.var("x", dtype="float64")
+    bogus = Symbol("TotallyNotAnOp", "bogus", [x], {})
+    assert not analyze(bogus, disable=("unknown-op", "float64-tpu",
+                                       "unresolved-dtype"))
+    only = analyze(bogus, rules=("unknown-op",))
+    assert rule_ids(only) == ["unknown-op"]
+    with pytest.raises(KeyError):
+        analyze(bogus, rules=("no-such-rule",))
+
+
+def test_findings_sorted_errors_first():
+    a = sym.var("x", dtype="float32")
+    b = sym.var("x", shape=(8, 100), dtype="float64")  # duplicate + f64
+    found = sym.FullyConnected(a + b, num_hidden=7, name="fc").lint()
+    ranks = [SEVERITIES.index(f.severity) for f in found]
+    assert ranks == sorted(ranks) and found[0].severity == "error"
+
+
+def test_finding_format_and_dict():
+    f = Finding("float64-tpu", "warning", "x", "msg")
+    assert f.format() == "node 'x': warning [float64-tpu] msg"
+    assert f.to_dict() == {"rule": "float64-tpu", "severity": "warning",
+                           "node": "x", "message": "msg"}
+    g = Finding("broad-except", "warning", None, "msg", path="a.py", line=3)
+    assert g.location == "a.py:3"
+    assert format_findings([f, g]).count("\n") == 1
+    with pytest.raises(ValueError):
+        Finding("x", "fatal", None, "bad severity")
+
+
+def test_custom_rule_pluggable():
+    class NamePrefix(Pass):
+        id = "name-prefix"
+        severity = "info"
+
+        def run(self, ctx):
+            for n in ctx.nodes:
+                if n._name.startswith("tmp_"):
+                    yield self.finding(n, "temporary name leaked")
+
+    x = sym.var("tmp_x", dtype="float32")
+    found = analyze(sym.relu(x), rules=(NamePrefix,))
+    assert rule_ids(found) == ["name-prefix"]
+
+
+def test_catalog_is_complete():
+    expected = {"unknown-op", "duplicate-arg", "unused-arg", "dead-node",
+                "unresolved-shape", "unresolved-dtype", "float64-tpu",
+                "tpu-tiling"}
+    assert expected <= set(GRAPH_RULES)
+    assert "FullyConnected" in MXU_OPS
+    for cls in GRAPH_RULES.values():
+        assert cls.id and cls.severity in SEVERITIES and cls.description
+
+
+# ---------------------------------------------------------------------------
+# registry collision (satellite: silent shadowing is now an error)
+# ---------------------------------------------------------------------------
+
+def test_register_collision_raises():
+    from incubator_mxnet_tpu.ops.registry import (
+        _OP_REGISTRY, alias, get_op, register)
+    assert "relu" in _OP_REGISTRY
+    before = get_op("relu")
+    with pytest.raises(ValueError, match="already registered"):
+        register("relu")(lambda x: x)
+    with pytest.raises(ValueError, match="override=True"):
+        register("graphlint_test_op", aliases=("relu",))(lambda x: x)
+    _OP_REGISTRY.pop("graphlint_test_op", None)
+    with pytest.raises(ValueError, match="already registered"):
+        alias("sigmoid", "relu")
+    assert get_op("relu") is before   # registry untouched by the failures
+
+
+def test_register_override_explicitly_allowed():
+    from incubator_mxnet_tpu.ops.registry import _OP_REGISTRY, get_op, register
+
+    try:
+        register("graphlint_tmp_op")(lambda x: x)
+        replacement = lambda x: x + 1
+        register("graphlint_tmp_op", override=True)(replacement)
+        assert get_op("graphlint_tmp_op").fn is replacement
+    finally:
+        _OP_REGISTRY.pop("graphlint_tmp_op", None)
+
+
+# ---------------------------------------------------------------------------
+# inference error paths (satellite: partial inference + lint parity)
+# ---------------------------------------------------------------------------
+
+def test_infer_shape_partial_returns_none_triple_on_failure():
+    a = sym.var("a", shape=(4, 8), dtype="float32")
+    b = sym.var("b", dtype="float32")
+    c = sym.broadcast_add(a, b, name="c")
+    assert c.infer_shape_partial() == (None, None, None)
+
+
+def test_infer_shape_partial_success_matches_infer_shape():
+    net = clean_mlp()
+    assert net.infer_shape_partial() == net.infer_shape()
+
+
+def test_infer_shape_conflicting_caller_shapes():
+    a = sym.var("a", shape=(4, 8), dtype="float32")
+    b = sym.var("b", shape=(3, 9), dtype="float32")
+    c = sym.broadcast_add(a, b, name="c")
+    assert c.infer_shape_partial() == (None, None, None)
+    blamed = [f.node for f in c.lint()
+              if f.rule_id == "unresolved-shape"]
+    assert blamed == ["c"]
+
+
+def test_lint_blames_same_node_infer_shape_gives_up_on():
+    a = sym.var("a", shape=(4, 8), dtype="float32")
+    b = sym.var("b", dtype="float32")
+    c = sym.broadcast_add(a, b, name="c")
+    net = sym.FullyConnected(sym.relu(c, name="d"), num_hidden=4, name="fc")
+    assert net.infer_shape_partial() == (None, None, None)
+    blamed = [f.node for f in net.lint()
+              if f.rule_id == "unresolved-shape"]
+    assert blamed == ["c"]   # root blame only, no downstream cascade
+
+
+def test_infer_type_lenient_on_unknown_op_but_lint_flags_it():
+    # dtype propagation stays registry-lenient (checkpoint graphs may
+    # carry ops this process never registered); lint owns the check
+    x = sym.var("x", dtype="float32")
+    bogus = Symbol("TotallyNotAnOp", "bogus", [x], {})
+    _, out_t, _ = bogus.infer_type()
+    assert str(out_t[0]) == "float32"
+    assert rule_ids(analyze(bogus), "unknown-op")

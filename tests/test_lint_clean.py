@@ -1,0 +1,90 @@
+"""The graphlint CI gate: the shipped tree lints clean.
+
+Tier-1 by design — a PR that introduces a finding (a new broad except, a
+mutable default, an unguarded store in a lock-owning class, a graph op
+the registry forgot) fails here, in-process, with the finding text in
+the assertion message. Suppressions (``# mxlint: disable=...`` with a
+reason) are the escape hatch and are themselves reviewable diffs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import incubator_mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "incubator_mxnet_tpu")
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _fmt(findings):
+    return "\n".join(f.format() for f in findings)
+
+
+def test_package_source_lints_clean():
+    from tools.mxlint import lint_paths
+    findings = lint_paths([PKG])
+    assert not findings, "mxlint findings in the package:\n" + _fmt(findings)
+
+
+def test_tools_source_lints_clean():
+    from tools.mxlint import lint_paths
+    findings = lint_paths([TOOLS])
+    assert not findings, "mxlint findings in tools/:\n" + _fmt(findings)
+
+
+def test_representative_graphs_analyze_clean():
+    """The graph analyzer's self-check: symbolic graphs the test-suite
+    models build (MLP, conv stack, multi-output split) carry zero
+    findings under the full rule catalog."""
+    sym = mx.sym
+    x = sym.var("data", shape=(128, 128), dtype="float32")
+    mlp = sym.FullyConnected(
+        sym.relu(sym.FullyConnected(x, num_hidden=256, name="fc1"),
+                 name="act1"),
+        num_hidden=128, name="fc2")
+    assert mlp.lint() == [], _fmt(mlp.lint())
+
+    img = sym.var("img", shape=(8, 3, 32, 128), dtype="float32")
+    conv = sym.Activation(
+        sym.Convolution(img, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                        name="conv1"),
+        act_type="relu", name="crelu")
+    assert conv.lint() == [], _fmt(conv.lint())
+
+    s = sym.SliceChannel(x, num_outputs=2, name="halves")
+    both = s[0] + s[1]
+    assert both.lint() == [], _fmt(both.lint())
+
+    # and the serialized form rides the same gate
+    from incubator_mxnet_tpu.analysis import analyze_json
+    assert analyze_json(mlp.tojson()) == []
+
+
+def test_mxlint_cli_gate():
+    """The exact command CI runs: ``python -m tools.mxlint <pkg>`` exits 0
+    on the shipped tree, and --json emits a parseable (empty) report."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", PKG, TOOLS],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", PKG, "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout) == []
+
+
+def test_diagnose_embeds_lint_section():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "diagnose.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Lint (graphlint)" in r.stdout
+    assert "mxlint       : clean" in r.stdout

@@ -1,0 +1,380 @@
+"""mxlint — the AST-level framework linter (tools/mxlint.py).
+
+One positive and one negative case per source rule, plus the suppression
+machinery (same-line, standalone comment, file-wide, noqa BLE001), the
+path drivers (.py trees and symbol .json graphs), and the CLI (exit
+codes, --json, --rules).
+"""
+
+import json
+import textwrap
+
+import pytest
+
+import incubator_mxnet_tpu as mx
+from tools.mxlint import (
+    SOURCE_RULES, lint_paths, lint_source, main)
+
+
+def lint(src, rules=None):
+    return lint_source(textwrap.dedent(src), "t.py", rules=rules)
+
+
+def ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# broad-except
+# ---------------------------------------------------------------------------
+
+def test_broad_except_fires_on_silent_swallow():
+    found = lint("""
+        try:
+            risky()
+        except Exception:
+            pass
+    """)
+    assert ids(found) == ["broad-except"]
+    assert found[0].path == "t.py" and found[0].line == 4
+
+
+def test_bare_except_fires():
+    assert ids(lint("""
+        try:
+            risky()
+        except:
+            pass
+    """)) == ["broad-except"]
+
+
+def test_broad_except_ok_when_reraised_logged_or_used():
+    assert not lint("""
+        try:
+            risky()
+        except Exception:
+            raise
+    """)
+    assert not lint("""
+        try:
+            risky()
+        except Exception as e:
+            log.warning("failed: %s", e)
+    """)
+    assert not lint("""
+        try:
+            risky()
+        except Exception as e:
+            result = e
+    """)
+
+
+def test_narrow_except_clean():
+    assert not lint("""
+        try:
+            risky()
+        except (KeyError, ValueError):
+            pass
+    """)
+
+
+def test_broad_except_exempt_in_del():
+    assert not lint("""
+        class A:
+            def __del__(self):
+                try:
+                    self.close()
+                except Exception:
+                    pass
+    """)
+
+
+# ---------------------------------------------------------------------------
+# mutable-default
+# ---------------------------------------------------------------------------
+
+def test_mutable_default_fires():
+    found = lint("""
+        def f(x, acc=[]):
+            return acc
+
+        def g(*, opts={}):
+            return opts
+
+        def h(s=set()):
+            return s
+    """)
+    assert ids(found) == ["mutable-default"] * 3
+    assert "'f'" in found[0].message
+
+
+def test_mutable_default_clean():
+    assert not lint("""
+        def f(x, acc=None, n=3, name="w", t=()):
+            if acc is None:
+                acc = []
+            return acc
+    """)
+
+
+# ---------------------------------------------------------------------------
+# impure-hybrid
+# ---------------------------------------------------------------------------
+
+def test_impure_hybrid_rng_and_state():
+    found = lint("""
+        class Block:
+            def hybrid_forward(self, F, x):
+                p = random.random()
+                self._cache = x
+                return x * p
+    """)
+    assert sorted(ids(found)) == ["impure-hybrid", "impure-hybrid"]
+    msgs = " ".join(f.message for f in found)
+    assert "trace time" in msgs and "self._cache" in msgs
+
+
+def test_impure_hybrid_jit_decorated_print():
+    found = lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            print(x)
+            return x + 1
+    """)
+    assert ids(found) == ["impure-hybrid"]
+
+
+def test_impure_hybrid_partial_jit():
+    assert ids(lint("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnums=0)
+        def step(n, x):
+            return x + time.time()
+    """)) == ["impure-hybrid"]
+
+
+def test_pure_hybrid_clean():
+    assert not lint("""
+        class Block:
+            def hybrid_forward(self, F, x):
+                return F.relu(x) * 2
+
+        def helper(x):   # not traced: side effects fine
+            print(x)
+            return random.random()
+    """)
+
+
+# ---------------------------------------------------------------------------
+# host-sync-loop
+# ---------------------------------------------------------------------------
+
+def test_host_sync_in_train_loop_fires():
+    found = lint("""
+        def train_epoch(model, data):
+            total = 0.0
+            for batch in data:
+                loss = model(batch)
+                total += loss.asnumpy()
+            return total
+    """)
+    assert ids(found) == ["host-sync-loop"]
+    assert ".asnumpy()" in found[0].message
+
+
+def test_host_sync_outside_loop_or_fn_clean():
+    assert not lint("""
+        def train_epoch(model, data):
+            for batch in data:
+                loss = model(batch)
+            return loss.asnumpy()   # once, after the loop: fine
+
+        def summarize(arrs):
+            return [a.asnumpy() for a in arrs]   # not a step loop
+    """)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+def test_lock_discipline_fires_on_unguarded_store():
+    found = lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+
+            def clear(self):
+                self._data = {}   # racy: guarded elsewhere
+    """)
+    assert ids(found) == ["lock-discipline"]
+    assert "self._data" in found[0].message
+
+
+def test_lock_discipline_honors_locked_suffix_and_init():
+    assert not lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}   # construction is single-threaded
+
+            def put(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+
+            def _clear_locked(self):   # caller holds the lock
+                self._data = {}
+    """)
+
+
+def test_lock_discipline_ignores_lockless_classes():
+    assert not lint("""
+        class Plain:
+            def set(self, v):
+                self._v = v
+    """)
+
+
+# ---------------------------------------------------------------------------
+# suppression
+# ---------------------------------------------------------------------------
+
+def test_same_line_disable():
+    assert not lint("""
+        try:
+            risky()
+        except Exception:  # mxlint: disable=broad-except — probe
+            pass
+    """)
+
+
+def test_disable_rides_inside_compound_comment():
+    assert not lint("""
+        try:
+            risky()
+        except Exception:  # pragma: no cover — mxlint: disable=broad-except (probe)
+            pass
+    """)
+
+
+def test_standalone_comment_disable_covers_next_line():
+    assert not lint("""
+        try:
+            risky()
+        # mxlint: disable=broad-except — long justification that would
+        # not fit on the except line itself
+        except Exception:
+            pass
+    """)
+
+
+def test_disable_file():
+    assert not lint("""
+        # mxlint: disable-file=mutable-default
+        def f(a=[]):
+            return a
+
+        def g(b={}):
+            return b
+    """)
+
+
+def test_noqa_ble001_equivalent():
+    assert not lint("""
+        try:
+            risky()
+        except Exception:  # noqa: BLE001
+            pass
+    """)
+
+
+def test_disable_only_mutes_named_rule():
+    found = lint("""
+        def f(a=[]):  # mxlint: disable=broad-except
+            return a
+    """)
+    assert ids(found) == ["mutable-default"]
+
+
+# ---------------------------------------------------------------------------
+# drivers + CLI
+# ---------------------------------------------------------------------------
+
+def test_syntax_error_is_a_finding():
+    found = lint_source("def broken(:\n", "bad.py")
+    assert ids(found) == ["syntax-error"]
+    assert found[0].severity == "error" and found[0].path == "bad.py"
+
+
+def test_rules_subset_selection():
+    src = textwrap.dedent("""
+        def f(a=[]):
+            try:
+                pass
+            except Exception:
+                pass
+    """)
+    assert ids(lint_source(src, "t.py", rules=["mutable-default"])) == \
+        ["mutable-default"]
+
+
+def test_lint_paths_walks_tree_and_routes_json(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "a.py").write_text(
+        "def f(a=[]):\n    return a\n")
+    (tmp_path / "pkg" / "__pycache__").mkdir()
+    (tmp_path / "pkg" / "__pycache__" / "junk.py").write_text("def f(:\n")
+    x = mx.sym.var("x", shape=(8, 128), dtype="float64")
+    (tmp_path / "g.json").write_text(mx.sym.relu(x).tojson())
+    found = lint_paths([str(tmp_path / "pkg"), str(tmp_path / "g.json")])
+    by_rule = {f.rule_id for f in found}
+    assert by_rule == {"mutable-default", "float64-tpu"}
+    gf = [f for f in found if f.rule_id == "float64-tpu"][0]
+    assert gf.path == str(tmp_path / "g.json") and gf.node == "x"
+
+
+def test_main_exit_codes_and_json(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(x):\n    return x\n")
+    assert main([str(clean)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("def f(a=[]):\n    return a\n")
+    assert main([str(dirty)]) == 1
+    out = capsys.readouterr().out
+    assert "mutable-default" in out and "1 finding(s)" in out
+
+    assert main([str(dirty), "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload[0]["rule"] == "mutable-default"
+    assert payload[0]["path"] == str(dirty) and payload[0]["line"] == 1
+
+
+def test_main_rejects_unknown_rule(tmp_path, capsys):
+    p = tmp_path / "x.py"
+    p.write_text("pass\n")
+    with pytest.raises(SystemExit):
+        main([str(p), "--rules", "no-such-rule"])
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_source_catalog_is_complete():
+    expected = {"broad-except", "mutable-default", "impure-hybrid",
+                "host-sync-loop", "lock-discipline"}
+    assert expected == set(SOURCE_RULES)
+    for cls in SOURCE_RULES.values():
+        assert cls.id and cls.description

@@ -1,0 +1,2 @@
+"""Repo tooling (diagnose, mxlint, launch, benchmarks). A package so
+``python -m tools.mxlint`` works from the repo root."""

@@ -65,6 +65,25 @@ def main():
     except Exception as e:  # noqa: BLE001
         print("framework import failed:", e)
 
+    section("Lint (graphlint)")
+    # a dirty tree is exactly the kind of context a bug report needs:
+    # embed the same findings `python -m tools.mxlint` would print
+    try:
+        from tools.mxlint import lint_paths
+        pkg = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "incubator_mxnet_tpu")
+        findings = lint_paths([pkg])
+        print("mxlint       :", "clean" if not findings
+              else "%d finding(s)" % len(findings))
+        for f in findings[:20]:
+            print("  -", f.format())
+        if len(findings) > 20:
+            print("  ... %d more (run python -m tools.mxlint)" %
+                  (len(findings) - 20))
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("mxlint failed:", e)
+
     section("Environment Variables (MXTPU_*/BENCH_*)")
     hits = {k: v for k, v in sorted(os.environ.items())
             if k.startswith(("MXTPU_", "BENCH_", "MXNET_"))}

@@ -180,7 +180,7 @@ def main():
     from incubator_mxnet_tpu.kvstore.dist_server import SchedulerClient
     try:
         SchedulerClient(("127.0.0.1", port)).shutdown()
-    except Exception:
+    except Exception:  # mxlint: disable=broad-except — best-effort teardown; scheduler may already be gone
         pass
     sys.exit(max(code, _drain(procs)))
 

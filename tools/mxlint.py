@@ -1,0 +1,510 @@
+#!/usr/bin/env python
+"""mxlint — the AST-level framework linter (level 2 of graphlint).
+
+Framework-specific rules over the repo's own Python source: broad
+``except Exception`` swallows, mutable default arguments, impurity inside
+``hybrid_forward``/jit-traced functions, host syncs inside training-step
+loops, and lock-discipline races in classes that own a ``threading.Lock``.
+Shares the ``Finding`` type with the graph analyzer
+(``incubator_mxnet_tpu.analysis``); ``.json`` arguments are routed to the
+graph analyzer, so one CLI lints both levels.
+
+Usage:
+    python -m tools.mxlint <paths...> [--json] [--rules id,id]
+
+Suppression (same-line comment):
+    except Exception:  # mxlint: disable=broad-except — <why it's safe>
+``# noqa: BLE001`` is honored as equivalent to disabling broad-except.
+A module-wide mute: ``# mxlint: disable-file=rule-id`` on any line.
+Exit code: 0 when clean, 1 when any finding survives suppression.
+
+Rule catalog with examples: docs/ANALYSIS.md.
+"""
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_mxnet_tpu.analysis.core import (  # noqa: E402
+    Finding, SEVERITIES, format_findings)
+
+__all__ = ["SourceRule", "SOURCE_RULES", "source_rule", "lint_source",
+           "lint_paths", "main"]
+
+SOURCE_RULES = {}   # rule id -> SourceRule subclass
+
+
+def source_rule(cls):
+    if not cls.id:
+        raise ValueError("source rule needs an id")
+    if cls.id in SOURCE_RULES:
+        raise ValueError("duplicate source rule id %r" % cls.id)
+    SOURCE_RULES[cls.id] = cls
+    return cls
+
+
+class SourceRule:
+    """One AST rule: ``check(tree, path)`` yields Findings."""
+
+    id = None
+    severity = "warning"
+    description = ""
+
+    def check(self, tree, path):
+        raise NotImplementedError
+
+    def finding(self, path, node, message, severity=None):
+        return Finding(self.id, severity or self.severity, None, message,
+                       path=path, line=getattr(node, "lineno", None))
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def _walk(node):
+    return ast.walk(node)
+
+
+def _dotted(node):
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node):
+    """'x' when node is ``self.x``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _functions(tree):
+    """(funcdef, enclosing_class_or_None) for every function in the file."""
+    out = []
+
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, cls))
+                visit(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, child)
+            else:
+                visit(child, cls)
+
+    visit(tree, None)
+    return out
+
+
+_LOG_CALL_NAMES = frozenset((
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "print", "perror", "write"))
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+@source_rule
+class BroadExcept(SourceRule):
+    id = "broad-except"
+    severity = "warning"
+    description = ("bare/overbroad except swallows errors without "
+                   "re-raise, log, or use of the caught exception")
+
+    _BROAD = frozenset(("Exception", "BaseException"))
+
+    def _is_broad(self, h):
+        if h.type is None:
+            return True
+        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        return any(isinstance(t, ast.Name) and t.id in self._BROAD
+                   for t in types)
+
+    def _handled(self, h):
+        for stmt in h.body:
+            for n in _walk(stmt):
+                if isinstance(n, ast.Raise):
+                    return True
+                if h.name and isinstance(n, ast.Name) and n.id == h.name \
+                        and isinstance(n.ctx, ast.Load):
+                    return True      # exception object is stored/inspected
+                if isinstance(n, ast.Call):
+                    fn = n.func
+                    last = fn.attr if isinstance(fn, ast.Attribute) else \
+                        (fn.id if isinstance(fn, ast.Name) else None)
+                    if last in _LOG_CALL_NAMES:
+                        return True
+        return False
+
+    def check(self, tree, path):
+        # interpreter-shutdown guards in __del__ are idiomatic — exempt
+        exempt = set()
+        for fn, _cls in _functions(tree):
+            if fn.name == "__del__":
+                exempt.update(id(n) for n in _walk(fn)
+                              if isinstance(n, ast.ExceptHandler))
+        for n in _walk(tree):
+            if isinstance(n, ast.ExceptHandler) and id(n) not in exempt \
+                    and self._is_broad(n) and not self._handled(n):
+                yield self.finding(
+                    path, n, "broad %r swallows errors without "
+                    "re-raise/log; narrow the exception type, surface "
+                    "it, or annotate why the swallow is intended"
+                    % ("bare except" if n.type is None
+                       else "except Exception"))
+
+
+@source_rule
+class MutableDefault(SourceRule):
+    id = "mutable-default"
+    severity = "warning"
+    description = "mutable default argument shared across calls"
+
+    _CTORS = frozenset(("list", "dict", "set", "bytearray"))
+
+    def _mutable(self, d):
+        if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return True
+        return isinstance(d, ast.Call) and isinstance(d.func, ast.Name) \
+            and d.func.id in self._CTORS
+
+    def check(self, tree, path):
+        for n in _walk(tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            defaults = list(n.args.defaults) + \
+                [d for d in n.args.kw_defaults if d is not None]
+            for d in defaults:
+                if self._mutable(d):
+                    name = getattr(n, "name", "<lambda>")
+                    yield self.finding(
+                        path, d, "mutable default argument in %r is "
+                        "evaluated once and shared across every call — "
+                        "use None and create it in the body" % name)
+
+
+@source_rule
+class ImpureHybrid(SourceRule):
+    id = "impure-hybrid"
+    severity = "warning"
+    description = ("side effects / Python RNG inside hybrid_forward or "
+                   "jit-traced functions run at TRACE time, not run time")
+
+    _RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+    _BANNED_CALLS = frozenset(("time.time", "time.sleep", "input"))
+
+    def _is_jitted(self, fn):
+        if fn.name == "hybrid_forward":
+            return True
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = _dotted(target) or ""
+            if d == "jit" or d.endswith(".jit"):
+                return True
+            if isinstance(dec, ast.Call) and d in ("partial",
+                                                   "functools.partial"):
+                inner = [_dotted(a) or "" for a in dec.args]
+                if any(x == "jit" or x.endswith(".jit") for x in inner):
+                    return True
+        return False
+
+    def check(self, tree, path):
+        for fn, _cls in _functions(tree):
+            if not self._is_jitted(fn):
+                continue
+            for n in _walk(fn):
+                if isinstance(n, ast.Call):
+                    d = _dotted(n.func) or ""
+                    if any(d.startswith(p) for p in self._RNG_PREFIXES):
+                        yield self.finding(
+                            path, n, "Python RNG %r inside %r is sampled "
+                            "once at trace time and baked into the "
+                            "compiled program — use the framework RNG ops"
+                            % (d, fn.name))
+                    elif d in self._BANNED_CALLS or d == "print":
+                        yield self.finding(
+                            path, n, "%r inside %r executes at trace "
+                            "time only (and retriggers retraces); hoist "
+                            "it out of the traced function"
+                            % (d, fn.name))
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if _self_attr(t) is not None:
+                            yield self.finding(
+                                path, n, "assignment to self.%s inside "
+                                "%r is a trace-time side effect: it runs "
+                                "once per compilation, not once per call"
+                                % (_self_attr(t), fn.name))
+
+
+@source_rule
+class HostSyncLoop(SourceRule):
+    id = "host-sync-loop"
+    severity = "warning"
+    description = (".asnumpy()/host-sync call inside a training-step "
+                   "loop blocks the accelerator pipeline every iteration")
+
+    _SYNC_ATTRS = frozenset(("asnumpy", "asscalar", "wait_to_read"))
+    _LOOP_FN = re.compile(r"(^|_)(train|fit|step|epoch)($|_)|forward_backward")
+
+    def check(self, tree, path):
+        for fn, _cls in _functions(tree):
+            if not self._LOOP_FN.search(fn.name):
+                continue
+            for loop in _walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for n in _walk(loop):
+                    if isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Attribute) and \
+                            n.func.attr in self._SYNC_ATTRS:
+                        yield self.finding(
+                            path, n, ".%s() inside a loop in %r forces a "
+                            "device->host sync every iteration, stalling "
+                            "the dispatch pipeline; hoist it out of the "
+                            "loop or batch the reads"
+                            % (n.func.attr, fn.name))
+
+
+@source_rule
+class LockDiscipline(SourceRule):
+    id = "lock-discipline"
+    severity = "warning"
+    description = ("attribute guarded by self._lock elsewhere is "
+                   "mutated outside `with self._lock`")
+
+    _LOCK_CTORS = frozenset(("Lock", "RLock"))
+
+    def _lock_attrs(self, cls):
+        out = set()
+        for n in _walk(cls):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                fn = n.value.func
+                last = fn.attr if isinstance(fn, ast.Attribute) else \
+                    (fn.id if isinstance(fn, ast.Name) else None)
+                if last in self._LOCK_CTORS:
+                    for t in n.targets:
+                        a = _self_attr(t)
+                        if a:
+                            out.add(a)
+        return out
+
+    def _stored_attrs(self, node):
+        """self attributes written by Assign/AugAssign/Subscript-store
+        anywhere under ``node`` (attribute-SET driven, reads don't count),
+        as (attr_name, ast_node) pairs."""
+        for n in _walk(node):
+            tgts = []
+            if isinstance(n, ast.Assign):
+                tgts = n.targets
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                tgts = [n.target]
+            for t in tgts:
+                base = t
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                a = _self_attr(base)
+                if a:
+                    yield a, n
+
+    def _with_lock_regions(self, fn, locks):
+        for n in _walk(fn):
+            if isinstance(n, ast.With):
+                for item in n.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call):
+                        continue   # a call result is some other manager;
+                        # only a bare ``with self._lock:`` counts
+                    if _self_attr(ce) in locks:
+                        yield n
+                        break
+
+    def check(self, tree, path):
+        for cls in (n for n in _walk(tree) if isinstance(n, ast.ClassDef)):
+            locks = self._lock_attrs(cls)
+            if not locks:
+                continue
+            methods = [m for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            guarded = set()
+            guarded_nodes = set()   # id of stores inside with-lock regions
+            for m in methods:
+                for w in self._with_lock_regions(m, locks):
+                    for a, stmt in self._stored_attrs(w):
+                        if a not in locks:
+                            guarded.add(a)
+                        guarded_nodes.add(id(stmt))
+            if not guarded:
+                continue
+            for m in methods:
+                if m.name == "__init__" or m.name.endswith("_locked"):
+                    # construction is single-threaded; the `_locked` suffix
+                    # is this codebase's caller-holds-the-lock convention
+                    continue
+                for a, stmt in self._stored_attrs(m):
+                    if a in guarded and id(stmt) not in guarded_nodes:
+                        yield self.finding(
+                            path, stmt, "self.%s is guarded by %s "
+                            "elsewhere in %r but mutated here outside "
+                            "`with`; racy under the threads that made the "
+                            "lock necessary" % (
+                                a, "/".join("self.%s" % l
+                                            for l in sorted(locks)),
+                                cls.name))
+
+
+# ---------------------------------------------------------------------------
+# suppression + drivers
+# ---------------------------------------------------------------------------
+
+# the directive may share a comment with other markers, e.g.
+# ``# pragma: no cover — mxlint: disable=broad-except (reason)``
+_DISABLE_RE = re.compile(r"#.*?mxlint:\s*disable=([A-Za-z0-9_,\-]+)")
+_DISABLE_FILE_RE = re.compile(
+    r"#.*?mxlint:\s*disable-file=([A-Za-z0-9_,\-]+)")
+_NOQA_BLE_RE = re.compile(r"#\s*noqa:.*\bBLE001\b")
+
+
+def _suppressions(src):
+    """(per-line {lineno: set(rule ids)}, file-wide set).
+
+    A directive on a code line mutes that line. A directive on a
+    standalone comment line carries forward to the next code line, so a
+    long justification can sit above the statement it excuses.
+    """
+    per_line, file_wide, pending = {}, set(), set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        rules = set()
+        m = _DISABLE_RE.search(line)
+        if m:
+            rules.update(
+                x.strip() for x in m.group(1).split(",") if x.strip())
+        m = _DISABLE_FILE_RE.search(line)
+        if m:
+            file_wide.update(
+                x.strip() for x in m.group(1).split(",") if x.strip())
+        if _NOQA_BLE_RE.search(line):
+            rules.add("broad-except")
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            pending |= rules
+        elif stripped:
+            rules |= pending
+            pending = set()
+        if rules:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_wide
+
+
+def lint_source(src, path="<string>", rules=None):
+    """Lint one Python source string; returns surviving Findings."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", "error", None,
+                        "cannot parse: %s" % e, path=path,
+                        line=e.lineno or 1)]
+    per_line, file_wide = _suppressions(src)
+    selected = (SOURCE_RULES.values() if rules is None
+                else [SOURCE_RULES[r] for r in rules])
+    findings = []
+    for cls in selected:
+        for f in cls().check(tree, path):
+            if f.rule_id in file_wide:
+                continue
+            line_dis = per_line.get(f.line, ())
+            if f.rule_id in line_dis or "all" in line_dis:
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line or 0, f.rule_id))
+    return findings
+
+
+def _iter_py_files(path):
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def lint_paths(paths, rules=None):
+    """Lint files/trees. ``.py`` goes through the AST rules; ``.json`` is
+    handed to the graph analyzer (``analysis.analyze_json``) so serialized
+    symbol graphs ride the same gate."""
+    findings = []
+    for p in paths:
+        if p.endswith(".json") and os.path.isfile(p):
+            from incubator_mxnet_tpu.analysis import GRAPH_RULES, analyze_json
+            # a rule selection naming only AST rules skips graph analysis
+            g_rules = rules if rules is None else \
+                [r for r in rules if r in GRAPH_RULES]
+            if g_rules is not None and not g_rules:
+                continue
+            with open(p) as fh:
+                for f in analyze_json(fh.read(), rules=g_rules):
+                    f.path = p
+                    findings.append(f)
+            continue
+        for fpath in _iter_py_files(p):
+            with open(fpath, encoding="utf-8") as fh:
+                findings.extend(lint_source(fh.read(), fpath, rules=rules))
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help=".py files / package dirs / symbol .json graphs")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array (for tooling, "
+                         "e.g. tools/diagnose.py embeds this)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    args = ap.parse_args(argv)
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in SOURCE_RULES]
+        if unknown:
+            ap.error("unknown rule(s): %s (have: %s)"
+                     % (", ".join(unknown), ", ".join(sorted(SOURCE_RULES))))
+    findings = lint_paths(args.paths, rules=rules)
+    if args.json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    elif findings:
+        print(format_findings(findings))
+        counts = {s: sum(1 for f in findings if f.severity == s)
+                  for s in SEVERITIES}
+        print("mxlint: %d finding(s): %s" % (
+            len(findings),
+            ", ".join("%d %s" % (counts[s], s)
+                      for s in SEVERITIES if counts[s])))
+    else:
+        print("mxlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
