@@ -974,11 +974,38 @@ def bench_zoo_scaling(steps, dtype):
               "model/batch (example/image-classification/README.md:290-319)")
 
 
+def _emit_telemetry_summary():
+    """Closing JSON line: what the run itself observed — step-time
+    histogram stats and the XLA compile tax — so a perf number can be
+    read next to the compile/step behavior that produced it."""
+    from incubator_mxnet_tpu.telemetry import catalog as cat
+    steps_snap = cat.trainer_step_seconds.snapshot()
+    count = sum(int(v[0]) for v in steps_snap.values())
+    total = sum(float(v[1]) for v in steps_snap.values())
+    line = {"metric": "telemetry_summary", "steps_observed": count,
+            "jit_compiles": int(cat.trainer_jit_compiles.value()),
+            "jit_compile_seconds": round(
+                float(cat.trainer_jit_compile_seconds.value()), 3)}
+    if count:
+        line["step_seconds_avg"] = round(total / count, 5)
+        line["step_seconds_total"] = round(total, 3)
+    print(json.dumps(line))
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     model = os.environ.get("BENCH_MODEL", "all")
+    from incubator_mxnet_tpu import telemetry
+    telemetry.enable()
+    try:
+        return _dispatch(model, batch, steps, dtype)
+    finally:
+        _emit_telemetry_summary()
+
+
+def _dispatch(model, batch, steps, dtype):
     preflight()          # tunnel-health gate, its own JSON line (first)
     if model == "resnet50":
         return bench_resnet50(batch, steps, dtype)

@@ -62,6 +62,7 @@ from . import parallel
 from . import models
 from . import runtime
 from . import profiler
+from . import telemetry
 from . import recordio
 from .recordio import MXRecordIO, MXIndexedRecordIO
 from . import image

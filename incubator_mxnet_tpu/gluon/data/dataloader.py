@@ -32,11 +32,14 @@ import glob as _glob
 import mmap as _mmap
 import os
 import multiprocessing as mp
+import time as _time
 import weakref as _weakref
 
 import numpy as _np
 
 from ...ndarray import array as nd_array
+from ...telemetry import catalog as _cat
+from ...telemetry import metrics as _met
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
@@ -289,6 +292,7 @@ class DataLoader:
             self._pool = ctx.Pool(
                 self._num_workers, initializer=_worker_initializer,
                 initargs=(dataset, ring))
+            self._worker_pids = {p.pid for p in self._pool._pool}
 
     def _rebuild_shm(self, msg):
         """Main-process side of the ring: attach (cached), copy out, free."""
@@ -311,10 +315,21 @@ class DataLoader:
             return leaves[0]
         return _unflatten(template, leaves, [0])
 
+    def _note_respawns(self):
+        """Count pool workers replaced since the last look (the fork pool
+        respawns a worker that died mid-batch; surface it as a metric
+        instead of a silent slowdown)."""
+        pids = {p.pid for p in self._pool._pool}
+        new = pids - self._worker_pids
+        if new:
+            _cat.dataloader_worker_respawns.inc(len(new))
+            self._worker_pids |= pids
+
     def __iter__(self):
         if self._pool is None:
             for batch in self._batch_sampler:
                 out = self._batchify_fn([self._dataset[i] for i in batch])
+                _cat.dataloader_batches.inc()
                 yield _to_device(out) if isinstance(out, _np.ndarray) or (
                     isinstance(out, list) and out and isinstance(out[0], _np.ndarray)) else out
             return
@@ -339,11 +354,22 @@ class DataLoader:
                     break
             while pending:
                 result = pending.pop(0)
+                enabled = _met.enabled()
+                t0 = _time.perf_counter() if enabled else 0.0
                 batch = result.get(self._timeout)
+                if enabled:
+                    _cat.dataloader_wait_seconds.observe(
+                        _time.perf_counter() - t0)
+                    _cat.dataloader_batches.inc()
+                    self._note_respawns()
                 if (isinstance(batch, tuple) and batch
                         and isinstance(batch[0], str)
                         and batch[0] == "__shm__"):
                     batch = self._rebuild_shm(batch)
+                elif self._use_shm:
+                    # worker answered over the pipe although the shm ring
+                    # is on: it fell back (e.g. no free slot / shm error)
+                    _cat.dataloader_shm_fallbacks.inc()
                 submit()
                 yield _to_device(batch)
         finally:

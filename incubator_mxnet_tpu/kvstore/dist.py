@@ -31,6 +31,8 @@ from .rpc import Connection
 from .dist_server import SchedulerClient
 from ..log import get_logger
 from ..ndarray import NDArray
+from ..telemetry import catalog as _cat
+from ..telemetry import tracing as _tr
 from ..utils import failpoints as _fp
 
 _log = get_logger(__name__)
@@ -190,6 +192,21 @@ class KVStoreDist(KVStore):
                        "rank": self._rank}))
         return out
 
+    def server_telemetry(self):
+        """Fetch each server's live metrics snapshot (JSON-decoded dicts,
+        one per server) — the telemetry analogue of the server-profiler
+        commands; tools/diagnose.py surfaces these for dist runs."""
+        import json as _json
+        self._flush()
+        out = []
+        for conn in self._servers:
+            _, payload = self._checked_call(
+                conn, {"op": "command", "command": "telemetry",
+                       "rank": self._rank})
+            out.append(_json.loads(payload.decode("utf-8")) if payload
+                       else {})
+        return out
+
     # -- key -> server placement (reference: EncodeDefaultKey) ---------------
     def _shards_for(self, key, shape):
         if key in self._key_shard:
@@ -255,25 +272,32 @@ class KVStoreDist(KVStore):
         else:
             arr = np.asarray(vals[0]._data, dtype=np.float32)
         compressed = self._compression is not None
-        for sid, lo, hi in self._shards_for(key, arr.shape):
-            part = arr[lo:hi] if arr.ndim else arr
-            if compressed:
-                import jax.numpy as jnp
-                q = self._compression.compress(self._part_key(key, lo),
-                                               jnp.asarray(part))
-                packed = np.asarray(self._compression.pack(q), dtype=np.int32)
-                meta = {"op": "push", "key": self._part_key(key, lo),
-                        "shape": list(part.shape), "dtype": "float32",
-                        "compressed": True, "rank": self._rank}
-                payload = packed.tobytes()
-            else:
-                meta = {"op": "push", "key": self._part_key(key, lo),
-                        "shape": list(part.shape), "dtype": str(part.dtype),
-                        "rank": self._rank}
-                payload = np.ascontiguousarray(part).tobytes()
-            conn = self._servers[sid]
-            self._submit(key, lambda c=conn, m=meta, p=payload:
-                         self._checked_call(c, m, p))
+        with _tr.span("kv.push", key=str(key)):
+            _cat.kvstore_pushes.inc(key=str(key))
+            for sid, lo, hi in self._shards_for(key, arr.shape):
+                part = arr[lo:hi] if arr.ndim else arr
+                if compressed:
+                    import jax.numpy as jnp
+                    q = self._compression.compress(self._part_key(key, lo),
+                                                   jnp.asarray(part))
+                    packed = np.asarray(self._compression.pack(q),
+                                        dtype=np.int32)
+                    meta = {"op": "push", "key": self._part_key(key, lo),
+                            "shape": list(part.shape), "dtype": "float32",
+                            "compressed": True, "rank": self._rank}
+                    payload = packed.tobytes()
+                else:
+                    meta = {"op": "push", "key": self._part_key(key, lo),
+                            "shape": list(part.shape), "dtype": str(part.dtype),
+                            "rank": self._rank}
+                    payload = np.ascontiguousarray(part).tobytes()
+                # stamp trace ids HERE, on the caller thread: async sends
+                # run on I/O threads where the span context is gone
+                _tr.inject(meta)
+                _cat.kvstore_push_bytes.inc(len(payload))
+                conn = self._servers[sid]
+                self._submit(key, lambda c=conn, m=meta, p=payload:
+                             self._checked_call(c, m, p))
 
     def _push_row_sparse(self, key, rsp):
         """Send only (row ids, row payloads) per shard (reference:
@@ -281,22 +305,26 @@ class KVStoreDist(KVStore):
         ids = np.asarray(rsp._sp_indices, dtype=np.int64)
         rows = np.asarray(rsp._sp_data, dtype=np.float32)
         shape = rsp.shape
-        for sid, lo, hi in self._shards_for(key, shape):
-            mask = (ids >= lo) & (ids < hi)
-            # an empty shard still sends a zero-row message: sync-mode
-            # servers count one push per worker per round, so skipping
-            # would desynchronize the aggregation generation. Row ids ride
-            # the BINARY payload (int64), not JSON metadata — a 1M-row
-            # gradient must not serialize a million JSON integers.
-            local = np.ascontiguousarray(ids[mask] - lo, dtype=np.int64)
-            part = np.ascontiguousarray(rows[mask])
-            meta = {"op": "push", "key": self._part_key(key, lo),
-                    "shape": list(part.shape), "dtype": str(part.dtype),
-                    "rows_n": int(local.size), "rank": self._rank}
-            payload = local.tobytes() + part.tobytes()
-            conn = self._servers[sid]
-            self._submit(key, lambda c=conn, m=meta, p=payload:
-                         self._checked_call(c, m, p))
+        with _tr.span("kv.push", key=str(key)):
+            _cat.kvstore_pushes.inc(key=str(key))
+            for sid, lo, hi in self._shards_for(key, shape):
+                mask = (ids >= lo) & (ids < hi)
+                # an empty shard still sends a zero-row message: sync-mode
+                # servers count one push per worker per round, so skipping
+                # would desynchronize the aggregation generation. Row ids ride
+                # the BINARY payload (int64), not JSON metadata — a 1M-row
+                # gradient must not serialize a million JSON integers.
+                local = np.ascontiguousarray(ids[mask] - lo, dtype=np.int64)
+                part = np.ascontiguousarray(rows[mask])
+                meta = {"op": "push", "key": self._part_key(key, lo),
+                        "shape": list(part.shape), "dtype": str(part.dtype),
+                        "rows_n": int(local.size), "rank": self._rank}
+                payload = local.tobytes() + part.tobytes()
+                _tr.inject(meta)    # caller thread — see dense push
+                _cat.kvstore_push_bytes.inc(len(payload))
+                conn = self._servers[sid]
+                self._submit(key, lambda c=conn, m=meta, p=payload:
+                             self._checked_call(c, m, p))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -307,17 +335,20 @@ class KVStoreDist(KVStore):
         ref = out if not isinstance(out, (list, tuple)) else out[0]
         shape = tuple(ref.shape)
         parts = []
-        for sid, lo, hi in self._shards_for(key, shape):
-            # pull is a read — naturally idempotent, retried WITHOUT a
-            # dedup stamp (replies can be large; never cached server-side)
-            meta, payload = self._servers[sid].call_idempotent(
-                {"op": "pull", "key": self._part_key(key, lo),
-                 "rank": self._rank},
-                dedup=False, on_retry=self._refresh_conn)
-            if meta.get("error"):
-                raise RuntimeError("pull(%r): %s" % (key, meta["error"]))
-            parts.append(np.frombuffer(payload, dtype=meta["dtype"])
-                         .reshape(meta["shape"]))
+        with _tr.span("kv.pull", key=str(key)):
+            _cat.kvstore_pulls.inc(key=str(key))
+            for sid, lo, hi in self._shards_for(key, shape):
+                # pull is a read — naturally idempotent, retried WITHOUT a
+                # dedup stamp (replies can be large; never cached server-side)
+                meta, payload = self._servers[sid].call_idempotent(
+                    {"op": "pull", "key": self._part_key(key, lo),
+                     "rank": self._rank},
+                    dedup=False, on_retry=self._refresh_conn)
+                if meta.get("error"):
+                    raise RuntimeError("pull(%r): %s" % (key, meta["error"]))
+                _cat.kvstore_pull_bytes.inc(len(payload))
+                parts.append(np.frombuffer(payload, dtype=meta["dtype"])
+                             .reshape(meta["shape"]))
         full = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
         import jax.numpy as jnp
         val = jnp.asarray(full)
@@ -330,6 +361,7 @@ class KVStoreDist(KVStore):
             return self.pull(key, out=out, priority=priority)
         self._flush(key)
         from ..ndarray.sparse import RowSparseNDArray
+        _cat.kvstore_pulls.inc(key=str(key))
         rids = np.unique(np.asarray(
             row_ids.asnumpy() if hasattr(row_ids, "asnumpy") else row_ids
         ).ravel().astype(np.int64))
@@ -350,6 +382,7 @@ class KVStoreDist(KVStore):
             if meta.get("error"):
                 raise RuntimeError("row_sparse_pull(%r): %s"
                                    % (key, meta["error"]))
+            _cat.kvstore_pull_bytes.inc(len(payload))
             rows_acc[mask] = np.frombuffer(payload, dtype=meta["dtype"]) \
                 .reshape(meta["shape"])
         import jax.numpy as jnp

@@ -691,6 +691,12 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
         if op == "command":
             if meta.get("command") == "profiler":
                 return _profiler_command(meta)
+            if meta.get("command") == "telemetry":
+                # live metrics snapshot, shipped to the asking worker the
+                # same way profiler dumps are (KVStoreDist.server_telemetry)
+                from .. import telemetry as _tm
+                return ({"ok": True},
+                        _tm.render_json().encode("utf-8"))
             return {"ok": True}, b""
         if op == "shutdown":
             state.done.set()

@@ -24,6 +24,9 @@ import threading
 import time
 import uuid
 
+from ..telemetry import catalog as _cat
+from ..telemetry import metrics as _met
+from ..telemetry import tracing as _tr
 from ..utils import failpoints as _fp
 
 _HDR = struct.Struct("<I")
@@ -39,8 +42,9 @@ class ProtocolError(RuntimeError):
 def send_msg(sock, obj, payload=b""):
     """obj: JSON-serializable metadata dict; payload: raw bytes."""
     meta = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-    sock.sendall(_HDR.pack(len(meta)) + _HDR.pack(len(payload)) + meta
-                 + payload)
+    frame = _HDR.pack(len(meta)) + _HDR.pack(len(payload)) + meta + payload
+    sock.sendall(frame)
+    _cat.rpc_bytes_sent.inc(len(frame))
 
 
 def recv_msg(sock):
@@ -70,6 +74,7 @@ def recv_msg(sock):
         raise ProtocolError("bad metadata frame: %s" % e)
     if not isinstance(meta, dict) or not isinstance(meta.get("op", ""), str):
         raise ProtocolError("metadata must be a JSON object")
+    _cat.rpc_bytes_received.inc(8 + meta_len + payload_len)
     return meta, payload
 
 
@@ -114,12 +119,16 @@ class Connection:
         # after a dropped socket must dedup against the original apply.
         self._client_token = uuid.uuid4().hex
         self._seq = itertools.count(1)
+        self._connected_once = False
 
     def _ensure(self):
         if self._sock is None:
+            if self._connected_once:
+                _cat.rpc_reconnects.inc()
             self._sock = socket.create_connection(self._addr,
                                                   timeout=self._timeout)
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._connected_once = True
 
     def set_addr(self, addr):
         """Repoint at a new peer address (a restarted server comes back on
@@ -133,6 +142,23 @@ class Connection:
                 self._close_locked()
 
     def call(self, obj, payload=b"", timeout=None):
+        if _tr.current() is not None and _tr.TRACE_KEY not in obj:
+            obj = dict(obj)     # don't mutate the caller's meta
+            _tr.inject(obj)
+        if not _met.enabled():
+            return self._call(obj, payload, timeout)
+        op = obj.get("op", "")
+        t0 = time.perf_counter()
+        try:
+            out = self._call(obj, payload, timeout)
+        except Exception:       # noqa: BLE001 — count, then re-raise
+            _cat.rpc_client_requests.inc(op=op, status="error")
+            raise
+        _cat.rpc_client_seconds.observe(time.perf_counter() - t0, op=op)
+        _cat.rpc_client_requests.inc(op=op, status="ok")
+        return out
+
+    def _call(self, obj, payload=b"", timeout=None):
         with self._lock:
             try:
                 self._ensure()
@@ -202,6 +228,7 @@ class Connection:
             except (OSError, ProtocolError):
                 if time.monotonic() + delay > deadline:
                     raise
+                _cat.rpc_retries.inc(op=obj.get("op", ""))
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
                 if on_retry is not None:
@@ -261,6 +288,7 @@ class DedupCache:
             with lock:
                 hit = cache.get(seq)
                 if hit is not None:
+                    _cat.rpc_dedup_hits.inc()
                     return hit
                 out = handler(meta, payload)
                 cache[seq] = out
@@ -336,11 +364,21 @@ class Server:
                 if meta is None:
                     return
                 meta["_peer"] = peer    # server-authoritative, not spoofable
+                op = meta.get("op", "")
+                enabled = _met.enabled()
+                t0 = time.perf_counter() if enabled else 0.0
+                status = "ok"
                 try:
-                    out_meta, out_payload = self._handler(meta, payload)
+                    with _tr.from_meta("rpc." + op, meta, peer=peer):
+                        out_meta, out_payload = self._handler(meta, payload)
                 except Exception as e:   # noqa: BLE001 — reply, don't die
+                    status = "error"
                     out_meta, out_payload = (
                         {"error": "%s: %s" % (type(e).__name__, e)}, b"")
+                if enabled:
+                    _cat.rpc_server_seconds.observe(
+                        time.perf_counter() - t0, op=op)
+                    _cat.rpc_server_requests.inc(op=op, status=status)
                 d = _fp.failpoint("rpc.reply.delay")
                 if d:
                     time.sleep(float(d))

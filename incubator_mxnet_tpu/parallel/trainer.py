@@ -9,6 +9,7 @@ collectives over ICI automatically (GSPMD).
 """
 
 import re
+import time
 
 import numpy as _np
 
@@ -18,6 +19,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..gluon.block import _TraceCtx, _trace_state
 from ..ndarray import NDArray
+from ..telemetry import catalog as _cat
+from ..telemetry import metrics as _met
 
 __all__ = ["ShardedTrainer", "sharding_rules"]
 
@@ -211,6 +214,9 @@ class ShardedTrainer:
         self._label_sharding = NamedSharding(
             mesh, label_spec if label_spec is not None else default_spec)
         self._jit_step = None
+        self._telemetry_labels = {"zero": self._zero1_mode or "off",
+                                  "pipeline": "on" if live_pp else "off"}
+        _cat.install_jax_compile_hook()
 
     @staticmethod
     def _pipeline_axes(block):
@@ -615,6 +621,14 @@ class ShardedTrainer:
             pv, aux_vals, self._opt_state, t, key, *(datas + labels))
         self._param_vals = {**new_params, **new_aux}
         self._opt_state = new_opt if new_opt else self._opt_state
+        if _met.enabled():
+            lbl = self._telemetry_labels
+            _cat.trainer_steps.inc(n_steps, **lbl)
+            if datas and getattr(datas[0], "shape", None):
+                shp = datas[0].shape
+                # per-step-batch mode: leading axis is the scan axis
+                batch = shp[1] if scan_over_batch and len(shp) > 1 else shp[0]
+                _cat.trainer_samples.inc(int(batch) * n_steps)
         return losses
 
     def _prep_batch(self, data, label):
@@ -638,6 +652,7 @@ class ShardedTrainer:
 
     def step(self, data, label, key=None):
         """Run one sharded train step; returns the (device) scalar loss."""
+        t0 = time.perf_counter() if _met.enabled() else None
         datas, labels = self._prep_batch(data, label)
         if self._jit_step is None:
             self._jit_step = self._build(len(datas))
@@ -652,6 +667,12 @@ class ShardedTrainer:
             *datas, *labels)
         self._param_vals = {**new_params, **new_aux}
         self._opt_state = new_opt if new_opt else self._opt_state
+        if t0 is not None:
+            lbl = self._telemetry_labels
+            _cat.trainer_step_seconds.observe(time.perf_counter() - t0, **lbl)
+            _cat.trainer_steps.inc(**lbl)
+            if datas and hasattr(datas[0], "shape") and datas[0].shape:
+                _cat.trainer_samples.inc(int(datas[0].shape[0]))
         return loss
 
     def _inspection_step(self, data, label, key=None):

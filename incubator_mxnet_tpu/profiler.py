@@ -114,9 +114,12 @@ def _record(category, name, ph="i", ts=None, dur=None, args=None):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write the chrome trace. profile_process='server': every server
-    dumps ITS trace server-side AND ships it back — this worker writes
-    each as <filename base>_server<i>.json and returns the paths."""
+    """Write the chrome trace. finished=True (the default) also stops
+    recording, matching the reference's dump(finished) contract; pass
+    finished=False to keep profiling across dumps (continuous dump).
+    profile_process='server': every server dumps ITS trace server-side
+    AND ships it back — this worker writes each as
+    <filename base>_server<i>.json and returns the paths."""
     if profile_process == "server":
         import os
         replies = _server_cmd("dump")
@@ -132,10 +135,23 @@ def dump(finished=True, profile_process="worker"):
         data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:
         json.dump(data, f)
+    if finished:
+        _state["running"] = False
+
+
+_SORT_KEYS = ("total", "count", "min", "max", "avg", "name")
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Aggregate stats table (reference: aggregate_stats.cc dump)."""
+    """Aggregate stats (reference: aggregate_stats.cc dump).
+
+    sort_by: one of total|count|min|max|avg|name; ascending flips the
+    order. format: "table" (aligned text) or "json" (name -> stats)."""
+    if sort_by not in _SORT_KEYS:
+        raise ValueError("sort_by must be one of %s, got %r"
+                         % ("|".join(_SORT_KEYS), sort_by))
+    if format not in ("table", "json"):
+        raise ValueError("format must be 'table' or 'json', got %r" % format)
     with _lock:
         evs = [e for e in _events if e.get("ph") == "X"]
     agg = {}
@@ -146,16 +162,25 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         st["total"] += e["dur"]
         st["min"] = min(st["min"], e["dur"])
         st["max"] = max(st["max"], e["dur"])
-    lines = ["%-40s %8s %12s %12s %12s %12s" % ("Name", "Count",
-                                                "Total(us)", "Min(us)",
-                                                "Max(us)", "Avg(us)")]
-    for name, st in sorted(agg.items(), key=lambda kv: -kv[1]["total"]):
-        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f" % (
-            name, st["count"], st["total"], st["min"], st["max"],
-            st["total"] / st["count"]))
+    for st in agg.values():
+        st["avg"] = st["total"] / st["count"]
+    if sort_by == "name":
+        items = sorted(agg.items(), reverse=not ascending)
+    else:
+        items = sorted(agg.items(), key=lambda kv: kv[1][sort_by],
+                       reverse=not ascending)
     if reset:
         with _lock:
             _events.clear()
+    if format == "json":
+        return json.dumps(dict(items), sort_keys=False)
+    lines = ["%-40s %8s %12s %12s %12s %12s" % ("Name", "Count",
+                                                "Total(us)", "Min(us)",
+                                                "Max(us)", "Avg(us)")]
+    for name, st in items:
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f" % (
+            name, st["count"], st["total"], st["min"], st["max"],
+            st["avg"]))
     return "\n".join(lines)
 
 
@@ -205,16 +230,24 @@ class Counter:
     def __init__(self, name, domain=None, value=0):
         self.name = name
         self.value = value
+        self._lock = threading.Lock()
 
     def set_value(self, value):
-        self.value = value
+        with self._lock:
+            self.value = value
         _record("counter", self.name, ph="C", args={"value": value})
 
     def increment(self, delta=1):
-        self.set_value(self.value + delta)
+        with self._lock:
+            self.value += delta
+            value = self.value
+        _record("counter", self.name, ph="C", args={"value": value})
 
     def decrement(self, delta=1):
-        self.set_value(self.value - delta)
+        with self._lock:
+            self.value -= delta
+            value = self.value
+        _record("counter", self.name, ph="C", args={"value": value})
 
     __iadd__ = lambda self, d: (self.increment(d), self)[1]
     __isub__ = lambda self, d: (self.decrement(d), self)[1]

@@ -1,0 +1,37 @@
+"""Runtime telemetry: metrics registry, exporters, distributed tracing.
+
+The reference framework's only runtime introspection is the profiler
+(src/profiler/profiler.h); serving at the ROADMAP's target scale also
+needs counters/histograms and cross-process causality.  This package
+adds both:
+
+- ``metrics``: thread-safe labeled Counter/Gauge/Histogram registry,
+  near-zero cost when disabled (one flag check per call site).
+- ``export``: Prometheus text exposition + JSON renderers and a
+  periodic flusher driven by ``MXTPU_METRICS_*`` env vars.
+- ``tracing``: ``span()`` context manager whose trace/parent ids ride
+  the RPC meta dict, linking worker and PS-server chrome-trace events.
+- ``catalog``: the framework-wide instrument definitions (RPC, dist
+  kvstore, trainer, dataloader, checkpoint, failpoints).
+
+See docs/OBSERVABILITY.md for the metric catalog and span semantics.
+"""
+
+from . import metrics
+from . import tracing
+from . import export
+from . import catalog
+
+from .metrics import (enable, disable, enabled, counter, gauge, histogram,
+                      snapshot, reset)
+from .export import (render_prometheus, render_json, flush, start_flusher,
+                     stop_flusher)
+from .tracing import span, current, inject, extract, from_meta, merge_traces
+
+__all__ = ["metrics", "tracing", "export", "catalog",
+           "enable", "disable", "enabled", "counter", "gauge", "histogram",
+           "snapshot", "reset",
+           "render_prometheus", "render_json", "flush", "start_flusher",
+           "stop_flusher",
+           "span", "current", "inject", "extract", "from_meta",
+           "merge_traces"]

@@ -1,0 +1,122 @@
+"""Central catalog of framework metric instruments.
+
+Every instrumented layer (kvstore/rpc.py, kvstore/dist.py,
+parallel/trainer.py, gluon/data/dataloader.py, utils/checkpoint.py,
+utils/failpoints.py) imports its instruments from here so the full
+metric surface is one greppable list — docs/OBSERVABILITY.md mirrors
+this catalog.
+
+All instruments are registered at import; registration is cheap and a
+registered-but-disabled instrument never mutates (see metrics.py).
+"""
+
+import threading
+
+from . import metrics as _m
+
+# -- RPC transport (kvstore/rpc.py) ----------------------------------
+rpc_bytes_sent = _m.counter(
+    "mxtpu_rpc_bytes_sent_total", "Wire bytes written by send_msg")
+rpc_bytes_received = _m.counter(
+    "mxtpu_rpc_bytes_received_total", "Wire bytes read by recv_msg")
+rpc_client_requests = _m.counter(
+    "mxtpu_rpc_client_requests_total",
+    "Client RPCs by op and status (ok|error)")
+rpc_client_seconds = _m.histogram(
+    "mxtpu_rpc_client_seconds", "Client RPC round-trip latency by op")
+rpc_retries = _m.counter(
+    "mxtpu_rpc_retries_total", "call_idempotent retry attempts by op")
+rpc_reconnects = _m.counter(
+    "mxtpu_rpc_reconnects_total", "Connection re-establishments after loss")
+rpc_server_requests = _m.counter(
+    "mxtpu_rpc_server_requests_total",
+    "Server-handled RPCs by op and status (ok|error)")
+rpc_server_seconds = _m.histogram(
+    "mxtpu_rpc_server_seconds", "Server handler latency by op")
+rpc_dedup_hits = _m.counter(
+    "mxtpu_rpc_dedup_hits_total",
+    "Idempotent requests answered from the server DedupCache")
+
+# -- dist kvstore (kvstore/dist.py) ----------------------------------
+kvstore_pushes = _m.counter(
+    "mxtpu_kvstore_pushes_total", "KVStoreDist.push calls by key")
+kvstore_pulls = _m.counter(
+    "mxtpu_kvstore_pulls_total", "KVStoreDist.pull calls by key")
+kvstore_push_bytes = _m.counter(
+    "mxtpu_kvstore_push_bytes_total", "Payload bytes pushed to servers")
+kvstore_pull_bytes = _m.counter(
+    "mxtpu_kvstore_pull_bytes_total", "Payload bytes pulled from servers")
+
+# -- trainer (parallel/trainer.py) -----------------------------------
+trainer_steps = _m.counter(
+    "mxtpu_trainer_steps_total",
+    "Optimizer steps by zero/pipeline mode labels")
+trainer_step_seconds = _m.histogram(
+    "mxtpu_trainer_step_seconds", "ShardedTrainer.step wall time")
+trainer_samples = _m.counter(
+    "mxtpu_trainer_samples_total",
+    "Leading-dim samples consumed by step/step_scan (tokens/sec numerator)")
+trainer_jit_compiles = _m.counter(
+    "mxtpu_trainer_jit_compiles_total",
+    "XLA backend_compile events observed via jax.monitoring")
+trainer_jit_compile_seconds = _m.counter(
+    "mxtpu_trainer_jit_compile_seconds_total",
+    "Cumulative XLA backend_compile seconds via jax.monitoring")
+
+# -- data pipeline (gluon/data/dataloader.py) ------------------------
+dataloader_batches = _m.counter(
+    "mxtpu_dataloader_batches_total", "Batches yielded by DataLoader")
+dataloader_wait_seconds = _m.histogram(
+    "mxtpu_dataloader_batch_wait_seconds",
+    "Time the consumer blocked waiting for the next batch")
+dataloader_worker_respawns = _m.counter(
+    "mxtpu_dataloader_worker_respawns_total",
+    "Pool worker processes replaced after dying mid-epoch")
+dataloader_shm_fallbacks = _m.counter(
+    "mxtpu_dataloader_shm_fallbacks_total",
+    "Batches that fell back from the shm ring to pipe transport")
+
+# -- checkpoint (utils/checkpoint.py) --------------------------------
+checkpoint_saves = _m.counter(
+    "mxtpu_checkpoint_saves_total", "Checkpoint writes by status (ok|error)")
+checkpoint_save_seconds = _m.histogram(
+    "mxtpu_checkpoint_save_seconds", "Checkpoint serialize+publish latency")
+checkpoint_restores = _m.counter(
+    "mxtpu_checkpoint_restores_total",
+    "Checkpoint restore attempts by status (ok|error)")
+checkpoint_restore_seconds = _m.histogram(
+    "mxtpu_checkpoint_restore_seconds", "Checkpoint restore latency")
+
+# -- fault injection (utils/failpoints.py) ---------------------------
+failpoints_triggered = _m.counter(
+    "mxtpu_failpoints_triggered_total", "Failpoint firings by name")
+
+
+# -- jax compile hook ------------------------------------------------
+# jax.monitoring calls duration listeners for every instrumented event;
+# we fold the XLA backend-compile ones into the trainer_jit_* counters.
+# Installed once (ShardedTrainer.__init__ calls this); the listener
+# itself is gated by the metrics enabled flag via Counter.inc.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_hook_lock = threading.Lock()
+_hook_state = {"installed": False}
+
+
+def install_jax_compile_hook():
+    """Register a jax.monitoring listener feeding trainer_jit_* metrics."""
+    with _hook_lock:
+        if _hook_state["installed"]:
+            return
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _on_jax_event_duration)
+        except (ImportError, AttributeError):
+            return   # jax too old/new for the monitoring API: skip quietly
+        _hook_state["installed"] = True
+
+
+def _on_jax_event_duration(event, duration, **_kw):
+    if event == _COMPILE_EVENT:
+        trainer_jit_compiles.inc()
+        trainer_jit_compile_seconds.inc(duration)

@@ -1,0 +1,241 @@
+"""Thread-safe labeled metrics registry: Counter / Gauge / Histogram.
+
+Reference parity: the reference framework exposes runtime counters only
+through the profiler's aggregate stats (src/profiler/profiler.h); modern
+serving stacks export Prometheus-style instruments instead.  This module
+is the registry half of that design: named instruments with label sets,
+a process-wide enabled flag, and snapshot() for the exporters in
+telemetry/export.py.
+
+Cost model: every mutator checks the module-level ``_state["enabled"]``
+flag first (same pattern as ``profiler.is_profiling_ops()``), so an
+instrumented call site costs one function call + one dict lookup when
+telemetry is off.  tests/test_telemetry_overhead.py gates this.
+"""
+
+import os
+import threading
+
+__all__ = ["enable", "disable", "enabled", "counter", "gauge", "histogram",
+           "snapshot", "reset", "Counter", "Gauge", "Histogram",
+           "DEFAULT_BUCKETS"]
+
+_state = {"enabled": False}
+_registry = {}          # name -> instrument
+_registry_lock = threading.Lock()
+
+# Latency-oriented seconds buckets: 100us .. 60s, roughly log-spaced.
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                   60.0)
+
+
+def enable():
+    """Turn metric collection on process-wide."""
+    _state["enabled"] = True
+
+
+def disable():
+    _state["enabled"] = False
+
+
+def enabled():
+    """Fast gate for instrumented hot paths."""
+    return _state["enabled"]
+
+
+def _label_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class _Instrument:
+    """Base: a named metric holding one series per label combination."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series = {}   # label-tuple -> value (type-specific)
+
+    def clear(self):
+        with self._lock:
+            self._series = {}
+
+    def labels(self):
+        with self._lock:
+            return list(self._series)
+
+
+class Counter(_Instrument):
+    """Monotonically increasing counter (per label set)."""
+
+    kind = "counter"
+
+    def inc(self, delta=1, **labels):
+        if not _state["enabled"]:
+            return
+        if delta < 0:
+            raise ValueError("Counter.inc: delta must be >= 0, got %r" % delta)
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + delta
+
+    def value(self, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            return self._series.get(key, 0)
+
+    def snapshot(self):
+        with self._lock:
+            return {k: v for k, v in self._series.items()}
+
+
+class Gauge(_Instrument):
+    """Point-in-time value that can go up and down."""
+
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        if not _state["enabled"]:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = value
+
+    def inc(self, delta=1, **labels):
+        if not _state["enabled"]:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + delta
+
+    def dec(self, delta=1, **labels):
+        self.inc(-delta, **labels)
+
+    def value(self, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            return self._series.get(key, 0)
+
+    def snapshot(self):
+        with self._lock:
+            return {k: v for k, v in self._series.items()}
+
+
+class Histogram(_Instrument):
+    """Cumulative-bucket histogram (Prometheus semantics).
+
+    Each series is ``[count, sum, per-bucket counts]`` where bucket i
+    counts observations <= buckets[i]; the implicit +Inf bucket is the
+    total count.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=None):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
+
+    def observe(self, value, **labels):
+        if not _state["enabled"]:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = [0, 0.0, [0] * len(self.buckets)]
+                self._series[key] = st
+            st[0] += 1
+            st[1] += value
+            counts = st[2]
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    counts[i] += 1
+
+    def count(self, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            return st[0] if st else 0
+
+    def sum(self, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            return st[1] if st else 0.0
+
+    def snapshot(self):
+        with self._lock:
+            return {k: [v[0], v[1], list(v[2])]
+                    for k, v in self._series.items()}
+
+
+def _get(cls, name, help, **kwargs):
+    with _registry_lock:
+        inst = _registry.get(name)
+        if inst is not None:
+            if type(inst) is not cls:
+                raise ValueError(
+                    "metric %r already registered as %s, not %s"
+                    % (name, inst.kind, cls.kind))
+            return inst
+        inst = cls(name, help, **kwargs)
+        _registry[name] = inst
+        return inst
+
+
+def counter(name, help=""):
+    """Get or create the named Counter."""
+    return _get(Counter, name, help)
+
+
+def gauge(name, help=""):
+    """Get or create the named Gauge."""
+    return _get(Gauge, name, help)
+
+
+def histogram(name, help="", buckets=None):
+    """Get or create the named Histogram."""
+    return _get(Histogram, name, help, buckets=buckets)
+
+
+def instruments():
+    """All registered instruments, sorted by name."""
+    with _registry_lock:
+        return [v for _, v in sorted(_registry.items())]
+
+
+def reset():
+    """Clear every instrument's series (registrations are kept)."""
+    for inst in instruments():
+        inst.clear()
+
+
+def snapshot():
+    """Plain-dict dump of every instrument, for the JSON exporter.
+
+    Label tuples are rendered as ``k=v,k2=v2`` strings so the result is
+    JSON-serializable.
+    """
+    out = {}
+    for inst in instruments():
+        series = {}
+        for key, val in inst.snapshot().items():
+            skey = ",".join("%s=%s" % kv for kv in key)
+            if inst.kind == "histogram":
+                series[skey] = {"count": val[0], "sum": val[1],
+                                "buckets": dict(zip(
+                                    [str(b) for b in inst.buckets], val[2]))}
+            else:
+                series[skey] = val
+        out[inst.name] = {"kind": inst.kind, "help": inst.help,
+                          "series": series}
+    return out
+
+
+if os.environ.get("MXTPU_METRICS", "") in ("1", "true", "on"):
+    enable()

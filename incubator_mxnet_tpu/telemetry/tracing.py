@@ -1,0 +1,181 @@
+"""Distributed trace spans with cross-process context propagation.
+
+A span is a named, timed region tied to a trace id.  Spans nest through
+a thread-local stack on the worker; crossing a process boundary rides
+the RPC meta dict (kvstore/rpc.py): ``inject()`` stamps the active
+span's ``_trace``/``_pspan`` ids into the outgoing meta, and the server
+handler opens a child span via ``from_meta()``, so worker and server
+events share one trace id and parent/child linkage.
+
+Span timings are recorded as chrome-trace complete events ("ph": "X")
+through ``profiler._record`` with ``trace_id``/``span_id``/``parent_id``
+in ``args`` — so server-side spans ship back inside the existing
+``profiler.dump(profile_process="server")`` payload and can be merged
+into one timeline with ``merge_traces()``.
+
+Cheap when off: ``span()`` returns a shared no-op object unless
+telemetry metrics are enabled, the profiler is running, or a parent
+span is already active (needed so propagated contexts keep linking).
+"""
+
+import json
+import threading
+import time
+import uuid
+
+from .. import profiler
+from . import metrics as _metrics
+
+__all__ = ["span", "from_meta", "current", "inject", "extract",
+           "merge_traces", "Span"]
+
+# RPC meta keys the propagation rides on (underscore-prefixed like the
+# idempotency keys _client/_seq so servers treat them as annotations).
+TRACE_KEY = "_trace"
+PARENT_KEY = "_pspan"
+
+_tls = threading.local()
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _new_id():
+    return uuid.uuid4().hex[:16]
+
+
+def current():
+    """The innermost active Span on this thread, or None."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+class Span:
+    """A timed region; use as a context manager."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs", "_t0")
+
+    def __init__(self, name, trace_id=None, parent_id=None, attrs=None):
+        self.name = name
+        self.trace_id = trace_id or _new_id()
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.attrs = attrs or {}
+        self._t0 = None
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+
+    def __enter__(self):
+        self._t0 = time.time() * 1e6
+        _stack().append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        args = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            args["parent_id"] = self.parent_id
+        if exc_type is not None:
+            args["error"] = exc_type.__name__
+        args.update(self.attrs)
+        profiler._record("span", self.name, ts=self._t0,
+                         dur=time.time() * 1e6 - self._t0, args=args)
+        return False
+
+
+class _NullSpan:
+    """Shared do-nothing span for the disabled path."""
+
+    __slots__ = ()
+    name = None
+    trace_id = None
+    span_id = None
+    parent_id = None
+
+    def set_attr(self, key, value):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _active():
+    if _metrics._state["enabled"] or profiler._state["running"]:
+        return True
+    st = getattr(_tls, "stack", None)
+    return bool(st)
+
+
+def span(name, **attrs):
+    """Open a child span of the current thread context (or a new trace).
+
+    Returns NULL_SPAN when telemetry is fully idle, so instrumented
+    code pays one call + two dict lookups when off.
+    """
+    if not _active():
+        return NULL_SPAN
+    parent = current()
+    if parent is not None and parent.trace_id is not None:
+        return Span(name, trace_id=parent.trace_id,
+                    parent_id=parent.span_id, attrs=attrs)
+    return Span(name, attrs=attrs)
+
+
+def from_meta(name, meta, **attrs):
+    """Server-side child span continuing the trace stamped in an RPC
+    meta dict; NULL_SPAN when the caller sent no context."""
+    trace_id = meta.get(TRACE_KEY)
+    if trace_id is None:
+        return NULL_SPAN
+    return Span(name, trace_id=trace_id, parent_id=meta.get(PARENT_KEY),
+                attrs=attrs)
+
+
+def inject(meta):
+    """Stamp the active span's context into an outgoing RPC meta dict
+    (in place; no-op without an active real span or if already stamped)."""
+    sp = current()
+    if sp is None or sp.trace_id is None or TRACE_KEY in meta:
+        return meta
+    meta[TRACE_KEY] = sp.trace_id
+    meta[PARENT_KEY] = sp.span_id
+    return meta
+
+
+def extract(meta):
+    """(trace_id, parent_span_id) from an RPC meta dict, or (None, None)."""
+    return meta.get(TRACE_KEY), meta.get(PARENT_KEY)
+
+
+def merge_traces(paths, out_path):
+    """Merge chrome-trace JSON dumps (worker + shipped server traces,
+    see profiler.dump(profile_process="server")) into one timeline.
+
+    Each input file's events keep their relative times but get a
+    distinct pid so chrome://tracing shows one row group per process.
+    Returns the merged event list.
+    """
+    merged = []
+    for pid, path in enumerate(paths):
+        with open(path) as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            merged.append(ev)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    return merged

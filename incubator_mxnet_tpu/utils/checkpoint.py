@@ -33,6 +33,8 @@ import weakref
 import numpy as _np
 
 from .. import ndarray as nd
+from ..telemetry import catalog as _cat
+from ..telemetry import metrics as _met
 
 __all__ = ["CheckpointManager"]
 
@@ -168,6 +170,7 @@ class CheckpointManager:
             self._raise_pending()
 
     def _write(self, step, snap, trainer_payload, meta):
+        t0 = time.perf_counter() if _met.enabled() else None
         try:
             final = self._path(step)
             tmp = "%s%s.%d" % (final, _TMP_SUFFIX, os.getpid())
@@ -205,6 +208,12 @@ class CheckpointManager:
             self._prune()
         except BaseException as e:   # re-raised on the caller thread
             self._error = e
+            _cat.checkpoint_saves.inc(status="error")
+        else:
+            if t0 is not None:
+                _cat.checkpoint_save_seconds.observe(
+                    time.perf_counter() - t0)
+            _cat.checkpoint_saves.inc(status="ok")
 
     def _prune(self):
         if self._keep is None:
@@ -232,20 +241,28 @@ class CheckpointManager:
         back as NDArrays. Raises FileNotFoundError when nothing complete
         exists."""
         self.wait()
-        if step is None:
-            step = self.latest_step()
+        t0 = time.perf_counter() if _met.enabled() else None
+        try:
             if step is None:
-                raise FileNotFoundError(
-                    "no complete checkpoint under %s" % self._dir)
-        path = self._path(step)
-        with open(os.path.join(path, "meta.json")) as f:
-            meta = json.load(f)
-        params = nd.load(os.path.join(path, "params"))
-        trainer_payload = None
-        tpath = os.path.join(path, "trainer")
-        if os.path.exists(tpath):
-            with open(tpath, "rb") as f:
-                trainer_payload = f.read()
+                step = self.latest_step()
+                if step is None:
+                    raise FileNotFoundError(
+                        "no complete checkpoint under %s" % self._dir)
+            path = self._path(step)
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+            params = nd.load(os.path.join(path, "params"))
+            trainer_payload = None
+            tpath = os.path.join(path, "trainer")
+            if os.path.exists(tpath):
+                with open(tpath, "rb") as f:
+                    trainer_payload = f.read()
+        except Exception:       # noqa: BLE001 — count, then re-raise
+            _cat.checkpoint_restores.inc(status="error")
+            raise
+        if t0 is not None:
+            _cat.checkpoint_restore_seconds.observe(time.perf_counter() - t0)
+        _cat.checkpoint_restores.inc(status="ok")
         return int(step), params, trainer_payload, meta
 
     def restore_trainer(self, trainer, payload):

@@ -79,6 +79,10 @@ def failpoint(name):
             fp[1] = count - 1
             if fp[1] <= 0:
                 del _ACTIVE[name]
+        # import here, not at module top: firing is rare, and the inactive
+        # fast path above must stay one dict check with no jax baggage
+        from ..telemetry import catalog as _cat
+        _cat.failpoints_triggered.inc(name=name)
         return value
 
 

@@ -1,0 +1,138 @@
+"""Distributed tracing acceptance: one trace id spans a worker `push`
+span and its server-side handler span over a REAL two-process dist
+kvstore, and the two chrome traces merge into a single timeline
+(worker-side profiler dump + shipped server dump, see
+profiler.dump(profile_process='server'))."""
+
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _worker_proc(worker_fn_name, queue):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    fn = globals()[worker_fn_name]
+    try:
+        queue.put((0, fn()))
+    except Exception as e:  # surface failures to the test
+        import traceback
+        queue.put((0, "ERROR: %s\n%s" % (e, traceback.format_exc())))
+
+
+def _traced_push_worker():
+    import json as _json
+    import tempfile
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    from incubator_mxnet_tpu import profiler, telemetry
+    kv = KVStoreDist("dist_sync")
+    profiler.set_kvstore_handle(kv)
+    tmpd = tempfile.mkdtemp(prefix="tmtrace_")
+    worker_file = os.path.join(tmpd, "worker_profile.json")
+    server_file = os.path.join(tmpd, "server_profile.json")
+    profiler.set_config(profile_process="server", filename=server_file)
+    profiler.set_config(filename=worker_file)
+    profiler.start(profile_process="server")
+    profiler.start()
+    telemetry.enable()
+
+    kv.init("w", nd.ones((8,)))
+    with telemetry.span("train.sync") as sp:
+        trace_id = sp.trace_id
+        kv.push("w", nd.ones((8,)) * 3)
+        out = nd.zeros((8,))
+        kv.pull("w", out=out)       # flush point: push applied server-side
+
+    profiler.stop()
+    profiler.stop(profile_process="server")
+    profiler.dump(finished=False)
+    server_paths = profiler.dump(profile_process="server")
+    merged_path = os.path.join(tmpd, "merged.json")
+    merged = telemetry.merge_traces([worker_file] + list(server_paths),
+                                    merged_path)
+    prom = telemetry.render_prometheus()
+    kv.barrier()
+    kv.close()
+    spans = [e for e in merged if e.get("cat") == "span"]
+    return {
+        "trace_id": trace_id,
+        "spans": [(e["name"], e["pid"], e.get("args", {})) for e in spans],
+        "merged_exists": os.path.exists(merged_path),
+        "n_inputs": 1 + len(server_paths),
+        "prom": prom,
+        "pull_ok": out.asnumpy().tolist(),
+    }
+
+
+def _spawn_single_worker_group(worker_fn_name):
+    from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
+                                                         run_server,
+                                                         SchedulerClient)
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    os.environ.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+    })
+    ctx = mp.get_context("spawn")
+    procs = []
+    sched = ctx.Process(target=run_scheduler, args=(port, 1, 1), daemon=True)
+    sched.start()
+    procs.append(sched)
+    time.sleep(0.3)
+    srv = ctx.Process(target=run_server, args=(("127.0.0.1", port), 1),
+                      daemon=True)
+    srv.start()
+    procs.append(srv)
+    queue = ctx.Queue()
+    w = ctx.Process(target=_worker_proc, args=(worker_fn_name, queue),
+                    daemon=True)
+    w.start()
+    _, res = queue.get(timeout=120)
+    w.join(timeout=10)
+    SchedulerClient(("127.0.0.1", port)).shutdown()
+    for p in procs:
+        p.terminate()
+    return res
+
+
+def test_trace_id_spans_worker_and_server():
+    res = _spawn_single_worker_group("_traced_push_worker")
+    assert not (isinstance(res, str) and res.startswith("ERROR")), res
+    np.testing.assert_allclose(res["pull_ok"], [3.0] * 8)
+    assert res["merged_exists"] and res["n_inputs"] == 2
+
+    tid = res["trace_id"]
+    spans = res["spans"]
+    # worker-side push span (pid 0, from KVStoreDist.push) carries the
+    # enclosing train.sync trace id...
+    worker_push = [(n, p, a) for n, p, a in spans
+                   if n == "kv.push" and p == 0]
+    assert worker_push, spans
+    assert worker_push[0][2]["trace_id"] == tid
+    # ...and the server-side handler span (pid 1, from rpc.Server via
+    # the meta-dict propagation) continues the SAME trace
+    server_push = [(n, p, a) for n, p, a in spans
+                   if n == "rpc.push" and p == 1]
+    assert server_push, spans
+    assert server_push[0][2]["trace_id"] == tid
+    # parent/child linkage: the server span's parent is the worker's
+    # kv.push span
+    assert server_push[0][2]["parent_id"] == worker_push[0][2]["span_id"]
+
+    # prometheus exposition from the live dist run covers the RPC layer
+    prom = res["prom"]
+    assert "mxtpu_rpc_client_requests_total" in prom
+    assert 'op="push"' in prom
+    assert "mxtpu_rpc_bytes_sent_total" in prom
+    assert "mxtpu_kvstore_pushes_total" in prom

@@ -1,0 +1,60 @@
+"""Tier-1 overhead gate: the disabled-telemetry path must reduce to one
+predicate check per instrumented call — no allocation, no locking, no
+recording. Verified by a generous wall-clock bound (CI boxes are noisy;
+the real disabled cost is ~100ns/call, the bound allows 50x that)."""
+
+import time
+
+from incubator_mxnet_tpu import profiler, telemetry
+from incubator_mxnet_tpu.telemetry import tracing
+
+N = 100_000
+MAX_SECONDS_PER_CALL = 5e-6     # 50x headroom over the measured cost
+
+
+def _per_call(fn):
+    t0 = time.perf_counter()
+    for _ in range(N):
+        fn()
+    return (time.perf_counter() - t0) / N
+
+
+def test_disabled_counter_is_cheap_and_records_nothing():
+    telemetry.disable()
+    c = telemetry.counter("overhead_counter_total")
+    assert _per_call(c.inc) < MAX_SECONDS_PER_CALL
+    assert c.value() == 0
+
+
+def test_disabled_histogram_is_cheap_and_records_nothing():
+    telemetry.disable()
+    h = telemetry.histogram("overhead_seconds")
+    assert _per_call(lambda: h.observe(0.5)) < MAX_SECONDS_PER_CALL
+    assert h.count() == 0
+
+
+def test_disabled_gauge_is_cheap():
+    telemetry.disable()
+    g = telemetry.gauge("overhead_gauge")
+    assert _per_call(lambda: g.set(1)) < MAX_SECONDS_PER_CALL
+    assert g.value() == 0
+
+
+def test_idle_span_is_shared_noop():
+    telemetry.disable()
+    assert not profiler._state["running"]
+    # no span object churn: every idle span() is the same null object
+    assert telemetry.span("x") is tracing.NULL_SPAN
+    assert _per_call(lambda: telemetry.span("x")) < MAX_SECONDS_PER_CALL
+
+
+def test_enabled_flag_is_single_predicate():
+    """The gate the hot paths check is one dict lookup."""
+    telemetry.disable()
+    assert telemetry.enabled() is False
+    assert _per_call(telemetry.enabled) < MAX_SECONDS_PER_CALL
+    telemetry.enable()
+    try:
+        assert telemetry.enabled() is True
+    finally:
+        telemetry.disable()

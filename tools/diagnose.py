@@ -84,6 +84,26 @@ def main():
     except Exception as e:  # noqa: BLE001 — diagnostics must not crash
         print("mxlint failed:", e)
 
+    section("Telemetry")
+    # live metrics snapshot: in-process state when diagnose runs embedded
+    # (post-mortem in a failing job), plus the exporter configuration
+    try:
+        from incubator_mxnet_tpu import telemetry
+        print("enabled      :", telemetry.enabled())
+        print("export       :",
+              os.environ.get("MXTPU_METRICS_EXPORT", "(unset)"))
+        snap = telemetry.snapshot()
+        nonzero = {k: v["series"] for k, v in snap.items() if v["series"]}
+        print("instruments  : %d registered, %d with data"
+              % (len(snap), len(nonzero)))
+        for name, series in sorted(nonzero.items())[:20]:
+            for labels, val in sorted(series.items())[:4]:
+                if isinstance(val, dict):   # histogram: skip bucket noise
+                    val = "count=%s sum=%.6g" % (val["count"], val["sum"])
+                print("  - %s{%s} = %s" % (name, labels, val))
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("telemetry unavailable:", e)
+
     section("Environment Variables (MXTPU_*/BENCH_*)")
     hits = {k: v for k, v in sorted(os.environ.items())
             if k.startswith(("MXTPU_", "BENCH_", "MXNET_"))}
