@@ -59,6 +59,7 @@ from . import libinfo
 from . import subgraph
 from . import rtc
 from . import parallel
+from . import resilience
 from . import models
 from . import runtime
 from . import profiler

@@ -38,6 +38,7 @@ import weakref as _weakref
 import numpy as _np
 
 from ...ndarray import array as nd_array
+from ...resilience import watchdog as _wd
 from ...telemetry import catalog as _cat
 from ...telemetry import metrics as _met
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
@@ -356,7 +357,15 @@ class DataLoader:
                 result = pending.pop(0)
                 enabled = _met.enabled()
                 t0 = _time.perf_counter() if enabled else 0.0
-                batch = result.get(self._timeout)
+                wd = _wd.current()
+                if wd is not None:
+                    # hang watchdog: a worker that never answers trips
+                    # the "batch_wait" deadline (stack+telemetry dump)
+                    # long before self._timeout (default 600s) gives up
+                    with wd.phase("batch_wait"):
+                        batch = result.get(self._timeout)
+                else:
+                    batch = result.get(self._timeout)
                 if enabled:
                     _cat.dataloader_wait_seconds.observe(
                         _time.perf_counter() - t0)

@@ -24,6 +24,7 @@ import threading
 import time
 import uuid
 
+from ..resilience import watchdog as _wd
 from ..telemetry import catalog as _cat
 from ..telemetry import metrics as _met
 from ..telemetry import tracing as _tr
@@ -145,6 +146,16 @@ class Connection:
         if _tr.current() is not None and _tr.TRACE_KEY not in obj:
             obj = dict(obj)     # don't mutate the caller's meta
             _tr.inject(obj)
+        wd = _wd.current()
+        if wd is not None:
+            # hang watchdog: a peer that stops answering trips the "rpc"
+            # deadline (stack+telemetry dump) even when the socket
+            # timeout is long/None
+            with wd.phase("rpc"):
+                return self._call_metered(obj, payload, timeout)
+        return self._call_metered(obj, payload, timeout)
+
+    def _call_metered(self, obj, payload=b"", timeout=None):
         if not _met.enabled():
             return self._call(obj, payload, timeout)
         op = obj.get("op", "")

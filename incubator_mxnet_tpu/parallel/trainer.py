@@ -207,13 +207,17 @@ class ShardedTrainer:
         default_spec = P(dp_axis) if dp_in_mesh else P()
         if data_specs is None:
             data_specs = default_spec
-        if isinstance(data_specs, (list, tuple)):
+        # a bare PartitionSpec is a tuple subclass on some jax versions:
+        # it means ONE spec for every data array, not a per-array list
+        if isinstance(data_specs, (list, tuple)) \
+                and not isinstance(data_specs, P):
             self._data_shardings = [NamedSharding(mesh, s) for s in data_specs]
         else:
             self._data_shardings = NamedSharding(mesh, data_specs)
         self._label_sharding = NamedSharding(
             mesh, label_spec if label_spec is not None else default_spec)
         self._jit_step = None
+        self._jit_step_guarded = None
         self._telemetry_labels = {"zero": self._zero1_mode or "off",
                                   "pipeline": "on" if live_pp else "off"}
         _cat.install_jax_compile_hook()
@@ -333,7 +337,7 @@ class ShardedTrainer:
         cdt = self._compute_dtype
         accum = self._accum
 
-        def loss_fn(pv, av, data, label, key):
+        def loss_fn(pv, av, data, label, key, scale=None):
             if cdt is not None:
                 data = tuple(d.astype(cdt)
                              if jnp.issubdtype(d.dtype, jnp.floating)
@@ -352,6 +356,11 @@ class ShardedTrainer:
                 out = block.forward(*data)
                 loss = loss_block(out, *label)
                 loss = jnp.mean(loss.astype(jnp.float32))
+                if scale is not None:
+                    # dynamic loss scaling (step_guarded): multiply INSIDE
+                    # the differentiated function so the backward pass runs
+                    # on the scaled loss
+                    loss = loss * scale
             finally:
                 _trace_state.ctx = prev
             new_aux = {n: ctx.aux_updates.get(n, av[n]) for n in aux_names}
@@ -362,47 +371,91 @@ class ShardedTrainer:
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-        def grads_of(param_vals, aux_vals, data, label, key):
+        def grads_of(param_vals, aux_vals, data, label, key, scale=None):
             if accum == 1:
                 (loss, new_aux), grads = grad_fn(param_vals, aux_vals, data,
-                                                 label, key)
-                return grads, new_aux, loss
-            # microbatch scan: split the batch's leading dim and average
-            # the gradients — the optimizer (and its collective traffic
-            # under zero1) runs ONCE per step, not per micro
-            mb = tuple(a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
-                       for a in data + label)
-            keys = jax.random.split(key, accum)
+                                                 label, key, scale)
+            else:
+                # microbatch scan: split the batch's leading dim and average
+                # the gradients — the optimizer (and its collective traffic
+                # under zero1) runs ONCE per step, not per micro
+                mb = tuple(a.reshape((accum, a.shape[0] // accum)
+                                     + a.shape[1:])
+                           for a in data + label)
+                keys = jax.random.split(key, accum)
 
-            def body(carry, xs):
-                g_sum, aux_c, loss_sum = carry
-                k_i, arrs = xs[0], xs[1:]
-                (loss, new_aux), g = grad_fn(param_vals, aux_c,
-                                             arrs[:len(data)],
-                                             arrs[len(data):], k_i)
-                g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g)
-                return (g_sum, new_aux, loss_sum + loss), None
+                def body(carry, xs):
+                    g_sum, aux_c, loss_sum = carry
+                    k_i, arrs = xs[0], xs[1:]
+                    (loss, new_aux), g = grad_fn(param_vals, aux_c,
+                                                 arrs[:len(data)],
+                                                 arrs[len(data):], k_i,
+                                                 scale)
+                    g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g)
+                    return (g_sum, new_aux, loss_sum + loss), None
 
-            # accumulate in fp32 even when params are stored bf16 —
-            # microbatch contributions below one bf16 ulp must not vanish
-            g0 = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape,
-                                    jnp.float32 if jnp.issubdtype(
-                                        p.dtype, jnp.floating) else p.dtype),
-                param_vals)
-            (grads, new_aux, loss), _ = jax.lax.scan(
-                body, (g0, aux_vals, jnp.float32(0)), (keys,) + mb)
-            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
-            return grads, new_aux, loss / accum
+                # accumulate in fp32 even when params are stored bf16 —
+                # microbatch contributions below one bf16 ulp must not
+                # vanish
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape,
+                                        jnp.float32 if jnp.issubdtype(
+                                            p.dtype, jnp.floating)
+                                        else p.dtype),
+                    param_vals)
+                (grads, new_aux, loss), _ = jax.lax.scan(
+                    body, (g0, aux_vals, jnp.float32(0)), (keys,) + mb)
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss / accum
+            if scale is not None:
+                # undo the loss scale on the way out: callers always see
+                # the TRUE loss/grads; an overflowed backward still shows
+                # up as inf/nan (that is the detection signal)
+                inv = 1.0 / scale
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss * inv
+            return grads, new_aux, loss
 
         return grads_of
+
+    def _apply_all(self, param_vals, grads, opt_state, t, upd_key):
+        """Apply the optimizer to every differentiable param — the shared
+        update stage of the plain and guarded step builders. Handles the
+        auto-ZeRO-1 with_sharding_constraint formulation; `upd_key` is the
+        stochastic-rounding key base (None for fp32-stored params)."""
+        auto_zero = self._zero1_mode == "auto"
+        new_params, new_opt = {}, {}
+        for i, n in enumerate(self._diff_names):
+            k_n = (jax.random.fold_in(upd_key, i)
+                   if upd_key is not None else None)
+            st = opt_state.get(n, ())
+            p, g = param_vals[n], grads[n]
+            if auto_zero and self._zero_axes[n] is not None:
+                # ZeRO-1, constraint formulation: pin the grad, the
+                # param copy the optimizer reads, and the opt state to
+                # the dp-sharded layout — GSPMD lowers the dp grad
+                # reduction to reduce-scatter, runs the update on 1/dp
+                # shards, and all-gathers the fresh params back to the
+                # replicated layout pinned on the output
+                zsh = self._zero_shardings[n]
+                g = jax.lax.with_sharding_constraint(g, zsh)
+                p = jax.lax.with_sharding_constraint(p, zsh)
+                st = tuple(jax.lax.with_sharding_constraint(s, zsh)
+                           for s in st)
+                newp, new_st = self._apply_opt(p, g, st, t, key=k_n)
+                newp = jax.lax.with_sharding_constraint(
+                    newp, self._param_shardings[n])
+            else:
+                newp, new_st = self._apply_opt(p, g, st, t, key=k_n)
+            new_params[n] = newp
+            if new_st:
+                new_opt[n] = new_st
+        return new_params, new_opt
 
     def _build_raw(self, n_data_args):
         if self._zero1:
             return self._build_raw_zero1(n_data_args)
-        diff_names = self._diff_names
         grads_of = self._make_grad_stage(n_data_args)
-        auto_zero = self._zero1_mode == "auto"
 
         def step_fn(param_vals, aux_vals, opt_state, t, key, *batch):
             data, label = batch[:n_data_args], batch[n_data_args:]
@@ -411,33 +464,54 @@ class ShardedTrainer:
             # decorrelated key stream for stochastic-rounding write-back
             upd_key = (jax.random.fold_in(key, 0x51A57)
                        if self._param_dtype is not None else None)
-            new_params, new_opt = {}, {}
-            for i, n in enumerate(diff_names):
-                k_n = (jax.random.fold_in(upd_key, i)
-                       if upd_key is not None else None)
-                st = opt_state.get(n, ())
-                p, g = param_vals[n], grads[n]
-                if auto_zero and self._zero_axes[n] is not None:
-                    # ZeRO-1, constraint formulation: pin the grad, the
-                    # param copy the optimizer reads, and the opt state to
-                    # the dp-sharded layout — GSPMD lowers the dp grad
-                    # reduction to reduce-scatter, runs the update on 1/dp
-                    # shards, and all-gathers the fresh params back to the
-                    # replicated layout pinned on the output
-                    zsh = self._zero_shardings[n]
-                    g = jax.lax.with_sharding_constraint(g, zsh)
-                    p = jax.lax.with_sharding_constraint(p, zsh)
-                    st = tuple(jax.lax.with_sharding_constraint(s, zsh)
-                               for s in st)
-                    newp, new_st = self._apply_opt(p, g, st, t, key=k_n)
-                    newp = jax.lax.with_sharding_constraint(
-                        newp, self._param_shardings[n])
-                else:
-                    newp, new_st = self._apply_opt(p, g, st, t, key=k_n)
-                new_params[n] = newp
-                if new_st:
-                    new_opt[n] = new_st
+            new_params, new_opt = self._apply_all(param_vals, grads,
+                                                  opt_state, t, upd_key)
             return new_params, new_aux, new_opt, loss
+
+        return step_fn
+
+    def _build_raw_guarded(self, n_data_args):
+        """Numeric-guarded step (resilience.GuardedTrainer): compute grads
+        under a loss scale, check loss/grad-norm finiteness ON DEVICE, and
+        select between updated and previous state with jnp.where — a
+        skipped step runs the same XLA program (no retrace, composes with
+        donation), and the host learns the verdict from ONE fused scalar
+        read of the stats vector."""
+        if self._zero1:
+            raise NotImplementedError(
+                "step_guarded does not support zero1='manual': the global "
+                "grad norm lives inside the manual dp shard_map region; "
+                "use zero1='auto' with the numeric guard")
+        grads_of = self._make_grad_stage(n_data_args)
+
+        def step_fn(param_vals, aux_vals, opt_state, t, key, scale, *batch):
+            data, label = batch[:n_data_args], batch[n_data_args:]
+            grads, new_aux, loss = grads_of(param_vals, aux_vals, data,
+                                            label, key, scale)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in grads.values()))
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            upd_key = (jax.random.fold_in(key, 0x51A57)
+                       if self._param_dtype is not None else None)
+            new_params, new_opt = self._apply_all(param_vals, grads,
+                                                  opt_state, t, upd_key)
+
+            # skip-step: elementwise select old vs new (both sides already
+            # computed). where, not cond: a NaN in the rejected branch
+            # never reaches the selected values, and select keeps the
+            # donation aliasing of the plain step
+            def sel(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(ok, a, b), new, old)
+            new_params = sel(new_params,
+                             {n: param_vals[n] for n in new_params})
+            new_aux = sel(new_aux, {n: aux_vals[n] for n in new_aux})
+            if new_opt:
+                new_opt = sel(new_opt, {n: opt_state[n] for n in new_opt})
+            stats = jnp.stack([1.0 - ok.astype(jnp.float32), gnorm,
+                               loss.astype(jnp.float32)])
+            return new_params, new_aux, new_opt, loss, stats
 
         return step_fn
 
@@ -675,6 +749,50 @@ class ShardedTrainer:
                 _cat.trainer_samples.inc(int(datas[0].shape[0]))
         return loss
 
+    def step_guarded(self, data, label, loss_scale=1.0, key=None):
+        """One numeric-guarded train step (resilience.GuardedTrainer's
+        primitive). Returns ``(loss, notfinite, grad_norm)``:
+
+        - loss : device scalar, UNSCALED true loss (may be nan/inf when
+          the step was bad);
+        - notfinite : host bool — True means loss or global grad norm was
+          non-finite and the update was SKIPPED on-device (params, aux
+          and optimizer state unchanged);
+        - grad_norm : host float global L2 grad norm (inf/nan on a bad
+          step).
+
+        `loss_scale` multiplies the loss inside the backward (dynamic
+        loss scaling); grads and the returned loss are unscaled. Passed
+        as a traced jnp scalar, so changing it never retraces. Costs one
+        fused 3-float device->host read vs step().
+        """
+        t0 = time.perf_counter() if _met.enabled() else None
+        datas, labels = self._prep_batch(data, label)
+        if self._jit_step_guarded is None:
+            self._jit_step_guarded = jax.jit(
+                self._build_raw_guarded(len(datas)),
+                donate_argnums=(0, 1, 2))
+        if key is None:
+            key = jax.random.PRNGKey(self._step_count)
+        self._step_count += 1
+        t = jnp.float32(self._step_count)
+        pv = {n: self._param_vals[n] for n in self._diff_names}
+        aux_vals = {n: self._param_vals[n] for n in self._aux_names}
+        new_params, new_aux, new_opt, loss, stats = self._jit_step_guarded(
+            pv, aux_vals, self._opt_state, t, key,
+            jnp.float32(loss_scale), *datas, *labels)
+        self._param_vals = {**new_params, **new_aux}
+        self._opt_state = new_opt if new_opt else self._opt_state
+        stats = jax.device_get(stats)   # the ONE host sync of the step
+        if t0 is not None:
+            lbl = self._telemetry_labels
+            _cat.trainer_step_seconds.observe(time.perf_counter() - t0,
+                                              **lbl)
+            _cat.trainer_steps.inc(**lbl)
+            if datas and hasattr(datas[0], "shape") and datas[0].shape:
+                _cat.trainer_samples.inc(int(datas[0].shape[0]))
+        return loss, bool(stats[0] > 0.5), float(stats[1])
+
     def _inspection_step(self, data, label, key=None):
         """Shared no-donation prep: the compiled-step calling convention
         lives HERE and only here. Returns (jitted_fn, args)."""
@@ -710,6 +828,30 @@ class ShardedTrainer:
         return counts, loss
 
     # ------------------------------------------------------- checkpointing
+    def device_snapshot(self):
+        """Copy the full DEVICE-resident training state (params, aux,
+        optimizer slots, step counter) — the resilience rollback ring's
+        primitive. jnp.copy is mandatory: the jitted step donates its
+        inputs, so uncopied references would be invalidated (deleted
+        buffers) by the very next step. No host transfer happens; the
+        copies stay sharded on device."""
+        return {
+            "step": self._step_count,
+            "params": {n: jnp.copy(v) for n, v in self._param_vals.items()},
+            "opt": {n: tuple(jnp.copy(s) for s in st)
+                    for n, st in self._opt_state.items()},
+        }
+
+    def restore_device_snapshot(self, snap):
+        """Rewind to a device_snapshot(). Copies again on the way in, so
+        the ring entry survives the restored state being donated by later
+        steps (one snapshot can be restored repeatedly)."""
+        self._param_vals = {n: jnp.copy(v)
+                            for n, v in snap["params"].items()}
+        self._opt_state = {n: tuple(jnp.copy(s) for s in st)
+                           for n, st in snap["opt"].items()}
+        self._step_count = int(snap["step"])
+
     def state_dict(self):
         """Flat name -> array dict of the FULL training state (params,
         aux, optimizer slots, step counter) for utils.CheckpointManager.

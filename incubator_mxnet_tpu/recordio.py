@@ -13,11 +13,32 @@ import struct
 import numpy as _np
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "CorruptRecordError"]
 
 _MAGIC = 0xced7230a
 _CFLAG_BITS = 29
 _LEN_MASK = (1 << _CFLAG_BITS) - 1
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
+_RESYNC_CHUNK = 1 << 16
+
+
+class CorruptRecordError(IOError):
+    """A corrupt RecordIO region with NO further valid record after it.
+
+    Raised only when the resync scan fails — mid-stream corruption that
+    a later magic survives is skipped (quarantined) instead, counted in
+    ``MXRecordIO.corrupt_skips``/``corrupt_bytes`` and the
+    ``recordio_resyncs``/``recordio_quarantined_bytes`` telemetry.
+
+    Attributes: ``uri`` (the file), ``offset`` (byte position of the
+    first corrupt header).
+    """
+
+    def __init__(self, uri, offset, reason):
+        super().__init__("corrupt RecordIO stream in %s at byte %d (%s): "
+                         "no further record found" % (uri, offset, reason))
+        self.uri = uri
+        self.offset = offset
 
 
 class MXRecordIO:
@@ -28,6 +49,9 @@ class MXRecordIO:
         self.flag = flag
         self.handle = None
         self.writable = None
+        # quarantine stats: corrupt regions skipped by the resync scan
+        self.corrupt_skips = 0
+        self.corrupt_bytes = 0
         self.open()
 
     def open(self):
@@ -94,19 +118,92 @@ class MXRecordIO:
     def read(self):
         assert not self.writable
         if getattr(self, "_native", None) is not None:
-            return self._native.read()
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", header)
-        if magic != _MAGIC:
-            raise IOError("Invalid RecordIO magic in %s" % self.uri)
+            buf = self._native.read()
+            if buf is not None:
+                return buf
+            # the native parser stops (nullptr) at EOF *and* at the
+            # first corrupt header (recordio.cc bails on a magic
+            # mismatch). Position short of the file size = corruption:
+            # hand off to the Python reader at this offset, whose
+            # resync scan below quarantines the region.
+            pos = self._native.tell()
+            if pos >= os.path.getsize(self.uri):
+                return None
+            self._native.close()
+            self._native = None
+            self.handle = open(self.uri, "rb")
+            self.handle.seek(pos)
+        while True:
+            header_pos = self.handle.tell()
+            header = self.handle.read(8)
+            if len(header) < 8:
+                return None                     # clean EOF
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                self._resync(header_pos, "bad magic")
+                continue
+            length = lrec & _LEN_MASK
+            buf = self.handle.read(length)
+            if len(buf) < length:
+                # payload truncated mid-file (or a garbage length word
+                # that happened to sit under a stale magic): quarantine
+                # from this header on
+                self._resync(header_pos, "truncated payload")
+                continue
+            pad = (-length) % 4
+            if pad:
+                self.handle.read(pad)
+            return buf
+
+    def _resync(self, corrupt_pos, reason):
+        """Scan forward from the corrupt header for the next PLAUSIBLE
+        record (a magic whose length word fits in the file and whose end
+        lands on EOF or another magic), seek there, and count the
+        skipped bytes as quarantined. Raises CorruptRecordError when no
+        such record exists before EOF."""
+        size = os.fstat(self.handle.fileno()).st_size
+        # +1: never re-match the corrupt header's own (stale) magic
+        pos = corrupt_pos + 1
+        while pos < size:
+            self.handle.seek(pos)
+            chunk = self.handle.read(_RESYNC_CHUNK + 8)
+            at = 0
+            while True:
+                at = chunk.find(_MAGIC_BYTES, at)
+                if at < 0 or at >= _RESYNC_CHUNK:
+                    break
+                cand = pos + at
+                if self._plausible_record(cand, size):
+                    self.handle.seek(cand)
+                    self.corrupt_skips += 1
+                    self.corrupt_bytes += cand - corrupt_pos
+                    from .telemetry import catalog as _cat
+                    _cat.recordio_resyncs.inc()
+                    _cat.recordio_quarantined_bytes.inc(cand - corrupt_pos)
+                    return
+                at += 1
+            # overlap by 8 so a magic straddling the chunk edge matches
+            pos += _RESYNC_CHUNK
+        raise CorruptRecordError(self.uri, corrupt_pos, reason)
+
+    def _plausible_record(self, cand, size):
+        """A candidate magic is a real record boundary when its length
+        word fits the file AND the record ends at EOF or at another
+        magic (records are magic-delimited back to back — one chance
+        coincidence would need 4 matching bytes at the right offset)."""
+        self.handle.seek(cand)
+        hdr = self.handle.read(8)
+        if len(hdr) < 8:
+            return False
+        _, lrec = struct.unpack("<II", hdr)
         length = lrec & _LEN_MASK
-        buf = self.handle.read(length)
-        pad = (-length) % 4
-        if pad:
-            self.handle.read(pad)
-        return buf
+        end = cand + 8 + length + ((-length) % 4)
+        if end > size:
+            return False
+        if end == size:
+            return True
+        self.handle.seek(end)
+        return self.handle.read(4) == _MAGIC_BYTES
 
     def tell(self):
         if getattr(self, "_native", None) is not None:

@@ -1,0 +1,26 @@
+"""Training resilience: numeric guard, last-good rollback, hang watchdog.
+
+The reference contains failures at the engine level (SURVEY §1:
+per-op error containment); this TPU build's unit of execution is one
+fused XLA step, so containment moves UP — the step either commits or
+is skipped as a whole:
+
+- ``GuardedTrainer`` (guardian.py) — wraps ShardedTrainer: on-device
+  finite-check + skip, dynamic loss scaling, skip budget, rollback
+  policy;
+- ``NumericGuard`` (guard.py) — host loss-scale automaton;
+- ``RollbackRing`` (rollback.py) — bounded device-side snapshot ring;
+- ``Watchdog`` (watchdog.py) — per-phase hang deadlines with
+  stack/telemetry dumps, wired into the dataloader and RPC transport;
+- ``TrainingDivergedError`` — the guardian's give-up signal.
+
+See docs/RESILIENCE.md for the policy matrix and knobs.
+"""
+
+from .guard import NumericGuard, TrainingDivergedError
+from .guardian import GuardedTrainer
+from .rollback import RollbackRing
+from .watchdog import Watchdog, current, format_thread_stacks
+
+__all__ = ["GuardedTrainer", "NumericGuard", "RollbackRing", "Watchdog",
+           "TrainingDivergedError", "current", "format_thread_stacks"]

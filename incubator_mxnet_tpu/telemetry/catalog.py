@@ -91,6 +91,28 @@ checkpoint_restore_seconds = _m.histogram(
 failpoints_triggered = _m.counter(
     "mxtpu_failpoints_triggered_total", "Failpoint firings by name")
 
+# -- resilience (resilience/, recordio.py) ---------------------------
+guard_skipped_steps = _m.counter(
+    "mxtpu_guard_skipped_steps_total",
+    "Optimizer updates skipped by the numeric guard (non-finite "
+    "loss/grad-norm)")
+guard_loss_scale = _m.gauge(
+    "mxtpu_guard_loss_scale", "Current dynamic loss scale")
+guard_rollbacks = _m.counter(
+    "mxtpu_guard_rollbacks_total",
+    "Last-good rewinds by source (ring|checkpoint)")
+rollback_snapshots = _m.counter(
+    "mxtpu_rollback_snapshots_total",
+    "Device-state snapshots taken into the rollback ring")
+watchdog_fires = _m.counter(
+    "mxtpu_watchdog_fires_total", "Watchdog deadline expiries by phase")
+recordio_resyncs = _m.counter(
+    "mxtpu_recordio_resyncs_total",
+    "Corrupt-region skips where the reader resynced to the next magic")
+recordio_quarantined_bytes = _m.counter(
+    "mxtpu_recordio_quarantined_bytes_total",
+    "Bytes skipped over while resyncing past corrupt RecordIO regions")
+
 
 # -- jax compile hook ------------------------------------------------
 # jax.monitoring calls duration listeners for every instrumented event;

@@ -235,35 +235,66 @@ class CheckpointManager:
             raise err
 
     # ------------------------------------------------------------ restore
+    def _load(self, step):
+        """Read one checkpoint dir; any corruption (truncated params npz,
+        unparsable meta.json) surfaces as the underlying exception."""
+        path = self._path(step)
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        params = nd.load(os.path.join(path, "params"))
+        trainer_payload = None
+        tpath = os.path.join(path, "trainer")
+        if os.path.exists(tpath):
+            with open(tpath, "rb") as f:
+                trainer_payload = f.read()
+        return int(step), params, trainer_payload, meta
+
     def restore(self, step=None):
-        """Load checkpoint `step` (default: latest). Returns
+        """Load checkpoint `step` (default: latest readable). Returns
         (step, params_dict, trainer_bytes_or_None, meta_dict); params come
         back as NDArrays. Raises FileNotFoundError when nothing complete
-        exists."""
+        exists.
+
+        With ``step=None``, a latest checkpoint that fails to LOAD
+        (truncated/corrupt despite the atomic-rename publish — e.g. disk
+        damage after the fact) is skipped with a warning and the previous
+        retained step is tried, oldest-last; the original error re-raises
+        only when every retained checkpoint is unreadable. An explicit
+        ``step=`` never falls back."""
         self.wait()
         t0 = time.perf_counter() if _met.enabled() else None
-        try:
-            if step is None:
-                step = self.latest_step()
-                if step is None:
-                    raise FileNotFoundError(
-                        "no complete checkpoint under %s" % self._dir)
-            path = self._path(step)
-            with open(os.path.join(path, "meta.json")) as f:
-                meta = json.load(f)
-            params = nd.load(os.path.join(path, "params"))
-            trainer_payload = None
-            tpath = os.path.join(path, "trainer")
-            if os.path.exists(tpath):
-                with open(tpath, "rb") as f:
-                    trainer_payload = f.read()
-        except Exception:       # noqa: BLE001 — count, then re-raise
-            _cat.checkpoint_restores.inc(status="error")
-            raise
+        if step is not None:
+            try:
+                out = self._load(step)
+            except Exception:   # noqa: BLE001 — count, then re-raise
+                _cat.checkpoint_restores.inc(status="error")
+                raise
+        else:
+            avail = self.steps()
+            if not avail:
+                _cat.checkpoint_restores.inc(status="error")
+                raise FileNotFoundError(
+                    "no complete checkpoint under %s" % self._dir)
+            out, errors = None, []
+            for s in reversed(avail):
+                try:
+                    out = self._load(s)
+                    break
+                except Exception as e:  # noqa: BLE001 — try older steps
+                    errors.append((s, e))
+                    _cat.checkpoint_restores.inc(status="corrupt_skipped")
+                    warnings.warn(
+                        "CheckpointManager(%s): checkpoint step %d is "
+                        "unreadable (%s: %s); falling back to the "
+                        "previous retained step" % (self._dir, s,
+                                                    type(e).__name__, e))
+            if out is None:
+                _cat.checkpoint_restores.inc(status="error")
+                raise errors[0][1]   # the newest checkpoint's error
         if t0 is not None:
             _cat.checkpoint_restore_seconds.observe(time.perf_counter() - t0)
         _cat.checkpoint_restores.inc(status="ok")
-        return int(step), params, trainer_payload, meta
+        return out
 
     def restore_trainer(self, trainer, payload):
         """Feed a restored trainer-states payload back into a Trainer."""

@@ -30,6 +30,10 @@ Activation:
   (default 1), ``count`` the number of times the site may fire before
   deactivating itself (default unlimited), ``value`` what the site
   receives when it fires (float if it parses, else the raw string).
+  The special value ``sleep=SECONDS`` hangs the firing thread inside
+  ``failpoint()`` itself and returns False to the site — any site
+  becomes an injectable hang for watchdog drills, e.g.
+  ``MXTPU_FAILPOINTS=rpc.reply.drop:1:1:sleep=5``.
 
 Known sites (grep for ``failpoint(`` to enumerate):
 
@@ -52,6 +56,7 @@ Known sites (grep for ``failpoint(`` to enumerate):
 import os
 import random
 import threading
+import time
 
 __all__ = ["failpoint", "activate", "deactivate", "reset", "active",
            "is_active", "load_env", "list_active"]
@@ -63,7 +68,13 @@ _ACTIVE = {}
 
 
 def failpoint(name):
-    """Return falsy when inactive; the configured value when firing."""
+    """Return falsy when inactive; the configured value when firing.
+
+    A ``sleep=SECONDS`` value is special: the firing thread sleeps HERE
+    (outside the registry lock, so concurrent failpoint checks never
+    stall behind an injected hang) and the site sees False — any
+    instrumented site doubles as a pure hang point for watchdog drills,
+    with no per-site sleep handling."""
     if not _ACTIVE:
         return False
     with _lock:
@@ -79,16 +90,28 @@ def failpoint(name):
             fp[1] = count - 1
             if fp[1] <= 0:
                 del _ACTIVE[name]
-        # import here, not at module top: firing is rare, and the inactive
-        # fast path above must stay one dict check with no jax baggage
-        from ..telemetry import catalog as _cat
-        _cat.failpoints_triggered.inc(name=name)
-        return value
+    # import here, not at module top: firing is rare, and the inactive
+    # fast path above must stay one dict check with no jax baggage
+    from ..telemetry import catalog as _cat
+    _cat.failpoints_triggered.inc(name=name)
+    if isinstance(value, str) and value.startswith("sleep="):
+        time.sleep(float(value[len("sleep="):]))
+        return False
+    return value
 
 
 def activate(name, prob=1.0, count=None, value=True):
     """Arm `name`: fire with probability `prob`, at most `count` times
-    (None = unlimited), handing `value` to the site."""
+    (None = unlimited), handing `value` to the site. A string value of
+    ``sleep=SECONDS`` makes the firing itself sleep and the site see
+    False (injectable hang; validated here so a typo fails at arm time,
+    not silently mid-chaos-run)."""
+    if isinstance(value, str) and value.startswith("sleep="):
+        try:
+            float(value[len("sleep="):])
+        except ValueError:
+            raise ValueError("bad sleep failpoint value %r "
+                             "(want sleep=SECONDS)" % value)
     with _lock:
         _ACTIVE[name] = [float(prob), count, value]
 

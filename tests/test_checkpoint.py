@@ -312,3 +312,36 @@ def test_sharded_trainer_checkpoint_resume(tmp_path):
         for s in st:
             assert "dp" in str(s.sharding.spec), (n, s.sharding)
     assert n_sh > 0
+
+
+def test_ckpt_manager_restore_falls_back_past_corruption(tmp_path):
+    """restore() with no explicit step skips an unreadable latest
+    checkpoint (post-publish disk damage) and loads the previous
+    retained step; an explicit step= never falls back."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(10, _params(10))
+    mgr.save(20, _params(20))
+    with open(os.path.join(str(tmp_path), "ckpt-%08d" % 20, "params"),
+              "wb") as f:
+        f.write(b"this is not an ndarray file")
+    with pytest.warns(UserWarning, match="step 20 is unreadable"):
+        step, params, _, _ = mgr.restore()
+    assert step == 10
+    np.testing.assert_array_equal(params["w0"].asnumpy(),
+                                  _params(10)["w0"].asnumpy())
+    # the damaged checkpoint stays damaged for direct addressing
+    with pytest.raises(Exception):
+        mgr.restore(step=20)
+
+
+def test_ckpt_manager_restore_all_corrupt_raises_newest_error(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    for step in (1, 2):
+        mgr.save(step, _params(step))
+        with open(os.path.join(str(tmp_path), "ckpt-%08d" % step,
+                               "params"), "wb") as f:
+            f.write(b"garbage")
+    with pytest.warns(UserWarning):
+        with pytest.raises(Exception) as ei:
+            mgr.restore()
+    assert not isinstance(ei.value, FileNotFoundError)

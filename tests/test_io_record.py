@@ -272,3 +272,125 @@ def test_native_pipeline_npy_fallback_records():
         b = it.next()
         want = np.stack(arrs).transpose(0, 3, 1, 2).astype(np.float32)
         np.testing.assert_allclose(b.data[0].asnumpy(), want)
+
+
+# ---------------------------------------------------------------------------
+# corrupt-record quarantine: resync scan, typed error, native handoff
+# ---------------------------------------------------------------------------
+
+import struct
+
+import pytest
+
+from incubator_mxnet_tpu import native, telemetry
+from incubator_mxnet_tpu.recordio import CorruptRecordError
+
+
+def _write_plain_rec(path, n=5, payload_len=9):
+    """n records of distinct, magic-free payloads; with payload_len=9
+    each record occupies exactly 8 + 9 + 3(pad) = 20 bytes."""
+    w = MXRecordIO(path, "w")
+    for i in range(n):
+        w.write(bytes([65 + i]) * payload_len)
+    w.close()
+    return 8 + payload_len + ((-payload_len) % 4)
+
+
+def _force_python_reader(monkeypatch):
+    monkeypatch.setattr(native, "available", lambda: False)
+
+
+def _read_all(rec):
+    out = []
+    while True:
+        buf = rec.read()
+        if buf is None:
+            return out
+        out.append(bytes(buf))
+
+
+def test_resync_skips_corrupt_magic_midstream(tmp_path, monkeypatch):
+    rec_path = str(tmp_path / "c.rec")
+    rec_size = _write_plain_rec(rec_path, n=5)
+    with open(rec_path, "r+b") as f:          # smash record 2's magic
+        f.seek(2 * rec_size)
+        f.write(b"XXXX")
+    _force_python_reader(monkeypatch)
+    r = MXRecordIO(rec_path, "r")
+    got = _read_all(r)
+    assert got == [b"A" * 9, b"B" * 9, b"D" * 9, b"E" * 9]  # C quarantined
+    assert r.corrupt_skips == 1
+    assert r.corrupt_bytes == rec_size        # exactly one record lost
+    r.close()
+
+
+def test_resync_skips_garbage_length_word(tmp_path, monkeypatch):
+    """A corrupt LENGTH under an intact magic claims more bytes than the
+    file holds -> 'truncated payload' -> resync to the next record."""
+    rec_path = str(tmp_path / "l.rec")
+    rec_size = _write_plain_rec(rec_path, n=4)
+    with open(rec_path, "r+b") as f:
+        f.seek(1 * rec_size + 4)
+        f.write(struct.pack("<I", 0x0FFFFFFF))
+    _force_python_reader(monkeypatch)
+    r = MXRecordIO(rec_path, "r")
+    assert _read_all(r) == [b"A" * 9, b"C" * 9, b"D" * 9]
+    assert r.corrupt_skips == 1
+    r.close()
+
+
+def test_corruption_with_no_later_record_raises_typed_error(
+        tmp_path, monkeypatch):
+    rec_path = str(tmp_path / "t.rec")
+    rec_size = _write_plain_rec(rec_path, n=3)
+    corrupt_at = 2 * rec_size                 # the LAST record's header
+    with open(rec_path, "r+b") as f:
+        f.seek(corrupt_at)
+        f.write(b"XXXX")
+    _force_python_reader(monkeypatch)
+    r = MXRecordIO(rec_path, "r")
+    assert r.read() == b"A" * 9
+    assert r.read() == b"B" * 9
+    with pytest.raises(CorruptRecordError) as ei:
+        r.read()
+    assert ei.value.uri == rec_path
+    assert ei.value.offset == corrupt_at
+    assert "bad magic" in str(ei.value)
+    r.close()
+
+
+def test_native_reader_hands_off_to_python_resync(tmp_path):
+    """No monkeypatch: when the native parser is built it bails at the
+    corrupt header mid-file and the wrapper falls back to the Python
+    resync scan at that offset (pure-Python envs exercise the same
+    assertions directly)."""
+    rec_path = str(tmp_path / "n.rec")
+    rec_size = _write_plain_rec(rec_path, n=5)
+    with open(rec_path, "r+b") as f:
+        f.seek(2 * rec_size)
+        f.write(b"XXXX")
+    r = MXRecordIO(rec_path, "r")
+    assert _read_all(r) == [b"A" * 9, b"B" * 9, b"D" * 9, b"E" * 9]
+    assert r.corrupt_skips == 1
+    r.close()
+
+
+def test_resync_telemetry_counters(tmp_path, monkeypatch):
+    from incubator_mxnet_tpu.telemetry import catalog as cat
+    rec_path = str(tmp_path / "m.rec")
+    rec_size = _write_plain_rec(rec_path, n=4)
+    with open(rec_path, "r+b") as f:
+        f.seek(1 * rec_size)
+        f.write(b"XXXX")
+    _force_python_reader(monkeypatch)
+    telemetry.enable()
+    try:
+        base_r = cat.recordio_resyncs.value()
+        base_b = cat.recordio_quarantined_bytes.value()
+        r = MXRecordIO(rec_path, "r")
+        _read_all(r)
+        r.close()
+        assert cat.recordio_resyncs.value() - base_r == 1
+        assert cat.recordio_quarantined_bytes.value() - base_b == rec_size
+    finally:
+        telemetry.disable()

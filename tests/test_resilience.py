@@ -1,0 +1,614 @@
+"""Training-guardian tests: numeric guard (loss scaling, skip-step),
+rollback ring, watchdog deadlines, guard-disabled overhead gate, and the
+combined chaos acceptance run (NaN grads + hung dataloader worker +
+mid-run SIGTERM in ONE subprocess training job)."""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, telemetry
+from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+from incubator_mxnet_tpu.resilience import (GuardedTrainer, NumericGuard,
+                                            RollbackRing,
+                                            TrainingDivergedError, Watchdog)
+from incubator_mxnet_tpu.resilience import watchdog as wd_mod
+from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+
+import jax
+
+
+@pytest.fixture(autouse=True)
+def _no_stray_watchdog():
+    yield
+    w = wd_mod.current()
+    if w is not None:
+        w.stop()
+
+
+def _make_trainer(optimizer="adam", dp=1, **kw):
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(8, 4).astype(np.float32))
+    net(x)
+    loss = gluon.loss.L2Loss()
+    mesh = make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    return ShardedTrainer(net, loss, mesh, optimizer=optimizer, **kw)
+
+
+def _batch(seed=0, bad=False):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 4).astype(np.float32)
+    if bad:
+        x = np.full_like(x, np.nan)
+    return mx.nd.array(x), mx.nd.array(y)
+
+
+def _params(tr):
+    return {n: np.asarray(v) for n, v in tr.param_values.items()}
+
+
+# --------------------------------------------------------------- guard unit
+def test_numeric_guard_scale_automaton():
+    g = NumericGuard(init_scale=1024.0, growth_factor=2.0,
+                     backoff_factor=0.5, growth_interval=3,
+                     min_scale=1.0, max_scale=4096.0)
+    for _ in range(2):
+        g.on_good_step()
+    assert g.scale == 1024.0          # streak not full yet
+    g.on_good_step()
+    assert g.scale == 2048.0          # grew after 3 good steps
+    g.on_bad_step()
+    assert g.scale == 1024.0 and g.good_streak == 0
+    for _ in range(20):
+        g.on_bad_step()
+    assert g.scale == 1.0             # clamped at min_scale
+    g2 = NumericGuard(init_scale=4096.0, growth_interval=1,
+                      max_scale=4096.0)
+    g2.on_good_step()
+    assert g2.scale == 4096.0         # clamped at max_scale
+
+
+def test_numeric_guard_env_defaults(monkeypatch):
+    monkeypatch.setenv("MXTPU_GUARD_INIT_SCALE", "256")
+    monkeypatch.setenv("MXTPU_GUARD_GROWTH_INTERVAL", "7")
+    g = NumericGuard()
+    assert g.scale == 256.0 and g.growth_interval == 7
+    monkeypatch.setenv("MXTPU_GUARD_INIT_SCALE", "nope")
+    with pytest.raises(ValueError, match="MXTPU_GUARD_INIT_SCALE"):
+        NumericGuard()
+
+
+# ------------------------------------------------------------ guarded steps
+def test_nan_batch_skips_update_and_backs_off():
+    tr = _make_trainer()
+    guardian = GuardedTrainer(
+        tr, guard=NumericGuard(init_scale=1024.0),
+        ring=RollbackRing(depth=2, interval=1000),
+        skip_budget=10, rollback_after=100, enabled=True)
+    data, label = _batch(0)
+    guardian.step(data, label)                 # good: prime + compile
+    before = _params(tr)
+    bad_data, _ = _batch(0, bad=True)
+    loss = guardian.step(bad_data, label)      # NaN loss -> skipped
+    assert guardian.skipped_steps == 1
+    assert not math.isfinite(float(jax.device_get(loss)))
+    assert guardian.loss_scale == 512.0        # one backoff
+    after = _params(tr)
+    for n in before:                           # update really skipped
+        assert np.array_equal(before[n], after[n]), n
+    # training continues: a good step after the skip applies normally
+    guardian.step(data, label)
+    assert any(not np.array_equal(after[n], p)
+               for n, p in _params(tr).items())
+
+
+def test_loss_scale_overflow_backs_off_until_finite():
+    tr = _make_trainer()
+    # near-fp32-max init scale + a large-magnitude loss (~1e3): the
+    # SCALED loss overflows to inf, the unscaled loss comes back inf,
+    # the step is skipped, and backoff halves until loss*scale fits
+    guardian = GuardedTrainer(
+        tr, guard=NumericGuard(init_scale=2.0 ** 120, growth_interval=4,
+                               max_scale=2.0 ** 127),
+        ring=RollbackRing(depth=1, interval=10_000),
+        skip_budget=50, rollback_after=100, enabled=True)
+    rng = np.random.RandomState(1)
+    data = mx.nd.array(rng.rand(8, 4).astype(np.float32))
+    label = mx.nd.array((rng.rand(8, 4) * 100.0).astype(np.float32))
+    bad = good = 0
+    for _ in range(20):
+        before = guardian.skipped_steps
+        guardian.step(data, label)
+        if guardian.skipped_steps > before:
+            bad += 1
+        else:
+            good += 1
+            break
+    # the overscaled backward overflowed at least once, every overflow
+    # was skipped (params untouched), and backoff found a working scale
+    assert bad >= 1 and good == 1
+    assert guardian.loss_scale < 2.0 ** 120
+    # growth resumes after growth_interval good steps (fresh guardian in
+    # a safe scale region — at the overflow boundary growth correctly
+    # oscillates: grow, overflow, back off)
+    g2 = GuardedTrainer(tr, guard=NumericGuard(init_scale=64.0,
+                                               growth_interval=2),
+                        ring=RollbackRing(depth=1, interval=10_000),
+                        enabled=True)
+    for _ in range(2):
+        g2.step(data, label)
+    assert g2.loss_scale == 128.0
+
+
+def test_guarded_step_matches_plain_step_when_finite():
+    """Guard on (scale 1.0) must be numerically identical to step()."""
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.nd.array(np.random.RandomState(0).rand(8, 4).astype(np.float32)))
+    loss_fn = gluon.loss.L2Loss()
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr_a = ShardedTrainer(net, loss_fn, mesh, optimizer="adam")
+    tr_b = ShardedTrainer(net, loss_fn, mesh, optimizer="adam")
+    # same block => aliased device buffers; break the aliasing so A's
+    # donated step doesn't delete B's state
+    tr_b.restore_device_snapshot(tr_a.device_snapshot())
+    data, label = _batch(2)
+    key = jax.random.PRNGKey(7)
+    la = jax.device_get(tr_a.step(data, label, key=key))
+    lb, bad, gnorm = tr_b.step_guarded(data, label, loss_scale=1.0, key=key)
+    assert not bad and math.isfinite(gnorm)
+    assert np.allclose(la, jax.device_get(lb), rtol=1e-6)
+    pa, pb = _params(tr_a), _params(tr_b)
+    for n in pa:
+        assert np.allclose(pa[n], pb[n], rtol=1e-6), n
+
+
+def test_guarded_step_auto_zero1():
+    """The guarded step composes with the ZeRO-1 constraint formulation."""
+    tr = _make_trainer(dp=2, zero1="auto")
+    data, label = _batch(3)
+    loss, bad, gnorm = tr.step_guarded(data, label, loss_scale=256.0)
+    assert not bad and math.isfinite(gnorm)
+    bad_data, _ = _batch(3, bad=True)
+    _, bad, _ = tr.step_guarded(bad_data, label)
+    assert bad
+
+
+def test_guarded_step_rejects_manual_zero1():
+    tr = _make_trainer(dp=2, zero1="manual")
+    data, label = _batch(4)
+    with pytest.raises(NotImplementedError, match="manual"):
+        tr.step_guarded(data, label)
+
+
+# ---------------------------------------------------------------- rollback
+def test_rollback_ring_rewinds_to_last_good():
+    tr = _make_trainer()
+    ring = RollbackRing(depth=2, interval=1)
+    guardian = GuardedTrainer(tr, ring=ring, skip_budget=20,
+                              rollback_after=2, enabled=True)
+    data, label = _batch(5)
+    for _ in range(3):
+        guardian.step(data, label)
+    good = _params(tr)
+    good_step = tr._step_count
+    snap_steps = ring.steps()
+    assert snap_steps and snap_steps[-1] == good_step
+    bad_data, _ = _batch(5, bad=True)
+    guardian.step(bad_data, label)             # streak 1
+    assert guardian.rollbacks == 0
+    guardian.step(bad_data, label)             # streak 2 -> rewind
+    assert guardian.rollbacks == 1
+    assert tr._step_count == good_step
+    now = _params(tr)
+    for n in good:
+        assert np.array_equal(good[n], now[n]), n
+    # replay: training continues from the restored state
+    guardian.step(data, label)
+    assert tr._step_count == good_step + 1
+
+
+def test_rollback_falls_back_to_checkpoint_when_ring_dry(tmp_path):
+    tr = _make_trainer()
+    guardian = GuardedTrainer(
+        tr, checkpoint_manager=CheckpointManager(str(tmp_path),
+                                                 async_save=False),
+        ring=RollbackRing(depth=1, interval=10_000),
+        skip_budget=50, rollback_after=1, enabled=True)
+    data, label = _batch(6)
+    guardian.step(data, label)
+    guardian.save_checkpoint()
+    ckpt_step = tr._step_count
+    guardian.step(data, label)
+    bad_data, _ = _batch(6, bad=True)
+    guardian.step(bad_data, label)   # rollback 1: ring (construction snap)
+    assert guardian.rollbacks == 1
+    guardian.step(bad_data, label)   # rollback 2: ring empty -> checkpoint
+    assert guardian.rollbacks == 2
+    assert tr._step_count == ckpt_step
+    meta = json.load(open(os.path.join(
+        tmp_path, "ckpt-%08d" % ckpt_step, "meta.json")))
+    assert meta["guardian"]["enabled"] is True
+    # ring dry + no more checkpoints beyond the restored one is NOT an
+    # error while the restored state yields good steps again
+    guardian.step(data, label)
+    assert guardian.skipped_steps == 2
+
+
+def test_diverged_when_no_rollback_source():
+    tr = _make_trainer()
+    guardian = GuardedTrainer(tr, ring=RollbackRing(depth=1, interval=1000),
+                              skip_budget=50, rollback_after=1, enabled=True)
+    data, label = _batch(7)
+    guardian.step(data, label)
+    bad_data, _ = _batch(7, bad=True)
+    guardian.step(bad_data, label)             # consumes the only snapshot
+    with pytest.raises(TrainingDivergedError, match="no checkpoint_manager"):
+        guardian.step(bad_data, label)
+
+
+def test_skip_budget_exhaustion_raises():
+    tr = _make_trainer()
+    guardian = GuardedTrainer(tr, ring=RollbackRing(depth=2, interval=1),
+                              skip_budget=3, rollback_after=100,
+                              enabled=True)
+    data, label = _batch(8)
+    guardian.step(data, label)
+    bad_data, _ = _batch(8, bad=True)
+    for _ in range(3):
+        guardian.step(bad_data, label)
+    with pytest.raises(TrainingDivergedError, match="skip budget"):
+        guardian.step(bad_data, label)
+
+
+def test_device_snapshot_survives_donation():
+    """Ring snapshots must outlive donated buffers: snapshot, run steps
+    (which donate params), restore, run again, restore AGAIN."""
+    tr = _make_trainer()
+    data, label = _batch(9)
+    tr.step(data, label)
+    snap = tr.device_snapshot()
+    ref = _params(tr)
+    tr.step(data, label)
+    tr.restore_device_snapshot(snap)
+    for n, v in _params(tr).items():
+        assert np.array_equal(ref[n], v), n
+    tr.step(data, label)                       # donates the restored state
+    tr.restore_device_snapshot(snap)           # snapshot still valid
+    for n, v in _params(tr).items():
+        assert np.array_equal(ref[n], v), n
+
+
+# ---------------------------------------------------------------- watchdog
+def test_watchdog_fires_on_expired_phase(tmp_path):
+    dump = str(tmp_path / "wd.txt")
+    wd = Watchdog(poll=0.05, dump_path=dump, install=False)
+    try:
+        with wd.phase("step", timeout=0.1):
+            time.sleep(0.4)
+        assert wd.fired and wd.fired[0][0] == "step"
+        text = open(dump).read()
+        assert "MXTPU WATCHDOG" in text
+        assert "test_watchdog_fires_on_expired_phase" in text  # our stack
+        assert "mxtpu-watchdog" in text         # every thread is dumped
+    finally:
+        wd.stop()
+
+
+def test_watchdog_phase_completes_without_firing():
+    wd = Watchdog(poll=0.02, install=False)
+    try:
+        for _ in range(3):
+            with wd.phase("step", timeout=5.0):
+                time.sleep(0.01)
+        time.sleep(0.1)
+        assert wd.fired == []
+        assert wd._entries == {}               # phases unregistered
+    finally:
+        wd.stop()
+
+
+def test_watchdog_fires_once_per_phase_entry():
+    wd = Watchdog(poll=0.02, install=False)
+    try:
+        with wd.phase("rpc", timeout=0.05):
+            time.sleep(0.3)                    # several poll periods late
+        assert len(wd.fired) == 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_env_configuration(monkeypatch):
+    monkeypatch.setenv("MXTPU_WATCHDOG_STEP_TIMEOUT", "123")
+    monkeypatch.setenv("MXTPU_WATCHDOG_BATCH_TIMEOUT", "45")
+    wd = Watchdog(install=False)
+    try:
+        assert wd._timeouts["step"] == 123.0
+        assert wd._timeouts["batch_wait"] == 45.0
+        assert wd._timeouts["rpc"] == 300.0
+    finally:
+        wd.stop()
+
+
+def test_watchdog_install_current():
+    assert wd_mod.current() is None
+    wd = Watchdog(install=True)
+    try:
+        assert wd_mod.current() is wd
+    finally:
+        wd.stop()
+    assert wd_mod.current() is None
+
+
+def test_watchdog_catches_hung_dataloader_worker():
+    """A dataloader worker stuck in __getitem__ trips the batch_wait
+    deadline long before the loader's own 120s timeout."""
+
+    class SlowDataset(gluon.data.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 0:
+                time.sleep(1.2)
+            return np.full((2,), float(i), dtype=np.float32)
+
+    wd = Watchdog(batch_timeout=0.25, poll=0.05, install=True)
+    try:
+        loader = gluon.data.DataLoader(SlowDataset(), batch_size=4,
+                                       num_workers=1)
+        batches = list(loader)
+        assert len(batches) == 2               # the epoch still completes
+        assert any(ph == "batch_wait" for ph, _, _ in wd.fired)
+    finally:
+        wd.stop()
+
+
+def test_format_thread_stacks_lists_this_frame():
+    text = wd_mod.format_thread_stacks()
+    assert "test_format_thread_stacks_lists_this_frame" in text
+
+
+def test_guardian_step_runs_inside_watchdog_phase():
+    tr = _make_trainer()
+    wd = Watchdog(step_timeout=0.02, poll=0.01, install=False)
+    try:
+        guardian = GuardedTrainer(tr, ring=RollbackRing(depth=1,
+                                                        interval=1000),
+                                  watchdog=wd, skip_budget=5,
+                                  rollback_after=100, enabled=True)
+        data, label = _batch(10)
+        # first step compiles (slow on purpose vs the tiny deadline):
+        # the step phase must fire and training must still proceed
+        guardian.step(data, label)
+        deadline = time.time() + 2.0
+        while not wd.fired and time.time() < deadline:
+            time.sleep(0.02)
+        assert any(ph == "step" for ph, _, _ in wd.fired)
+        assert "watchdog_fired" in guardian.stats()
+    finally:
+        wd.stop()
+
+
+# ----------------------------------------------------------- overhead gate
+def test_guard_disabled_step_overhead(monkeypatch):
+    """MXTPU_GUARD=0: GuardedTrainer.step must reduce to one flag check
+    plus the wrapped trainer's step (same contract as disabled
+    telemetry; bound mirrors tests/test_telemetry_overhead.py)."""
+
+    class StubTrainer:
+        def step(self, data, label, key=None):
+            return 0.0
+
+    monkeypatch.setenv("MXTPU_GUARD", "0")
+    guardian = GuardedTrainer(StubTrainer())
+    assert guardian._enabled is False
+    assert guardian._guard is None             # nothing allocated
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        guardian.step(None, None)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 5e-6                     # 50x headroom, like telemetry
+
+
+def test_guard_disabled_uses_plain_step_path():
+    calls = []
+
+    class StubTrainer:
+        def step(self, data, label, key=None):
+            calls.append("plain")
+            return 1.5
+
+        def step_guarded(self, *a, **kw):       # must never be hit
+            raise AssertionError("guarded path used while disabled")
+
+    guardian = GuardedTrainer(StubTrainer(), enabled=False)
+    assert guardian.step("d", "l") == 1.5
+    assert calls == ["plain"]
+    assert guardian.stats()["enabled"] is False
+
+
+# --------------------------------------------------------------- telemetry
+def test_guard_telemetry_instruments():
+    from incubator_mxnet_tpu.telemetry import catalog as cat
+    telemetry.enable()
+    try:
+        base_skip = cat.guard_skipped_steps.value()
+        base_roll = cat.guard_rollbacks.value(source="ring")
+        base_snap = cat.rollback_snapshots.value()
+        tr = _make_trainer()
+        guardian = GuardedTrainer(
+            tr, guard=NumericGuard(init_scale=64.0),
+            ring=RollbackRing(depth=2, interval=1),
+            skip_budget=20, rollback_after=2, enabled=True)
+        data, label = _batch(11)
+        guardian.step(data, label)
+        bad_data, _ = _batch(11, bad=True)
+        guardian.step(bad_data, label)
+        guardian.step(bad_data, label)         # second bad -> rollback
+        assert cat.guard_skipped_steps.value() - base_skip == 2
+        assert cat.guard_rollbacks.value(source="ring") - base_roll == 1
+        assert cat.rollback_snapshots.value() - base_snap >= 2
+        assert cat.guard_loss_scale.value() == guardian.loss_scale
+    finally:
+        telemetry.disable()
+
+
+# -------------------------------------------------------- chaos acceptance
+_CHAOS_TRAIN = textwrap.dedent("""
+    import json, os, sys, time
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.parallel import make_mesh, ShardedTrainer
+    from incubator_mxnet_tpu.resilience import (GuardedTrainer, NumericGuard,
+                                                RollbackRing, Watchdog)
+    from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+    import jax
+
+    CKPT = sys.argv[1]
+    RESUME = len(sys.argv) > 2 and sys.argv[2] == "resume"
+    TOTAL = 40
+
+    class ChaosDataset(gluon.data.Dataset):
+        # index 96 (batch 12 at batch_size 8) hangs ~1.2s: the "stuck
+        # worker". Data itself stays finite; NaN grads are injected by
+        # the training loop below so they hit exact step numbers.
+        def __len__(self):
+            return 8 * TOTAL
+
+        def __getitem__(self, i):
+            if i == 96 and not RESUME:
+                time.sleep(1.2)
+            rng = np.random.RandomState(i)
+            return (rng.rand(4).astype(np.float32),
+                    rng.rand(4).astype(np.float32))
+
+    def batchify(samples):
+        xs, ys = zip(*samples)
+        return np.stack(xs), np.stack(ys)
+
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.nd.array(np.zeros((8, 4), np.float32)))
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = ShardedTrainer(net, gluon.loss.L2Loss(), mesh,
+                             optimizer="adam",
+                             optimizer_params={"learning_rate": 1e-2})
+    mgr = CheckpointManager(CKPT, async_save=False)
+    wd = Watchdog(batch_timeout=0.3, step_timeout=600, poll=0.05,
+                  install=True)
+    guardian = GuardedTrainer(trainer, checkpoint_manager=mgr,
+                              guard=NumericGuard(init_scale=1024.0),
+                              ring=RollbackRing(depth=2, interval=5),
+                              skip_budget=10, rollback_after=2)
+    uninstall = guardian.install_preemption_handler()
+
+    start = 0
+    if RESUME:
+        step, params, _, meta = mgr.restore()
+        trainer.load_state_dict(params)
+        start = trainer._step_count
+        print("RESUMED", start, json.dumps(meta.get("guardian", {})),
+              flush=True)
+
+    loader = gluon.data.DataLoader(ChaosDataset(), batch_size=8,
+                                   num_workers=1, batchify_fn=batchify)
+    it = iter(loader)
+    for _ in range(start):          # a real sampler would seek; skip
+        next(it)
+    def to_np(a):
+        return a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+
+    step = start
+    last = None
+    for x, y in it:
+        x = to_np(x)
+        # chaos: NaN gradients on two CONSECUTIVE steps every ~20
+        # (20+21, 40+41 would be past the horizon) -> streak hits
+        # rollback_after
+        if not RESUME and step % 20 in (12, 13):
+            x = x * np.float32("nan")
+        last = guardian.step(mx.nd.array(x), mx.nd.array(to_np(y)))
+        step += 1
+        print("STEP", step, float(jax.device_get(last)),
+              guardian.skipped_steps, guardian.rollbacks,
+              len(wd.fired), flush=True)
+        if step >= TOTAL:
+            break
+    print("FINAL", float(jax.device_get(last)), guardian.skipped_steps,
+          guardian.rollbacks, len(wd.fired), flush=True)
+""")
+
+
+def test_chaos_nan_hang_sigterm_resume(tmp_path):
+    """The ISSUE acceptance run: one training job with injected NaN
+    grads (two consecutive, mid-run), a hung dataloader worker, and a
+    mid-run SIGTERM; must skip within budget, roll back at least once,
+    dump from the watchdog, checkpoint on SIGTERM, and a second process
+    must resume from that checkpoint to a finite final loss."""
+    script = tmp_path / "chaos_train.py"
+    script.write_text(_CHAOS_TRAIN)
+    ckpt = str(tmp_path / "ckpt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.getcwd(), PYTHONUNBUFFERED="1")
+    env.pop("MXTPU_FAILPOINTS", None)
+
+    proc = subprocess.Popen([sys.executable, str(script), ckpt],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, env=env, text=True)
+    stats = {}
+    try:
+        for line in proc.stdout:
+            parts = line.split()
+            if parts and parts[0] == "STEP":
+                stats = {"step": int(parts[1]), "loss": float(parts[2]),
+                         "skipped": int(parts[3]), "rollbacks": int(parts[4]),
+                         "wd_fires": int(parts[5])}
+                if stats["step"] == 25:
+                    proc.send_signal(signal.SIGTERM)   # preemption notice
+                    break
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # phase 1 observed every injected fault before the preemption
+    assert stats, "no training steps observed"
+    assert stats["skipped"] >= 2, stats           # NaN steps skipped
+    assert stats["skipped"] <= 10, stats          # within the budget
+    assert stats["rollbacks"] >= 1, stats         # ring rewind happened
+    assert stats["wd_fires"] >= 1, stats          # hung worker caught
+    # SIGTERM handler persisted a checkpoint
+    mgr = CheckpointManager(ckpt, async_save=False)
+    saved = mgr.latest_step()
+    assert saved is not None and saved >= 20
+
+    # phase 2: resume from the preemption checkpoint, finish the run
+    out = subprocess.run([sys.executable, str(script), ckpt, "resume"],
+                         capture_output=True, env=env, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = out.stdout.strip().splitlines()
+    resumed = [l for l in lines if l.startswith("RESUMED")]
+    final = [l for l in lines if l.startswith("FINAL")]
+    assert resumed and int(resumed[0].split()[1]) == saved
+    meta = json.loads(resumed[0].split(None, 2)[2])
+    assert meta.get("skipped_steps", 0) >= 2      # guardian stats traveled
+    assert final, out.stdout[-2000:]
+    final_loss = float(final[0].split()[1])
+    assert math.isfinite(final_loss)
+    assert int(final[0].split()[3]) == 0          # no rollback after resume

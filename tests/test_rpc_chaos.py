@@ -400,3 +400,61 @@ def test_interleaved_chaos_and_real_traffic():
         conn.close()
     finally:
         srv.stop()
+
+
+def test_sleep_failpoint_delays_without_firing():
+    """name:prob:count:sleep=SECONDS stalls the site (simulating a slow
+    disk / network hiccup) but does NOT trigger the fault itself."""
+    srv = _echo_server()
+    try:
+        fp.activate("rpc.send.drop", prob=1.0, count=1, value="sleep=0.4")
+        conn = Connection(srv.addr)
+        t0 = time.monotonic()
+        meta, _ = conn.call({"op": "ping", "x": 1})
+        elapsed = time.monotonic() - t0
+        assert meta["echo"] == 1          # the call SUCCEEDED (no drop)
+        assert elapsed >= 0.4             # ... but was stalled
+        # count=1 exhausted: the next call is fast
+        t0 = time.monotonic()
+        conn.call({"op": "ping", "x": 2})
+        assert time.monotonic() - t0 < 0.3
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_sleep_failpoint_value_validated_at_arm_time():
+    with pytest.raises(ValueError, match="sleep"):
+        fp.activate("rpc.send.drop", value="sleep=not-a-number")
+
+
+def test_watchdog_rpc_phase_fires_on_slow_server():
+    """A peer that stops answering trips the watchdog's rpc deadline:
+    the hang becomes a stack dump while the call itself still completes
+    (recovery stays with the caller's timeout/SIGTERM policy)."""
+    from incubator_mxnet_tpu.resilience import Watchdog
+
+    srv = _echo_server()
+    wd = Watchdog(rpc_timeout=0.2, poll=0.05, install=True)
+    try:
+        conn = Connection(srv.addr)
+        meta, _ = conn.call({"op": "sleep", "seconds": 0.8, "x": 3})
+        assert meta["op"] == "ok"         # slow, not dead
+        deadline = time.time() + 2
+        while not wd.fired and time.time() < deadline:
+            time.sleep(0.02)
+        assert any(ph == "rpc" for ph, _, _ in wd.fired)
+        conn.close()
+    finally:
+        wd.stop()
+        srv.stop()
+
+
+def test_watchdog_not_installed_rpc_path_unaffected():
+    from incubator_mxnet_tpu.resilience import watchdog as wd_mod
+    assert wd_mod.current() is None
+    srv = _echo_server()
+    try:
+        _assert_alive(srv)
+    finally:
+        srv.stop()

@@ -104,6 +104,21 @@ def main():
     except Exception as e:  # noqa: BLE001 — diagnostics must not crash
         print("telemetry unavailable:", e)
 
+    section("Threads")
+    # hang post-mortem: every live thread's stack plus watchdog state —
+    # the same rendering the resilience watchdog dumps on a deadline
+    try:
+        from incubator_mxnet_tpu.resilience import watchdog as wd
+        w = wd.current()
+        print("watchdog     :", "installed" if w is not None else "(none)")
+        if w is not None and w.fired:
+            for phase, tname, overdue in w.fired:
+                print("  fired      : phase %r on %r (+%.1fs)"
+                      % (phase, tname, overdue))
+        print(wd.format_thread_stacks())
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("thread dump failed:", e)
+
     section("Environment Variables (MXTPU_*/BENCH_*)")
     hits = {k: v for k, v in sorted(os.environ.items())
             if k.startswith(("MXTPU_", "BENCH_", "MXNET_"))}
