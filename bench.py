@@ -974,6 +974,116 @@ def bench_zoo_scaling(steps, dtype):
               "model/batch (example/image-classification/README.md:290-319)")
 
 
+def bench_serving():
+    """BENCH_MODEL=serving_bert: sustained QPS and client-observed p99
+    at a fixed latency SLO on the BERT encoder, through the FULL serving
+    plane — RPC transport, continuous batcher, deadline shed — not a
+    bare forward loop. Closed-loop: BENCH_SERVE_CLIENTS concurrent
+    clients each keep one request in flight with `deadline_ms = SLO`,
+    so overload shows up as shed_pct, never as silently blown latency.
+
+    Knobs: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_SECONDS (10 per timed
+    window), BENCH_SERVE_SLO_MS (200), BENCH_SERVE_SEQLEN (64),
+    BENCH_SERVE_WAIT_MS (join window, 2), and BENCH_SERVE_UNITS /
+    BENCH_SERVE_LAYERS to shrink the model for smoke runs (defaults are
+    BERT-base: 768x12)."""
+    import tempfile
+    import threading
+    from incubator_mxnet_tpu import init as mxinit
+    from incubator_mxnet_tpu import nd, serving
+    from incubator_mxnet_tpu.models.bert import BERTModel
+
+    units = int(os.environ.get("BENCH_SERVE_UNITS", "768"))
+    layers = int(os.environ.get("BENCH_SERVE_LAYERS", "12"))
+    seqlen = int(os.environ.get("BENCH_SERVE_SEQLEN", "64"))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    slo_ms = float(os.environ.get("BENCH_SERVE_SLO_MS", "200"))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "10"))
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "2"))
+    cfg = dict(vocab_size=30522, units=units, hidden_size=4 * units,
+               num_layers=layers, num_heads=max(1, units // 64),
+               max_length=max(seqlen, 128))
+
+    model = BERTModel(prefix="bench_serve_", dropout=0.0, **cfg)
+    model.initialize(mxinit.Normal(0.02))
+    model(nd.array(np.zeros((1, 8), np.int32)))
+    ckpt = tempfile.mkdtemp(prefix="bench_serve_")
+    serving.export_for_serving(ckpt, "bert_encoder", cfg, model)
+    srv = serving.ModelServer()
+    srv.load("bert", directory=ckpt, max_wait_ms=wait_ms,
+             buckets=(seqlen,))
+    srv.start()
+
+    rng = np.random.RandomState(0)
+
+    def one_request(client, deadline_ms=None):
+        ids = rng.randint(1, cfg["vocab_size"], (1, seqlen)).astype(
+            np.int32)
+        return client.infer("bert", {"token_ids": ids},
+                            deadline_ms=deadline_ms)
+
+    clients = [serving.ServingClient(srv.addr) for _ in range(n_clients)]
+    try:
+        # warm every compiled shape: occupancy pads rows to powers of
+        # two, so drive full concurrent waves until timings settle
+        for _ in range(3):
+            warm = [threading.Thread(target=one_request, args=(c,))
+                    for c in clients]
+            for t in warm:
+                t.start()
+            for t in warm:
+                t.join()
+
+        repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+        qps, lat_ms, shed = [], [], [0]
+
+        def closed_loop(client, stop_at):
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    one_request(client, deadline_ms=slo_ms)
+                except serving.DeadlineExceeded:
+                    shed[0] += 1
+                    continue
+                lat_ms.append(1e3 * (time.perf_counter() - t0))
+
+        for _ in range(repeats):
+            done_before = len(lat_ms)
+            stop_at = time.perf_counter() + seconds
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=closed_loop,
+                                        args=(c, stop_at))
+                       for c in clients]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            qps.append((len(lat_ms) - done_before)
+                       / (time.perf_counter() - t0))
+
+        qps.sort()
+        med = qps[repeats // 2] if repeats % 2 else \
+            0.5 * (qps[repeats // 2 - 1] + qps[repeats // 2])
+        stats = {"value": med, "repeats": repeats, "min": qps[0],
+                 "max": qps[-1],
+                 "spread_pct": round(100.0 * (qps[-1] - qps[0]) / med, 1)}
+        served_stats = clients[0].stats()["bert"]
+        total = len(lat_ms) + shed[0]
+        return _emit(
+            "serving_bert_sustained_qps", "req/sec", stats,
+            p50_ms=round(float(np.percentile(lat_ms, 50)), 2),
+            p99_ms=round(float(np.percentile(lat_ms, 99)), 2),
+            slo_ms=slo_ms,
+            shed_pct=round(100.0 * shed[0] / max(total, 1), 2),
+            mean_batch_occupancy=served_stats.get("mean_batch_occupancy"),
+            clients=n_clients, seqlen=seqlen,
+            model="bert_%dx%d" % (units, layers))
+    finally:
+        for c in clients:
+            c.close()
+        srv.stop()
+
+
 def _emit_telemetry_summary():
     """Closing JSON line: what the run itself observed — step-time
     histogram stats and the XLA compile tax — so a perf number can be
@@ -1021,6 +1131,8 @@ def _dispatch(model, batch, steps, dtype):
         return bench_fused_block()
     if model == "int8_matmul":
         return bench_int8_matmul()
+    if model == "serving_bert":
+        return bench_serving()
     if model == "ssd":
         return bench_ssd(int(os.environ.get("BENCH_STEPS", "30")), dtype)
     if model == "consistency":

@@ -86,3 +86,4 @@ from . import executor_manager
 from . import test_utils
 from . import torch_bridge as th
 from . import contrib
+from . import serving

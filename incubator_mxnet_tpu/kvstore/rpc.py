@@ -40,6 +40,16 @@ class ProtocolError(RuntimeError):
     pass
 
 
+def _deadline_expired(dl):
+    """True when meta's optional `_deadline` (absolute unix seconds) has
+    passed. Malformed stamps never expire — a bad client field must not
+    silently drop training traffic."""
+    try:
+        return time.time() > float(dl)
+    except (TypeError, ValueError):
+        return False
+
+
 def send_msg(sock, obj, payload=b""):
     """obj: JSON-serializable metadata dict; payload: raw bytes."""
     meta = json.dumps(obj, separators=(",", ":")).encode("utf-8")
@@ -376,6 +386,20 @@ class Server:
                     return
                 meta["_peer"] = peer    # server-authoritative, not spoofable
                 op = meta.get("op", "")
+                dl = meta.get("_deadline")
+                if dl is not None and _deadline_expired(dl):
+                    # Admission control: the client's deadline (absolute
+                    # unix seconds in the meta dict) passed while the
+                    # request was on the wire or queued behind this
+                    # connection — NACK instead of burning handler time
+                    # on a reply nobody is waiting for. The serving
+                    # plane's shed path relies on this; training RPC
+                    # gets it for free.
+                    _cat.rpc_deadline_dropped.inc(op=op)
+                    send_msg(conn, {"error": "DeadlineExceeded: request "
+                                    "_deadline already expired",
+                                    "deadline_exceeded": True}, b"")
+                    continue
                 enabled = _met.enabled()
                 t0 = time.perf_counter() if enabled else 0.0
                 status = "ok"

@@ -1,0 +1,38 @@
+"""serving/ — continuous-batching multi-tenant inference plane.
+
+Takes a trained model from `export_for_serving` (an ordinary
+utils/checkpoint.py directory plus a family/config stanza) to a served
+endpoint over the kvstore RPC fabric:
+
+- `wire`      — array manifest <-> payload framing (no pickling);
+- `scheduler` — shape-bucketed continuous batcher for one-shot forward
+                requests (pad-or-pack, join windows, deadline shed);
+- `kv_cache`  — slot-grid KV/state cache for autoregressive decode;
+- `decode`    — iteration-level join/leave decode loop (Orca-style);
+- `quant`     — optional int8 path for decode matmuls;
+- `loader`    — checkpoint export/load + the model-family registry;
+- `server`    — ModelServer: many models, one RPC endpoint;
+- `client`    — ServingClient: typed calls with wire-level deadlines.
+
+Latency/throughput instruments (p50/p99, QPS, batch occupancy) live in
+telemetry/catalog.py under the `serving_*` names.
+"""
+
+from .client import DeadlineExceeded, ServingClient, ServingError
+from .decode import DecodeLoop, DecodeRequest
+from .kv_cache import KVCache
+from .loader import (SERVING_FAMILIES, ServedModel, export_for_serving,
+                     load_served_model, serving_family)
+from .quant import Int8Dense, int8_serving_enabled
+from .scheduler import (ContinuousBatcher, Request, ShedError, bucket_for,
+                        default_buckets, pad_to_bucket)
+from .server import ModelServer
+
+__all__ = [
+    "ContinuousBatcher", "DeadlineExceeded", "DecodeLoop", "DecodeRequest",
+    "Int8Dense", "KVCache", "ModelServer", "Request", "SERVING_FAMILIES",
+    "ServedModel", "ServingClient", "ServingError", "ShedError",
+    "bucket_for", "default_buckets", "export_for_serving",
+    "int8_serving_enabled", "load_served_model", "pad_to_bucket",
+    "serving_family",
+]

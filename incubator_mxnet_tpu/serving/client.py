@@ -1,0 +1,101 @@
+"""Client for the serving plane: a thin typed wrapper over
+`kvstore.rpc.Connection`.
+
+Deadlines are first-class: ``deadline_ms`` becomes the wire-level
+``_deadline`` meta stamp (absolute unix seconds), so an expired request
+is NACKed by the rpc layer before the handler runs, shed by the
+scheduler if the batch can't make it, and surfaced here as a
+`DeadlineExceeded` carrying the stage that dropped it. One Connection
+serializes its calls — run one client per concurrent request stream
+(that is what the server's continuous batcher coalesces).
+"""
+
+import time
+
+import numpy as np
+
+from ..kvstore.rpc import Connection
+from .scheduler import ShedError
+from .wire import pack_arrays, unpack_arrays
+
+__all__ = ["ServingClient", "ServingError", "DeadlineExceeded"]
+
+
+class ServingError(RuntimeError):
+    pass
+
+
+class DeadlineExceeded(ServingError):
+    def __init__(self, message, stage="unknown"):
+        super().__init__(message)
+        self.stage = stage
+
+
+class ServingClient:
+    def __init__(self, addr, timeout=120.0):
+        self._conn = Connection(addr, timeout=timeout)
+
+    def close(self):
+        self._conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        self.close()
+
+    # ---------------------------------------------------------------- rpc
+    def _call(self, meta, payload=b"", deadline_ms=None):
+        if deadline_ms is not None:
+            meta["_deadline"] = time.time() + float(deadline_ms) / 1e3
+        rmeta, rpayload = self._conn.call(meta, payload)
+        if rmeta.get("shed") or rmeta.get("deadline_exceeded"):
+            raise DeadlineExceeded(rmeta.get("error", "request shed"),
+                                   stage=rmeta.get("shed", "rpc"))
+        if rmeta.get("error"):
+            raise ServingError(rmeta["error"])
+        return rmeta, rpayload
+
+    # ---------------------------------------------------------------- ops
+    def ping(self):
+        meta, _ = self._call({"op": "serve.ping"})
+        return meta
+
+    def models(self):
+        meta, _ = self._call({"op": "serve.models"})
+        return meta["models"]
+
+    def stats(self):
+        meta, _ = self._call({"op": "serve.stats"})
+        return meta["stats"]
+
+    def metrics(self, fmt="prom"):
+        """The server's telemetry export, as text ("prom" or "json")."""
+        _meta, payload = self._call({"op": "serve.metrics", "format": fmt})
+        return payload.decode("utf-8")
+
+    def infer(self, model, arrays, deadline_ms=None):
+        """One-shot forward on `model`. arrays: name -> (rows, ...) array,
+        all with the same leading dim. Returns name -> array."""
+        manifest, payload = pack_arrays(arrays)
+        meta, rpayload = self._call(
+            {"op": "serve.infer", "model": model, "arrays": manifest},
+            payload, deadline_ms=deadline_ms)
+        return unpack_arrays(meta["arrays"], rpayload)
+
+    def decode(self, model, prompt, max_new_tokens=16, eos_id=None,
+               deadline_ms=None):
+        """Greedy-generate after `prompt` (1-D int tokens). Returns the
+        generated int32 token array (eos, when hit, is its last entry)."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        manifest, payload = pack_arrays({"tokens": prompt})
+        req = {"op": "serve.decode", "model": model, "arrays": manifest,
+               "max_new_tokens": int(max_new_tokens)}
+        if eos_id is not None:
+            req["eos_id"] = int(eos_id)
+        meta, rpayload = self._call(req, payload, deadline_ms=deadline_ms)
+        return unpack_arrays(meta["arrays"], rpayload)["tokens"]
+
+
+# re-exported so callers can catch scheduler sheds without importing it
+ServingClient.ShedError = ShedError

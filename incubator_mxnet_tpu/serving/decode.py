@@ -1,0 +1,251 @@
+"""Continuous-batching autoregressive decode over a fixed slot grid.
+
+The encode path (scheduler.py) batches whole requests; generation can't
+— a sequence occupies the batch for many steps and sequences finish at
+different times. This loop implements iteration-level join/leave (the
+Orca scheduling insight): the decode batch is a FIXED grid of KV-cache
+slots, requests are admitted into free slots BETWEEN steps, run however
+many steps they need, and release their slot the moment they finish —
+no waiting for stragglers, no reshaping, one compiled step shape.
+
+The step contract is model-agnostic:
+
+    step_fn(tokens, cache, active) -> logits
+
+with ``tokens (slots,) int32`` (pad token in inactive rows), ``cache``
+the KVCache (the step reads/writes its entries for ALL slots at once —
+inactive rows compute garbage that is never observed), and ``active
+(slots,) bool``. Prompts are prefilled one token per step through the
+same path, so a joining request warms its KV slot without a separate
+prefill program. Greedy argmax sampling — deterministic, which the
+acceptance tests rely on.
+
+Deadline shed: at join the loop estimates ``(prompt+max_new) * EWMA
+(step seconds)``; mid-generation an expired deadline retires the slot
+immediately (stage "decode") instead of finishing a reply nobody will
+read.
+"""
+
+import collections
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..telemetry import catalog as _cat
+from .scheduler import Request
+
+__all__ = ["DecodeRequest", "DecodeLoop"]
+
+
+class DecodeRequest(Request):
+    """Generate up to `max_new_tokens` after `prompt` (1-D int tokens);
+    stops early at `eos_id`. Result: {"tokens": generated int32 array}.
+    """
+
+    def __init__(self, model, prompt, max_new_tokens, eos_id=None,
+                 deadline=None):
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        super().__init__(model, {"tokens": prompt.reshape(1, -1)},
+                         deadline=deadline)
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+
+
+class _Seq:
+    """Per-slot progress: prompt prefill (one token per step), then
+    greedy generation off the model's logits."""
+
+    def __init__(self, req):
+        self.req = req
+        self.fed = 0
+        self.generated = []
+
+    def next_input(self):
+        if self.fed < self.req.prompt.size:
+            return int(self.req.prompt[self.fed])
+        return self.generated[-1]
+
+    def consume(self, logits):
+        """Account one executed step; once the whole prompt is in, the
+        step's logits predict the next token."""
+        self.fed += 1
+        if self.fed >= self.req.prompt.size:
+            self.generated.append(int(np.argmax(logits)))
+
+    @property
+    def finished(self):
+        if len(self.generated) >= self.req.max_new_tokens:
+            return True
+        return (self.req.eos_id is not None and self.generated
+                and self.generated[-1] == self.req.eos_id)
+
+    def steps_remaining(self):
+        return (self.req.prompt.size - self.fed) \
+            + (self.req.max_new_tokens - len(self.generated))
+
+
+class DecodeLoop:
+    """One per served generative model; owns the KVCache exclusively."""
+
+    def __init__(self, name, step_fn, cache, pad_token=0,
+                 max_new_tokens_cap=None):
+        self.name = name
+        self._step_fn = step_fn
+        self._cache = cache
+        self._pad = int(pad_token)
+        self._cap = int(max_new_tokens_cap if max_new_tokens_cap is not None
+                        else os.environ.get("MXTPU_SERVE_MAX_NEW_TOKENS",
+                                            "64"))
+        self._cond = threading.Condition()
+        self._pending = collections.deque()
+        self._active = {}               # slot -> _Seq
+        self._stopping = False
+        self._steps = 0
+        self._ewma_step = None
+        self._thread = threading.Thread(
+            target=self._run, name="serve-decode-%s" % name, daemon=True)
+
+    # ---------------------------------------------------------- admission
+    def submit(self, req):
+        if req.max_new_tokens > self._cap:
+            req.max_new_tokens = self._cap
+        now = time.monotonic()
+        if req.deadline is not None and now >= req.deadline:
+            self._shed(req, "queue", "deadline expired before admission")
+            return req
+        if req.prompt.size + req.max_new_tokens > self._cache.max_len:
+            req.fail(ValueError(
+                "prompt %d + max_new_tokens %d exceeds the KV cache "
+                "max_len %d" % (req.prompt.size, req.max_new_tokens,
+                                self._cache.max_len)))
+            return req
+        with self._cond:
+            if self._stopping:
+                req.fail(RuntimeError("decode loop %r is stopped"
+                                      % self.name))
+                return req
+            self._pending.append(req)
+            self._cond.notify_all()
+        return req
+
+    def _shed(self, req, stage, detail=""):
+        _cat.serving_shed.inc(model=self.name, stage=stage)
+        _cat.serving_requests.inc(model=self.name, status="shed")
+        req.shed(stage, detail)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread.ident is not None:      # started
+            self._thread.join(timeout)
+        with self._cond:
+            while self._pending:
+                self._pending.popleft().fail(
+                    RuntimeError("decode loop %r stopped" % self.name))
+            for slot, seq in list(self._active.items()):
+                seq.req.fail(RuntimeError("decode loop %r stopped"
+                                          % self.name))
+                self._cache.free(slot)
+            self._active.clear()
+
+    def stats(self):
+        with self._cond:
+            return {"pending": len(self._pending),
+                    "active": len(self._active),
+                    "steps": self._steps,
+                    "step_ewma_s": self._ewma_step}
+
+    # -------------------------------------------------------- decode loop
+    def _admit_locked(self):
+        """Join point: fill free slots from the FIFO between steps."""
+        now = time.monotonic()
+        est = self._ewma_step or 0.0
+        while self._pending and self._cache.in_use < self._cache.slots:
+            req = self._pending[0]
+            if req.deadline is not None and \
+                    now + est * (req.prompt.size + req.max_new_tokens) \
+                    > req.deadline:
+                self._pending.popleft()
+                self._shed(req, "join", "full generation can't meet "
+                           "the deadline")
+                continue
+            slot = self._cache.alloc()
+            if slot is None:
+                return
+            self._pending.popleft()
+            self._active[slot] = _Seq(req)
+        _cat.serving_decode_slots.set(len(self._active), model=self.name)
+
+    def _run(self):
+        slots = self._cache.slots
+        while True:
+            with self._cond:
+                while (not self._stopping and not self._pending
+                        and not self._active):
+                    self._cond.wait(0.1)
+                if self._stopping:
+                    return
+                self._admit_locked()
+                active = dict(self._active)
+            if not active:
+                continue
+            tokens = np.full(slots, self._pad, np.int32)
+            mask = np.zeros(slots, bool)
+            for slot, seq in active.items():
+                tokens[slot] = seq.next_input()
+                mask[slot] = True
+            t0 = time.perf_counter()
+            try:
+                logits = np.asarray(self._step_fn(tokens, self._cache,
+                                                  mask))
+            except Exception as e:  # noqa: BLE001 — a broken step fails
+                # the in-flight sequences, not the serving loop
+                with self._cond:
+                    for slot, seq in list(self._active.items()):
+                        _cat.serving_requests.inc(model=self.name,
+                                                  status="error")
+                        seq.req.fail(e)
+                        self._cache.free(slot)
+                    self._active.clear()
+                continue
+            dt = time.perf_counter() - t0
+            self._ewma_step = dt if self._ewma_step is None else \
+                0.7 * self._ewma_step + 0.3 * dt
+            self._steps += 1
+            _cat.serving_decode_steps.inc(model=self.name)
+            _cat.serving_batch_occupancy.observe(len(active),
+                                                 model=self.name)
+            _cat.serving_forward_seconds.observe(dt, model=self.name,
+                                                 bucket="decode")
+            now = time.monotonic()
+            with self._cond:
+                for slot, seq in list(self._active.items()):
+                    seq.consume(logits[slot])
+                    if seq.req.deadline is not None \
+                            and now > seq.req.deadline:
+                        self._shed(seq.req, "decode",
+                                   "deadline passed mid-generation")
+                    elif seq.finished:
+                        _cat.serving_requests.inc(model=self.name,
+                                                  status="ok")
+                        _cat.serving_request_seconds.observe(
+                            now - seq.req.arrival, model=self.name)
+                        seq.req.complete({"tokens": np.asarray(
+                            seq.generated, np.int32)})
+                    else:
+                        continue
+                    self._cache.free(slot)
+                    del self._active[slot]
+                _cat.serving_decode_slots.set(len(self._active),
+                                              model=self.name)

@@ -1,0 +1,120 @@
+"""Slot-granular KV cache for autoregressive decode.
+
+A fixed grid of ``slots`` sequences (the decode batch dimension) over
+two kinds of entries, declared by a spec dict ``name -> (kind, shape,
+dtype)``:
+
+- ``("state", shape)`` — one tensor per slot that is REPLACED each step
+  (LSTM h/c, rolling summaries). Storage ``(slots,) + shape``.
+- ``("kv", per_step_shape)`` — per-position append buffers (attention
+  keys/values). Storage ``(slots, max_len) + per_step_shape``; `append`
+  writes at the slot's current length, `advance` commits the position.
+
+Dense contiguous layout (one ndarray per entry, the whole grid feeds
+the step function as-is) — a paged layout (PagedAttention, Kwon et al.,
+SOSP '23) drops in behind the same alloc/free/append surface when
+ROADMAP item 5 needs fragmentation-free long contexts; at BERT/LSTM
+decode lengths the dense grid wastes at most (max_len - len) rows per
+live slot and zero compile variety (the step shape never changes).
+
+Slot lifecycle is the continuous-batching join/leave contract:
+``alloc`` as a request joins the in-flight batch, ``free`` the moment
+it retires, so the next waiting request reuses the slot between two
+decode steps without reshaping anything.
+"""
+
+import numpy as np
+
+__all__ = ["KVCache"]
+
+_KINDS = ("state", "kv")
+
+
+class KVCache:
+    """Not thread-safe by itself: the decode loop is the single owner
+    (requests never touch the cache directly)."""
+
+    def __init__(self, slots, spec, max_len=512):
+        if slots < 1:
+            raise ValueError("need at least one slot, got %r" % slots)
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.spec = {}
+        self.data = {}
+        for name, ent in spec.items():
+            kind, shape = ent[0], tuple(ent[1])
+            dtype = np.dtype(ent[2]) if len(ent) > 2 else np.float32
+            if kind not in _KINDS:
+                raise ValueError("entry %r: kind must be one of %s, got %r"
+                                 % (name, _KINDS, kind))
+            full = ((self.slots,) + shape if kind == "state"
+                    else (self.slots, self.max_len) + shape)
+            self.spec[name] = (kind, shape, dtype)
+            self.data[name] = np.zeros(full, dtype)
+        self.lengths = np.zeros(self.slots, np.int64)
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._live = set()
+
+    # ------------------------------------------------------------- slots
+    @property
+    def in_use(self):
+        return len(self._live)
+
+    def alloc(self):
+        """Claim a zeroed slot; None when the grid is full."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._live.add(slot)
+        self.lengths[slot] = 0
+        for name, (kind, _shape, _dtype) in self.spec.items():
+            self.data[name][slot] = 0
+        return slot
+
+    def free(self, slot):
+        if slot not in self._live:
+            raise ValueError("slot %r is not live" % slot)
+        self._live.remove(slot)
+        self._free.append(slot)
+
+    # ------------------------------------------------------------ access
+    def _check(self, slot):
+        if slot not in self._live:
+            raise ValueError("slot %r is not live" % slot)
+
+    def set_state(self, name, slot, value):
+        kind, shape, _ = self.spec[name]
+        if kind != "state":
+            raise ValueError("%r is a %r entry, not state" % (name, kind))
+        self._check(slot)
+        self.data[name][slot] = np.asarray(value).reshape(shape)
+
+    def state(self, name, slot):
+        self._check(slot)
+        return self.data[name][slot]
+
+    def append(self, name, slot, value):
+        """Write `value` at this slot's current position (all kv entries
+        share the position counter; call `advance` once per step after
+        every entry is written)."""
+        kind, shape, _ = self.spec[name]
+        if kind != "kv":
+            raise ValueError("%r is a %r entry, not kv" % (name, kind))
+        self._check(slot)
+        pos = int(self.lengths[slot])
+        if pos >= self.max_len:
+            raise ValueError("slot %d is full (max_len=%d)"
+                             % (slot, self.max_len))
+        self.data[name][slot, pos] = np.asarray(value).reshape(shape)
+
+    def advance(self, slot):
+        self._check(slot)
+        self.lengths[slot] += 1
+
+    def prefix(self, name, slot):
+        """The filled (length, ...) view of a kv entry for one slot."""
+        kind = self.spec[name][0]
+        if kind != "kv":
+            raise ValueError("%r is a %r entry, not kv" % (name, kind))
+        self._check(slot)
+        return self.data[name][slot, :int(self.lengths[slot])]

@@ -1,0 +1,219 @@
+"""Checkpoint loading + the serveable-model family registry.
+
+A serving checkpoint is an ordinary `utils/checkpoint.py` directory
+whose ``meta.json`` carries a ``serving`` stanza naming the model
+FAMILY (a registered builder) and its construction config — the same
+atomic-rename/corruption-fallback machinery training already trusts,
+so "deploy" is `export_for_serving(...)` on the trainer side and a
+directory path on the server side. No code rides in the checkpoint:
+the family name is looked up in this process's registry and the params
+are plain tensors, keeping the no-unpickling stance of the RPC layer.
+
+Families map a restored param dict onto the callables the scheduler
+needs: ``encode_fn(arrays, bucket)`` for one-shot forward models
+(batched by scheduler.ContinuousBatcher) and ``step_fn/make_cache``
+for autoregressive ones (driven by decode.DecodeLoop). Two built-ins:
+
+- ``bert_encoder`` — models.bert.BERTModel, returns the pooled
+  embedding (and the full sequence when ``emit_seq`` is set);
+- ``lstm_lm`` — models.lstm_lm.RNNModel step decode with the hidden
+  state living in a KVCache state grid; its vocab projection runs int8
+  (serving.quant.Int8Dense) when quantization is on.
+"""
+
+import numpy as np
+
+from .. import init as _init
+from .. import ndarray as nd
+from ..utils.checkpoint import CheckpointManager
+from .kv_cache import KVCache
+from .quant import Int8Dense, int8_serving_enabled
+
+__all__ = ["ServedModel", "serving_family", "export_for_serving",
+           "load_served_model", "SERVING_FAMILIES"]
+
+SERVING_FAMILIES = {}
+
+
+def serving_family(name):
+    """Register ``builder(config, params, quantize) -> ServedModel``."""
+    def wrap(builder):
+        if name in SERVING_FAMILIES:
+            raise ValueError("serving family %r already registered" % name)
+        SERVING_FAMILIES[name] = builder
+        return builder
+    return wrap
+
+
+class ServedModel:
+    """What a family builder hands the server: the forward surfaces plus
+    the construction facts the scheduler needs."""
+
+    def __init__(self, family, config, encode_fn=None, step_fn=None,
+                 make_cache=None, pad_token=0, quantized=False):
+        if encode_fn is None and step_fn is None:
+            raise ValueError("a ServedModel needs encode_fn, step_fn, "
+                             "or both")
+        if (step_fn is None) != (make_cache is None):
+            raise ValueError("step_fn and make_cache come together")
+        self.family = family
+        self.config = dict(config)
+        self.encode_fn = encode_fn
+        self.step_fn = step_fn
+        self.make_cache = make_cache
+        self.pad_token = int(pad_token)
+        self.quantized = bool(quantized)
+
+    @property
+    def has_encode(self):
+        return self.encode_fn is not None
+
+    @property
+    def has_decode(self):
+        return self.step_fn is not None
+
+
+# ------------------------------------------------------------ export/load
+def export_for_serving(directory, family, config, model):
+    """Write a serving checkpoint: the model's params (hierarchical
+    `_collect_params_with_prefix` names — prefix-independent, so the
+    server rebuilds under any name scope) plus the family/config stanza.
+    """
+    if family not in SERVING_FAMILIES:
+        raise ValueError("unknown serving family %r (registered: %s)"
+                         % (family, sorted(SERVING_FAMILIES)))
+    params = {k: v.data() for k, v
+              in model._collect_params_with_prefix().items()}
+    mgr = CheckpointManager(directory, keep=None, async_save=False,
+                            prefix="serve")
+    mgr.save(0, params, extra={"serving": {"family": family,
+                                           "config": dict(config)}})
+    return directory
+
+
+def load_served_model(directory, quantize=None):
+    """Restore the newest serving checkpoint in `directory` and build
+    its family. ``quantize=None`` follows MXTPU_SERVE_INT8."""
+    mgr = CheckpointManager(directory, keep=None, async_save=False,
+                            prefix="serve")
+    _step, params, _trainer, meta = mgr.restore()
+    info = meta.get("serving")
+    if not isinstance(info, dict) or "family" not in info:
+        raise ValueError("checkpoint under %r has no serving stanza — "
+                         "export it with export_for_serving()" % directory)
+    family = info["family"]
+    builder = SERVING_FAMILIES.get(family)
+    if builder is None:
+        raise ValueError("serving family %r is not registered in this "
+                         "process" % family)
+    if quantize is None:
+        quantize = int8_serving_enabled()
+    return builder(dict(info.get("config") or {}), params, bool(quantize))
+
+
+def _set_params(model, params):
+    """Copy a restored param dict into a freshly built (materialized)
+    model; every model param must be present in the checkpoint."""
+    targets = model._collect_params_with_prefix()
+    missing = sorted(set(targets) - set(params))
+    if missing:
+        raise IOError("serving checkpoint is missing params: %s"
+                      % ", ".join(missing[:8]))
+    for name, p in targets.items():
+        p.set_data(nd.array(params[name]))
+
+
+# ------------------------------------------------------- builtin families
+@serving_family("bert_encoder")
+def _build_bert_encoder(config, params, quantize):
+    """One-shot BERT forward. Inputs: token_ids (B,T) int32; optional
+    token_types (B,T) int32 and valid_mask (B,T) float (zero-padded to
+    the bucket, so padding is masked for free). Output: pooled (B,units)
+    [+ seq (B,T,units) when config emit_seq]."""
+    from ..models.bert import BERTModel
+    cfg = dict(vocab_size=int(config.get("vocab_size", 30522)),
+               units=int(config.get("units", 768)),
+               hidden_size=int(config.get("hidden_size", 3072)),
+               num_layers=int(config.get("num_layers", 12)),
+               num_heads=int(config.get("num_heads", 12)),
+               max_length=int(config.get("max_length", 512)),
+               dropout=0.0)
+    model = BERTModel(prefix="serve_bert_", **cfg)
+    model.initialize(_init.Normal(0.02))
+    model(nd.array(np.zeros((1, 8), np.int32)))   # materialize shapes
+    _set_params(model, params)
+    emit_seq = bool(config.get("emit_seq", False))
+
+    def encode(arrays, _bucket):
+        ids = nd.array(np.asarray(arrays["token_ids"], np.int32))
+        types = (nd.array(np.asarray(arrays["token_types"], np.int32))
+                 if "token_types" in arrays else None)
+        mask = (nd.array(np.asarray(arrays["valid_mask"], np.float32))
+                if "valid_mask" in arrays else None)
+        seq, pooled = model(ids, types, mask)
+        out = {"pooled": pooled.asnumpy()}
+        if emit_seq:
+            out["seq"] = seq.asnumpy()
+        return out
+
+    return ServedModel("bert_encoder", config, encode_fn=encode,
+                       quantized=False)
+
+
+@serving_family("lstm_lm")
+def _build_lstm_lm(config, params, quantize):
+    """Autoregressive word-LM step decode. The recurrent state (h, c per
+    layer) lives in the KVCache state grid — one row per slot — so
+    sequences join and leave the fixed decode batch between steps. With
+    `quantize`, the (V, H) vocab projection — the decode-dominant
+    matmul — runs through Int8Dense."""
+    from ..models.lstm_lm import RNNModel
+    mode = str(config.get("mode", "lstm"))
+    layers = int(config.get("num_layers", 2))
+    hidden = int(config.get("num_hidden", 650))
+    cfg = dict(mode=mode, vocab_size=int(config.get("vocab_size", 10000)),
+               num_embed=int(config.get("num_embed", hidden)),
+               num_hidden=hidden, num_layers=layers, dropout=0.0,
+               tie_weights=bool(config.get("tie_weights", False)))
+    model = RNNModel(prefix="serve_lm_", **cfg)
+    model.initialize(_init.Normal(0.02))
+    model(nd.array(np.zeros((1, 2), np.int32)),
+          model.begin_state(batch_size=2))      # materialize shapes
+    _set_params(model, params)
+
+    n_states = 2 if mode == "lstm" else 1       # (h, c) vs h only
+    state_names = ("h", "c")[:n_states]
+    int8_head = None
+    if quantize:
+        w = model.decoder.weight.data().asnumpy()
+        b = (model.decoder.bias.data().asnumpy()
+             if model.decoder.bias is not None else None)
+        int8_head = Int8Dense(w, b)
+
+    def make_cache(slots, max_len):
+        return KVCache(slots, {s: ("state", (layers, hidden))
+                               for s in state_names}, max_len=max_len)
+
+    def step(tokens, cache, _active):
+        s = tokens.shape[0]
+        inp = nd.array(tokens.reshape(1, s))
+        states = [nd.array(np.ascontiguousarray(
+            cache.data[name].transpose(1, 0, 2))) for name in state_names]
+        if int8_head is None:
+            logits, out_states = model(inp, states)
+            out = logits.asnumpy()[0]                       # (S, V)
+        else:
+            emb = model.encoder(inp)
+            rnn_out, out_states = model.rnn(emb, states)
+            out = int8_head(rnn_out.asnumpy().reshape(s, hidden))
+        for name, st in zip(state_names, out_states):
+            # mxlint: disable=host-sync-loop — the KV cache is
+            # host-resident by design (slot join/leave mutates it
+            # between steps); this is <=2 tiny (layers, B, H) reads
+            # per decode step, not a training hot loop
+            cache.data[name][:] = st.asnumpy().transpose(1, 0, 2)
+        return out
+
+    return ServedModel("lstm_lm", config, step_fn=step,
+                       make_cache=make_cache, pad_token=0,
+                       quantized=bool(quantize))

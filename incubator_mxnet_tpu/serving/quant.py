@@ -1,0 +1,77 @@
+"""Optional int8 path for the serving decode matmuls.
+
+The dominant decode-time matmul (the vocab projection: hidden x (V,
+units)) runs int8 x int8 -> int32 on the MXU instead of fp32: the
+weight is quantized ONCE at model-load time (symmetric, zero-point-free
+— `ops.quantization.quantize_v2`), activations are quantized per call,
+the accumulate goes through `quantized_fully_connected` (lax.dot_general
+with int8 operands, int32 accumulation) or the Pallas `int8_matmul`
+kernel when its tiling contract holds on this backend, and the int32
+accumulator is rescaled back to fp32. The fp32 bias is added after
+dequantization — exact, and it keeps the quantization error confined to
+the product term.
+
+Enabled per model via ``load(..., quantize=True)`` or globally with
+``MXTPU_SERVE_INT8=1``. Weight-reconstruction error is bounded by the
+symmetric-127 grid (~0.4% of the per-tensor amax); the serving tests
+check end-to-end logit agreement against the fp32 path.
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["Int8Dense", "int8_serving_enabled"]
+
+
+def int8_serving_enabled():
+    return os.environ.get("MXTPU_SERVE_INT8", "0") in ("1", "true", "on")
+
+
+class Int8Dense:
+    """Drop-in for ``x @ W.T + b`` with a pre-quantized weight.
+
+    weight : (out, in) float array; bias : (out,) or None.
+    __call__(x) with x (rows, in) float32 -> (rows, out) float32.
+    """
+
+    def __init__(self, weight, bias=None):
+        import jax.numpy as jnp
+        from ..ops.quantization import quantize_v2
+        w = jnp.asarray(np.asarray(weight, np.float32))
+        qw, _wmin, wmax = quantize_v2(w)
+        self._qw = qw                              # (out, in) int8
+        self._w_amax = float(wmax)
+        self._bias = (np.asarray(bias, np.float32)
+                      if bias is not None else None)
+        self.out_features, self.in_features = w.shape
+
+    def _accumulate(self, qx):
+        """(rows, in) int8 -> (rows, out) int32, Pallas MXU kernel when
+        the grid tiles, XLA dot_general otherwise."""
+        import jax.numpy as jnp
+        from ..ops.pallas.int8_matmul import (int8_matmul,
+                                              int8_matmul_available)
+        rows = qx.shape[0]
+        if (int8_matmul_available() and rows % 128 == 0
+                and self.out_features % 128 == 0):
+            return int8_matmul(qx, jnp.transpose(self._qw),
+                               block_m=min(512, rows),
+                               block_n=min(512, self.out_features))
+        from jax import lax
+        return lax.dot_general(qx, self._qw, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from ..ops.quantization import quantize_v2
+        x = jnp.asarray(np.asarray(x, np.float32))
+        qx, _xmin, xmax = quantize_v2(x)
+        acc = self._accumulate(qx)
+        # one int32 unit = (x_amax/127) * (w_amax/127)
+        scale = (jnp.asarray(xmax, jnp.float32) * self._w_amax) \
+            / (127.0 * 127.0)
+        y = acc.astype(jnp.float32) * scale
+        if self._bias is not None:
+            y = y + jnp.asarray(self._bias)
+        return np.asarray(y)

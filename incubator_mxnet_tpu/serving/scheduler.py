@@ -1,0 +1,365 @@
+"""Deadline-aware continuous batcher — the serve-side throughput core.
+
+Orca-style continuous batching (Yu et al., OSDI '22) at iteration
+granularity: the batch worker never waits for a "full" batch. Between
+forward steps it takes whatever is queued — up to ``max_batch`` rows
+from ONE shape bucket — pads each request to the bucket edge, stacks
+them into a single forward call, and scatters the output rows back to
+their waiting RPC handlers. Requests that arrive while a forward step
+is running join the next step, so under load the batch refills every
+iteration instead of draining to one row.
+
+Shape buckets (pad-or-pack): variable-length requests are grouped by
+the smallest configured bucket >= their sequence length, so XLA
+compiles one program per (bucket, padded-batch) pair instead of one per
+exact shape. The padded-batch dimension is also bucketed to powers of
+two, bounding compile count at O(|buckets| * log max_batch).
+
+Deadline shed (vLLM/Orca admission flavor): a request whose deadline
+is already unmeetable — expired at submit, or ``now + EWMA(bucket
+service time) > deadline`` at join — is NACKed immediately rather than
+served late. Late answers cost a forward slot AND get discarded by the
+caller; shedding converts that dead weight into capacity.
+
+This module is model-agnostic: ``forward_fn(arrays, bucket)`` is any
+callable over numpy arrays. serving/loader.py builds those from
+exported checkpoints; serving/decode.py layers the autoregressive
+variant (slot-based KV cache) on the same Request/shed machinery.
+"""
+
+import collections
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..telemetry import catalog as _cat
+
+__all__ = ["Request", "ContinuousBatcher", "ShedError", "bucket_for",
+           "default_buckets", "pad_batch_rows", "pad_to_bucket"]
+
+_req_ids = itertools.count(1)
+
+
+class ShedError(RuntimeError):
+    """Request was shed (deadline unmeetable or queue overloaded), not
+    served. `stage` says where: queue | join | overload | decode."""
+
+    def __init__(self, stage, detail=""):
+        super().__init__("shed at %s%s" % (stage, ": " + detail
+                                           if detail else ""))
+        self.stage = stage
+
+
+def default_buckets():
+    """Sequence-length pad targets (MXTPU_SERVE_BUCKETS, ascending)."""
+    spec = os.environ.get("MXTPU_SERVE_BUCKETS", "16,32,64,128,256,512")
+    out = sorted({int(b) for b in spec.split(",") if b.strip()})
+    if not out or out[0] < 1:
+        raise ValueError("MXTPU_SERVE_BUCKETS must name positive lengths, "
+                         "got %r" % spec)
+    return tuple(out)
+
+
+def bucket_for(length, buckets):
+    """Smallest bucket >= length, or None when the request is too long."""
+    for b in buckets:
+        if length <= b:
+            return b
+    return None
+
+
+def pad_batch_rows(n):
+    """Round a row count up to the next power of two (the padded batch
+    dimension is bucketed too, so XLA sees O(log max_batch) batch sizes
+    per length bucket, not one program per occupancy level)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def pad_to_bucket(a, bucket, pad_value=0):
+    """Pad axis 1 (sequence) of (rows, T, ...) up to `bucket`; 1-D
+    per-row arrays pass through untouched."""
+    a = np.asarray(a)
+    if a.ndim < 2 or a.shape[1] == bucket:
+        return a
+    if a.shape[1] > bucket:
+        raise ValueError("array length %d exceeds bucket %d"
+                         % (a.shape[1], bucket))
+    pad = [(0, 0)] * a.ndim
+    pad[1] = (0, bucket - a.shape[1])
+    return np.pad(a, pad, constant_values=pad_value)
+
+
+class Request:
+    """One admitted inference request riding through the batcher.
+
+    arrays : dict name -> np.ndarray, leading dim = rows (samples), and
+        (for >=2-D inputs) axis 1 = sequence length.
+    deadline : absolute ``time.monotonic()`` seconds, or None.
+    """
+
+    def __init__(self, model, arrays, deadline=None):
+        self.id = next(_req_ids)
+        self.model = model
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        shapes = {tuple(a.shape[:1]) for a in self.arrays.values()}
+        if not self.arrays or len(shapes) != 1:
+            raise ValueError("request needs >=1 array, all with the same "
+                             "leading (rows) dimension")
+        self.rows = int(next(iter(self.arrays.values())).shape[0])
+        self.length = max((a.shape[1] for a in self.arrays.values()
+                           if a.ndim >= 2), default=1)
+        self.deadline = deadline
+        self.arrival = time.monotonic()
+        self._done = threading.Event()
+        self.result = None          # dict name -> np.ndarray on success
+        self.error = None           # Exception on failure/shed
+
+    # -- completion (exactly one of these fires, once) -----------------
+    def complete(self, result):
+        self.result = result
+        self._done.set()
+
+    def fail(self, error):
+        self.error = error
+        self._done.set()
+
+    def shed(self, stage, detail=""):
+        self.fail(ShedError(stage, detail))
+
+    def wait(self, timeout=None):
+        """Block until served/shed; returns the result dict or raises
+        the recorded error (TimeoutError if nothing fired in time)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request %d not completed within %ss"
+                               % (self.id, timeout))
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+
+class ContinuousBatcher:
+    """Per-model scheduler: shape-bucketed queues + one batch worker.
+
+    max_wait_ms bounds the join window: with a non-empty queue the
+    worker serves immediately once `max_batch` rows are waiting in the
+    chosen bucket, and otherwise gives late arrivals up to this long
+    (measured from the oldest queued request's arrival) to coalesce.
+    0 = serve whatever is there the moment the worker is free — pure
+    continuous batching, lowest latency, occupancy comes from load.
+    """
+
+    def __init__(self, name, forward_fn, max_batch=None, buckets=None,
+                 max_wait_ms=None, queue_depth=None, pad_value=0):
+        self.name = name
+        self._forward = forward_fn
+        self._max_batch = int(max_batch if max_batch is not None else
+                              os.environ.get("MXTPU_SERVE_MAX_BATCH", "8"))
+        self._buckets = tuple(buckets) if buckets else default_buckets()
+        wait = (max_wait_ms if max_wait_ms is not None else
+                float(os.environ.get("MXTPU_SERVE_MAX_WAIT_MS", "0")))
+        self._max_wait = float(wait) / 1e3
+        self._depth = int(queue_depth if queue_depth is not None else
+                          os.environ.get("MXTPU_SERVE_QUEUE_DEPTH", "256"))
+        self._pad_value = pad_value
+        self._cond = threading.Condition()
+        self._queues = collections.OrderedDict(
+            (b, collections.deque()) for b in self._buckets)
+        self._pending = 0
+        self._ewma = {}                 # bucket -> smoothed service secs
+        self._stopping = False
+        self._batches = 0
+        self._thread = threading.Thread(
+            target=self._run, name="serve-batch-%s" % name, daemon=True)
+
+    # ---------------------------------------------------------- admission
+    def submit(self, req):
+        """Admit a request (returns it for chaining). Sheds instead of
+        queueing when its deadline already passed or the queue is full —
+        the caller observes ShedError from `req.wait()`."""
+        bucket = bucket_for(req.length, self._buckets)
+        if bucket is None:
+            req.fail(ValueError(
+                "sequence length %d exceeds the largest serving bucket %d"
+                % (req.length, self._buckets[-1])))
+            return req
+        now = time.monotonic()
+        if req.deadline is not None and now >= req.deadline:
+            self._shed(req, "queue", "deadline expired before admission")
+            return req
+        with self._cond:
+            if self._stopping:
+                req.fail(RuntimeError("batcher %r is stopped" % self.name))
+                return req
+            if self._pending >= self._depth:
+                self._shed(req, "overload",
+                           "queue depth %d reached" % self._depth)
+                return req
+            self._queues[bucket].append(req)
+            self._pending += 1
+            self._cond.notify_all()
+        return req
+
+    def _shed(self, req, stage, detail=""):
+        _cat.serving_shed.inc(model=self.name, stage=stage)
+        _cat.serving_requests.inc(model=self.name, status="shed")
+        req.shed(stage, detail)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        """Stop the worker; queued-but-unserved requests fail fast."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread.ident is not None:      # started
+            self._thread.join(timeout)
+        with self._cond:
+            for q in self._queues.values():
+                while q:
+                    q.popleft().fail(
+                        RuntimeError("batcher %r stopped" % self.name))
+            self._pending = 0
+
+    def stats(self):
+        with self._cond:
+            return {
+                "pending": self._pending,
+                "batches": self._batches,
+                "per_bucket": {b: len(q) for b, q in self._queues.items()
+                               if q},
+                "service_ewma_s": dict(self._ewma),
+            }
+
+    # -------------------------------------------------------- batch worker
+    def _estimate(self, bucket):
+        """EWMA service seconds for this bucket (0 before first sample:
+        never shed on a guess we haven't measured)."""
+        return self._ewma.get(bucket, 0.0)
+
+    def _pick_bucket_locked(self):
+        """Bucket whose HEAD request is oldest (global FIFO across
+        buckets — no bucket starves)."""
+        best, best_t = None, None
+        for b, q in self._queues.items():
+            if q and (best_t is None or q[0].arrival < best_t):
+                best, best_t = b, q[0].arrival
+        return best
+
+    def _take_locked(self, bucket):
+        """Pop requests from one bucket until max_batch rows are staged
+        (a request's rows never split across batches)."""
+        taken, rows = [], 0
+        q = self._queues[bucket]
+        while q and rows + q[0].rows <= self._max_batch:
+            r = q.popleft()
+            self._pending -= 1
+            taken.append(r)
+            rows += r.rows
+        return taken, rows
+
+    def _rows_queued_locked(self, bucket):
+        return sum(r.rows for r in self._queues[bucket])
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._stopping and self._pending == 0:
+                    self._cond.wait(0.1)
+                if self._stopping:
+                    return
+                bucket = self._pick_bucket_locked()
+                if bucket is None:      # raced with another drain
+                    continue
+                if self._max_wait > 0:
+                    # join window: give late arrivals a bounded chance to
+                    # coalesce, anchored to the oldest queued arrival so
+                    # the window never restarts as new requests land
+                    until = self._queues[bucket][0].arrival + self._max_wait
+                    while (not self._stopping
+                           and self._rows_queued_locked(bucket)
+                           < self._max_batch
+                           and time.monotonic() < until):
+                        self._cond.wait(max(until - time.monotonic(), 1e-4))
+                    if self._stopping:
+                        return
+                    refreshed = self._pick_bucket_locked()
+                    if refreshed is None:
+                        continue
+                    bucket = refreshed
+                taken, rows = self._take_locked(bucket)
+            if taken:
+                self._serve_batch(bucket, taken, rows)
+
+    def _serve_batch(self, bucket, taken, rows):
+        now = time.monotonic()
+        est = self._estimate(bucket)
+        live = []
+        for r in taken:
+            if r.deadline is not None and now + est > r.deadline:
+                self._shed(r, "join",
+                           "needs ~%.3fs, %.3fs left"
+                           % (est, r.deadline - now))
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        for r in live:
+            _cat.serving_queue_seconds.observe(now - r.arrival,
+                                               model=self.name)
+        _cat.serving_batch_occupancy.observe(rows, model=self.name)
+
+        # pad-or-pack: each request to the bucket edge, rows stacked,
+        # then the batch dim padded to its own power-of-two bucket
+        names = sorted(live[0].arrays)
+        padded_rows = pad_batch_rows(rows)
+        batch = {}
+        try:
+            for n in names:
+                parts = [pad_to_bucket(r.arrays[n], bucket, self._pad_value)
+                         for r in live]
+                stacked = np.concatenate(parts, axis=0)
+                if padded_rows != rows:
+                    fill = np.repeat(stacked[-1:], padded_rows - rows,
+                                     axis=0)
+                    stacked = np.concatenate([stacked, fill], axis=0)
+                batch[n] = stacked
+            t0 = time.perf_counter()
+            out = self._forward(batch, bucket)
+            dt = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — one bad batch must fail
+            # its own requests, never kill the worker loop
+            for r in live:
+                _cat.serving_requests.inc(model=self.name, status="error")
+                r.fail(e)
+            return
+        self._batches += 1
+        with self._cond:
+            prev = self._ewma.get(bucket)
+            self._ewma[bucket] = dt if prev is None else \
+                0.7 * prev + 0.3 * dt
+        _cat.serving_forward_seconds.observe(dt, model=self.name,
+                                             bucket=str(bucket))
+        # scatter rows back in submit order; padding rows are dropped
+        offset = 0
+        for r in live:
+            res = {k: np.asarray(v)[offset:offset + r.rows]
+                   for k, v in out.items()}
+            offset += r.rows
+            _cat.serving_requests.inc(model=self.name, status="ok")
+            _cat.serving_request_seconds.observe(
+                time.monotonic() - r.arrival, model=self.name)
+            r.complete(res)

@@ -1,0 +1,243 @@
+"""Multi-tenant model server over the kvstore RPC fabric.
+
+One `kvstore.rpc.Server` (threaded, length-prefixed JSON+payload
+frames — the same transport the parameter server trusts) fronting any
+number of loaded models. Each connection's handler thread BLOCKS on its
+request's completion event while the per-model batch worker coalesces
+every waiting thread's rows into shared forward steps — that handoff
+is what turns N concurrent clients into one MXU-shaped batch.
+
+Request flow:
+  client infer/decode  →  rpc.Server (expired `_deadline` NACKed
+  before the handler runs — satellite of this plane)  →  handler
+  unpacks arrays, stamps the monotonic deadline  →  ContinuousBatcher
+  / DecodeLoop (shape buckets, join-window coalescing, EWMA deadline
+  shed)  →  handler wakes, packs the row slice back over the wire.
+
+Multi-tenancy is per-model isolation: a model gets its own batcher
+thread, queues, and (for decode) KV-cache slot grid, so one tenant's
+queue depth or broken checkpoint never blocks another's forward
+progress. Telemetry is enabled on construction by default — per-model
+p50/p99 latency, QPS counters, and batch-occupancy histograms are the
+product surface here, not an option (`telemetry=False` opts out).
+"""
+
+import os
+import threading
+import time
+
+from ..kvstore import rpc as _rpc
+from ..telemetry import catalog as _cat
+from ..telemetry import export as _texport
+from ..telemetry import metrics as _met
+from .decode import DecodeLoop, DecodeRequest
+from .loader import ServedModel, load_served_model
+from .scheduler import ContinuousBatcher, Request, ShedError
+from .wire import pack_arrays, unpack_arrays
+
+__all__ = ["ModelServer"]
+
+
+class _Tenant:
+    """One loaded model: its ServedModel + running scheduler(s)."""
+
+    def __init__(self, name, served, batcher, decode_loop):
+        self.name = name
+        self.served = served
+        self.batcher = batcher
+        self.decode_loop = decode_loop
+
+    def stop(self):
+        if self.batcher is not None:
+            self.batcher.stop()
+        if self.decode_loop is not None:
+            self.decode_loop.stop()
+
+
+class ModelServer:
+    def __init__(self, host="127.0.0.1", port=0, telemetry=True):
+        if telemetry:
+            _met.enable()
+        self._models = {}
+        self._lock = threading.Lock()
+        self._timeout = float(os.environ.get("MXTPU_SERVE_TIMEOUT", "60"))
+        self._rpc = _rpc.Server(self._handle, host=host, port=port)
+        self.addr = self._rpc.addr
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        self._rpc.start()
+        return self
+
+    def stop(self):
+        self._rpc.stop()
+        with self._lock:
+            tenants = list(self._models.values())
+            self._models = {}
+        for t in tenants:
+            t.stop()
+        _cat.serving_models.set(0)
+
+    # -------------------------------------------------------------- models
+    def load(self, name, directory=None, served=None, quantize=None,
+             max_batch=None, max_wait_ms=None, buckets=None, slots=None,
+             cache_len=None):
+        """Load a model under `name` from a serving checkpoint directory
+        (or an already-built ServedModel) and start its schedulers.
+        Unnamed knobs fall back to the MXTPU_SERVE_* env defaults."""
+        if (directory is None) == (served is None):
+            raise ValueError("pass exactly one of directory/served")
+        if served is None:
+            served = load_served_model(directory, quantize=quantize)
+        elif not isinstance(served, ServedModel):
+            raise TypeError("served must be a loader.ServedModel")
+        batcher = decode_loop = None
+        if served.has_encode:
+            batcher = ContinuousBatcher(
+                name, served.encode_fn, max_batch=max_batch,
+                buckets=buckets, max_wait_ms=max_wait_ms,
+                pad_value=served.pad_token).start()
+        if served.has_decode:
+            n_slots = int(slots if slots is not None else
+                          os.environ.get("MXTPU_SERVE_SLOTS", "8"))
+            n_len = int(cache_len if cache_len is not None else
+                        os.environ.get("MXTPU_SERVE_CACHE_LEN", "512"))
+            cache = served.make_cache(n_slots, n_len)
+            decode_loop = DecodeLoop(
+                name, served.step_fn, cache,
+                pad_token=served.pad_token).start()
+        tenant = _Tenant(name, served, batcher, decode_loop)
+        with self._lock:
+            if name in self._models:
+                tenant.stop()
+                raise ValueError("model %r is already loaded" % name)
+            self._models[name] = tenant
+            _cat.serving_models.set(len(self._models))
+        return self
+
+    def unload(self, name):
+        with self._lock:
+            tenant = self._models.pop(name, None)
+            _cat.serving_models.set(len(self._models))
+        if tenant is None:
+            raise KeyError("model %r is not loaded" % name)
+        tenant.stop()
+
+    def _tenant(self, name):
+        with self._lock:
+            t = self._models.get(name)
+        if t is None:
+            raise KeyError("model %r is not loaded (have: %s)"
+                           % (name, sorted(self._models)))
+        return t
+
+    # ------------------------------------------------------------- handler
+    def _handle(self, meta, payload):
+        op = meta.get("op", "")
+        if op == "serve.ping":
+            with self._lock:
+                names = sorted(self._models)
+            return {"ok": True, "models": names, "addr": list(self.addr)}, b""
+        if op == "serve.models":
+            with self._lock:
+                tenants = list(self._models.items())
+            out = {name: {"family": t.served.family,
+                          "config": t.served.config,
+                          "quantized": t.served.quantized,
+                          "modes": [m for m, on in
+                                    (("encode", t.served.has_encode),
+                                     ("decode", t.served.has_decode)) if on]}
+                   for name, t in tenants}
+            return {"models": out}, b""
+        if op == "serve.infer":
+            return self._infer(meta, payload)
+        if op == "serve.decode":
+            return self._decode(meta, payload)
+        if op == "serve.stats":
+            return {"stats": self._stats()}, b""
+        if op == "serve.metrics":
+            if meta.get("format") == "json":
+                return {"format": "json"}, \
+                    _texport.render_json().encode("utf-8")
+            return {"format": "prom"}, \
+                _texport.render_prometheus().encode("utf-8")
+        raise ValueError("unknown serving op %r" % op)
+
+    @staticmethod
+    def _mono_deadline(meta):
+        """Client deadlines travel as absolute unix seconds (`_deadline`,
+        shared with the rpc-layer NACK); scheduling runs on the monotonic
+        clock, so convert via the remaining budget."""
+        dl = meta.get("_deadline")
+        if dl is None:
+            return None
+        return time.monotonic() + (float(dl) - time.time())
+
+    def _wait(self, req, name):
+        timeout = self._timeout
+        if req.deadline is not None:
+            timeout = min(timeout,
+                          max(req.deadline - time.monotonic(), 0.0) + 5.0)
+        try:
+            result = req.wait(timeout)
+        except ShedError as e:
+            return {"error": str(e), "shed": e.stage,
+                    "deadline_exceeded": e.stage != "overload"}, b""
+        except TimeoutError as e:
+            _cat.serving_requests.inc(model=name, status="error")
+            return {"error": "Timeout: %s" % e}, b""
+        manifest, out_payload = pack_arrays(result)
+        return {"ok": True, "arrays": manifest}, out_payload
+
+    def _infer(self, meta, payload):
+        name = meta.get("model", "")
+        tenant = self._tenant(name)
+        if tenant.batcher is None:
+            raise ValueError("model %r has no encode path" % name)
+        arrays = unpack_arrays(meta.get("arrays", []), payload)
+        req = Request(name, arrays, deadline=self._mono_deadline(meta))
+        tenant.batcher.submit(req)
+        return self._wait(req, name)
+
+    def _decode(self, meta, payload):
+        name = meta.get("model", "")
+        tenant = self._tenant(name)
+        if tenant.decode_loop is None:
+            raise ValueError("model %r has no decode path" % name)
+        arrays = unpack_arrays(meta.get("arrays", []), payload)
+        if "tokens" not in arrays:
+            raise ValueError("decode needs a 'tokens' prompt array")
+        req = DecodeRequest(
+            name, arrays["tokens"],
+            max_new_tokens=int(meta.get("max_new_tokens", 16)),
+            eos_id=meta.get("eos_id"),
+            deadline=self._mono_deadline(meta))
+        tenant.decode_loop.submit(req)
+        return self._wait(req, name)
+
+    def _stats(self):
+        """Per-model scheduler state + the latency quantiles the SLO
+        dashboards read (p50/p99 straight from the exported histogram)."""
+        with self._lock:
+            tenants = list(self._models.items())
+        out = {}
+        for name, t in tenants:
+            ent = {"family": t.served.family}
+            if t.batcher is not None:
+                ent["batch"] = t.batcher.stats()
+            if t.decode_loop is not None:
+                ent["decode"] = t.decode_loop.stats()
+            for q, key in ((0.5, "p50_s"), (0.99, "p99_s")):
+                v = _cat.serving_request_seconds.quantile(q, model=name)
+                if v is not None:
+                    ent[key] = round(v, 6)
+            occ = _cat.serving_batch_occupancy
+            n = occ.count(model=name)
+            if n:
+                ent["mean_batch_occupancy"] = round(
+                    occ.sum(model=name) / n, 3)
+            ent["requests"] = {
+                s: _cat.serving_requests.value(model=name, status=s)
+                for s in ("ok", "shed", "error")}
+            out[name] = ent
+        return out

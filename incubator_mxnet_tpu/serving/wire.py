@@ -1,0 +1,61 @@
+"""Tensor codec for the serving RPC surface.
+
+The kvstore transport (kvstore/rpc.py) moves one JSON meta dict plus one
+raw payload frame per message. Serving requests carry a *named set* of
+arrays (token ids, type ids, masks, ...), so this module packs them as:
+
+    meta["arrays"] = [{"name", "shape", "dtype"}, ...]   (order = layout)
+    payload        = concatenated C-order raw bytes
+
+No pickling — dtype strings go through ``numpy.dtype`` which rejects
+garbage, and byte counts are validated against the frame length before
+any array is built, so a malicious peer can at worst produce a
+ValueError, never code execution (same stance as the JSON meta framing).
+"""
+
+import numpy as np
+
+__all__ = ["pack_arrays", "unpack_arrays"]
+
+# dtypes a serving peer may send; object/str dtypes are rejected outright
+_ALLOWED_KINDS = frozenset("biuf")
+
+
+def pack_arrays(arrays):
+    """dict name -> array-like  ->  (manifest list, payload bytes)."""
+    manifest, chunks = [], []
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        if a.dtype.kind not in _ALLOWED_KINDS:
+            raise ValueError("unsupported dtype %r for array %r"
+                             % (a.dtype, name))
+        manifest.append({"name": str(name), "shape": list(a.shape),
+                         "dtype": a.dtype.str})
+        chunks.append(a.tobytes())
+    return manifest, b"".join(chunks)
+
+
+def unpack_arrays(manifest, payload):
+    """Inverse of `pack_arrays`; validates sizes before slicing."""
+    if not isinstance(manifest, list):
+        raise ValueError("array manifest must be a list")
+    out, offset = {}, 0
+    for ent in manifest:
+        name = ent["name"]
+        dtype = np.dtype(str(ent["dtype"]))
+        if dtype.kind not in _ALLOWED_KINDS:
+            raise ValueError("unsupported dtype %r for array %r"
+                             % (dtype, name))
+        shape = tuple(int(s) for s in ent["shape"])
+        if any(s < 0 for s in shape):
+            raise ValueError("negative dimension in %r" % (shape,))
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if offset + nbytes > len(payload):
+            raise ValueError(
+                "array %r claims %d bytes but only %d remain in the frame"
+                % (name, nbytes, len(payload) - offset))
+        out[name] = np.frombuffer(
+            payload, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)),
+            offset=offset).reshape(shape)
+        offset += nbytes
+    return out

@@ -36,6 +36,10 @@ rpc_server_seconds = _m.histogram(
 rpc_dedup_hits = _m.counter(
     "mxtpu_rpc_dedup_hits_total",
     "Idempotent requests answered from the server DedupCache")
+rpc_deadline_dropped = _m.counter(
+    "mxtpu_rpc_deadline_dropped_total",
+    "Requests NACKed by Server because their _deadline expired before "
+    "the handler ran, by op")
 
 # -- dist kvstore (kvstore/dist.py) ----------------------------------
 kvstore_pushes = _m.counter(
@@ -112,6 +116,38 @@ recordio_resyncs = _m.counter(
 recordio_quarantined_bytes = _m.counter(
     "mxtpu_recordio_quarantined_bytes_total",
     "Bytes skipped over while resyncing past corrupt RecordIO regions")
+
+
+# -- serving plane (serving/) ----------------------------------------
+serving_requests = _m.counter(
+    "mxtpu_serving_requests_total",
+    "Serving requests by model and status (ok|shed|error)")
+serving_request_seconds = _m.histogram(
+    "mxtpu_serving_request_seconds",
+    "End-to-end admission->completion latency by model "
+    "(the per-model p50/p99 source)")
+serving_queue_seconds = _m.histogram(
+    "mxtpu_serving_queue_seconds",
+    "Time a request waited before joining a forward batch, by model")
+serving_batch_occupancy = _m.histogram(
+    "mxtpu_serving_batch_occupancy",
+    "Rows per executed forward batch by model — >1 means concurrent "
+    "requests were coalesced (continuous batching is working)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+serving_forward_seconds = _m.histogram(
+    "mxtpu_serving_forward_seconds",
+    "Forward/decode step wall time by model and shape bucket")
+serving_shed = _m.counter(
+    "mxtpu_serving_shed_total",
+    "Requests shed by model and stage (queue|join|overload|decode)")
+serving_decode_steps = _m.counter(
+    "mxtpu_serving_decode_steps_total",
+    "Autoregressive decode steps executed by model")
+serving_decode_slots = _m.gauge(
+    "mxtpu_serving_decode_slots_in_use",
+    "KV-cache slots currently held by live decode sequences, by model")
+serving_models = _m.gauge(
+    "mxtpu_serving_models_loaded", "Models currently loaded in the server")
 
 
 # -- jax compile hook ------------------------------------------------

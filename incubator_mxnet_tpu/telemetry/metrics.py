@@ -162,6 +162,31 @@ class Histogram(_Instrument):
             st = self._series.get(key)
             return st[0] if st else 0
 
+    def quantile(self, q, **labels):
+        """Estimate the q-quantile (0 <= q <= 1) from the cumulative
+        buckets, Prometheus histogram_quantile style: find the first
+        bucket whose cumulative count reaches rank q*count and
+        interpolate linearly inside it. Returns None with no
+        observations; ranks beyond the last finite bucket clamp to its
+        upper edge (the +Inf bucket has no width to interpolate)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be in [0, 1], got %r" % q)
+        key = _label_key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None or st[0] == 0:
+                return None
+            total, counts = st[0], list(st[2])
+        rank = q * total
+        prev_edge, prev_count = 0.0, 0
+        for edge, c in zip(self.buckets, counts):
+            if c >= rank:
+                span = c - prev_count
+                frac = 1.0 if span <= 0 else (rank - prev_count) / span
+                return prev_edge + (edge - prev_edge) * frac
+            prev_edge, prev_count = edge, c
+        return self.buckets[-1]
+
     def sum(self, **labels):
         key = _label_key(labels)
         with self._lock:

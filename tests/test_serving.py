@@ -1,0 +1,500 @@
+"""serving/ unit suite: wire codec, shape bucketing, continuous-batcher
+join/leave/shed invariants, KV cache slot lifecycle, decode loop over a
+toy deterministic step function, the int8 dense path, checkpoint
+export/load round trips, and the histogram quantile estimator the
+p50/p99 stats ride on. The two-process acceptance path lives in
+test_serving_dist.py."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, serving, telemetry
+from incubator_mxnet_tpu.serving import kv_cache, scheduler, wire
+from incubator_mxnet_tpu.serving.decode import DecodeLoop, DecodeRequest
+from incubator_mxnet_tpu.telemetry import metrics as _met
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    telemetry.enable()
+    _met.reset()
+    yield
+    _met.reset()
+    telemetry.disable()
+
+
+# ------------------------------------------------------------------ wire
+def test_wire_roundtrip_preserves_arrays():
+    arrays = {"ids": np.arange(12, dtype=np.int32).reshape(3, 4),
+              "mask": np.ones((3, 4), np.float32),
+              "flag": np.array([True, False, True])}
+    manifest, payload = wire.pack_arrays(arrays)
+    assert [e["name"] for e in manifest] == sorted(arrays)
+    out = wire.unpack_arrays(manifest, payload)
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(out[k], v)
+        assert out[k].dtype == v.dtype
+
+
+def test_wire_rejects_object_dtype_and_bad_manifest():
+    with pytest.raises(ValueError):
+        wire.pack_arrays({"x": np.array(["a", "b"], object)})
+    manifest, payload = wire.pack_arrays({"x": np.zeros(4, np.float32)})
+    # claims more bytes than the frame holds
+    manifest[0]["shape"] = [400]
+    with pytest.raises(ValueError):
+        wire.unpack_arrays(manifest, payload)
+    with pytest.raises(ValueError):
+        wire.unpack_arrays([{"name": "x", "shape": [-1],
+                             "dtype": "<f4"}], b"")
+    with pytest.raises(ValueError):
+        wire.unpack_arrays([{"name": "x", "shape": [1],
+                             "dtype": "O"}], b"\0" * 8)
+
+
+# ------------------------------------------------------------- bucketing
+def test_bucket_for_picks_smallest_cover():
+    buckets = (16, 32, 128)
+    assert scheduler.bucket_for(1, buckets) == 16
+    assert scheduler.bucket_for(16, buckets) == 16
+    assert scheduler.bucket_for(17, buckets) == 32
+    assert scheduler.bucket_for(129, buckets) is None
+
+
+def test_default_buckets_env_override(monkeypatch):
+    monkeypatch.setenv("MXTPU_SERVE_BUCKETS", "8, 64,8")
+    assert scheduler.default_buckets() == (8, 64)
+    monkeypatch.setenv("MXTPU_SERVE_BUCKETS", "0,8")
+    with pytest.raises(ValueError):
+        scheduler.default_buckets()
+
+
+def test_pad_helpers():
+    a = np.ones((2, 5), np.int32)
+    p = scheduler.pad_to_bucket(a, 8, pad_value=7)
+    assert p.shape == (2, 8) and (p[:, 5:] == 7).all()
+    with pytest.raises(ValueError):
+        scheduler.pad_to_bucket(a, 4)
+    assert scheduler.pad_to_bucket(np.ones(3), 8).shape == (3,)  # 1-D: as-is
+    assert [scheduler.pad_batch_rows(n) for n in (1, 2, 3, 5, 8)] \
+        == [1, 2, 4, 8, 8]
+
+
+def test_request_validates_leading_dim():
+    with pytest.raises(ValueError):
+        scheduler.Request("m", {})
+    with pytest.raises(ValueError):
+        scheduler.Request("m", {"a": np.zeros((2, 3)),
+                                "b": np.zeros((3, 3))})
+    r = scheduler.Request("m", {"a": np.zeros((2, 5)), "b": np.zeros(2)})
+    assert r.rows == 2 and r.length == 5
+
+
+# ------------------------------------------------------------ batcher
+def _echo_forward(calls=None):
+    """forward_fn that records (rows, bucket) and echoes its input."""
+    def fn(batch, bucket):
+        if calls is not None:
+            calls.append((next(iter(batch.values())).shape[0], bucket))
+        return {"y": batch["x"] * 2}
+    return fn
+
+
+def test_batcher_serves_and_scatters_rows_back():
+    calls = []
+    b = scheduler.ContinuousBatcher("m", _echo_forward(calls),
+                                    max_batch=8, buckets=(4, 8),
+                                    max_wait_ms=0)
+    b.start()
+    try:
+        r = b.submit(scheduler.Request("m", {"x": np.arange(6.).reshape(2, 3)}))
+        out = r.wait(5.0)
+        np.testing.assert_array_equal(out["y"][:, :3],
+                                      np.arange(6.).reshape(2, 3) * 2)
+        assert out["y"].shape == (2, 4)         # padded to bucket 4
+        assert calls and calls[0][1] == 4
+        assert calls[0][0] == 2                 # rows padded to pow2 (2)
+    finally:
+        b.stop()
+
+
+def test_batcher_join_window_coalesces_concurrent_requests():
+    calls = []
+    b = scheduler.ContinuousBatcher("m", _echo_forward(calls),
+                                    max_batch=8, buckets=(4,),
+                                    max_wait_ms=200)
+    b.start()
+    try:
+        reqs = [scheduler.Request("m", {"x": np.full((1, 4), i, np.float32)})
+                for i in range(3)]
+        threads = [threading.Thread(target=b.submit, args=(r,))
+                   for r in reqs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        outs = [r.wait(5.0) for r in reqs]
+        for i, out in enumerate(outs):
+            np.testing.assert_array_equal(out["y"], np.full((1, 4), 2. * i))
+        # all three rows coalesced into one forward step
+        assert len(calls) == 1 and calls[0][0] == 4   # 3 rows -> pow2 pad 4
+        occ = telemetry.catalog.serving_batch_occupancy
+        assert occ.sum(model="m") == 3 and occ.count(model="m") == 1
+    finally:
+        b.stop()
+
+
+def test_batcher_sheds_expired_and_overloaded():
+    release = threading.Event()
+
+    def slow(batch, bucket):
+        release.wait(10.0)
+        return {"y": batch["x"]}
+
+    b = scheduler.ContinuousBatcher("m", slow, max_batch=2, buckets=(4,),
+                                    max_wait_ms=0, queue_depth=1)
+    b.start()
+    try:
+        # expired before admission -> queue shed, never queued
+        r0 = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4))},
+            deadline=time.monotonic() - 0.1))
+        with pytest.raises(scheduler.ShedError) as ei:
+            r0.wait(1.0)
+        assert ei.value.stage == "queue"
+
+        blocker = b.submit(scheduler.Request("m", {"x": np.zeros((1, 4))}))
+        time.sleep(0.2)           # worker is now stuck inside `slow`
+        keeper = b.submit(scheduler.Request("m", {"x": np.zeros((1, 4))}))
+        over = b.submit(scheduler.Request("m", {"x": np.zeros((1, 4))}))
+        with pytest.raises(scheduler.ShedError) as ei:
+            over.wait(1.0)
+        assert ei.value.stage == "overload"
+        release.set()
+        blocker.wait(5.0)
+        keeper.wait(5.0)
+        shed = telemetry.catalog.serving_shed
+        assert shed.value(model="m", stage="queue") == 1
+        assert shed.value(model="m", stage="overload") == 1
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_batcher_join_shed_uses_measured_service_time():
+    def slow(batch, bucket):
+        time.sleep(0.3)
+        return {"y": batch["x"]}
+
+    b = scheduler.ContinuousBatcher("m", slow, max_batch=8, buckets=(4,),
+                                    max_wait_ms=0)
+    b.start()
+    try:
+        # first request trains the EWMA (no shed on an unmeasured guess,
+        # even with a deadline the service time will blow through)
+        first = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4))},
+            deadline=time.monotonic() + 0.05))
+        first.wait(5.0)
+        # now ~0.3s is on record; a 50ms deadline is unmeetable -> join shed
+        late = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4))},
+            deadline=time.monotonic() + 0.05))
+        with pytest.raises(scheduler.ShedError) as ei:
+            late.wait(5.0)
+        assert ei.value.stage == "join"
+    finally:
+        b.stop()
+
+
+def test_batcher_forward_error_fails_batch_not_worker():
+    flaky = {"n": 0}
+
+    def fn(batch, bucket):
+        flaky["n"] += 1
+        if flaky["n"] == 1:
+            raise RuntimeError("boom")
+        return {"y": batch["x"]}
+
+    b = scheduler.ContinuousBatcher("m", fn, buckets=(4,), max_wait_ms=0)
+    b.start()
+    try:
+        bad = b.submit(scheduler.Request("m", {"x": np.zeros((1, 4))}))
+        with pytest.raises(RuntimeError, match="boom"):
+            bad.wait(5.0)
+        good = b.submit(scheduler.Request("m", {"x": np.zeros((1, 4))}))
+        assert good.wait(5.0)["y"].shape == (1, 4)   # worker survived
+    finally:
+        b.stop()
+
+
+def test_batcher_stop_drains_queued_requests():
+    b = scheduler.ContinuousBatcher("m", _echo_forward(), buckets=(4,))
+    r = scheduler.Request("m", {"x": np.zeros((1, 4))})
+    b.submit(r)       # never started -> stop must fail it, not strand it
+    b.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        r.wait(1.0)
+    after = b.submit(scheduler.Request("m", {"x": np.zeros((1, 4))}))
+    with pytest.raises(RuntimeError, match="stopped"):
+        after.wait(1.0)
+
+
+def test_batcher_rejects_overlong_sequence():
+    b = scheduler.ContinuousBatcher("m", _echo_forward(), buckets=(4, 8))
+    r = b.submit(scheduler.Request("m", {"x": np.zeros((1, 9))}))
+    with pytest.raises(ValueError, match="largest serving bucket"):
+        r.wait(1.0)
+
+
+# ------------------------------------------------------------- kv cache
+def test_kv_cache_slot_lifecycle():
+    c = kv_cache.KVCache(2, {"h": ("state", (3,)),
+                             "k": ("kv", (4,), np.float32)}, max_len=5)
+    s0, s1 = c.alloc(), c.alloc()
+    assert {s0, s1} == {0, 1} and c.alloc() is None and c.in_use == 2
+    c.set_state("h", s0, np.arange(3.))
+    np.testing.assert_array_equal(c.state("h", s0), np.arange(3.))
+    c.append("k", s0, np.ones(4))
+    c.advance(s0)
+    c.append("k", s0, np.full(4, 2.))
+    c.advance(s0)
+    np.testing.assert_array_equal(c.prefix("k", s0),
+                                  [[1.] * 4, [2.] * 4])
+    assert c.prefix("k", s1).shape == (0, 4)
+    c.free(s0)
+    with pytest.raises(ValueError):
+        c.state("h", s0)            # freed slot is dead
+    s2 = c.alloc()                  # reused slot comes back zeroed
+    assert s2 == s0
+    assert (c.state("h", s2) == 0).all() and c.lengths[s2] == 0
+
+
+def test_kv_cache_guards():
+    with pytest.raises(ValueError):
+        kv_cache.KVCache(0, {})
+    with pytest.raises(ValueError):
+        kv_cache.KVCache(1, {"x": ("pages", (2,))})
+    c = kv_cache.KVCache(1, {"h": ("state", (2,)), "k": ("kv", (2,))},
+                         max_len=1)
+    s = c.alloc()
+    with pytest.raises(ValueError):
+        c.append("h", s, np.zeros(2))       # state entry: no append
+    with pytest.raises(ValueError):
+        c.set_state("k", s, np.zeros(2))    # kv entry: no set_state
+    c.append("k", s, np.zeros(2))
+    c.advance(s)
+    with pytest.raises(ValueError, match="full"):
+        c.append("k", s, np.zeros(2))
+    with pytest.raises(ValueError):
+        c.free(99)
+
+
+# ---------------------------------------------------------- decode loop
+def _counting_step(vocab=10):
+    """Deterministic toy LM: next token = (input token + 1) % vocab.
+    Also proves statefulness by counting steps per slot in the cache."""
+    def step(tokens, cache, active):
+        logits = np.zeros((tokens.shape[0], vocab), np.float32)
+        for slot in range(tokens.shape[0]):
+            if active[slot]:
+                cache.data["h"][slot] += 1
+                logits[slot, (int(tokens[slot]) + 1) % vocab] = 1.0
+        return logits
+    return step
+
+
+def _toy_cache(slots=2, max_len=64):
+    return kv_cache.KVCache(slots, {"h": ("state", (1,))}, max_len=max_len)
+
+
+def test_decode_loop_generates_deterministic_continuation():
+    loop = DecodeLoop("lm", _counting_step(), _toy_cache(), pad_token=0)
+    loop.start()
+    try:
+        r = loop.submit(DecodeRequest("lm", [3, 4], max_new_tokens=4))
+        out = r.wait(10.0)
+        np.testing.assert_array_equal(out["tokens"], [5, 6, 7, 8])
+        r2 = loop.submit(DecodeRequest("lm", [7], max_new_tokens=5,
+                                       eos_id=9))
+        np.testing.assert_array_equal(r2.wait(10.0)["tokens"], [8, 9])
+    finally:
+        loop.stop()
+
+
+def test_decode_loop_joins_and_leaves_between_steps():
+    """More requests than slots: the third request must join the moment
+    a slot frees, not after the whole grid drains."""
+    loop = DecodeLoop("lm", _counting_step(), _toy_cache(slots=2))
+    loop.start()
+    try:
+        reqs = [loop.submit(DecodeRequest("lm", [i], max_new_tokens=3))
+                for i in range(3)]
+        outs = [r.wait(10.0)["tokens"] for r in reqs]
+        for i, out in enumerate(outs):
+            np.testing.assert_array_equal(out, [(i + j) % 10
+                                                for j in range(1, 4)])
+        assert loop.stats()["active"] == 0
+        occ = telemetry.catalog.serving_batch_occupancy
+        assert occ.count(model="lm") >= 3   # stepped with both slots live
+    finally:
+        loop.stop()
+
+
+def test_decode_loop_clamps_caps_and_sheds():
+    cache = _toy_cache(slots=1, max_len=8)
+    loop = DecodeLoop("lm", _counting_step(), cache, pad_token=0,
+                      max_new_tokens_cap=2)
+    loop.start()
+    try:
+        r = loop.submit(DecodeRequest("lm", [1], max_new_tokens=50))
+        assert r.wait(10.0)["tokens"].size == 2       # cap applied
+        long = loop.submit(DecodeRequest("lm", [0] * 7, max_new_tokens=2))
+        with pytest.raises(ValueError, match="KV cache"):
+            long.wait(1.0)
+        dead = loop.submit(DecodeRequest("lm", [1], max_new_tokens=2,
+                                         deadline=time.monotonic() - 1))
+        with pytest.raises(serving.ShedError) as ei:
+            dead.wait(1.0)
+        assert ei.value.stage == "queue"
+    finally:
+        loop.stop()
+
+
+def test_decode_loop_step_error_fails_active_requests():
+    def bad_step(tokens, cache, active):
+        raise RuntimeError("step exploded")
+
+    loop = DecodeLoop("lm", bad_step, _toy_cache())
+    loop.start()
+    try:
+        r = loop.submit(DecodeRequest("lm", [1], max_new_tokens=2))
+        with pytest.raises(RuntimeError, match="step exploded"):
+            r.wait(5.0)
+        assert loop.stats()["active"] == 0    # slot freed, loop alive
+    finally:
+        loop.stop()
+
+
+# ----------------------------------------------------------------- int8
+def test_int8_dense_matches_fp32_within_quant_error():
+    rng = np.random.RandomState(7)
+    w = rng.randn(32, 16).astype(np.float32)
+    b = rng.randn(32).astype(np.float32)
+    x = rng.randn(5, 16).astype(np.float32)
+    ref = x @ w.T + b
+    got = serving.Int8Dense(w, b)(x)
+    assert got.shape == ref.shape
+    # symmetric-127 grid on both operands: ~1% of the output scale
+    tol = 0.02 * np.abs(ref).max()
+    assert np.abs(got - ref).max() < tol
+
+
+def test_int8_serving_enabled_env(monkeypatch):
+    monkeypatch.delenv("MXTPU_SERVE_INT8", raising=False)
+    assert not serving.int8_serving_enabled()
+    monkeypatch.setenv("MXTPU_SERVE_INT8", "1")
+    assert serving.int8_serving_enabled()
+
+
+# ----------------------------------------------------------- loader
+BERT_CFG = dict(vocab_size=40, units=8, hidden_size=16, num_layers=1,
+                num_heads=2, max_length=32)
+LM_CFG = dict(mode="lstm", vocab_size=30, num_embed=8, num_hidden=8,
+              num_layers=1)
+
+
+def _tiny_bert():
+    from incubator_mxnet_tpu.models.bert import BERTModel
+    m = BERTModel(prefix="tb_", dropout=0.0, **BERT_CFG)
+    m.initialize(mx.init.Normal(0.02))
+    m(nd.array(np.zeros((1, 4), np.int32)))
+    return m
+
+
+def test_export_load_roundtrip_matches_source_model(tmp_path):
+    m = _tiny_bert()
+    serving.export_for_serving(str(tmp_path), "bert_encoder", BERT_CFG, m)
+    served = serving.load_served_model(str(tmp_path))
+    assert served.has_encode and not served.has_decode
+    ids = np.random.randint(1, 40, (2, 4)).astype(np.int32)
+    out = served.encode_fn({"token_ids": ids}, 4)
+    ref = m(nd.array(ids))[1].asnumpy()
+    np.testing.assert_allclose(out["pooled"], ref, atol=1e-5)
+
+
+def test_lstm_family_decodes_and_quantizes(tmp_path):
+    from incubator_mxnet_tpu.models.lstm_lm import RNNModel
+    m = RNNModel(prefix="tl_", dropout=0.0, **LM_CFG)
+    m.initialize(mx.init.Normal(0.02))
+    m(nd.array(np.zeros((1, 2), np.int32)), m.begin_state(batch_size=2))
+    serving.export_for_serving(str(tmp_path), "lstm_lm", LM_CFG, m)
+    fp32 = serving.load_served_model(str(tmp_path), quantize=False)
+    int8 = serving.load_served_model(str(tmp_path), quantize=True)
+    assert fp32.has_decode and int8.quantized
+    for served in (fp32, int8):
+        loop = DecodeLoop("lm", served.step_fn,
+                          served.make_cache(2, 32))
+        loop.start()
+        try:
+            out = loop.submit(DecodeRequest(
+                "lm", [1, 2], max_new_tokens=4)).wait(30.0)
+            assert out["tokens"].shape == (4,)
+            assert (out["tokens"] >= 0).all() \
+                and (out["tokens"] < LM_CFG["vocab_size"]).all()
+        finally:
+            loop.stop()
+
+
+def test_loader_guards(tmp_path):
+    with pytest.raises(ValueError, match="unknown serving family"):
+        serving.export_for_serving(str(tmp_path), "nope", {}, None)
+    with pytest.raises(ValueError, match="already registered"):
+        serving.serving_family("bert_encoder")(lambda *a: None)
+    m = _tiny_bert()
+    # a plain training checkpoint (no serving stanza) is refused
+    from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+    CheckpointManager(str(tmp_path), keep=None, async_save=False,
+                      prefix="serve").save(0, {"w": nd.ones((2,))})
+    with pytest.raises(ValueError, match="serving stanza"):
+        serving.load_served_model(str(tmp_path))
+
+
+def test_set_params_requires_every_param(tmp_path):
+    m = _tiny_bert()
+    serving.export_for_serving(str(tmp_path), "bert_encoder", BERT_CFG, m)
+    mgr_params = None
+    from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep=None, async_save=False,
+                            prefix="serve")
+    _s, params, _t, meta = mgr.restore()
+    params.pop(sorted(params)[0])
+    mgr.save(1, params, extra=meta)
+    with pytest.raises(IOError, match="missing params"):
+        serving.load_served_model(str(tmp_path))
+
+
+# ------------------------------------------------------ histogram stats
+def test_histogram_quantile_interpolates():
+    h = _met.histogram("test_quantile_seconds", buckets=(1, 2, 4))
+    assert h.quantile(0.5) is None
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    # rank 2 of 4 falls in the (1, 2] bucket -> interpolated inside it
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+    assert h.quantile(1.0) == 4.0       # clamps to last finite edge
+    assert h.quantile(0.0) <= 1.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_rpc_deadline_expired_helper():
+    from incubator_mxnet_tpu.kvstore.rpc import _deadline_expired
+    assert _deadline_expired(time.time() - 5)
+    assert not _deadline_expired(time.time() + 60)
+    assert not _deadline_expired(None)
+    assert not _deadline_expired("not-a-number")

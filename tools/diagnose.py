@@ -104,6 +104,35 @@ def main():
     except Exception as e:  # noqa: BLE001 — diagnostics must not crash
         print("telemetry unavailable:", e)
 
+    section("Serving")
+    # live serving-plane probe: point MXTPU_SERVE_ADDR at a ModelServer
+    # ("host:port") and diagnose reports its models and SLO quantiles
+    addr = os.environ.get("MXTPU_SERVE_ADDR", "")
+    if not addr:
+        print("(no server configured — set MXTPU_SERVE_ADDR=host:port)")
+    else:
+        try:
+            host, port = addr.rsplit(":", 1)
+            from incubator_mxnet_tpu.serving import ServingClient
+            c = ServingClient((host, int(port)), timeout=3.0)
+            try:
+                ping = c.ping()
+                print("server       :", addr, "up,",
+                      "%d model(s)" % len(ping["models"]))
+                for name, ent in sorted(c.stats().items()):
+                    reqs = ent.get("requests", {})
+                    print("  - %s (%s): ok=%s shed=%s error=%s p50=%ss "
+                          "p99=%ss occupancy=%s"
+                          % (name, ent.get("family", "?"),
+                             reqs.get("ok"), reqs.get("shed"),
+                             reqs.get("error"), ent.get("p50_s", "n/a"),
+                             ent.get("p99_s", "n/a"),
+                             ent.get("mean_batch_occupancy", "n/a")))
+            finally:
+                c.close()
+        except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+            print("server       : %s unreachable (%s)" % (addr, e))
+
     section("Threads")
     # hang post-mortem: every live thread's stack plus watchdog state —
     # the same rendering the resilience watchdog dumps on a deadline
