@@ -1033,6 +1033,10 @@ def bench_serving():
                 t.start()
             for t in warm:
                 t.join()
+        # the warm waves trained the batcher's EWMA on compile-laden
+        # forwards; reset so the timed, deadlined phase sheds on
+        # steady-state service time, not XLA compile time
+        srv.reset_service_estimates("bert")
 
         repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
         qps, lat_ms, shed = [], [], [0]
@@ -1066,13 +1070,18 @@ def bench_serving():
             0.5 * (qps[repeats // 2 - 1] + qps[repeats // 2])
         stats = {"value": med, "repeats": repeats, "min": qps[0],
                  "max": qps[-1],
-                 "spread_pct": round(100.0 * (qps[-1] - qps[0]) / med, 1)}
+                 # med == 0 means total overload: every request shed at
+                 # the SLO — still a valid emit (shed_pct tells the story)
+                 "spread_pct": round(100.0 * (qps[-1] - qps[0]) / med, 1)
+                 if med else None}
         served_stats = clients[0].stats()["bert"]
         total = len(lat_ms) + shed[0]
         return _emit(
             "serving_bert_sustained_qps", "req/sec", stats,
-            p50_ms=round(float(np.percentile(lat_ms, 50)), 2),
-            p99_ms=round(float(np.percentile(lat_ms, 99)), 2),
+            p50_ms=round(float(np.percentile(lat_ms, 50)), 2)
+            if lat_ms else None,
+            p99_ms=round(float(np.percentile(lat_ms, 99)), 2)
+            if lat_ms else None,
             slo_ms=slo_ms,
             shed_pct=round(100.0 * shed[0] / max(total, 1), 2),
             mean_batch_occupancy=served_stats.get("mean_batch_occupancy"),

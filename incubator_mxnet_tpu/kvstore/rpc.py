@@ -50,6 +50,16 @@ def _deadline_expired(dl):
         return False
 
 
+def _budget_expired(ms):
+    """True when meta's optional `_deadline_ms` (RELATIVE milliseconds of
+    remaining budget — gRPC-style, immune to client/server wall-clock
+    skew) arrived already non-positive. Malformed stamps never expire."""
+    try:
+        return float(ms) <= 0.0
+    except (TypeError, ValueError):
+        return False
+
+
 def send_msg(sock, obj, payload=b""):
     """obj: JSON-serializable metadata dict; payload: raw bytes."""
     meta = json.dumps(obj, separators=(",", ":")).encode("utf-8")
@@ -387,19 +397,31 @@ class Server:
                 meta["_peer"] = peer    # server-authoritative, not spoofable
                 op = meta.get("op", "")
                 dl = meta.get("_deadline")
-                if dl is not None and _deadline_expired(dl):
-                    # Admission control: the client's deadline (absolute
-                    # unix seconds in the meta dict) passed while the
-                    # request was on the wire or queued behind this
-                    # connection — NACK instead of burning handler time
-                    # on a reply nobody is waiting for. The serving
-                    # plane's shed path relies on this; training RPC
-                    # gets it for free.
+                ms = meta.get("_deadline_ms")
+                if (dl is not None and _deadline_expired(dl)) or \
+                        (ms is not None and _budget_expired(ms)):
+                    # Admission control: the client's deadline — either a
+                    # relative `_deadline_ms` budget (preferred, skew-
+                    # immune) or a legacy absolute-unix `_deadline` —
+                    # is already spent, so NACK instead of burning
+                    # handler time on a reply nobody is waiting for. The
+                    # serving plane's shed path relies on this; training
+                    # RPC gets it for free.
                     _cat.rpc_deadline_dropped.inc(op=op)
                     send_msg(conn, {"error": "DeadlineExceeded: request "
-                                    "_deadline already expired",
+                                    "deadline already expired",
                                     "deadline_exceeded": True}, b"")
                     continue
+                if ms is not None:
+                    # convert the surviving budget to an absolute deadline
+                    # on the SERVER's monotonic clock at frame-read time;
+                    # handlers schedule against this without ever
+                    # comparing client wall time to server wall time
+                    try:
+                        meta["_deadline_mono"] = (time.monotonic()
+                                                  + float(ms) / 1e3)
+                    except (TypeError, ValueError):
+                        pass
                 enabled = _met.enabled()
                 t0 = time.perf_counter() if enabled else 0.0
                 status = "ok"

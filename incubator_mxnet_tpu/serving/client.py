@@ -2,15 +2,15 @@
 `kvstore.rpc.Connection`.
 
 Deadlines are first-class: ``deadline_ms`` becomes the wire-level
-``_deadline`` meta stamp (absolute unix seconds), so an expired request
-is NACKed by the rpc layer before the handler runs, shed by the
-scheduler if the batch can't make it, and surfaced here as a
-`DeadlineExceeded` carrying the stage that dropped it. One Connection
+``_deadline_ms`` meta stamp — a RELATIVE remaining budget, gRPC-style,
+which the server converts to its own monotonic clock on receipt so
+client/server wall-clock skew can never shed a valid request. An
+exhausted budget is NACKed by the rpc layer before the handler runs,
+shed by the scheduler if the batch can't make it, and surfaced here as
+a `DeadlineExceeded` carrying the stage that dropped it. One Connection
 serializes its calls — run one client per concurrent request stream
 (that is what the server's continuous batcher coalesces).
 """
-
-import time
 
 import numpy as np
 
@@ -47,7 +47,7 @@ class ServingClient:
     # ---------------------------------------------------------------- rpc
     def _call(self, meta, payload=b"", deadline_ms=None):
         if deadline_ms is not None:
-            meta["_deadline"] = time.time() + float(deadline_ms) / 1e3
+            meta["_deadline_ms"] = float(deadline_ms)
         rmeta, rpayload = self._conn.call(meta, payload)
         if rmeta.get("shed") or rmeta.get("deadline_exceeded"):
             raise DeadlineExceeded(rmeta.get("error", "request shed"),

@@ -23,7 +23,8 @@ acceptance tests rely on.
 Deadline shed: at join the loop estimates ``(prompt+max_new) * EWMA
 (step seconds)``; mid-generation an expired deadline retires the slot
 immediately (stage "decode") instead of finishing a reply nobody will
-read.
+read — unless the sequence finished on that very step, in which case
+the already-paid-for result is delivered.
 """
 
 import collections
@@ -134,9 +135,9 @@ class DecodeLoop:
         return req
 
     def _shed(self, req, stage, detail=""):
-        _cat.serving_shed.inc(model=self.name, stage=stage)
-        _cat.serving_requests.inc(model=self.name, status="shed")
-        req.shed(stage, detail)
+        if req.shed(stage, detail):     # no double-count if already done
+            _cat.serving_shed.inc(model=self.name, stage=stage)
+            _cat.serving_requests.inc(model=self.name, status="shed")
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -159,6 +160,12 @@ class DecodeLoop:
                 self._cache.free(slot)
             self._active.clear()
 
+    def reset_service_estimates(self):
+        """Forget the EWMA step time (see ContinuousBatcher's twin —
+        compile-skewed early samples would join-shed deadlined work)."""
+        with self._cond:
+            self._ewma_step = None
+
     def stats(self):
         with self._cond:
             return {"pending": len(self._pending),
@@ -173,6 +180,9 @@ class DecodeLoop:
         est = self._ewma_step or 0.0
         while self._pending and self._cache.in_use < self._cache.slots:
             req = self._pending[0]
+            if req.done:                # cancelled while queued
+                self._pending.popleft()
+                continue
             if req.deadline is not None and \
                     now + est * (req.prompt.size + req.max_new_tokens) \
                     > req.deadline:
@@ -213,9 +223,9 @@ class DecodeLoop:
                 # the in-flight sequences, not the serving loop
                 with self._cond:
                     for slot, seq in list(self._active.items()):
-                        _cat.serving_requests.inc(model=self.name,
-                                                  status="error")
-                        seq.req.fail(e)
+                        if seq.req.fail(e):
+                            _cat.serving_requests.inc(model=self.name,
+                                                      status="error")
                         self._cache.free(slot)
                     self._active.clear()
                 continue
@@ -232,17 +242,23 @@ class DecodeLoop:
             with self._cond:
                 for slot, seq in list(self._active.items()):
                     seq.consume(logits[slot])
-                    if seq.req.deadline is not None \
+                    if seq.req.done:    # cancelled mid-flight: release
+                        pass
+                    elif seq.finished:
+                        # finished beats the deadline check: this step's
+                        # compute already paid for the final token, so a
+                        # sequence that completed at the buzzer is
+                        # delivered, not shed
+                        if seq.req.complete({"tokens": np.asarray(
+                                seq.generated, np.int32)}):
+                            _cat.serving_requests.inc(model=self.name,
+                                                      status="ok")
+                            _cat.serving_request_seconds.observe(
+                                now - seq.req.arrival, model=self.name)
+                    elif seq.req.deadline is not None \
                             and now > seq.req.deadline:
                         self._shed(seq.req, "decode",
                                    "deadline passed mid-generation")
-                    elif seq.finished:
-                        _cat.serving_requests.inc(model=self.name,
-                                                  status="ok")
-                        _cat.serving_request_seconds.observe(
-                            now - seq.req.arrival, model=self.name)
-                        seq.req.complete({"tokens": np.asarray(
-                            seq.generated, np.int32)})
                     else:
                         continue
                     self._cache.free(slot)

@@ -114,23 +114,46 @@ class Request:
         self.rows = int(next(iter(self.arrays.values())).shape[0])
         self.length = max((a.shape[1] for a in self.arrays.values()
                            if a.ndim >= 2), default=1)
+        # co-batch compatibility key: only requests sharing array names,
+        # dtypes, and trailing (post-sequence) dims may stack into one
+        # forward call — one client's malformed arrays must never fail
+        # another client's batch
+        self.signature = tuple(sorted(
+            (k, a.dtype.str, a.ndim, a.shape[2:])
+            for k, a in self.arrays.items()))
         self.deadline = deadline
         self.arrival = time.monotonic()
         self._done = threading.Event()
+        self._settle = threading.Lock()
         self.result = None          # dict name -> np.ndarray on success
         self.error = None           # Exception on failure/shed
 
-    # -- completion (exactly one of these fires, once) -----------------
+    # -- completion (first complete/fail/cancel wins, the rest no-op;
+    #    each returns whether THIS call settled the request) ----------
     def complete(self, result):
-        self.result = result
-        self._done.set()
+        with self._settle:
+            if self._done.is_set():
+                return False
+            self.result = result
+            self._done.set()
+            return True
 
     def fail(self, error):
-        self.error = error
-        self._done.set()
+        with self._settle:
+            if self._done.is_set():
+                return False
+            self.error = error
+            self._done.set()
+            return True
 
     def shed(self, stage, detail=""):
-        self.fail(ShedError(stage, detail))
+        return self.fail(ShedError(stage, detail))
+
+    def cancel(self, detail="caller stopped waiting"):
+        """Abandon the request (e.g. its RPC handler timed out): fails
+        it immediately, and the schedulers discard it on next touch
+        instead of spending forward capacity on an unread reply."""
+        return self.fail(TimeoutError(detail))
 
     def wait(self, timeout=None):
         """Block until served/shed; returns the result dict or raises
@@ -192,6 +215,13 @@ class ContinuousBatcher:
                 "sequence length %d exceeds the largest serving bucket %d"
                 % (req.length, self._buckets[-1])))
             return req
+        if req.rows > self._max_batch:
+            # an unpoppable request (_take_locked can never stage it)
+            # would wedge its bucket forever — fail it at the door
+            req.fail(ValueError(
+                "request rows %d exceed max_batch %d — split the request "
+                "client-side" % (req.rows, self._max_batch)))
+            return req
         now = time.monotonic()
         if req.deadline is not None and now >= req.deadline:
             self._shed(req, "queue", "deadline expired before admission")
@@ -210,9 +240,9 @@ class ContinuousBatcher:
         return req
 
     def _shed(self, req, stage, detail=""):
-        _cat.serving_shed.inc(model=self.name, stage=stage)
-        _cat.serving_requests.inc(model=self.name, status="shed")
-        req.shed(stage, detail)
+        if req.shed(stage, detail):     # no double-count if already done
+            _cat.serving_shed.inc(model=self.name, stage=stage)
+            _cat.serving_requests.inc(model=self.name, status="shed")
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -232,6 +262,13 @@ class ContinuousBatcher:
                     q.popleft().fail(
                         RuntimeError("batcher %r stopped" % self.name))
             self._pending = 0
+
+    def reset_service_estimates(self):
+        """Forget EWMA service times. Early samples carry XLA compile
+        seconds; callers that warm the compile cache first (bench, warm
+        start) reset so deadline sheds reflect steady-state service."""
+        with self._cond:
+            self._ewma.clear()
 
     def stats(self):
         with self._cond:
@@ -260,18 +297,33 @@ class ContinuousBatcher:
 
     def _take_locked(self, bucket):
         """Pop requests from one bucket until max_batch rows are staged
-        (a request's rows never split across batches)."""
-        taken, rows = [], 0
+        (a request's rows never split across batches). Cancelled
+        requests are discarded; only signature-compatible requests
+        co-batch, so the first mismatch ends the batch and becomes the
+        next head. submit() bounds rows <= max_batch, so a live head is
+        always takeable — the worker can never spin on a stuck queue."""
+        taken, rows, sig = [], 0, None
         q = self._queues[bucket]
-        while q and rows + q[0].rows <= self._max_batch:
-            r = q.popleft()
+        while q:
+            head = q[0]
+            if head.done:               # cancelled while queued
+                q.popleft()
+                self._pending -= 1
+                continue
+            if sig is None:
+                sig = head.signature
+            elif head.signature != sig:
+                break
+            if rows + head.rows > self._max_batch:
+                break
+            q.popleft()
             self._pending -= 1
-            taken.append(r)
-            rows += r.rows
+            taken.append(head)
+            rows += head.rows
         return taken, rows
 
     def _rows_queued_locked(self, bucket):
-        return sum(r.rows for r in self._queues[bucket])
+        return sum(r.rows for r in self._queues[bucket] if not r.done)
 
     def _run(self):
         while True:
@@ -308,6 +360,8 @@ class ContinuousBatcher:
         est = self._estimate(bucket)
         live = []
         for r in taken:
+            if r.done:                  # cancelled between take and serve
+                continue
             if r.deadline is not None and now + est > r.deadline:
                 self._shed(r, "join",
                            "needs ~%.3fs, %.3fs left"
@@ -343,8 +397,9 @@ class ContinuousBatcher:
         except Exception as e:  # noqa: BLE001 — one bad batch must fail
             # its own requests, never kill the worker loop
             for r in live:
-                _cat.serving_requests.inc(model=self.name, status="error")
-                r.fail(e)
+                if r.fail(e):
+                    _cat.serving_requests.inc(model=self.name,
+                                              status="error")
             return
         self._batches += 1
         with self._cond:
@@ -359,7 +414,7 @@ class ContinuousBatcher:
             res = {k: np.asarray(v)[offset:offset + r.rows]
                    for k, v in out.items()}
             offset += r.rows
-            _cat.serving_requests.inc(model=self.name, status="ok")
-            _cat.serving_request_seconds.observe(
-                time.monotonic() - r.arrival, model=self.name)
-            r.complete(res)
+            if r.complete(res):
+                _cat.serving_requests.inc(model=self.name, status="ok")
+                _cat.serving_request_seconds.observe(
+                    time.monotonic() - r.arrival, model=self.name)

@@ -8,9 +8,10 @@ every waiting thread's rows into shared forward steps — that handoff
 is what turns N concurrent clients into one MXU-shaped batch.
 
 Request flow:
-  client infer/decode  →  rpc.Server (expired `_deadline` NACKed
-  before the handler runs — satellite of this plane)  →  handler
-  unpacks arrays, stamps the monotonic deadline  →  ContinuousBatcher
+  client infer/decode  →  rpc.Server (an exhausted `_deadline_ms`
+  budget is NACKed before the handler runs — satellite of this plane —
+  and a live one is stamped onto the server's monotonic clock)  →
+  handler unpacks arrays, reads that deadline  →  ContinuousBatcher
   / DecodeLoop (shape buckets, join-window coalescing, EWMA deadline
   shed)  →  handler wakes, packs the row slice back over the wire.
 
@@ -123,6 +124,17 @@ class ModelServer:
             raise KeyError("model %r is not loaded" % name)
         tenant.stop()
 
+    def reset_service_estimates(self, name):
+        """Drop a model's EWMA service estimates. The first forwards per
+        shape carry XLA compile seconds; warm-start flows replay those
+        shapes then call this so deadline sheds track steady-state
+        service time instead of compile time."""
+        t = self._tenant(name)
+        if t.batcher is not None:
+            t.batcher.reset_service_estimates()
+        if t.decode_loop is not None:
+            t.decode_loop.reset_service_estimates()
+
     def _tenant(self, name):
         with self._lock:
             t = self._models.get(name)
@@ -165,9 +177,15 @@ class ModelServer:
 
     @staticmethod
     def _mono_deadline(meta):
-        """Client deadlines travel as absolute unix seconds (`_deadline`,
-        shared with the rpc-layer NACK); scheduling runs on the monotonic
-        clock, so convert via the remaining budget."""
+        """Clients send a RELATIVE `_deadline_ms` budget which the rpc
+        server converts to `_deadline_mono` (its own monotonic clock) the
+        moment the frame is read — scheduling never trusts client wall
+        time, so clock skew cannot shed a valid request. A legacy
+        absolute `_deadline` (unix seconds) still works via
+        remaining-budget conversion, with skew exposure."""
+        mono = meta.get("_deadline_mono")
+        if mono is not None:
+            return float(mono)
         dl = meta.get("_deadline")
         if dl is None:
             return None
@@ -184,8 +202,18 @@ class ModelServer:
             return {"error": str(e), "shed": e.stage,
                     "deadline_exceeded": e.stage != "overload"}, b""
         except TimeoutError as e:
-            _cat.serving_requests.inc(model=name, status="error")
-            return {"error": "Timeout: %s" % e}, b""
+            # Nobody will read a late reply: cancel so the schedulers
+            # drop the request instead of holding its queue entry or
+            # decode slot. Losing the cancel race means it settled at
+            # the buzzer — deliver that outcome instead.
+            if req.cancel("handler timed out after %.1fs" % timeout):
+                _cat.serving_requests.inc(model=name, status="error")
+                return {"error": "Timeout: %s" % e}, b""
+            try:
+                result = req.wait(0)
+            except ShedError as e2:
+                return {"error": str(e2), "shed": e2.stage,
+                        "deadline_exceeded": e2.stage != "overload"}, b""
         manifest, out_payload = pack_arrays(result)
         return {"ok": True, "arrays": manifest}, out_payload
 

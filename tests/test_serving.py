@@ -207,6 +207,13 @@ def test_batcher_join_shed_uses_measured_service_time():
         with pytest.raises(scheduler.ShedError) as ei:
             late.wait(5.0)
         assert ei.value.stage == "join"
+        # warm-start reset: forgetting the (e.g. compile-skewed)
+        # estimate re-admits deadlined work — never shed on a guess
+        b.reset_service_estimates()
+        retry = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4))},
+            deadline=time.monotonic() + 0.05))
+        assert retry.wait(5.0)["y"].shape == (1, 4)
     finally:
         b.stop()
 
@@ -249,6 +256,76 @@ def test_batcher_rejects_overlong_sequence():
     r = b.submit(scheduler.Request("m", {"x": np.zeros((1, 9))}))
     with pytest.raises(ValueError, match="largest serving bucket"):
         r.wait(1.0)
+
+
+def test_batcher_rejects_oversized_rows_and_keeps_serving():
+    """rows > max_batch can never be staged by _take_locked; admitting
+    one used to wedge the bucket (worker busy-spin, every later request
+    starved). It must fail at submit and leave the worker healthy."""
+    b = scheduler.ContinuousBatcher("m", _echo_forward(), max_batch=2,
+                                    buckets=(4,), max_wait_ms=0)
+    b.start()
+    try:
+        big = b.submit(scheduler.Request("m", {"x": np.zeros((3, 4))}))
+        with pytest.raises(ValueError, match="exceed max_batch"):
+            big.wait(1.0)
+        ok = b.submit(scheduler.Request("m", {"x": np.zeros((2, 4))}))
+        assert ok.wait(5.0)["y"].shape == (2, 4)
+        assert b.stats()["pending"] == 0
+    finally:
+        b.stop()
+
+
+def test_batcher_cobatches_only_compatible_signatures():
+    """Requests with different array name sets (or trailing dims) land
+    in separate forward calls — one client's malformed/odd request must
+    never raise inside another client's batch."""
+    calls = []
+
+    def fn(batch, bucket):
+        calls.append(sorted(batch))
+        return {k: v * 2 for k, v in batch.items()}
+
+    b = scheduler.ContinuousBatcher("m", fn, max_batch=8, buckets=(4,),
+                                    max_wait_ms=0)
+    ra = scheduler.Request("m", {"x": np.ones((1, 4), np.float32)})
+    rb = scheduler.Request("m", {"z": np.ones((1, 4), np.float32)})
+    rc = scheduler.Request("m", {"x": np.ones((1, 4, 2), np.float32)})
+    for r in (ra, rb, rc):      # queued together before the worker runs
+        b.submit(r)
+    b.start()
+    try:
+        np.testing.assert_array_equal(ra.wait(5.0)["x"], 2 * np.ones((1, 4)))
+        np.testing.assert_array_equal(rb.wait(5.0)["z"], 2 * np.ones((1, 4)))
+        assert rc.wait(5.0)["x"].shape == (1, 4, 2)
+        assert calls == [["x"], ["z"], ["x"]]    # three distinct batches
+    finally:
+        b.stop()
+
+
+def test_batcher_drops_cancelled_requests():
+    """A cancelled (e.g. handler-timeout) request is discarded by the
+    worker instead of burning a forward slot on an unread reply."""
+    calls = []
+    b = scheduler.ContinuousBatcher("m", _echo_forward(calls),
+                                    max_batch=8, buckets=(4,),
+                                    max_wait_ms=0)
+    gone = scheduler.Request("m", {"x": np.zeros((1, 4), np.float32)})
+    live = scheduler.Request("m", {"x": np.ones((1, 4), np.float32)})
+    b.submit(gone)
+    b.submit(live)
+    assert gone.cancel("test timeout")
+    assert not gone.cancel()            # settle is first-wins, once
+    b.start()
+    try:
+        np.testing.assert_array_equal(live.wait(5.0)["y"],
+                                      2 * np.ones((1, 4)))
+        assert len(calls) == 1 and calls[0][0] == 1   # only live's row
+        with pytest.raises(TimeoutError, match="test timeout"):
+            gone.wait(0.1)
+        assert b.stats()["pending"] == 0
+    finally:
+        b.stop()
 
 
 # ------------------------------------------------------------- kv cache
@@ -361,6 +438,43 @@ def test_decode_loop_clamps_caps_and_sheds():
         with pytest.raises(serving.ShedError) as ei:
             dead.wait(1.0)
         assert ei.value.stage == "queue"
+    finally:
+        loop.stop()
+
+
+def test_decode_delivers_sequence_finished_at_the_buzzer():
+    """A sequence whose FINAL token lands on the very step its deadline
+    expires is already paid for — it must be delivered, not shed."""
+    base = _counting_step()
+
+    def slow_step(tokens, cache, active):
+        time.sleep(0.15)
+        return base(tokens, cache, active)
+
+    loop = DecodeLoop("lm", slow_step, _toy_cache(slots=1))
+    loop.start()
+    try:
+        # one step both feeds the 1-token prompt and emits the single
+        # generated token; the deadline expires during that step
+        r = loop.submit(DecodeRequest("lm", [3], max_new_tokens=1,
+                                      deadline=time.monotonic() + 0.05))
+        np.testing.assert_array_equal(r.wait(10.0)["tokens"], [4])
+    finally:
+        loop.stop()
+
+
+def test_decode_loop_drops_cancelled_pending_request():
+    loop = DecodeLoop("lm", _counting_step(), _toy_cache(slots=1))
+    gone = DecodeRequest("lm", [1], max_new_tokens=2)
+    loop.submit(gone)
+    assert gone.cancel("test timeout")
+    loop.start()
+    try:
+        live = loop.submit(DecodeRequest("lm", [5], max_new_tokens=2))
+        np.testing.assert_array_equal(live.wait(10.0)["tokens"], [6, 7])
+        assert loop.stats()["pending"] == 0
+        with pytest.raises(TimeoutError, match="test timeout"):
+            gone.wait(0.1)
     finally:
         loop.stop()
 
@@ -498,3 +612,41 @@ def test_rpc_deadline_expired_helper():
     assert not _deadline_expired(time.time() + 60)
     assert not _deadline_expired(None)
     assert not _deadline_expired("not-a-number")
+
+
+def test_rpc_budget_expired_helper():
+    from incubator_mxnet_tpu.kvstore.rpc import _budget_expired
+    assert _budget_expired(-100) and _budget_expired(0)
+    assert not _budget_expired(1) and not _budget_expired(30000)
+    assert not _budget_expired(None)        # malformed never drops
+    assert not _budget_expired("not-a-number")
+
+
+def test_mono_deadline_prefers_server_stamp():
+    """The rpc server converts the relative `_deadline_ms` budget to a
+    `_deadline_mono` stamp on ITS clock; the handler must use that and
+    only fall back to the skew-exposed absolute `_deadline`."""
+    from incubator_mxnet_tpu.serving.server import ModelServer
+    assert ModelServer._mono_deadline({"_deadline_mono": 123.5}) == 123.5
+    assert ModelServer._mono_deadline({}) is None
+    legacy = ModelServer._mono_deadline({"_deadline": time.time() + 10})
+    assert abs(legacy - (time.monotonic() + 10)) < 1.0
+
+
+def test_client_sends_relative_deadline_budget():
+    """Wall-clock skew must not shed valid requests: the wire stamp is
+    a relative ms budget, not the client's absolute unix time."""
+    from incubator_mxnet_tpu.serving.client import ServingClient
+
+    sent = {}
+
+    class _FakeConn:
+        def call(self, meta, payload):
+            sent.update(meta)
+            return {"ok": True, "models": []}, b""
+
+    c = ServingClient.__new__(ServingClient)
+    c._conn = _FakeConn()
+    c._call({"op": "serve.ping"}, deadline_ms=250)
+    assert sent["_deadline_ms"] == 250.0
+    assert "_deadline" not in sent
