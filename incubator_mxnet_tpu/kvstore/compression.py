@@ -1,13 +1,21 @@
-"""2-bit gradient compression with error-feedback residual.
+"""Gradient compression with error-feedback residual: 2-bit and top-k.
 
 Reference parity: src/kvstore/gradient_compression.cc:44-80 (stochastic 2-bit
 quantization to {-threshold, 0, +threshold} with residual accumulation),
 configured via Trainer(compression_params={'type': '2bit', 'threshold': t}).
 
-TPU-first: quantize/dequantize are jitted XLA programs; the packed wire
-format stores 16 2-bit codes per int32 word (same 16x ratio as the
-reference) for the PS/DCN path.
+Top-k sparsification (compression_params={'type': 'topk', 'k': k}) keeps
+only the k largest-magnitude entries of residual+gradient per key and
+carries everything else forward in the residual (error feedback, after
+Lin et al.'s Deep Gradient Compression) — the wire form is k (index,
+value) pairs, a 2N/(3k)-fold byte win over dense f32 for N-element keys.
+
+TPU-first: quantize/dequantize/top-k are jitted XLA programs; the packed
+2-bit wire format stores 16 2-bit codes per int32 word (same 16x ratio as
+the reference) for the PS/DCN path.
 """
+
+import functools as _functools
 
 import jax
 import jax.numpy as jnp
@@ -35,9 +43,6 @@ def _pack_2bit(q, threshold):
     return jnp.sum(codes << shifts, axis=1).astype(jnp.int32)
 
 
-import functools as _functools
-
-
 @_functools.partial(jax.jit, static_argnums=(2,))
 def _unpack_2bit(packed, threshold, n):
     shifts = jnp.arange(16, dtype=jnp.int32) * 2
@@ -47,24 +52,63 @@ def _unpack_2bit(packed, threshold, n):
                      jnp.where(codes == 2, -threshold, 0.0)).astype(jnp.float32)
 
 
+@_functools.partial(jax.jit, static_argnums=(2,))
+def _topk_sparsify(grad, residual, k):
+    """(residual+grad) -> (indices, values, new residual): the k
+    largest-|.| entries ship, the rest stay in the residual."""
+    r = residual + grad
+    _, idx = jax.lax.top_k(jnp.abs(r), k)
+    vals = r[idx]
+    res = r.at[idx].set(0.0)
+    return idx.astype(jnp.int32), vals.astype(jnp.float32), res
+
+
 class GradientCompression:
-    def __init__(self, type="2bit", threshold=0.5):  # noqa: A002
-        if type != "2bit":
-            raise ValueError("only '2bit' compression is supported (reference parity)")
+    def __init__(self, type="2bit", threshold=0.5, k=64):  # noqa: A002
+        if type not in ("2bit", "topk"):
+            raise ValueError(
+                "compression type must be '2bit' or 'topk', got %r" % (type,))
         self.type = type
         self.threshold = float(threshold)
+        self.k = int(k)
+        if self.type == "topk" and self.k < 1:
+            raise ValueError("topk compression needs k >= 1, got %d" % self.k)
         self._residuals = {}
 
     def compress(self, key, grad_val):
-        """grad_val: flat or shaped jax array -> quantized (same shape)."""
+        """grad_val: flat or shaped jax array -> compressed gradient of
+        the SAME shape (2bit: quantized; topk: all-but-k entries zeroed).
+        Updates this key's error-feedback residual."""
         shape = grad_val.shape
         flat = grad_val.reshape(-1)
         res = self._residuals.get(key)
         if res is None:
             res = jnp.zeros_like(flat)
+        if self.type == "topk":
+            kk = min(self.k, flat.shape[0])
+            idx, vals, res = _topk_sparsify(flat, res, kk)
+            self._residuals[key] = res
+            q = jnp.zeros_like(flat).at[idx].set(vals)
+            return q.reshape(shape)
         q, res = _quantize_2bit(flat, res, jnp.float32(self.threshold))
         self._residuals[key] = res
         return q.reshape(shape)
+
+    def sparsify(self, key, grad_val):
+        """Top-k wire form: (int32 flat indices, f32 values) of the k
+        largest-magnitude residual+gradient entries; the rest carry over
+        in this key's residual. One call = one compression event (same
+        residual contract as `compress`)."""
+        if self.type != "topk":
+            raise ValueError("sparsify() requires type='topk'")
+        flat = grad_val.reshape(-1)
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros_like(flat)
+        kk = min(self.k, flat.shape[0])
+        idx, vals, res = _topk_sparsify(flat, res, kk)
+        self._residuals[key] = res
+        return idx, vals
 
     def pack(self, q_val):
         return _pack_2bit(q_val.reshape(-1), jnp.float32(self.threshold))

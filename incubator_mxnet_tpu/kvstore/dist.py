@@ -17,11 +17,30 @@ Set MXTPU_PS_ASYNC_PUSH=0 for fully synchronous sends.
 
 Liveness: a background heartbeat thread beats the scheduler
 (`get_num_dead_node` surfaces stale peers); `barrier()` RAISES on timeout
-or when the scheduler reports a dead participant, instead of hanging."""
+or when the scheduler reports a dead participant, instead of hanging.
 
+Placement: keys map to servers by consistent hashing (md5 ring, 64 virtual
+nodes per server) instead of the reference's `hash(key) % n` — with
+MXTPU_PS_SHARDS=k each key is additionally row-sliced over its first k
+DISTINCT ring successors, so no single server is the byte bottleneck and
+adding a server remaps only ~1/n of the keys.
+
+Elastic membership (MXTPU_ELASTIC=1): a worker constructed mid-training
+bootstraps — it lists the keys each server holds, pulls current values,
+and starts its per-key round counters at each key's server generation, so
+its first push lands in the open sync round. A push rejected with
+`stale_epoch` refreshes the membership view from the scheduler and
+re-sends; every push is stamped with its per-key ROUND so server-side
+aggregation stays exact across retries and server restarts (see
+dist_server.py)."""
+
+import bisect
+import contextlib
+import hashlib
 import os
 import pickle
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -31,6 +50,7 @@ from .rpc import Connection
 from .dist_server import SchedulerClient
 from ..log import get_logger
 from ..ndarray import NDArray
+from ..resilience import watchdog as _wd
 from ..telemetry import catalog as _cat
 from ..telemetry import tracing as _tr
 from ..utils import failpoints as _fp
@@ -62,6 +82,20 @@ class KVStoreDist(KVStore):
         self._servers = [Connection(tuple(a)) for _, a in
                          sorted(nodes["servers"].items())]
         self._key_shard = {}
+        # sync-round stamping: each part-key's CURRENT round number plus
+        # the set of keys with an open (pushed, not yet pulled) round —
+        # read on the CALLER thread at push() time so the stamp order
+        # matches the per-key send order, advanced at pull() (see
+        # _round_stamp/_advance_round and dist_server.py aggregation)
+        self._push_round = {}
+        self._round_open = set()
+        self._shards_n = max(1, int(os.environ.get("MXTPU_PS_SHARDS",
+                                                   "1") or 1))
+        self._ring = self._ring_points(len(self._servers))
+        self._elastic = os.environ.get("MXTPU_ELASTIC", "0") == "1"
+        self._members = None     # worker-rank set of the current epoch
+        self._epoch = self._sched.epoch
+        self._mem_lock = threading.Lock()
         self._async_push = os.environ.get("MXTPU_PS_ASYNC_PUSH", "1") != "0"
         # one lane per server: sends to different servers overlap, sends on
         # one connection serialize (the Connection lock would anyway)
@@ -70,6 +104,11 @@ class KVStoreDist(KVStore):
         self._pending = {}       # key -> [futures]
         self._chain = {}         # key -> last submitted future (ordering)
         self._pending_lock = threading.Lock()
+        if self._elastic:
+            # membership-change notifications arrive on heartbeat replies
+            self._sched.on_epoch = lambda _ep: self._refresh_membership()
+            self._refresh_membership()
+            self._bootstrap()
 
     # -- identity ------------------------------------------------------------
     @property
@@ -84,9 +123,83 @@ class KVStoreDist(KVStore):
     def num_workers(self):
         return self._num_workers
 
+    @property
+    def epoch(self):
+        """Last membership epoch observed from the scheduler."""
+        return self._epoch
+
     def barrier(self, timeout=600):
         self._flush()
         self._sched.barrier("worker", timeout=timeout)
+
+    # -- elastic membership --------------------------------------------------
+    def _refresh_membership(self):
+        """Re-read the scheduler's epoch-numbered membership view (and
+        re-resolve server addresses while at it). Runs under the
+        watchdog's "membership" phase: a scheduler that stops answering
+        during a membership change surfaces as a watchdog fire, not a
+        silent stall."""
+        wd = _wd.current()
+        cm = wd.phase("membership") if wd is not None \
+            else contextlib.nullcontext()
+        with cm:
+            mem = self._sched.membership()
+        with self._mem_lock:
+            self._epoch = mem["epoch"]
+            self._members = set(mem["workers"])
+            for sid, addr in mem["servers"].items():
+                if 0 <= sid < len(self._servers):
+                    self._servers[sid].set_addr(addr)
+        _cat.membership_epoch.set(mem["epoch"])
+        _cat.membership_quorum.set(mem["quorum"])
+        return mem
+
+    def _bootstrap(self):
+        """Mid-training join: learn which keys the servers hold, start
+        this worker's per-key round counters at each key's current server
+        generation, and pull current parameter values — the joiner enters
+        the OPEN sync round with fresh weights instead of pushing into
+        round 0 of a fleet that is thousands of rounds in."""
+        t0 = time.time()
+        total = 0
+        found = {}               # part_key -> (sid, info)
+        for sid, conn in enumerate(self._servers):
+            meta, _ = conn.call_idempotent(
+                {"op": "list_keys", "rank": self._rank},
+                dedup=False, on_retry=self._refresh_conn)
+            if meta.get("error"):
+                raise RuntimeError("list_keys: %s" % meta["error"])
+            for pk, info in (meta.get("keys") or {}).items():
+                found[pk] = (sid, info)
+        if not found:
+            return               # fresh fleet: nothing to bootstrap
+        parts = {}               # base key -> [(lo, array)]
+        for pk, (sid, info) in found.items():
+            self._push_round[pk] = int(info.get("round", 0))
+            meta, payload = self._servers[sid].call_idempotent(
+                {"op": "pull", "key": pk, "rank": self._rank},
+                dedup=False, on_retry=self._refresh_conn)
+            if meta.get("error"):
+                raise RuntimeError("bootstrap pull(%r): %s"
+                                   % (pk, meta["error"]))
+            total += len(payload)
+            base, _, lo = pk.rpartition("@")
+            parts.setdefault(base, []).append(
+                (int(lo), np.frombuffer(payload, dtype=meta["dtype"])
+                 .reshape(meta["shape"])))
+        import jax.numpy as jnp
+        for base, ps in parts.items():
+            ps.sort(key=lambda t: t[0])
+            full = ps[0][1] if len(ps) == 1 else np.concatenate(
+                [a for _, a in ps], axis=0)
+            if base.lstrip("-").isdigit():
+                base = int(base)    # integer keys round-trip through "%s"
+            self._store[base] = NDArray(jnp.asarray(full))
+        _cat.bootstrap_bytes.observe(float(total))
+        _cat.bootstrap_seconds.observe(time.time() - t0)
+        _log.info("elastic bootstrap: rank %d pulled %d keys (%d bytes) "
+                  "in %.2fs", self._rank, len(found), total,
+                  time.time() - t0)
 
     def get_num_dead_node(self, node_id=0, timeout=None):
         from .dist_server import _DEAD_TIMEOUT
@@ -160,6 +273,27 @@ class KVStoreDist(KVStore):
             meta, payload if payload is not None else b"",
             on_retry=self._refresh_conn)
         if isinstance(rmeta, dict) and rmeta.get("error"):
+            if rmeta.get("stale_epoch"):
+                # the server's membership view has moved past ours (we
+                # just joined, or it just refreshed past an eviction):
+                # re-sync with the scheduler and re-send ONCE — if we are
+                # genuinely out of the membership, surface that clearly
+                self._refresh_membership()
+                if self._members is not None \
+                        and self._rank not in self._members:
+                    raise RuntimeError(
+                        "worker rank %d was evicted from membership "
+                        "epoch %d (missed heartbeats?) — restart to "
+                        "rejoin" % (self._rank, self._epoch))
+                rmeta, rpayload = conn.call_idempotent(
+                    meta, payload if payload is not None else b"",
+                    on_retry=self._refresh_conn)
+                if isinstance(rmeta, dict) and rmeta.get("error"):
+                    raise RuntimeError("%s(%r) after membership refresh: "
+                                       "%s" % (meta.get("op"),
+                                               meta.get("key"),
+                                               rmeta["error"]))
+                return rmeta, rpayload
             raise RuntimeError("%s(%r): %s" % (
                 meta.get("op"), meta.get("key"), rmeta["error"]))
         return rmeta, rpayload
@@ -207,24 +341,63 @@ class KVStoreDist(KVStore):
                        else {})
         return out
 
-    # -- key -> server placement (reference: EncodeDefaultKey) ---------------
+    # -- key -> server placement: consistent hashing -------------------------
+    # (replaces the reference's EncodeDefaultKey round-robin: a ring with
+    # virtual nodes keeps the byte load even AND remaps only ~1/n of the
+    # keys when a server is added — hash%n remaps almost all of them)
+    @staticmethod
+    def _ring_points(n, vnodes=64):
+        """The hash ring for n servers: sorted (point, server) pairs,
+        `vnodes` virtual nodes per server. Deterministic in n — every
+        worker computes the identical ring, so placement needs no
+        coordination."""
+        pts = []
+        for sid in range(n):
+            for v in range(vnodes):
+                d = hashlib.md5(b"srv-%d-%d" % (sid, v)).digest()
+                pts.append((int.from_bytes(d[:8], "big"), sid))
+        pts.sort()
+        return pts
+
+    def _ring_servers(self, key, k):
+        """The first k DISTINCT servers clockwise from the key's ring
+        point — the replica-walk that guarantees a k-way row slice really
+        lands on k different servers (plain vnode order can repeat one)."""
+        h = int.from_bytes(
+            hashlib.md5(str(key).encode("utf-8")).digest()[:8], "big")
+        i = bisect.bisect(self._ring, (h, -1))
+        out, seen = [], set()
+        for j in range(len(self._ring)):
+            sid = self._ring[(i + j) % len(self._ring)][1]
+            if sid not in seen:
+                seen.add(sid)
+                out.append(sid)
+                if len(out) == k:
+                    break
+        return out
+
     def _shards_for(self, key, shape):
         if key in self._key_shard:
             return self._key_shard[key]
         size = int(np.prod(shape)) if shape else 1
         n = len(self._servers)
-        if size < _BIGARRAY_BOUND or n == 1 or not shape:
-            sid = (key if isinstance(key, int) else abs(hash(key))) % n
-            shards = [(sid, 0, shape[0] if shape else 1)]
+        rows = shape[0] if shape else 1
+        if size >= _BIGARRAY_BOUND and shape and n > 1:
+            k = min(n, rows)     # big arrays always span the whole group
         else:
-            # split along axis 0 across all servers
-            rows = shape[0]
-            per = -(-rows // n)
+            # MXTPU_PS_SHARDS=k row-slices even small keys over k distinct
+            # servers so per-server push bytes stay balanced
+            k = max(1, min(self._shards_n, n, rows if shape else 1))
+        sids = self._ring_servers(key, k)
+        if k == 1:
+            shards = [(sids[0], 0, rows)]
+        else:
+            per = -(-rows // k)
             shards = []
-            for i in range(n):
+            for i, sid in enumerate(sids):
                 lo, hi = i * per, min((i + 1) * per, rows)
                 if lo < hi:
-                    shards.append((i, lo, hi))
+                    shards.append((sid, lo, hi))
         self._key_shard[key] = shards
         return shards
 
@@ -249,6 +422,29 @@ class KVStoreDist(KVStore):
     @staticmethod
     def _part_key(key, lo):
         return "%s@%d" % (key, lo)
+
+    def _round_stamp(self, part_key):
+        """This worker's round stamp for a push of `part_key`: the CURRENT
+        sync round. Repeated pushes before the next pull stamp the SAME
+        round — the server folds them into one aggregate that still waits
+        for every other rank (reference sum-into-the-open-round
+        semantics). The round closes on this worker at its next pull of
+        the key (_advance_round), so a post-pull push stamps the NEXT
+        round and a crash-retry can never merge into a restored stale
+        round. Read on the caller thread so stamps follow program order
+        even when the send runs on an I/O thread; a joiner's counters are
+        seeded by _bootstrap at the servers' current generation."""
+        self._round_open.add(part_key)
+        return self._push_round.get(part_key, 0)
+
+    def _advance_round(self, part_key):
+        """pull() closes the key's open round: the server's round-aware
+        pull wait just proved our contribution was applied (or we never
+        pushed — then there is nothing to close and no advance)."""
+        if part_key in self._round_open:
+            self._round_open.discard(part_key)
+            self._push_round[part_key] = \
+                self._push_round.get(part_key, 0) + 1
 
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
@@ -276,25 +472,43 @@ class KVStoreDist(KVStore):
             _cat.kvstore_pushes.inc(key=str(key))
             for sid, lo, hi in self._shards_for(key, arr.shape):
                 part = arr[lo:hi] if arr.ndim else arr
-                if compressed:
+                pk = self._part_key(key, lo)
+                if compressed and self._compression.type == "topk":
+                    # sparse wire form: int32 flat indices + f32 values of
+                    # the top-k error-fed residual entries; the server
+                    # scatters them dense before aggregating
                     import jax.numpy as jnp
-                    q = self._compression.compress(self._part_key(key, lo),
-                                                   jnp.asarray(part))
+                    idx, vals = self._compression.sparsify(
+                        pk, jnp.asarray(part, jnp.float32))
+                    meta = {"op": "push", "key": pk,
+                            "shape": list(part.shape), "dtype": "float32",
+                            "compressed": "topk", "nnz": int(idx.size),
+                            "rank": self._rank}
+                    payload = (np.ascontiguousarray(idx, np.int32).tobytes()
+                               + np.ascontiguousarray(vals,
+                                                      np.float32).tobytes())
+                elif compressed:
+                    import jax.numpy as jnp
+                    q = self._compression.compress(pk, jnp.asarray(part))
                     packed = np.asarray(self._compression.pack(q),
                                         dtype=np.int32)
-                    meta = {"op": "push", "key": self._part_key(key, lo),
+                    meta = {"op": "push", "key": pk,
                             "shape": list(part.shape), "dtype": "float32",
                             "compressed": True, "rank": self._rank}
                     payload = packed.tobytes()
                 else:
-                    meta = {"op": "push", "key": self._part_key(key, lo),
+                    meta = {"op": "push", "key": pk,
                             "shape": list(part.shape), "dtype": str(part.dtype),
                             "rank": self._rank}
                     payload = np.ascontiguousarray(part).tobytes()
+                if self._sync_mode:
+                    meta["round"] = self._round_stamp(pk)
                 # stamp trace ids HERE, on the caller thread: async sends
                 # run on I/O threads where the span context is gone
                 _tr.inject(meta)
-                _cat.kvstore_push_bytes.inc(len(payload))
+                # per-server label: the acceptance check that sharding
+                # actually splits the byte load reads this split
+                _cat.kvstore_push_bytes.inc(len(payload), server=str(sid))
                 conn = self._servers[sid]
                 self._submit(key, lambda c=conn, m=meta, p=payload:
                              self._checked_call(c, m, p))
@@ -316,12 +530,15 @@ class KVStoreDist(KVStore):
                 # gradient must not serialize a million JSON integers.
                 local = np.ascontiguousarray(ids[mask] - lo, dtype=np.int64)
                 part = np.ascontiguousarray(rows[mask])
-                meta = {"op": "push", "key": self._part_key(key, lo),
+                pk = self._part_key(key, lo)
+                meta = {"op": "push", "key": pk,
                         "shape": list(part.shape), "dtype": str(part.dtype),
                         "rows_n": int(local.size), "rank": self._rank}
+                if self._sync_mode:
+                    meta["round"] = self._round_stamp(pk)
                 payload = local.tobytes() + part.tobytes()
                 _tr.inject(meta)    # caller thread — see dense push
-                _cat.kvstore_push_bytes.inc(len(payload))
+                _cat.kvstore_push_bytes.inc(len(payload), server=str(sid))
                 conn = self._servers[sid]
                 self._submit(key, lambda c=conn, m=meta, p=payload:
                              self._checked_call(c, m, p))
@@ -350,6 +567,12 @@ class KVStoreDist(KVStore):
                 parts.append(np.frombuffer(payload, dtype=meta["dtype"])
                              .reshape(meta["shape"]))
         full = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+        if self._sync_mode:
+            # every shard's pull wait proved our round-r contribution was
+            # applied — close the round on ALL shards of the key so the
+            # next push stamps r+1
+            for sid, lo, hi in self._shards_for(key, shape):
+                self._advance_round(self._part_key(key, lo))
         import jax.numpy as jnp
         val = jnp.asarray(full)
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -385,6 +608,12 @@ class KVStoreDist(KVStore):
             _cat.kvstore_pull_bytes.inc(len(payload))
             rows_acc[mask] = np.frombuffer(payload, dtype=meta["dtype"]) \
                 .reshape(meta["shape"])
+        if self._sync_mode:
+            # close the round on EVERY shard (sparse pushes send zero-row
+            # messages to all of them; a shard skipped by this pull's row
+            # mask still advances — the server buffers rounds in order)
+            for sid, lo, hi in shards:
+                self._advance_round(self._part_key(key, lo))
         import jax.numpy as jnp
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:

@@ -10,7 +10,27 @@ Liveness model: every node heartbeats the scheduler (registration seeds the
 first beat). A node whose last beat is older than MXTPU_PS_DEAD_TIMEOUT
 (default 30 s) counts as dead; barriers abort with an error instead of
 hanging when a participant dies mid-wait (the reference's ps-lite hangs —
-VERDICT r1 called that out, so this build fails fast)."""
+VERDICT r1 called that out, so this build fails fast).
+
+Elastic membership (MXTPU_ELASTIC=1): the scheduler owns an epoch-numbered
+membership view — every worker join, graceful bye, and heartbeat-detected
+eviction advances the epoch and re-sizes the quorum, so barriers and
+sync-aggregation rounds complete over the workers OF THE CURRENT EPOCH
+instead of a launch-time constant (torch-elastic-style rendezvous over the
+Li et al. OSDI'14 parameter-server design). Epoch changes ride existing
+reply metadata (`_epoch`) — heartbeat replies double as the membership-
+change notification channel. Without the flag, the fixed-membership
+semantics above are unchanged.
+
+Sync-round correctness: every worker stamps each push with its per-key
+ROUND number. The server keeps one accumulator per (key, round) and only
+applies round R when R is the next unapplied round AND the quorum has
+contributed — a retried or early push can never be merged into a
+neighboring round. (The PR 1 ack race was exactly that merge: a pull reply
+could reveal an in-memory round completion whose snapshot never became
+durable; after restore, the puller's next-round push landed in the
+restored half-round and desynchronized the fleet by one round.)
+"""
 
 import logging
 import os
@@ -23,6 +43,7 @@ import numpy as np
 from .rpc import Server, request, Connection, ProtocolError, DedupCache
 from .compression import GradientCompression
 from .. import profiler as _server_profiler
+from ..telemetry import catalog as _cat
 from ..utils import failpoints as _fp
 
 __all__ = ["run_scheduler", "run_server", "SchedulerClient"]
@@ -33,8 +54,13 @@ _DEAD_TIMEOUT = float(os.environ.get("MXTPU_PS_DEAD_TIMEOUT", "30"))
 _BARRIER_POLL = 2.0
 
 
+def _elastic():
+    """Elastic membership on? (read per call: tests toggle the env var)"""
+    return os.environ.get("MXTPU_ELASTIC", "0") == "1"
+
+
 # ---------------------------------------------------------------------------
-# scheduler: rendezvous + barrier + liveness
+# scheduler: rendezvous + barrier + liveness + epoch membership
 # ---------------------------------------------------------------------------
 
 class _SchedulerState:
@@ -51,6 +77,13 @@ class _SchedulerState:
         self.heartbeats = {}       # (role, rank) -> last beat time
         self.tokens = {}           # role -> {client token -> rank}
         self.done = threading.Event()
+        # epoch-numbered membership: `active` is the worker-rank set of the
+        # current epoch; every membership change advances `epoch`. Worker
+        # ranks are never reused (monotonic counter) so a respawned worker
+        # is distinguishable from the one it replaces.
+        self.epoch = 0
+        self.active = set()
+        self.next_worker_rank = 0
 
     def dead_nodes(self, timeout=_DEAD_TIMEOUT):
         now = time.time()
@@ -60,6 +93,32 @@ class _SchedulerState:
 def run_scheduler(port, num_workers, num_servers, ready_event=None):
     """Blocking scheduler loop (run in its own process)."""
     state = _SchedulerState(num_workers, num_servers)
+
+    def _bump_epoch_locked():
+        state.epoch += 1
+        _cat.membership_epoch.set(state.epoch)
+        _cat.membership_quorum.set(len(state.active))
+        state.cv.notify_all()
+
+    def _evict_dead_locked(timeout=_DEAD_TIMEOUT):
+        """Elastic only: stale-heartbeat WORKERS leave the membership (and
+        the quorum shrinks) instead of poisoning every barrier. Dead
+        servers are never evicted — they hold state and must be replaced,
+        which the snapshot/rejoin path handles."""
+        if not _elastic():
+            return False
+        now = time.time()
+        changed = False
+        for (role, rank), t in list(state.heartbeats.items()):
+            if role == "worker" and rank in state.active \
+                    and now - t > timeout:
+                state.active.discard(rank)
+                state.heartbeats.pop((role, rank), None)
+                _cat.membership_evictions.inc()
+                changed = True
+        if changed:
+            _bump_epoch_locked()
+        return changed
 
     def handler(meta, payload):
         op = meta["op"]
@@ -77,16 +136,29 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
                     known = state.tokens.setdefault(role, {})
                     if tok is not None and tok in known:
                         rank = known[tok]
+                    elif role == "worker":
+                        rank = state.next_worker_rank
+                        state.next_worker_rank += 1
+                        if tok is not None:
+                            known[tok] = rank
                     else:
                         rank = len(table)
                         if tok is not None:
                             known[tok] = rank
+                elif role == "worker":
+                    state.next_worker_rank = max(state.next_worker_rank,
+                                                 rank + 1)
                 table[rank] = tuple(meta["addr"])
                 # registration seeds liveness: a node that dies before its
                 # first explicit beat still counts as dead later
                 state.heartbeats[(role, rank)] = time.time()
+                if role == "worker" and rank not in state.active:
+                    state.active.add(rank)
+                    _cat.membership_joins.inc()
+                    _bump_epoch_locked()
                 state.cv.notify_all()
-            return {"rank": rank}, b""
+                return {"rank": rank, "_epoch": state.epoch,
+                        "quorum": len(state.active)}, b""
         if op == "get_nodes":
             deadline = time.time() + meta.get("timeout", 60)
             with state.cv:
@@ -99,29 +171,59 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
                                     for k, v in state.servers.items()},
                         "workers": {str(k): list(v)
                                     for k, v in state.workers.items()}}, b""
+        if op == "membership":
+            # the epoch-numbered membership view (workers of the CURRENT
+            # epoch only); servers ride along so a refresh also re-resolves
+            # replaced server addresses
+            with state.cv:
+                _evict_dead_locked()
+                return {"ok": True, "epoch": state.epoch,
+                        "quorum": len(state.active),
+                        "workers": {str(r): list(state.workers[r])
+                                    for r in sorted(state.active)
+                                    if r in state.workers},
+                        "servers": {str(k): list(v)
+                                    for k, v in state.servers.items()},
+                        "_epoch": state.epoch}, b""
         if op == "barrier":
             group = meta.get("group", "worker")
             timeout = float(meta.get("timeout", 600))
-            n = state.num_workers if group == "worker" else state.num_servers
             deadline = time.time() + timeout
             with state.cv:
                 gen = state.barrier_gen.setdefault(group, 0)
-                state.barrier_count[group] = state.barrier_count.get(group, 0) + 1
-                if state.barrier_count[group] == n:
-                    state.barrier_count[group] = 0
-                    state.barrier_gen[group] = gen + 1
-                    state.cv.notify_all()
-                    return {"ok": True}, b""
-                while state.barrier_gen[group] == gen:
-                    if state.barrier_failed.get(group) == gen:
-                        return {"ok": False, "error": "dead_node",
-                                "dead": ["%s:%s" % k for k in
-                                         state.dead_nodes()]}, b""
+                state.barrier_count[group] = \
+                    state.barrier_count.get(group, 0) + 1
+                while True:
+                    if state.barrier_gen.get(group) != gen:
+                        # generation advanced without us completing it:
+                        # either the barrier failed, or a quorum shrink /
+                        # another waiter completed it
+                        if state.barrier_failed.get(group) == gen:
+                            return {"ok": False, "error": "dead_node",
+                                    "dead": ["%s:%s" % k for k in
+                                             state.dead_nodes()]}, b""
+                        return {"ok": True, "_epoch": state.epoch}, b""
+                    if group == "worker" and _elastic():
+                        # quorum = the CURRENT epoch's membership; evicting
+                        # a dead worker here shrinks it so the survivors
+                        # complete instead of deadlocking
+                        _evict_dead_locked()
+                        n = len(state.active)
+                    else:
+                        n = (state.num_workers if group == "worker"
+                             else state.num_servers)
+                    if n > 0 and state.barrier_count.get(group, 0) >= n:
+                        state.barrier_count[group] = 0
+                        state.barrier_gen[group] = gen + 1
+                        state.cv.notify_all()
+                        return {"ok": True, "_epoch": state.epoch}, b""
                     dead = state.dead_nodes()
                     if dead:
-                        # release every waiter of THIS generation with an
-                        # error and advance the generation so a later retry
-                        # (node recovered / replaced) starts clean
+                        # a dead non-evictable node (any server, or any
+                        # node in fixed-membership mode): release every
+                        # waiter of THIS generation with an error and
+                        # advance the generation so a later retry (node
+                        # recovered / replaced) starts clean
                         state.barrier_failed[group] = gen
                         state.barrier_gen[group] = gen + 1
                         state.barrier_count[group] = 0
@@ -135,25 +237,32 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
                                 "waiting": state.barrier_count.get(group, 0),
                                 "expected": n}, b""
                     state.cv.wait(timeout=_BARRIER_POLL)
-                if state.barrier_failed.get(group) == gen:
-                    # woken by the generation advancing BECAUSE it failed
-                    return {"ok": False, "error": "dead_node",
-                            "dead": ["%s:%s" % k
-                                     for k in state.dead_nodes()]}, b""
-                return {"ok": True}, b""
         if op == "heartbeat":
-            with state.lock:
+            with state.cv:
                 state.heartbeats[(meta["role"], meta["rank"])] = time.time()
-            return {"ok": True}, b""
+                _evict_dead_locked()
+                ep = state.epoch
+            # `_epoch` piggybacks on the beat's reply: the existing meta
+            # channel IS the membership-change notification path (clients
+            # watch it via Connection.on_epoch)
+            return {"ok": True, "_epoch": ep}, b""
         if op == "bye":
             # clean departure: stop counting this node for liveness so a
-            # finished worker is not later reported dead
-            with state.lock:
+            # finished worker is not later reported dead; a worker bye is
+            # a graceful membership departure (epoch advances, quorum
+            # shrinks)
+            with state.cv:
                 state.heartbeats.pop((meta["role"], meta["rank"]), None)
-            return {"ok": True}, b""
+                if meta["role"] == "worker" and \
+                        meta["rank"] in state.active:
+                    state.active.discard(meta["rank"])
+                    _cat.membership_departures.inc()
+                    _bump_epoch_locked()
+            return {"ok": True, "_epoch": state.epoch}, b""
         if op == "num_dead":
             timeout = meta.get("timeout", _DEAD_TIMEOUT)
-            with state.lock:
+            with state.cv:
+                _evict_dead_locked(timeout)
                 dead = len(state.dead_nodes(timeout))
             return {"num_dead": dead}, b""
         if op == "shutdown":
@@ -182,6 +291,20 @@ class SchedulerClient:
         self._token = uuid.uuid4().hex
         self._hb_thread = None
         self._hb_stop = threading.Event()
+        # last membership epoch seen in any scheduler reply; `on_epoch`
+        # (if set) fires from the heartbeat thread when it advances —
+        # the notification half of the elastic membership protocol
+        self.epoch = 0
+        self.on_epoch = None
+        self._conn.on_epoch = self._epoch_seen
+
+    def _epoch_seen(self, epoch):
+        if epoch == self.epoch:
+            return
+        self.epoch = epoch
+        cb = self.on_epoch
+        if cb is not None:
+            cb(epoch)
 
     def register(self, role, my_addr, rank=None):
         # bootstrap race: workers/servers may start before the scheduler's
@@ -207,6 +330,17 @@ class SchedulerClient:
         return {k: {int(r): tuple(a) for r, a in v.items()}
                 if isinstance(v, dict) else v for k, v in meta.items()}
 
+    def membership(self, timeout=10):
+        """The scheduler's current epoch-numbered membership view:
+        {"epoch", "quorum", "workers": {rank: addr}, "servers": {...}}."""
+        meta, _ = self._conn.call({"op": "membership"}, timeout=timeout)
+        return {"epoch": int(meta.get("epoch", 0)),
+                "quorum": int(meta.get("quorum", 0)),
+                "workers": {int(r): tuple(a) for r, a in
+                            (meta.get("workers") or {}).items()},
+                "servers": {int(r): tuple(a) for r, a in
+                            (meta.get("servers") or {}).items()}}
+
     def barrier(self, group="worker", timeout=600):
         # own connection: a barrier can block for minutes and must not
         # serialize against concurrent heartbeats on the shared socket
@@ -226,7 +360,10 @@ class SchedulerClient:
         self._conn.call({"op": "heartbeat", "role": role, "rank": rank})
 
     def start_heartbeats(self, role, rank, interval=None):
-        """Background liveness beats (reference: ps-lite Van heartbeat)."""
+        """Background liveness beats (reference: ps-lite Van heartbeat).
+        Beat replies carry the membership `_epoch`; `on_epoch` fires on
+        change, so every heartbeating node learns of joins/departures
+        within one beat interval with no extra traffic."""
         if self._hb_thread is not None:
             return
         interval = interval or float(
@@ -234,6 +371,7 @@ class SchedulerClient:
 
         def loop():
             conn = Connection(self.addr)   # dedicated socket
+            conn.on_epoch = self._epoch_seen
             failures = 0
             first_failure = None
             warned = False
@@ -269,7 +407,8 @@ class SchedulerClient:
         self._hb_stop.set()
 
     def bye(self, role, rank):
-        """Clean deregistration (stops liveness accounting for this node)."""
+        """Clean deregistration (stops liveness accounting for this node;
+        a worker bye is a graceful membership departure)."""
         self.stop_heartbeats()
         try:
             self._conn.call({"op": "bye", "role": role, "rank": rank},
@@ -297,8 +436,11 @@ class SchedulerClient:
 class _ServerState:
     def __init__(self, num_workers, sync_mode):
         self.store = {}          # key -> np.ndarray (the weights)
-        self.accum = {}          # key -> np.ndarray gradient sum (sync mode)
-        self.pending = {}        # key -> set of worker ranks in current round
+        # sync aggregation is ROUND-ADDRESSED: each key holds one
+        # [accumulator, contributed-rank-set] per not-yet-applied round,
+        # keyed by the round number the pushing worker stamped. push_gen is
+        # the next round to apply (== the count of applied rounds).
+        self.rounds = {}         # key -> {round: [accum | None, set(ranks)]}
         self.num_workers = num_workers
         self.sync_mode = sync_mode
         self.optimizer = None
@@ -306,8 +448,11 @@ class _ServerState:
         self.compression = None
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
-        self.push_gen = {}       # key -> generation (sync rounds)
+        self.push_gen = {}       # key -> next unapplied round index
         self.done = threading.Event()
+        # elastic membership view (None => fixed launch-time quorum)
+        self.members = None      # set of worker ranks of the current epoch
+        self.epoch = 0
 
 
 def _decode(meta, payload):
@@ -328,9 +473,10 @@ def _pickle_allowed(meta):
 class _ServerSnapshot:
     """Durable server state via utils.checkpoint's atomic-rename writer.
 
-    Persists the key→value store, in-flight sync-round accumulators and
-    pending sets, the optimizer (registry spec when JSON-clean, pickle
-    otherwise), this server's RANK, and the idempotency dedup windows —
+    Persists the key→value store, the per-round in-flight sync
+    accumulators and contributed-rank sets, the optimizer (registry spec
+    when JSON-clean, pickle otherwise), this server's RANK, the
+    membership epoch view, and the idempotency dedup windows —
     everything a replacement process needs to rejoin under the old rank
     and keep retried pushes exactly-once.
 
@@ -366,16 +512,25 @@ class _ServerSnapshot:
         acked update on restore or double-applies a retried one)."""
         state = self._state
         params = {}
-        extra = {"rank": self.rank, "sync_mode": state.sync_mode}
+        extra = {"rank": self.rank, "sync_mode": state.sync_mode,
+                 "format": 2}
         with state.lock:
             for k, v in state.store.items():
                 params["store/%s" % k] = v.copy()
-            for k, v in state.accum.items():
-                if v is not None:
-                    params["accum/%s" % k] = v.copy()
-            extra["pending"] = {k: sorted(v)
-                                for k, v in state.pending.items() if v}
+            rounds_meta = {}
+            for k, by_round in state.rounds.items():
+                ent = {}
+                for r, (acc, pend) in by_round.items():
+                    if acc is not None:
+                        params["round/%d/%s" % (r, k)] = acc.copy()
+                    ent[str(r)] = sorted(pend)
+                if ent:
+                    rounds_meta[k] = ent
+            extra["rounds"] = rounds_meta
             extra["push_gen"] = dict(state.push_gen)
+            extra["epoch"] = state.epoch
+            extra["members"] = (sorted(state.members)
+                                if state.members is not None else None)
             opt = state.optimizer
         trainer_payload = None
         if opt is not None:
@@ -400,16 +555,32 @@ class _ServerSnapshot:
         state = self._state
         with state.cv:
             state.store = {}
-            state.accum = {}
+            state.rounds = {}
+            accums = {}
             for k, v in params.items():
                 arr = np.asarray(v.asnumpy())
                 if k.startswith("store/"):
                     state.store[k[len("store/"):]] = arr
+                elif k.startswith("round/"):
+                    _, r, key = k.split("/", 2)
+                    accums[(key, int(r))] = arr
                 elif k.startswith("accum/"):
-                    state.accum[k[len("accum/"):]] = arr
-            state.pending = {k: set(v)
-                             for k, v in (meta.get("pending") or {}).items()}
+                    # format-1 snapshot: single open round per key
+                    accums[(k[len("accum/"):], None)] = arr
             state.push_gen = dict(meta.get("push_gen") or {})
+            for key, by_round in (meta.get("rounds") or {}).items():
+                for r, pend in by_round.items():
+                    r = int(r)
+                    state.rounds.setdefault(key, {})[r] = [
+                        accums.pop((key, r), None), set(pend)]
+            for key, pend in (meta.get("pending") or {}).items():
+                # format-1 snapshot: the open round is push_gen[key]
+                gen = int(state.push_gen.get(key, 0))
+                state.rounds.setdefault(key, {})[gen] = [
+                    accums.pop((key, None), None), set(pend)]
+            state.epoch = int(meta.get("epoch") or 0)
+            members = meta.get("members")
+            state.members = set(members) if members is not None else None
             opt = None
             if meta.get("optimizer_spec"):
                 from .optimizer_spec import optimizer_from_spec
@@ -475,6 +646,7 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
     workers retrying through `call_idempotent` reconnect to the new
     address from the scheduler and training continues."""
     state = _ServerState(num_workers, sync_mode)
+    sched_box = {"client": None}    # filled after registration
 
     def apply_update(key, agg):
         """Run the server-side optimizer or plain assignment."""
@@ -487,6 +659,52 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             state.store[key] = np.asarray(w._data)
         else:
             state.store[key] = agg.copy()
+
+    def _quorum_met_locked(pend):
+        """Has the sync round got every required contribution? Fixed mode
+        counts distinct ranks against the launch constant; elastic mode
+        requires every worker OF THE CURRENT EPOCH (extra contributions
+        from since-departed ranks stay in the sum — they were valid when
+        pushed)."""
+        if state.members is None:
+            return len(pend) >= state.num_workers
+        return bool(state.members) and state.members <= pend
+
+    def _cascade_locked(key):
+        """Apply every consecutive completed round starting at push_gen.
+        Rounds are applied strictly in order — a buffered future round
+        (fast worker) waits for the open one no matter how full it is."""
+        by_round = state.rounds.get(key)
+        while by_round:
+            gen = state.push_gen.get(key, 0)
+            ent = by_round.get(gen)
+            if ent is None or ent[0] is None \
+                    or not _quorum_met_locked(ent[1]):
+                return
+            apply_update(key, ent[0])
+            del by_round[gen]
+            state.push_gen[key] = gen + 1
+            state.cv.notify_all()
+
+    def _refresh_members():
+        """Pull the scheduler's membership view into the aggregation
+        quorum and re-check every open round — a shrink may complete
+        rounds that were waiting on a dead worker."""
+        sched = sched_box["client"]
+        if sched is None or not _elastic():
+            return
+        try:
+            mem = sched.membership()
+        except (OSError, ConnectionError, ProtocolError, KeyError):
+            return
+        with state.cv:
+            state.members = set(mem["workers"])
+            state.epoch = mem["epoch"]
+            for key in list(state.rounds):
+                _cascade_locked(key)
+            state.cv.notify_all()
+        _cat.membership_epoch.set(mem["epoch"])
+        _cat.membership_quorum.set(mem["quorum"])
 
     def _profiler_command(meta):
         """Server-side profiler control (reference: kvstore.h:385
@@ -556,6 +774,36 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             return out
         return deduped(meta, payload)
 
+    def _decode_push_payload(meta, payload, full_shape):
+        """Dense gradient from any wire encoding: raw f32, 2-bit packed,
+        or top-k (index,value) pairs. Returns (rows, arr): rows is the
+        row-sparse id vector (None for dense)."""
+        rows = meta.get("rows")          # legacy JSON ids
+        if meta.get("rows_n") is not None:
+            n = int(meta["rows_n"])
+            rows = np.frombuffer(payload[:8 * n], dtype=np.int64)
+            payload = payload[8 * n:]
+        comp = meta.get("compressed")
+        if comp == "topk":
+            # self-describing sparse encoding: int32 flat indices + f32
+            # values scattered into a dense gradient server-side
+            n = int(meta.get("nnz", 0))
+            idx = np.frombuffer(payload[:4 * n], dtype=np.int32)
+            vals = np.frombuffer(payload[4 * n:4 * n + 4 * n],
+                                 dtype=np.float32)
+            arr = np.zeros(int(np.prod(meta["shape"])), dtype=np.float32)
+            np.add.at(arr, idx.astype(np.int64), vals)
+            arr = arr.reshape(meta["shape"])
+        elif comp and state.compression is not None:
+            import jax.numpy as jnp
+            packed = jnp.asarray(np.frombuffer(payload, dtype=np.int32))
+            arr = np.asarray(state.compression.unpack(
+                packed, int(np.prod(meta["shape"])),
+                tuple(meta["shape"])))
+        else:
+            arr = _decode(meta, payload)
+        return rows, arr
+
     def _handle(meta, payload):
         op = meta["op"]
         if op == "init":
@@ -572,18 +820,19 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             if d:
                 time.sleep(float(d))
             key = meta["key"]
-            rows = meta.get("rows")          # legacy JSON ids
-            if meta.get("rows_n") is not None:
-                n = int(meta["rows_n"])
-                rows = np.frombuffer(payload[:8 * n], dtype=np.int64)
-                payload = payload[8 * n:]
-            if meta.get("compressed") and state.compression is not None:
-                import jax.numpy as jnp
-                packed = jnp.asarray(np.frombuffer(payload, dtype=np.int32))
-                arr = np.asarray(state.compression.unpack(
-                    packed, int(np.prod(meta["shape"])), tuple(meta["shape"])))
-            else:
-                arr = _decode(meta, payload)
+            rank = meta.get("rank")
+            if state.members is not None and rank is not None \
+                    and rank not in state.members:
+                # the pusher is not in OUR epoch's membership: either we
+                # are behind (it just joined — refresh fixes it) or the
+                # pusher was evicted (it must refresh and rejoin)
+                _refresh_members()
+                if rank not in (state.members or ()):
+                    return {"error": "stale_epoch: rank %s is not in "
+                                     "membership epoch %d" % (rank,
+                                                              state.epoch),
+                            "stale_epoch": True,
+                            "_epoch": state.epoch}, b""
             with state.cv:
                 if key not in state.store:
                     return {"error": "push(%r) before init" % key}, b""
@@ -594,27 +843,37 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                     # dependency graph sequences ApplyUpdates; a blocking
                     # push couples the workers' key orders and deadlocks
                     # when sends race) — aggregation completes when the
-                    # last worker's push lands, and PULL waits for it
-                    pend = state.pending.setdefault(key, set())
-                    rank = meta.get("rank")
+                    # open round has every quorum contribution, and PULL
+                    # waits for it
                     if rank is None:
                         # a synthetic rank could collide with a real one and
                         # stall (or early-complete) the round — reject, the
                         # worker's _checked_call surfaces this immediately
                         return {"error": "sync push(%r) without a rank"
                                          % key}, b""
-                    # a second push from one rank ACCUMULATES (same as async
-                    # and local aggregation), but the round only completes
-                    # when every DISTINCT rank has contributed — a
-                    # double-pushing worker must never complete the round
-                    # early with another worker's gradient missing. Pushes
-                    # land in the round open at arrival: the transport never
-                    # retries (rpc.py), so in sync mode each worker must
-                    # push each key exactly once per round (the Trainer
-                    # does); a user-level retry after an error is NOT
-                    # idempotent (same property as the reference server's
-                    # raw merge counting).
-                    acc = state.accum.get(key)
+                    gen = state.push_gen.get(key, 0)
+                    r = meta.get("round")
+                    r = gen if r is None else int(r)
+                    if r < gen:
+                        # the worker stamped this before it observed the
+                        # round completing (it hasn't pulled since) — fold
+                        # into the OPEN round. Safe: a wire retry whose
+                        # original apply is durable never reaches here (the
+                        # dedup cache replays it, and the dedup entry rides
+                        # the same snapshot as the apply), so this is a NEW
+                        # logical push joining the current round. Stamps
+                        # AHEAD of gen (r > gen) buffer instead: after a
+                        # restore they must never merge into the restored
+                        # stale round (the PR 1 race).
+                        r = gen
+                    rows, arr = _decode_push_payload(meta, payload,
+                                                     full_shape)
+                    by_round = state.rounds.setdefault(key, {})
+                    ent = by_round.get(r)
+                    if ent is None:
+                        ent = [None, set()]
+                        by_round[r] = ent
+                    acc = ent[0]
                     if acc is None:
                         acc = np.zeros(full_shape, np.float32)
                     if rows is not None:
@@ -624,16 +883,12 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                                   arr.astype(np.float32))
                     else:
                         acc = acc + arr.astype(np.float32)
-                    pend.add(rank)
-                    if len(pend) == state.num_workers:
-                        apply_update(key, acc)
-                        state.accum[key] = None
-                        state.pending[key] = set()
-                        state.push_gen[key] = state.push_gen.get(key, 0) + 1
-                        state.cv.notify_all()
-                    else:
-                        state.accum[key] = acc
+                    ent[0] = acc
+                    ent[1].add(rank)
+                    _cascade_locked(key)
                 else:
+                    rows, arr = _decode_push_payload(meta, payload,
+                                                     full_shape)
                     if rows is not None:
                         g = np.zeros(full_shape, np.float32)
                         np.add.at(g, np.asarray(rows, np.int64),
@@ -650,14 +905,17 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                     # contribution sits in a not-yet-applied round. A fast
                     # worker's next-round push must not stall a slow
                     # worker's pull for the previous round (its rank is not
-                    # in the new round's pending set, so it sails through).
+                    # in any open round's set, so it sails through).
                     rank = meta.get("rank", -1)
                     deadline = time.time() + 600
-                    while rank in state.pending.get(key, ()):
+                    while any(rank in ent[1] for ent in
+                              state.rounds.get(key, {}).values()):
                         if time.time() > deadline:
                             return {"error": "pull timed out waiting for "
                                              "aggregation of %r" % key}, b""
                         state.cv.wait(timeout=_BARRIER_POLL)
+                if key not in state.store:
+                    return {"error": "pull(%r) before init" % key}, b""
                 arr = state.store[key]
             rows = meta.get("rows")
             if meta.get("rows_n") is not None:
@@ -667,6 +925,16 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
                 arr = arr[np.asarray(rows, dtype=np.int64)]
             return ({"shape": list(arr.shape), "dtype": str(arr.dtype)},
                     np.ascontiguousarray(arr).tobytes())
+        if op == "list_keys":
+            # joiner bootstrap: which keys live HERE, and which round each
+            # is at — the joining worker pulls current values and starts
+            # its per-key round counters at the server's generation
+            with state.lock:
+                keys = {k: {"round": int(state.push_gen.get(k, 0)),
+                            "shape": list(v.shape),
+                            "dtype": str(v.dtype)}
+                        for k, v in state.store.items()}
+            return {"ok": True, "keys": keys, "_epoch": state.epoch}, b""
         if op == "set_optimizer_spec":
             # registry-token form: class name + JSON-clean attrs, rebuilt
             # through the optimizer registry — NO code crosses the wire
@@ -707,10 +975,16 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
     srv = Server(handler, port=port,
                  host=os.environ.get("DMLC_NODE_HOST", "127.0.0.1")).start()
     sched = SchedulerClient(tuple(scheduler_addr))
+    sched_box["client"] = sched
     # a replacement server claims its predecessor's rank: the scheduler
     # updates that rank's address in place, so workers re-resolving via
     # get_nodes find the new process where the old one lived
     rank = sched.register("server", srv.addr, rank=restored_rank)
+    if _elastic():
+        # seed the aggregation quorum from the live membership view and
+        # keep it fresh: epoch changes arrive on heartbeat replies
+        sched.on_epoch = lambda _ep: _refresh_members()
+        _refresh_members()
     sched.start_heartbeats("server", rank)
     if snap is not None:
         snap.rank = rank

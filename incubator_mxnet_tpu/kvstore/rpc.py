@@ -17,6 +17,7 @@ without touching the KVStore semantics layered above.
 
 import itertools
 import json
+import logging
 import os
 import socket
 import struct
@@ -29,6 +30,8 @@ from ..telemetry import catalog as _cat
 from ..telemetry import metrics as _met
 from ..telemetry import tracing as _tr
 from ..utils import failpoints as _fp
+
+_log = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<I")
 
@@ -141,6 +144,12 @@ class Connection:
         self._client_token = uuid.uuid4().hex
         self._seq = itertools.count(1)
         self._connected_once = False
+        # membership-change notification channel: any reply whose meta
+        # carries `_epoch` (scheduler/server piggyback) advances the
+        # observed epoch; `on_epoch` (if set) fires on change, outside
+        # the connection lock
+        self.on_epoch = None
+        self._seen_epoch = None
 
     def _ensure(self):
         if self._sock is None:
@@ -172,8 +181,22 @@ class Connection:
             # deadline (stack+telemetry dump) even when the socket
             # timeout is long/None
             with wd.phase("rpc"):
-                return self._call_metered(obj, payload, timeout)
-        return self._call_metered(obj, payload, timeout)
+                out = self._call_metered(obj, payload, timeout)
+        else:
+            out = self._call_metered(obj, payload, timeout)
+        meta = out[0]
+        if isinstance(meta, dict):
+            ep = meta.get("_epoch")
+            if ep is not None and ep != self._seen_epoch:
+                self._seen_epoch = ep
+                cb = self.on_epoch
+                if cb is not None:
+                    try:
+                        cb(ep)
+                    except Exception:   # noqa: BLE001 — a notification
+                        _log.debug(     # observer must not fail the call
+                            "on_epoch callback failed", exc_info=True)
+        return out
 
     def _call_metered(self, obj, payload=b"", timeout=None):
         if not _met.enabled():

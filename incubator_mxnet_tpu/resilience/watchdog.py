@@ -75,6 +75,11 @@ class Watchdog:
         dataloader (``MXTPU_WATCHDOG_BATCH_TIMEOUT``, default 300).
     rpc_timeout : seconds one RPC round-trip may take
         (``MXTPU_WATCHDOG_RPC_TIMEOUT``, default 300).
+    membership_timeout : seconds a membership refresh / elastic
+        bootstrap against the scheduler may take
+        (``MXTPU_WATCHDOG_MEMBERSHIP_TIMEOUT``, default 300) — a
+        scheduler that wedges mid-membership-change surfaces here
+        instead of stalling the worker silently.
     poll : monitor wake period (``MXTPU_WATCHDOG_POLL``, default 1.0).
     sigterm : on expiry, SIGTERM the process after dumping
         (``MXTPU_WATCHDOG_SIGTERM``, default off) — with a
@@ -94,13 +99,15 @@ class Watchdog:
 
     _DEFAULTS = {"step": ("MXTPU_WATCHDOG_STEP_TIMEOUT", 600.0),
                  "batch_wait": ("MXTPU_WATCHDOG_BATCH_TIMEOUT", 300.0),
-                 "rpc": ("MXTPU_WATCHDOG_RPC_TIMEOUT", 300.0)}
+                 "rpc": ("MXTPU_WATCHDOG_RPC_TIMEOUT", 300.0),
+                 "membership": ("MXTPU_WATCHDOG_MEMBERSHIP_TIMEOUT", 300.0)}
 
     def __init__(self, step_timeout=None, batch_timeout=None,
-                 rpc_timeout=None, poll=None, sigterm=None, dump_path=None,
+                 rpc_timeout=None, membership_timeout=None,
+                 poll=None, sigterm=None, dump_path=None,
                  install=True):
         explicit = {"step": step_timeout, "batch_wait": batch_timeout,
-                    "rpc": rpc_timeout}
+                    "rpc": rpc_timeout, "membership": membership_timeout}
         self._timeouts = {}
         for phase, (env, dflt) in self._DEFAULTS.items():
             t = explicit[phase]

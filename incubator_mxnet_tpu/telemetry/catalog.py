@@ -51,6 +51,35 @@ kvstore_push_bytes = _m.counter(
 kvstore_pull_bytes = _m.counter(
     "mxtpu_kvstore_pull_bytes_total", "Payload bytes pulled from servers")
 
+# -- elastic membership (kvstore/dist_server.py, kvstore/dist.py) ----
+membership_epoch = _m.gauge(
+    "mxtpu_membership_epoch",
+    "Current epoch of the scheduler's membership view (advances on every "
+    "worker join, graceful departure, or heartbeat eviction)")
+membership_quorum = _m.gauge(
+    "mxtpu_membership_quorum",
+    "Worker count of the current membership epoch — the barrier and "
+    "sync-round completion quorum under MXTPU_ELASTIC=1")
+membership_joins = _m.counter(
+    "mxtpu_membership_joins_total",
+    "Workers that joined the membership (initial registration and "
+    "mid-training elastic joins)")
+membership_departures = _m.counter(
+    "mxtpu_membership_departures_total",
+    "Graceful worker departures (bye) that shrank the membership")
+membership_evictions = _m.counter(
+    "mxtpu_membership_evictions_total",
+    "Workers evicted from the membership after missing heartbeats past "
+    "MXTPU_PS_DEAD_TIMEOUT")
+bootstrap_bytes = _m.histogram(
+    "mxtpu_bootstrap_bytes",
+    "Parameter bytes a joining worker pulled from the servers to enter "
+    "the sync round",
+    buckets=(1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9))
+bootstrap_seconds = _m.histogram(
+    "mxtpu_bootstrap_seconds",
+    "Wall time of a joining worker's parameter bootstrap")
+
 # -- trainer (parallel/trainer.py) -----------------------------------
 trainer_steps = _m.counter(
     "mxtpu_trainer_steps_total",

@@ -1,0 +1,415 @@
+"""Elastic training fabric (ISSUE 7): consistent-hash placement units,
+epoch-numbered membership units, top-k gradient compression units, and
+the "train_smoke" acceptance drills — per-server push-byte split under
+MXTPU_PS_SHARDS=2 and the top-k wire-byte win.
+
+The chaos acceptance drill (SIGKILL a worker mid-round + mid-training
+join) lives in test_ps_fault_tolerance.py::test_elastic_chaos_drill.
+"""
+
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring units
+# ---------------------------------------------------------------------------
+
+def _fake_kv(n, shards_n=1):
+    """A stand-in carrying exactly the state the placement methods read —
+    the ring math needs no scheduler/socket."""
+    class _F(object):
+        _ring_servers = KVStoreDist._ring_servers
+        _shards_for = KVStoreDist._shards_for
+    f = _F()
+    f._servers = [None] * n
+    f._shards_n = shards_n
+    f._key_shard = {}
+    f._ring = KVStoreDist._ring_points(n)
+    return f
+
+
+KEYS = ["layer%d_weight" % i for i in range(1500)] + list(range(500))
+
+
+def test_ring_deterministic_and_sorted():
+    a = KVStoreDist._ring_points(4)
+    b = KVStoreDist._ring_points(4)
+    assert a == b                       # every worker computes the same ring
+    assert a == sorted(a)
+    assert len(a) == 4 * 64             # 64 vnodes per server
+    assert {sid for _, sid in a} == {0, 1, 2, 3}
+
+
+def test_ring_distribution_balance():
+    """No server owns a pathological share of the key space."""
+    n = 8
+    f = _fake_kv(n)
+    counts = {s: 0 for s in range(n)}
+    for k in KEYS:
+        counts[KVStoreDist._ring_servers(f, k, 1)[0]] += 1
+    shares = {s: c / float(len(KEYS)) for s, c in counts.items()}
+    for s, share in shares.items():
+        assert 0.03 < share < 0.30, (s, shares)
+
+
+def test_ring_minimal_remap_on_grow():
+    """N -> N+1 servers moves only ~1/(N+1) of the keys, and every moved
+    key moves TO the new server (the old servers' vnodes are unchanged,
+    so a changed primary can only be a new vnode)."""
+    f8, f9 = _fake_kv(8), _fake_kv(9)
+    moved = 0
+    for k in KEYS:
+        old = KVStoreDist._ring_servers(f8, k, 1)[0]
+        new = KVStoreDist._ring_servers(f9, k, 1)[0]
+        if old != new:
+            moved += 1
+            assert new == 8, (k, old, new)
+    frac = moved / float(len(KEYS))
+    assert 0.0 < frac < 0.30, frac      # theory ~1/9 ~= 0.11
+
+
+def test_ring_replica_walk_distinct():
+    f = _fake_kv(5)
+    for k in KEYS[:200]:
+        sids = KVStoreDist._ring_servers(f, k, 3)
+        assert len(sids) == 3
+        assert len(set(sids)) == 3      # k-way slice -> k DIFFERENT servers
+
+
+def test_shards_for_row_slices():
+    f = _fake_kv(4, shards_n=2)
+    shards = KVStoreDist._shards_for(f, "w", (7, 3))
+    assert len(shards) == 2
+    assert len({sid for sid, _, _ in shards}) == 2
+    # the row slices partition [0, rows) exactly, in order
+    assert shards[0][1] == 0 and shards[-1][2] == 7
+    for (_, _, hi), (_, lo, _) in zip(shards, shards[1:]):
+        assert hi == lo
+    # cached: placement is computed once per key
+    assert KVStoreDist._shards_for(f, "w", (7, 3)) is shards
+
+
+def test_shards_for_big_array_spans_group():
+    f = _fake_kv(4, shards_n=1)
+    shards = KVStoreDist._shards_for(f, "big", (1000, 1000))   # >= BIGARRAY
+    assert len(shards) == 4
+    assert len({sid for sid, _, _ in shards}) == 4
+    assert shards[0][1] == 0 and shards[-1][2] == 1000
+    total = sum(hi - lo for _, lo, hi in shards)
+    assert total == 1000
+
+
+def test_shards_for_small_key_single_server():
+    f = _fake_kv(4, shards_n=1)
+    shards = KVStoreDist._shards_for(f, "tiny", (8,))
+    assert len(shards) == 1
+    assert shards[0][1:] == (0, 8)
+
+
+# ---------------------------------------------------------------------------
+# epoch-numbered membership units (in-thread scheduler)
+# ---------------------------------------------------------------------------
+
+def _start_scheduler(num_workers=2, num_servers=1):
+    from incubator_mxnet_tpu.kvstore.dist_server import run_scheduler
+    port = _free_port()
+    t = threading.Thread(target=run_scheduler,
+                         args=(port, num_workers, num_servers), daemon=True)
+    t.start()
+    time.sleep(0.2)
+    return port
+
+
+def _client(port):
+    from incubator_mxnet_tpu.kvstore.dist_server import SchedulerClient
+    return SchedulerClient(("127.0.0.1", port))
+
+
+def test_epoch_bumps_on_join_and_departure(monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC", "1")
+    port = _start_scheduler()
+    admin = _client(port)
+    try:
+        w0, w1 = _client(port), _client(port)
+        assert w0.register("worker", ("127.0.0.1", 0)) == 0
+        assert w1.register("worker", ("127.0.0.1", 0)) == 1
+        mem = admin.membership()
+        assert mem["epoch"] == 2        # one bump per join
+        assert mem["quorum"] == 2
+        assert sorted(mem["workers"]) == [0, 1]
+
+        # graceful departure: quorum shrinks, epoch advances
+        w1._conn.call({"op": "bye", "role": "worker", "rank": 1})
+        mem = admin.membership()
+        assert mem["epoch"] == 3
+        assert mem["quorum"] == 1
+        assert sorted(mem["workers"]) == [0]
+
+        # a NEW joiner gets a FRESH rank — worker ranks are never reused
+        w2 = _client(port)
+        assert w2.register("worker", ("127.0.0.1", 0)) == 2
+        mem = admin.membership()
+        assert mem["epoch"] == 4
+        assert mem["quorum"] == 2
+        assert sorted(mem["workers"]) == [0, 2]
+
+        # retried registration (same client token) does NOT bump the epoch
+        assert w2.register("worker", ("127.0.0.1", 0)) == 2
+        assert admin.membership()["epoch"] == 4
+    finally:
+        admin.shutdown()
+
+
+def test_heartbeat_eviction_shrinks_quorum(monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC", "1")
+    port = _start_scheduler()
+    admin = _client(port)
+    try:
+        w0, w1 = _client(port), _client(port)
+        w0.register("worker", ("127.0.0.1", 0))
+        w1.register("worker", ("127.0.0.1", 0))
+        epoch0 = admin.membership()["epoch"]
+        time.sleep(1.0)
+        w0.heartbeat("worker", 0)       # w0 stays fresh; w1 goes stale
+        assert admin.num_dead_nodes(0.8) == 0   # stale w1 was EVICTED
+        mem = admin.membership()
+        assert mem["epoch"] == epoch0 + 1
+        assert mem["quorum"] == 1
+        assert sorted(mem["workers"]) == [0]
+    finally:
+        admin.shutdown()
+
+
+def test_no_eviction_without_elastic(monkeypatch):
+    """Fixed-membership mode keeps the PR 1 contract: a stale worker is
+    REPORTED dead (barriers abort), never silently evicted."""
+    monkeypatch.delenv("MXTPU_ELASTIC", raising=False)
+    port = _start_scheduler()
+    admin = _client(port)
+    try:
+        w0, w1 = _client(port), _client(port)
+        w0.register("worker", ("127.0.0.1", 0))
+        w1.register("worker", ("127.0.0.1", 0))
+        time.sleep(1.0)
+        w0.heartbeat("worker", 0)
+        assert admin.num_dead_nodes(0.8) == 1   # reported, not evicted
+        assert admin.membership()["quorum"] == 2
+    finally:
+        admin.shutdown()
+
+
+def test_epoch_piggybacks_on_heartbeat_reply(monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC", "1")
+    port = _start_scheduler()
+    admin = _client(port)
+    try:
+        w0 = _client(port)
+        w0.register("worker", ("127.0.0.1", 0))
+        seen = []
+        w0.on_epoch = seen.append
+        _client(port).register("worker", ("127.0.0.1", 0))   # epoch bump
+        w0.heartbeat("worker", 0)       # reply carries the new _epoch
+        assert seen and seen[-1] == admin.membership()["epoch"]
+        assert w0.epoch == seen[-1]
+    finally:
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# top-k gradient compression units
+# ---------------------------------------------------------------------------
+
+def test_topk_sparsify_picks_largest_and_keeps_residual():
+    from incubator_mxnet_tpu.kvstore.compression import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression(type="topk", k=3)
+    g = jnp.asarray([0.1, -5.0, 3.0, -0.2, 0.3, 2.0], jnp.float32)
+    idx, vals = gc.sparsify("w", g)
+    assert sorted(np.asarray(idx).tolist()) == [1, 2, 5]
+    got = dict(zip(np.asarray(idx).tolist(), np.asarray(vals).tolist()))
+    assert got[1] == pytest.approx(-5.0) and got[2] == pytest.approx(3.0)
+    # error feedback: a zero gradient still ships the carried residual
+    idx2, vals2 = gc.sparsify("w", jnp.zeros(6, jnp.float32))
+    assert sorted(np.asarray(idx2).tolist()) == [0, 3, 4]
+    total = float(np.abs(vals).sum() + np.abs(vals2).sum())
+    assert total == pytest.approx(float(np.abs(np.asarray(g)).sum()))
+
+
+def test_topk_residuals_are_per_key():
+    from incubator_mxnet_tpu.kvstore.compression import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression(type="topk", k=1)
+    gc.sparsify("a", jnp.asarray([1.0, 2.0], jnp.float32))
+    idx, vals = gc.sparsify("b", jnp.asarray([3.0, 0.0], jnp.float32))
+    assert np.asarray(idx).tolist() == [0]     # 'a' residual never leaks in
+    assert np.asarray(vals).tolist() == pytest.approx([3.0])
+
+
+def test_topk_compress_dense_form():
+    from incubator_mxnet_tpu.kvstore.compression import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression(type="topk", k=2)
+    q = np.asarray(gc.compress("w", jnp.asarray([4.0, -1.0, 0.5, -3.0],
+                                                jnp.float32)))
+    assert int((q != 0).sum()) == 2
+    assert q[0] == pytest.approx(4.0) and q[3] == pytest.approx(-3.0)
+
+
+def test_topk_validation():
+    from incubator_mxnet_tpu.kvstore.compression import GradientCompression
+    with pytest.raises(ValueError):
+        GradientCompression(type="topk", k=0)
+    with pytest.raises(ValueError):
+        GradientCompression(type="nope")
+    with pytest.raises(ValueError):
+        GradientCompression(type="2bit").sparsify("w", None)
+
+
+# ---------------------------------------------------------------------------
+# "train_smoke" drills: shard byte-split and top-k wire win
+# ---------------------------------------------------------------------------
+
+_SMOKE_KEYS = [("w_embed", (6, 64)), ("w_dense", (5, 32)),
+               (3, (4, 16)), ("bias", (2,))]
+
+
+def _train_smoke_worker(tag, queue, rounds, keys_spec, compression):
+    """The train_smoke workload: dist_sync push/pull over a small mixed
+    key set, then report this process's per-server push-byte counters."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from incubator_mxnet_tpu.kvstore.dist import KVStoreDist as KV
+        from incubator_mxnet_tpu.telemetry import catalog as cat
+        kv = KV("dist_sync")
+        if compression:
+            kv.set_gradient_compression(compression)
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        kv.set_optimizer(opt)
+        if kv.rank == 0:
+            for name, shape in keys_spec:
+                kv.init(name, nd.zeros(shape))
+        kv.barrier()
+        outs = {name: nd.zeros(shape) for name, shape in keys_spec}
+        for _ in range(rounds):
+            for name, _shape in keys_spec:
+                kv.push(name, nd.ones(_shape))
+            for name, _shape in keys_spec:
+                kv.pull(name, out=outs[name])
+        kv.barrier()
+        for name in outs:
+            assert np.isfinite(outs[name].asnumpy()).all(), name
+        per_server = {}
+        for labels, v in cat.kvstore_push_bytes.snapshot().items():
+            per_server[dict(labels).get("server", "?")] = v
+        kv.close()
+        queue.put(("ok", tag, per_server))
+    except Exception as e:   # surface failures to the test process
+        import traceback
+        queue.put(("err", tag, "%s\n%s" % (e, traceback.format_exc())))
+
+
+def _run_train_smoke(n_workers, n_servers, extra_env, rounds=6,
+                     keys_spec=_SMOKE_KEYS, compression=None):
+    from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
+                                                         run_server,
+                                                         SchedulerClient)
+    port = _free_port()
+    env = {
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n_workers),
+        "DMLC_NUM_SERVER": str(n_servers),
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+        "MXTPU_METRICS": "1",
+    }
+    env.update(extra_env)
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    ctx = mp.get_context("spawn")
+    procs = []
+    try:
+        sched = ctx.Process(target=run_scheduler,
+                            args=(port, n_workers, n_servers), daemon=True)
+        sched.start()
+        procs.append(sched)
+        time.sleep(0.3)
+        for _ in range(n_servers):
+            s = ctx.Process(target=run_server,
+                            args=(("127.0.0.1", port), n_workers),
+                            daemon=True)
+            s.start()
+            procs.append(s)
+        queue = ctx.Queue()
+        for i in range(n_workers):
+            w = ctx.Process(target=_train_smoke_worker,
+                            args=("w%d" % i, queue, rounds, keys_spec,
+                                  compression),
+                            daemon=True)
+            w.start()
+            procs.append(w)
+        out = {}
+        for _ in range(n_workers):
+            status, tag, data = queue.get(timeout=120)
+            assert status == "ok", "%s failed: %s" % (tag, data)
+            out[tag] = data
+        SchedulerClient(("127.0.0.1", port)).shutdown()
+        return out
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_train_smoke_shard_split_balances_push_bytes(tmp_path):
+    """ISSUE 7 acceptance: with MXTPU_PS_SHARDS=2 and 2 servers, the
+    per-server kvstore_push_bytes counters show no server receiving more
+    than 65% of the total pushed bytes."""
+    results = _run_train_smoke(2, 2, {"MXTPU_PS_SHARDS": "2"})
+    per_server = {}
+    for data in results.values():
+        for sid, v in data.items():
+            per_server[sid] = per_server.get(sid, 0) + v
+    total = sum(per_server.values())
+    assert total > 0
+    assert len(per_server) == 2, per_server    # both servers took bytes
+    worst = max(per_server.values()) / float(total)
+    assert worst <= 0.65, (per_server, worst)
+
+
+def test_train_smoke_topk_wire_byte_win(tmp_path):
+    """Satellite acceptance: topk compression cuts wire bytes. Same
+    workload, one dense run vs one topk run; the per-server push-byte
+    counters must show a large win (k=16 of 1024 entries -> ~1/32 of the
+    dense f32 bytes even counting the index words)."""
+    keys = [("g", (1024,))]
+    dense = _run_train_smoke(1, 1, {}, rounds=4, keys_spec=keys)
+    topk = _run_train_smoke(1, 1, {}, rounds=4, keys_spec=keys,
+                            compression={"type": "topk", "k": 16})
+    dense_b = sum(v for d in dense.values() for v in d.values())
+    topk_b = sum(v for d in topk.values() for v in d.values())
+    assert dense_b > 0 and topk_b > 0
+    assert topk_b < 0.25 * dense_b, (dense_b, topk_b)

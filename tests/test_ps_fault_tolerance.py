@@ -9,9 +9,19 @@ from the scheduler — finish with parameters IDENTICAL to an uninterrupted
 run: no hang, no lost update, no duplicate apply from a retried push.
 
 Exactness comes from the sync-snapshot mode (MXTPU_PS_SNAPSHOT_SYNC=1,
-the default when a snapshot dir is set): every mutating op is durable
-before its ack leaves, so whatever instant SIGKILL lands, acked state is
-recoverable and unacked requests are safely retried.
+the default when a snapshot dir is set) — every mutating op is durable
+before its ack leaves — plus ROUND-STAMPED pushes: each worker stamps
+every push with its per-key round number and the server aggregates
+per-(key, round), so a retried push can never merge into a neighboring
+round even when the replacement restores a cut from mid-round (the PR 1
+ack race: a pull reply could leak an in-memory round completion whose
+snapshot never became durable, desynchronizing worker and server rounds
+by one).
+
+The elastic chaos drill additionally exercises MXTPU_ELASTIC=1: a worker
+SIGKILL'd mid-sync-round is evicted by heartbeat timeout (quorum
+shrinks, no deadlock), and a fresh worker joins mid-training (bootstraps
+current params, quorum regrows) — see test_elastic_chaos_drill.
 """
 
 import multiprocessing as mp
@@ -167,18 +177,19 @@ def test_server_sigkill_mid_training_recovers_exactly(tmp_path):
     np.testing.assert_allclose(res, [-0.1 * rounds] * 4, rtol=1e-6)
 
 
-@pytest.mark.slow
 def test_server_sigkill_two_workers_mid_round_exact(tmp_path):
     """Two workers: the kill can land mid-aggregation-round; the restored
-    accumulator + pending set + dedup windows make the round complete
+    per-round accumulators + dedup windows make every round complete
     exactly once (w = -0.1 * 3 * rounds, aggregate grad = 1 + 2).
 
-    Marked slow: flakes (~277s timeout signature) on a pre-existing ack
-    race between a worker's retried push and the replacement server's
-    restored pending set — present since PR 1 and independent of later
-    changes (ROADMAP open item 2 owns the fix). Run explicitly with
-    ``-m slow`` when working on the recovery path; the single-worker
-    drill above keeps SIGKILL recovery covered in tier 1."""
+    Previously marked slow for a ~277s-flake: the replacement could
+    restore a cut from mid-round R while a worker — whose pull reply had
+    already exposed R's in-memory completion — was retrying its round
+    R+1 push, which the server merged into the restored round R
+    (desynchronizing the fleet by one round; the final round then never
+    reached quorum and the last pull wedged). Round-stamped pushes with
+    per-(key, round) aggregation close that race; this drill is tier-1
+    again."""
     rounds = 6
     results = _run_sigkill_drill(2, rounds, tmp_path, kill_after_step=8)
     for rank, res in results.items():
@@ -188,8 +199,9 @@ def test_server_sigkill_two_workers_mid_round_exact(tmp_path):
 
 def test_snapshot_restore_roundtrip_in_process(tmp_path):
     """Unit-level: a server snapshot written by one _ServerSnapshot is
-    fully restored by another — store, accumulators, pending ranks,
-    optimizer (spec path), rank, and dedup windows."""
+    fully restored by another — store, per-round accumulators and
+    contributed-rank sets, membership epoch, optimizer (spec path),
+    rank, and dedup windows."""
     from incubator_mxnet_tpu.kvstore.dist_server import (_ServerSnapshot,
                                                          _ServerState)
     from incubator_mxnet_tpu.kvstore.rpc import DedupCache
@@ -198,9 +210,13 @@ def test_snapshot_restore_roundtrip_in_process(tmp_path):
     snap_dir = str(tmp_path / "snap")
     state = _ServerState(num_workers=2, sync_mode=True)
     state.store = {"w@0": np.arange(4, dtype=np.float32)}
-    state.accum = {"w@0": np.ones(4, dtype=np.float32) * 2}
-    state.pending = {"w@0": {1}}
+    # open round 3 (one contribution in) plus a buffered round 4 from a
+    # fast worker — both must survive the round trip
+    state.rounds = {"w@0": {3: [np.ones(4, dtype=np.float32) * 2, {1}],
+                            4: [np.ones(4, dtype=np.float32), {0}]}}
     state.push_gen = {"w@0": 3}
+    state.epoch = 7
+    state.members = {0, 1}
     state.optimizer = optmod.create("sgd", learning_rate=0.25)
     dedup = DedupCache()
     wrapped = dedup.wrap(lambda m, p: ({"ok": True}, b""))
@@ -216,10 +232,15 @@ def test_snapshot_restore_roundtrip_in_process(tmp_path):
     assert snap2.restore() == 1
     np.testing.assert_array_equal(state2.store["w@0"],
                                   np.arange(4, dtype=np.float32))
-    np.testing.assert_array_equal(state2.accum["w@0"],
-                                  np.ones(4, dtype=np.float32) * 2)
-    assert state2.pending == {"w@0": {1}}
+    acc3, pend3 = state2.rounds["w@0"][3]
+    np.testing.assert_array_equal(acc3, np.ones(4, dtype=np.float32) * 2)
+    assert pend3 == {1}
+    acc4, pend4 = state2.rounds["w@0"][4]
+    np.testing.assert_array_equal(acc4, np.ones(4, dtype=np.float32))
+    assert pend4 == {0}
     assert state2.push_gen == {"w@0": 3}
+    assert state2.epoch == 7
+    assert state2.members == {0, 1}
     assert state2.optimizer.lr == 0.25
     assert state2.updater is not None
     # a replayed seq must hit the restored window, not re-apply
@@ -231,6 +252,181 @@ def test_snapshot_restore_roundtrip_in_process(tmp_path):
     wrapped2 = dedup2.wrap(count)
     out = wrapped2({"op": "push", "_client": "c1", "_seq": 4}, b"")
     assert out == ({"ok": True}, b"") and calls["n"] == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic chaos drill (ISSUE 7 tentpole acceptance)
+
+def _elastic_worker(tag, queue, target, preamble, failpoints=""):
+    """Training loop that runs until the pulled weight crosses `target`
+    (round counts are NOT fixed: the quorum changes mid-run). Joiners
+    (preamble=False) skip init/set_optimizer/barrier — they bootstrap
+    from the servers inside KVStoreDist.__init__ and enter the open
+    round."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if failpoints:
+        os.environ["MXTPU_FAILPOINTS"] = failpoints
+        from incubator_mxnet_tpu.utils import failpoints as fp
+        fp.load_env()
+    try:
+        from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+        kv = KVStoreDist("dist_sync")
+        queue.put(("up", tag, kv.rank))
+        if preamble:
+            opt = mx.optimizer.create("sgd", learning_rate=0.1)
+            kv.set_optimizer(opt)
+            if kv.rank == 0:
+                kv.init("w", nd.zeros((4,)))
+            kv.barrier()
+            out = nd.zeros((4,))
+        else:
+            # the bootstrap must have delivered CURRENT params: the fleet
+            # has trained for a while, so w is already well below 0
+            out = nd.zeros((4,))
+            kv.pull("w", out=out)
+            queue.put(("bootstrap", tag, out.asnumpy().tolist()))
+        rounds = 0
+        while True:
+            kv.push("w", nd.ones((4,)))
+            kv.pull("w", out=out)
+            rounds += 1
+            queue.put(("progress", tag, float(out.asnumpy()[0])))
+            if float(out.asnumpy()[0]) <= target or rounds > 500:
+                break
+        kv.close()
+        queue.put(("done", tag, out.asnumpy().tolist()))
+    except Exception as e:   # surface failures to the test process
+        import traceback
+        queue.put(("done", tag, "ERROR: %s\n%s" % (e, traceback.format_exc())))
+
+
+def test_elastic_chaos_drill(tmp_path):
+    """ISSUE 7 acceptance: 2 servers + 3 workers under MXTPU_ELASTIC=1.
+    SIGKILL one worker mid-sync-round (its pushes slowed by the
+    kv.push.delay failpoint so the kill lands inside a round); the
+    heartbeat timeout evicts it, the quorum SHRINKS and the open round
+    completes without it — no barrier deadlock. Then a fresh worker
+    registers mid-training: it bootstraps the current (already-trained)
+    params from the servers, the quorum REGROWS, and every survivor plus
+    the joiner reaches the finite target loss."""
+    from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
+                                                         run_server,
+                                                         SchedulerClient)
+    n_workers, n_servers, target = 3, 2, -6.0
+    port = _free_port()
+    env = {
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n_workers),
+        "DMLC_NUM_SERVER": str(n_servers),
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+        "MXTPU_ELASTIC": "1",
+        "MXTPU_PS_DEAD_TIMEOUT": "3",       # fast eviction for the drill
+        "MXTPU_PS_HEARTBEAT_INTERVAL": "0.5",
+        "MXTPU_PS_RETRY_WINDOW": "60",
+    }
+    saved_env = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    ctx = mp.get_context("spawn")
+    procs = []
+    try:
+        sched = ctx.Process(target=run_scheduler,
+                            args=(port, n_workers, n_servers), daemon=True)
+        sched.start()
+        procs.append(sched)
+        time.sleep(0.3)
+        for _ in range(n_servers):
+            s = ctx.Process(target=run_server,
+                            args=(("127.0.0.1", port), n_workers),
+                            daemon=True)
+            s.start()
+            procs.append(s)
+        queue = ctx.Queue()
+        victim = ctx.Process(
+            target=_elastic_worker, args=("victim", queue, target, True,
+                                          "kv.push.delay:1:1000:0.2"),
+            daemon=True)
+        victim.start()
+        procs.append(victim)
+        survivors = []
+        for i in range(n_workers - 1):
+            w = ctx.Process(target=_elastic_worker,
+                            args=("s%d" % i, queue, target, True),
+                            daemon=True)
+            w.start()
+            survivors.append(w)
+            procs.append(w)
+
+        events = []
+
+        def wait_for(pred, timeout, what):
+            deadline = time.time() + timeout
+            while True:
+                for ev in events:
+                    if pred(ev):
+                        return ev
+                remaining = deadline - time.time()
+                assert remaining > 0, "timed out waiting for %s; saw %r" \
+                    % (what, events[-20:])
+                try:
+                    events.append(queue.get(timeout=min(remaining, 5)))
+                except Exception:
+                    pass
+
+        # kill the victim MID-ROUND: after it reports progress, its next
+        # push is mid-flight within ~0.2s (the injected delay ensures the
+        # kill window straddles a round)
+        wait_for(lambda e: e[0] == "progress" and e[1] == "victim" and
+                 e[2] <= -0.5, 120, "victim progress")
+        time.sleep(0.1)     # inside the victim's delayed push
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.join(timeout=10)
+
+        # survivors must keep completing rounds AFTER the quorum shrinks
+        # (scheduler evicts the victim within MXTPU_PS_DEAD_TIMEOUT)
+        base = max(e[2] for e in events
+                   if e[0] == "progress" and e[1].startswith("s"))
+        wait_for(lambda e: e[0] == "progress" and e[1].startswith("s") and
+                 e[2] < base - 0.3, 60,
+                 "post-eviction progress (quorum shrink)")
+
+        # mid-training join: fresh worker, fresh rank, bootstrap
+        joiner = ctx.Process(target=_elastic_worker,
+                             args=("joiner", queue, target, False),
+                             daemon=True)
+        joiner.start()
+        procs.append(joiner)
+        up = wait_for(lambda e: e[0] == "up" and e[1] == "joiner", 60,
+                      "joiner registration")
+        assert up[2] >= n_workers, \
+            "joiner must get a FRESH rank, got %r" % (up[2],)
+        boot = wait_for(lambda e: e[0] == "bootstrap", 60,
+                        "joiner bootstrap")
+        assert not isinstance(boot[2], str), boot[2]
+        assert boot[2][0] <= -0.5, \
+            "joiner must bootstrap already-trained params, got %r" % boot[2]
+
+        # everyone reaches the finite target — no deadlock anywhere
+        done = {}
+        while len(done) < 3:
+            ev = wait_for(lambda e: e[0] == "done" and e[1] not in done,
+                          180, "worker completion (done=%r)" % done)
+            done[ev[1]] = ev[2]
+        for tag, res in done.items():
+            assert not (isinstance(res, str) and res.startswith("ERROR")), \
+                "%s failed: %s" % (tag, res)
+            assert np.isfinite(res).all(), (tag, res)
+            assert res[0] <= target + 0.5, (tag, res)
+        SchedulerClient(("127.0.0.1", port)).shutdown()
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 # ---------------------------------------------------------------------------
@@ -262,6 +458,61 @@ def test_launch_mesh_propagates_max_exit_code():
            "time.sleep(60)"]
     r = subprocess.run(cmd, timeout=60)
     assert r.returncode == 7
+
+
+def test_launch_elastic_graceful_departure_ends_clean():
+    """--elastic: a worker finishing early (code 0) is a graceful
+    DEPARTURE — the quorum shrinks, the survivor keeps completing sync
+    rounds alone, and the job exits 0 (the departed worker's exit never
+    propagates through teardown)."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu",
+                "MXTPU_PS_HEARTBEAT_INTERVAL": "0.5",
+                "MXTPU_PS_DEAD_TIMEOUT": "5"})
+    worker = (
+        "from incubator_mxnet_tpu.kvstore.dist import KVStoreDist; "
+        "from incubator_mxnet_tpu import nd; "
+        "import numpy as np, sys; "
+        "kv = KVStoreDist('dist_sync'); "
+        "kv.init('w', nd.zeros((2,))) if kv.rank == 0 else None; "
+        "kv.barrier(); "
+        "(kv.close(), sys.exit(0)) if kv.rank != 0 else None; "
+        "out = nd.zeros((2,)); "
+        "[(kv.push('w', nd.ones((2,))), kv.pull('w', out=out)) "
+        " for _ in range(3)]; "
+        "assert np.isfinite(out.asnumpy()).all(); "
+        "kv.close()")
+    cmd = [sys.executable, _LAUNCH, "-n", "2", "-s", "1", "--elastic",
+           "--launcher", "local", sys.executable, "-c", worker]
+    r = subprocess.run(cmd, env=env, timeout=120)
+    assert r.returncode == 0
+
+
+def test_launch_elastic_respawns_preempted_worker(tmp_path):
+    """--elastic: a dirty worker exit is a PREEMPTION — the launcher
+    respawns a replacement (which registers for a fresh rank) within the
+    respawn budget and the job still ends 0."""
+    marker = str(tmp_path / "preempted_once")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu",
+                "MXTPU_PS_HEARTBEAT_INTERVAL": "0.5",
+                "MXTPU_PS_DEAD_TIMEOUT": "2",
+                "MXTPU_ELASTIC_MARKER": marker})
+    worker = (
+        "import os, sys; "
+        "m = os.environ['MXTPU_ELASTIC_MARKER']; "
+        "(open(m, 'w').close(), os._exit(9)) if not os.path.exists(m) "
+        "else None; "
+        "from incubator_mxnet_tpu.kvstore.dist import KVStoreDist; "
+        "from incubator_mxnet_tpu import nd; "
+        "kv = KVStoreDist('dist_sync'); "
+        "assert kv.rank >= 1, kv.rank; "    # fresh rank, never reused
+        "kv.init('w', nd.ones((2,))); kv.barrier(); kv.close()")
+    cmd = [sys.executable, _LAUNCH, "-n", "1", "-s", "1", "--elastic",
+           "--launcher", "local", sys.executable, "-c", worker]
+    r = subprocess.run(cmd, env=env, timeout=120)
+    assert os.path.exists(marker)
+    assert r.returncode == 0
 
 
 def test_launch_ps_infra_death_tears_down_job(tmp_path):

@@ -133,6 +133,38 @@ def main():
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
             print("server       : %s unreachable (%s)" % (addr, e))
 
+    section("Membership")
+    # elastic-fabric probe: when a parameter-server scheduler is
+    # reachable (DMLC_PS_ROOT_URI/PORT), report its epoch-numbered
+    # membership view — who is in the quorum right now
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "")
+    sport = os.environ.get("DMLC_PS_ROOT_PORT", "")
+    if not uri or not sport:
+        print("(no scheduler configured — set DMLC_PS_ROOT_URI and "
+              "DMLC_PS_ROOT_PORT)")
+    else:
+        try:
+            from incubator_mxnet_tpu.kvstore.dist_server import \
+                SchedulerClient
+            sc = SchedulerClient((uri, int(sport)))
+            try:
+                mem = sc.membership(timeout=3)
+                print("scheduler    : %s:%s up" % (uri, sport))
+                print("epoch        :", mem["epoch"])
+                print("quorum       :", mem["quorum"], "worker(s)")
+                print("elastic      :",
+                      "on" if os.environ.get("MXTPU_ELASTIC") == "1"
+                      else "off (fixed launch-time membership)")
+                for r, a in sorted(mem["workers"].items()):
+                    print("  worker %-4d: %s:%s" % (r, a[0], a[1]))
+                for r, a in sorted(mem["servers"].items()):
+                    print("  server %-4d: %s:%s" % (r, a[0], a[1]))
+            finally:
+                sc._conn.close()
+        except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+            print("scheduler    : %s:%s unreachable (%s)"
+                  % (uri, sport, e))
+
     section("Threads")
     # hang post-mortem: every live thread's stack plus watchdog state —
     # the same rendering the resilience watchdog dumps on a deadline

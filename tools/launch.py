@@ -72,6 +72,15 @@ def main():
     parser.add_argument("--sync-dst-dir", default=None)
     parser.add_argument("--mode", choices=["dist_sync", "dist_async"],
                         default="dist_sync")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic membership (MXTPU_ELASTIC=1): worker "
+                        "exits shrink the quorum instead of ending the "
+                        "job, preempted workers are respawned with a "
+                        "fresh rank (up to MXTPU_ELASTIC_MAX_RESPAWNS, "
+                        "default 3)")
+    parser.add_argument("--max-workers", type=int, default=None,
+                        help="elastic upper bound on concurrently live "
+                        "workers (default: --num-workers)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if args.num_servers is None:
@@ -115,6 +124,8 @@ def main():
         "DMLC_NUM_SERVER": str(args.num_servers),
         "MXNET_KVSTORE_MODE": args.mode,
     })
+    if args.elastic:
+        base_env["MXTPU_ELASTIC"] = "1"
 
     procs = []
     role_cmd = [sys.executable, "-m", "incubator_mxnet_tpu.kvstore.dist_server"]
@@ -169,8 +180,39 @@ def main():
     # Wait for the WORKERS; but a scheduler/server rank exiting early —
     # even with code 0 — strands them (pushes hang, barriers abort), so
     # any rank exit tears the job down instead of hanging the launcher.
+    # Elastic mode changes only the WORKER-exit rules: a clean exit is an
+    # EXPECTED departure (the scheduler shrank the quorum, the job goes
+    # on), a dirty exit is a preemption — respawn a replacement (it
+    # registers for a FRESH rank and bootstraps) within the respawn
+    # budget and the --max-workers cap.
     code = 0
-    while any(w.poll() is None for w in workers):
+    max_workers = args.max_workers or args.num_workers
+    max_respawns = int(os.environ.get("MXTPU_ELASTIC_MAX_RESPAWNS", "3"))
+    respawns = 0
+    failed = False
+    while not failed:
+        if args.elastic:
+            for w in list(workers):
+                rc = w.poll()
+                if rc is None:
+                    continue
+                workers.remove(w)
+                # a HANDLED worker exit (departure or respawned
+                # preemption) must not count as a job failure in the
+                # final drain
+                procs.remove(w)
+                if rc == 0:
+                    continue            # graceful departure
+                rc = 128 - rc if rc < 0 else rc
+                live = sum(1 for x in workers if x.poll() is None)
+                if respawns < max_respawns and live < max_workers:
+                    respawns += 1
+                    workers.append(spawn("worker"))
+                else:
+                    code = max(code, rc, 1)
+                    failed = True       # respawn budget spent: tear down
+        if not workers or all(w.poll() is not None for w in workers):
+            break
         dead_infra = [p for p in infra if p.poll() is not None]
         if dead_infra:
             code = max(max(p.returncode for p in dead_infra), 1)
