@@ -270,9 +270,27 @@ def bench_bert(steps, dtype, seqlen=128, metric=None, baseline=None):
     else:
         bdesc = ("this repo's own r1 fp32 encoder-only first light "
                  "(47k tok/s; r1 omitted the MLM head, this row does not)")
+    extra = {}
+    try:
+        # MFU: static FLOPs of the compiled step (XLA cost analysis, the
+        # same accounting as the SSD roofline row) at the measured token
+        # rate, as a fraction of MXTPU_PEAK_TFLOPS. Falls back to the
+        # 6*params*tokens transformer estimate when the backend reports
+        # no flops.
+        from incubator_mxnet_tpu.telemetry import costs as _costs
+        flops = _costs.cost_of(tr.lowered(data, label).compile())["flops"]
+        if flops <= 0:
+            n_params = sum(int(np.prod(v.shape))
+                           for v in tr._param_vals.values())
+            flops = 6.0 * n_params * B * T
+        steps_per_sec = stats["value"] / float(B * T)
+        extra["mfu"] = round(min(1.0, _costs.mfu(flops, 1.0 / steps_per_sec)),
+                             4)
+    except Exception:   # noqa: BLE001 — the throughput row must land
+        pass            # even if cost analysis is unavailable
     _emit(metric or "bert_base_pretrain_tokens_per_sec_per_chip",
           "tokens/sec/chip", stats, baseline=baseline or 47000.0,
-          baseline_desc=bdesc)
+          baseline_desc=bdesc, **extra)
 
 
 def bench_lstm(steps, dtype):

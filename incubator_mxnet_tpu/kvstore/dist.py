@@ -46,12 +46,14 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from .kvstore import KVStore
-from .rpc import Connection
+from .rpc import Connection, Server as _RpcServer
 from .dist_server import SchedulerClient
 from ..log import get_logger
 from ..ndarray import NDArray
 from ..resilience import watchdog as _wd
 from ..telemetry import catalog as _cat
+from ..telemetry import debugz as _dbz
+from ..telemetry import flight as _fl
 from ..telemetry import tracing as _tr
 from ..utils import failpoints as _fp
 
@@ -76,8 +78,20 @@ class KVStoreDist(KVStore):
         self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
         self._sync_mode = sync_mode
         self._sched = SchedulerClient((uri, port))
-        self._rank = self._sched.register("worker", ("127.0.0.1", 0))
+        # worker introspection endpoint: answers the same RPC `telemetry`
+        # command the servers do, and its REAL address replaces the old
+        # ("127.0.0.1", 0) registration placeholder, so aggregate.scrape()
+        # reaches workers through the membership view (the scheduler
+        # dedups registration by instance token, never by address)
+        self._introspect = _RpcServer(
+            self._introspect_handler,
+            host=os.environ.get("DMLC_NODE_HOST", "127.0.0.1")).start()
+        self._rank = self._sched.register("worker", self._introspect.addr)
         self._sched.start_heartbeats("worker", self._rank)
+        _fl.set_identity("worker", self._rank)
+        if _dbz.start_from_env(role="worker", rank=self._rank) is not None:
+            _dbz.set_status("epoch", lambda: self._epoch)
+            _dbz.set_status("num_workers", lambda: self.num_workers)
         nodes = self._sched.get_nodes()
         self._servers = [Connection(tuple(a)) for _, a in
                          sorted(nodes["servers"].items())]
@@ -109,6 +123,27 @@ class KVStoreDist(KVStore):
             self._sched.on_epoch = lambda _ep: self._refresh_membership()
             self._refresh_membership()
             self._bootstrap()
+
+    # -- introspection endpoint ----------------------------------------------
+    def _introspect_handler(self, meta, payload):
+        """Read-only worker-side RPC surface for fleet observability;
+        this server's address is what the scheduler's membership view
+        reports for this worker."""
+        op = meta.get("op", "")
+        if op == "command":
+            cmd = meta.get("command")
+            if cmd == "telemetry":
+                from .. import telemetry as _tm
+                return ({"ok": True, "role": "worker",
+                         "rank": getattr(self, "_rank", None)},
+                        _tm.render_json().encode("utf-8"))
+            if cmd == "status":
+                return ({"ok": True, "role": "worker",
+                         "rank": getattr(self, "_rank", None),
+                         "epoch": getattr(self, "_epoch", None)}, b"")
+            return {"error": "unknown command %r" % cmd}, b""
+        return {"error": "worker introspection endpoint: unsupported "
+                "op %r" % op}, b""
 
     # -- identity ------------------------------------------------------------
     @property
@@ -145,6 +180,7 @@ class KVStoreDist(KVStore):
         with cm:
             mem = self._sched.membership()
         with self._mem_lock:
+            changed = mem["epoch"] != self._epoch
             self._epoch = mem["epoch"]
             self._members = set(mem["workers"])
             for sid, addr in mem["servers"].items():
@@ -152,6 +188,9 @@ class KVStoreDist(KVStore):
                     self._servers[sid].set_addr(addr)
         _cat.membership_epoch.set(mem["epoch"])
         _cat.membership_quorum.set(mem["quorum"])
+        if changed:
+            _fl.record("membership.epoch", epoch=mem["epoch"],
+                       quorum=mem["quorum"])
         return mem
 
     def _bootstrap(self):
@@ -278,9 +317,13 @@ class KVStoreDist(KVStore):
                 # just joined, or it just refreshed past an eviction):
                 # re-sync with the scheduler and re-send ONCE — if we are
                 # genuinely out of the membership, surface that clearly
+                _fl.record("membership.stale_epoch",
+                           op=meta.get("op"), key=meta.get("key"))
                 self._refresh_membership()
                 if self._members is not None \
                         and self._rank not in self._members:
+                    _fl.record("membership.evicted", rank=self._rank,
+                               epoch=self._epoch)
                     raise RuntimeError(
                         "worker rank %d was evicted from membership "
                         "epoch %d (missed heartbeats?) — restart to "
@@ -670,11 +713,15 @@ class KVStoreDist(KVStore):
         try:
             self._flush()
         finally:
+            _fl.record("worker.bye", rank=self._rank)
             self._sched.bye("worker", self._rank)
             if self._io is not None:
                 self._io.shutdown(wait=True)
             for conn in self._servers:
                 conn.close()
+            introspect = getattr(self, "_introspect", None)
+            if introspect is not None:
+                introspect.stop()
             # drop the server-profiling handle if it points at this store:
             # a later profile_process="server" call must get the clean
             # "requires a dist kvstore" error, not a dead-socket OSError

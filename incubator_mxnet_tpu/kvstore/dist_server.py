@@ -44,6 +44,8 @@ from .rpc import Server, request, Connection, ProtocolError, DedupCache
 from .compression import GradientCompression
 from .. import profiler as _server_profiler
 from ..telemetry import catalog as _cat
+from ..telemetry import debugz as _dbz
+from ..telemetry import flight as _fl
 from ..utils import failpoints as _fp
 
 __all__ = ["run_scheduler", "run_server", "SchedulerClient"]
@@ -115,6 +117,8 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
                 state.active.discard(rank)
                 state.heartbeats.pop((role, rank), None)
                 _cat.membership_evictions.inc()
+                _fl.record("membership.evict", worker=rank,
+                           stale_s=round(now - t, 1))
                 changed = True
         if changed:
             _bump_epoch_locked()
@@ -155,6 +159,8 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
                 if role == "worker" and rank not in state.active:
                     state.active.add(rank)
                     _cat.membership_joins.inc()
+                    _fl.record("membership.join", worker=rank,
+                               epoch=state.epoch + 1)
                     _bump_epoch_locked()
                 state.cv.notify_all()
                 return {"rank": rank, "_epoch": state.epoch,
@@ -257,6 +263,8 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
                         meta["rank"] in state.active:
                     state.active.discard(meta["rank"])
                     _cat.membership_departures.inc()
+                    _fl.record("membership.bye", worker=meta["rank"],
+                               epoch=state.epoch + 1)
                     _bump_epoch_locked()
             return {"ok": True, "_epoch": state.epoch}, b""
         if op == "num_dead":
@@ -268,12 +276,29 @@ def run_scheduler(port, num_workers, num_servers, ready_event=None):
         if op == "shutdown":
             state.done.set()
             return {"ok": True}, b""
+        if op == "command":
+            # scheduler-side introspection: same `telemetry` command the
+            # kvstore servers answer, so aggregate.scrape() reaches all
+            # three roles over one wire protocol
+            if meta.get("command") == "telemetry":
+                from .. import telemetry as _tm
+                return ({"ok": True, "role": "scheduler"},
+                        _tm.render_json().encode("utf-8"))
+            return {"error": "unknown command %r"
+                    % meta.get("command")}, b""
         return {"error": "unknown op %s" % op}, b""
 
     # DMLC_NODE_HOST (reference: ps-lite van bind host): the bind/advertise
     # address for multi-host topologies; default stays loopback
     srv = Server(handler, port=port,
                  host=os.environ.get("DMLC_NODE_HOST", "127.0.0.1")).start()
+    _fl.set_identity("scheduler", 0)
+    if _dbz.start_from_env(role="scheduler", rank=0) is not None:
+        _dbz.set_status("epoch", lambda: state.epoch)
+        _dbz.set_status("quorum", lambda: len(state.active))
+        _dbz.set_status("active_workers", lambda: sorted(state.active))
+        _dbz.set_status("servers", lambda: {str(k): list(v) for k, v
+                                            in state.servers.items()})
     if ready_event is not None:
         ready_event.set()
     state.done.wait()
@@ -986,6 +1011,12 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
         sched.on_epoch = lambda _ep: _refresh_members()
         _refresh_members()
     sched.start_heartbeats("server", rank)
+    _fl.set_identity("server", rank)
+    if _dbz.start_from_env(role="server", rank=rank) is not None:
+        _dbz.set_status("keys", lambda: len(state.store))
+        _dbz.set_status("sync_mode", sync_mode)
+        _dbz.set_status("num_workers", lambda: state.num_workers)
+        _dbz.set_status("epoch", lambda: state.epoch)
     if snap is not None:
         snap.rank = rank
         with mut_lock:

@@ -27,6 +27,7 @@ import uuid
 
 from ..resilience import watchdog as _wd
 from ..telemetry import catalog as _cat
+from ..telemetry import flight as _fl
 from ..telemetry import metrics as _met
 from ..telemetry import tracing as _tr
 from ..utils import failpoints as _fp
@@ -155,6 +156,8 @@ class Connection:
         if self._sock is None:
             if self._connected_once:
                 _cat.rpc_reconnects.inc()
+                _fl.record("rpc.reconnect",
+                           addr="%s:%s" % self._addr)
             self._sock = socket.create_connection(self._addr,
                                                   timeout=self._timeout)
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -283,6 +286,9 @@ class Connection:
                 if time.monotonic() + delay > deadline:
                     raise
                 _cat.rpc_retries.inc(op=obj.get("op", ""))
+                _fl.record("rpc.retry", op=obj.get("op", ""),
+                           addr="%s:%s" % self._addr,
+                           delay_s=round(delay, 3))
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
                 if on_retry is not None:
@@ -431,6 +437,7 @@ class Server:
                     # serving plane's shed path relies on this; training
                     # RPC gets it for free.
                     _cat.rpc_deadline_dropped.inc(op=op)
+                    _fl.record("rpc.deadline_dropped", op=op, peer=peer)
                     send_msg(conn, {"error": "DeadlineExceeded: request "
                                     "deadline already expired",
                                     "deadline_exceeded": True}, b"")

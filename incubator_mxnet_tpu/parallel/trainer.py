@@ -20,6 +20,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..gluon.block import _TraceCtx, _trace_state
 from ..ndarray import NDArray
 from ..telemetry import catalog as _cat
+from ..telemetry import costs as _costs
 from ..telemetry import metrics as _met
 
 __all__ = ["ShardedTrainer", "sharding_rules"]
@@ -691,11 +692,31 @@ class ShardedTrainer:
         self._step_count += n_steps
         pv = {n: self._param_vals[n] for n in self._diff_names}
         aux_vals = {n: self._param_vals[n] for n in self._aux_names}
+        t0 = time.perf_counter() if _met.enabled() else None
+        if t0 is not None and _costs.capture_enabled():
+            if getattr(self, "_cost_captured", None) is None:
+                self._cost_captured = set()
+            if cache_key not in self._cost_captured:
+                # lower (never run) the scan program with these avals: the
+                # cost covers all n_steps steps of one scan execution
+                self._cost_captured.add(cache_key)
+                try:
+                    shp = datas[0].shape if datas else None
+                    batch = shp[1] if scan_over_batch and len(shp) > 1 \
+                        else (shp[0] if shp else 0)
+                    _costs.capture(
+                        "trainer.step_scan",
+                        self._scan_cache[cache_key].lower(
+                            pv, aux_vals, self._opt_state, t, key,
+                            *(datas + labels)).compile(),
+                        samples_per_exec=int(batch) * n_steps)
+                except Exception:   # noqa: BLE001 — accounting must
+                    pass            # never fail a train step
         new_params, new_aux, new_opt, losses = self._scan_cache[cache_key](
             pv, aux_vals, self._opt_state, t, key, *(datas + labels))
         self._param_vals = {**new_params, **new_aux}
         self._opt_state = new_opt if new_opt else self._opt_state
-        if _met.enabled():
+        if t0 is not None:
             lbl = self._telemetry_labels
             _cat.trainer_steps.inc(n_steps, **lbl)
             if datas and getattr(datas[0], "shape", None):
@@ -703,6 +724,7 @@ class ShardedTrainer:
                 # per-step-batch mode: leading axis is the scan axis
                 batch = shp[1] if scan_over_batch and len(shp) > 1 else shp[0]
                 _cat.trainer_samples.inc(int(batch) * n_steps)
+            _costs.observe("trainer.step_scan", time.perf_counter() - t0)
         return losses
 
     def _prep_batch(self, data, label):
@@ -730,6 +752,18 @@ class ShardedTrainer:
         datas, labels = self._prep_batch(data, label)
         if self._jit_step is None:
             self._jit_step = self._build(len(datas))
+            if t0 is not None and _costs.capture_enabled():
+                # MXTPU_COSTS=1: pay one extra (non-donating) lower+compile
+                # to record the step's static FLOPs/bytes, enabling the
+                # per-step MFU / tokens-per-sec gauges below
+                try:
+                    _costs.capture(
+                        "trainer.step", self.lowered(data, label).compile(),
+                        samples_per_exec=int(datas[0].shape[0])
+                        if datas and getattr(datas[0], "shape", None)
+                        else None)
+                except Exception:   # noqa: BLE001 — accounting must
+                    pass            # never fail a train step
         if key is None:
             key = jax.random.PRNGKey(self._step_count)
         self._step_count += 1
@@ -742,11 +776,13 @@ class ShardedTrainer:
         self._param_vals = {**new_params, **new_aux}
         self._opt_state = new_opt if new_opt else self._opt_state
         if t0 is not None:
+            dt = time.perf_counter() - t0
             lbl = self._telemetry_labels
-            _cat.trainer_step_seconds.observe(time.perf_counter() - t0, **lbl)
+            _cat.trainer_step_seconds.observe(dt, **lbl)
             _cat.trainer_steps.inc(**lbl)
             if datas and hasattr(datas[0], "shape") and datas[0].shape:
                 _cat.trainer_samples.inc(int(datas[0].shape[0]))
+            _costs.observe("trainer.step", dt)
         return loss
 
     def step_guarded(self, data, label, loss_scale=1.0, key=None):

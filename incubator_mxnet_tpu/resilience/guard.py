@@ -77,7 +77,9 @@ class NumericGuard:
 
     def _gauge(self):
         from ..telemetry import catalog as _cat
+        from ..telemetry import flight as _fl
         _cat.guard_loss_scale.set(self.scale)
+        _fl.record("guard.loss_scale", scale=self.scale)
 
     def on_good_step(self):
         """Record a finite step; grow the scale on a full streak."""

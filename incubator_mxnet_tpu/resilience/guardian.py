@@ -131,10 +131,13 @@ class GuardedTrainer:
 
     def _on_bad_step(self, loss, gnorm):
         from ..telemetry import catalog as _cat
+        from ..telemetry import flight as _fl
         self.skipped_steps += 1
         self._bad_streak += 1
         self._guard.on_bad_step()
         _cat.guard_skipped_steps.inc()
+        _fl.record("guard.skip", skipped=self.skipped_steps,
+                   streak=self._bad_streak, grad_norm=repr(gnorm))
         if self.skipped_steps > self._skip_budget:
             raise TrainingDivergedError(
                 "numeric guard skip budget exhausted: %d non-finite steps "
@@ -148,10 +151,12 @@ class GuardedTrainer:
 
     def _rollback(self):
         from ..telemetry import catalog as _cat
+        from ..telemetry import flight as _fl
         step = self._ring.rewind(self._trainer)
         if step is not None:
             self.rollbacks += 1
             _cat.guard_rollbacks.inc(source="ring")
+            _fl.record("guard.rollback", source="ring", step=step)
             return step
         if self._mgr is not None:
             try:
@@ -163,6 +168,8 @@ class GuardedTrainer:
             self._trainer.load_state_dict(params)
             self.rollbacks += 1
             _cat.guard_rollbacks.inc(source="checkpoint")
+            _fl.record("guard.rollback", source="checkpoint",
+                       step=ck_step)
             return ck_step
         raise TrainingDivergedError(
             "rollback ring exhausted and no checkpoint_manager configured")

@@ -190,7 +190,10 @@ class Watchdog:
     def _fire(self, phase, thread_name, overdue):
         self.fired.append((phase, thread_name, overdue))
         from ..telemetry import catalog as _cat
+        from ..telemetry import flight as _fl
         _cat.watchdog_fires.inc(phase=phase)
+        _fl.record("watchdog.fire", phase=phase, thread=thread_name,
+                   overdue_s=round(overdue, 1))
         report = self._render(phase, thread_name, overdue)
         sys.stderr.write(report)
         sys.stderr.flush()
@@ -201,6 +204,14 @@ class Watchdog:
             except OSError as e:
                 sys.stderr.write("watchdog: cannot write dump %s: %s\n"
                                  % (self._dump_path, e))
+        # flight-recorder dump rides along: next to the thread dump when
+        # one is configured, else to MXTPU_FLIGHT_EXPORT (no-op if neither)
+        try:
+            _fl.dump(path=(self._dump_path + ".flight.jsonl")
+                     if self._dump_path else None,
+                     reason="watchdog:%s" % phase)
+        except OSError:
+            pass
         if self._sigterm:
             os.kill(os.getpid(), signal.SIGTERM)
 

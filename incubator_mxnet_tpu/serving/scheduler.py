@@ -36,6 +36,8 @@ import time
 import numpy as np
 
 from ..telemetry import catalog as _cat
+from ..telemetry import costs as _costs
+from ..telemetry import metrics as _met
 
 __all__ = ["Request", "ContinuousBatcher", "ShedError", "bucket_for",
            "default_buckets", "pad_batch_rows", "pad_to_bucket"]
@@ -194,6 +196,7 @@ class ContinuousBatcher:
         self._depth = int(queue_depth if queue_depth is not None else
                           os.environ.get("MXTPU_SERVE_QUEUE_DEPTH", "256"))
         self._pad_value = pad_value
+        self._cost_captured = set()   # (bucket, rows) shapes accounted
         self._cond = threading.Condition()
         self._queues = collections.OrderedDict(
             (b, collections.deque()) for b in self._buckets)
@@ -391,6 +394,20 @@ class ContinuousBatcher:
                                      axis=0)
                     stacked = np.concatenate([stacked, fill], axis=0)
                 batch[n] = stacked
+            if _costs.capture_enabled() \
+                    and (bucket, padded_rows) not in self._cost_captured \
+                    and hasattr(self._forward, "lower"):
+                # jit-wrapped encode fns expose .lower: record the static
+                # FLOPs of this (bucket, batch) shape once so the
+                # per-forward observe below can report MFU
+                self._cost_captured.add((bucket, padded_rows))
+                try:
+                    _costs.capture(
+                        "serving.forward/%s" % self.name,
+                        self._forward.lower(batch, bucket).compile(),
+                        samples_per_exec=padded_rows * bucket)
+                except Exception:   # noqa: BLE001 — accounting is
+                    pass            # best-effort, never fails a batch
             t0 = time.perf_counter()
             out = self._forward(batch, bucket)
             dt = time.perf_counter() - t0
@@ -408,6 +425,15 @@ class ContinuousBatcher:
                 0.7 * prev + 0.3 * dt
         _cat.serving_forward_seconds.observe(dt, model=self.name,
                                              bucket=str(bucket))
+        if _met._state["enabled"]:
+            # hardware-truth accounting for the serving forward: tokens
+            # consumed per second always; MFU when the cost was captured
+            # (MXTPU_COSTS=1 and a lowerable forward, see telemetry.costs)
+            cost_name = "serving.forward/%s" % self.name
+            if dt > 0:
+                _cat.model_tokens_per_sec.set(padded_rows * bucket / dt,
+                                              name=cost_name)
+            _costs.observe(cost_name, dt)
         # scatter rows back in submit order; padding rows are dropped
         offset = 0
         for r in live:

@@ -29,7 +29,9 @@ import time
 
 from ..kvstore import rpc as _rpc
 from ..telemetry import catalog as _cat
+from ..telemetry import debugz as _dbz
 from ..telemetry import export as _texport
+from ..telemetry import flight as _fl
 from ..telemetry import metrics as _met
 from .decode import DecodeLoop, DecodeRequest
 from .loader import ServedModel, load_served_model
@@ -68,6 +70,10 @@ class ModelServer:
     # ----------------------------------------------------------- lifecycle
     def start(self):
         self._rpc.start()
+        _fl.set_identity("serving", 0)
+        if _dbz.start_from_env(role="serving") is not None:
+            _dbz.set_status("serve_addr", "%s:%s" % self.addr)
+            _dbz.set_status("models", lambda: sorted(self._models))
         return self
 
     def stop(self):
@@ -199,6 +205,7 @@ class ModelServer:
         try:
             result = req.wait(timeout)
         except ShedError as e:
+            _fl.record("serving.shed", model=name, stage=e.stage)
             return {"error": str(e), "shed": e.stage,
                     "deadline_exceeded": e.stage != "overload"}, b""
         except TimeoutError as e:
@@ -212,6 +219,7 @@ class ModelServer:
             try:
                 result = req.wait(0)
             except ShedError as e2:
+                _fl.record("serving.shed", model=name, stage=e2.stage)
                 return {"error": str(e2), "shed": e2.stage,
                         "deadline_exceeded": e2.stage != "overload"}, b""
         manifest, out_payload = pack_arrays(result)

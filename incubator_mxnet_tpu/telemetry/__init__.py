@@ -1,4 +1,5 @@
-"""Runtime telemetry: metrics registry, exporters, distributed tracing.
+"""Runtime telemetry: metrics registry, exporters, distributed tracing,
+and the fleet observability plane.
 
 The reference framework's only runtime introspection is the profiler
 (src/profiler/profiler.h); serving at the ROADMAP's target scale also
@@ -10,9 +11,19 @@ adds both:
 - ``export``: Prometheus text exposition + JSON renderers and a
   periodic flusher driven by ``MXTPU_METRICS_*`` env vars.
 - ``tracing``: ``span()`` context manager whose trace/parent ids ride
-  the RPC meta dict, linking worker and PS-server chrome-trace events.
+  the RPC meta dict, linking worker and PS-server chrome-trace events;
+  finished spans are retained in a bounded ring for /tracez.
 - ``catalog``: the framework-wide instrument definitions (RPC, dist
-  kvstore, trainer, dataloader, checkpoint, failpoints).
+  kvstore, trainer, dataloader, checkpoint, failpoints, serving,
+  observability).
+- ``flight``: bounded ring-buffer flight recorder of structured fleet
+  events, dumped as JSONL on watchdog fire, crash, or SIGTERM.
+- ``debugz``: per-process stdlib HTTP debug server (/metrics, /statusz,
+  /tracez, /threadz, /flightz) opted in via MXTPU_DEBUGZ_PORT.
+- ``aggregate``: fleet-wide scrape merging every member's registry
+  under role/rank labels via the scheduler's membership view.
+- ``costs``: per-executable FLOPs/bytes from XLA cost analysis and the
+  MFU / achieved-vs-roofline gauges.
 
 See docs/OBSERVABILITY.md for the metric catalog and span semantics.
 """
@@ -21,17 +32,23 @@ from . import metrics
 from . import tracing
 from . import export
 from . import catalog
+from . import flight
+from . import debugz
+from . import costs
+from . import aggregate
 
 from .metrics import (enable, disable, enabled, counter, gauge, histogram,
                       snapshot, reset)
 from .export import (render_prometheus, render_json, flush, start_flusher,
                      stop_flusher)
-from .tracing import span, current, inject, extract, from_meta, merge_traces
+from .tracing import (span, current, inject, extract, from_meta,
+                      merge_traces, recent_spans)
 
 __all__ = ["metrics", "tracing", "export", "catalog",
+           "flight", "debugz", "costs", "aggregate",
            "enable", "disable", "enabled", "counter", "gauge", "histogram",
            "snapshot", "reset",
            "render_prometheus", "render_json", "flush", "start_flusher",
            "stop_flusher",
            "span", "current", "inject", "extract", "from_meta",
-           "merge_traces"]
+           "merge_traces", "recent_spans"]

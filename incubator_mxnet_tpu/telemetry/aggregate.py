@@ -1,0 +1,145 @@
+"""Fleet-wide metric aggregation over the RPC fabric.
+
+``scrape()`` asks the PS scheduler for its membership view, then
+collects every member's local registry snapshot over the existing RPC
+``telemetry`` command — the scheduler itself, every kvstore server,
+every worker (workers register their introspection endpoint's address
+at join, see kvstore/dist.py), and optionally serving processes via
+``serve.metrics`` — and merges them into one registry whose series are
+re-labeled with ``role`` and ``rank``.  That merged registry is what
+``tools/mxtop.py`` renders live and what a prometheus bridge would
+export for the whole fleet from one place.
+
+Unreachable members are reported per-member (``ok: False`` + error),
+never raised: a scrape during an elastic shrink must still show the
+survivors.  kvstore imports happen inside functions so importing
+``telemetry`` stays light.
+"""
+
+import json
+import os
+
+__all__ = ["scrape", "merge", "fetch_member", "scheduler_addr",
+           "hist_quantile"]
+
+
+def scheduler_addr():
+    """(host, port) of the PS scheduler from the DMLC_* environment."""
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    return (host, port)
+
+
+def _addr(spec):
+    if spec is None:
+        return scheduler_addr()
+    if isinstance(spec, (tuple, list)):
+        return (spec[0], int(spec[1]))
+    host, _, port = str(spec).rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def fetch_member(addr, role="server", timeout=5.0):
+    """One member's registry snapshot (the render_json dict), raises on
+    unreachable/invalid."""
+    from ..kvstore.rpc import request
+    if role == "serving":
+        meta, payload = request(tuple(addr), {"op": "serve.metrics",
+                                              "format": "json"},
+                                timeout=timeout)
+    else:
+        meta, payload = request(tuple(addr), {"op": "command",
+                                              "command": "telemetry"},
+                                timeout=timeout)
+    if meta.get("error"):
+        raise RuntimeError("telemetry fetch from %s:%s failed: %s"
+                           % (addr[0], addr[1], meta["error"]))
+    return json.loads(payload.decode("utf-8"))
+
+
+def merge(snapshots):
+    """Merge per-member snapshots into one registry.
+
+    ``snapshots`` is a list of ``(role, rank, snap)``; every series key
+    is prefixed with ``role=...,rank=...`` labels so same-named
+    instruments from different processes stay distinct.
+    """
+    merged = {}
+    for role, rank, snap in snapshots:
+        prefix = "role=%s,rank=%s" % (role, rank)
+        for name, inst in (snap or {}).items():
+            out = merged.setdefault(name, {"kind": inst.get("kind"),
+                                           "help": inst.get("help"),
+                                           "series": {}})
+            for labels, value in inst.get("series", {}).items():
+                key = "%s,%s" % (prefix, labels) if labels else prefix
+                out["series"][key] = value
+    return merged
+
+
+def scrape(scheduler=None, serving=None, timeout=5.0):
+    """Scrape the whole fleet reachable from one scheduler.
+
+    Returns ``{"epoch", "quorum", "members": [...], "registry": ...}``
+    where each member entry is ``{"role", "rank", "addr", "ok"}`` plus
+    ``"error"`` when the fetch failed, and ``registry`` is the merged,
+    role/rank-labeled registry of every member that answered.
+
+    ``serving`` is an optional list of ``host:port`` model-server
+    addresses (they are not part of PS membership).
+    """
+    from ..kvstore.rpc import request
+    sched = _addr(scheduler)
+    meta, _ = request(sched, {"op": "membership"}, timeout=timeout)
+    if meta.get("error"):
+        raise RuntimeError("membership query to %s:%s failed: %s"
+                           % (sched[0], sched[1], meta["error"]))
+    targets = [("scheduler", 0, sched)]
+    for rank, addr in sorted((int(r), a) for r, a in
+                             (meta.get("servers") or {}).items()):
+        targets.append(("server", rank, tuple(addr)))
+    for rank, addr in sorted((int(r), a) for r, a in
+                             (meta.get("workers") or {}).items()):
+        if addr and int(addr[1]) > 0:   # pre-observability placeholder = 0
+            targets.append(("worker", rank, tuple(addr)))
+    for i, spec in enumerate(serving or []):
+        targets.append(("serving", i, _addr(spec)))
+
+    members, snaps = [], []
+    for role, rank, addr in targets:
+        entry = {"role": role, "rank": rank,
+                 "addr": "%s:%s" % (addr[0], addr[1])}
+        try:
+            snap = fetch_member(addr, role=role, timeout=timeout)
+            entry["ok"] = True
+            snaps.append((role, rank, snap))
+        except (OSError, RuntimeError, ValueError) as exc:
+            entry["ok"] = False
+            entry["error"] = str(exc)
+        members.append(entry)
+    return {"epoch": meta.get("epoch"), "quorum": meta.get("quorum"),
+            "members": members, "registry": merge(snaps)}
+
+
+def hist_quantile(series_value, q):
+    """Approximate quantile from a JSON-snapshot histogram series value
+    ``{"count", "sum", "buckets": {edge: cumulative_count}}`` (linear
+    within the winning bucket, like prometheus histogram_quantile)."""
+    if not isinstance(series_value, dict):
+        return None
+    count = series_value.get("count") or 0
+    buckets = series_value.get("buckets") or {}
+    if not count or not buckets:
+        return None
+    target = q * count
+    edges = sorted(buckets.items(), key=lambda kv: float(kv[0]))
+    prev_edge, prev_cum = 0.0, 0
+    for edge, cum in edges:
+        e = float(edge)
+        if cum >= target:
+            if cum == prev_cum:
+                return e
+            frac = (target - prev_cum) / float(cum - prev_cum)
+            return prev_edge + frac * (e - prev_edge)
+        prev_edge, prev_cum = e, cum
+    return float(edges[-1][0]) if edges else None

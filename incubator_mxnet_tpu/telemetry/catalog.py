@@ -179,6 +179,37 @@ serving_models = _m.gauge(
     "mxtpu_serving_models_loaded", "Models currently loaded in the server")
 
 
+# -- observability plane (tracing ring, flight, debugz, costs) --------
+telemetry_spans_dropped = _m.counter(
+    "mxtpu_telemetry_spans_dropped_total",
+    "Finished trace spans evicted from the bounded retention ring "
+    "(MXTPU_TRACE_MAX_SPANS) to admit newer ones")
+flight_events = _m.counter(
+    "mxtpu_flight_events_total",
+    "Flight-recorder events recorded, by event type")
+debugz_requests = _m.counter(
+    "mxtpu_debugz_requests_total",
+    "Debugz HTTP requests served, by path and status")
+model_flops_per_exec = _m.gauge(
+    "mxtpu_model_flops_per_executable",
+    "Static XLA cost-analysis FLOPs for one run of the named executable")
+model_bytes_per_exec = _m.gauge(
+    "mxtpu_model_bytes_per_executable",
+    "Static XLA cost-analysis bytes accessed for one run of the named "
+    "executable")
+model_achieved_tflops = _m.gauge(
+    "mxtpu_model_achieved_tflops",
+    "Achieved TFLOP/s over the last observed execution of the named "
+    "executable")
+model_flops_utilization = _m.gauge(
+    "mxtpu_model_flops_utilization",
+    "Achieved FLOP/s as a fraction of the MXTPU_PEAK_TFLOPS roofline "
+    "(MFU) for the named executable")
+model_tokens_per_sec = _m.gauge(
+    "mxtpu_model_tokens_per_sec",
+    "Samples/tokens consumed per second by the named executable")
+
+
 # -- jax compile hook ------------------------------------------------
 # jax.monitoring calls duration listeners for every instrumented event;
 # we fold the XLA backend-compile ones into the trainer_jit_* counters.

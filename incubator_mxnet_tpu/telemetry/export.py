@@ -142,6 +142,18 @@ def _atexit_flush():
             flush()
         except OSError:
             pass    # export target vanished at shutdown
+    # a clean exit must not lose the retained spans / flight events:
+    # both dumps are no-ops unless their env knobs are set
+    try:
+        from . import tracing as _tracing
+        _tracing.dump_spans()       # MXTPU_TRACE_EXPORT
+    except OSError:
+        pass
+    try:
+        from . import flight as _flight
+        _flight.dump()              # MXTPU_FLIGHT_EXPORT
+    except OSError:
+        pass
 
 
 atexit.register(_atexit_flush)

@@ -19,15 +19,18 @@ span is already active (needed so propagated contexts keep linking).
 """
 
 import json
+import os
 import threading
 import time
 import uuid
+from collections import deque
 
 from .. import profiler
 from . import metrics as _metrics
 
 __all__ = ["span", "from_meta", "current", "inject", "extract",
-           "merge_traces", "Span"]
+           "merge_traces", "Span", "recent_spans", "clear_spans",
+           "dump_spans"]
 
 # RPC meta keys the propagation rides on (underscore-prefixed like the
 # idempotency keys _client/_seq so servers treat them as annotations).
@@ -35,6 +38,68 @@ TRACE_KEY = "_trace"
 PARENT_KEY = "_pspan"
 
 _tls = threading.local()
+
+
+def _default_max_spans():
+    try:
+        return max(16, int(os.environ.get("MXTPU_TRACE_MAX_SPANS", "4096")))
+    except ValueError:
+        return 4096
+
+
+# Bounded retention of finished spans.  profiler._events only records
+# while the profiler is running, so without this ring spans opened under
+# metrics-only telemetry were kept nowhere; with it /tracez and the
+# atexit trace dump always have the last MXTPU_TRACE_MAX_SPANS spans,
+# and week-long jobs can't grow span storage without bound.
+_finished_lock = threading.Lock()
+_finished = deque(maxlen=_default_max_spans())
+
+
+def _resize(maxlen):
+    """Swap the retention ring's capacity (tests); keeps newest spans."""
+    global _finished
+    with _finished_lock:
+        _finished = deque(_finished, maxlen=max(1, int(maxlen)))
+
+
+def _retain(rec):
+    dropped = False
+    with _finished_lock:
+        if len(_finished) == _finished.maxlen:
+            dropped = True
+        _finished.append(rec)
+    if dropped and _metrics._state["enabled"]:
+        from . import catalog as _cat  # late: catalog imports this module's package
+        _cat.telemetry_spans_dropped.inc()
+
+
+def recent_spans(n=None):
+    """Newest-last list of finished span records (bounded ring)."""
+    with _finished_lock:
+        spans = list(_finished)
+    return spans[-int(n):] if n else spans
+
+
+def clear_spans():
+    with _finished_lock:
+        _finished.clear()
+
+
+def dump_spans(path=None):
+    """Write retained spans as JSONL.  ``path`` defaults to
+    ``MXTPU_TRACE_EXPORT``; no-op (returns None) when neither is set."""
+    path = path or os.environ.get("MXTPU_TRACE_EXPORT")
+    if not path:
+        return None
+    spans = recent_spans()
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        for rec in spans:
+            f.write(json.dumps(rec, default=str))
+            f.write("\n")
+    os.replace(tmp, path)
+    return path
 
 
 def _stack():
@@ -85,8 +150,12 @@ class Span:
         if exc_type is not None:
             args["error"] = exc_type.__name__
         args.update(self.attrs)
+        dur = time.time() * 1e6 - self._t0
         profiler._record("span", self.name, ts=self._t0,
-                         dur=time.time() * 1e6 - self._t0, args=args)
+                         dur=dur, args=args)
+        rec = {"name": self.name, "ts_us": self._t0, "dur_us": dur}
+        rec.update(args)
+        _retain(rec)
         return False
 
 

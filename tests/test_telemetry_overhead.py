@@ -6,7 +6,7 @@ the real disabled cost is ~100ns/call, the bound allows 50x that)."""
 import time
 
 from incubator_mxnet_tpu import profiler, telemetry
-from incubator_mxnet_tpu.telemetry import tracing
+from incubator_mxnet_tpu.telemetry import costs, debugz, flight, tracing
 
 N = 100_000
 MAX_SECONDS_PER_CALL = 5e-6     # 50x headroom over the measured cost
@@ -58,3 +58,35 @@ def test_enabled_flag_is_single_predicate():
         assert telemetry.enabled() is True
     finally:
         telemetry.disable()
+
+
+def test_disabled_flight_record_is_cheap_and_records_nothing():
+    was = flight.enabled()
+    flight.disable()
+    try:
+        flight.clear()
+        assert _per_call(lambda: flight.record("ev", a=1)) \
+            < MAX_SECONDS_PER_CALL
+        assert flight.events() == []
+    finally:
+        if was:
+            flight.enable()
+
+
+def test_disabled_cost_observe_is_cheap_and_records_nothing():
+    telemetry.disable()
+    costs.capture("overhead_exec", cost={"flops": 1e9, "bytes": 1e6})
+    try:
+        assert _per_call(lambda: costs.observe("overhead_exec", 0.1)) \
+            < MAX_SECONDS_PER_CALL
+        from incubator_mxnet_tpu.telemetry import catalog
+        assert catalog.model_flops_utilization.value(
+            name="overhead_exec") == 0
+    finally:
+        costs.reset()
+
+
+def test_inactive_debugz_status_is_cheap():
+    assert not debugz.active()
+    assert _per_call(lambda: debugz.set_status("k", 1)) \
+        < MAX_SECONDS_PER_CALL

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""bench_diff — automated reader for the BENCH_r*.json trajectory.
+
+Compares the newest round against the previous one: every throughput
+metric the two rounds share (unit contains "/sec" — higher is better)
+plus any `mfu` fields. Exits nonzero when a shared metric regressed by
+more than --threshold (default 10%), so CI or a human can gate on "did
+this round get slower" without reading JSON by hand.
+
+Preflight health rows (tunnel_preflight_*) are diagnostics, not
+benchmarks — dispatch RTT is lower-is-better and tunnel-condition
+dependent — so they are reported but never gated on.
+
+    python tools/bench_diff.py                 # newest vs previous, repo root
+    python tools/bench_diff.py --dir . --threshold 0.05
+    python tools/bench_diff.py --old BENCH_r03.json --new BENCH_r05.json
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_round(path):
+    """{metric: {"value", "unit", "mfu"?}} from one BENCH_r*.json (its
+    `tail` field holds the bench stdout with one JSON line per metric)."""
+    with open(path) as f:
+        doc = json.load(f)
+    out = {}
+    for line in (doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "metric" not in rec or "value" not in rec:
+            continue
+        out[rec["metric"]] = rec
+    return out
+
+
+def comparable(rec):
+    """Gate-worthy throughput row: higher-is-better per-second units,
+    excluding the preflight health probes."""
+    if rec["metric"].startswith("tunnel_preflight"):
+        return False
+    return "/sec" in str(rec.get("unit", ""))
+
+
+def diff(old, new, threshold):
+    """[(metric, kind, old, new, ratio, regressed)] over shared rows."""
+    rows = []
+    for metric in sorted(set(old) & set(new)):
+        o, n = old[metric], new[metric]
+        if comparable(o) and comparable(n):
+            ratio = n["value"] / o["value"] if o["value"] else float("inf")
+            rows.append((metric, "throughput", o["value"], n["value"],
+                         ratio, ratio < 1.0 - threshold))
+        if "mfu" in o and "mfu" in n:
+            ratio = n["mfu"] / o["mfu"] if o["mfu"] else float("inf")
+            rows.append((metric, "mfu", o["mfu"], n["mfu"], ratio,
+                         ratio < 1.0 - threshold))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--old", default=None, help="explicit older round file")
+    ap.add_argument("--new", default=None, help="explicit newer round file")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression gate as a fraction (default 0.10)")
+    args = ap.parse_args(argv)
+
+    if (args.old is None) != (args.new is None):
+        ap.error("pass both --old and --new, or neither")
+    if args.old:
+        old_path, new_path = args.old, args.new
+    else:
+        rounds = sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+        if len(rounds) < 2:
+            print("bench_diff: need at least two BENCH_r*.json rounds "
+                  "under %s, found %d" % (args.dir, len(rounds)))
+            return 2
+        old_path, new_path = rounds[-2], rounds[-1]
+
+    old = load_round(old_path)
+    new = load_round(new_path)
+    rows = diff(old, new, args.threshold)
+
+    print("bench_diff: %s -> %s (gate: -%.0f%%)"
+          % (os.path.basename(old_path), os.path.basename(new_path),
+             args.threshold * 100))
+    if not rows:
+        print("no shared throughput metrics between the two rounds")
+        return 2
+    failed = False
+    for metric, kind, o, n, ratio, regressed in rows:
+        flag = "REGRESSED" if regressed else "ok"
+        print("  %-9s %-52s %12.2f -> %12.2f  %+6.1f%%  %s"
+              % (kind, metric, o, n, (ratio - 1.0) * 100, flag))
+        failed = failed or regressed
+    skipped = [m for m in sorted(set(old) & set(new))
+               if not comparable(old[m]) and "mfu" not in old[m]]
+    if skipped:
+        print("  (not gated: %s)" % ", ".join(skipped))
+    if failed:
+        print("bench_diff: FAIL — regression beyond %.0f%%"
+              % (args.threshold * 100))
+        return 1
+    print("bench_diff: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,31 @@ def main():
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
             print("server       : %s unreachable (%s)" % (addr, e))
 
+    section("Debugz")
+    # live-process probe: point MXTPU_DEBUGZ_PORT at a process that
+    # started its debug server and diagnose reports its /statusz
+    dport = os.environ.get("MXTPU_DEBUGZ_PORT", "")
+    if not dport or dport == "0":
+        print("(no port configured — set MXTPU_DEBUGZ_PORT to a live "
+              "process's debugz port; 0 means auto-bind, see that "
+              "process's stderr for the chosen port)")
+    else:
+        url = "http://127.0.0.1:%s/statusz" % dport
+        try:
+            import json as _json
+            from urllib.request import urlopen
+            with urlopen(url, timeout=3) as resp:
+                status = _json.loads(resp.read().decode("utf-8"))
+            print("statusz      :", url, "up")
+            for key in ("role", "rank", "pid", "uptime_s", "epoch",
+                        "models", "jax_devices"):
+                if key in status:
+                    print("  - %s: %s" % (key, status[key]))
+            print("  endpoints: /metrics /metrics.json /statusz /tracez "
+                  "/threadz /flightz")
+        except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+            print("statusz      : %s unreachable (%s)" % (url, e))
+
     section("Membership")
     # elastic-fabric probe: when a parameter-server scheduler is
     # reachable (DMLC_PS_ROOT_URI/PORT), report its epoch-numbered

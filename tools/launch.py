@@ -81,6 +81,10 @@ def main():
     parser.add_argument("--max-workers", type=int, default=None,
                         help="elastic upper bound on concurrently live "
                         "workers (default: --num-workers)")
+    parser.add_argument("--debugz", action="store_true",
+                        help="every spawned role auto-binds a /debugz "
+                        "HTTP server (MXTPU_DEBUGZ_PORT=0; each child "
+                        "prints its bound port on stderr)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if args.num_servers is None:
@@ -126,6 +130,10 @@ def main():
     })
     if args.elastic:
         base_env["MXTPU_ELASTIC"] = "1"
+    if args.debugz or "MXTPU_DEBUGZ_PORT" in os.environ:
+        # children must never inherit a FIXED parent port (N processes
+        # would race for one bind): force auto-pick in every role
+        base_env["MXTPU_DEBUGZ_PORT"] = "0"
 
     procs = []
     role_cmd = [sys.executable, "-m", "incubator_mxnet_tpu.kvstore.dist_server"]

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""mxtop — live terminal dashboard over the fleet telemetry scrape.
+
+Walks the scheduler's membership view via telemetry.aggregate.scrape()
+once per interval and renders per-member rates: kvstore push bytes/s,
+rpc retries, compile seconds, guardian skips, membership epoch, and —
+for model servers passed with --serving — QPS, p99 latency, batch
+occupancy, and shed counts. Counters are turned into rates by diffing
+consecutive scrapes.
+
+    python tools/mxtop.py                      # scheduler from DMLC env
+    python tools/mxtop.py --scheduler host:port --serving host:port
+    python tools/mxtop.py --once               # one frame, no clearing
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_mxnet_tpu.telemetry import aggregate  # noqa: E402
+
+
+def _series_sum(registry, name, where=None):
+    """Sum of a (counter) instrument's series values, optionally
+    filtered by a label-substring predicate on the series key."""
+    inst = registry.get(name) or {}
+    total = 0.0
+    for key, val in (inst.get("series") or {}).items():
+        if where and where not in key:
+            continue
+        if isinstance(val, dict):      # histogram: use the count
+            total += val.get("count", 0)
+        else:
+            total += val
+    return total
+
+
+def _member_key(role, rank):
+    return "role=%s,rank=%s" % (role, rank)
+
+
+def _rates(prev, cur, elapsed):
+    if prev is None or elapsed <= 0:
+        return {k: 0.0 for k in cur}
+    return {k: max(0.0, (cur[k] - prev.get(k, 0.0)) / elapsed)
+            for k in cur}
+
+
+def frame(scheduler, serving, prev_totals, prev_ts):
+    scrape = aggregate.scrape(scheduler=scheduler, serving=serving)
+    reg = scrape["registry"]
+    now = time.monotonic()
+    elapsed = (now - prev_ts) if prev_ts else 0.0
+
+    lines = []
+    lines.append("mxtop  %s  epoch=%s quorum=%s  members=%d (%d up)"
+                 % (time.strftime("%H:%M:%S"), scrape["epoch"],
+                    scrape["quorum"], len(scrape["members"]),
+                    sum(1 for m in scrape["members"] if m["ok"])))
+    lines.append("-" * 78)
+    lines.append("%-10s %-5s %-21s %12s %8s %9s %7s"
+                 % ("ROLE", "RANK", "ADDR", "PUSH B/s", "RETRY/s",
+                    "COMPILE s", "SKIPS"))
+
+    totals = {}
+    for m in scrape["members"]:
+        key = _member_key(m["role"], m["rank"])
+        if not m["ok"]:
+            lines.append("%-10s %-5s %-21s  DOWN: %s"
+                         % (m["role"], m["rank"], m["addr"],
+                            m.get("error", "?")[:40]))
+            continue
+        totals[key + "/push_bytes"] = _series_sum(
+            reg, "mxtpu_kvstore_push_bytes_total", where=key)
+        totals[key + "/retries"] = _series_sum(
+            reg, "mxtpu_rpc_retries_total", where=key)
+        compile_s = _series_sum(
+            reg, "mxtpu_trainer_jit_compile_seconds_total", where=key)
+        skips = _series_sum(
+            reg, "mxtpu_guard_skipped_steps_total", where=key)
+        r = _rates({k: prev_totals.get(k, 0.0) for k in totals},
+                   totals, elapsed)
+        lines.append("%-10s %-5s %-21s %12.0f %8.2f %9.1f %7.0f"
+                     % (m["role"], m["rank"], m["addr"],
+                        r.get(key + "/push_bytes", 0.0),
+                        r.get(key + "/retries", 0.0), compile_s, skips))
+
+    # serving rollup (per model): QPS / p99 / occupancy / shed
+    req = reg.get("mxtpu_serving_requests_total") or {}
+    models = sorted({seg.split("model=", 1)[1].split(",")[0]
+                     for seg in (req.get("series") or {})
+                     if "model=" in seg})
+    if models:
+        lines.append("")
+        lines.append("%-16s %8s %9s %10s %7s"
+                     % ("MODEL", "QPS", "p99 ms", "OCCUPANCY", "SHED"))
+        lat = reg.get("mxtpu_serving_request_seconds") or {}
+        occ = reg.get("mxtpu_serving_batch_occupancy") or {}
+        for model in models:
+            sel = "model=%s" % model
+            ok = _series_sum(reg, "mxtpu_serving_requests_total",
+                             where=sel + ",status=ok")
+            totals["serve/%s/ok" % model] = ok
+            qps = _rates({("serve/%s/ok" % model):
+                          prev_totals.get("serve/%s/ok" % model, 0.0)},
+                         {("serve/%s/ok" % model): ok},
+                         elapsed)["serve/%s/ok" % model]
+            p99 = occ_mean = None
+            for skey, sval in (lat.get("series") or {}).items():
+                if sel in skey:
+                    p99 = aggregate.hist_quantile(sval, 0.99)
+            for skey, sval in (occ.get("series") or {}).items():
+                if sel in skey and isinstance(sval, dict) \
+                        and sval.get("count"):
+                    occ_mean = sval["sum"] / sval["count"]
+            shed = _series_sum(reg, "mxtpu_serving_shed_total", where=sel)
+            lines.append("%-16s %8.1f %9s %10s %7.0f"
+                         % (model, qps,
+                            "%.1f" % (p99 * 1e3) if p99 is not None else "-",
+                            "%.1f" % occ_mean if occ_mean is not None
+                            else "-", shed))
+    return "\n".join(lines), totals, now, scrape
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scheduler", default=None,
+                    help="host:port (default: DMLC_PS_ROOT_URI/PORT)")
+    ap.add_argument("--serving", action="append", default=None,
+                    help="model-server host:port (repeatable)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: print the raw scrape as JSON")
+    args = ap.parse_args(argv)
+
+    prev_totals, prev_ts = {}, None
+    while True:
+        try:
+            text, prev_totals, prev_ts, scrape = frame(
+                args.scheduler, args.serving, prev_totals, prev_ts)
+        except (OSError, RuntimeError) as exc:
+            text, scrape = "mxtop: scrape failed: %s" % exc, None
+        if args.once:
+            if args.json and scrape is not None:
+                print(json.dumps(scrape, indent=2, default=str))
+            else:
+                print(text)
+            return 0 if scrape is not None else 1
+        sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
