@@ -16,7 +16,8 @@ host transfer of the final loss (float(...)), which cannot complete before
 every queued step has executed on device.
 
 BENCH_MODEL selects a single benchmark: resnet50 | bert | bert_long |
-resnet50_pipe. bert runs REAL BERT-base pretraining — BERTForPretrain
+resnet50_pipe | lstm | ssd | serving_bert | stream_input | ... (see
+_dispatch). bert runs REAL BERT-base pretraining — BERTForPretrain
 with the full MLM objective (gather-first masked-position decode through
 the 768x30522 vocab projection, loss on the 15% masked slots) plus the
 NSP head, per the reference pretraining recipe.
@@ -1111,6 +1112,101 @@ def bench_serving():
         srv.stop()
 
 
+def bench_stream():
+    import shutil
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="bench_stream_")
+    try:
+        return _bench_stream(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_stream(tmp):
+    """BENCH_MODEL=stream_input: input-plane throughput through the FULL
+    streaming data plane — coordinator assignment, worker decode+collate,
+    wire transport, double-buffered device prefetch — while a simulated
+    train step of BENCH_STREAM_STEP_MS runs between batches. One JSON
+    line: records/sec per host (gated by bench_diff like every /sec row)
+    plus the two overlap numbers the acceptance test pins — batch-wait
+    p99 ms and step-overlap % (share of wall time NOT spent waiting on
+    input; >=90 means the device never starves).
+
+    Knobs: BENCH_STREAM_SHARDS (8), BENCH_STREAM_RECORDS per shard (128),
+    BENCH_STREAM_WORKERS (2), BENCH_STREAM_BATCH (32),
+    BENCH_STREAM_STEP_MS (5), BENCH_STREAM_DIM (1024)."""
+    from incubator_mxnet_tpu.io.stream import (DataWorker, StreamCoordinator,
+                                               StreamLoader)
+    from incubator_mxnet_tpu.io.stream import records as srec
+
+    n_shards = int(os.environ.get("BENCH_STREAM_SHARDS", "8"))
+    per_shard = int(os.environ.get("BENCH_STREAM_RECORDS", "128"))
+    n_workers = int(os.environ.get("BENCH_STREAM_WORKERS", "2"))
+    batch = int(os.environ.get("BENCH_STREAM_BATCH", "32"))
+    step_ms = float(os.environ.get("BENCH_STREAM_STEP_MS", "5"))
+    dim = int(os.environ.get("BENCH_STREAM_DIM", "1024"))
+
+    rng = np.random.RandomState(0)
+    shards = []
+    for s in range(n_shards):
+        uri = os.path.join(tmp, "part-%03d.rec" % s)
+        srec.write_shard(uri, ({"data": rng.rand(dim).astype(np.float32),
+                                "label": np.int64(s * per_shard + i)}
+                               for i in range(per_shard)))
+        shards.append(srec.shard_info(uri))
+
+    coord = StreamCoordinator(shards, seed=0, batch_size=batch,
+                              window=max(batch, 64)).start()
+    workers = [DataWorker(coord.addr).start() for _ in range(n_workers)]
+    loader = StreamLoader(coordinator=coord.addr, epochs=1)
+    n_records = n_shards * per_shard
+    epoch_ctr = [0]
+    waits, elapsed = [], [0.0]
+
+    def run():
+        # one full epoch in planned order; per-batch wait measured at the
+        # consumer so it is exactly what a training loop would stall on
+        waits.clear()
+        it = loader.epoch(epoch_ctr[0])
+        epoch_ctr[0] += 1
+        t_run = time.perf_counter()
+        n = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                b = next(it)
+            except StopIteration:
+                break
+            waits.append(time.perf_counter() - t0)
+            n += int(b["label"].shape[0])
+            if step_ms:
+                time.sleep(step_ms / 1e3)    # the simulated device step
+        elapsed[0] = time.perf_counter() - t_run
+        assert n == n_records, "epoch served %d of %d records" % (
+            n, n_records)
+
+    try:
+        run()   # warm: worker decode caches, connections, transfer path
+        stats = _timed_rate(run, n_records)
+        p99 = (float(np.percentile([w * 1e3 for w in waits], 99))
+               if waits else None)
+        overlap = 100.0 * (1.0 - sum(waits) / max(elapsed[0], 1e-9))
+        _emit("stream_input_records_per_sec_per_host",
+              "records/sec/host (%dx%d records, %d worker(s), bs %d, "
+              "%.0f ms simulated step)"
+              % (n_shards, per_shard, n_workers, batch, step_ms),
+              stats,
+              batch_wait_p99_ms=(round(p99, 3) if p99 is not None
+                                 else None),
+              overlap_pct=round(overlap, 1),
+              workers=n_workers, batch_size=batch)
+    finally:
+        loader.close()
+        for w in workers:
+            w.stop()
+        coord.stop()
+
+
 def _emit_telemetry_summary():
     """Closing JSON line: what the run itself observed — step-time
     histogram stats and the XLA compile tax — so a perf number can be
@@ -1160,6 +1256,8 @@ def _dispatch(model, batch, steps, dtype):
         return bench_int8_matmul()
     if model == "serving_bert":
         return bench_serving()
+    if model == "stream_input":
+        return bench_stream()
     if model == "ssd":
         return bench_ssd(int(os.environ.get("BENCH_STEPS", "30")), dtype)
     if model == "consistency":

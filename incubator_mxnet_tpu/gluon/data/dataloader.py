@@ -46,6 +46,10 @@ from .sampler import SequentialSampler, RandomSampler, BatchSampler
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 
+class _ClosedError(Exception):
+    """Internal: the loader was close()d while a batch wait was blocked."""
+
+
 def default_batchify_fn(data):
     """Stack samples into a batch NDArray (recursive on tuples)."""
     if isinstance(data[0], tuple):
@@ -251,6 +255,9 @@ class DataLoader:
                  thread_pool=False, timeout=120):
         self._dataset = dataset
         self._timeout = timeout
+        self._closed = False
+        self._pin_memory = pin_memory
+        self._prefetchers = []      # live DevicePrefetchers (pin_memory)
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size must be specified unless "
@@ -326,13 +333,52 @@ class DataLoader:
             _cat.dataloader_worker_respawns.inc(len(new))
             self._worker_pids |= pids
 
+    def _result_get(self, result):
+        """result.get(self._timeout) as a short poll loop so close()
+        from another thread (or __del__ terminating the pool) unblocks
+        the consumer within one poll tick instead of the full timeout."""
+        deadline = _time.monotonic() + self._timeout
+        while True:
+            if self._closed:
+                raise _ClosedError("DataLoader closed during batch wait")
+            try:
+                return result.get(0.2)
+            except mp.TimeoutError:
+                if _time.monotonic() >= deadline:
+                    raise
+
     def __iter__(self):
+        if self._closed:
+            raise RuntimeError("DataLoader is closed")
+        if not self._pin_memory:
+            for batch in self._iter_host():
+                yield _to_device(batch)
+            return
+        # pin_memory: overlap the device transfer with the step via the
+        # stream plane's double buffer; close() reaches the prefetcher
+        # through _prefetchers so an early close drains its thread and
+        # queue instead of leaking them
+        from ...io.stream.loader import DevicePrefetcher
+        pf = DevicePrefetcher(self._iter_host(), depth=2,
+                              transfer=_to_device,
+                              name="dataloader-pin")
+        self._prefetchers.append(pf)
+        try:
+            for batch in pf:
+                yield batch
+        finally:
+            pf.close()
+            if pf in self._prefetchers:
+                self._prefetchers.remove(pf)
+
+    def _iter_host(self):
+        """Yield HOST (numpy) batches; __iter__ layers device placement
+        (inline or via the pin_memory prefetch thread) on top."""
         if self._pool is None:
             for batch in self._batch_sampler:
                 out = self._batchify_fn([self._dataset[i] for i in batch])
                 _cat.dataloader_batches.inc()
-                yield _to_device(out) if isinstance(out, _np.ndarray) or (
-                    isinstance(out, list) and out and isinstance(out[0], _np.ndarray)) else out
+                yield out
             return
 
         # async prefetch pipeline through the worker pool
@@ -358,14 +404,20 @@ class DataLoader:
                 enabled = _met.enabled()
                 t0 = _time.perf_counter() if enabled else 0.0
                 wd = _wd.current()
-                if wd is not None:
-                    # hang watchdog: a worker that never answers trips
-                    # the "batch_wait" deadline (stack+telemetry dump)
-                    # long before self._timeout (default 600s) gives up
-                    with wd.phase("batch_wait"):
-                        batch = result.get(self._timeout)
-                else:
-                    batch = result.get(self._timeout)
+                try:
+                    if wd is not None:
+                        # hang watchdog: a worker that never answers trips
+                        # the "batch_wait" deadline (stack+telemetry dump)
+                        # long before self._timeout (default 600s) gives
+                        # up; the phase context exits on ANY outcome —
+                        # including _ClosedError from an early close — so
+                        # it cannot stay armed past teardown
+                        with wd.phase("batch_wait"):
+                            batch = self._result_get(result)
+                    else:
+                        batch = self._result_get(result)
+                except _ClosedError:
+                    return
                 if enabled:
                     _cat.dataloader_wait_seconds.observe(
                         _time.perf_counter() - t0)
@@ -380,14 +432,20 @@ class DataLoader:
                     # is on: it fell back (e.g. no free slot / shm error)
                     _cat.dataloader_shm_fallbacks.inc()
                 submit()
-                yield _to_device(batch)
+                yield batch
         finally:
             # abandoning iteration mid-epoch must not strand ring slots in
             # flight: recycle each in-flight token straight from the
-            # message header (no need to memcpy batches nobody will read)
+            # message header (no need to memcpy batches nobody will read).
+            # After close() the pool is gone — nothing will ever answer,
+            # so draining would just burn a timeout per pending result.
+            drain_by = _time.monotonic() + min(self._timeout, 5.0)
             for result in pending:
+                if self._closed or self._pool is None:
+                    break
                 try:
-                    batch = result.get(self._timeout)
+                    batch = result.get(
+                        max(0.0, drain_by - _time.monotonic()))
                 except Exception:  # mxlint: disable=broad-except
                     # mid-epoch teardown: a worker may already be
                     # gone; recycling what answered is all we need
@@ -401,13 +459,40 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
-    def __del__(self):
-        try:
-            if self._pool is not None:
-                self._pool.terminate()
-        except Exception:
-            pass
+    def close(self):
+        """Tear down workers, shm ring and pin_memory buffers NOW.
+
+        Idempotent and safe mid-epoch: a consumer blocked in the batch
+        wait observes the closed flag within one poll tick, its watchdog
+        phase disarms, and in-flight device batches are dropped. Called
+        by __del__; usable as a context manager for deterministic
+        release."""
+        if self._closed:
+            return
+        self._closed = True
+        for pf in list(self._prefetchers):
+            pf.close()
+        del self._prefetchers[:]
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.terminate()
+            pool.join()
         for _, seg in self._segments.values():
             seg.close()
+        self._segments = {}
         if getattr(self, "_ring_finalizer", None) is not None:
             self._ring_finalizer()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # mxlint: disable=broad-except — interpreter
+            # teardown: pool/segments may be half-collected already
+            pass

@@ -13,4 +13,14 @@ from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter", "ImageDetRecordIter", "MXDataIter"]
+           "LibSVMIter", "ImageDetRecordIter", "MXDataIter", "stream"]
+
+
+def __getattr__(name):
+    # mx.io is imported ahead of kvstore/telemetry/resilience in the
+    # package __init__; the stream plane sits on top of all three, so it
+    # loads lazily (PEP 562) on first touch of ``mx.io.stream``
+    if name == "stream":
+        import importlib
+        return importlib.import_module(".stream", __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,36 @@
+"""Sharded streaming data plane (ROADMAP item 4).
+
+A disaggregated input service over the kvstore RPC fabric, after
+tf.data service (Murray et al., VLDB'21; Audibert et al., 2023):
+
+* ``plan``      — deterministic windowed global shuffle + rendezvous
+  shard placement (the sampling-neutrality core);
+* ``records``   — sample ⇄ bytes codec over serving/wire.py (no pickle);
+* ``pack``      — pad-or-pack collation with pow2 length buckets;
+* ``registry``  — ShardRegistry + StreamCoordinator control service;
+* ``worker``    — DataWorker shard decode/serve service;
+* ``client``    — StreamClient deterministic fetch with failover;
+* ``loader``    — DevicePrefetcher / StreamLoader double-buffered
+  host→device prefetch for the trainer.
+
+See docs/DATA.md for topology, shuffle-window semantics and packing
+rules; MXTPU_STREAM_* knobs are in docs/ENV_VARS.md.
+"""
+
+from . import pack, plan, records
+from .client import StreamClient, StreamError
+from .loader import DevicePrefetcher, StreamLoader
+from .plan import assign_shards, build_epoch_plan
+from .records import decode_sample, encode_sample, shard_info, write_shard
+from .registry import ShardRegistry, StreamCoordinator
+from .worker import DataWorker
+
+__all__ = [
+    "pack", "plan", "records",
+    "StreamClient", "StreamError",
+    "DevicePrefetcher", "StreamLoader",
+    "assign_shards", "build_epoch_plan",
+    "decode_sample", "encode_sample", "shard_info", "write_shard",
+    "ShardRegistry", "StreamCoordinator",
+    "DataWorker",
+]

@@ -1,0 +1,176 @@
+"""Trainer-side stream client: deterministic fetch with failover.
+
+The client builds the SAME ``EpochPlan`` as every worker (from
+``stream.config``) and walks it in global order, routing each batch to
+its shard's current owner from the coordinator's versioned assignment.
+Failure handling is routing-only, never sampling:
+
+* a dead worker ⇒ ``stream.report_failure`` + assignment refresh +
+  retry of the SAME batch index against the new owner, inside a bounded
+  ``MXTPU_STREAM_RETRY_WINDOW`` (so a vanished fleet surfaces as
+  ``StreamError``, not a silent hang);
+* a quarantined shard ⇒ its batches are SKIPPED (counted, flight-
+  recorded) — the epoch completes degraded with every healthy shard
+  still in the planned order.
+
+Every fetch observes ``stream_client_wait_seconds`` — the histogram the
+acceptance test holds against per-step time to prove overlap.
+"""
+
+import os
+import time
+
+from ...kvstore import rpc as _rpc
+from ...telemetry import catalog as _cat
+from ...telemetry import flight as _fl
+from . import plan as _plan
+
+__all__ = ["StreamClient", "StreamError"]
+
+
+class StreamError(RuntimeError):
+    """The stream could not make progress within the retry window."""
+
+
+class StreamClient:
+    def __init__(self, coordinator, timeout=30.0, retry_window=None):
+        self._coord = _rpc.Connection(
+            (str(coordinator[0]), int(coordinator[1])), timeout=timeout)
+        self._timeout = float(timeout)
+        self._retry_window = float(
+            retry_window if retry_window is not None
+            else os.environ.get("MXTPU_STREAM_RETRY_WINDOW", "30"))
+        meta, _ = self._coord.call({"op": "stream.config"})
+        if meta.get("error"):
+            raise StreamError("stream.config failed: %s" % meta["error"])
+        self.config = meta
+        self._plans = {}
+        self._asn = None
+        self._conns = {}            # (host, port) -> Connection
+        self._quarantined = set()
+        self.skipped_batches = 0
+        self.skipped_records = 0
+
+    # ------------------------------------------------------------ plumbing
+    def plan(self, epoch):
+        p = self._plans.get(epoch)
+        if p is None:
+            cfg = self.config
+            p = _plan.build_epoch_plan(
+                cfg["shards"], cfg["seed"], epoch, cfg["batch_size"],
+                window=cfg["window"], drop_last=cfg["drop_last"])
+            self._plans = {epoch: p}    # keep one: epochs are sequential
+        return p
+
+    def _assignment(self, refresh=False):
+        if self._asn is None or refresh:
+            meta, _ = self._coord.call({"op": "stream.assignment"})
+            if meta.get("error"):
+                raise StreamError("stream.assignment failed: %s"
+                                  % meta["error"])
+            self._asn = meta
+            self._quarantined.update(meta.get("quarantined", ()))
+        return self._asn
+
+    def _conn_for(self, addr):
+        addr = (str(addr[0]), int(addr[1]))
+        c = self._conns.get(addr)
+        if c is None:
+            c = _rpc.Connection(addr, timeout=self._timeout)
+            self._conns[addr] = c
+        return c
+
+    def _drop_conn(self, addr):
+        c = self._conns.pop((str(addr[0]), int(addr[1])), None)
+        if c is not None:
+            c.close()
+
+    def _report_failure(self, wid):
+        try:
+            meta, _ = self._coord.call({"op": "stream.report_failure",
+                                        "wid": wid})
+            self._asn = meta
+            self._quarantined.update(meta.get("quarantined", ()))
+        except (OSError, _rpc.ProtocolError):
+            self._asn = None    # coordinator hiccup: refetch next round
+        _cat.stream_fetch_retries.inc()
+        _fl.record("stream.worker_failure", wid=wid)
+
+    # -------------------------------------------------------------- fetch
+    def fetch(self, epoch, index):
+        """Fetch one planned batch; dict of arrays, or None when its
+        shard is quarantined (the caller skips it)."""
+        b = self.plan(epoch).batches[index]
+        if b.uri in self._quarantined:
+            return None
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + self._retry_window
+        delay = 0.05
+        try:
+            while True:
+                arrays, retry = self._try_fetch(b, epoch, index)
+                if not retry:
+                    return arrays
+                if time.monotonic() >= deadline:
+                    raise StreamError(
+                        "batch %d of epoch %d (shard %s) unfetchable for "
+                        "%.0fs — no live owner" %
+                        (index, epoch, b.uri, self._retry_window))
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        finally:
+            _cat.stream_client_wait_seconds.observe(
+                time.perf_counter() - t0)
+
+    def _try_fetch(self, b, epoch, index):
+        """(arrays_or_None, retry?) — one routing attempt."""
+        try:
+            asn = self._assignment()
+        except (OSError, _rpc.ProtocolError):
+            return None, True           # coordinator unreachable: back off
+        if b.uri in self._quarantined:
+            return None, False
+        wid = asn.get("owners", {}).get(b.uri)
+        if wid is None or wid not in asn.get("workers", {}):
+            self._asn = None            # stale or empty: refresh next try
+            _cat.stream_fetch_retries.inc()
+            return None, True
+        addr = asn["workers"][wid]
+        conn = self._conn_for(addr)
+        try:
+            meta, payload = conn.call({"op": "stream.get_batch",
+                                       "epoch": epoch, "index": index})
+        except (OSError, _rpc.ProtocolError):
+            self._drop_conn(addr)
+            self._report_failure(wid)
+            return None, True
+        if meta.get("quarantined"):
+            self._quarantined.add(meta["quarantined"])
+            self._asn = None
+            return None, False
+        if meta.get("error"):
+            raise StreamError("stream.get_batch failed: %s" % meta["error"])
+        from ...serving import wire
+        arrays = wire.unpack_arrays(meta.get("arrays", []), payload)
+        _cat.stream_batches_fetched.inc()
+        return arrays, False
+
+    # -------------------------------------------------------------- epochs
+    def epoch(self, epoch):
+        """Yield the epoch's batches in the deterministic global order,
+        skipping quarantined shards' batches (counted)."""
+        p = self.plan(epoch)
+        for i in range(len(p.batches)):
+            arrays = self.fetch(epoch, i)
+            if arrays is None:
+                self.skipped_batches += 1
+                self.skipped_records += len(p.batches[i].records)
+                continue
+            yield arrays
+
+    def close(self):
+        self._coord.close()
+        conns = list(self._conns.values())
+        self._conns = {}
+        for c in conns:
+            c.close()

@@ -1,0 +1,222 @@
+"""Double-buffered host→device prefetch for the stream plane.
+
+``DevicePrefetcher`` runs a background thread that pulls host batches
+from any iterator, runs the (device-placing) ``transfer`` function
+there, and parks the results in a bounded queue — so the NEXT batch's
+RPC fetch, decode and ``jax.device_put`` all overlap the in-flight
+training step.  The consumer side blocks under the watchdog's
+``batch_wait`` phase and feeds the SAME ``dataloader_batch_wait``
+histogram the per-host DataLoader uses: "input-bound" means one thing
+fleet-wide, whichever loader produced the batch.
+
+Shutdown is the hard part and is test-pinned: ``close()`` (also called
+by ``__del__`` and on consumer ``GeneratorExit``) must [1] never leave
+the producer thread blocked on a full queue, [2] never leave the
+consumer blocked on an empty one, [3] never leave a watchdog
+``batch_wait`` phase armed, and [4] surface a producer exception to the
+consumer instead of swallowing it.  Both sides therefore poll with
+short timeouts against a shared stop event rather than parking
+indefinitely.
+"""
+
+import os
+import queue
+import threading
+
+from ...resilience import watchdog as _wd
+from ...telemetry import catalog as _cat
+from ...telemetry import metrics as _met
+from .client import StreamClient
+
+__all__ = ["DevicePrefetcher", "StreamLoader"]
+
+_ITEM, _END, _ERR = 0, 1, 2
+_POLL_S = 0.2
+
+
+def _default_transfer(batch):
+    """Host→device placement on the prefetch thread (uncommitted default
+    device); trainers override with sharded placement (see
+    ShardedTrainer.stream_loader)."""
+    import jax
+    if isinstance(batch, dict):
+        return {k: jax.device_put(v) for k, v in batch.items()}
+    return jax.device_put(batch)
+
+
+class DevicePrefetcher:
+    """Iterate ``source`` through a ``depth``-bounded background queue,
+    applying ``transfer`` (device_put) on the producer thread."""
+
+    def __init__(self, source, depth=None, transfer=_default_transfer,
+                 name="stream-prefetch"):
+        self.depth = int(depth if depth is not None
+                         else os.environ.get("MXTPU_STREAM_PREFETCH", "2"))
+        if self.depth <= 0:
+            raise ValueError("prefetch depth must be positive")
+        self._source = source
+        self._transfer = transfer
+        self._q = queue.Queue(self.depth)
+        self._stop = threading.Event()
+        self._exhausted = False
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def _put(self, item):
+        """Queue.put that gives up when close() raises the stop flag, so
+        a full buffer can never pin the producer thread (rule [1])."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=_POLL_S)
+                _cat.stream_prefetch_depth.set(self._q.qsize())
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    break
+                if self._transfer is not None:
+                    batch = self._transfer(batch)
+                if not self._put((_ITEM, batch)):
+                    break
+            else:
+                self._put((_END, None))
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            # rule [4]: the consumer re-raises this from __next__
+            self._put((_ERR, e))
+        finally:
+            src_close = getattr(self._source, "close", None)
+            if self._stop.is_set() and callable(src_close):
+                src_close()     # abandoned generator: release its frame
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted or self._stop.is_set():
+            raise StopIteration
+        enabled = _met.enabled()
+        if enabled:
+            import time as _time
+            t0 = _time.perf_counter()
+        wd = _wd.current()
+        if wd is not None:
+            with wd.phase("batch_wait"):
+                kind, value = self._get()
+        else:
+            kind, value = self._get()
+        if enabled:
+            _cat.dataloader_wait_seconds.observe(_time.perf_counter() - t0)
+        if kind == _ITEM:
+            if enabled:
+                _cat.dataloader_batches.inc()
+            return value
+        self._exhausted = True
+        if kind == _ERR:
+            raise value
+        raise StopIteration
+
+    def _get(self):
+        """Queue.get polling the stop flag (rule [2]); exits with the
+        watchdog phase context, so it cannot stay armed (rule [3])."""
+        while True:
+            try:
+                item = self._q.get(timeout=_POLL_S)
+                _cat.stream_prefetch_depth.set(self._q.qsize())
+                return item
+            except queue.Empty:
+                if self._stop.is_set():
+                    return (_END, None)
+
+    # ----------------------------------------------------------- lifecycle
+    def close(self, join_timeout=5.0):
+        """Idempotent early shutdown: unblock both sides and join the
+        producer. Safe mid-epoch — pending device batches are dropped."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        _cat.stream_prefetch_depth.set(0)
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=join_timeout)
+
+    def __del__(self):
+        self.close(join_timeout=0.5)
+
+
+class StreamLoader:
+    """Epoch iterator over a stream coordinator with device prefetch.
+
+    ``for batch in StreamLoader(addr, epochs=3): ...`` walks epochs
+    ``start_epoch .. start_epoch+epochs-1`` in the deterministic global
+    order, each through a fresh DevicePrefetcher. ``transfer`` receives
+    the host batch dict and returns the device-placed structure the
+    training loop consumes.
+    """
+
+    def __init__(self, coordinator=None, client=None, epochs=1,
+                 start_epoch=0, depth=None, transfer=_default_transfer,
+                 retry_window=None):
+        if (coordinator is None) == (client is None):
+            raise ValueError("pass exactly one of coordinator/client")
+        self._own_client = client is None
+        self.client = client if client is not None else StreamClient(
+            coordinator, retry_window=retry_window)
+        self.epochs = int(epochs)
+        self.start_epoch = int(start_epoch)
+        self.depth = depth
+        self._transfer = transfer
+        self._active = None     # the epoch's live DevicePrefetcher
+        self._closed = False
+
+    def epoch(self, e):
+        """A DevicePrefetcher over one epoch's batches (caller closes it
+        or drains it fully)."""
+        if self._closed:
+            raise RuntimeError("StreamLoader is closed")
+        if self._active is not None:
+            self._active.close()
+        self._active = DevicePrefetcher(
+            self.client.epoch(e), depth=self.depth,
+            transfer=self._transfer, name="stream-prefetch-e%d" % e)
+        return self._active
+
+    def __iter__(self):
+        try:
+            for e in range(self.start_epoch, self.start_epoch + self.epochs):
+                pf = self.epoch(e)
+                for batch in pf:
+                    yield batch
+        finally:
+            # GeneratorExit / exception mid-epoch: tear the buffer down
+            # instead of leaking the thread + device batches
+            if self._active is not None:
+                self._active.close()
+                self._active = None
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._active is not None:
+            self._active.close()
+            self._active = None
+        if self._own_client:
+            self.client.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # mxlint: disable=broad-except — interpreter
+            # teardown: modules may be half-collected; nothing to report
+            pass

@@ -1,0 +1,129 @@
+"""Pad-or-pack collation for variable-length sequences.
+
+Mirrors the serving plane's pow2 bucket discipline: batch shapes are
+quantized to powers of two so XLA compiles a handful of program shapes
+instead of one per observed length (see serving/batcher.py).  Two modes:
+
+* **pad** (``collate``): stack fixed-shape arrays; ragged arrays are
+  padded along axis 0 to the pow2 bucket of the batch max and an
+  ``<name>_len`` int32 vector records true lengths.
+* **pack** (``pack_sequences``): concatenate many short sequences into
+  few bucket-length rows (BERT-style sequence packing) with segment-id
+  and position arrays so attention masks can keep sequences from
+  cross-talking.  Rows are filled greedily in arrival order — the
+  epoch plan already globally shuffled the samples, so first-fit here
+  does not re-bias sampling and keeps packing deterministic.
+"""
+
+import numpy as np
+
+__all__ = ["pow2_bucket", "collate", "pack_sequences"]
+
+
+def pow2_bucket(n, min_bucket=16):
+    """Smallest power of two >= n (floored at min_bucket)."""
+    n = int(n)
+    b = 1
+    while b < min_bucket or b < n:
+        b <<= 1
+    return b
+
+
+def _is_ragged(arrs):
+    first = arrs[0].shape
+    return any(a.shape != first for a in arrs[1:])
+
+
+def collate(samples, varlen=(), pad_value=0, min_bucket=16):
+    """Collate sample dicts into one batch dict (pad mode).
+
+    Arrays named in ``varlen`` — plus any whose shapes disagree across
+    the batch — are padded along axis 0 to the pow2 bucket of the batch
+    max length, with true lengths in ``<name>_len``. Everything else is
+    np.stack'ed as-is.
+    """
+    if not samples:
+        raise ValueError("collate: empty batch")
+    names = sorted(samples[0].keys())
+    for s in samples[1:]:
+        if sorted(s.keys()) != names:
+            raise ValueError("collate: inconsistent sample keys %r vs %r"
+                             % (sorted(s.keys()), names))
+    out = {}
+    for name in names:
+        arrs = [np.asarray(s[name]) for s in samples]
+        if name in varlen or _is_ragged(arrs):
+            lens = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
+            bucket = pow2_bucket(int(lens.max()) if len(lens) else 0,
+                                 min_bucket)
+            tail = arrs[0].shape[1:]
+            padded = np.full((len(arrs), bucket) + tail, pad_value,
+                             dtype=arrs[0].dtype)
+            for i, a in enumerate(arrs):
+                if a.shape[1:] != tail:
+                    raise ValueError(
+                        "collate: %r trailing dims differ (%r vs %r)"
+                        % (name, a.shape[1:], tail))
+                padded[i, :a.shape[0]] = a
+            out[name] = padded
+            out[name + "_len"] = lens
+        else:
+            out[name] = np.stack(arrs)
+    return out
+
+
+def pack_sequences(seqs, bucket, pad_value=0):
+    """Pack 1-D sequences into rows of length ``bucket`` (first-fit in
+    arrival order).
+
+    Returns ``(tokens, segments, positions, row_of)``:
+
+    * ``tokens``    (rows, bucket) — packed values, ``pad_value`` filled;
+    * ``segments``  (rows, bucket) int32 — 0 for padding, k>=1 for the
+      k-th sequence packed into that row (the attention-mask key);
+    * ``positions`` (rows, bucket) int32 — position WITHIN each packed
+      sequence (0-based), 0 on padding;
+    * ``row_of``    list of (row, start) per input sequence, so callers
+      can scatter per-sequence labels next to their tokens.
+
+    A sequence longer than ``bucket`` raises — the caller chooses the
+    bucket from its length distribution (cf. pow2_bucket).
+    """
+    bucket = int(bucket)
+    if bucket <= 0:
+        raise ValueError("pack_sequences: bucket must be positive")
+    arrs = [np.asarray(s) for s in seqs]
+    for a in arrs:
+        if a.ndim != 1:
+            raise ValueError("pack_sequences: only 1-D sequences, got shape "
+                             "%r" % (a.shape,))
+        if a.shape[0] > bucket:
+            raise ValueError("pack_sequences: sequence of length %d exceeds "
+                             "bucket %d" % (a.shape[0], bucket))
+    rows = []           # [(used, [seq_index, ...])]
+    row_of = [None] * len(arrs)
+    for i, a in enumerate(arrs):
+        n = a.shape[0]
+        placed = False
+        for ri, (used, members) in enumerate(rows):
+            if used + n <= bucket:
+                row_of[i] = (ri, used)
+                rows[ri] = (used + n, members + [i])
+                placed = True
+                break
+        if not placed:
+            row_of[i] = (len(rows), 0)
+            rows.append((n, [i]))
+    dtype = arrs[0].dtype if arrs else np.int32
+    tokens = np.full((max(len(rows), 1), bucket), pad_value, dtype=dtype)
+    segments = np.zeros((max(len(rows), 1), bucket), dtype=np.int32)
+    positions = np.zeros((max(len(rows), 1), bucket), dtype=np.int32)
+    for ri, (_, members) in enumerate(rows):
+        off = 0
+        for k, i in enumerate(members):
+            n = arrs[i].shape[0]
+            tokens[ri, off:off + n] = arrs[i]
+            segments[ri, off:off + n] = k + 1
+            positions[ri, off:off + n] = np.arange(n, dtype=np.int32)
+            off += n
+    return tokens, segments, positions, row_of

@@ -1,0 +1,157 @@
+"""Deterministic epoch planning: windowed global shuffle + shard placement.
+
+The whole streaming data plane hangs off one invariant: the global
+sample order of an epoch is a PURE FUNCTION of (shard set, seed, epoch,
+batch size, shuffle window) — it never depends on how many data workers
+exist, which worker owns which shard, or the timing of fetches. That is
+what makes elastic joins/leaves sampling-neutral (tf.data service's
+"coordinated reads" argument, Audibert et al. 2023): a worker dying
+mid-epoch changes WHO serves the remaining batches, never WHAT they
+contain.
+
+Construction (all RNG streams are keyed off md5 digests, so the plan is
+stable across processes and interpreter versions — never `hash()`,
+which is salted per process):
+
+1. per shard, record indices are split into contiguous *windows* of
+   ``window`` records; each window is shuffled with rng(seed, epoch,
+   uri, window_index) and the window ORDER within the shard is shuffled
+   with rng(seed, epoch, uri).  ``window=0`` degenerates to a full
+   per-shard shuffle.  The window is the unit of sequential-read
+   locality a data worker can exploit (decode a window once, serve its
+   batches from cache) — the analogue of a tf.data shuffle buffer, but
+   deterministic;
+2. the windowed sequence is chopped into batches of ``batch_size``
+   (each batch therefore references ONE shard — the property that lets
+   whole shards be the assignment/failure unit);
+3. the global batch list is shuffled with rng(seed, epoch), which
+   interleaves shards into the global order.
+
+Shard→worker placement is rendezvous hashing (highest-random-weight):
+each shard goes to the live worker maximizing md5(uri, worker_id).
+Removing a worker moves ONLY that worker's shards (spread over the
+survivors); adding one steals ~1/n of every survivor's shards — the
+minimal-disruption property the elastic test pins down.
+"""
+
+import hashlib
+import random
+
+__all__ = ["Batch", "EpochPlan", "build_epoch_plan", "assign_shards",
+           "rng_for"]
+
+
+def rng_for(*key):
+    """A ``random.Random`` seeded from the md5 of the key tuple —
+    process- and PYTHONHASHSEED-independent."""
+    digest = hashlib.md5(
+        "\x1f".join(str(k) for k in key).encode("utf-8")).digest()
+    return random.Random(int.from_bytes(digest[:8], "little"))
+
+
+class Batch:
+    """One planned batch: ``index`` in the global order, the ``uri`` of
+    the single shard its records live in, and the record indices (in
+    serve order) within that shard."""
+
+    __slots__ = ("index", "uri", "records", "window")
+
+    def __init__(self, index, uri, records, window):
+        self.index = index
+        self.uri = uri
+        self.records = records
+        self.window = window        # source window ordinal within the shard
+
+    def __repr__(self):
+        return "Batch(%d, %r, %d recs, w%d)" % (
+            self.index, self.uri, len(self.records), self.window)
+
+
+class EpochPlan:
+    """The deterministic batch schedule of one epoch."""
+
+    def __init__(self, batches, seed, epoch, batch_size, window):
+        self.batches = batches
+        self.seed = seed
+        self.epoch = epoch
+        self.batch_size = batch_size
+        self.window = window
+
+    def __len__(self):
+        return len(self.batches)
+
+    def global_order(self):
+        """Flat [(uri, record_index), ...] — the epoch's global sample
+        order; the determinism tests compare this across worker counts."""
+        return [(b.uri, r) for b in self.batches for r in b.records]
+
+    def num_records(self):
+        return sum(len(b.records) for b in self.batches)
+
+
+def _canonical_shards(shards):
+    """[(uri, n_records), ...] sorted by uri; accepts dicts or pairs."""
+    pairs = []
+    for s in shards:
+        if isinstance(s, dict):
+            pairs.append((str(s["uri"]), int(s["records"])))
+        else:
+            pairs.append((str(s[0]), int(s[1])))
+    pairs.sort()
+    return pairs
+
+
+def build_epoch_plan(shards, seed, epoch, batch_size, window=1024,
+                     drop_last=False):
+    """Build the epoch's global batch schedule (see module docstring).
+
+    shards : iterable of (uri, n_records) pairs or {"uri", "records"}
+        dicts.  Order does not matter — the plan canonicalizes by uri.
+    drop_last : drop each SHARD's trailing partial batch (keeps every
+        batch full-size at the cost of <batch_size records per shard).
+    """
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive, got %d" % batch_size)
+    window = int(window)
+    batches = []
+    for uri, n in _canonical_shards(shards):
+        ids = list(range(n))
+        if window <= 0 or window >= n:
+            windows = [ids] if ids else []
+        else:
+            windows = [ids[i:i + window] for i in range(0, n, window)]
+        for wi, w in enumerate(windows):
+            rng_for(seed, epoch, uri, wi, "in-window").shuffle(w)
+        order = list(range(len(windows)))
+        rng_for(seed, epoch, uri, "window-order").shuffle(order)
+        for wi in order:
+            w = windows[wi]
+            for i in range(0, len(w), batch_size):
+                chunk = w[i:i + batch_size]
+                if drop_last and len(chunk) < batch_size:
+                    continue
+                batches.append((uri, tuple(chunk), wi))
+    rng_for(seed, epoch, "global").shuffle(batches)
+    planned = [Batch(i, uri, recs, wi)
+               for i, (uri, recs, wi) in enumerate(batches)]
+    return EpochPlan(planned, seed, epoch, batch_size, window)
+
+
+def assign_shards(uris, worker_ids):
+    """Rendezvous-hash shard placement: {uri: worker_id}.
+
+    Deterministic in (uris, worker_ids); removing one worker reassigns
+    exactly its own shards, adding one steals ~1/n of each survivor's.
+    Empty worker set returns {} (nothing is placeable).
+    """
+    workers = sorted(set(worker_ids))
+    if not workers:
+        return {}
+    out = {}
+    for uri in uris:
+        out[uri] = max(
+            workers,
+            key=lambda w: hashlib.md5(
+                ("%s\x1f%s" % (uri, w)).encode("utf-8")).digest())
+    return out

@@ -1,0 +1,93 @@
+"""Sample ⇄ bytes codec for stream shards — `serving/wire.py` framing,
+no pickling.
+
+A *sample* is a dict of named numpy arrays ({"data": ..., "label": ...}).
+On disk each RecordIO record holds one sample encoded as:
+
+    b"MXS1" | u32 manifest_len | manifest JSON | raw array payload
+
+which is exactly the serving plane's ``pack_arrays`` manifest+payload
+pair with a magic/length prefix so a record is self-describing.  The
+decode path inherits wire.py's dtype allowlist ("biuf" kinds only) and
+size validation, so a data worker never unpickles attacker-controlled
+bytes and a truncated payload raises instead of mis-slicing.
+
+``write_shard`` / ``read_sample`` are the only places the stream plane
+touches RecordIO framing; corrupt regions inside a shard surface through
+``recordio.CorruptRecordError`` (PR 4's resync/quarantine machinery) and
+are handled by the worker, not here.
+"""
+
+import json
+import struct
+
+import numpy as np
+
+_MAGIC = b"MXS1"
+_HDR = struct.Struct("<I")
+
+__all__ = ["encode_sample", "decode_sample", "write_shard", "shard_info"]
+
+
+def _wire():
+    # lazy: serving/__init__ pulls in the model loader stack, which this
+    # package must not drag into every importer of io.stream
+    from ...serving import wire
+    return wire
+
+
+def encode_sample(arrays):
+    """dict[str, ndarray] -> bytes (one RecordIO record body)."""
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    # wire's ascontiguousarray promotes 0-d to (1,); remember which
+    # names were scalars so decode restores their true shape
+    scalars = sorted(k for k, v in arrays.items() if v.ndim == 0)
+    manifest, payload = _wire().pack_arrays(arrays)
+    mbytes = json.dumps({"arrays": manifest, "scalars": scalars},
+                        sort_keys=True).encode("utf-8")
+    return b"".join([_MAGIC, _HDR.pack(len(mbytes)), mbytes, payload])
+
+
+def decode_sample(buf):
+    """bytes -> dict[str, ndarray]; raises ValueError on bad framing."""
+    buf = bytes(buf)
+    if len(buf) < len(_MAGIC) + _HDR.size or not buf.startswith(_MAGIC):
+        raise ValueError("not a stream sample record (bad magic)")
+    (mlen,) = _HDR.unpack_from(buf, len(_MAGIC))
+    moff = len(_MAGIC) + _HDR.size
+    if moff + mlen > len(buf):
+        raise ValueError("stream sample manifest truncated")
+    wrapper = json.loads(buf[moff:moff + mlen].decode("utf-8"))
+    if not isinstance(wrapper, dict) or "arrays" not in wrapper:
+        raise ValueError("stream sample manifest malformed")
+    out = _wire().unpack_arrays(wrapper["arrays"], buf[moff + mlen:])
+    for name in wrapper.get("scalars", ()):
+        if name in out and out[name].size == 1:
+            out[name] = out[name].reshape(())
+    return out
+
+
+def write_shard(uri, samples):
+    """Write an indexed RecordIO shard (and its .idx sidecar) from an
+    iterable of sample dicts. Returns the record count."""
+    from ... import recordio
+    writer = recordio.MXIndexedRecordIO(uri + ".idx", uri, "w")
+    n = 0
+    try:
+        for sample in samples:
+            writer.write_idx(n, encode_sample(sample))
+            n += 1
+    finally:
+        writer.close()
+    return n
+
+
+def shard_info(uri):
+    """(uri, n_records) for a shard, via the .idx sidecar (building it
+    from the data file if missing) — what the registry registers."""
+    from ... import recordio
+    reader = recordio.MXIndexedRecordIO(uri + ".idx", uri, "r")
+    try:
+        return uri, len(reader.keys)
+    finally:
+        reader.close()

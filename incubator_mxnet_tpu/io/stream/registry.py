@@ -1,0 +1,281 @@
+"""Shard registry + stream coordinator service.
+
+The coordinator is the data plane's control point (tf.data service's
+dispatcher): it owns the dataset spec (shard list + shuffle parameters),
+tracks live data workers by heartbeat, and publishes a *versioned*
+shard→worker assignment computed by rendezvous hashing.  It is
+deliberately OFF the data path — batches flow client↔worker; the
+coordinator only answers small JSON control calls, so its loss degrades
+(clients keep their last assignment) rather than stalls.
+
+Failure semantics the tests pin down:
+
+* a worker that misses heartbeats for ``MXTPU_STREAM_DEAD_TIMEOUT``
+  seconds (or is reported failed by a client) is evicted ONCE: the
+  version bumps once and exactly its shards move (rendezvous property),
+  counted in ``stream_shard_reassignments``;
+* a shard whose decode hits ``CorruptRecordError`` is quarantined ONCE
+  (idempotent), removed from the assignment, counted per-uri in
+  ``stream_quarantined_shards`` — clients skip its remaining batches so
+  the epoch completes degraded instead of hanging.
+"""
+
+import os
+import threading
+import time
+
+from ...kvstore import rpc as _rpc
+from ...telemetry import catalog as _cat
+from ...telemetry import debugz as _dbz
+from ...telemetry import export as _texport
+from ...telemetry import flight as _fl
+from ...telemetry import metrics as _met
+from . import plan as _plan
+
+__all__ = ["ShardRegistry", "StreamCoordinator"]
+
+
+class ShardRegistry:
+    """Versioned shard→worker assignment state machine (thread-safe).
+
+    Pure bookkeeping — no sockets — so the elasticity tests drive it
+    directly: register/heartbeat/evict/quarantine each bump ``version``
+    exactly once per actual change, and ``assignment()`` is always the
+    rendezvous placement over the CURRENT live worker set.
+    """
+
+    def __init__(self, dead_timeout=None):
+        self._lock = threading.Lock()
+        self._shards = {}          # uri -> record count
+        self._workers = {}         # wid -> {"addr": (h, p), "seen": mono}
+        self._quarantined = {}     # uri -> reason
+        self._version = 0
+        self._next_wid = 0
+        self._reassigned_total = 0
+        self.dead_timeout = float(
+            dead_timeout if dead_timeout is not None
+            else os.environ.get("MXTPU_STREAM_DEAD_TIMEOUT", "10"))
+
+    # ------------------------------------------------------------- shards
+    def add_shards(self, shards):
+        with self._lock:
+            for s in shards:
+                uri, n = (s["uri"], s["records"]) if isinstance(s, dict) \
+                    else (s[0], s[1])
+                self._shards[str(uri)] = int(n)
+            self._version += 1
+            self._update_gauges_locked()
+
+    def quarantine(self, uri, reason=""):
+        """Idempotently quarantine a shard; True only on the first call."""
+        uri = str(uri)
+        with self._lock:
+            if uri in self._quarantined or uri not in self._shards:
+                return False
+            self._quarantined[uri] = str(reason)
+            self._version += 1
+            self._update_gauges_locked()
+        _cat.stream_quarantined_shards.inc(uri=uri)
+        _fl.record("stream.quarantine", uri=uri, reason=str(reason)[:120])
+        return True
+
+    # ------------------------------------------------------------ workers
+    def register_worker(self, addr, wid=None):
+        """Register (or re-register) a data worker; returns (wid, version).
+
+        A re-registration with the same wid refreshes addr/heartbeat
+        without a version bump unless the worker was previously evicted.
+        """
+        addr = (str(addr[0]), int(addr[1]))
+        now = time.monotonic()
+        with self._lock:
+            before = self._owners_locked()
+            if wid is None:
+                wid = "w%d" % self._next_wid
+                self._next_wid += 1
+            wid = str(wid)
+            known = wid in self._workers
+            self._workers[wid] = {"addr": addr, "seen": now}
+            if not known:
+                self._version += 1
+                self._count_moves_locked(before)
+            self._update_gauges_locked()
+            return wid, self._version
+
+    def heartbeat(self, wid):
+        """True if the worker is (still) registered."""
+        with self._lock:
+            ent = self._workers.get(str(wid))
+            if ent is None:
+                return False
+            ent["seen"] = time.monotonic()
+            return True
+
+    def remove_worker(self, wid, reason="evicted"):
+        """Evict a worker (idempotent); True only when it was present."""
+        with self._lock:
+            removed = self._remove_worker_locked(str(wid))
+        if removed:
+            _fl.record("stream.worker_evicted", wid=str(wid), reason=reason)
+        return removed
+
+    def evict_dead(self):
+        """Drop workers whose last heartbeat is older than dead_timeout;
+        returns the evicted wids. Called lazily from every control op so
+        no dedicated ticker thread is needed."""
+        cutoff = time.monotonic() - self.dead_timeout
+        with self._lock:
+            dead = [w for w, e in self._workers.items() if e["seen"] < cutoff]
+            for w in dead:
+                self._remove_worker_locked(w)
+        for w in dead:
+            _fl.record("stream.worker_evicted", wid=w, reason="heartbeat")
+        return dead
+
+    def _remove_worker_locked(self, wid):
+        if wid not in self._workers:
+            return False
+        before = self._owners_locked()
+        del self._workers[wid]
+        self._version += 1
+        self._count_moves_locked(before)
+        self._update_gauges_locked()
+        return True
+
+    # ------------------------------------------------------------- views
+    def _active_uris_locked(self):
+        return [u for u in self._shards if u not in self._quarantined]
+
+    def _owners_locked(self):
+        return _plan.assign_shards(self._active_uris_locked(),
+                                   list(self._workers))
+
+    def _count_moves_locked(self, before):
+        after = self._owners_locked()
+        moved = sum(1 for u, w in after.items() if before.get(u) != w)
+        if moved:
+            self._reassigned_total += moved
+            _cat.stream_shard_reassignments.inc(moved)
+
+    def _update_gauges_locked(self):
+        _cat.stream_workers.set(len(self._workers))
+        _cat.stream_shards.set(len(self._shards) - len(self._quarantined))
+
+    def assignment(self):
+        """{"version", "owners": {uri: wid}, "workers": {wid: [h, p]},
+        "quarantined": [uri, ...]} — everything a client needs to route
+        fetches."""
+        with self._lock:
+            return {
+                "version": self._version,
+                "owners": self._owners_locked(),
+                "workers": {w: list(e["addr"])
+                            for w, e in self._workers.items()},
+                "quarantined": sorted(self._quarantined),
+            }
+
+    def shards(self):
+        with self._lock:
+            return sorted(self._shards.items())
+
+    def stats(self):
+        with self._lock:
+            return {
+                "version": self._version,
+                "workers": len(self._workers),
+                "shards": len(self._shards),
+                "quarantined": sorted(self._quarantined),
+                "reassigned_total": self._reassigned_total,
+            }
+
+
+class StreamCoordinator:
+    """RPC front for a ShardRegistry + the dataset spec.
+
+    Ops (all JSON meta, empty payload unless noted): ``stream.ping``,
+    ``stream.config``, ``stream.register``, ``stream.heartbeat``,
+    ``stream.assignment``, ``stream.report_failure``,
+    ``stream.quarantine``, ``stream.stats``, ``stream.members``,
+    ``stream.metrics`` (payload = registry JSON, for the aggregate
+    plane).
+    """
+
+    def __init__(self, shards, seed=None, batch_size=None, window=None,
+                 drop_last=False, host="127.0.0.1", port=0,
+                 dead_timeout=None, telemetry=True):
+        if telemetry:
+            _met.enable()
+        self.registry = ShardRegistry(dead_timeout=dead_timeout)
+        self.registry.add_shards(shards)
+        self.seed = int(seed if seed is not None
+                        else os.environ.get("MXTPU_STREAM_SEED", "0"))
+        self.batch_size = int(
+            batch_size if batch_size is not None
+            else os.environ.get("MXTPU_STREAM_BATCH", "32"))
+        self.window = int(window if window is not None
+                          else os.environ.get("MXTPU_STREAM_WINDOW", "1024"))
+        self.drop_last = bool(drop_last)
+        self._rpc = _rpc.Server(self._handle, host=host, port=port)
+        self.addr = self._rpc.addr
+
+    def start(self):
+        self._rpc.start()
+        _fl.set_identity("stream-coord", 0)
+        if _dbz.start_from_env(role="stream-coord") is not None:
+            _dbz.set_status("stream_addr", "%s:%s" % self.addr)
+            _dbz.set_status("stream", self.registry.stats)
+        return self
+
+    def stop(self):
+        self._rpc.stop()
+
+    def config(self):
+        return {
+            "seed": self.seed,
+            "batch_size": self.batch_size,
+            "window": self.window,
+            "drop_last": self.drop_last,
+            "shards": [[u, n] for u, n in self.registry.shards()],
+        }
+
+    def _handle(self, meta, payload):
+        op = meta.get("op", "")
+        reg = self.registry
+        reg.evict_dead()
+        if op == "stream.ping":
+            st = reg.stats()
+            st["ok"] = True
+            st["addr"] = list(self.addr)
+            return st, b""
+        if op == "stream.config":
+            return self.config(), b""
+        if op == "stream.register":
+            wid, version = reg.register_worker(
+                meta["addr"], wid=meta.get("wid"))
+            return {"wid": wid, "version": version}, b""
+        if op == "stream.heartbeat":
+            return {"ok": reg.heartbeat(meta.get("wid", ""))}, b""
+        if op == "stream.assignment":
+            return reg.assignment(), b""
+        if op == "stream.report_failure":
+            removed = reg.remove_worker(meta.get("wid", ""),
+                                        reason="client-report")
+            out = reg.assignment()
+            out["removed"] = removed
+            return out, b""
+        if op == "stream.quarantine":
+            fresh = reg.quarantine(meta.get("uri", ""),
+                                   meta.get("reason", ""))
+            out = reg.assignment()
+            out["fresh"] = fresh
+            return out, b""
+        if op == "stream.stats":
+            return {"stats": reg.stats(), "config": self.config()}, b""
+        if op == "stream.members":
+            asn = reg.assignment()
+            return {"coordinator": list(self.addr),
+                    "workers": asn["workers"],
+                    "version": asn["version"]}, b""
+        if op == "stream.metrics":
+            return {"format": "json"}, _texport.render_json().encode("utf-8")
+        raise ValueError("unknown stream op %r" % op)

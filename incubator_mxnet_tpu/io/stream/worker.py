@@ -1,0 +1,273 @@
+"""Data worker: decodes shard records and serves planned batches.
+
+A worker is a tiny RPC service (``stream.get_batch``) plus a heartbeat
+loop against the coordinator.  It holds NO plan authority: given
+(epoch, batch index) it rebuilds the same deterministic ``EpochPlan``
+every client builds from ``stream.config`` — so any worker can serve
+any batch, and reassignment after a worker death needs no state
+transfer, only re-routing (the registry's rendezvous remap).
+
+Decode results are kept in a per-record LRU sized by
+``MXTPU_STREAM_CACHE_RECORDS``; because the plan shuffles records only
+WITHIN windows before batching, consecutive batches of a window hit the
+same cache lines — the gauge ``stream_window_records`` reports that
+occupancy.
+
+Corruption: after every shard read the worker checks the reader's
+PR 4 resync counters.  Any quarantined region (or an undecodable /
+missing record, or ``CorruptRecordError``) marks the WHOLE shard
+corrupt: the worker reports ``stream.quarantine`` to the coordinator
+and replies ``{"quarantined": uri}`` so the client skips the shard's
+remaining batches instead of hanging the epoch — a resync-substituted
+record must never silently stand in for the planned sample.
+"""
+
+import os
+import threading
+from collections import OrderedDict
+
+from ...kvstore import rpc as _rpc
+from ...telemetry import catalog as _cat
+from ...telemetry import debugz as _dbz
+from ...telemetry import export as _texport
+from ...telemetry import flight as _fl
+from ...telemetry import metrics as _met
+from . import pack as _pack
+from . import plan as _plan
+from . import records as _records
+
+__all__ = ["DataWorker"]
+
+
+class _ShardCorrupt(Exception):
+    """Internal: shard-level corruption detected while serving a batch."""
+
+    def __init__(self, uri, reason):
+        super().__init__("%s: %s" % (uri, reason))
+        self.uri = uri
+        self.reason = reason
+
+
+class DataWorker:
+    def __init__(self, coordinator, host="127.0.0.1", port=0, varlen=(),
+                 pack_key=None, pad_value=0, min_bucket=None,
+                 cache_records=None, heartbeat_interval=None,
+                 telemetry=True):
+        if telemetry:
+            _met.enable()
+        self._coord_addr = (str(coordinator[0]), int(coordinator[1]))
+        self.varlen = tuple(varlen)
+        self.pack_key = pack_key
+        self.pad_value = pad_value
+        self.min_bucket = int(
+            min_bucket if min_bucket is not None
+            else os.environ.get("MXTPU_STREAM_BUCKET_MIN", "16"))
+        self._cache_cap = int(
+            cache_records if cache_records is not None
+            else os.environ.get("MXTPU_STREAM_CACHE_RECORDS", "4096"))
+        self._hb_interval = float(
+            heartbeat_interval if heartbeat_interval is not None
+            else os.environ.get("MXTPU_STREAM_HEARTBEAT_INTERVAL", "2"))
+        self._lock = threading.Lock()   # guards plans/readers/cache/corrupt
+        self._config = None
+        self._plans = OrderedDict()     # epoch -> EpochPlan (keep last 2)
+        self._readers = {}              # uri -> MXIndexedRecordIO
+        self._cache = OrderedDict()     # (uri, rec) -> sample dict (LRU)
+        self._corrupt = set()           # uris this worker already reported
+        self._stop_evt = threading.Event()
+        self._hb_thread = None
+        self._coord = _rpc.Connection(self._coord_addr, timeout=30.0)
+        self._rpc = _rpc.Server(self._handle, host=host, port=port)
+        self.addr = self._rpc.addr
+        self.wid = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        meta, _ = self._coord.call({"op": "stream.config"})
+        if meta.get("error"):
+            raise RuntimeError("stream.config failed: %s" % meta["error"])
+        self._config = meta
+        self._rpc.start()
+        meta, _ = self._coord.call({"op": "stream.register",
+                                    "addr": list(self.addr)})
+        if meta.get("error"):
+            raise RuntimeError("stream.register failed: %s" % meta["error"])
+        self.wid = meta["wid"]
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, name="stream-worker-hb", daemon=True)
+        self._hb_thread.start()
+        _fl.set_identity("stream-worker", self.wid)
+        if _dbz.start_from_env(role="stream-worker") is not None:
+            _dbz.set_status("stream_worker", "%s:%s" % self.addr)
+            _dbz.set_status("stream_wid", self.wid)
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        self._rpc.stop()
+        self._coord.close()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+        with self._lock:
+            readers = list(self._readers.values())
+            self._readers = {}
+            self._cache = OrderedDict()
+        for r in readers:
+            r.close()
+
+    def _hb_loop(self):
+        # dedicated connection: the control conn is used by request
+        # handler threads for quarantine reports
+        conn = _rpc.Connection(self._coord_addr, timeout=10.0)
+        try:
+            while not self._stop_evt.wait(self._hb_interval):
+                try:
+                    meta, _ = conn.call({"op": "stream.heartbeat",
+                                         "wid": self.wid})
+                    if meta.get("ok") is False:
+                        # evicted (e.g. after a partition): rejoin under
+                        # the same wid so assignment converges back
+                        conn.call({"op": "stream.register",
+                                   "addr": list(self.addr),
+                                   "wid": self.wid})
+                except (OSError, _rpc.ProtocolError):
+                    continue    # coordinator away; retry next tick
+        finally:
+            conn.close()
+
+    # ------------------------------------------------------------ serving
+    def _plan_for(self, epoch):
+        cfg = self._config
+        with self._lock:
+            p = self._plans.get(epoch)
+            if p is None:
+                p = _plan.build_epoch_plan(
+                    cfg["shards"], cfg["seed"], epoch, cfg["batch_size"],
+                    window=cfg["window"], drop_last=cfg["drop_last"])
+                self._plans[epoch] = p
+                while len(self._plans) > 2:
+                    self._plans.popitem(last=False)
+            return p
+
+    def _reader_locked(self, uri):
+        r = self._readers.get(uri)
+        if r is None:
+            from ... import recordio
+            r = recordio.MXIndexedRecordIO(uri + ".idx", uri, "r")
+            self._readers[uri] = r
+        return r
+
+    def _sample_locked(self, uri, rec):
+        key = (uri, rec)
+        s = self._cache.get(key)
+        if s is not None:
+            self._cache.move_to_end(key)
+            return s
+        r = self._reader_locked(uri)
+        skips_before = r.corrupt_skips
+        from ... import recordio
+        try:
+            buf = r.read_idx(rec)
+        except recordio.CorruptRecordError as e:
+            raise _ShardCorrupt(uri, "corrupt region at byte %d" % e.offset)
+        if r.corrupt_skips != skips_before:
+            # resync quarantined a region mid-read: whatever came back is
+            # NOT record `rec` — the shard can no longer serve its plan
+            raise _ShardCorrupt(uri, "resync during record %d" % rec)
+        if buf is None:
+            raise _ShardCorrupt(uri, "record %d missing (truncated)" % rec)
+        try:
+            s = _records.decode_sample(buf)
+        except ValueError as e:
+            raise _ShardCorrupt(uri, "record %d undecodable: %s" % (rec, e))
+        self._cache[key] = s
+        while len(self._cache) > self._cache_cap:
+            self._cache.popitem(last=False)
+        _cat.stream_window_records.set(len(self._cache))
+        return s
+
+    def _quarantine(self, uri, reason):
+        """Report shard corruption to the coordinator (once per uri)."""
+        with self._lock:
+            fresh = uri not in self._corrupt
+            self._corrupt.add(uri)
+            reader = self._readers.pop(uri, None)
+            for key in [k for k in self._cache if k[0] == uri]:
+                del self._cache[key]
+        if reader is not None:
+            reader.close()
+        if fresh:
+            try:
+                self._coord.call_idempotent(
+                    {"op": "stream.quarantine", "uri": uri,
+                     "reason": reason})
+            except (OSError, _rpc.ProtocolError):
+                _fl.record("stream.quarantine_report_failed", uri=uri)
+
+    def _get_batch(self, meta):
+        epoch = int(meta.get("epoch", 0))
+        index = int(meta.get("index", -1))
+        p = self._plan_for(epoch)
+        if not 0 <= index < len(p.batches):
+            raise ValueError("batch index %d out of range (epoch has %d)"
+                             % (index, len(p.batches)))
+        b = p.batches[index]
+        with self._lock:
+            if b.uri in self._corrupt:
+                return {"quarantined": b.uri}, b""
+        try:
+            with self._lock:
+                samples = [self._sample_locked(b.uri, r) for r in b.records]
+        except _ShardCorrupt as e:
+            self._quarantine(e.uri, e.reason)
+            return {"quarantined": e.uri, "reason": e.reason}, b""
+        if self.pack_key is not None:
+            batch = self._pack_batch(samples)
+        else:
+            batch = _pack.collate(samples, varlen=self.varlen,
+                                  pad_value=self.pad_value,
+                                  min_bucket=self.min_bucket)
+        from ...serving import wire
+        manifest, payload = wire.pack_arrays(batch)
+        _cat.stream_batches_served.inc()
+        _cat.stream_records_served.inc(len(samples))
+        return {"ok": True, "arrays": manifest, "epoch": epoch,
+                "index": index, "uri": b.uri}, payload
+
+    def _pack_batch(self, samples):
+        """Sequence-packing collation: the ``pack_key`` array is packed
+        into pow2-bucket rows; every other array is stacked per-sequence
+        (order preserved) with ``<key>_rows`` mapping sequence -> (row,
+        start) so labels can follow their tokens."""
+        import numpy as np
+        key = self.pack_key
+        seqs = [np.asarray(s[key]) for s in samples]
+        bucket = _pack.pow2_bucket(
+            max((int(a.shape[0]) for a in seqs), default=0),
+            self.min_bucket)
+        tokens, segments, positions, row_of = _pack.pack_sequences(
+            seqs, bucket, pad_value=self.pad_value)
+        out = {key: tokens, key + "_segments": segments,
+               key + "_positions": positions,
+               key + "_rows": np.asarray(row_of, dtype=np.int32)}
+        for name in sorted(samples[0].keys()):
+            if name != key:
+                out[name] = np.stack([np.asarray(s[name]) for s in samples])
+        return out
+
+    def _handle(self, meta, payload):
+        op = meta.get("op", "")
+        if op == "stream.get_batch":
+            return self._get_batch(meta)
+        if op == "stream.ping":
+            return {"ok": True, "wid": self.wid, "addr": list(self.addr)}, b""
+        if op == "stream.stats":
+            with self._lock:
+                cached = len(self._cache)
+                corrupt = sorted(self._corrupt)
+            return {"wid": self.wid, "cached_records": cached,
+                    "corrupt": corrupt,
+                    "batches_served": _cat.stream_batches_served.value()}, b""
+        if op == "stream.metrics":
+            return {"format": "json"}, _texport.render_json().encode("utf-8")
+        raise ValueError("unknown stream worker op %r" % op)

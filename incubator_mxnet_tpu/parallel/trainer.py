@@ -746,6 +746,35 @@ class ShardedTrainer:
         labels = [_gput(l, self._label_sharding) for l in labels]
         return datas, labels
 
+    def place_batch(self, data, label):
+        """Device-place one (data, label) batch exactly as ``step``
+        would — public so prefetch threads (StreamLoader / pin_memory)
+        can pay the host→device transfer ahead of the step; ``step``
+        then re-places already-resident arrays for free."""
+        datas, labels = self._prep_batch(data, label)
+        return (datas[0] if len(datas) == 1 else datas,
+                labels[0] if len(labels) == 1 else labels)
+
+    def stream_loader(self, coordinator=None, data_keys=("data",),
+                      label_keys=("label",), epochs=1, start_epoch=0,
+                      depth=None, retry_window=None, client=None):
+        """A stream-plane loader feeding this trainer: yields device-
+        placed ``(data, label)`` pairs whose transfer (sharded
+        device_put) ran on the prefetch thread, overlapping the
+        in-flight step. ``data_keys``/``label_keys`` pick arrays out of
+        each batch dict in ``step``'s argument order."""
+        from ..io.stream.loader import StreamLoader
+
+        def _transfer(batch):
+            data = [batch[k] for k in data_keys]
+            label = [batch[k] for k in label_keys]
+            return self.place_batch(data, label)
+
+        return StreamLoader(coordinator=coordinator, client=client,
+                            epochs=epochs, start_epoch=start_epoch,
+                            depth=depth, transfer=_transfer,
+                            retry_window=retry_window)
+
     def step(self, data, label, key=None):
         """Run one sharded train step; returns the (device) scalar loss."""
         t0 = time.perf_counter() if _met.enabled() else None

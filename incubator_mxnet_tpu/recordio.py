@@ -178,8 +178,11 @@ class MXRecordIO:
                     self.corrupt_skips += 1
                     self.corrupt_bytes += cand - corrupt_pos
                     from .telemetry import catalog as _cat
-                    _cat.recordio_resyncs.inc()
-                    _cat.recordio_quarantined_bytes.inc(cand - corrupt_pos)
+                    # uri-labeled so mxtop/aggregate can attribute
+                    # corruption to the specific shard
+                    _cat.recordio_resyncs.inc(uri=self.uri)
+                    _cat.recordio_quarantined_bytes.inc(
+                        cand - corrupt_pos, uri=self.uri)
                     return
                 at += 1
             # overlap by 8 so a magic straddling the chunk edge matches

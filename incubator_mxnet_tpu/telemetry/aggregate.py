@@ -47,6 +47,10 @@ def fetch_member(addr, role="server", timeout=5.0):
         meta, payload = request(tuple(addr), {"op": "serve.metrics",
                                               "format": "json"},
                                 timeout=timeout)
+    elif role.startswith("stream"):
+        meta, payload = request(tuple(addr), {"op": "stream.metrics",
+                                              "format": "json"},
+                                timeout=timeout)
     else:
         meta, payload = request(tuple(addr), {"op": "command",
                                               "command": "telemetry"},
@@ -77,7 +81,7 @@ def merge(snapshots):
     return merged
 
 
-def scrape(scheduler=None, serving=None, timeout=5.0):
+def scrape(scheduler=None, serving=None, stream=None, timeout=5.0):
     """Scrape the whole fleet reachable from one scheduler.
 
     Returns ``{"epoch", "quorum", "members": [...], "registry": ...}``
@@ -86,15 +90,25 @@ def scrape(scheduler=None, serving=None, timeout=5.0):
     role/rank-labeled registry of every member that answered.
 
     ``serving`` is an optional list of ``host:port`` model-server
-    addresses (they are not part of PS membership).
+    addresses (they are not part of PS membership). ``stream`` is an
+    optional stream-coordinator ``host:port`` (or ``MXTPU_STREAM_ADDR``
+    style spec); the coordinator's live data workers are discovered via
+    ``stream.members`` and scraped as ``stream-worker`` members.
     """
     from ..kvstore.rpc import request
     sched = _addr(scheduler)
-    meta, _ = request(sched, {"op": "membership"}, timeout=timeout)
-    if meta.get("error"):
-        raise RuntimeError("membership query to %s:%s failed: %s"
-                           % (sched[0], sched[1], meta["error"]))
-    targets = [("scheduler", 0, sched)]
+    try:
+        meta, _ = request(sched, {"op": "membership"}, timeout=timeout)
+        if meta.get("error"):
+            raise RuntimeError("membership query to %s:%s failed: %s"
+                               % (sched[0], sched[1], meta["error"]))
+    except (OSError, RuntimeError):
+        # serving/stream processes live outside PS membership: a scrape
+        # pointed only at them must not require a scheduler
+        if not (serving or stream is not None):
+            raise
+        meta = {}
+    targets = [("scheduler", 0, sched)] if meta else []
     for rank, addr in sorted((int(r), a) for r, a in
                              (meta.get("servers") or {}).items()):
         targets.append(("server", rank, tuple(addr)))
@@ -104,6 +118,16 @@ def scrape(scheduler=None, serving=None, timeout=5.0):
             targets.append(("worker", rank, tuple(addr)))
     for i, spec in enumerate(serving or []):
         targets.append(("serving", i, _addr(spec)))
+    if stream is not None:
+        coord = _addr(stream)
+        targets.append(("stream-coord", 0, coord))
+        try:
+            mmeta, _ = request(coord, {"op": "stream.members"},
+                               timeout=timeout)
+            for wid, addr in sorted((mmeta.get("workers") or {}).items()):
+                targets.append(("stream-worker", wid, tuple(addr)))
+        except (OSError, RuntimeError, ValueError):
+            pass    # coordinator down: its own entry will report the error
 
     members, snaps = [], []
     for role, rank, addr in targets:

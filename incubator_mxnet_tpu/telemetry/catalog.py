@@ -141,10 +141,51 @@ watchdog_fires = _m.counter(
     "mxtpu_watchdog_fires_total", "Watchdog deadline expiries by phase")
 recordio_resyncs = _m.counter(
     "mxtpu_recordio_resyncs_total",
-    "Corrupt-region skips where the reader resynced to the next magic")
+    "Corrupt-region skips where the reader resynced to the next magic, "
+    "by shard uri")
 recordio_quarantined_bytes = _m.counter(
     "mxtpu_recordio_quarantined_bytes_total",
-    "Bytes skipped over while resyncing past corrupt RecordIO regions")
+    "Bytes skipped over while resyncing past corrupt RecordIO regions, "
+    "by shard uri")
+
+
+# -- streaming data plane (io/stream/) -------------------------------
+stream_batches_served = _m.counter(
+    "mxtpu_stream_batches_served_total",
+    "Batches a data worker decoded, collated and shipped")
+stream_records_served = _m.counter(
+    "mxtpu_stream_records_served_total",
+    "Records inside the batches a data worker shipped")
+stream_batches_fetched = _m.counter(
+    "mxtpu_stream_batches_fetched_total",
+    "Batches a stream client received (trainer side)")
+stream_fetch_retries = _m.counter(
+    "mxtpu_stream_fetch_retries_total",
+    "Client fetch attempts re-routed after a worker failure or a stale "
+    "assignment")
+stream_shard_reassignments = _m.counter(
+    "mxtpu_stream_shard_reassignments_total",
+    "Shards whose rendezvous owner changed on a registry version bump "
+    "(worker join/eviction/quarantine)")
+stream_quarantined_shards = _m.counter(
+    "mxtpu_stream_quarantined_shards_total",
+    "Shards the registry quarantined after corruption reports, by uri")
+stream_workers = _m.gauge(
+    "mxtpu_stream_workers",
+    "Data workers currently registered with the stream coordinator")
+stream_shards = _m.gauge(
+    "mxtpu_stream_shards",
+    "Non-quarantined shards the stream coordinator is distributing")
+stream_window_records = _m.gauge(
+    "mxtpu_stream_window_records",
+    "Decoded records resident in a data worker's shuffle-window cache")
+stream_client_wait_seconds = _m.histogram(
+    "mxtpu_stream_client_wait_seconds",
+    "Stream client time-to-batch including failover retries (the remote "
+    "analogue of dataloader_batch_wait)")
+stream_prefetch_depth = _m.gauge(
+    "mxtpu_stream_prefetch_depth",
+    "Device batches currently parked in the DevicePrefetcher queue")
 
 
 # -- serving plane (serving/) ----------------------------------------

@@ -385,12 +385,15 @@ def test_resync_telemetry_counters(tmp_path, monkeypatch):
     _force_python_reader(monkeypatch)
     telemetry.enable()
     try:
-        base_r = cat.recordio_resyncs.value()
-        base_b = cat.recordio_quarantined_bytes.value()
+        # counters are uri-labeled (r9) so corruption attributes to the
+        # specific shard in mxtop/aggregate views
+        base_r = cat.recordio_resyncs.value(uri=rec_path)
+        base_b = cat.recordio_quarantined_bytes.value(uri=rec_path)
         r = MXRecordIO(rec_path, "r")
         _read_all(r)
         r.close()
-        assert cat.recordio_resyncs.value() - base_r == 1
-        assert cat.recordio_quarantined_bytes.value() - base_b == rec_size
+        assert cat.recordio_resyncs.value(uri=rec_path) - base_r == 1
+        assert (cat.recordio_quarantined_bytes.value(uri=rec_path)
+                - base_b) == rec_size
     finally:
         telemetry.disable()

@@ -1,0 +1,465 @@
+"""Streaming data plane (io/stream/, ISSUE 9): deterministic windowed
+global shuffle, rendezvous shard assignment, worker failover with
+no-drop/no-dup semantics, corrupt-shard quarantine, and the
+double-buffered device prefetcher's shutdown contract.
+
+The load-bearing invariant everything here pins: the global sample
+order of an epoch is a pure function of (shard set, seed, epoch,
+batch_size, window) — independent of worker count, ownership, and
+fetch timing — so elastic membership changes are sampling-neutral.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import recordio, telemetry
+from incubator_mxnet_tpu.io import stream
+from incubator_mxnet_tpu.io.stream import pack as spack
+from incubator_mxnet_tpu.io.stream import plan as splan
+from incubator_mxnet_tpu.io.stream import records as srec
+from incubator_mxnet_tpu.telemetry import catalog as cat
+
+
+def _write_shards(tmp_path, n_shards=3, per_shard=10, dim=4, tag="p"):
+    """Shards whose per-sample label IS the global record id, so a
+    fetched label sequence can be compared against the plan."""
+    shards = []
+    for s in range(n_shards):
+        uri = str(tmp_path / ("%s%d.rec" % (tag, s)))
+        srec.write_shard(
+            uri,
+            ({"data": np.full(dim, s * per_shard + i, np.float32),
+              "label": np.int64(s * per_shard + i)}
+             for i in range(per_shard)))
+        shards.append(srec.shard_info(uri))
+    return shards
+
+
+def _labels_of(shards, order):
+    """Map the plan's [(uri, rec), ...] to the labels _write_shards put
+    there (shards are sized equally, labels are globally sequential)."""
+    per_shard = shards[0][1]
+    base = {uri: i * per_shard for i, (uri, _) in enumerate(sorted(shards))}
+    return [base[uri] + rec for uri, rec in order]
+
+
+def _smash_record_magic(uri, rec_index):
+    """Corrupt the RecordIO framing of one record so a fresh read
+    triggers PR 4's resync machinery (not just a decode error)."""
+    r = recordio.MXIndexedRecordIO(uri + ".idx", uri, "r")
+    pos = r.idx[rec_index]
+    r.close()
+    with open(uri, "r+b") as f:
+        f.seek(pos)
+        f.write(b"\x00\x00\x00\x00")
+
+
+# ---------------------------------------------------------------- plan
+
+def test_plan_pure_function_of_spec():
+    shards = [("b.rec", 10), ("a.rec", 7), ("c.rec", 3)]
+    p1 = splan.build_epoch_plan(shards, seed=7, epoch=3, batch_size=4,
+                                window=4)
+    # input order is canonicalized away
+    p2 = splan.build_epoch_plan(list(reversed(shards)), seed=7, epoch=3,
+                                batch_size=4, window=4)
+    assert p1.global_order() == p2.global_order()
+    assert p1.num_records() == 20
+    # every record exactly once
+    assert sorted(p1.global_order()) == sorted(
+        (u, r) for u, n in shards for r in range(n))
+    # epoch and seed both perturb the order
+    assert p1.global_order() != splan.build_epoch_plan(
+        shards, seed=7, epoch=4, batch_size=4, window=4).global_order()
+    assert p1.global_order() != splan.build_epoch_plan(
+        shards, seed=8, epoch=3, batch_size=4, window=4).global_order()
+
+
+def test_plan_batches_respect_shard_and_window_bounds():
+    p = splan.build_epoch_plan([("a.rec", 10), ("b.rec", 6)], seed=1,
+                               epoch=0, batch_size=4, window=4)
+    for b in p.batches:
+        # single-shard batches (the assignment/failure unit)
+        assert len({b.uri}) == 1
+        lo, hi = b.window * 4, (b.window + 1) * 4
+        assert all(lo <= r < hi for r in b.records)
+    # drop_last drops only each shard's trailing partial batch
+    full = splan.build_epoch_plan([("a.rec", 10)], seed=1, epoch=0,
+                                  batch_size=4, window=0, drop_last=True)
+    assert all(len(b.records) == 4 for b in full.batches)
+    assert full.num_records() == 8
+
+
+def test_plan_rng_is_hashseed_independent():
+    # golden values: md5-derived streams must not vary with process or
+    # PYTHONHASHSEED (random.Random over int.from_bytes(md5[:8]))
+    r = splan.rng_for(7, 3, "global")
+    assert [r.randrange(1000) for _ in range(4)] == [106, 45, 53, 313]
+
+
+def test_assign_shards_rendezvous_minimal_remap():
+    uris = ["s%02d.rec" % i for i in range(20)]
+    before = splan.assign_shards(uris, ["w0", "w1", "w2"])
+    assert set(before.values()) == {"w0", "w1", "w2"}
+    # removing w1 moves exactly w1's shards
+    after = splan.assign_shards(uris, ["w0", "w2"])
+    moved = [u for u in uris if before[u] != after[u]]
+    assert sorted(moved) == sorted(u for u, w in before.items()
+                                   if w == "w1")
+    # adding w3 only ever moves shards TO w3
+    grown = splan.assign_shards(uris, ["w0", "w1", "w2", "w3"])
+    assert all(grown[u] == "w3" for u in uris if grown[u] != before[u])
+    assert splan.assign_shards(uris, []) == {}
+
+
+# ------------------------------------------------------------- records
+
+def test_records_roundtrip_preserves_dtypes_and_scalar_shapes():
+    sample = {"data": np.arange(6, dtype=np.float32).reshape(2, 3),
+              "label": np.int64(41),        # 0-d: wire would pad to (1,)
+              "mask": np.array([True, False]),
+              "w": np.float16(0.5)}
+    out = srec.decode_sample(srec.encode_sample(sample))
+    assert sorted(out) == sorted(sample)
+    for k, v in sample.items():
+        got = out[k]
+        assert got.shape == np.asarray(v).shape, k
+        assert got.dtype == np.asarray(v).dtype, k
+        np.testing.assert_array_equal(got, np.asarray(v))
+
+
+def test_records_decode_rejects_bad_framing():
+    buf = srec.encode_sample({"x": np.zeros(3, np.float32)})
+    with pytest.raises(ValueError):
+        srec.decode_sample(b"JUNK" + buf[4:])          # bad magic
+    with pytest.raises(ValueError):
+        srec.decode_sample(buf[:12])                   # truncated manifest
+    with pytest.raises(ValueError):
+        srec.decode_sample(buf[:-2])                   # truncated payload
+
+
+def test_write_shard_and_shard_info(tmp_path):
+    uri = str(tmp_path / "s.rec")
+    n = srec.write_shard(uri, ({"x": np.full(2, i, np.int32)}
+                               for i in range(7)))
+    assert n == 7
+    assert srec.shard_info(uri) == (uri, 7)
+
+
+# ---------------------------------------------------------------- pack
+
+def test_collate_pads_varlen_to_pow2_bucket():
+    samples = [{"tokens": np.arange(n, dtype=np.int32),
+                "label": np.int64(n)} for n in (3, 17, 9)]
+    out = spack.collate(samples, varlen=("tokens",), min_bucket=16)
+    assert out["tokens"].shape == (3, 32)              # pow2 over max 17
+    np.testing.assert_array_equal(out["tokens_len"], [3, 17, 9])
+    np.testing.assert_array_equal(out["tokens"][0, 3:], 0)
+    assert out["label"].shape == (3,)
+    # fixed-shape batches stay un-padded
+    fixed = spack.collate([{"x": np.zeros(4)}, {"x": np.ones(4)}])
+    assert fixed["x"].shape == (2, 4) and "x_len" not in fixed
+
+
+def test_pack_sequences_first_fit_segments_positions():
+    seqs = [np.arange(5), np.arange(3), np.arange(6), np.arange(2)]
+    tokens, segments, positions, row_of = spack.pack_sequences(seqs, 8)
+    # first-fit: [5,3] share row 0, [6,2] share row 1
+    assert row_of == [(0, 0), (0, 5), (1, 0), (1, 6)]
+    np.testing.assert_array_equal(segments[0], [1] * 5 + [2] * 3)
+    np.testing.assert_array_equal(positions[0], [0, 1, 2, 3, 4, 0, 1, 2])
+    np.testing.assert_array_equal(tokens[1], [0, 1, 2, 3, 4, 5, 0, 1])
+    with pytest.raises(ValueError):
+        spack.pack_sequences([np.arange(9)], 8)
+
+
+# ------------------------------------------------- registry (no sockets)
+
+def test_registry_quarantine_and_eviction_version_discipline():
+    reg = stream.ShardRegistry(dead_timeout=1000)
+    reg.add_shards([("a.rec", 4), ("b.rec", 4)])
+    w0, v0 = reg.register_worker(("127.0.0.1", 1))
+    w1, v1 = reg.register_worker(("127.0.0.1", 2))
+    assert v1 == v0 + 1
+    # re-register same wid: refresh, no version bump
+    _, v2 = reg.register_worker(("127.0.0.1", 3), wid=w1)
+    assert v2 == v1
+    asn = reg.assignment()
+    assert set(asn["owners"]) == {"a.rec", "b.rec"}
+    assert set(asn["owners"].values()) <= {w0, w1}
+    # quarantine is idempotent and removes the shard from the plan
+    assert reg.quarantine("a.rec", "bad") is True
+    assert reg.quarantine("a.rec", "again") is False
+    assert reg.assignment()["quarantined"] == ["a.rec"]
+    assert "a.rec" not in reg.assignment()["owners"]
+    # eviction is idempotent too
+    assert reg.remove_worker(w0) is True
+    assert reg.remove_worker(w0) is False
+    assert list(reg.assignment()["workers"]) == [w1]
+
+
+# --------------------------------------------------------------- e2e
+
+def _fetch_epoch_labels(client, epoch=0):
+    return [int(x) for b in client.epoch(epoch)
+            for x in np.asarray(b["label"]).tolist()]
+
+
+def test_global_order_identical_for_1_2_3_workers(tmp_path):
+    """The satellite's headline pin: same seed+epoch ⇒ the same global
+    sample order whether 1, 2, or 3 workers serve the shards."""
+    shards = _write_shards(tmp_path, n_shards=3, per_shard=8)
+    expected = None
+    for n_workers in (1, 2, 3):
+        coord = stream.StreamCoordinator(shards, seed=5, batch_size=4,
+                                         window=4).start()
+        workers = [stream.DataWorker(coord.addr).start()
+                   for _ in range(n_workers)]
+        client = stream.StreamClient(coord.addr)
+        try:
+            labels = _fetch_epoch_labels(client)
+            plan_labels = _labels_of(shards,
+                                     client.plan(0).global_order())
+            assert labels == plan_labels
+            if expected is None:
+                expected = labels
+            assert labels == expected, "order changed at %d workers" \
+                % n_workers
+        finally:
+            client.close()
+            for w in workers:
+                w.stop()
+            coord.stop()
+    assert sorted(expected) == list(range(24))          # every record once
+
+
+def test_dead_worker_shards_reassigned_exactly_once_no_drop_no_dup(
+        tmp_path):
+    """Kill a worker mid-epoch: the client re-routes the SAME batch to
+    the new owner; the epoch's label sequence still equals the plan
+    exactly (nothing dropped, nothing duplicated) and the registry
+    counted one reassignment wave covering exactly the dead worker's
+    shards."""
+    telemetry.enable()
+    try:
+        shards = _write_shards(tmp_path, n_shards=4, per_shard=8)
+        coord = stream.StreamCoordinator(shards, seed=2, batch_size=4,
+                                         window=8, dead_timeout=1000)
+        coord.start()
+        w0 = stream.DataWorker(coord.addr).start()
+        w1 = stream.DataWorker(coord.addr).start()
+        client = stream.StreamClient(coord.addr, retry_window=30)
+        try:
+            plan_labels = _labels_of(shards, client.plan(0).global_order())
+            owners = coord.registry.assignment()["owners"]
+            victim, survivor = w0, w1
+            if w1.wid in owners.values() and \
+                    list(owners.values()).count(w0.wid) == 0:
+                victim, survivor = w1, w0
+            victim_shards = [u for u, w in owners.items()
+                             if w == victim.wid]
+            assert victim_shards, "rendezvous gave the victim nothing"
+            base_moves = cat.stream_shard_reassignments.value()
+
+            got = []
+            it = client.epoch(0)
+            for b in it:
+                got.extend(int(x) for x in np.asarray(b["label"]).tolist())
+                if len(got) == 8 and victim is not None:
+                    victim.stop()       # SIGKILL-equivalent: rpc goes dark
+                    victim = None
+            assert got == plan_labels   # no drop, no dup, same order
+            moved = cat.stream_shard_reassignments.value() - base_moves
+            assert moved == len(victim_shards)
+            after = coord.registry.assignment()
+            assert list(after["workers"]) == [survivor.wid]
+            assert all(w == survivor.wid for w in after["owners"].values())
+        finally:
+            client.close()
+            for w in (w0, w1):
+                try:
+                    w.stop()
+                except Exception:  # noqa: BLE001 — victim already stopped
+                    pass
+            coord.stop()
+    finally:
+        telemetry.disable()
+
+
+def test_corrupt_shard_quarantined_epoch_completes_degraded(tmp_path):
+    """Corruption inside one shard must cost AT MOST that shard — the
+    epoch completes with every other shard's record served in planned
+    order, the registry quarantines the uri, and the PR 4 resync
+    counters attribute the corruption to the shard uri."""
+    telemetry.enable()
+    try:
+        shards = _write_shards(tmp_path, n_shards=3, per_shard=8)
+        bad_uri = shards[1][0]
+        _smash_record_magic(bad_uri, 2)
+        base_resync = cat.recordio_resyncs.value(uri=bad_uri)
+        base_quar = cat.stream_quarantined_shards.value(uri=bad_uri)
+
+        coord = stream.StreamCoordinator(shards, seed=0, batch_size=4,
+                                         window=8).start()
+        worker = stream.DataWorker(coord.addr).start()
+        client = stream.StreamClient(coord.addr)
+        try:
+            t0 = time.monotonic()
+            got = _fetch_epoch_labels(client)
+            assert time.monotonic() - t0 < 30       # degraded, never hung
+            plan_labels = _labels_of(shards, client.plan(0).global_order())
+            # order-preserving subsequence of the plan...
+            it = iter(plan_labels)
+            assert all(x in it for x in got)
+            # ...containing EVERY healthy-shard record exactly once
+            healthy = [x for x in plan_labels if not 8 <= x < 16]
+            assert sorted(set(got) & set(healthy)) == sorted(healthy)
+            assert len(got) == len(set(got))
+            assert client.skipped_batches > 0
+            assert coord.registry.assignment()["quarantined"] == [bad_uri]
+            assert cat.recordio_resyncs.value(uri=bad_uri) > base_resync
+            assert cat.stream_quarantined_shards.value(uri=bad_uri) \
+                == base_quar + 1
+        finally:
+            client.close()
+            worker.stop()
+            coord.stop()
+    finally:
+        telemetry.disable()
+
+
+def test_aggregate_scrape_discovers_stream_members(tmp_path):
+    """The r8 observability plane sees the data plane: scrape(stream=...)
+    pulls the coordinator AND its registered workers without a PS
+    scheduler, and the merged registry carries role-labeled stream
+    series."""
+    telemetry.enable()
+    try:
+        from incubator_mxnet_tpu.telemetry import aggregate
+        shards = _write_shards(tmp_path, n_shards=2, per_shard=8)
+        coord = stream.StreamCoordinator(shards, seed=0,
+                                         batch_size=4).start()
+        worker = stream.DataWorker(coord.addr).start()
+        client = stream.StreamClient(coord.addr)
+        try:
+            assert len(_fetch_epoch_labels(client)) == 16
+            scrape = aggregate.scrape(stream="%s:%s" % coord.addr)
+            roles = sorted(m["role"] for m in scrape["members"])
+            assert roles == ["stream-coord", "stream-worker"]
+            assert all(m["ok"] for m in scrape["members"])
+            served = scrape["registry"][
+                "mxtpu_stream_records_served_total"]["series"]
+            assert any("role=stream-worker" in k for k in served)
+        finally:
+            client.close()
+            worker.stop()
+            coord.stop()
+    finally:
+        telemetry.disable()
+
+
+# --------------------------------------------------- device prefetcher
+
+def test_prefetcher_preserves_order_and_stops_cleanly():
+    src = iter([{"x": np.full(2, i)} for i in range(20)])
+    pf = stream.DevicePrefetcher(src, depth=2, transfer=None)
+    got = [int(b["x"][0]) for b in pf]
+    assert got == list(range(20))
+    pf.close()          # idempotent after exhaustion
+
+
+def test_prefetcher_propagates_producer_exception():
+    def boom():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("decoder exploded")
+
+    pf = stream.DevicePrefetcher(boom(), depth=2, transfer=None)
+    assert int(pf.__next__()["x"][0]) == 0
+    with pytest.raises(RuntimeError, match="decoder exploded"):
+        next(pf)
+    pf.close()
+
+
+def test_prefetcher_close_unpins_blocked_producer():
+    """close() with a FULL queue and a source that keeps producing must
+    join the producer thread promptly (shutdown rules [1] and [3])."""
+    def endless():
+        i = 0
+        while True:
+            yield {"x": np.full(1, i)}
+            i += 1
+
+    pf = stream.DevicePrefetcher(endless(), depth=1, transfer=None)
+    next(pf)
+    time.sleep(0.1)                  # let the producer fill + block
+    t0 = time.monotonic()
+    pf.close()
+    assert time.monotonic() - t0 < 3.0
+    assert not pf._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(pf)                     # consumer never pins either (rule [2])
+
+
+def test_prefetcher_close_does_not_leave_watchdog_phase_armed():
+    from incubator_mxnet_tpu.resilience.watchdog import Watchdog
+
+    class _Slow:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            time.sleep(30)
+            return {}
+
+    with Watchdog(batch_timeout=600, poll=0.05, install=True) as w:
+        pf = stream.DevicePrefetcher(_Slow(), depth=1, transfer=None)
+        waiter_done = threading.Event()
+
+        def consume():
+            try:
+                next(pf)
+            except StopIteration:
+                pass
+            waiter_done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)              # consumer parked inside batch_wait
+        pf.close()
+        assert waiter_done.wait(3.0)
+        assert w._entries == {}, "batch_wait left armed after close"
+        assert w.fired == []
+
+
+def test_stream_loader_walks_epochs_and_closes(tmp_path):
+    shards = _write_shards(tmp_path, n_shards=2, per_shard=8)
+    coord = stream.StreamCoordinator(shards, seed=1, batch_size=4,
+                                     window=4).start()
+    worker = stream.DataWorker(coord.addr).start()
+    loader = stream.StreamLoader(coordinator=coord.addr, epochs=2,
+                                 transfer=None)
+    try:
+        per_epoch = {}
+        for e in (0, 1):
+            per_epoch[e] = [int(x) for batch in loader.epoch(e)
+                            for x in batch["label"]]
+        assert sorted(per_epoch[0]) == sorted(per_epoch[1]) \
+            == list(range(16))
+        assert per_epoch[0] != per_epoch[1]      # epochs reshuffle
+        # the __iter__ protocol walks the same epochs back to back
+        flat = [int(x) for batch in loader for x in batch["label"]]
+        assert flat == per_epoch[0] + per_epoch[1]
+        # early-abandon path: fresh epoch, break, close — no hang
+        it = loader.epoch(2)
+        next(it)
+        loader.close()
+        with pytest.raises(RuntimeError):
+            loader.epoch(3)
+        loader.close()                           # idempotent
+    finally:
+        loader.close()
+        worker.stop()
+        coord.stop()

@@ -133,6 +133,44 @@ def main():
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
             print("server       : %s unreachable (%s)" % (addr, e))
 
+    section("Stream")
+    # live data-plane probe: point MXTPU_STREAM_ADDR at a
+    # StreamCoordinator ("host:port") and diagnose reports its shard
+    # assignment, worker roster, and quarantine state
+    saddr = os.environ.get("MXTPU_STREAM_ADDR", "")
+    if not saddr:
+        print("(no coordinator configured — set "
+              "MXTPU_STREAM_ADDR=host:port)")
+    else:
+        try:
+            host, port = saddr.rsplit(":", 1)
+            from incubator_mxnet_tpu.kvstore.rpc import request
+            meta, _ = request((host, int(port)), {"op": "stream.stats"},
+                              timeout=3.0)
+            if meta.get("error"):
+                raise RuntimeError(meta["error"])
+            stats = meta.get("stats") or {}
+            cfg = meta.get("config") or {}
+            print("coordinator  :", saddr, "up")
+            print("  - seed=%s batch_size=%s window=%s version=%s"
+                  % (cfg.get("seed"), cfg.get("batch_size"),
+                     cfg.get("window"), stats.get("version")))
+            quar = stats.get("quarantined") or []
+            print("  - shards: %s (%d quarantined)"
+                  % (stats.get("shards", "?"), len(quar)))
+            for uri in quar[:5]:
+                print("    quarantined: %s" % uri)
+            print("  - workers: %s, reassignments: %s"
+                  % (stats.get("workers", "?"),
+                     stats.get("reassigned_total", "?")))
+            mmeta, _ = request((host, int(port)), {"op": "stream.members"},
+                               timeout=3.0)
+            for wid, waddr in sorted(
+                    (mmeta.get("workers") or {}).items()):
+                print("  worker %-6s: %s:%s" % (wid, waddr[0], waddr[1]))
+        except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+            print("coordinator  : %s unreachable (%s)" % (saddr, e))
+
     section("Debugz")
     # live-process probe: point MXTPU_DEBUGZ_PORT at a process that
     # started its debug server and diagnose reports its /statusz

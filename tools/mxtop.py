@@ -8,8 +8,14 @@ for model servers passed with --serving — QPS, p99 latency, batch
 occupancy, and shed counts. Counters are turned into rates by diffing
 consecutive scrapes.
 
+With --stream (or MXTPU_STREAM_ADDR) the frame adds an input-plane
+rollup — records/s, shard reassignments, quarantined shards, fetch-wait
+p99 — plus a corrupt-shard attribution table built from the uri-labeled
+recordio resync/quarantine counters.
+
     python tools/mxtop.py                      # scheduler from DMLC env
     python tools/mxtop.py --scheduler host:port --serving host:port
+    python tools/mxtop.py --stream host:port   # + data-plane rollup
     python tools/mxtop.py --once               # one frame, no clearing
 """
 
@@ -50,8 +56,9 @@ def _rates(prev, cur, elapsed):
             for k in cur}
 
 
-def frame(scheduler, serving, prev_totals, prev_ts):
-    scrape = aggregate.scrape(scheduler=scheduler, serving=serving)
+def frame(scheduler, serving, prev_totals, prev_ts, stream=None):
+    scrape = aggregate.scrape(scheduler=scheduler, serving=serving,
+                              stream=stream)
     reg = scrape["registry"]
     now = time.monotonic()
     elapsed = (now - prev_ts) if prev_ts else 0.0
@@ -123,6 +130,47 @@ def frame(scheduler, serving, prev_totals, prev_ts):
                             "%.1f" % (p99 * 1e3) if p99 is not None else "-",
                             "%.1f" % occ_mean if occ_mean is not None
                             else "-", shed))
+
+    # stream rollup: input-plane throughput + failure accounting
+    served = _series_sum(reg, "mxtpu_stream_batches_served_total")
+    recs = _series_sum(reg, "mxtpu_stream_records_served_total")
+    if served or recs:
+        totals["stream/records"] = recs
+        rps = _rates({"stream/records": prev_totals.get("stream/records",
+                                                        0.0)},
+                     {"stream/records": recs}, elapsed)["stream/records"]
+        reassigned = _series_sum(
+            reg, "mxtpu_stream_shard_reassignments_total")
+        quarantined = _series_sum(
+            reg, "mxtpu_stream_quarantined_shards_total")
+        wait = None
+        for sval in ((reg.get("mxtpu_stream_client_wait_seconds") or {})
+                     .get("series") or {}).values():
+            wait = aggregate.hist_quantile(sval, 0.99)
+        lines.append("")
+        lines.append("STREAM  records/s=%.0f batches=%.0f reassigned=%.0f "
+                     "quarantined=%.0f fetch-wait p99=%s"
+                     % (rps, served, reassigned, quarantined,
+                        "%.1f ms" % (wait * 1e3) if wait is not None
+                        else "-"))
+
+    # corrupt-shard attribution: the uri-labeled recordio counters name
+    # the shard(s) producing resyncs/quarantined bytes
+    resync = reg.get("mxtpu_recordio_resyncs_total") or {}
+    bad = {}
+    for skey, sval in (resync.get("series") or {}).items():
+        if "uri=" in skey:
+            uri = skey.split("uri=", 1)[1].split(",")[0]
+            bad[uri] = bad.get(uri, 0.0) + sval
+    if bad:
+        qbytes = reg.get("mxtpu_recordio_quarantined_bytes_total") or {}
+        lines.append("")
+        lines.append("%-52s %8s %12s" % ("CORRUPT SHARD", "RESYNCS",
+                                         "QUAR BYTES"))
+        for uri in sorted(bad, key=bad.get, reverse=True)[:10]:
+            b = sum(v for k, v in (qbytes.get("series") or {}).items()
+                    if "uri=%s" % uri in k)
+            lines.append("%-52s %8.0f %12.0f" % (uri[-52:], bad[uri], b))
     return "\n".join(lines), totals, now, scrape
 
 
@@ -132,6 +180,10 @@ def main(argv=None):
                     help="host:port (default: DMLC_PS_ROOT_URI/PORT)")
     ap.add_argument("--serving", action="append", default=None,
                     help="model-server host:port (repeatable)")
+    ap.add_argument("--stream",
+                    default=os.environ.get("MXTPU_STREAM_ADDR") or None,
+                    help="stream coordinator host:port "
+                         "(default: MXTPU_STREAM_ADDR)")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit")
@@ -143,7 +195,8 @@ def main(argv=None):
     while True:
         try:
             text, prev_totals, prev_ts, scrape = frame(
-                args.scheduler, args.serving, prev_totals, prev_ts)
+                args.scheduler, args.serving, prev_totals, prev_ts,
+                stream=args.stream)
         except (OSError, RuntimeError) as exc:
             text, scrape = "mxtop: scrape failed: %s" % exc, None
         if args.once:
