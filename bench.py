@@ -1207,6 +1207,182 @@ def _bench_stream(tmp):
         coord.stop()
 
 
+def bench_cold_start():
+    """BENCH_MODEL=cold_start: the fleet-restart tax, cold vs warm
+    through the persistent compile cache + AOT executable transport.
+
+    Spawns the SAME child payload twice per plane against one
+    MXTPU_COMPILE_CACHE_DIR: run 1 starts with an empty cache, compiles
+    everything, and publishes its executables (the trainer child also
+    checkpoints them; the serving child attaches them to the serving
+    checkpoint); run 2 is the restarted replica — it must reach its
+    first step / first reply on deserialized executables alone. Emits
+    cold_start_{trainer,serving}_{cold,warm}_seconds rows (flagged
+    lower_is_better, so bench_diff gates them in the inverted
+    direction, and carrying the backend-compile event count of the
+    measured window — warm should be 0) plus a warm_speedup summary row
+    per plane with the >=3x acceptance floor."""
+    child = os.environ.get("BENCH_COLD_CHILD")
+    if child:
+        return _cold_child(child, os.environ["BENCH_COLD_DIR"])
+    import shutil
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="bench_cold_")
+    try:
+        for plane, first in (("trainer", "step"), ("serving", "reply")):
+            if plane == "serving":
+                _cold_export_serving(workdir)
+            results = {}
+            for mode in ("cold", "warm"):
+                results[mode] = _spawn_cold_child(plane, workdir)
+                sec = results[mode]["seconds"]
+                _emit("cold_start_%s_%s_seconds" % (plane, mode),
+                      "seconds from restored state to first %s (%s "
+                      "process)" % (first, mode),
+                      {"value": sec, "repeats": 1, "min": sec,
+                       "max": sec, "spread_pct": 0.0},
+                      lower_is_better=True,
+                      compile_events=results[mode]["compile_events"])
+            speedup = (results["cold"]["seconds"]
+                       / max(results["warm"]["seconds"], 1e-9))
+            print(json.dumps({
+                "metric": "cold_start_%s_warm_speedup" % plane,
+                "value": round(speedup, 2),
+                "unit": "x (cold seconds / warm seconds)",
+                "floor": 3.0, "degraded": speedup < 3.0}))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _spawn_cold_child(plane, workdir):
+    """One process lifetime of the restart drill; returns its report."""
+    import subprocess
+    import sys
+    env = dict(os.environ,
+               BENCH_MODEL="cold_start", BENCH_COLD_CHILD=plane,
+               BENCH_COLD_DIR=workdir, BENCH_PREFLIGHT="0",
+               MXTPU_COMPILE_CACHE_DIR=os.path.join(workdir, "cache"))
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, capture_output=True, text=True,
+                          timeout=900)
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "cold_child":
+            return rec
+    raise RuntimeError("cold_start child (%s) produced no report; "
+                       "stderr:\n%s" % (plane, proc.stderr[-2000:]))
+
+
+def _cold_export_serving(workdir):
+    """Publish the serving checkpoint the serving children restart from."""
+    from incubator_mxnet_tpu import init as mxinit
+    from incubator_mxnet_tpu import ndarray as nd
+    from incubator_mxnet_tpu.models.bert import BERTModel
+    from incubator_mxnet_tpu.serving import loader as sload
+    cfg = dict(vocab_size=97, units=32, hidden_size=64, num_layers=2,
+               num_heads=2, max_length=64)
+    m = BERTModel(prefix="cold_bert_", dropout=0.0, **cfg)
+    m.initialize(mxinit.Normal(0.02))
+    m(nd.array(np.zeros((1, 8), np.int32)))
+    sload.export_for_serving(os.path.join(workdir, "serve_ckpt"),
+                             "bert_encoder", cfg, m)
+
+
+def _cold_child(plane, workdir):
+    """Hidden child mode for bench_cold_start. Measures this process's
+    time from framework-objects-start to first step/reply, counts the
+    backend-compile events inside that window, and prints ONE
+    {"metric": "cold_child"} JSON line the parent parses."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu import init as mxinit
+    from incubator_mxnet_tpu import ndarray as nd
+    from incubator_mxnet_tpu.telemetry import catalog as cat
+    cat.install_jax_compile_hook()
+
+    from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+    if plane == "trainer":
+        from incubator_mxnet_tpu.parallel import ShardedTrainer, make_mesh
+        rng = np.random.RandomState(0)
+        X = rng.rand(32, 64).astype(np.float32)
+        y = (np.arange(32) % 8).astype(np.int32)
+
+        def loss_fn(out, label):
+            logp = jax.nn.log_softmax(out, axis=-1)
+            return -jnp.take_along_axis(
+                logp, label.astype(jnp.int32)[:, None], axis=-1).mean()
+
+        # model/trainer construction is identical cold vs warm (and its
+        # eager-op compiles dwarf nothing real: a restarted replica pays
+        # it either way) — the measured window is restored-state ->
+        # first step, the part the cache/AOT transport actually removes
+        key = jax.random.PRNGKey(0)     # key creation compiles: outside
+        ckpt = os.path.join(workdir, "trainer_ckpt")
+        depth = int(os.environ.get("BENCH_COLD_DEPTH", "20"))
+        net = gluon.nn.HybridSequential(prefix="cold_mlp_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(256, activation="relu", in_units=64))
+            for _ in range(depth):
+                net.add(gluon.nn.Dense(256, activation="relu",
+                                       in_units=256))
+            net.add(gluon.nn.Dense(8, in_units=256))
+        net.initialize(mxinit.Xavier())
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        tr = ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1})
+        mgr = CheckpointManager(ckpt, keep=2, async_save=False)
+        warm = os.path.isdir(ckpt)
+        data, label = nd.array(X), nd.array(y)
+        base = cat.compile_events()
+        t0 = time.perf_counter()
+        if warm:
+            tr.load_executables(mgr.load_executables())
+        loss = tr.step(data, label, key=key)
+        final = float(jax.device_get(loss))
+        dt = time.perf_counter() - t0
+        events = cat.compile_events() - base
+        assert np.isfinite(final), "cold_start child diverged: %r" % final
+        if not warm:
+            mgr.save(0, tr.param_values,
+                     executables=tr.export_executables())
+    else:
+        from incubator_mxnet_tpu.serving import loader as sload
+        ids = (np.arange(16, dtype=np.int32).reshape(2, 8) % 97)
+        ckpt = os.path.join(workdir, "serve_ckpt")
+        mgr = CheckpointManager(ckpt, keep=None, async_save=False,
+                                prefix="serve")
+        _step, params, _tr, meta = mgr.restore()
+        info = meta["serving"]
+        builder = sload.SERVING_FAMILIES[info["family"]]
+        served = builder(dict(info["config"]), params, False)
+        # family build (weights in, eager materialization) happens on
+        # every restart regardless — the window is restored-replica ->
+        # first reply: executable acquisition + the reply itself
+        base = cat.compile_events()
+        t0 = time.perf_counter()
+        blobs = mgr.load_executables()
+        warm = bool(blobs)
+        for nme in sorted(blobs):
+            served.bind_executable(nme, blobs[nme])
+        out = served.encode_fn({"token_ids": ids}, 8)
+        np.asarray(out["pooled"])
+        dt = time.perf_counter() - t0
+        events = cat.compile_events() - base
+        if not warm:
+            sload.attach_executables(ckpt, served.export_executables())
+
+    print(json.dumps({"metric": "cold_child", "plane": plane,
+                      "warm": bool(warm), "seconds": round(dt, 4),
+                      "compile_events": int(events)}))
+
+
 def _emit_telemetry_summary():
     """Closing JSON line: what the run itself observed — step-time
     histogram stats and the XLA compile tax — so a perf number can be
@@ -1262,6 +1438,8 @@ def _dispatch(model, batch, steps, dtype):
         return bench_ssd(int(os.environ.get("BENCH_STEPS", "30")), dtype)
     if model == "consistency":
         return bench_consistency()
+    if model == "cold_start":
+        return bench_cold_start()
     if model == "zoo_scaling":
         return bench_zoo_scaling(int(os.environ.get("BENCH_STEPS", "30")),
                                  dtype)

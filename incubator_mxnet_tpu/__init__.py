@@ -87,3 +87,4 @@ from . import test_utils
 from . import torch_bridge as th
 from . import contrib
 from . import serving
+from . import compilecache

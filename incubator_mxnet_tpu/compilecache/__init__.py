@@ -1,0 +1,28 @@
+"""compilecache/ — persistent compile cache + AOT executable transport.
+
+Every process in a fleet used to pay full XLA compilation on start; this
+subsystem makes compilation a fleet-level, once-per-program cost:
+
+- ``store``  — content-addressed on-disk cache of serialized executables
+  (``MXTPU_COMPILE_CACHE_DIR`` / ``MXTPU_COMPILE_CACHE_MAX_MB``), atomic
+  rename-published, corruption-safe, LRU-capped;
+- ``aot``    — ``cached_compile`` (the cache-aware ``.compile()``) and
+  the serialize/deserialize codec that lets executables ride in
+  checkpoint ``executables`` sections;
+- ``warmup`` — precompile the serving bucket grid and trainer step avals
+  before a process takes traffic (CLI: ``tools/warmup.py``).
+
+With no cache dir configured the subsystem costs one env lookup per
+query and touches no files.
+"""
+
+from . import aot, store, warmup
+from .aot import (block_program, cached_compile, compile_key,
+                  deserialize_compiled, serialize_compiled)
+from .store import CompileCacheStore, cache_dir, default_store, enabled
+from .warmup import warmup_serving, warmup_trainer
+
+__all__ = ["aot", "store", "warmup", "block_program", "cached_compile",
+           "compile_key", "deserialize_compiled", "serialize_compiled",
+           "CompileCacheStore", "cache_dir", "default_store", "enabled",
+           "warmup_serving", "warmup_trainer"]

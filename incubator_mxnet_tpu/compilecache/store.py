@@ -1,0 +1,246 @@
+"""Content-addressed persistent store for serialized XLA executables.
+
+The disk layout is deliberately dumb: one file per executable under
+``MXTPU_COMPILE_CACHE_DIR``, named by the content key (sha256 over the
+StableHLO text, mesh geometry, donation signature, backend identity and
+jax/jaxlib versions — see ``aot.compile_key``). Each file is a one-line
+JSON header (entry version, payload sha256/size, the compile seconds the
+entry originally cost, a human-readable name) followed by the raw
+serialized-executable payload.
+
+Durability discipline mirrors ``utils/checkpoint.py``: writes go to a
+pid+thread-suffixed temp file, fsync, then one atomic ``os.rename``
+publishes the entry — two writers racing on the same key both write complete
+temp files and the second rename harmlessly replaces identical content,
+so a reader can never observe a torn entry that was *published*. Reads
+verify the header's sha256 over the payload; any mismatch, truncation,
+or unparsable header is logged, the bad file is deleted, and the caller
+falls back to a fresh compile — a corrupt cache can cost time, never
+correctness or a crash.
+
+Size is LRU-capped at ``MXTPU_COMPILE_CACHE_MAX_MB`` (default 2048):
+hits bump the entry mtime, and after each write the oldest-mtime entries
+are evicted until the directory fits. With no cache dir configured the
+entire subsystem is one env-dict lookup per query (gated by
+tests/test_telemetry_overhead.py) — no filesystem access, no imports.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import threading
+
+from ..telemetry import catalog as _cat
+from ..telemetry import debugz as _dbz
+
+__all__ = ["enabled", "cache_dir", "max_mb", "CompileCacheStore",
+           "default_store", "statusz_entry", "ENTRY_VERSION"]
+
+log = logging.getLogger(__name__)
+
+ENTRY_VERSION = 1
+_ENTRY_SUFFIX = ".mxc"
+_TMP_SUFFIX = ".tmp"
+
+_lock = threading.Lock()
+_default = {"dir": None, "store": None}
+
+
+def cache_dir():
+    """The configured cache directory, or None (cache off)."""
+    return os.environ.get("MXTPU_COMPILE_CACHE_DIR") or None
+
+
+def enabled():
+    """True when a persistent compile cache directory is configured.
+    ONE env-dict lookup — the entire cost of the subsystem when off."""
+    return bool(os.environ.get("MXTPU_COMPILE_CACHE_DIR"))
+
+
+def max_mb(default=2048):
+    """LRU size cap in MB (MXTPU_COMPILE_CACHE_MAX_MB)."""
+    try:
+        return float(os.environ.get("MXTPU_COMPILE_CACHE_MAX_MB", default))
+    except ValueError:
+        return float(default)
+
+
+def default_store():
+    """Process-wide store for the configured cache dir, or None when the
+    cache is off. Re-resolved when the env changes (tests flip it)."""
+    d = cache_dir()
+    if d is None:
+        return None
+    with _lock:
+        if _default["dir"] != d:
+            _default["dir"] = d
+            _default["store"] = CompileCacheStore(d)
+        return _default["store"]
+
+
+def statusz_entry():
+    """The /statusz ``compile_cache`` value (also used by diagnose):
+    cheap {'enabled': False} when no cache dir is configured."""
+    st = default_store()
+    if st is None:
+        return {"enabled": False}
+    out = st.stats()
+    out["enabled"] = True
+    return out
+
+
+class CompileCacheStore:
+    """One cache directory of content-addressed executable entries."""
+
+    def __init__(self, directory, cap_mb=None):
+        self._dir = directory
+        self._cap_mb = cap_mb
+        os.makedirs(directory, exist_ok=True)
+        self._register_statusz()
+
+    @property
+    def directory(self):
+        return self._dir
+
+    def _cap_bytes(self):
+        cap = self._cap_mb if self._cap_mb is not None else max_mb()
+        return int(cap * 1e6)
+
+    def _path(self, key):
+        return os.path.join(self._dir, key + _ENTRY_SUFFIX)
+
+    # -------------------------------------------------------------- read
+    def get(self, key, where="other"):
+        """Return ``(payload_bytes, header_dict)`` for ``key`` or None.
+
+        Never raises: a missing entry is a miss; a truncated, bit-flipped
+        or unparsable entry is logged, deleted, counted under
+        ``compile_cache_errors{kind=corrupt}`` and reported as a miss so
+        the caller recompiles."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                header_line = f.readline()
+                header = json.loads(header_line.decode("utf-8"))
+                payload = f.read()
+        except FileNotFoundError:
+            _cat.compile_cache_misses.inc(where=where)
+            return None
+        except Exception as e:  # noqa: BLE001 — a corrupt cache entry
+            # must degrade to a fresh compile, never crash the step
+            self._quarantine(path, "unreadable header (%s: %s)"
+                             % (type(e).__name__, e))
+            _cat.compile_cache_misses.inc(where=where)
+            return None
+        if (not isinstance(header, dict)
+                or header.get("v") != ENTRY_VERSION
+                or len(payload) != header.get("size")
+                or hashlib.sha256(payload).hexdigest()
+                != header.get("sha256")):
+            self._quarantine(path, "payload does not match header "
+                             "(truncated or bit-flipped)")
+            _cat.compile_cache_misses.inc(where=where)
+            return None
+        try:
+            os.utime(path)          # LRU recency bump
+        except OSError:
+            pass
+        _cat.compile_cache_hits.inc(where=where)
+        saved = header.get("compile_seconds")
+        if isinstance(saved, (int, float)) and saved > 0:
+            _cat.compile_cache_seconds_saved.inc(float(saved))
+        return payload, header
+
+    def _quarantine(self, path, why):
+        log.warning("compile cache: dropping %s: %s", path, why)
+        _cat.compile_cache_errors.inc(kind="corrupt")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- write
+    def put(self, key, payload, compile_seconds=0.0, name=None):
+        """Publish an entry atomically; returns the entry path or None on
+        I/O failure (counted, logged, never raised — the caller already
+        holds the compiled executable, the cache is best-effort)."""
+        header = {"v": ENTRY_VERSION,
+                  "sha256": hashlib.sha256(payload).hexdigest(),
+                  "size": len(payload),
+                  "compile_seconds": round(float(compile_seconds), 6),
+                  "name": name or ""}
+        final = self._path(key)
+        # pid AND thread id: two threads of one process racing the same
+        # key must not interleave into a shared temp file
+        tmp = "%s%s.%d.%d" % (final, _TMP_SUFFIX, os.getpid(),
+                              threading.get_ident())
+        try:
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header).encode("utf-8"))
+                f.write(b"\n")
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, final)   # atomic publish; last writer wins
+        except OSError as e:
+            log.warning("compile cache: cannot write %s: %s", final, e)
+            _cat.compile_cache_errors.inc(kind="io")
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        self._enforce_cap()
+        return final
+
+    # --------------------------------------------------------------- LRU
+    def _entries(self):
+        """[(path, size, mtime)] for every published entry."""
+        out = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return out
+        for n in names:
+            if not n.endswith(_ENTRY_SUFFIX):
+                continue
+            p = os.path.join(self._dir, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            out.append((p, st.st_size, st.st_mtime))
+        return out
+
+    def _enforce_cap(self):
+        entries = self._entries()
+        total = sum(e[1] for e in entries)
+        cap = self._cap_bytes()
+        if total > cap:
+            for p, size, _m in sorted(entries, key=lambda e: e[2]):
+                try:
+                    os.remove(p)
+                except OSError:
+                    continue
+                _cat.compile_cache_evictions.inc()
+                total -= size
+                if total <= cap:
+                    break
+        _cat.compile_cache_entries.set(
+            len([1 for e in self._entries()]))
+        _cat.compile_cache_bytes.set(
+            sum(e[1] for e in self._entries()))
+
+    # ------------------------------------------------------------- stats
+    def stats(self):
+        entries = self._entries()
+        return {"dir": self._dir,
+                "entries": len(entries),
+                "bytes": sum(e[1] for e in entries),
+                "cap_bytes": self._cap_bytes()}
+
+    def _register_statusz(self):
+        # one /statusz entry per process; set_status is a no-op predicate
+        # check while no debugz server runs, so re-registration is cheap
+        _dbz.set_status("compile_cache", self.stats)

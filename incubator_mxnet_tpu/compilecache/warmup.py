@@ -1,0 +1,134 @@
+"""Fleet warmup driver: precompile before taking traffic.
+
+A process that knows its shapes ahead of time should pay XLA compilation
+BEFORE it joins the serving rotation or the training quorum — and with
+``MXTPU_COMPILE_CACHE_DIR`` set, pay it once per fleet, not once per
+process. This module drives exactly the shapes the planes declare:
+
+- ``warmup_serving`` walks the serving scheduler's shape-bucket grid
+  (``MXTPU_SERVE_BUCKETS`` x the pow2 row counts of
+  ``MXTPU_WARMUP_ROWS``) plus the decode slot grid, building every
+  forward/decode program through the persistent cache; with
+  ``attach=True`` the serialized executables are also written back into
+  the serving checkpoint's ``executables`` section so replicas on
+  machines that never saw this cache directory still skip compilation.
+
+- ``warmup_trainer`` precompiles a trainer's step program for one
+  example batch (``ShardedTrainer.precompile``) without consuming it.
+
+``tools/warmup.py`` is the CLI face of ``warmup_serving``.
+"""
+
+import logging
+import os
+import time
+
+from ..telemetry import catalog as _cat
+from . import store as _store
+
+__all__ = ["warmup_rows", "warmup_buckets", "warmup_serving",
+           "warmup_trainer"]
+
+log = logging.getLogger(__name__)
+
+
+def _int_list(raw):
+    out = []
+    for part in raw.replace(";", ",").split(","):
+        part = part.strip()
+        if part:
+            out.append(int(part))
+    return out
+
+
+def warmup_rows(default="1,8"):
+    """Row counts (post pad_batch_rows pow2 padding) to precompile per
+    bucket — MXTPU_WARMUP_ROWS."""
+    try:
+        rows = _int_list(os.environ.get("MXTPU_WARMUP_ROWS", default))
+    except ValueError:
+        rows = _int_list(default)
+    return sorted(set(r for r in rows if r > 0)) or [1]
+
+
+def warmup_buckets():
+    """Sequence-length buckets to precompile — MXTPU_WARMUP_BUCKETS,
+    falling back to the serving plane's MXTPU_SERVE_BUCKETS grid."""
+    raw = os.environ.get("MXTPU_WARMUP_BUCKETS")
+    if raw:
+        try:
+            b = _int_list(raw)
+            if b:
+                return sorted(set(b))
+        except ValueError:
+            pass
+    from ..serving.scheduler import default_buckets
+    return list(default_buckets())
+
+
+def warmup_serving(directory=None, served=None, buckets=None, rows=None,
+                   slots=None, attach=False, quantize=None):
+    """Precompile a served model's forward/decode programs.
+
+    Pass a serving checkpoint ``directory`` (loaded via
+    ``load_served_model``) or an already-built ``served`` model. Returns
+    a summary dict: programs built, cache hits/misses observed, wall
+    seconds, and (with ``attach=True`` and a directory) how many
+    serialized executables were written back into the checkpoint.
+    """
+    from ..serving import loader as _loader
+    if (directory is None) == (served is None):
+        raise ValueError("pass exactly one of directory/served")
+    _cat.install_jax_compile_hook()
+    t0 = time.perf_counter()
+    if served is None:
+        served = _loader.load_served_model(directory, quantize=quantize)
+    buckets = list(buckets) if buckets is not None else warmup_buckets()
+    rows = list(rows) if rows is not None else warmup_rows()
+    built, failed = [], []
+    if served.has_encode and served.program_factory is not None:
+        sigs = served.warmup_signatures or [("token_ids",)]
+        for names in sigs:
+            for b in buckets:
+                for r in rows:
+                    prog = served.program_for(r, b, tuple(names))
+                    (built if prog is not None else failed).append(
+                        "encode/r%dxb%d/%s" % (r, b, "+".join(names)))
+    if served.has_decode and served.decode_program_factory is not None:
+        n_slots = int(slots if slots is not None else
+                      os.environ.get("MXTPU_SERVE_SLOTS", "8"))
+        prog = served.decode_program_for(n_slots)
+        (built if prog is not None else failed).append(
+            "decode/s%d" % n_slots)
+    attached = 0
+    if attach:
+        if directory is None:
+            raise ValueError("attach=True needs a checkpoint directory")
+        blobs = served.export_executables()
+        if blobs:
+            _loader.attach_executables(directory, blobs)
+            attached = len(blobs)
+    st = _store.default_store()
+    summary = {
+        "programs_built": len(built),
+        "programs_failed": len(failed),
+        "built": built,
+        "failed": failed,
+        "attached_executables": attached,
+        "seconds": round(time.perf_counter() - t0, 3),
+        "cache": st.stats() if st is not None else None,
+    }
+    log.info("serving warmup: %d program(s) in %.1fs (%d attached)",
+             len(built), summary["seconds"], attached)
+    return summary
+
+
+def warmup_trainer(trainer, data, label, key=None):
+    """Precompile a ShardedTrainer's step program for this batch
+    signature (through the cache / imported executables) without
+    consuming the batch. Returns a summary dict."""
+    t0 = time.perf_counter()
+    trainer.precompile(data, label, key=key)
+    st = _store.default_store()
+    return {"seconds": round(time.perf_counter() - t0, 3),
+            "cache": st.stats() if st is not None else None}

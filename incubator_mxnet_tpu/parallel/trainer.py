@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..compilecache import aot as _aot
+from ..compilecache import store as _ccstore
 from ..gluon.block import _TraceCtx, _trace_state
 from ..ndarray import NDArray
 from ..telemetry import catalog as _cat
@@ -219,6 +221,13 @@ class ShardedTrainer:
             mesh, label_spec if label_spec is not None else default_spec)
         self._jit_step = None
         self._jit_step_guarded = None
+        self._step_is_aot = False
+        # AOT plumbing: serialized executables handed in by
+        # load_executables (checkpoint `executables` section) keyed by
+        # program name, and the compiled programs this trainer built
+        # (the export_executables source)
+        self._imported_exes = {}
+        self._aot_built = {}
         self._telemetry_labels = {"zero": self._zero1_mode or "off",
                                   "pipeline": "on" if live_pp else "off"}
         _cat.install_jax_compile_hook()
@@ -327,6 +336,110 @@ class ShardedTrainer:
     # ----------------------------------------------------------------- step
     def _build(self, n_data_args):
         return jax.jit(self._build_raw(n_data_args), donate_argnums=(0, 1, 2))
+
+    # ---------------------------------------------------- compile plumbing
+    def _aot_wanted(self):
+        """Use the AOT lower+compile path (a pinned jax.stages.Compiled)
+        instead of plain jax.jit: opted in by the persistent compile
+        cache, by imported serialized executables, or by MXTPU_COSTS=1 —
+        cost capture needs the compiled object anyway, and routing it
+        through one shared lower+compile is what removes the old
+        second non-donating compile."""
+        return (_ccstore.enabled() or bool(self._imported_exes)
+                or _costs.capture_enabled())
+
+    def _exe_args(self, datas, labels, key):
+        """The step calling convention at its current avals (lowering
+        only — nothing executes)."""
+        pv = {n: self._param_vals[n] for n in self._diff_names}
+        av = {n: self._param_vals[n] for n in self._aux_names}
+        return (pv, av, self._opt_state, jnp.float32(1), key,
+                *datas, *labels)
+
+    def _compile_program(self, exe_name, jit_fn, args, cost_name=None,
+                         samples_per_exec=None):
+        """Produce ONE executable for `exe_name`: bind an imported
+        serialized executable when a checkpoint shipped one, else
+        lower+compile through the persistent cache. Cost capture
+        (MXTPU_COSTS=1) reads the SAME executable — no extra compile."""
+        blob = self._imported_exes.pop(exe_name, None)
+        compiled = None
+        if blob is not None:
+            try:
+                compiled = _aot.deserialize_compiled(blob)
+                _cat.aot_executables_imported.inc(where="trainer")
+            except Exception as e:  # noqa: BLE001 — a blob from another
+                # backend/jaxlib must fall back to compiling, never crash
+                import warnings
+                warnings.warn("trainer: imported executable %r failed to "
+                              "deserialize (%s: %s); recompiling"
+                              % (exe_name, type(e).__name__, e))
+                compiled, blob = None, None
+        if compiled is None:
+            lowered = jit_fn.lower(*args)
+            compiled, blob = _aot.cached_compile(
+                lowered, name="trainer." + exe_name, where="trainer",
+                mesh=self._mesh, donation=(0, 1, 2), want_blob=True)
+        # keep the blob the executable was loaded from / published as:
+        # a deserialized executable cannot re-serialize, so this is the
+        # only durable form export_executables can ship
+        self._aot_built[exe_name] = (compiled, blob)
+        if cost_name is not None:
+            _aot.capture_cost(cost_name, compiled,
+                              samples_per_exec=samples_per_exec)
+        return compiled
+
+    def _ensure_step_program(self, datas, labels, key):
+        """Build self._jit_step for this batch signature (AOT path when
+        opted in, plain jax.jit otherwise)."""
+        if self._jit_step is not None:
+            return
+        if self._aot_wanted():
+            batch = (int(datas[0].shape[0])
+                     if datas and getattr(datas[0], "shape", None) else None)
+            self._jit_step = self._compile_program(
+                "step", self._build(len(datas)),
+                self._exe_args(datas, labels, key),
+                cost_name="trainer.step", samples_per_exec=batch)
+            self._step_is_aot = True
+        else:
+            self._jit_step = self._build(len(datas))
+            self._step_is_aot = False
+
+    def precompile(self, data, label, key=None):
+        """Warmup hook: compile (or cache-hit / import) the step program
+        for this batch signature WITHOUT consuming the batch or mutating
+        training state. Returns self."""
+        datas, labels = self._prep_batch(data, label)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        self._ensure_step_program(datas, labels, key)
+        return self
+
+    def export_executables(self):
+        """{program_name: blob} of every AOT-compiled program this
+        trainer holds, serialized for a checkpoint's ``executables``
+        section. Empty when the AOT path never engaged (cache off and no
+        MXTPU_COSTS) or the backend cannot serialize executables."""
+        out = {}
+        for exe_name, (compiled, blob) in self._aot_built.items():
+            if blob is not None:
+                out[exe_name] = blob
+                continue
+            try:
+                out[exe_name] = _aot.serialize_compiled(compiled)
+            except Exception:  # noqa: BLE001 — backends without
+                continue       # executable serialization export nothing
+        return out
+
+    def load_executables(self, blobs):
+        """Accept serialized executables restored from a checkpoint
+        (CheckpointManager.load_executables). Each binds lazily the
+        first time its program is needed; an incompatible blob falls
+        back to a fresh compile."""
+        if blobs:
+            self._imported_exes.update(blobs)
+        return self
 
     def _make_grad_stage(self, n_data_args):
         """Shared loss/grad computation: returns grads(param_vals, aux_vals,
@@ -683,37 +796,48 @@ class ShardedTrainer:
         cache_key = (len(datas), n_steps, scan_over_batch)
         if getattr(self, "_scan_cache", None) is None:
             self._scan_cache = {}
-        if cache_key not in self._scan_cache:
-            self._scan_cache[cache_key] = self._build_scan(
-                len(datas), n_steps, scan_over_batch)
         if key is None:
             key = jax.random.PRNGKey(self._step_count)
         t = jnp.float32(self._step_count + 1)
         self._step_count += n_steps
         pv = {n: self._param_vals[n] for n in self._diff_names}
         aux_vals = {n: self._param_vals[n] for n in self._aux_names}
+        scan_args = (pv, aux_vals, self._opt_state, t, key,
+                     *(datas + labels))
+
+        def _scan_samples():
+            shp = datas[0].shape if datas else None
+            if not shp:
+                return None
+            batch = shp[1] if scan_over_batch and len(shp) > 1 else shp[0]
+            return int(batch) * n_steps
+
+        def _build_scan_program():
+            jit_fn = self._build_scan(len(datas), n_steps, scan_over_batch)
+            if not self._aot_wanted():
+                return jit_fn, False
+            # AOT path: ONE lower+compile through the persistent cache
+            # serves both execution and MXTPU_COSTS accounting (the old
+            # path paid a second, non-donating compile for the latter)
+            exe_name = "scan/%d_%d_%d" % (len(datas), n_steps,
+                                          int(scan_over_batch))
+            return self._compile_program(
+                exe_name, jit_fn, scan_args, cost_name="trainer.step_scan",
+                samples_per_exec=_scan_samples()), True
+        if cache_key not in self._scan_cache:
+            self._scan_cache[cache_key] = _build_scan_program()
         t0 = time.perf_counter() if _met.enabled() else None
-        if t0 is not None and _costs.capture_enabled():
-            if getattr(self, "_cost_captured", None) is None:
-                self._cost_captured = set()
-            if cache_key not in self._cost_captured:
-                # lower (never run) the scan program with these avals: the
-                # cost covers all n_steps steps of one scan execution
-                self._cost_captured.add(cache_key)
-                try:
-                    shp = datas[0].shape if datas else None
-                    batch = shp[1] if scan_over_batch and len(shp) > 1 \
-                        else (shp[0] if shp else 0)
-                    _costs.capture(
-                        "trainer.step_scan",
-                        self._scan_cache[cache_key].lower(
-                            pv, aux_vals, self._opt_state, t, key,
-                            *(datas + labels)).compile(),
-                        samples_per_exec=int(batch) * n_steps)
-                except Exception:   # noqa: BLE001 — accounting must
-                    pass            # never fail a train step
-        new_params, new_aux, new_opt, losses = self._scan_cache[cache_key](
-            pv, aux_vals, self._opt_state, t, key, *(datas + labels))
+        scan_fn, scan_is_aot = self._scan_cache[cache_key]
+        try:
+            new_params, new_aux, new_opt, losses = scan_fn(*scan_args)
+        except TypeError:
+            if not scan_is_aot:
+                raise
+            # pinned avals drifted (new batch shape under the same cache
+            # key): re-lower through the cache and retry once
+            self._scan_cache[cache_key] = _build_scan_program()
+            new_params, new_aux, new_opt, losses = \
+                self._scan_cache[cache_key][0](*scan_args)
         self._param_vals = {**new_params, **new_aux}
         self._opt_state = new_opt if new_opt else self._opt_state
         if t0 is not None:
@@ -779,29 +903,28 @@ class ShardedTrainer:
         """Run one sharded train step; returns the (device) scalar loss."""
         t0 = time.perf_counter() if _met.enabled() else None
         datas, labels = self._prep_batch(data, label)
-        if self._jit_step is None:
-            self._jit_step = self._build(len(datas))
-            if t0 is not None and _costs.capture_enabled():
-                # MXTPU_COSTS=1: pay one extra (non-donating) lower+compile
-                # to record the step's static FLOPs/bytes, enabling the
-                # per-step MFU / tokens-per-sec gauges below
-                try:
-                    _costs.capture(
-                        "trainer.step", self.lowered(data, label).compile(),
-                        samples_per_exec=int(datas[0].shape[0])
-                        if datas and getattr(datas[0], "shape", None)
-                        else None)
-                except Exception:   # noqa: BLE001 — accounting must
-                    pass            # never fail a train step
         if key is None:
             key = jax.random.PRNGKey(self._step_count)
+        self._ensure_step_program(datas, labels, key)
         self._step_count += 1
         t = jnp.float32(self._step_count)
         self._param_vals_diff = {n: self._param_vals[n] for n in self._diff_names}
         aux_vals = {n: self._param_vals[n] for n in self._aux_names}
-        new_params, new_aux, new_opt, loss = self._jit_step(
-            self._param_vals_diff, aux_vals, self._opt_state, t, key,
-            *datas, *labels)
+        try:
+            new_params, new_aux, new_opt, loss = self._jit_step(
+                self._param_vals_diff, aux_vals, self._opt_state, t, key,
+                *datas, *labels)
+        except TypeError:
+            if not self._step_is_aot:
+                raise
+            # an AOT executable is pinned to its compile-time avals: a
+            # changed batch signature (where plain jit would retrace)
+            # re-lowers through the cache and retries once
+            self._jit_step = None
+            self._ensure_step_program(datas, labels, key)
+            new_params, new_aux, new_opt, loss = self._jit_step(
+                self._param_vals_diff, aux_vals, self._opt_state, t, key,
+                *datas, *labels)
         self._param_vals = {**new_params, **new_aux}
         self._opt_state = new_opt if new_opt else self._opt_state
         if t0 is not None:

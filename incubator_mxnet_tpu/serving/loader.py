@@ -21,16 +21,22 @@ for autoregressive ones (driven by decode.DecodeLoop). Two built-ins:
   (serving.quant.Int8Dense) when quantization is on.
 """
 
+import logging
+
 import numpy as np
 
 from .. import init as _init
 from .. import ndarray as nd
+from ..compilecache import aot as _aot
+from ..compilecache import store as _ccstore
 from ..utils.checkpoint import CheckpointManager
 from .kv_cache import KVCache
 from .quant import Int8Dense, int8_serving_enabled
 
 __all__ = ["ServedModel", "serving_family", "export_for_serving",
-           "load_served_model", "SERVING_FAMILIES"]
+           "load_served_model", "attach_executables", "SERVING_FAMILIES"]
+
+log = logging.getLogger(__name__)
 
 SERVING_FAMILIES = {}
 
@@ -47,10 +53,22 @@ def serving_family(name):
 
 class ServedModel:
     """What a family builder hands the server: the forward surfaces plus
-    the construction facts the scheduler needs."""
+    the construction facts the scheduler needs.
+
+    The AOT surfaces are optional and family-owned: ``program_factory``
+    (``(rows, bucket, names) -> BlockProgram or None``, caching into the
+    shared ``programs`` dict) and ``decode_program_factory``
+    (``(slots) -> BlockProgram or None``) build compiled programs through
+    the persistent compile cache; ``program_binder`` rebinds a serialized
+    executable blob from a checkpoint ``executables`` section onto the
+    restored params — zero tracing, zero compiling. ``warmup_signatures``
+    names the encode input-key tuples the warmup driver should walk."""
 
     def __init__(self, family, config, encode_fn=None, step_fn=None,
-                 make_cache=None, pad_token=0, quantized=False):
+                 make_cache=None, pad_token=0, quantized=False,
+                 program_factory=None, decode_program_factory=None,
+                 program_binder=None, warmup_signatures=None,
+                 programs=None, decode_programs=None):
         if encode_fn is None and step_fn is None:
             raise ValueError("a ServedModel needs encode_fn, step_fn, "
                              "or both")
@@ -63,6 +81,14 @@ class ServedModel:
         self.make_cache = make_cache
         self.pad_token = int(pad_token)
         self.quantized = bool(quantized)
+        self.program_factory = program_factory
+        self.decode_program_factory = decode_program_factory
+        self.program_binder = program_binder
+        self.warmup_signatures = (list(warmup_signatures)
+                                  if warmup_signatures else None)
+        self.programs = programs if programs is not None else {}
+        self.decode_programs = (decode_programs
+                                if decode_programs is not None else {})
 
     @property
     def has_encode(self):
@@ -72,12 +98,64 @@ class ServedModel:
     def has_decode(self):
         return self.step_fn is not None
 
+    # ------------------------------------------------------ AOT surfaces
+    def program_for(self, rows, bucket, names):
+        """The compiled encode program for this (rows, bucket, input-name)
+        signature, building it through the compile cache on first ask.
+        None when the family has no program factory or the build failed —
+        callers fall back to the eager encode path."""
+        if self.program_factory is None:
+            return None
+        return self.program_factory(int(rows), int(bucket), tuple(names))
+
+    def decode_program_for(self, slots):
+        """The compiled decode-step program for this slot count, or
+        None (no factory / build failed / family opted out)."""
+        if self.decode_program_factory is None:
+            return None
+        return self.decode_program_factory(int(slots))
+
+    def export_executables(self):
+        """Serialize every built program: {executable name: blob bytes}
+        for a checkpoint ``executables`` section. Programs that fail to
+        serialize are skipped (the blob is an accelerator, not state)."""
+        out = {}
+        for progs in (self.programs, self.decode_programs):
+            for prog in progs.values():
+                if prog is None:
+                    continue
+                try:
+                    out[prog.name] = prog.dump()
+                except Exception as e:  # noqa: BLE001 — backends without
+                    # executable serialization still serve; just no export
+                    log.info("serving: %r not serializable (%s: %s)",
+                             prog.name, type(e).__name__, e)
+        return out
+
+    def bind_executable(self, name, blob):
+        """Rebind one serialized executable from a checkpoint onto this
+        model's params. Returns True when bound; a stale or foreign blob
+        logs and returns False (that program recompiles on demand)."""
+        if self.program_binder is None:
+            return False
+        try:
+            return bool(self.program_binder(name, blob))
+        except Exception as e:  # noqa: BLE001 — an unloadable executable
+            # must degrade to a fresh compile, never block model load
+            log.warning("serving: executable %r failed to bind "
+                        "(%s: %s); it will be recompiled on demand",
+                        name, type(e).__name__, e)
+            return False
+
 
 # ------------------------------------------------------------ export/load
-def export_for_serving(directory, family, config, model):
+def export_for_serving(directory, family, config, model,
+                       executables=None):
     """Write a serving checkpoint: the model's params (hierarchical
     `_collect_params_with_prefix` names — prefix-independent, so the
     server rebuilds under any name scope) plus the family/config stanza.
+    ``executables`` ({name: blob}) rides along as the checkpoint's AOT
+    ``executables`` section so replicas skip XLA compilation on load.
     """
     if family not in SERVING_FAMILIES:
         raise ValueError("unknown serving family %r (registered: %s)"
@@ -87,7 +165,24 @@ def export_for_serving(directory, family, config, model):
     mgr = CheckpointManager(directory, keep=None, async_save=False,
                             prefix="serve")
     mgr.save(0, params, extra={"serving": {"family": family,
-                                           "config": dict(config)}})
+                                           "config": dict(config)}},
+             executables=executables)
+    return directory
+
+
+def attach_executables(directory, blobs):
+    """Re-publish the newest serving checkpoint in `directory` with an
+    ``executables`` section — weights and serving stanza unchanged, step
+    bumped by one so the write is a fresh atomic publish. This is how
+    the warmup driver ships compiled programs to replicas that never
+    share this machine's compile-cache directory."""
+    if not blobs:
+        return directory
+    mgr = CheckpointManager(directory, keep=2, async_save=False,
+                            prefix="serve")
+    step, params, _trainer, meta = mgr.restore()
+    extra = {"serving": meta["serving"]} if "serving" in meta else None
+    mgr.save(int(step) + 1, params, extra=extra, executables=blobs)
     return directory
 
 
@@ -108,7 +203,22 @@ def load_served_model(directory, quantize=None):
                          "process" % family)
     if quantize is None:
         quantize = int8_serving_enabled()
-    return builder(dict(info.get("config") or {}), params, bool(quantize))
+    served = builder(dict(info.get("config") or {}), params,
+                     bool(quantize))
+    try:
+        blobs = mgr.load_executables()
+    except Exception as e:  # noqa: BLE001 — an unreadable executables
+        # section degrades to compile-on-demand, never blocks serving
+        log.warning("serving: cannot read executables section under %r "
+                    "(%s: %s)", directory, type(e).__name__, e)
+        blobs = {}
+    bound = sum(1 for name in sorted(blobs)
+                if served.bind_executable(name, blobs[name]))
+    if bound:
+        log.info("serving: bound %d/%d checkpoint executable(s) — warm "
+                 "replica, no XLA compile needed for those programs",
+                 bound, len(blobs))
+    return served
 
 
 def _set_params(model, params):
@@ -143,9 +253,65 @@ def _build_bert_encoder(config, params, quantize):
     model(nd.array(np.zeros((1, 8), np.int32)))   # materialize shapes
     _set_params(model, params)
     emit_seq = bool(config.get("emit_seq", False))
+    programs = {}
+
+    def _program_name(rows, bucket, names):
+        return "encode/r%dxb%d/%s" % (rows, bucket, "+".join(names))
+
+    def program_for(rows, bucket, names):
+        names = tuple(sorted(names))
+        key = (int(rows), int(bucket), names)
+        if key not in programs:
+            args = [np.zeros(key[:2], np.int32),
+                    (np.zeros(key[:2], np.int32)
+                     if "token_types" in names else None),
+                    (np.ones(key[:2], np.float32)
+                     if "valid_mask" in names else None)]
+            try:
+                programs[key] = _aot.block_program(
+                    model, args, _program_name(*key), where="serving")
+            except Exception as e:  # noqa: BLE001 — an AOT build
+                # failure falls back to the eager encode path
+                log.warning("serving: cannot build %r (%s: %s); this "
+                            "signature serves eagerly",
+                            _program_name(*key), type(e).__name__, e)
+                programs[key] = None
+        return programs[key]
+
+    def bind(name, blob):
+        if not name.startswith("encode/r"):
+            return False
+        shape, sig = name[len("encode/"):].split("/", 1)
+        rows, bucket = (int(x) for x in shape[1:].split("xb"))
+        names = tuple(sig.split("+"))
+        programs[(rows, bucket, names)] = _aot.bind_block_program(
+            model, blob, len(names), name)
+        return True
 
     def encode(arrays, _bucket):
-        ids = nd.array(np.asarray(arrays["token_ids"], np.int32))
+        ids_np = np.asarray(arrays["token_ids"], np.int32)
+        if _ccstore.enabled() or programs:
+            names = tuple(sorted(arrays))
+            prog = program_for(ids_np.shape[0], ids_np.shape[1], names)
+            if prog is not None:
+                ins = [ids_np]
+                if "token_types" in arrays:
+                    ins.append(np.asarray(arrays["token_types"],
+                                          np.int32))
+                if "valid_mask" in arrays:
+                    ins.append(np.asarray(arrays["valid_mask"],
+                                          np.float32))
+                try:
+                    seq, pooled = prog(*ins)
+                except TypeError:   # aval drift — retire, serve eagerly
+                    programs[(ids_np.shape[0], ids_np.shape[1],
+                              names)] = None
+                else:
+                    out = {"pooled": np.asarray(pooled)}
+                    if emit_seq:
+                        out["seq"] = np.asarray(seq)
+                    return out
+        ids = nd.array(ids_np)
         types = (nd.array(np.asarray(arrays["token_types"], np.int32))
                  if "token_types" in arrays else None)
         mask = (nd.array(np.asarray(arrays["valid_mask"], np.float32))
@@ -157,7 +323,9 @@ def _build_bert_encoder(config, params, quantize):
         return out
 
     return ServedModel("bert_encoder", config, encode_fn=encode,
-                       quantized=False)
+                       quantized=False, program_factory=program_for,
+                       program_binder=bind, programs=programs,
+                       warmup_signatures=[("token_ids",)])
 
 
 @serving_family("lstm_lm")
@@ -194,11 +362,57 @@ def _build_lstm_lm(config, params, quantize):
         return KVCache(slots, {s: ("state", (layers, hidden))
                                for s in state_names}, max_len=max_len)
 
+    decode_programs = {}
+
+    def decode_program_for(slots):
+        slots = int(slots)
+        if int8_head is not None:
+            return None     # the int8 head is a host-side matmul; the
+            # mixed path is not one jax program to serialize
+        if slots not in decode_programs:
+            args = [np.zeros((1, slots), np.int32),
+                    [np.zeros((layers, slots, hidden), np.float32)
+                     for _ in state_names]]
+            try:
+                decode_programs[slots] = _aot.block_program(
+                    model, args, "decode/s%d" % slots, where="serving")
+            except Exception as e:  # noqa: BLE001 — an AOT build
+                # failure falls back to the eager decode path
+                log.warning("serving: cannot build decode/s%d (%s: %s); "
+                            "decode runs eagerly", slots,
+                            type(e).__name__, e)
+                decode_programs[slots] = None
+        return decode_programs[slots]
+
+    def bind(name, blob):
+        if int8_head is not None or not name.startswith("decode/s"):
+            return False
+        slots = int(name[len("decode/s"):])
+        decode_programs[slots] = _aot.bind_block_program(
+            model, blob, 1 + n_states, name)
+        return True
+
     def step(tokens, cache, _active):
         s = tokens.shape[0]
+        states_np = [np.ascontiguousarray(
+            cache.data[name].transpose(1, 0, 2)) for name in state_names]
+        if int8_head is None and (_ccstore.enabled() or decode_programs):
+            prog = decode_program_for(s)
+            if prog is not None:
+                try:
+                    flat = prog(np.asarray(tokens, np.int32)
+                                .reshape(1, s), *states_np)
+                except TypeError:   # aval drift — retire the program
+                    decode_programs[s] = None
+                else:
+                    logits, out_states = flat[0], flat[1:]
+                    for name, st in zip(state_names, out_states):
+                        # mxlint: disable=host-sync-loop — see below
+                        cache.data[name][:] = np.asarray(st) \
+                            .transpose(1, 0, 2)
+                    return np.asarray(logits)[0]            # (S, V)
         inp = nd.array(tokens.reshape(1, s))
-        states = [nd.array(np.ascontiguousarray(
-            cache.data[name].transpose(1, 0, 2))) for name in state_names]
+        states = [nd.array(a) for a in states_np]
         if int8_head is None:
             logits, out_states = model(inp, states)
             out = logits.asnumpy()[0]                       # (S, V)
@@ -216,4 +430,7 @@ def _build_lstm_lm(config, params, quantize):
 
     return ServedModel("lstm_lm", config, step_fn=step,
                        make_cache=make_cache, pad_token=0,
-                       quantized=bool(quantize))
+                       quantized=bool(quantize),
+                       decode_program_factory=decode_program_for,
+                       program_binder=bind,
+                       decode_programs=decode_programs)

@@ -27,6 +27,7 @@ import os
 import threading
 import time
 
+from ..compilecache import store as _ccstore
 from ..kvstore import rpc as _rpc
 from ..telemetry import catalog as _cat
 from ..telemetry import debugz as _dbz
@@ -74,6 +75,7 @@ class ModelServer:
         if _dbz.start_from_env(role="serving") is not None:
             _dbz.set_status("serve_addr", "%s:%s" % self.addr)
             _dbz.set_status("models", lambda: sorted(self._models))
+            _dbz.set_status("compile_cache", _ccstore.statusz_entry)
         return self
 
     def stop(self):

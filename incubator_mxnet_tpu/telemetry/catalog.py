@@ -10,6 +10,7 @@ All instruments are registered at import; registration is cheap and a
 registered-but-disabled instrument never mutates (see metrics.py).
 """
 
+import contextlib
 import threading
 
 from . import metrics as _m
@@ -89,12 +90,23 @@ trainer_step_seconds = _m.histogram(
 trainer_samples = _m.counter(
     "mxtpu_trainer_samples_total",
     "Leading-dim samples consumed by step/step_scan (tokens/sec numerator)")
+jit_compiles = _m.counter(
+    "mxtpu_jit_compiles_total",
+    "XLA backend_compile events observed via jax.monitoring, by where "
+    "(trainer|serving|warmup|other) — the compile region sets the label "
+    "via compiling()")
+jit_compile_seconds = _m.counter(
+    "mxtpu_jit_compile_seconds_total",
+    "Cumulative XLA backend_compile seconds via jax.monitoring, by where")
+# DEPRECATED aliases (PR 3 names): un-labeled process-wide totals kept so
+# existing dashboards don't break; new consumers read mxtpu_jit_*.
 trainer_jit_compiles = _m.counter(
     "mxtpu_trainer_jit_compiles_total",
-    "XLA backend_compile events observed via jax.monitoring")
+    "DEPRECATED alias of mxtpu_jit_compiles_total (label-free total; "
+    "counts serving/warmup compiles too despite the trainer_ name)")
 trainer_jit_compile_seconds = _m.counter(
     "mxtpu_trainer_jit_compile_seconds_total",
-    "Cumulative XLA backend_compile seconds via jax.monitoring")
+    "DEPRECATED alias of mxtpu_jit_compile_seconds_total")
 
 # -- data pipeline (gluon/data/dataloader.py) ------------------------
 dataloader_batches = _m.counter(
@@ -251,6 +263,37 @@ model_tokens_per_sec = _m.gauge(
     "Samples/tokens consumed per second by the named executable")
 
 
+# -- persistent compile cache (compilecache/) ------------------------
+compile_cache_hits = _m.counter(
+    "mxtpu_compile_cache_hits_total",
+    "Executables served from the persistent compile cache instead of a "
+    "fresh XLA compile, by where")
+compile_cache_misses = _m.counter(
+    "mxtpu_compile_cache_misses_total",
+    "Cache lookups that fell through to a fresh XLA compile, by where")
+compile_cache_seconds_saved = _m.counter(
+    "mxtpu_compile_cache_seconds_saved_total",
+    "Cumulative compile seconds avoided by cache hits (each entry "
+    "remembers what its original compile cost)")
+compile_cache_errors = _m.counter(
+    "mxtpu_compile_cache_errors_total",
+    "Cache entries that could not be used, by kind (corrupt|io|"
+    "serialize|deserialize) — every one falls back to a fresh compile")
+compile_cache_evictions = _m.counter(
+    "mxtpu_compile_cache_evictions_total",
+    "Entries removed by the MXTPU_COMPILE_CACHE_MAX_MB LRU cap")
+compile_cache_entries = _m.gauge(
+    "mxtpu_compile_cache_entries",
+    "Entries resident in the persistent compile cache directory")
+compile_cache_bytes = _m.gauge(
+    "mxtpu_compile_cache_bytes",
+    "Bytes resident in the persistent compile cache directory")
+aot_executables_imported = _m.counter(
+    "mxtpu_aot_executables_imported_total",
+    "Serialized executables deserialized from a checkpoint's "
+    "executables section, by where")
+
+
 # -- jax compile hook ------------------------------------------------
 # jax.monitoring calls duration listeners for every instrumented event;
 # we fold the XLA backend-compile ones into the trainer_jit_* counters.
@@ -259,10 +302,34 @@ model_tokens_per_sec = _m.gauge(
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _hook_lock = threading.Lock()
 _hook_state = {"installed": False}
+_compile_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def compiling(where):
+    """Label XLA compiles fired inside the region: backend_compile events
+    observed by the jax.monitoring hook while this context is active are
+    counted under ``mxtpu_jit_compiles_total{where=...}``. Nestable; events
+    outside any region fall under where="other"."""
+    prev = getattr(_compile_ctx, "where", None)
+    _compile_ctx.where = where
+    try:
+        yield
+    finally:
+        _compile_ctx.where = prev
+
+
+def compile_events(where=None):
+    """Current backend_compile event count — ``where=None`` sums every
+    label (the process-wide total the deprecated alias also carries)."""
+    if where is not None:
+        return jit_compiles.value(where=where)
+    return sum(jit_compiles.snapshot().values())
 
 
 def install_jax_compile_hook():
-    """Register a jax.monitoring listener feeding trainer_jit_* metrics."""
+    """Register a jax.monitoring listener feeding the mxtpu_jit_* metrics
+    (and their deprecated trainer_jit_* aliases)."""
     with _hook_lock:
         if _hook_state["installed"]:
             return
@@ -277,5 +344,8 @@ def install_jax_compile_hook():
 
 def _on_jax_event_duration(event, duration, **_kw):
     if event == _COMPILE_EVENT:
-        trainer_jit_compiles.inc()
+        where = getattr(_compile_ctx, "where", None) or "other"
+        jit_compiles.inc(where=where)
+        jit_compile_seconds.inc(duration, where=where)
+        trainer_jit_compiles.inc()              # deprecated aliases
         trainer_jit_compile_seconds.inc(duration)

@@ -41,6 +41,11 @@ __all__ = ["CheckpointManager"]
 _TMP_SUFFIX = ".tmp"
 
 
+def _sha256_hex(blob):
+    import hashlib
+    return hashlib.sha256(blob).hexdigest()
+
+
 def _drain_writer(cell, directory):
     """Exit/gc finalizer: join an in-flight async write so a clean process
     exit never truncates the final checkpoint (daemon threads would be
@@ -134,16 +139,24 @@ class CheckpointManager:
                 snap[k] = _np.asarray(v)
         return snap
 
-    def save(self, step, params, trainer=None, extra=None):
+    def save(self, step, params, trainer=None, extra=None,
+             executables=None):
         """Checkpoint `params` (dict name -> NDArray/array) at `step`.
 
         trainer : object with ``save_states(fname)`` (gluon Trainer) or a
             raw bytes payload to store alongside.
         extra : JSON-able dict merged into meta.json (e.g. epoch, rng
             seed, data-iterator position).
+        executables : dict name -> bytes of serialized AOT executables
+            (compilecache.aot / ShardedTrainer.export_executables);
+            stored under an ``executables/`` subdir with sha256-verified
+            readback via ``load_executables`` so a restarted replica
+            skips XLA compilation.
         """
         self.wait()   # surface any previous writer error before snapshot
         snap = self._snapshot(params)
+        exes = ({str(k): bytes(v) for k, v in executables.items()}
+                if executables else None)
         trainer_payload = None
         if trainer is not None:
             if isinstance(trainer, (bytes, bytearray)):
@@ -162,14 +175,15 @@ class CheckpointManager:
 
         if self._async:
             self._thread = threading.Thread(
-                target=self._write, args=(step, snap, trainer_payload, meta),
+                target=self._write,
+                args=(step, snap, trainer_payload, meta, exes),
                 daemon=True)
             self._thread.start()
         else:
-            self._write(step, snap, trainer_payload, meta)
+            self._write(step, snap, trainer_payload, meta, exes)
             self._raise_pending()
 
-    def _write(self, step, snap, trainer_payload, meta):
+    def _write(self, step, snap, trainer_payload, meta, executables=None):
         t0 = time.perf_counter() if _met.enabled() else None
         try:
             final = self._path(step)
@@ -182,6 +196,22 @@ class CheckpointManager:
             if trainer_payload is not None:
                 with open(os.path.join(tmp, "trainer"), "wb") as f:
                     f.write(trainer_payload)
+            if executables:
+                # serialized AOT executables: one opaque file per program
+                # under executables/, indexed (with payload sha256) from
+                # meta.json — names may hold '/' so files are numbered
+                exdir = os.path.join(tmp, "executables")
+                os.makedirs(exdir)
+                index = {}
+                for i, name in enumerate(sorted(executables)):
+                    blob = executables[name]
+                    fname = "exe-%04d.bin" % i
+                    with open(os.path.join(exdir, fname), "wb") as f:
+                        f.write(blob)
+                    index[name] = {
+                        "file": fname, "bytes": len(blob),
+                        "sha256": _sha256_hex(blob)}
+                meta["executables"] = index
             # meta.json last: its presence marks the payload complete
             # (steps() requires it), and the dir rename publishes it
             with open(os.path.join(tmp, "meta.json"), "w") as f:
@@ -294,6 +324,46 @@ class CheckpointManager:
         if t0 is not None:
             _cat.checkpoint_restore_seconds.observe(time.perf_counter() - t0)
         _cat.checkpoint_restores.inc(status="ok")
+        return out
+
+    def load_executables(self, step=None):
+        """Read the ``executables`` section of checkpoint `step` (default:
+        latest complete) as a dict name -> bytes.
+
+        Returns {} when the checkpoint has no executables section. Each
+        blob is verified against the sha256 recorded in meta.json; a
+        missing or corrupt blob is skipped with a warning (the consumer
+        falls back to a fresh compile for that program) — executables are
+        an accelerator, never a correctness dependency."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return {}
+        path = self._path(step)
+        try:
+            with open(os.path.join(path, "meta.json")) as f:
+                index = json.load(f).get("executables") or {}
+        except (OSError, ValueError):
+            return {}
+        out = {}
+        for name, ent in sorted(index.items()):
+            fpath = os.path.join(path, "executables",
+                                 os.path.basename(str(ent.get("file", ""))))
+            try:
+                with open(fpath, "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                warnings.warn("CheckpointManager(%s): executable %r is "
+                              "unreadable (%s); it will be recompiled"
+                              % (self._dir, name, e))
+                continue
+            if _sha256_hex(blob) != ent.get("sha256") \
+                    or len(blob) != ent.get("bytes"):
+                warnings.warn("CheckpointManager(%s): executable %r is "
+                              "corrupt (checksum mismatch); it will be "
+                              "recompiled" % (self._dir, name))
+                continue
+            out[name] = blob
         return out
 
     def restore_trainer(self, trainer, payload):

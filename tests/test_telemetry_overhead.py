@@ -90,3 +90,23 @@ def test_inactive_debugz_status_is_cheap():
     assert not debugz.active()
     assert _per_call(lambda: debugz.set_status("k", 1)) \
         < MAX_SECONDS_PER_CALL
+
+
+def test_disabled_compile_cache_is_one_env_check(monkeypatch):
+    """Cache off (no MXTPU_COMPILE_CACHE_DIR): enabled() is one env-dict
+    lookup, default_store() resolves to None, and the statusz entry is a
+    constant — no filesystem access anywhere on the off path."""
+    from incubator_mxnet_tpu.compilecache import store as ccstore
+    monkeypatch.delenv("MXTPU_COMPILE_CACHE_DIR", raising=False)
+    assert ccstore.enabled() is False
+    assert ccstore.default_store() is None
+    assert ccstore.statusz_entry() == {"enabled": False}
+    assert _per_call(ccstore.enabled) < MAX_SECONDS_PER_CALL
+    calls = []
+    monkeypatch.setattr(ccstore.os, "listdir",
+                        lambda *a, **k: calls.append(a) or [])
+    monkeypatch.setattr(ccstore.os, "makedirs",
+                        lambda *a, **k: calls.append(a))
+    assert ccstore.default_store() is None
+    assert ccstore.statusz_entry() == {"enabled": False}
+    assert calls == []

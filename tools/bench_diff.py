@@ -2,10 +2,13 @@
 """bench_diff — automated reader for the BENCH_r*.json trajectory.
 
 Compares the newest round against the previous one: every throughput
-metric the two rounds share (unit contains "/sec" — higher is better)
-plus any `mfu` fields. Exits nonzero when a shared metric regressed by
-more than --threshold (default 10%), so CI or a human can gate on "did
-this round get slower" without reading JSON by hand.
+metric the two rounds share (unit contains "/sec" — higher is better),
+every row the emitter flagged `lower_is_better` (latency/startup rows
+like the BENCH_MODEL=cold_start time-to-first-step numbers — gated in
+the INVERTED direction), plus any `mfu` fields. Exits nonzero when a
+shared metric regressed by more than --threshold (default 10%), so CI
+or a human can gate on "did this round get slower" without reading
+JSON by hand.
 
 Preflight health rows (tunnel_preflight_*) are diagnostics, not
 benchmarks — dispatch RTT is lower-is-better and tunnel-condition
@@ -51,6 +54,15 @@ def comparable(rec):
     return "/sec" in str(rec.get("unit", ""))
 
 
+def lower_is_better(rec):
+    """Gate-worthy latency row: the emitter flagged it
+    ``lower_is_better`` (e.g. the cold_start time-to-first-step rows),
+    so the regression direction is INVERTED — growing is bad."""
+    if rec["metric"].startswith("tunnel_preflight"):
+        return False
+    return bool(rec.get("lower_is_better"))
+
+
 def diff(old, new, threshold):
     """[(metric, kind, old, new, ratio, regressed)] over shared rows."""
     rows = []
@@ -60,6 +72,10 @@ def diff(old, new, threshold):
             ratio = n["value"] / o["value"] if o["value"] else float("inf")
             rows.append((metric, "throughput", o["value"], n["value"],
                          ratio, ratio < 1.0 - threshold))
+        elif lower_is_better(o) and lower_is_better(n):
+            ratio = n["value"] / o["value"] if o["value"] else float("inf")
+            rows.append((metric, "latency", o["value"], n["value"],
+                         ratio, ratio > 1.0 + threshold))
         if "mfu" in o and "mfu" in n:
             ratio = n["mfu"] / o["mfu"] if o["mfu"] else float("inf")
             rows.append((metric, "mfu", o["mfu"], n["mfu"], ratio,
@@ -107,7 +123,8 @@ def main(argv=None):
               % (kind, metric, o, n, (ratio - 1.0) * 100, flag))
         failed = failed or regressed
     skipped = [m for m in sorted(set(old) & set(new))
-               if not comparable(old[m]) and "mfu" not in old[m]]
+               if not comparable(old[m]) and not lower_is_better(old[m])
+               and "mfu" not in old[m]]
     if skipped:
         print("  (not gated: %s)" % ", ".join(skipped))
     if failed:

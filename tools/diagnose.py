@@ -133,6 +133,36 @@ def main():
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
             print("server       : %s unreachable (%s)" % (addr, e))
 
+    section("Compile Cache")
+    # persistent compile cache: config + entry inventory of the
+    # MXTPU_COMPILE_CACHE_DIR this process would use
+    try:
+        from incubator_mxnet_tpu.compilecache import store as ccstore
+        if not ccstore.enabled():
+            print("(disabled — set MXTPU_COMPILE_CACHE_DIR to enable)")
+        else:
+            st = ccstore.default_store()
+            stats = st.stats()
+            print("dir          :", stats["dir"])
+            print("entries      : %d (%.1f MB of %.0f MB cap)"
+                  % (stats["entries"], stats["bytes"] / 1e6,
+                     stats["cap_bytes"] / 1e6))
+            import json as _json
+            shown = 0
+            for path, size, _mtime in sorted(
+                    st._entries(), key=lambda e: -e[2]):
+                if shown >= 10:
+                    print("  ... (%d more)" % (stats["entries"] - shown))
+                    break
+                with open(path, "rb") as f:
+                    hdr = _json.loads(f.readline().decode("utf-8"))
+                print("  - %-32s %8.2f MB  saved %.1fs"
+                      % (hdr.get("name") or os.path.basename(path),
+                         size / 1e6, hdr.get("compile_seconds") or 0))
+                shown += 1
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("compile cache unavailable:", e)
+
     section("Stream")
     # live data-plane probe: point MXTPU_STREAM_ADDR at a
     # StreamCoordinator ("host:port") and diagnose reports its shard

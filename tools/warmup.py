@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""warmup — precompile a serving replica's programs before it takes
+traffic.
+
+Drives compilecache.warmup.warmup_serving against a serving checkpoint
+directory: every (row count x sequence bucket) encode signature of the
+bucket grid plus the decode slot program, all built through the
+persistent compile cache (MXTPU_COMPILE_CACHE_DIR). With --attach the
+serialized executables are also written back into the checkpoint's
+``executables`` section, so replicas on machines that never shared this
+cache directory still skip XLA compilation on load.
+
+Run it once per (model, jax version, backend) after export — e.g. from
+the deploy pipeline right after export_for_serving — then every
+restarted or autoscaled replica reaches its first reply in seconds.
+
+    python tools/warmup.py /ckpt/bert-serving
+    python tools/warmup.py /ckpt/bert-serving --attach
+    python tools/warmup.py /ckpt/lm --buckets 64,128 --rows 1,8 --slots 16
+    MXTPU_COMPILE_CACHE_DIR=/var/cache/mxtpu python tools/warmup.py /ckpt/m
+
+Knobs default from the serving plane's own env: MXTPU_WARMUP_BUCKETS
+(falls back to MXTPU_SERVE_BUCKETS), MXTPU_WARMUP_ROWS (default "1,8"),
+MXTPU_SERVE_SLOTS. Exits nonzero when any program failed to build.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_mxnet_tpu import telemetry  # noqa: E402
+from incubator_mxnet_tpu.compilecache import warmup as _warmup  # noqa: E402
+
+
+def _int_list(raw):
+    return [int(p) for p in raw.replace(";", ",").split(",") if p.strip()]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("directory", help="serving checkpoint directory "
+                    "(export_for_serving output)")
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated sequence buckets "
+                    "(default: MXTPU_WARMUP_BUCKETS / MXTPU_SERVE_BUCKETS)")
+    ap.add_argument("--rows", default=None,
+                    help="comma-separated batch row counts "
+                    "(default: MXTPU_WARMUP_ROWS or 1,8)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="decode slot count (default: MXTPU_SERVE_SLOTS)")
+    ap.add_argument("--attach", action="store_true",
+                    help="write the serialized executables back into the "
+                    "checkpoint's executables section")
+    ap.add_argument("--quantize", action="store_true",
+                    help="build the int8-serving variant of the family")
+    args = ap.parse_args(argv)
+
+    if not os.environ.get("MXTPU_COMPILE_CACHE_DIR"):
+        print("warmup: note: MXTPU_COMPILE_CACHE_DIR is unset — programs "
+              "warm this process only%s"
+              % ("" if args.attach else
+                 " and nothing persists (pass --attach or set the cache "
+                 "dir)"), file=sys.stderr)
+    telemetry.enable()
+    summary = _warmup.warmup_serving(
+        directory=args.directory,
+        buckets=_int_list(args.buckets) if args.buckets else None,
+        rows=_int_list(args.rows) if args.rows else None,
+        slots=args.slots, attach=args.attach,
+        quantize=True if args.quantize else None)
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 1 if summary["programs_failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
