@@ -1,0 +1,105 @@
+"""Cross-version resolvers for drifting jax APIs.
+
+The framework targets the modern ``jax.shard_map`` surface
+(``axis_names=``, ``check_vma=``).  Older/newer installs drift: some
+ship the primitive only as ``jax.experimental.shard_map.shard_map``
+with the pre-rename keyword names (``auto=``, ``check_rep=``).  Every
+in-tree caller imports ``shard_map`` from here instead of from jax, so
+the whole codebase tracks one resolver:
+
+- ``jax.shard_map`` present: returned as-is.
+- only the experimental module present: returned wrapped in a keyword
+  adapter that translates ``check_vma``→``check_rep`` and
+  ``axis_names={manual}``→``auto=mesh.axis_names - manual``.
+- neither present: ``shard_map`` is None and ``HAS_SHARD_MAP`` is
+  False; tests marked ``needs_shard_map`` (see tests/conftest.py) skip
+  with one shared reason instead of erroring individually.
+
+Partial-manual regions (``axis_names`` a strict subset of the mesh
+axes, i.e. nonempty ``auto=``) ABORT the process inside XLA on the
+old experimental path — a native crash, not an exception — so the
+adapter refuses them with NotImplementedError up front and
+``SHARD_MAP_PARTIAL`` is False; tests exercising such regions carry
+``needs_shard_map_partial`` and skip.
+"""
+
+import functools
+import inspect
+
+__all__ = ["shard_map", "HAS_SHARD_MAP", "SHARD_MAP_PARTIAL",
+           "MULTIPROCESS_CPU", "resolve_shard_map", "jax_version"]
+
+
+def jax_version():
+    """Installed jax version as an int tuple, (0,) when unparseable."""
+    import jax
+    parts = []
+    for p in str(getattr(jax, "__version__", "0")).split("."):
+        if not p.isdigit():
+            break
+        parts.append(int(p))
+    return tuple(parts) or (0,)
+
+
+def _adapt_experimental(exp):
+    """Wrap the pre-rename experimental shard_map so modern keyword
+    call sites (axis_names=, check_vma=) keep working."""
+
+    @functools.wraps(exp)
+    def _compat_shard_map(f=None, *, mesh, in_specs, out_specs,
+                          axis_names=None, check_vma=None,
+                          check_rep=None, auto=None, **kw):
+        kwargs = dict(kw)
+        rep = check_rep if check_rep is not None else check_vma
+        if rep is not None:
+            kwargs["check_rep"] = rep
+        if auto is None and axis_names is not None:
+            # modern API names the MANUAL axes; the old one names the
+            # complement (axes left automatic)
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            # auto= exists in the old signature but partial-manual
+            # lowering aborts (not raises) inside this jaxlib's XLA
+            raise NotImplementedError(
+                "partial-manual shard_map regions (auto=%r) are not "
+                "supported by the installed jax; mark dependent tests "
+                "needs_shard_map_partial (incubator_mxnet_tpu/compat.py)"
+                % (sorted(auto),))
+        if f is None:
+            return functools.partial(
+                _compat_shard_map, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, axis_names=axis_names,
+                check_vma=check_vma, check_rep=check_rep, auto=auto, **kw)
+        return exp(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kwargs)
+
+    return _compat_shard_map
+
+
+def resolve_shard_map():
+    """``(shard_map callable or None, partial-manual supported?)``."""
+    import jax
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, True
+    try:
+        from jax.experimental.shard_map import shard_map as exp
+    except ImportError:
+        return None, False
+    try:
+        params = inspect.signature(exp).parameters
+    except (TypeError, ValueError):
+        return exp, True
+    if "check_vma" in params or "axis_names" in params:
+        return exp, True        # already the modern keyword surface
+    return _adapt_experimental(exp), False
+
+
+shard_map, SHARD_MAP_PARTIAL = resolve_shard_map()
+HAS_SHARD_MAP = shard_map is not None
+
+# Old jaxlibs reject multi-process meshes on the CPU backend outright
+# ("Multiprocess computations aren't implemented on the CPU backend"),
+# which the virtual-device test rig depends on; cross-process CPU
+# collectives landed alongside the 0.5 series.
+MULTIPROCESS_CPU = jax_version() >= (0, 5)

@@ -122,9 +122,10 @@ class MultiHeadAttention(HybridBlock):
         except Exception:  # mxlint: disable=broad-except — abstract
             # mesh probe across jax versions; concrete mesh fallback
             pass
-        return jax.shard_map(fn, mesh=use_mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, axis_names={"sp"},
-                             check_vma=False)(q, k, v)
+        from ..compat import shard_map
+        return shard_map(fn, mesh=use_mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={"sp"},
+                         check_vma=False)(q, k, v)
 
 
 class PositionwiseFFN(HybridBlock):

@@ -244,7 +244,7 @@ def make_ring_attention(mesh, seq_axis="sp", causal=False, impl="auto",
     impl: 'flash' (Pallas per-hop kernel), 'dense' (einsum per hop), or
     'auto' — flash on TPU when the local shard length satisfies the
     kernel's tiling contract, dense otherwise."""
-    from jax import shard_map
+    from ..compat import shard_map
     from ..ops.pallas import flash_attention_available
 
     spec = P(None, None, seq_axis, None)

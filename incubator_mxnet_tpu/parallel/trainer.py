@@ -717,7 +717,8 @@ class ShardedTrainer:
                          {n: rep for n in aux_vals},
                          {n: opt_specs[n] for n in opt_state},
                          rep)
-            return jax.shard_map(
+            from ..compat import shard_map
+            return shard_map(
                 manual_step, mesh=self._mesh, in_specs=in_specs,
                 out_specs=out_specs, axis_names={dp}, check_vma=False,
             )(param_vals, aux_vals, opt_state, t, key, *batch)

@@ -24,6 +24,11 @@ adds both:
   under role/rank labels via the scheduler's membership view.
 - ``costs``: per-executable FLOPs/bytes from XLA cost analysis and the
   MFU / achieved-vs-roofline gauges.
+- ``history``: bounded in-memory ring TSDB sampling the local registry
+  and fleet scrapes (MXTPU_HISTORY_*), feeding health evaluation.
+- ``health``: declarative SLO rules (threshold / burn_rate / absence /
+  skew) with OK→WARN→PAGE hysteresis, surfaced via /alertz, /statusz,
+  mxtop and tools/healthcheck.py (MXTPU_HEALTH_*).
 
 See docs/OBSERVABILITY.md for the metric catalog and span semantics.
 """
@@ -36,6 +41,8 @@ from . import flight
 from . import debugz
 from . import costs
 from . import aggregate
+from . import history
+from . import health
 
 from .metrics import (enable, disable, enabled, counter, gauge, histogram,
                       snapshot, reset)
@@ -45,7 +52,7 @@ from .tracing import (span, current, inject, extract, from_meta,
                       merge_traces, recent_spans)
 
 __all__ = ["metrics", "tracing", "export", "catalog",
-           "flight", "debugz", "costs", "aggregate",
+           "flight", "debugz", "costs", "aggregate", "history", "health",
            "enable", "disable", "enabled", "counter", "gauge", "histogram",
            "snapshot", "reset",
            "render_prometheus", "render_json", "flush", "start_flusher",

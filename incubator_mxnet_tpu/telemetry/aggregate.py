@@ -129,7 +129,7 @@ def scrape(scheduler=None, serving=None, stream=None, timeout=5.0):
         except (OSError, RuntimeError, ValueError):
             pass    # coordinator down: its own entry will report the error
 
-    members, snaps = [], []
+    members, snaps, failed = [], [], []
     for role, rank, addr in targets:
         entry = {"role": role, "rank": rank,
                  "addr": "%s:%s" % (addr[0], addr[1])}
@@ -140,9 +140,26 @@ def scrape(scheduler=None, serving=None, stream=None, timeout=5.0):
         except (OSError, RuntimeError, ValueError) as exc:
             entry["ok"] = False
             entry["error"] = str(exc)
+            failed.append((role, rank))
         members.append(entry)
+    registry = merge(snaps)
+    if failed:
+        # a member dying mid-scrape is itself a signal: surface it as a
+        # series in the merged registry (and the scraper's own counter)
+        # so history/health see the gap — never raise mid-walk
+        from . import catalog as _cat
+        series = {}
+        for role, rank in failed:
+            member = "%s:%s" % (role, rank)
+            _cat.scrape_errors.inc(member=member)
+            key = "member=%s" % member
+            series[key] = series.get(key, 0) + 1
+        registry["mxtpu_scrape_errors_total"] = {
+            "kind": "counter",
+            "help": "member fetches that failed during this scrape",
+            "series": series}
     return {"epoch": meta.get("epoch"), "quorum": meta.get("quorum"),
-            "members": members, "registry": merge(snaps)}
+            "members": members, "registry": registry}
 
 
 def hist_quantile(series_value, q):

@@ -294,6 +294,101 @@ aot_executables_imported = _m.counter(
     "executables section, by where")
 
 
+# -- health plane (telemetry/history.py, telemetry/health.py) --------
+scrape_errors = _m.counter(
+    "mxtpu_scrape_errors_total",
+    "Fleet-scrape member fetches that failed (dead/unreachable member), "
+    "by member role:rank — aggregate.scrape() records the gap instead "
+    "of raising mid-walk")
+history_series = _m.gauge(
+    "mxtpu_history_series",
+    "Distinct (metric, label-key) series retained in the local "
+    "MetricHistory ring")
+history_series_dropped = _m.counter(
+    "mxtpu_history_series_dropped_total",
+    "New series rejected because the history held MXTPU_HISTORY_MAX_SERIES")
+health_level = _m.gauge(
+    "mxtpu_health_level",
+    "Current hysteresis-filtered level per health rule "
+    "(0=OK, 1=WARN, 2=PAGE)")
+health_transitions = _m.counter(
+    "mxtpu_health_transitions_total",
+    "Health-rule level transitions, by rule and destination level")
+health_evaluations = _m.counter(
+    "mxtpu_health_evaluations_total",
+    "HealthEvaluator.evaluate passes completed")
+
+
+def default_health_rules():
+    """The stock SLO rule pack, as declarative specs for
+    ``health.make_rule``.  Budgets/windows are env-tunable so a drill
+    (or an impatient operator) can compress the SRE-textbook windows;
+    see docs/ENV_VARS.md.  Returned fresh each call — mutate freely."""
+    import os
+
+    def _f(name, default):
+        try:
+            return float(os.environ.get(name, "") or default)
+        except ValueError:
+            return default
+
+    fast = _f("MXTPU_HEALTH_FAST_WINDOW", 300.0)
+    slow = _f("MXTPU_HEALTH_SLOW_WINDOW", 3600.0)
+    return [
+        # Google-SRE multiwindow burn rates: PAGE only when both the
+        # fast window (still burning NOW) and the slow window (enough
+        # budget already spent) agree.
+        {"type": "burn_rate", "name": "serving_shed_burn",
+         "numerator": "mxtpu_serving_shed_total",
+         "denominator": "mxtpu_serving_requests_total",
+         "budget": _f("MXTPU_HEALTH_SHED_BUDGET", 0.01),
+         "fast_window": fast, "slow_window": slow,
+         "warn_burn": 2.0, "page_burn": 10.0},
+        {"type": "burn_rate", "name": "rpc_retry_burn",
+         "numerator": "mxtpu_rpc_retries_total",
+         "denominator": "mxtpu_rpc_client_requests_total",
+         "budget": _f("MXTPU_HEALTH_RETRY_BUDGET", 0.01),
+         "fast_window": fast, "slow_window": slow,
+         "warn_burn": 2.0, "page_burn": 10.0},
+        {"type": "burn_rate", "name": "compile_cache_error_burn",
+         "numerator": "mxtpu_compile_cache_errors_total",
+         "denominator": ["mxtpu_compile_cache_hits_total",
+                         "mxtpu_compile_cache_misses_total"],
+         "budget": _f("MXTPU_HEALTH_CACHE_ERROR_BUDGET", 0.05),
+         "fast_window": fast, "slow_window": slow,
+         "warn_burn": 2.0, "page_burn": 10.0},
+        # Bursts / one-shot badness.
+        {"type": "threshold", "name": "guard_skip_burst",
+         "metric": "mxtpu_guard_skipped_steps_total", "source": "increase",
+         "window": fast, "warn": 1.0,
+         "page": _f("MXTPU_HEALTH_GUARD_SKIP_PAGE", 5.0)},
+        {"type": "threshold", "name": "watchdog_fired",
+         "metric": "mxtpu_watchdog_fires_total", "source": "increase",
+         "window": slow, "page": 1.0},
+        # Capacity.
+        {"type": "threshold", "name": "serving_occupancy_saturation",
+         "metric": "mxtpu_serving_batch_occupancy:p99", "source": "latest",
+         "warn": _f("MXTPU_HEALTH_OCCUPANCY_WARN", 0.9) *
+                 _f("MXTPU_SERVE_MAX_BATCH", 8)},
+        # Fleet consistency: ranks disagreeing on the membership epoch
+        # means someone is acting on a stale view.
+        {"type": "threshold", "name": "membership_epoch_stale",
+         "metric": "mxtpu_membership_epoch", "source": "latest",
+         "agg": "spread", "warn": 1.0, "fire_for": 3},
+        # Liveness + stragglers.
+        {"type": "absence", "name": "member_absent",
+         "for_seconds": _f("MXTPU_HEALTH_ABSENCE_SECONDS", 15.0)},
+        {"type": "skew", "name": "step_time_straggler",
+         "metric": "mxtpu_trainer_step_seconds:p99",
+         "warn_factor": _f("MXTPU_HEALTH_SKEW_WARN", 2.0),
+         "page_factor": _f("MXTPU_HEALTH_SKEW_PAGE", 4.0)},
+        {"type": "skew", "name": "batch_wait_straggler",
+         "metric": "mxtpu_dataloader_batch_wait_seconds:p99",
+         "warn_factor": _f("MXTPU_HEALTH_SKEW_WARN", 2.0),
+         "page_factor": _f("MXTPU_HEALTH_SKEW_PAGE", 4.0)},
+    ]
+
+
 # -- jax compile hook ------------------------------------------------
 # jax.monitoring calls duration listeners for every instrumented event;
 # we fold the XLA backend-compile ones into the trainer_jit_* counters.

@@ -13,6 +13,8 @@ debugging — no dependencies, daemon threads only, loopback by default:
     /tracez     recent finished spans (tracing's bounded ring)
     /threadz    all-thread stack dump (watchdog.format_thread_stacks)
     /flightz    flight-recorder ring contents
+    /alertz     health-plane verdict + rule config (JSON;
+                ``?format=text`` renders the human screen)
 
 Opt-in via ``MXTPU_DEBUGZ_PORT`` (0 = auto-bind a free port; the bound
 address is printed to stderr) — ``start_from_env()`` is a no-op when
@@ -63,6 +65,8 @@ def status_dict():
            "uptime_s": round(time.time() - _state["start_ts"], 3)}
     from . import metrics as _m
     out["telemetry_enabled"] = _m.enabled()
+    from . import health as _health
+    out["health"] = _health.statusz_entry()
     with _lock:
         entries = list(_status.items())
     for key, value in entries:
@@ -83,7 +87,7 @@ def _index():
     lines = ["mxtpu debugz (role=%s rank=%s pid=%d)" %
              (_state["role"], _state["rank"], os.getpid()), ""]
     lines += ["/metrics", "/metrics.json", "/statusz", "/tracez",
-              "/threadz", "/flightz", ""]
+              "/threadz", "/flightz", "/alertz", ""]
     return "\n".join(lines)
 
 
@@ -130,6 +134,16 @@ class _Handler(BaseHTTPRequestHandler):
                                    "events": flight.events()},
                                   indent=2, default=str)
                 ctype = "application/json"
+            elif path == "/alertz":
+                from . import health
+                query = self.path.partition("?")[2]
+                if "format=text" in query:
+                    body = health.render_text()
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    body = json.dumps(health.alertz_dict(), indent=2,
+                                      default=str)
+                    ctype = "application/json"
             else:
                 status, body, ctype = 404, "not found: %s\n" % path, "text/plain"
         except Exception:  # mxlint: disable=broad-except — the traceback IS the 500 body; a debug endpoint never kills its server

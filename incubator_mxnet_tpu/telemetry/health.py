@@ -1,0 +1,582 @@
+"""Declarative SLO health evaluation over the metric history ring.
+
+``HealthEvaluator`` runs a pack of rules (declarative dict specs or
+``Rule`` instances) against a :class:`~.history.MetricHistory` and
+maintains a hysteresis-filtered level per rule — OK → WARN → PAGE —
+plus a machine-readable ``verdict()`` consumed by the ``/alertz``
+debugz endpoint, the ``/statusz`` health section, the mxtop alerts
+panel and ``tools/healthcheck.py`` (which exits nonzero exactly when
+a PAGE rule is firing).
+
+Rule types:
+
+``threshold``
+    Compare a series (latest value, or windowed rate/increase for
+    counters) against warn/page bounds; series matching the key
+    filter are aggregated with max|min|sum|spread (spread = max-min,
+    the stale-epoch detector).
+``burn_rate``
+    Google-SRE multiwindow error-budget burn: burn(w) =
+    (err_increase(w) / total_increase(w)) / budget.  PAGE only when
+    BOTH the fast window (still burning now) and the slow window
+    (meaningful budget already spent) exceed ``page_burn``; WARN when
+    both exceed ``warn_burn``.
+``absence``
+    A scraped member stopped reporting: its latest scrape fetch
+    failed or its last successful scrape is older than
+    ``for_seconds``.
+``skew``
+    Cross-rank straggler: any rank whose per-rank series value (e.g.
+    a step-time p99) exceeds the fleet median by ``warn_factor`` /
+    ``page_factor``.
+
+Transitions pass through per-rule hysteresis (``fire_for`` consecutive
+breaching evaluations to raise, ``clear_for`` to lower) and are
+recorded into the flight recorder (``health.firing`` /
+``health.resolved``) and the ``mxtpu_health_*`` catalog instruments.
+
+Disabled (the default) the module-level ``tick()`` hook is one
+predicate check — gated by tests/test_telemetry_overhead.py.  Enable
+with ``MXTPU_HEALTH=1`` (installs the default rule pack from
+``catalog.default_health_rules()`` and starts an evaluation loop at
+``MXTPU_HEALTH_INTERVAL`` seconds) or ``health.install()``.
+"""
+
+import os
+import threading
+import time
+
+from . import history as _history
+
+__all__ = ["OK", "WARN", "PAGE", "Rule", "ThresholdRule", "BurnRateRule",
+           "AbsenceRule", "SkewRule", "make_rule", "HealthEvaluator",
+           "install", "uninstall", "evaluator", "enabled", "tick",
+           "verdict", "statusz_entry", "alertz_dict", "render_text",
+           "start_loop", "stop_loop"]
+
+OK, WARN, PAGE = "OK", "WARN", "PAGE"
+LEVEL_NUM = {OK: 0, WARN: 1, PAGE: 2}
+
+_state = {"enabled": False, "evaluator": None, "thread": None, "stop": None}
+_lock = threading.Lock()
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# -- rules -------------------------------------------------------------
+
+class Rule:
+    """Base: subclasses implement raw_level(history, now) ->
+    (level, value, detail) — the INSTANTANEOUS reading; hysteresis is
+    the evaluator's job."""
+
+    type = "rule"
+
+    def __init__(self, name, fire_for=1, clear_for=2):
+        self.name = name
+        self.fire_for = max(1, int(fire_for))
+        self.clear_for = max(1, int(clear_for))
+
+    def raw_level(self, history, now):
+        raise NotImplementedError
+
+    def describe(self):
+        d = {"name": self.name, "type": self.type,
+             "fire_for": self.fire_for, "clear_for": self.clear_for}
+        d.update(self._params())
+        return d
+
+    def _params(self):
+        return {}
+
+
+def _match_keys(history, metric, key_filter):
+    keys = history.keys(metric)
+    if key_filter:
+        keys = [k for k in keys if key_filter in k]
+    return keys
+
+
+class ThresholdRule(Rule):
+    type = "threshold"
+
+    def __init__(self, name, metric, key="", source="latest", window=300.0,
+                 warn=None, page=None, op=">", agg="max", **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.key = key
+        self.source = source        # latest | rate | increase
+        self.window = float(window)
+        self.warn = warn
+        self.page = page
+        self.op = op                # ">" or "<"
+        self.agg = agg              # max | min | sum | spread
+
+    def _params(self):
+        return {"metric": self.metric, "key": self.key,
+                "source": self.source, "window": self.window,
+                "warn": self.warn, "page": self.page,
+                "op": self.op, "agg": self.agg}
+
+    def _read(self, history, key, now):
+        if self.source == "rate":
+            return history.rate(self.metric, key, self.window, now)
+        if self.source == "increase":
+            return history.increase(self.metric, key, self.window, now)
+        return history.latest(self.metric, key)
+
+    def _breach(self, value, bound):
+        if bound is None or value is None:
+            return False
+        return value < bound if self.op == "<" else value > bound
+
+    def raw_level(self, history, now):
+        values = {}
+        for key in _match_keys(history, self.metric, self.key):
+            v = self._read(history, key, now)
+            if v is not None:
+                values[key] = v
+        if not values:
+            return OK, None, {"reason": "no data"}
+        vs = list(values.values())
+        if self.agg == "spread":
+            value = max(vs) - min(vs)
+        elif self.agg == "sum":
+            value = sum(vs)
+        elif self.agg == "min":
+            value = min(vs)
+        else:
+            value = max(vs)
+        detail = {"agg": self.agg, "series": len(values)}
+        if self._breach(value, self.page):
+            return PAGE, value, detail
+        if self._breach(value, self.warn):
+            return WARN, value, detail
+        return OK, value, detail
+
+
+class BurnRateRule(Rule):
+    type = "burn_rate"
+
+    def __init__(self, name, numerator, denominator, budget=0.01,
+                 fast_window=300.0, slow_window=3600.0,
+                 warn_burn=2.0, page_burn=10.0, key="",
+                 min_denominator=1.0, **kw):
+        super().__init__(name, **kw)
+        self.numerator = [numerator] if isinstance(numerator, str) \
+            else list(numerator)
+        self.denominator = [denominator] if isinstance(denominator, str) \
+            else list(denominator)
+        self.budget = float(budget)
+        self.fast_window = float(fast_window)
+        self.slow_window = float(slow_window)
+        self.warn_burn = float(warn_burn)
+        self.page_burn = float(page_burn)
+        self.key = key
+        self.min_denominator = float(min_denominator)
+
+    def _params(self):
+        return {"numerator": self.numerator,
+                "denominator": self.denominator, "budget": self.budget,
+                "fast_window": self.fast_window,
+                "slow_window": self.slow_window,
+                "warn_burn": self.warn_burn, "page_burn": self.page_burn,
+                "key": self.key, "min_denominator": self.min_denominator}
+
+    def _sum_increase(self, history, metrics, window, now):
+        total, saw = 0.0, False
+        for metric in metrics:
+            for key in _match_keys(history, metric, self.key):
+                inc = history.increase(metric, key, window, now)
+                if inc is not None:
+                    total += inc
+                    saw = True
+        return total if saw else None
+
+    def burn(self, history, window, now):
+        """Burn multiple over one window, or None without enough data
+        (denominator missing or below min_denominator)."""
+        den = self._sum_increase(history, self.denominator, window, now)
+        if den is None or den < self.min_denominator:
+            return None
+        num = self._sum_increase(history, self.numerator, window, now) or 0.0
+        if self.budget <= 0:
+            return None
+        return (num / den) / self.budget
+
+    def raw_level(self, history, now):
+        fast = self.burn(history, self.fast_window, now)
+        slow = self.burn(history, self.slow_window, now)
+        detail = {"fast_burn": fast, "slow_burn": slow,
+                  "budget": self.budget}
+        if fast is None or slow is None:
+            return OK, fast, dict(detail, reason="no data")
+        if fast >= self.page_burn and slow >= self.page_burn:
+            return PAGE, fast, detail
+        if fast >= self.warn_burn and slow >= self.warn_burn:
+            return WARN, fast, detail
+        return OK, fast, detail
+
+
+class AbsenceRule(Rule):
+    type = "absence"
+
+    def __init__(self, name, roles=None, for_seconds=15.0, **kw):
+        super().__init__(name, **kw)
+        self.roles = set(roles) if roles else None
+        self.for_seconds = float(for_seconds)
+
+    def _params(self):
+        return {"roles": sorted(self.roles) if self.roles else None,
+                "for_seconds": self.for_seconds}
+
+    def raw_level(self, history, now):
+        members = history.members()
+        if not members:
+            return OK, 0, {"reason": "no scrapes recorded"}
+        absent = []
+        for key, rec in sorted(members.items()):
+            if self.roles and rec.get("role") not in self.roles:
+                continue
+            last_ok = rec.get("last_ok")
+            if rec.get("ok") is False or last_ok is None:
+                absent.append({"member": key, "error": rec.get("error"),
+                               "last_ok": last_ok})
+            elif now - last_ok > self.for_seconds:
+                absent.append({"member": key, "last_ok": last_ok,
+                               "stale_seconds": now - last_ok})
+        if absent:
+            return PAGE, len(absent), {"absent": absent}
+        return OK, 0, {"members": len(members)}
+
+
+class SkewRule(Rule):
+    type = "skew"
+
+    def __init__(self, name, metric, key="", warn_factor=2.0,
+                 page_factor=4.0, min_members=3, min_value=1e-4, **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.key = key
+        self.warn_factor = float(warn_factor)
+        self.page_factor = float(page_factor)
+        self.min_members = int(min_members)
+        self.min_value = float(min_value)
+
+    def _params(self):
+        return {"metric": self.metric, "key": self.key,
+                "warn_factor": self.warn_factor,
+                "page_factor": self.page_factor,
+                "min_members": self.min_members,
+                "min_value": self.min_value}
+
+    @staticmethod
+    def _rank_of(key):
+        for part in key.split(","):
+            if part.startswith("rank="):
+                return part[5:]
+        return None
+
+    def raw_level(self, history, now):
+        # one value per rank: the worst matching series under that rank
+        per_rank = {}
+        for key in _match_keys(history, self.metric, self.key):
+            rank = self._rank_of(key)
+            if rank is None:
+                continue
+            v = history.latest(self.metric, key)
+            if v is None:
+                continue
+            per_rank[rank] = max(per_rank.get(rank, 0.0), v)
+        if len(per_rank) < self.min_members:
+            return OK, None, {"reason": "fewer than %d ranks reporting"
+                              % self.min_members, "ranks": len(per_rank)}
+        vals = sorted(per_rank.values())
+        mid = len(vals) // 2
+        median = vals[mid] if len(vals) % 2 else \
+            0.5 * (vals[mid - 1] + vals[mid])
+        floor = max(median, self.min_value)
+        worst_rank = max(per_rank, key=per_rank.get)
+        worst = per_rank[worst_rank]
+        factor = worst / floor if floor > 0 else 0.0
+        detail = {"median": median, "worst_rank": worst_rank,
+                  "worst": worst, "factor": factor,
+                  "ranks": len(per_rank)}
+        if worst > self.min_value and factor >= self.page_factor:
+            return PAGE, factor, detail
+        if worst > self.min_value and factor >= self.warn_factor:
+            return WARN, factor, detail
+        return OK, factor, detail
+
+
+_RULE_TYPES = {"threshold": ThresholdRule, "burn_rate": BurnRateRule,
+               "absence": AbsenceRule, "skew": SkewRule}
+
+
+def make_rule(spec):
+    """Declarative dict spec -> Rule (already-built rules pass through)."""
+    if isinstance(spec, Rule):
+        return spec
+    spec = dict(spec)
+    kind = spec.pop("type")
+    try:
+        cls = _RULE_TYPES[kind]
+    except KeyError:
+        raise ValueError("unknown health rule type %r (have %s)"
+                         % (kind, sorted(_RULE_TYPES))) from None
+    return cls(**spec)
+
+
+# -- evaluator ---------------------------------------------------------
+
+class HealthEvaluator:
+    """Evaluates a rule pack against a MetricHistory with OK→WARN→PAGE
+    hysteresis; transitions hit the flight recorder and the
+    mxtpu_health_* instruments."""
+
+    def __init__(self, history, rules=None):
+        self.history = history
+        self.rules = [make_rule(r) for r in (rules if rules is not None
+                                             else [])]
+        self._lock = threading.Lock()
+        self._state = {}
+        for rule in self.rules:
+            self._state[rule.name] = {
+                "level": OK, "raw": OK, "since": None, "value": None,
+                "detail": None, "pending": None, "pending_n": 0,
+                "error": None}
+        self._last_eval_ts = None
+
+    def _transition(self, rule, st, new, now, value):
+        from . import catalog as _cat
+        from . import flight as _flight
+        prev = st["level"]
+        st["level"], st["since"] = new, now
+        st["pending"], st["pending_n"] = None, 0
+        _cat.health_level.set(LEVEL_NUM[new], rule=rule.name)
+        _cat.health_transitions.inc(rule=rule.name, to=new)
+        event = "health.firing" if LEVEL_NUM[new] > LEVEL_NUM[prev] \
+            else "health.resolved"
+        _flight.record(event, rule=rule.name, level=new, prev=prev,
+                       value=value)
+
+    def evaluate(self, now=None):
+        """One evaluation pass; returns the verdict dict."""
+        from . import catalog as _cat
+        now = now if now is not None else time.time()
+        with self._lock:
+            for rule in self.rules:
+                st = self._state[rule.name]
+                try:
+                    raw, value, detail = rule.raw_level(self.history, now)
+                    st["error"] = None
+                except Exception as exc:  # noqa: BLE001 — a broken rule
+                    # must not take down the plane that reports breakage
+                    raw, value, detail = OK, None, None
+                    st["error"] = "%s: %s" % (type(exc).__name__, exc)
+                st["raw"], st["value"], st["detail"] = raw, value, detail
+                cur = st["level"]
+                if raw == cur:
+                    st["pending"], st["pending_n"] = None, 0
+                    continue
+                if st["pending"] == raw:
+                    st["pending_n"] += 1
+                else:
+                    st["pending"], st["pending_n"] = raw, 1
+                need = rule.fire_for if LEVEL_NUM[raw] > LEVEL_NUM[cur] \
+                    else rule.clear_for
+                if st["pending_n"] >= need:
+                    self._transition(rule, st, raw, now, value)
+            self._last_eval_ts = now
+        _cat.health_evaluations.inc()
+        return self.verdict(now)
+
+    def verdict(self, now=None):
+        """Machine-readable overall verdict of the LAST evaluation:
+        ``{ok, level, ts, firing: [...], rules: {...}}`` — ``ok`` is
+        True iff every rule sits at OK; healthcheck pages on
+        ``level == "PAGE"``."""
+        now = now if now is not None else time.time()
+        with self._lock:
+            rules, firing = {}, []
+            worst = OK
+            for rule in self.rules:
+                st = self._state[rule.name]
+                entry = {"type": rule.type, "level": st["level"],
+                         "raw": st["raw"], "since": st["since"],
+                         "value": st["value"], "detail": st["detail"]}
+                if st["error"]:
+                    entry["error"] = st["error"]
+                rules[rule.name] = entry
+                if LEVEL_NUM[st["level"]] > LEVEL_NUM[worst]:
+                    worst = st["level"]
+                if st["level"] != OK:
+                    firing.append(dict(entry, rule=rule.name))
+            firing.sort(key=lambda e: -LEVEL_NUM[e["level"]])
+            return {"ok": worst == OK, "level": worst, "ts": now,
+                    "last_eval_ts": self._last_eval_ts,
+                    "firing": firing, "rules": rules}
+
+    def describe(self):
+        return [r.describe() for r in self.rules]
+
+
+# -- module-level singleton -------------------------------------------
+
+def enabled():
+    return _state["enabled"]
+
+
+def evaluator():
+    """The installed HealthEvaluator, or None."""
+    return _state["evaluator"]
+
+
+def install(rules=None, history=None):
+    """Install (and enable) the process-wide evaluator.  ``rules``
+    defaults to ``catalog.default_health_rules()``; ``history``
+    defaults to the module-level history (enabling that plane too —
+    rules are useless over an empty ring)."""
+    from . import catalog as _cat
+    if rules is None:
+        rules = _cat.default_health_rules()
+    if history is None:
+        _history.enable()
+        history = _history.default()
+    ev = HealthEvaluator(history, rules)
+    with _lock:
+        _state["evaluator"] = ev
+        _state["enabled"] = True
+    return ev
+
+
+def uninstall():
+    stop_loop()
+    with _lock:
+        _state["evaluator"] = None
+        _state["enabled"] = False
+
+
+def tick(now=None):
+    """Sample the local registry and run one evaluation — the hook a
+    serving/training loop may call inline.  One predicate check when
+    the plane is disabled."""
+    if not _state["enabled"]:
+        return None
+    ev = _state["evaluator"]
+    if ev is None:
+        return None
+    if ev.history is _history.default():
+        _history.sample_local()
+    else:
+        ev.history.record_registry()
+    return ev.evaluate(now)
+
+
+def verdict():
+    """Last verdict, or a stub when the plane is disabled."""
+    ev = _state["evaluator"]
+    if not _state["enabled"] or ev is None:
+        return {"ok": True, "level": OK, "enabled": False,
+                "firing": [], "rules": {}}
+    return ev.verdict()
+
+
+def statusz_entry():
+    """The ``health`` section of /statusz — constant-cheap when the
+    plane is disabled."""
+    if not _state["enabled"]:
+        return {"enabled": False}
+    v = verdict()
+    return {"enabled": True, "level": v["level"], "ok": v["ok"],
+            "firing": [e["rule"] for e in v["firing"]],
+            "last_eval_ts": v.get("last_eval_ts")}
+
+
+def alertz_dict():
+    """Full /alertz payload: verdict + rule configuration."""
+    ev = _state["evaluator"]
+    out = {"enabled": _state["enabled"], "verdict": verdict()}
+    if ev is not None:
+        out["config"] = ev.describe()
+    return out
+
+
+def render_text(v=None):
+    """Human one-screen rendering of a verdict (``/alertz?format=text``
+    and tools/healthcheck.py --text)."""
+    v = v if v is not None else verdict()
+    lines = ["health: %s%s" % (v["level"],
+                               "" if v.get("enabled", True) else
+                               " (plane disabled)")]
+    for e in v.get("firing", []):
+        val = e.get("value")
+        val_s = "%.4g" % val if isinstance(val, (int, float)) else "-"
+        lines.append("  [%s] %-28s %-10s value=%s since=%s"
+                     % (e["level"], e["rule"], e.get("type", ""), val_s,
+                        time.strftime("%H:%M:%S",
+                                      time.localtime(e["since"]))
+                        if e.get("since") else "-"))
+        detail = e.get("detail")
+        if detail:
+            parts = []
+            for k, dv in sorted(detail.items()):
+                if isinstance(dv, float):
+                    parts.append("%s=%.4g" % (k, dv))
+                elif isinstance(dv, (str, int)):
+                    parts.append("%s=%s" % (k, dv))
+            if parts:
+                lines.append("        " + " ".join(parts[:8]))
+    if not v.get("firing"):
+        lines.append("  all %d rules OK" % len(v.get("rules", {})))
+    return "\n".join(lines) + "\n"
+
+
+# -- background loop ---------------------------------------------------
+
+def start_loop(interval=None):
+    """Daemon thread: sample local registry + evaluate every
+    ``interval`` seconds (default MXTPU_HEALTH_INTERVAL=15)."""
+    with _lock:
+        if _state["thread"] is not None:
+            return _state["thread"]
+        if interval is None:
+            interval = _env_float("MXTPU_HEALTH_INTERVAL", 15.0)
+        stop = threading.Event()
+
+        def _loop():
+            while not stop.wait(interval):
+                try:
+                    tick()
+                except Exception:   # noqa: BLE001 — the health loop
+                    pass            # must outlive transient errors
+
+        t = threading.Thread(target=_loop, name="mxtpu-health-loop",
+                             daemon=True)
+        _state["thread"], _state["stop"] = t, stop
+        t.start()
+        return t
+
+
+def stop_loop():
+    with _lock:
+        stop, t = _state["stop"], _state["thread"]
+        _state["thread"] = _state["stop"] = None
+    if stop is not None:
+        stop.set()
+    if t is not None:
+        t.join(timeout=5)
+
+
+def _init_from_env():
+    if os.environ.get("MXTPU_HEALTH", "") in ("1", "true", "on"):
+        install()
+        start_loop()
+
+
+_init_from_env()

@@ -1,0 +1,316 @@
+"""Bounded in-memory metric history: the ring TSDB under the health plane.
+
+``MetricHistory`` retains periodic samples of every counter/gauge —
+and per-quantile derivations of every histogram — from the local
+registry (``record_registry``) and from fleet scrapes
+(``record_scrape``, series keys already carry ``role=``/``rank=``
+labels).  ``telemetry/health.py`` evaluates SLO rules over it: burn
+rates need windowed counter increases, skew rules need per-rank
+quantiles, absence rules need per-member last-seen timestamps.
+
+Histogram series are decomposed into scalar sub-series named
+``<metric>:count``, ``<metric>:sum`` and ``<metric>:p<Q>`` (one per
+configured quantile), so every retained series is a plain
+``(timestamp, float)`` ring and rules address quantiles by name.
+
+Everything is bounded: ``MXTPU_HISTORY_MAX_SAMPLES`` per series (ring),
+``MXTPU_HISTORY_MAX_SERIES`` distinct series (new series beyond the cap
+are dropped and counted).  Disabled (the default) the module-level
+``sample_local()`` hook is one predicate check — gated by
+tests/test_telemetry_overhead.py.  Enable with ``MXTPU_HISTORY=1``
+(which also starts a daemon sampler at ``MXTPU_HISTORY_INTERVAL``
+seconds) or ``history.enable()``.
+"""
+
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ["MetricHistory", "default", "enable", "disable", "enabled",
+           "sample_local", "start_sampler", "stop_sampler", "reset"]
+
+_state = {"enabled": False, "default": None, "thread": None, "stop": None}
+_lock = threading.Lock()
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_quantiles():
+    raw = os.environ.get("MXTPU_HISTORY_QUANTILES", "0.5,0.99")
+    out = []
+    for part in raw.split(","):
+        try:
+            q = float(part)
+        except ValueError:
+            continue
+        if 0.0 <= q <= 1.0:
+            out.append(q)
+    return tuple(out) or (0.5, 0.99)
+
+
+def quantile_suffix(q):
+    """``0.99`` -> ``p99``, ``0.5`` -> ``p50``, ``0.999`` -> ``p99.9``."""
+    pct = q * 100.0
+    if pct == int(pct):
+        return "p%d" % int(pct)
+    return "p%g" % pct
+
+
+class MetricHistory:
+    """Ring-buffered samples of scalar series keyed (name, label-key)."""
+
+    def __init__(self, max_samples=None, max_series=None, quantiles=None):
+        self.max_samples = max_samples or _env_int(
+            "MXTPU_HISTORY_MAX_SAMPLES", 512)
+        self.max_series = max_series or _env_int(
+            "MXTPU_HISTORY_MAX_SERIES", 8192)
+        self.quantiles = tuple(quantiles) if quantiles is not None \
+            else _env_quantiles()
+        self._lock = threading.Lock()
+        self._data = {}       # name -> {key: deque[(ts, value)]}
+        self._n_series = 0
+        self._members = {}    # "role=R,rank=K" -> member record
+        self._last_ts = None
+
+    # -- recording -----------------------------------------------------
+
+    def _append_locked(self, name, key, ts, value):
+        if not isinstance(value, (int, float)):
+            return
+        by_key = self._data.get(name)
+        if by_key is None:
+            by_key = self._data[name] = {}
+        ring = by_key.get(key)
+        if ring is None:
+            if self._n_series >= self.max_series:
+                from . import catalog as _cat
+                _cat.history_series_dropped.inc()
+                return
+            ring = by_key[key] = deque(maxlen=self.max_samples)
+            self._n_series += 1
+        ring.append((ts, float(value)))
+
+    def _record_instrument_locked(self, name, inst, ts):
+        from . import aggregate as _agg
+        kind = inst.get("kind")
+        for key, value in (inst.get("series") or {}).items():
+            if kind == "histogram" or isinstance(value, dict):
+                self._append_locked(name + ":count", key, ts,
+                             value.get("count") or 0)
+                self._append_locked(name + ":sum", key, ts, value.get("sum") or 0.0)
+                for q in self.quantiles:
+                    qv = _agg.hist_quantile(value, q)
+                    if qv is not None:
+                        self._append_locked("%s:%s" % (name, quantile_suffix(q)),
+                                     key, ts, qv)
+            else:
+                self._append_locked(name, key, ts, value)
+
+    def record_registry(self, snap=None, ts=None):
+        """Sample a registry snapshot (default: the local process's)."""
+        if snap is None:
+            from . import metrics as _m
+            snap = _m.snapshot()
+        ts = ts if ts is not None else time.time()
+        with self._lock:
+            for name, inst in (snap or {}).items():
+                self._record_instrument_locked(name, inst, ts)
+            self._last_ts = ts
+
+    def record_scrape(self, scrape, ts=None):
+        """Sample one ``aggregate.scrape()`` result: the merged
+        role/rank-labeled registry plus per-member liveness."""
+        ts = ts if ts is not None else time.time()
+        with self._lock:
+            for name, inst in (scrape.get("registry") or {}).items():
+                self._record_instrument_locked(name, inst, ts)
+            for m in scrape.get("members") or []:
+                key = "role=%s,rank=%s" % (m.get("role"), m.get("rank"))
+                rec = self._members.get(key)
+                if rec is None:
+                    rec = self._members[key] = {
+                        "role": m.get("role"), "rank": m.get("rank"),
+                        "addr": m.get("addr"), "first_seen": ts,
+                        "last_ok": None, "ok": None, "error": None}
+                rec["ok"] = bool(m.get("ok"))
+                rec["error"] = m.get("error")
+                rec["addr"] = m.get("addr") or rec["addr"]
+                if m.get("ok"):
+                    rec["last_ok"] = ts
+            if scrape.get("epoch") is not None:
+                self._append_locked("mxtpu_membership_epoch_scraped", "", ts,
+                             scrape["epoch"])
+            self._last_ts = ts
+
+    # -- reading -------------------------------------------------------
+
+    def names(self):
+        with self._lock:
+            return sorted(self._data)
+
+    def keys(self, name):
+        with self._lock:
+            return sorted(self._data.get(name) or ())
+
+    def series(self, name, key=""):
+        with self._lock:
+            ring = (self._data.get(name) or {}).get(key)
+            return list(ring) if ring else []
+
+    def latest(self, name, key=""):
+        with self._lock:
+            ring = (self._data.get(name) or {}).get(key)
+            return ring[-1][1] if ring else None
+
+    def last_ts(self):
+        with self._lock:
+            return self._last_ts
+
+    def increase(self, name, key="", window=60.0, now=None):
+        """Counter increase over the trailing window, reset-aware: a
+        sample lower than its predecessor counts from zero (process
+        restart), matching prometheus ``increase()`` semantics.  None
+        with fewer than two samples in the window."""
+        now = now if now is not None else time.time()
+        samples = [s for s in self.series(name, key)
+                   if now - window <= s[0] <= now]
+        if len(samples) < 2:
+            return None
+        total = 0.0
+        for (_, prev), (_, cur) in zip(samples, samples[1:]):
+            total += cur - prev if cur >= prev else cur
+        return total
+
+    def rate(self, name, key="", window=60.0, now=None):
+        """increase / window, per second (None with insufficient data)."""
+        inc = self.increase(name, key, window, now)
+        if inc is None:
+            return None
+        return inc / window if window > 0 else None
+
+    def members(self):
+        """Per-member liveness from recorded scrapes:
+        ``{"role=R,rank=K": {role, rank, addr, first_seen, last_ok, ok,
+        error}}`` — evicted/dead members are retained (that gap is the
+        absence signal)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._members.items()}
+
+    def stats(self):
+        with self._lock:
+            return {"series": self._n_series,
+                    "max_samples": self.max_samples,
+                    "max_series": self.max_series,
+                    "members": len(self._members),
+                    "last_ts": self._last_ts}
+
+    def clear(self):
+        with self._lock:
+            self._data.clear()
+            self._members.clear()
+            self._n_series = 0
+            self._last_ts = None
+
+
+# -- module-level default instance ------------------------------------
+
+def enable():
+    _state["enabled"] = True
+
+
+def disable():
+    _state["enabled"] = False
+
+
+def enabled():
+    return _state["enabled"]
+
+
+def default():
+    """The process-wide MetricHistory (created on first use), or None
+    while the history plane is disabled — one predicate on the off
+    path."""
+    if not _state["enabled"]:
+        return None
+    hist = _state["default"]
+    if hist is None:
+        with _lock:
+            hist = _state["default"]
+            if hist is None:
+                hist = _state["default"] = MetricHistory()
+    return hist
+
+
+def sample_local():
+    """Record one local-registry sample into the default history.
+    One predicate check when the plane is disabled."""
+    if not _state["enabled"]:
+        return None
+    hist = default()
+    hist.record_registry()
+    from . import catalog as _cat
+    _cat.history_series.set(hist.stats()["series"])
+    return hist
+
+
+def reset():
+    """Drop the default history's retained data (keeps enablement)."""
+    hist = _state["default"]
+    if hist is not None:
+        hist.clear()
+
+
+def start_sampler(interval=None):
+    """Daemon thread sampling the local registry every ``interval``
+    seconds (default MXTPU_HISTORY_INTERVAL=10).  Idempotent."""
+    with _lock:
+        if _state["thread"] is not None:
+            return _state["thread"]
+        if interval is None:
+            interval = _env_float("MXTPU_HISTORY_INTERVAL", 10.0)
+        stop = threading.Event()
+
+        def _loop():
+            while not stop.wait(interval):
+                try:
+                    sample_local()
+                except Exception:   # noqa: BLE001 — the sampler must
+                    pass            # outlive any transient snapshot error
+
+        t = threading.Thread(target=_loop, name="mxtpu-history-sampler",
+                             daemon=True)
+        _state["thread"], _state["stop"] = t, stop
+        t.start()
+        return t
+
+
+def stop_sampler():
+    with _lock:
+        stop, t = _state["stop"], _state["thread"]
+        _state["thread"] = _state["stop"] = None
+    if stop is not None:
+        stop.set()
+    if t is not None:
+        t.join(timeout=5)
+
+
+def _init_from_env():
+    if os.environ.get("MXTPU_HISTORY", "") in ("1", "true", "on"):
+        enable()
+        start_sampler()
+
+
+_init_from_env()

@@ -30,6 +30,51 @@ def pytest_configure(config):
         "known-flaky long drill (deselected by -m 'not slow'; the tier-1 "
         "'not slow' set itself needs ~2400s on the CI box — see the "
         "verify command in ROADMAP.md)")
+    config.addinivalue_line(
+        "markers", "needs_shard_map: exercises a manual-mesh region and "
+        "requires shard_map in the installed jax (resolved through "
+        "incubator_mxnet_tpu.compat — top-level or experimental spelling); "
+        "skipped with one shared reason when neither exists")
+    config.addinivalue_line(
+        "markers", "needs_shard_map_partial: the region leaves some mesh "
+        "axes automatic (axis_names ⊂ mesh axes); the old experimental "
+        "shard_map aborts XLA natively on that, so compat refuses it and "
+        "these skip unless compat.SHARD_MAP_PARTIAL")
+    config.addinivalue_line(
+        "markers", "needs_multiprocess_cpu: drives a multi-process mesh on "
+        "the CPU backend, which old jaxlibs reject outright; skipped "
+        "unless compat.MULTIPROCESS_CPU")
+
+
+def pytest_collection_modifyitems(config, items):
+    from incubator_mxnet_tpu import compat
+    skip_all = skip_partial = None
+    if not compat.HAS_SHARD_MAP:
+        skip_all = pytest.mark.skip(
+            reason="installed jax has neither jax.shard_map nor "
+                   "jax.experimental.shard_map.shard_map "
+                   "(see incubator_mxnet_tpu/compat.py)")
+    if not compat.SHARD_MAP_PARTIAL:
+        skip_partial = pytest.mark.skip(
+            reason="installed jax only has the old experimental shard_map, "
+                   "whose partial-manual (auto=) lowering aborts XLA "
+                   "(see incubator_mxnet_tpu/compat.py)")
+    skip_multiproc = None
+    if not compat.MULTIPROCESS_CPU:
+        skip_multiproc = pytest.mark.skip(
+            reason="installed jaxlib rejects multi-process computations "
+                   "on the CPU backend (see incubator_mxnet_tpu/compat.py)")
+    if skip_all is None and skip_partial is None and skip_multiproc is None:
+        return
+    for item in items:
+        if skip_all is not None and item.get_closest_marker("needs_shard_map"):
+            item.add_marker(skip_all)
+        elif skip_partial is not None and \
+                item.get_closest_marker("needs_shard_map_partial"):
+            item.add_marker(skip_partial)
+        if skip_multiproc is not None and \
+                item.get_closest_marker("needs_multiprocess_cpu"):
+            item.add_marker(skip_multiproc)
 
 
 @pytest.fixture(autouse=True)

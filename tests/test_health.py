@@ -1,0 +1,745 @@
+"""Fleet health plane: metrics history, SLO burn-rate evaluation,
+straggler detection, and the machine-readable verdict API.
+
+- MetricHistory: histogram decomposition, bounded retention,
+  reset-aware increase/rate, member liveness from scrapes
+- rule units: threshold (incl. spread agg), multiwindow burn rate with
+  the natural OK→WARN→PAGE progression, absence within one evaluation,
+  cross-rank skew
+- evaluator hysteresis (fire_for/clear_for) + flight-recorder
+  firing/resolved transitions + catalog instruments
+- /alertz endpoint (JSON + text), /statusz health section
+- Histogram.quantile + aggregate.hist_quantile edge cases
+- scrape resilience: one dead member yields scrape_errors, not a raise
+- tools/healthcheck.py exit codes
+- two-process acceptance drill: a kv.push.delay + rpc.send.drop chaos
+  phase drives the retry burn rule OK→WARN→PAGE, visible in /alertz,
+  mxtop --once and the flight dump; a SIGKILL'd worker trips the
+  absence rule within one evaluation; healthcheck exits nonzero
+  exactly when a PAGE rule is firing
+"""
+
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import incubator_mxnet_tpu as mx  # noqa: F401 — forces the cpu mesh env
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.telemetry import (aggregate, catalog, debugz,
+                                           flight, health, history)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    """History/health are module singletons: leave every test with both
+    planes off and empty."""
+    yield
+    health.uninstall()
+    history.stop_sampler()
+    history.reset()
+    history.disable()
+    history._state["default"] = None
+
+
+# ------------------------------------------------------- MetricHistory
+
+def _snap_counter(name, series):
+    return {name: {"kind": "counter", "help": "", "series": series}}
+
+
+def test_history_decomposes_histograms_into_scalar_series():
+    telemetry.enable()
+    try:
+        h = telemetry.histogram("hist_hist_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.05, 0.5, 0.5):
+            h.observe(v, op="x")
+        hist = history.MetricHistory(quantiles=(0.5, 0.99))
+        hist.record_registry(ts=100.0)
+        assert hist.latest("hist_hist_seconds:count", "op=x") == 4
+        assert hist.latest("hist_hist_seconds:sum", "op=x") == \
+            pytest.approx(1.1)
+        p50 = hist.latest("hist_hist_seconds:p50", "op=x")
+        p99 = hist.latest("hist_hist_seconds:p99", "op=x")
+        assert p50 is not None and 0 < p50 <= 0.1
+        assert p99 is not None and 0.1 < p99 <= 1.0
+    finally:
+        telemetry.disable()
+
+
+def test_history_bounded_samples_and_series():
+    hist = history.MetricHistory(max_samples=4, max_series=2)
+    for i in range(10):
+        hist.record_registry(_snap_counter("a_total", {"": i}),
+                             ts=float(i))
+    assert len(hist.series("a_total")) == 4          # ring kept last 4
+    assert hist.series("a_total")[-1] == (9.0, 9.0)
+    hist.record_registry(_snap_counter("b_total", {"": 1}), ts=11.0)
+    before = catalog.history_series_dropped.value()
+    hist.record_registry(_snap_counter("c_total", {"": 1}), ts=12.0)
+    assert hist.latest("c_total") is None            # over max_series
+    assert hist.stats()["series"] == 2
+    # the drop is counted when telemetry is on
+    telemetry.enable()
+    try:
+        hist.record_registry(_snap_counter("d_total", {"": 1}), ts=13.0)
+        assert catalog.history_series_dropped.value() == before + 1
+    finally:
+        telemetry.disable()
+
+
+def test_history_increase_and_rate_are_reset_aware():
+    hist = history.MetricHistory()
+    for ts, v in ((0.0, 10), (10.0, 30), (20.0, 5), (30.0, 25)):
+        hist.record_registry(_snap_counter("r_total", {"": v}), ts=ts)
+    # 10->30 (+20), 30->5 (reset: +5), 5->25 (+20)
+    assert hist.increase("r_total", "", window=100, now=30.0) == 45.0
+    assert hist.rate("r_total", "", window=100, now=30.0) == \
+        pytest.approx(0.45)
+    # window clips to the last two samples
+    assert hist.increase("r_total", "", window=11, now=30.0) == 20.0
+    # one sample in window -> no data
+    assert hist.increase("r_total", "", window=5, now=30.0) is None
+
+
+def test_history_members_track_liveness_from_scrapes():
+    hist = history.MetricHistory()
+    scrape = {"epoch": 3, "members": [
+        {"role": "worker", "rank": 0, "addr": "h:1", "ok": True},
+        {"role": "server", "rank": 0, "addr": "h:2", "ok": True}],
+        "registry": {}}
+    hist.record_scrape(scrape, ts=100.0)
+    dead = {"epoch": 3, "members": [
+        {"role": "worker", "rank": 0, "addr": "h:1", "ok": False,
+         "error": "refused"},
+        {"role": "server", "rank": 0, "addr": "h:2", "ok": True}],
+        "registry": {}}
+    hist.record_scrape(dead, ts=110.0)
+    members = hist.members()
+    w = members["role=worker,rank=0"]
+    assert w["ok"] is False and w["last_ok"] == 100.0
+    assert w["error"] == "refused"
+    assert members["role=server,rank=0"]["last_ok"] == 110.0
+    assert hist.latest("mxtpu_membership_epoch_scraped") == 3
+
+
+# ---------------------------------------------------------- rule units
+
+def test_threshold_rule_latest_increase_and_spread():
+    hist = history.MetricHistory()
+    hist.record_registry(
+        _snap_counter("mxtpu_membership_epoch",
+                      {"role=worker,rank=0": 5, "role=worker,rank=1": 3}),
+        ts=100.0)
+    spread = health.ThresholdRule("stale", "mxtpu_membership_epoch",
+                                  agg="spread", warn=1.0)
+    level, value, _ = spread.raw_level(hist, 100.0)
+    assert (level, value) == (health.WARN, 2.0)
+
+    for ts, v in ((0.0, 0), (50.0, 2), (100.0, 8)):
+        hist.record_registry(_snap_counter("skips_total", {"": v}), ts=ts)
+    burst = health.ThresholdRule("burst", "skips_total",
+                                 source="increase", window=200,
+                                 warn=1.0, page=5.0)
+    level, value, _ = burst.raw_level(hist, 100.0)
+    assert (level, value) == (health.PAGE, 8.0)
+    # no data -> OK
+    level, _, detail = burst.raw_level(history.MetricHistory(), 100.0)
+    assert level == health.OK and detail["reason"] == "no data"
+
+
+def test_burn_rate_rule_multiwindow_progression():
+    """The SRE multiwindow gate produces OK → WARN → PAGE naturally as
+    the slow window fills with the error burst."""
+    hist = history.MetricHistory()
+    # 10 req/s throughout; retries start at t=10 at 8/s
+    for t in range(0, 31):
+        hist.record_registry(
+            _snap_counter("req_total", {"": 10 * t}), ts=float(t))
+        hist.record_registry(
+            _snap_counter("err_total", {"": 8 * max(0, t - 10)}),
+            ts=float(t))
+    rule = health.BurnRateRule("burn", "err_total", "req_total",
+                               budget=0.05, fast_window=3.0,
+                               slow_window=20.0, warn_burn=2.0,
+                               page_burn=10.0)
+    assert rule.raw_level(hist, 9.0)[0] == health.OK     # pre-burst
+    assert rule.raw_level(hist, 11.0)[0] == health.OK    # slow still cold
+    assert rule.raw_level(hist, 13.0)[0] == health.WARN  # fast hot, slow warm
+    level, value, detail = rule.raw_level(hist, 25.0)
+    assert level == health.PAGE                          # both windows hot
+    assert detail["fast_burn"] >= 10.0 and detail["slow_burn"] >= 10.0
+    # a denominator below min_denominator reads as no data
+    starving = health.BurnRateRule("b2", "err_total", "req_total",
+                                   budget=0.05, min_denominator=1e9)
+    assert starving.raw_level(hist, 25.0)[0] == health.OK
+
+
+def test_burn_rate_rule_sums_denominator_metric_list():
+    hist = history.MetricHistory()
+    for t in (0.0, 10.0):
+        hist.record_registry(_snap_counter("hits_total", {"": 5 * t}), ts=t)
+        hist.record_registry(_snap_counter("miss_total", {"": 5 * t}), ts=t)
+        hist.record_registry(_snap_counter("errs_total", {"": t}), ts=t)
+    rule = health.BurnRateRule("b", "errs_total",
+                               ["hits_total", "miss_total"], budget=0.1,
+                               fast_window=20.0, slow_window=20.0)
+    # 10 errs / 100 total = 0.1 ratio -> burn 1.0
+    assert rule.burn(hist, 20.0, 10.0) == pytest.approx(1.0)
+
+
+def test_absence_rule_fires_in_one_evaluation():
+    hist = history.MetricHistory()
+    hist.record_scrape({"members": [
+        {"role": "worker", "rank": 0, "ok": True}], "registry": {}},
+        ts=100.0)
+    rule = health.AbsenceRule("absent", for_seconds=15.0)
+    assert rule.raw_level(hist, 101.0)[0] == health.OK
+    # the very next scrape shows the member dead -> PAGE immediately
+    hist.record_scrape({"members": [
+        {"role": "worker", "rank": 0, "ok": False, "error": "refused"}],
+        "registry": {}}, ts=102.0)
+    level, n, detail = rule.raw_level(hist, 103.0)
+    assert (level, n) == (health.PAGE, 1)
+    assert detail["absent"][0]["member"] == "role=worker,rank=0"
+    # ... and a member silently gone stale trips via for_seconds
+    hist2 = history.MetricHistory()
+    hist2.record_scrape({"members": [
+        {"role": "worker", "rank": 0, "ok": True}], "registry": {}},
+        ts=100.0)
+    assert rule.raw_level(hist2, 120.0)[0] == health.PAGE
+
+
+def test_skew_rule_flags_straggler_rank():
+    def mk(v3):
+        hist = history.MetricHistory()
+        series = {"role=worker,rank=%d" % r:
+                  {"count": 10, "sum": 1.0,
+                   "buckets": {"0.1": 10, "0.2": 10, "0.4": 10,
+                               "0.8": 10}}
+                  for r in range(3)}
+        series["role=worker,rank=3"] = {
+            "count": 10, "sum": v3 * 10,
+            "buckets": {"0.1": 0, "0.2": 0, "0.4": 0,
+                        "0.8": 10 if v3 <= 0.8 else 0}}
+        hist.record_registry(
+            {"mxtpu_trainer_step_seconds":
+             {"kind": "histogram", "help": "", "series": series}},
+            ts=100.0)
+        return hist
+    rule = health.SkewRule("straggler",
+                           "mxtpu_trainer_step_seconds:p99",
+                           warn_factor=2.0, page_factor=6.0,
+                           min_members=3)
+    # ranks 0-2 p99 ~0.1; rank 3 all mass in (0.4, 0.8] -> p99 ~0.8
+    level, factor, detail = rule.raw_level(mk(0.6), 100.0)
+    assert level == health.PAGE and detail["worst_rank"] == "3"
+    assert factor >= 6.0
+    # below min_members: no verdict
+    few = history.MetricHistory()
+    few.record_registry(
+        {"mxtpu_trainer_step_seconds":
+         {"kind": "histogram", "help": "",
+          "series": {"role=worker,rank=0":
+                     {"count": 1, "sum": 1.0, "buckets": {"0.8": 1}}}}},
+        ts=1.0)
+    assert rule.raw_level(few, 1.0)[0] == health.OK
+
+
+# --------------------------------------------- hysteresis + transitions
+
+class _ScriptRule(health.Rule):
+    """Replays a scripted sequence of raw levels."""
+    type = "script"
+
+    def __init__(self, name, script, **kw):
+        super().__init__(name, **kw)
+        self.script = list(script)
+        self.i = 0
+
+    def raw_level(self, history, now):
+        raw = self.script[min(self.i, len(self.script) - 1)]
+        self.i += 1
+        return raw, float(self.i), {}
+
+
+def test_hysteresis_fire_for_and_clear_for():
+    rule = _ScriptRule("h", [health.WARN, health.WARN, health.OK,
+                             health.OK, health.OK],
+                       fire_for=2, clear_for=2)
+    ev = health.HealthEvaluator(history.MetricHistory(), [rule])
+    assert ev.evaluate(1.0)["rules"]["h"]["level"] == health.OK   # 1st breach
+    assert ev.evaluate(2.0)["rules"]["h"]["level"] == health.WARN  # 2nd
+    assert ev.evaluate(3.0)["rules"]["h"]["level"] == health.WARN  # 1st clear
+    v = ev.evaluate(4.0)                                           # 2nd clear
+    assert v["rules"]["h"]["level"] == health.OK
+    assert v["ok"] is True
+
+
+def test_transitions_hit_flight_and_catalog():
+    was = flight.enabled()
+    flight.enable()
+    telemetry.enable()
+    try:
+        flight.clear()
+        rule = _ScriptRule("t_rule", [health.PAGE, health.PAGE, health.OK,
+                                      health.OK],
+                           fire_for=1, clear_for=2)
+        ev = health.HealthEvaluator(history.MetricHistory(), [rule])
+        v = ev.evaluate(1.0)
+        assert v["level"] == health.PAGE and v["ok"] is False
+        assert v["firing"][0]["rule"] == "t_rule"
+        ev.evaluate(2.0)
+        ev.evaluate(3.0)
+        assert ev.evaluate(4.0)["level"] == health.OK
+        evs = [(e["event"], e["attrs"]["level"]) for e in flight.events()
+               if e["event"].startswith("health.")]
+        assert evs == [("health.firing", health.PAGE),
+                       ("health.resolved", health.OK)]
+        assert catalog.health_level.value(rule="t_rule") == 0
+        assert catalog.health_transitions.value(rule="t_rule",
+                                                to=health.PAGE) == 1
+        assert catalog.health_transitions.value(rule="t_rule",
+                                                to=health.OK) == 1
+    finally:
+        flight.clear()
+        if not was:
+            flight.disable()
+        telemetry.disable()
+
+
+def test_broken_rule_is_contained():
+    class Boom(health.Rule):
+        type = "boom"
+
+        def raw_level(self, history, now):
+            raise RuntimeError("kaput")
+
+    ev = health.HealthEvaluator(history.MetricHistory(), [Boom("b")])
+    v = ev.evaluate(1.0)
+    assert v["level"] == health.OK
+    assert "kaput" in v["rules"]["b"]["error"]
+
+
+def test_default_rule_pack_builds_and_holds_on_empty_history():
+    rules = [health.make_rule(s) for s in catalog.default_health_rules()]
+    names = [r.name for r in rules]
+    assert len(names) == len(set(names))
+    for expected in ("serving_shed_burn", "rpc_retry_burn",
+                     "guard_skip_burst", "watchdog_fired",
+                     "serving_occupancy_saturation",
+                     "membership_epoch_stale", "compile_cache_error_burn",
+                     "member_absent", "step_time_straggler",
+                     "batch_wait_straggler"):
+        assert expected in names
+    ev = health.HealthEvaluator(history.MetricHistory(), rules)
+    v = ev.evaluate()
+    assert v["ok"] is True and v["firing"] == []
+    with pytest.raises(ValueError):
+        health.make_rule({"type": "nonesuch", "name": "x"})
+
+
+# --------------------------------------------- /alertz + statusz wiring
+
+def test_alertz_endpoint_and_statusz_health_section():
+    telemetry.enable()
+    try:
+        g = telemetry.gauge("alertz_gauge")
+        g.set(9.0)
+        ev = health.install(rules=[
+            {"type": "threshold", "name": "gauge_high",
+             "metric": "alertz_gauge", "source": "latest", "page": 5.0}])
+        assert health.evaluator() is ev
+        health.tick()
+        srv = debugz.start(0)
+        port = srv.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d%s" % (port, path),
+                    timeout=10) as r:
+                return r.status, r.read().decode("utf-8")
+
+        st, body = get("/alertz")
+        assert st == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["verdict"]["level"] == health.PAGE
+        assert payload["verdict"]["rules"]["gauge_high"]["value"] == 9.0
+        assert any(c["name"] == "gauge_high" for c in payload["config"])
+        st, text = get("/alertz?format=text")
+        assert st == 200
+        assert "health: PAGE" in text and "gauge_high" in text
+        st, body = get("/statusz")
+        status = json.loads(body)
+        assert status["health"]["enabled"] is True
+        assert status["health"]["level"] == health.PAGE
+        assert status["health"]["firing"] == ["gauge_high"]
+        st, body = get("/")
+        assert "/alertz" in body
+    finally:
+        debugz.stop()
+        telemetry.disable()
+    # plane off again: the endpoint data degrades to the stub
+    health.uninstall()
+    assert health.statusz_entry() == {"enabled": False}
+    assert health.alertz_dict()["verdict"]["level"] == health.OK
+
+
+# --------------------------------------- histogram quantile edge cases
+
+def test_histogram_quantile_edge_cases():
+    telemetry.enable()
+    try:
+        empty = telemetry.histogram("q_empty_seconds", buckets=(1.0,))
+        assert empty.quantile(0.5) is None
+        with pytest.raises(ValueError):
+            empty.quantile(1.5)
+
+        single = telemetry.histogram("q_single_seconds", buckets=(1.0,))
+        for _ in range(4):
+            single.observe(0.5)
+        assert single.quantile(0.0) == 0.0
+        assert single.quantile(0.5) == pytest.approx(0.5)
+        assert single.quantile(1.0) == pytest.approx(1.0)
+
+        over = telemetry.histogram("q_over_seconds", buckets=(1.0, 2.0))
+        for _ in range(3):
+            over.observe(50.0)       # all mass in the implicit +Inf bucket
+        assert over.quantile(0.5) == 2.0   # clamps to last finite edge
+        assert over.quantile(1.0) == 2.0
+    finally:
+        telemetry.disable()
+
+
+def test_aggregate_hist_quantile_edge_cases_on_json_shape():
+    hq = aggregate.hist_quantile
+    assert hq({"count": 0, "sum": 0.0, "buckets": {}}, 0.5) is None
+    assert hq("not a histogram", 0.5) is None
+    single = {"count": 4, "sum": 2.0, "buckets": {"1.0": 4}}
+    assert hq(single, 0.0) == 0.0
+    assert hq(single, 0.5) == pytest.approx(0.5)
+    assert hq(single, 1.0) == pytest.approx(1.0)
+    # all mass beyond the last finite edge -> clamp to that edge
+    over = {"count": 3, "sum": 150.0, "buckets": {"1.0": 0, "2.0": 0}}
+    assert hq(over, 0.5) == 2.0
+    assert hq(over, 1.0) == 2.0
+
+
+# ------------------------------------------------- scrape resilience
+
+def test_scrape_with_dead_member_records_scrape_errors():
+    """One dead member mid-scrape: the walk completes, the survivors'
+    registry merges, and the gap surfaces as mxtpu_scrape_errors_total
+    instead of an exception."""
+    import socket as _socket
+    from incubator_mxnet_tpu.kvstore.rpc import Server
+    from incubator_mxnet_tpu.telemetry import export
+
+    telemetry.enable()
+    try:
+        catalog.rpc_retries.inc(op="probe")   # give the live member data
+
+        def handler(meta, payload):
+            if meta.get("op") == "serve.metrics":
+                return {}, export.render_json().encode("utf-8")
+            return {"error": "bad op"}, b""
+
+        live = Server(handler).start()
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_addr = "127.0.0.1:%d" % s.getsockname()[1]
+        s.close()                               # nothing listens here
+        before = catalog.scrape_errors.value(member="serving:1")
+        scrape = aggregate.scrape(
+            serving=["%s:%d" % live.addr, dead_addr], timeout=2.0)
+        live.stop()
+        oks = {m["rank"]: m["ok"] for m in scrape["members"]
+               if m["role"] == "serving"}
+        assert oks == {0: True, 1: False}
+        dead = [m for m in scrape["members"]
+                if m["role"] == "serving" and m["rank"] == 1][0]
+        assert dead["error"]
+        # survivors merged with role labels intact
+        reg = scrape["registry"]
+        assert any("role=serving,rank=0" in k for k in
+                   reg["mxtpu_rpc_retries_total"]["series"])
+        # the gap is a first-class series + a local counter
+        errs = reg["mxtpu_scrape_errors_total"]["series"]
+        assert errs == {"member=serving:1": 1}
+        assert catalog.scrape_errors.value(member="serving:1") == before + 1
+    finally:
+        telemetry.disable()
+
+
+# --------------------------------------------------- healthcheck CLI
+
+def _canned_scrape(ok, retries=0.0, requests=0.0):
+    return {"epoch": 1, "quorum": 1,
+            "members": [{"role": "worker", "rank": 0,
+                         "addr": "h:1", "ok": ok,
+                         **({} if ok else {"error": "refused"})}],
+            "registry": {
+                "mxtpu_rpc_retries_total": {
+                    "kind": "counter", "help": "",
+                    "series": {"role=worker,rank=0": retries}},
+                "mxtpu_rpc_client_requests_total": {
+                    "kind": "counter", "help": "",
+                    "series": {"role=worker,rank=0": requests}}}}
+
+
+def test_healthcheck_exit_codes(monkeypatch, capsys):
+    from tools import healthcheck
+
+    def fake_seq(seq):
+        it = iter(seq)
+        return lambda **kw: next(it)
+
+    # healthy fleet -> 0, verdict on stdout
+    monkeypatch.setattr(aggregate, "scrape", fake_seq(
+        [_canned_scrape(True, 0, 100), _canned_scrape(True, 0, 200)]))
+    rc = healthcheck.main(["--samples", "2", "--interval", "0"])
+    v = json.loads(capsys.readouterr().out)
+    assert rc == 0 and v["level"] == "OK" and v["ok"] is True
+
+    # dead member -> absence PAGEs -> 2
+    monkeypatch.setattr(aggregate, "scrape", fake_seq(
+        [_canned_scrape(True, 0, 100), _canned_scrape(False, 0, 200)]))
+    rc = healthcheck.main(["--samples", "2", "--interval", "0"])
+    v = json.loads(capsys.readouterr().out)
+    assert rc == 2 and v["level"] == "PAGE"
+    assert any(e["rule"] == "member_absent" for e in v["firing"])
+
+    # unreachable fleet -> 3
+    def boom(**kw):
+        raise OSError("connection refused")
+    monkeypatch.setattr(aggregate, "scrape", boom)
+    rc = healthcheck.main(["--samples", "1"])
+    assert rc == 3
+    assert "scrape failed" in capsys.readouterr().out
+
+
+# -------------------------------------- two-process acceptance drill
+
+_KV = []
+
+
+def _drill_worker():
+    os.environ["MXTPU_DEBUGZ_PORT"] = "0"
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    from incubator_mxnet_tpu.utils import failpoints
+    telemetry.enable()
+    flight.enable()
+    health.install()        # default pack, env-compressed windows
+
+    kv = KVStoreDist("dist_sync")
+    kv.init("w", nd.ones((4,)))
+    _KV.append(kv)
+
+    levels = []
+
+    def push_and_tick():
+        kv.push("w", nd.ones((4,)) * 2)
+        kv.push("w", nd.ones((4,)) * 2)
+        v = health.tick()
+        levels.append(v["rules"]["rpc_retry_burn"]["level"])
+
+    for _ in range(8):                       # clean phase: burn 0 -> OK
+        push_and_tick()
+        time.sleep(0.25)
+
+    # chaos: the ISSUE's kv.push.delay plus send drops that force
+    # call_idempotent retries — the burn-rate numerator
+    failpoints.activate("kv.push.delay", value=0.01)
+    failpoints.activate("rpc.send.drop", prob=0.45)
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        push_and_tick()
+        if levels[-1] == health.PAGE:
+            break
+        time.sleep(0.2)
+
+    port = debugz.port()
+
+    def get(path):
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (port, path), timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    alertz = json.loads(get("/alertz"))
+    alertz_text = get("/alertz?format=text")
+    statusz = json.loads(get("/statusz"))
+    flight_path = os.path.join(os.environ["MXTPU_DRILL_TMP"],
+                               "flight.jsonl")
+    flight.dump(flight_path, reason="drill")
+    return {"levels": levels, "alertz": alertz,
+            "alertz_text": alertz_text, "statusz": statusz,
+            "flight_path": flight_path}
+
+
+def _drill_worker_proc(queue, ctrl):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        res = _drill_worker()
+    except Exception as e:  # surface failures to the test
+        import traceback
+        queue.put("ERROR: %s\n%s" % (e, traceback.format_exc()))
+        return
+    queue.put(res)
+    # stay alive (still pushing, chaos still armed) for the parent's
+    # mxtop/healthcheck phases, until the parent SIGKILLs this process;
+    # a ctrl message disarms the failpoints first so the healthy-fleet
+    # healthcheck sees a quiet burn rate
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.utils import failpoints
+    kv = _KV[0]
+    end = time.time() + 180
+    while time.time() < end:
+        try:
+            ctrl.get_nowait()
+            failpoints.reset()
+        except Exception:  # noqa: BLE001 — queue.Empty
+            pass
+        try:
+            kv.push("w", nd.ones((4,)) * 2)
+            health.tick()
+        except Exception:  # noqa: BLE001 — dying fleet mid-teardown
+            pass
+        time.sleep(0.1)
+
+
+def _run_tool(script, *args):
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", script)] + list(args),
+        capture_output=True, text=True, env=env, timeout=120)
+
+
+def test_health_drill_burn_rate_absence_and_verdicts(tmp_path):
+    """Acceptance drill (two OS processes + scheduler/server):
+
+    1. chaos failpoints drive the retry burn rule OK→WARN→PAGE in the
+       worker, visible in /alertz (JSON + text), /statusz, the flight
+       dump, and a parent-side ``mxtop --once`` frame;
+    2. with chaos disarmed, ``healthcheck`` exits 0;
+    3. after SIGKILL-ing the worker, the absence rule PAGEs within ONE
+       evaluation and ``healthcheck`` exits 2.
+    """
+    from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
+                                                         run_server,
+                                                         SchedulerClient)
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    drill_env = {
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+        "MXTPU_METRICS": "1",
+        # compress the SRE windows so the drill fits in seconds
+        "MXTPU_HEALTH_FAST_WINDOW": "4", "MXTPU_HEALTH_SLOW_WINDOW": "8",
+        "MXTPU_HEALTH_RETRY_BUDGET": "0.02",
+        "MXTPU_DRILL_TMP": str(tmp_path),
+    }
+    os.environ.update(drill_env)
+    ctx = mp.get_context("spawn")
+    procs = []
+    w = None
+    try:
+        sched = ctx.Process(target=run_scheduler, args=(port, 1, 1),
+                            daemon=True)
+        sched.start()
+        procs.append(sched)
+        time.sleep(0.3)
+        srv = ctx.Process(target=run_server,
+                          args=(("127.0.0.1", port), 1), daemon=True)
+        srv.start()
+        procs.append(srv)
+        queue, ctrl = ctx.Queue(), ctx.Queue()
+        w = ctx.Process(target=_drill_worker_proc, args=(queue, ctrl),
+                        daemon=True)
+        w.start()
+        res = queue.get(timeout=150)
+        assert not (isinstance(res, str) and res.startswith("ERROR")), res
+
+        # (1) the burn rule walked OK -> WARN -> PAGE, in that order
+        levels = res["levels"]
+        assert levels[0] == health.OK
+        assert health.WARN in levels and health.PAGE in levels
+        assert levels.index(health.OK) < levels.index(health.WARN) \
+            < levels.index(health.PAGE)
+        assert levels[-1] == health.PAGE
+
+        # ... visible in /alertz JSON + text and the statusz section
+        verdict = res["alertz"]["verdict"]
+        assert verdict["level"] == health.PAGE and verdict["ok"] is False
+        assert any(e["rule"] == "rpc_retry_burn"
+                   for e in verdict["firing"])
+        assert "[PAGE] rpc_retry_burn" in res["alertz_text"]
+        assert res["statusz"]["health"]["level"] == health.PAGE
+        assert "rpc_retry_burn" in res["statusz"]["health"]["firing"]
+
+        # ... and in the flight recorder dump (firing transitions)
+        lines = [json.loads(l) for l in
+                 open(res["flight_path"]).read().splitlines()]
+        fired = [(e["attrs"]["rule"], e["attrs"]["level"]) for e in lines
+                 if e["event"] == "health.firing"]
+        assert ("rpc_retry_burn", health.WARN) in fired
+        assert ("rpc_retry_burn", health.PAGE) in fired
+
+        # ... and in a parent-side mxtop frame (chaos still armed)
+        top = _run_tool("mxtop.py", "--once", "--interval", "2")
+        assert top.returncode == 0, top.stderr[-2000:]
+        assert "ALERTS" in top.stdout
+        assert "rpc_retry_burn" in top.stdout, top.stdout
+
+        # (2) disarm chaos: the fleet is healthy, healthcheck passes
+        ctrl.put("clean")
+        time.sleep(1.5)
+        hc = _run_tool("healthcheck.py", "--samples", "2",
+                       "--interval", "1")
+        assert hc.returncode == 0, (hc.stdout[-2000:], hc.stderr[-2000:])
+
+        # (3) SIGKILL the worker: absence PAGEs within ONE evaluation
+        w.kill()
+        w.join(timeout=10)
+        time.sleep(0.3)
+        hist = history.MetricHistory()
+        hist.record_scrape(aggregate.scrape())
+        ev = health.HealthEvaluator(
+            hist, [health.AbsenceRule("member_absent")])
+        v = ev.evaluate()
+        assert v["rules"]["member_absent"]["level"] == health.PAGE
+        dead = v["rules"]["member_absent"]["detail"]["absent"]
+        assert any("role=worker" in d["member"] for d in dead)
+
+        # ... and healthcheck now exits 2 with member_absent firing
+        hc2 = _run_tool("healthcheck.py", "--samples", "2",
+                        "--interval", "1")
+        assert hc2.returncode == 2, (hc2.stdout[-2000:],
+                                     hc2.stderr[-2000:])
+        out = json.loads(hc2.stdout)
+        assert out["level"] == health.PAGE
+        assert any(e["rule"] == "member_absent" for e in out["firing"])
+    finally:
+        for k in drill_env:
+            os.environ.pop(k, None)
+        try:
+            SchedulerClient(("127.0.0.1", port)).shutdown()
+        except OSError:
+            pass
+        if w is not None:
+            w.kill()
+        for p in procs:
+            p.terminate()

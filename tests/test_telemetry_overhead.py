@@ -92,6 +92,35 @@ def test_inactive_debugz_status_is_cheap():
         < MAX_SECONDS_PER_CALL
 
 
+def test_disabled_history_is_one_flag_check():
+    """History plane off (the default): sample_local() is one predicate
+    check, default() resolves to None, and nothing is retained."""
+    from incubator_mxnet_tpu.telemetry import history
+    was = history.enabled()
+    history.disable()
+    try:
+        assert history.enabled() is False
+        assert history.default() is None
+        assert history.sample_local() is None
+        assert _per_call(history.sample_local) < MAX_SECONDS_PER_CALL
+    finally:
+        if was:
+            history.enable()
+
+
+def test_disabled_health_is_one_flag_check():
+    """Health plane off (the default): tick() is one predicate check,
+    statusz_entry() is a constant stub, and the verdict is a benign OK."""
+    from incubator_mxnet_tpu.telemetry import health
+    assert health.enabled() is False
+    assert health.evaluator() is None
+    assert health.tick() is None
+    assert health.statusz_entry() == {"enabled": False}
+    v = health.verdict()
+    assert v["ok"] is True and v["level"] == health.OK
+    assert _per_call(health.tick) < MAX_SECONDS_PER_CALL
+
+
 def test_disabled_compile_cache_is_one_env_check(monkeypatch):
     """Cache off (no MXTPU_COMPILE_CACHE_DIR): enabled() is one env-dict
     lookup, default_store() resolves to None, and the statusz entry is a

@@ -26,7 +26,7 @@ def main():
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from incubator_mxnet_tpu.compat import shard_map
 
     devs = jax.devices()
     n = len(devs)

@@ -104,6 +104,34 @@ def main():
     except Exception as e:  # noqa: BLE001 — diagnostics must not crash
         print("telemetry unavailable:", e)
 
+    section("Health")
+    # health plane: in-process evaluator state when embedded in a live
+    # job; with a reachable scheduler, a one-shot fleet verdict via
+    # tools/healthcheck.py semantics
+    try:
+        from incubator_mxnet_tpu.telemetry import health as _health
+        print("enabled      :", _health.enabled())
+        if _health.enabled():
+            v = _health.verdict()
+            print("level        :", v["level"])
+            for e in v.get("firing", []):
+                print("  [%s] %s value=%s" % (e["level"], e["rule"],
+                                              e.get("value")))
+        elif os.environ.get("DMLC_PS_ROOT_URI"):
+            from tools import healthcheck as _hc
+            v, _ = _hc.run(samples=2, interval=1.0, timeout=3.0)
+            print("fleet verdict:", v["level"],
+                  "(%d firing / %d rules)" % (len(v["firing"]),
+                                              len(v["rules"])))
+            for e in v.get("firing", [])[:10]:
+                print("  [%s] %s value=%s" % (e["level"], e["rule"],
+                                              e.get("value")))
+        else:
+            print("(disabled — set MXTPU_HEALTH=1 for the in-process "
+                  "loop, or DMLC_PS_ROOT_URI/PORT for a fleet verdict)")
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("health unavailable:", e)
+
     section("Serving")
     # live serving-plane probe: point MXTPU_SERVE_ADDR at a ModelServer
     # ("host:port") and diagnose reports its models and SLO quantiles
@@ -222,7 +250,7 @@ def main():
                 if key in status:
                     print("  - %s: %s" % (key, status[key]))
             print("  endpoints: /metrics /metrics.json /statusz /tracez "
-                  "/threadz /flightz")
+                  "/threadz /flightz /alertz")
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
             print("statusz      : %s unreachable (%s)" % (url, e))
 

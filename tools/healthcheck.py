@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""healthcheck — scrape the fleet, evaluate the SLO rule pack, exit by
+verdict.
+
+Scrapes the scheduler's membership view (plus optional --serving /
+--stream targets) at least twice --interval apart so windowed
+burn/rate rules have data, runs the default health rules (or a JSON
+rule file via --rules) and prints the machine-readable verdict.
+
+Exit codes — scripts and the ROADMAP's canary/autoscaler loops branch
+on these:
+
+    0   OK or WARN (healthy enough to proceed)
+    2   a PAGE rule is firing
+    3   the fleet could not be scraped at all
+
+    python tools/healthcheck.py                      # DMLC env scheduler
+    python tools/healthcheck.py --scheduler h:p --samples 3 --interval 5
+    python tools/healthcheck.py --text               # human rendering
+    python tools/healthcheck.py --fail-on-warn       # strict: WARN also fails
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_mxnet_tpu.telemetry import aggregate  # noqa: E402
+from incubator_mxnet_tpu.telemetry import catalog, health, history  # noqa: E402
+
+EXIT_OK, EXIT_PAGE, EXIT_SCRAPE_FAILED = 0, 2, 3
+
+
+def run(scheduler=None, serving=None, stream=None, rules=None,
+        samples=2, interval=2.0, timeout=5.0):
+    """Scrape ``samples`` times ``interval`` apart, evaluate after each,
+    return (verdict, evaluator).  Raises OSError/RuntimeError when the
+    first scrape already fails."""
+    hist = history.MetricHistory()
+    ev = health.HealthEvaluator(
+        hist, rules if rules is not None else catalog.default_health_rules())
+    verdict = None
+    for i in range(max(1, int(samples))):
+        if i:
+            time.sleep(interval)
+        hist.record_scrape(aggregate.scrape(
+            scheduler=scheduler, serving=serving, stream=stream,
+            timeout=timeout))
+        verdict = ev.evaluate()
+    return verdict, ev
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scheduler", default=None,
+                    help="host:port (default: DMLC_PS_ROOT_URI/PORT)")
+    ap.add_argument("--serving", action="append", default=None,
+                    help="model-server host:port (repeatable)")
+    ap.add_argument("--stream",
+                    default=os.environ.get("MXTPU_STREAM_ADDR") or None,
+                    help="stream coordinator host:port")
+    ap.add_argument("--samples", type=int, default=2,
+                    help="scrapes to take (>=2 gives burn rules data)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between scrapes")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--rules", default=None,
+                    help="JSON file with a list of rule specs "
+                         "(default: the built-in pack)")
+    ap.add_argument("--text", action="store_true",
+                    help="human rendering instead of the JSON verdict")
+    ap.add_argument("--fail-on-warn", action="store_true",
+                    help="also exit nonzero when the level is WARN")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        with open(args.rules) as f:
+            rules = json.load(f)
+    try:
+        verdict, _ = run(scheduler=args.scheduler, serving=args.serving,
+                         stream=args.stream, rules=rules,
+                         samples=args.samples, interval=args.interval,
+                         timeout=args.timeout)
+    except (OSError, RuntimeError, ValueError) as exc:
+        print(json.dumps({"ok": False, "level": "UNKNOWN",
+                          "error": "scrape failed: %s" % exc}))
+        return EXIT_SCRAPE_FAILED
+
+    if args.text:
+        sys.stdout.write(health.render_text(verdict))
+    else:
+        print(json.dumps(verdict, indent=2, default=str))
+    if verdict["level"] == health.PAGE:
+        return EXIT_PAGE
+    if args.fail_on_warn and verdict["level"] == health.WARN:
+        return EXIT_PAGE
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from incubator_mxnet_tpu.telemetry import aggregate  # noqa: E402
+from incubator_mxnet_tpu.telemetry import catalog, health, history  # noqa: E402
 
 
 def _series_sum(registry, name, where=None):
@@ -56,7 +57,8 @@ def _rates(prev, cur, elapsed):
             for k in cur}
 
 
-def frame(scheduler, serving, prev_totals, prev_ts, stream=None):
+def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
+          health_state=None):
     scrape = aggregate.scrape(scheduler=scheduler, serving=serving,
                               stream=stream)
     reg = scrape["registry"]
@@ -171,6 +173,22 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None):
             b = sum(v for k, v in (qbytes.get("series") or {}).items()
                     if "uri=%s" % uri in k)
             lines.append("%-52s %8.0f %12.0f" % (uri[-52:], bad[uri], b))
+
+    # alerts panel: the persistent history+evaluator in health_state
+    # accumulate across frames, so burn windows fill as mxtop watches
+    if health_state is not None:
+        health_state["history"].record_scrape(scrape)
+        verdict = health_state["evaluator"].evaluate()
+        lines.append("")
+        lines.append("ALERTS  overall=%s  (%d firing / %d rules)"
+                     % (verdict["level"], len(verdict["firing"]),
+                        len(verdict["rules"])))
+        for e in verdict["firing"][:10]:
+            val = e.get("value")
+            lines.append("  [%s] %-28s %-10s %s"
+                         % (e["level"], e["rule"], e["type"],
+                            "%.4g" % val
+                            if isinstance(val, (int, float)) else "-"))
     return "\n".join(lines), totals, now, scrape
 
 
@@ -192,11 +210,25 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     prev_totals, prev_ts = {}, None
+    health_state = {"history": history.MetricHistory(),
+                    "evaluator": None}
+    health_state["evaluator"] = health.HealthEvaluator(
+        health_state["history"], catalog.default_health_rules())
+    if args.once:
+        # burn/rate rules need two samples: prime the history with one
+        # scrape so the single rendered frame still evaluates them
+        try:
+            health_state["history"].record_scrape(aggregate.scrape(
+                scheduler=args.scheduler, serving=args.serving,
+                stream=args.stream))
+            time.sleep(min(args.interval, 2.0))
+        except (OSError, RuntimeError):
+            pass      # the framed scrape will report the failure
     while True:
         try:
             text, prev_totals, prev_ts, scrape = frame(
                 args.scheduler, args.serving, prev_totals, prev_ts,
-                stream=args.stream)
+                stream=args.stream, health_state=health_state)
         except (OSError, RuntimeError) as exc:
             text, scrape = "mxtop: scrape failed: %s" % exc, None
         if args.once:
