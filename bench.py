@@ -16,8 +16,8 @@ host transfer of the final loss (float(...)), which cannot complete before
 every queued step has executed on device.
 
 BENCH_MODEL selects a single benchmark: resnet50 | bert | bert_long |
-resnet50_pipe | lstm | ssd | serving_bert | stream_input | ... (see
-_dispatch). bert runs REAL BERT-base pretraining — BERTForPretrain
+resnet50_pipe | lstm | ssd | serving_bert | llm_decode | stream_input
+| ... (see _dispatch). bert runs REAL BERT-base pretraining — BERTForPretrain
 with the full MLM objective (gather-first masked-position decode through
 the 768x30522 vocab projection, loss on the 15% masked slots) plus the
 NSP head, per the reference pretraining recipe.
@@ -1112,6 +1112,86 @@ def bench_serving():
         srv.stop()
 
 
+def bench_llm_decode():
+    """BENCH_MODEL=llm_decode: third north-star — autoregressive LLM
+    generation tokens/sec/chip through the FULL generate/ subsystem:
+    GPT decoder over the paged KV cache, chunked prefill, and
+    draft-model speculative decoding. The JSON line splits prefill vs
+    decode throughput (they bottleneck differently: prefill is
+    compute-bound matmul, decode is memory-bound gather) and carries
+    the speculation accept-rate, since tokens/sec with speculation is
+    only comparable at a stated accept-rate.
+
+    Knobs: BENCH_LLM_LAYERS (4), BENCH_LLM_HEADS (4), BENCH_LLM_UNITS
+    (256), BENCH_LLM_VOCAB (512), BENCH_LLM_PROMPT (64), BENCH_LLM_NEW
+    (64), BENCH_LLM_BATCH (8), BENCH_LLM_SPEC_K (4; 0 runs plain
+    greedy with no draft model)."""
+    import jax
+    from incubator_mxnet_tpu.generate import GenerateEngine, GPTPagedLM
+    from incubator_mxnet_tpu.models.gpt import gpt_config, gpt_param_shapes
+
+    layers = int(os.environ.get("BENCH_LLM_LAYERS", "4"))
+    heads = int(os.environ.get("BENCH_LLM_HEADS", "4"))
+    units = int(os.environ.get("BENCH_LLM_UNITS", "256"))
+    vocab = int(os.environ.get("BENCH_LLM_VOCAB", "512"))
+    prompt_len = int(os.environ.get("BENCH_LLM_PROMPT", "64"))
+    new_tokens = int(os.environ.get("BENCH_LLM_NEW", "64"))
+    batch = int(os.environ.get("BENCH_LLM_BATCH", "8"))
+    spec_k = int(os.environ.get("BENCH_LLM_SPEC_K", "4"))
+    max_len = prompt_len + new_tokens
+
+    def make(cfg_dict, seed):
+        cfg = gpt_config(cfg_dict)
+        rng = np.random.RandomState(seed)
+        params = {n: (rng.randn(*s) * 0.02).astype(np.float32)
+                  for n, s in gpt_param_shapes(cfg).items()}
+        return GPTPagedLM(params, cfg)
+
+    base = dict(vocab_size=vocab, units=units, num_layers=layers,
+                num_heads=heads, max_len=max_len)
+    target = make(base, 0)
+    draft = draft_cache = None
+    if spec_k > 0:
+        # quarter-size draft: same vocab/max_len (the verify contract),
+        # head_dim kept >= 8 so tiny smoke configs stay valid
+        draft = make(dict(base, units=max(heads * 8, units // 4),
+                          num_layers=max(1, layers // 4)), 1)
+        draft_cache = draft.make_cache(batch, max_len=max_len)
+    engine = GenerateEngine(
+        target, target.make_cache(batch, max_len=max_len),
+        draft=draft, draft_cache=draft_cache,
+        spec_k=spec_k if spec_k > 0 else None)
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, vocab, prompt_len).tolist()
+               for _ in range(batch)]
+    engine.generate(prompts, max_new_tokens=new_tokens)     # warm compile
+
+    def run():
+        engine.generate(prompts, max_new_tokens=new_tokens)
+
+    stats = _timed_rate(run, batch * new_tokens)
+    chips = max(1, jax.device_count())
+    for k in ("value", "min", "max"):
+        stats[k] = stats[k] / chips
+    st = engine.last_stats        # splits from the LAST timed window
+    accept = (st["accepted"] / st["proposed"]) if st["proposed"] else None
+    return _emit(
+        "llm_decode_tokens_per_sec_per_chip", "tokens/sec/chip", stats,
+        prefill_tokens_per_sec=round(
+            st["prefill_tokens"] / st["prefill_seconds"], 2)
+        if st["prefill_seconds"] else None,
+        decode_tokens_per_sec=round(
+            st["decode_tokens"] / st["decode_seconds"], 2)
+        if st["decode_seconds"] else None,
+        prefill_seconds=round(st["prefill_seconds"], 4),
+        decode_seconds=round(st["decode_seconds"], 4),
+        accept_rate=round(accept, 4) if accept is not None else None,
+        spec_k=spec_k if spec_k > 0 else None,
+        prompt_len=prompt_len, new_tokens=new_tokens, batch=batch,
+        chips=chips, model="gpt_%dx%d" % (units, layers))
+
+
 def bench_stream():
     import shutil
     import tempfile
@@ -1432,6 +1512,8 @@ def _dispatch(model, batch, steps, dtype):
         return bench_int8_matmul()
     if model == "serving_bert":
         return bench_serving()
+    if model == "llm_decode":
+        return bench_llm_decode()
     if model == "stream_input":
         return bench_stream()
     if model == "ssd":

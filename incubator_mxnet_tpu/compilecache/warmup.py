@@ -100,6 +100,15 @@ def warmup_serving(directory=None, served=None, buckets=None, rows=None,
         prog = served.decode_program_for(n_slots)
         (built if prog is not None else failed).append(
             "decode/s%d" % n_slots)
+        # generative families (gpt_decoder) expose extra_warmup for
+        # the rest of their program grid — chunked prefill and the
+        # draft verify shape — so a warm replica boots with ZERO
+        # compile events, not just a warm decode step
+        extra = getattr(served, "extra_warmup", None)
+        if extra is not None:
+            res = extra(n_slots)
+            built.extend(res.get("built", ()))
+            failed.extend(res.get("failed", ()))
     attached = 0
     if attach:
         if directory is None:

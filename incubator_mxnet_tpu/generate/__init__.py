@@ -1,0 +1,27 @@
+"""Generative inference engine: decoder LLMs over a paged KV cache.
+
+The subsystem ROADMAP item 1 names: a GPT-style causal decoder served
+through the continuous-batching plane, with
+
+- :mod:`.paged_kv` — block-table + free-list KV allocator that drops in
+  behind the ``serving/kv_cache.py`` alloc/free/append surface,
+- :mod:`.engine` — chunked prefill, greedy/temperature sampling, and
+  draft-model speculative decoding (Leviathan et al., ICML 2023),
+- :mod:`.family` — the ``gpt_decoder`` ``@serving_family`` wiring the
+  engine's forward into ModelServer's slot grid with AOT programs.
+
+Importing this package registers the serving family.
+"""
+
+from .paged_kv import PagedKVCache
+from .engine import GenerateEngine, GPTPagedLM
+from . import family  # noqa: F401  (registers the gpt_decoder family)
+from .family import export_gpt_for_serving, gpt_cache_spec
+
+__all__ = [
+    "PagedKVCache",
+    "GenerateEngine",
+    "GPTPagedLM",
+    "export_gpt_for_serving",
+    "gpt_cache_spec",
+]

@@ -1,0 +1,317 @@
+"""``gpt_decoder`` serving family: the GPT decoder on the slot grid.
+
+Wires ``models/gpt.py`` + ``paged_kv`` into the serving plane's
+continuous-batching contract (``step_fn(tokens, cache, active)`` over a
+fixed slot grid) plus the family-owned extras this decoder adds:
+
+- ``prefill_fn(slot, tokens, cache)`` — chunked prompt ingestion, so
+  the DecodeLoop commits a joining prompt in ``ceil(P/chunk)`` wide
+  forwards instead of P one-token steps;
+- AOT programs for the decode step (``gptdecode/s%d``), the prefill
+  chunk (``gptprefill/s%dxc%d``) and — when the checkpoint carries a
+  draft model — the draft's decode step (``gptdraft/s%d``), all built
+  through the persistent compile cache and exported/bound via the
+  checkpoint ``executables`` section like every other family;
+- ``extra_warmup(slots)`` — called by the warmup driver to pre-build
+  the full program grid (target decode × prefill × draft decode), so a
+  warm replica's first generative request compiles nothing.
+
+The programs are pure functions over the flat param dict (sorted-name
+``BlockProgram`` convention), NOT gluon traces — the paged forward
+takes the cache pools/tables as explicit inputs, which gluon's forward
+protocol has no slot for.
+"""
+
+import logging
+import math
+import os
+
+import numpy as np
+
+from ..compilecache import aot as _aot
+from ..compilecache import store as _ccstore
+from ..models.gpt import gpt_config, gpt_forward_paged, gpt_param_shapes
+from ..serving.loader import ServedModel, serving_family
+from ..utils.checkpoint import CheckpointManager
+from .paged_kv import PagedKVCache
+
+__all__ = ["export_gpt_for_serving", "gpt_cache_spec"]
+
+log = logging.getLogger(__name__)
+
+_DRAFT_PREFIX = "draft/"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def gpt_cache_spec(cfg):
+    """PagedKVCache spec for a gpt config: per-layer k/v (H, D) entries."""
+    cfg = gpt_config(cfg)
+    H = cfg["num_heads"]
+    D = cfg["units"] // H
+    spec = {}
+    for i in range(cfg["num_layers"]):
+        spec["k%d" % i] = ("kv", (H, D))
+        spec["v%d" % i] = ("kv", (H, D))
+    return spec
+
+
+class _PagedProgramSet:
+    """Builds/binds the paged-forward programs for ONE param set
+    (target or draft). Calling convention per program: input arrays
+    ``[tokens (S, C), lengths (S,), tables (S, MB), k_pool x L,
+    v_pool x L]`` then the params in sorted-name order; outputs
+    ``[logits, new_k x L, new_v x L]``."""
+
+    def __init__(self, cfg, params, tag):
+        import jax.numpy as jnp
+        self.cfg = cfg
+        self.tag = tag
+        self.num_layers = cfg["num_layers"]
+        self.pnames = sorted(gpt_param_shapes(cfg))
+        missing = [n for n in self.pnames if n not in params]
+        if missing:
+            raise IOError("gpt serving checkpoint is missing params "
+                          "(%s): %s" % (tag, ", ".join(missing[:8])))
+        self.pvals = [jnp.asarray(params[n]) for n in self.pnames]
+        self.n_inputs = 3 + 2 * self.num_layers
+        self._jit = None
+
+    def _pure(self):
+        L = self.num_layers
+
+        def pure_fn(input_vals, param_vals):
+            params = dict(zip(self.pnames, param_vals))
+            tokens, lengths, tables = input_vals[:3]
+            kps = list(input_vals[3:3 + L])
+            vps = list(input_vals[3 + L:])
+            logits, nk, nv = gpt_forward_paged(
+                params, self.cfg, tokens, lengths, tables, kps, vps)
+            return [logits] + nk + nv
+        return pure_fn
+
+    def example_inputs(self, rows, chunk, slots, max_len):
+        """Zero arrays shaped like one program invocation against a
+        ``slots``-slot cache of ``max_len`` (pool geometry follows the
+        PagedKVCache defaults for the current env)."""
+        import jax.numpy as jnp
+        H = self.cfg["num_heads"]
+        D = self.cfg["units"] // H
+        bs = _env_int("MXTPU_GEN_BLOCK_SIZE", 16)
+        mb = max(1, math.ceil(max_len / bs))
+        nb = slots * mb
+        ins = [jnp.zeros((rows, chunk), jnp.int32),
+               jnp.zeros((rows,), jnp.int32),
+               jnp.zeros((rows, mb), jnp.int32)]
+        ins += [jnp.zeros((nb, bs, H, D), jnp.float32)
+                for _ in range(2 * self.num_layers)]
+        return ins
+
+    def build(self, name, rows, chunk, slots, max_len):
+        import jax
+        ins = self.example_inputs(rows, chunk, slots, max_len)
+        lowered = jax.jit(self._pure()).lower(ins, self.pvals)
+        compiled, blob = _aot.cached_compile(lowered, name=name,
+                                             where="serving",
+                                             want_blob=True)
+        return _aot.BlockProgram(compiled, self.pvals, self.n_inputs,
+                                 name, blob=blob)
+
+    def bind(self, name, blob):
+        compiled = _aot.deserialize_compiled(blob)
+        return _aot.BlockProgram(compiled, self.pvals, self.n_inputs,
+                                 name, blob=blob)
+
+    def eager(self, tokens, lengths, tables, kps, vps):
+        """jit fallback (compiles on first use — the non-warm path)."""
+        if self._jit is None:
+            import jax
+            self._jit = jax.jit(self._pure())
+        return self._jit([tokens, lengths, tables] + list(kps)
+                         + list(vps), self.pvals)
+
+
+@serving_family("gpt_decoder")
+def _build_gpt_decoder(config, params, quantize):
+    """Autoregressive GPT decode over a paged KV cache. The checkpoint
+    may carry a draft model (params under ``draft/``, config under
+    ``config["draft"]``) for engine-side speculative decoding; the
+    serving DecodeLoop itself always steps the target one token at a
+    time and prefills through ``prefill_fn``."""
+    cfg = gpt_config({k: v for k, v in config.items() if k != "draft"})
+    if quantize:
+        log.info("serving: gpt_decoder has no int8 path yet; serving "
+                 "full precision")
+    target = _PagedProgramSet(cfg, params, "target")
+    draft = None
+    draft_cfg = config.get("draft")
+    if isinstance(draft_cfg, dict):
+        dparams = {k[len(_DRAFT_PREFIX):]: v for k, v in params.items()
+                   if k.startswith(_DRAFT_PREFIX)}
+        draft = _PagedProgramSet(gpt_config(draft_cfg), dparams, "draft")
+
+    L = cfg["num_layers"]
+    prefill_chunk = _env_int("MXTPU_GEN_PREFILL_CHUNK", 32)
+    geom = {"slots": None, "max_len": None}
+    decode_programs = {}
+
+    def make_cache(slots, max_len):
+        geom["slots"], geom["max_len"] = int(slots), int(max_len)
+        return PagedKVCache(slots, gpt_cache_spec(cfg), max_len=max_len,
+                            name="gpt")
+
+    def _geometry(slots):
+        return (int(slots),
+                geom["max_len"] or _env_int("MXTPU_SERVE_CACHE_LEN", 512))
+
+    def _program(pset, name, rows, chunk, slots):
+        if name not in decode_programs:
+            slots_n, max_len = _geometry(slots)
+            try:
+                decode_programs[name] = pset.build(name, rows, chunk,
+                                                   slots_n, max_len)
+            except Exception as e:  # noqa: BLE001 — an AOT build
+                # failure falls back to the jit path
+                log.warning("serving: cannot build %r (%s: %s); this "
+                            "shape serves through plain jit", name,
+                            type(e).__name__, e)
+                decode_programs[name] = None
+        return decode_programs[name]
+
+    def decode_program_for(slots):
+        return _program(target, "gptdecode/s%d" % int(slots),
+                        int(slots), 1, int(slots))
+
+    def prefill_program_for(slots):
+        name = "gptprefill/s%dxc%d" % (int(slots), prefill_chunk)
+        return _program(target, name, 1, prefill_chunk, int(slots))
+
+    def draft_program_for(slots):
+        if draft is None:
+            return None
+        return _program(draft, "gptdraft/s%d" % int(slots),
+                        int(slots), 1, int(slots))
+
+    def bind(name, blob):
+        if name.startswith("gptdecode/s") or name.startswith("gptprefill/s"):
+            decode_programs[name] = target.bind(name, blob)
+            return True
+        if name.startswith("gptdraft/s") and draft is not None:
+            decode_programs[name] = draft.bind(name, blob)
+            return True
+        return False
+
+    def _gather(cache, slots):
+        lengths = np.asarray([int(cache.lengths[s]) for s in slots],
+                             np.int32)
+        tables = cache.tables_array(slots)
+        kps = [cache.pool("k%d" % i) for i in range(L)]
+        vps = [cache.pool("v%d" % i) for i in range(L)]
+        return lengths, tables, kps, vps
+
+    def _run(pset, prog_name, prog_factory, slots_arg, tokens, lengths,
+             tables, kps, vps):
+        """One paged forward: AOT program when available/gated, jit
+        fallback otherwise. Returns the flat [logits, k..., v...]."""
+        if _ccstore.enabled() or decode_programs:
+            prog = prog_factory(slots_arg)
+            if prog is not None:
+                try:
+                    return prog(tokens, lengths, tables, *kps, *vps)
+                except TypeError:   # aval drift — retire the program
+                    decode_programs[prog_name] = None
+        return pset.eager(tokens, lengths, tables, kps, vps)
+
+    def _commit(cache, slot, row, flat, count):
+        nk, nv = flat[1:1 + L], flat[1 + L:]
+        for c in range(count):
+            for i in range(L):
+                cache.append("k%d" % i, slot, np.asarray(nk[i])[row, c])
+                cache.append("v%d" % i, slot, np.asarray(nv[i])[row, c])
+            cache.advance(slot)
+
+    def step(tokens, cache, active):
+        """DecodeLoop contract: tokens (slots,) int32 over the FULL
+        grid; commit K/V for active slots only; return (slots, V)."""
+        s = int(tokens.shape[0])
+        lengths, tables, kps, vps = _gather(cache, range(s))
+        flat = _run(target, "gptdecode/s%d" % s, decode_program_for, s,
+                    np.asarray(tokens, np.int32).reshape(s, 1), lengths,
+                    tables, kps, vps)
+        for slot in np.flatnonzero(np.asarray(active)):
+            _commit(cache, int(slot), int(slot), flat, 1)
+        return np.asarray(flat[0])[:, 0]
+
+    def prefill(slot, tokens, cache):
+        """Commit a prompt prefix into one slot in fixed-width chunks
+        (pad tokens sit after the valid ones — causal masking keeps
+        them out of every committed position's window — and their K/V
+        are simply not committed)."""
+        n_slots = geom["slots"] or cache.slots
+        name = "gptprefill/s%dxc%d" % (n_slots, prefill_chunk)
+        tokens = np.asarray(tokens, np.int32).ravel()
+        for start in range(0, len(tokens), prefill_chunk):
+            piece = tokens[start:start + prefill_chunk]
+            padded = np.zeros((1, prefill_chunk), np.int32)
+            padded[0, :len(piece)] = piece
+            lengths, tables, kps, vps = _gather(cache, [slot])
+            flat = _run(target, name, prefill_program_for, n_slots,
+                        padded, lengths, tables, kps, vps)
+            _commit(cache, slot, 0, flat, len(piece))
+
+    def extra_warmup(slots):
+        """Pre-build the generative program grid for a slot count:
+        target decode, prefill chunk, and the draft decode when the
+        checkpoint carries one. Returns {built: [...], failed: [...]}."""
+        built, failed = [], []
+        jobs = [("gptdecode/s%d" % slots, decode_program_for),
+                ("gptprefill/s%dxc%d" % (slots, prefill_chunk),
+                 prefill_program_for)]
+        if draft is not None:
+            jobs.append(("gptdraft/s%d" % slots, draft_program_for))
+        for name, factory in jobs:
+            (built if factory(slots) is not None else failed).append(name)
+        return {"built": built, "failed": failed}
+
+    served = ServedModel("gpt_decoder", config, step_fn=step,
+                         make_cache=make_cache, pad_token=0,
+                         quantized=False,
+                         decode_program_factory=decode_program_for,
+                         program_binder=bind,
+                         decode_programs=decode_programs,
+                         prefill_fn=prefill,
+                         prefill_chunk=prefill_chunk)
+    served.extra_warmup = extra_warmup
+    served.draft_program_factory = draft_program_for
+    return served
+
+
+def export_gpt_for_serving(directory, config, model, draft=None,
+                           executables=None):
+    """Write a gpt_decoder serving checkpoint: the target decoder's
+    params (flat local names), optionally a draft model's params under
+    ``draft/`` with its config under ``config["draft"]``, plus the
+    family stanza — same atomic checkpoint machinery as
+    ``export_for_serving``, extended for the two-model layout."""
+    params = {k: v.data() for k, v
+              in model._collect_params_with_prefix().items()}
+    config = dict(config)
+    if draft is not None:
+        params.update({_DRAFT_PREFIX + k: v.data() for k, v
+                       in draft._collect_params_with_prefix().items()})
+        config.setdefault("draft", getattr(draft, "config", None)
+                          or config.get("draft"))
+        if not isinstance(config.get("draft"), dict):
+            raise ValueError("draft model carries no config dict; pass "
+                             "config['draft'] explicitly")
+    mgr = CheckpointManager(directory, keep=None, async_save=False,
+                            prefix="serve")
+    mgr.save(0, params, extra={"serving": {"family": "gpt_decoder",
+                                           "config": config}},
+             executables=executables)
+    return directory

@@ -1,0 +1,260 @@
+"""Paged KV cache — block-granular allocation behind the KVCache surface.
+
+PagedAttention (Kwon et al., SOSP '23): instead of reserving a dense
+``(slots, max_len)`` strip per slot, kv entries live in a shared pool of
+``num_blocks`` fixed-size blocks and each slot holds a *block table* —
+the ordered list of pool blocks its sequence occupies. A slot consumes
+``ceil(length / block_size)`` blocks, so short sequences in a grid sized
+for long ones stop wasting ``max_len - length`` rows, and the freed
+blocks are immediately reusable by other slots.
+
+The public surface is a strict superset of ``serving.kv_cache.KVCache``
+(alloc/free/append/advance/prefix/set_state/state, same error messages),
+so the continuous-batching ``DecodeLoop`` runs unchanged on top. The
+paged extras feed the flash-decode kernel:
+
+- ``pool(name)`` — the ``(num_blocks, block_size) + per_step_shape``
+  backing array of a kv entry,
+- ``tables_array(slots)`` — an ``(S, max_blocks_per_slot)`` int32 block
+  table, padded with block 0 (padded fetches are masked by ``lengths``
+  so any valid pool row is safe),
+- ``truncate(slot, new_len)`` — roll a sequence back (speculative
+  decode rejects draft tokens by truncating the drafted suffix),
+- ``fragmentation()`` — unused fraction of mapped block capacity.
+
+State-kind entries stay dense ``(slots,) + shape`` (they are replaced,
+not appended — paging buys nothing). All kv entries share one block
+table per slot: the spec's kv entries advance in lockstep (the KVCache
+contract), so their block layouts are identical by construction.
+"""
+
+import math
+
+import numpy as np
+
+from ..telemetry import catalog as _cat
+
+__all__ = ["PagedKVCache"]
+
+_KINDS = ("state", "kv")
+
+#: default block size (positions per block); MXTPU_GEN_BLOCK_SIZE
+DEFAULT_BLOCK_SIZE = 16
+
+
+def _env_int(name, default):
+    import os
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class PagedKVCache:
+    """Drop-in paged replacement for ``serving.kv_cache.KVCache``.
+
+    Not thread-safe by itself: the decode loop is the single owner.
+
+    ``block_size`` defaults to ``MXTPU_GEN_BLOCK_SIZE`` (16); ``num_blocks``
+    defaults to ``slots * ceil(max_len / block_size)`` — full capacity
+    parity with the dense grid, so the drop-in can never refuse an
+    append the dense cache would have accepted. Size it smaller to
+    oversubscribe (appends raise when the pool is exhausted).
+    """
+
+    def __init__(self, slots, spec, max_len=512, block_size=None,
+                 num_blocks=None, name="default"):
+        if slots < 1:
+            raise ValueError("need at least one slot, got %r" % slots)
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.block_size = int(block_size or
+                              _env_int("MXTPU_GEN_BLOCK_SIZE",
+                                       DEFAULT_BLOCK_SIZE))
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1, got %r"
+                             % self.block_size)
+        self.max_blocks_per_slot = max(
+            1, math.ceil(self.max_len / self.block_size))
+        self.num_blocks = int(num_blocks or
+                              self.slots * self.max_blocks_per_slot)
+        self.name = name
+        self.spec = {}
+        self.data = {}
+        for ent_name, ent in spec.items():
+            kind, shape = ent[0], tuple(ent[1])
+            dtype = np.dtype(ent[2]) if len(ent) > 2 else np.float32
+            if kind not in _KINDS:
+                raise ValueError("entry %r: kind must be one of %s, got %r"
+                                 % (ent_name, _KINDS, kind))
+            full = ((self.slots,) + shape if kind == "state"
+                    else (self.num_blocks, self.block_size) + shape)
+            self.spec[ent_name] = (kind, shape, dtype)
+            self.data[ent_name] = np.zeros(full, dtype)
+        self.lengths = np.zeros(self.slots, np.int64)
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._live = set()
+        self._free_blocks = list(range(self.num_blocks - 1, -1, -1))
+        self._tables = {}          # slot -> [block ids], shared by kv entries
+        self._note_blocks()
+
+    # ------------------------------------------------------------- slots
+    @property
+    def in_use(self):
+        return len(self._live)
+
+    def alloc(self):
+        """Claim a zeroed slot; None when the grid is full. Blocks are
+        mapped lazily by `append`, so alloc itself never exhausts the
+        pool."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._live.add(slot)
+        self.lengths[slot] = 0
+        self._tables[slot] = []
+        for name, (kind, _shape, _dtype) in self.spec.items():
+            if kind == "state":
+                self.data[name][slot] = 0
+        self._note_blocks()
+        return slot
+
+    def free(self, slot):
+        if slot not in self._live:
+            raise ValueError("slot %r is not live" % slot)
+        self._live.remove(slot)
+        self._free.append(slot)
+        self._free_blocks.extend(reversed(self._tables.pop(slot, [])))
+        self.lengths[slot] = 0
+        self._note_blocks()
+
+    # ------------------------------------------------------------ access
+    def _check(self, slot):
+        if slot not in self._live:
+            raise ValueError("slot %r is not live" % slot)
+
+    def set_state(self, name, slot, value):
+        kind, shape, _ = self.spec[name]
+        if kind != "state":
+            raise ValueError("%r is a %r entry, not state" % (name, kind))
+        self._check(slot)
+        self.data[name][slot] = np.asarray(value).reshape(shape)
+
+    def state(self, name, slot):
+        self._check(slot)
+        return self.data[name][slot]
+
+    def append(self, name, slot, value):
+        """Write `value` at this slot's current position (all kv entries
+        share the position counter; call `advance` once per step after
+        every entry is written). Maps a fresh pool block when the
+        position crosses a block boundary."""
+        kind, shape, _ = self.spec[name]
+        if kind != "kv":
+            raise ValueError("%r is a %r entry, not kv" % (name, kind))
+        self._check(slot)
+        pos = int(self.lengths[slot])
+        if pos >= self.max_len:
+            raise ValueError("slot %d is full (max_len=%d)"
+                             % (slot, self.max_len))
+        bi, off = divmod(pos, self.block_size)
+        table = self._tables[slot]
+        if bi == len(table):
+            if not self._free_blocks:
+                raise ValueError(
+                    "paged KV pool exhausted (%d blocks of %d positions); "
+                    "slot %d needs block %d"
+                    % (self.num_blocks, self.block_size, slot, bi))
+            block = self._free_blocks.pop()
+            # zero the reused block across ALL kv entries so a partial
+            # fill never exposes a previous sequence's tail
+            for n, (k, _s, _d) in self.spec.items():
+                if k == "kv":
+                    self.data[n][block] = 0
+            table.append(block)
+            self._note_blocks()
+        self.data[name][table[bi], off] = np.asarray(value).reshape(shape)
+
+    def advance(self, slot):
+        self._check(slot)
+        self.lengths[slot] += 1
+        self._note_blocks()
+
+    def prefix(self, name, slot):
+        """The filled (length, ...) view of a kv entry for one slot
+        (gathered copy — pool rows are not contiguous)."""
+        kind = self.spec[name][0]
+        if kind != "kv":
+            raise ValueError("%r is a %r entry, not kv" % (name, kind))
+        self._check(slot)
+        length = int(self.lengths[slot])
+        if length == 0:
+            _kind, shape, dtype = self.spec[name]
+            return np.zeros((0,) + shape, dtype)
+        table = self._tables[slot]
+        nb = math.ceil(length / self.block_size)
+        rows = self.data[name][table[:nb]]          # (nb, bs) + shape
+        return rows.reshape((nb * self.block_size,) + rows.shape[2:])[:length]
+
+    # ------------------------------------------------- paged extensions
+    def pool(self, name):
+        """The (num_blocks, block_size, ...) backing array of a kv entry."""
+        kind = self.spec[name][0]
+        if kind != "kv":
+            raise ValueError("%r is a %r entry, not kv" % (name, kind))
+        return self.data[name]
+
+    def table(self, slot):
+        self._check(slot)
+        return list(self._tables[slot])
+
+    def tables_array(self, slots=None):
+        """Block tables as an (S, max_blocks_per_slot) int32 array for
+        the kernel. Unmapped entries pad with block 0 — padded fetches
+        are masked by ``lengths`` downstream, so any valid row is safe.
+        ``slots=None`` covers the full grid in slot order."""
+        order = list(range(self.slots)) if slots is None else list(slots)
+        out = np.zeros((len(order), self.max_blocks_per_slot), np.int32)
+        for row, slot in enumerate(order):
+            table = self._tables.get(slot, [])
+            out[row, :len(table)] = table
+        return out
+
+    def truncate(self, slot, new_len):
+        """Roll a slot back to ``new_len`` committed positions, freeing
+        now-unused blocks (speculative decode rejects a drafted suffix
+        this way). No-op when new_len >= current length."""
+        self._check(slot)
+        new_len = int(new_len)
+        if new_len < 0:
+            raise ValueError("new_len must be >= 0, got %r" % new_len)
+        if new_len >= int(self.lengths[slot]):
+            return
+        keep = math.ceil(new_len / self.block_size)
+        table = self._tables[slot]
+        self._free_blocks.extend(reversed(table[keep:]))
+        del table[keep:]
+        self.lengths[slot] = new_len
+        self._note_blocks()
+
+    @property
+    def blocks_in_use(self):
+        return self.num_blocks - len(self._free_blocks)
+
+    @property
+    def blocks_free(self):
+        return len(self._free_blocks)
+
+    def fragmentation(self):
+        """1 - filled_positions / mapped capacity: the ragged-last-block
+        waste. 0.0 when nothing is mapped."""
+        mapped = self.blocks_in_use * self.block_size
+        if mapped == 0:
+            return 0.0
+        filled = int(sum(int(self.lengths[s]) for s in self._live))
+        return 1.0 - filled / float(mapped)
+
+    def _note_blocks(self):
+        _cat.gen_kv_blocks_in_use.set(self.blocks_in_use, name=self.name)
+        _cat.gen_kv_blocks_free.set(self.blocks_free, name=self.name)
+        _cat.gen_kv_fragmentation.set(self.fragmentation(), name=self.name)

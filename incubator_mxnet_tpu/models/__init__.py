@@ -19,4 +19,6 @@ from .lstnet import LSTNet
 from .bert import (BERTModel, BERTForPretrain, bert_base, bert_large,
                    bert_sharding_rules, MultiHeadAttention,
                    TransformerEncoderLayer, BERTEncoder)
+from .gpt import (GPTDecoder, gpt_config, gpt_param_shapes, gpt_logits,
+                  gpt_forward_paged, gpt_sharding_rules)
 from ..gluon.model_zoo.vision import get_model  # noqa: F401

@@ -16,7 +16,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..gluon.block import HybridBlock
 
-__all__ = ["moe_apply", "MoEBlock"]
+__all__ = ["moe_apply", "moe_ffn", "MoEBlock"]
 
 
 def moe_apply(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
@@ -110,6 +110,25 @@ def moe_apply(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
                  "capacity": jnp.float32(C)}
         return out, aux, stats
     return out, aux
+
+
+def moe_ffn(x, params, prefix, top_k=2, capacity_factor=1.25,
+            ep_sharding=None):
+    """Functional MoE feed-forward over MoEBlock-style flat param names.
+
+    Pulls ``{prefix}gate_weight / expert_w1 / expert_b1 / expert_w2 /
+    expert_b2`` out of a flat name->array dict and runs :func:`moe_apply`
+    on (S, d) tokens, returning the mixed output only. This is the
+    decode-path entry: the GPT decoder's paged forward is a pure
+    function over its param dict (no gluon trace context), so it reuses
+    the routing math without the HybridBlock wrapper.
+    """
+    out, _aux = moe_apply(
+        x, params[prefix + "gate_weight"], params[prefix + "expert_w1"],
+        params[prefix + "expert_b1"], params[prefix + "expert_w2"],
+        params[prefix + "expert_b2"], capacity_factor,
+        ep_sharding=ep_sharding, top_k=top_k)
+    return out
 
 
 class MoEBlock(HybridBlock):

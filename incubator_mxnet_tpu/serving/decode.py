@@ -15,9 +15,12 @@ The step contract is model-agnostic:
 with ``tokens (slots,) int32`` (pad token in inactive rows), ``cache``
 the KVCache (the step reads/writes its entries for ALL slots at once —
 inactive rows compute garbage that is never observed), and ``active
-(slots,) bool``. Prompts are prefilled one token per step through the
-same path, so a joining request warms its KV slot without a separate
-prefill program. Greedy argmax sampling — deterministic, which the
+(slots,) bool``. By default prompts are prefilled one token per step
+through the same path, so a joining request warms its KV slot without a
+separate prefill program; families that provide a ``prefill_fn`` (the
+gpt_decoder paged family) instead get the prompt prefix committed in
+chunked forwards at admission, and the grid only ever feeds the last
+prompt token. Greedy argmax sampling — deterministic, which the
 acceptance tests rely on.
 
 Deadline shed: at join the loop estimates ``(prompt+max_new) * EWMA
@@ -94,10 +97,15 @@ class DecodeLoop:
     """One per served generative model; owns the KVCache exclusively."""
 
     def __init__(self, name, step_fn, cache, pad_token=0,
-                 max_new_tokens_cap=None):
+                 max_new_tokens_cap=None, prefill_fn=None,
+                 prefill_chunk=None):
         self.name = name
         self._step_fn = step_fn
         self._cache = cache
+        self._prefill_fn = prefill_fn
+        self._prefill_chunk = max(1, int(
+            prefill_chunk if prefill_chunk is not None
+            else os.environ.get("MXTPU_GEN_PREFILL_CHUNK", "32") or 32))
         self._pad = int(pad_token)
         self._cap = int(max_new_tokens_cap if max_new_tokens_cap is not None
                         else os.environ.get("MXTPU_SERVE_MAX_NEW_TOKENS",
@@ -174,8 +182,21 @@ class DecodeLoop:
                     "step_ewma_s": self._ewma_step}
 
     # -------------------------------------------------------- decode loop
+    def _est_steps(self, req):
+        """Grid steps a request still needs: with a family prefill_fn
+        the prompt prefix lands in ceil((P-1)/chunk) chunked forwards
+        plus one step for the last prompt token; without, one step per
+        prompt token — plus max_new decode steps either way."""
+        if self._prefill_fn is not None and req.prompt.size > 1:
+            chunks = -(-(req.prompt.size - 1) // self._prefill_chunk)
+            return chunks + 1 + req.max_new_tokens
+        return req.prompt.size + req.max_new_tokens
+
     def _admit_locked(self):
-        """Join point: fill free slots from the FIFO between steps."""
+        """Join point: fill free slots from the FIFO between steps.
+        Families with a ``prefill_fn`` get their prompt prefix committed
+        here, chunked, so the step grid only ever feeds the LAST prompt
+        token (chunked prefill replaces one-token-per-step prefill)."""
         now = time.monotonic()
         est = self._ewma_step or 0.0
         while self._pending and self._cache.in_use < self._cache.slots:
@@ -184,8 +205,7 @@ class DecodeLoop:
                 self._pending.popleft()
                 continue
             if req.deadline is not None and \
-                    now + est * (req.prompt.size + req.max_new_tokens) \
-                    > req.deadline:
+                    now + est * self._est_steps(req) > req.deadline:
                 self._pending.popleft()
                 self._shed(req, "join", "full generation can't meet "
                            "the deadline")
@@ -194,7 +214,27 @@ class DecodeLoop:
             if slot is None:
                 return
             self._pending.popleft()
-            self._active[slot] = _Seq(req)
+            seq = _Seq(req)
+            if self._prefill_fn is not None and req.prompt.size > 1:
+                t0 = time.perf_counter()
+                try:
+                    self._prefill_fn(slot, req.prompt[:-1], self._cache)
+                except Exception as e:  # noqa: BLE001 — a broken
+                    # prefill fails this request, not the serving loop
+                    if req.fail(e):
+                        _cat.serving_requests.inc(model=self.name,
+                                                  status="error")
+                    self._cache.free(slot)
+                    continue
+                dt = time.perf_counter() - t0
+                seq.fed = req.prompt.size - 1
+                _cat.gen_prefill_seconds.observe(dt, model=self.name)
+                _cat.serving_forward_seconds.observe(
+                    dt, model=self.name, bucket="prefill")
+                _cat.gen_tokens_committed.inc(
+                    req.prompt.size - 1, model=self.name,
+                    phase="prefill")
+            self._active[slot] = seq
         _cat.serving_decode_slots.set(len(self._active), model=self.name)
 
     def _run(self):
@@ -239,9 +279,20 @@ class DecodeLoop:
             _cat.serving_forward_seconds.observe(dt, model=self.name,
                                                  bucket="decode")
             now = time.monotonic()
+            # token accounting happens in the retire pass BELOW the
+            # consume, so the final step of a retiring sequence counts
+            # too (the historical undercount: per-step counters bumped
+            # before retirement skipped the buzzer token)
+            step_decode_tokens = 0
+            step_prefill_tokens = 0
             with self._cond:
                 for slot, seq in list(self._active.items()):
+                    before = len(seq.generated)
                     seq.consume(logits[slot])
+                    if len(seq.generated) > before:
+                        step_decode_tokens += 1
+                    else:
+                        step_prefill_tokens += 1
                     if seq.req.done:    # cancelled mid-flight: release
                         pass
                     elif seq.finished:
@@ -265,3 +316,9 @@ class DecodeLoop:
                     del self._active[slot]
                 _cat.serving_decode_slots.set(len(self._active),
                                               model=self.name)
+            if step_decode_tokens:
+                _cat.gen_tokens_committed.inc(
+                    step_decode_tokens, model=self.name, phase="decode")
+            if step_prefill_tokens:
+                _cat.gen_tokens_committed.inc(
+                    step_prefill_tokens, model=self.name, phase="prefill")

@@ -11,11 +11,15 @@ dtype)``:
   writes at the slot's current length, `advance` commits the position.
 
 Dense contiguous layout (one ndarray per entry, the whole grid feeds
-the step function as-is) — a paged layout (PagedAttention, Kwon et al.,
-SOSP '23) drops in behind the same alloc/free/append surface when
-ROADMAP item 5 needs fragmentation-free long contexts; at BERT/LSTM
-decode lengths the dense grid wastes at most (max_len - len) rows per
-live slot and zero compile variety (the step shape never changes).
+the step function as-is). The paged layout (PagedAttention, Kwon et
+al., SOSP '23) is delivered in ``generate/paged_kv.py``: PagedKVCache
+mirrors this exact alloc/free/append/advance/prefix surface (same
+error messages, same slot lifecycle) over a shared block pool with a
+per-slot block table, so the decode loop can't tell them apart. At
+BERT/LSTM decode lengths the dense grid stays the right default — it
+wastes at most (max_len - len) rows per live slot with zero compile
+variety (the step shape never changes); the paged cache is for the
+long-context gpt_decoder family where dense would fragment.
 
 Slot lifecycle is the continuous-batching join/leave contract:
 ``alloc`` as a request joins the in-flight batch, ``free`` the moment

@@ -68,17 +68,23 @@ class ServedModel:
                  make_cache=None, pad_token=0, quantized=False,
                  program_factory=None, decode_program_factory=None,
                  program_binder=None, warmup_signatures=None,
-                 programs=None, decode_programs=None):
+                 programs=None, decode_programs=None, prefill_fn=None,
+                 prefill_chunk=None):
         if encode_fn is None and step_fn is None:
             raise ValueError("a ServedModel needs encode_fn, step_fn, "
                              "or both")
         if (step_fn is None) != (make_cache is None):
             raise ValueError("step_fn and make_cache come together")
+        if prefill_fn is not None and step_fn is None:
+            raise ValueError("prefill_fn requires step_fn")
         self.family = family
         self.config = dict(config)
         self.encode_fn = encode_fn
         self.step_fn = step_fn
         self.make_cache = make_cache
+        self.prefill_fn = prefill_fn
+        self.prefill_chunk = (int(prefill_chunk)
+                              if prefill_chunk is not None else None)
         self.pad_token = int(pad_token)
         self.quantized = bool(quantized)
         self.program_factory = program_factory
@@ -198,6 +204,11 @@ def load_served_model(directory, quantize=None):
                          "export it with export_for_serving()" % directory)
     family = info["family"]
     builder = SERVING_FAMILIES.get(family)
+    if builder is None and family == "gpt_decoder":
+        # the generative families register on package import; a server
+        # that never touched generate/ can still load its checkpoints
+        from .. import generate  # noqa: F401
+        builder = SERVING_FAMILIES.get(family)
     if builder is None:
         raise ValueError("serving family %r is not registered in this "
                          "process" % family)

@@ -114,7 +114,10 @@ class ModelServer:
             cache = served.make_cache(n_slots, n_len)
             decode_loop = DecodeLoop(
                 name, served.step_fn, cache,
-                pad_token=served.pad_token).start()
+                pad_token=served.pad_token,
+                prefill_fn=getattr(served, "prefill_fn", None),
+                prefill_chunk=getattr(served, "prefill_chunk",
+                                      None)).start()
         tenant = _Tenant(name, served, batcher, decode_loop)
         with self._lock:
             if name in self._models:

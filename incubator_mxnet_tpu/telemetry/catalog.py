@@ -232,6 +232,39 @@ serving_models = _m.gauge(
     "mxtpu_serving_models_loaded", "Models currently loaded in the server")
 
 
+# -- generative engine (generate/) -----------------------------------
+gen_prefill_seconds = _m.histogram(
+    "mxtpu_gen_prefill_seconds",
+    "Chunked-prefill wall time per sequence by model (prompt ingestion "
+    "before the first decode step)")
+gen_decode_seconds = _m.histogram(
+    "mxtpu_gen_decode_seconds",
+    "Decode-phase wall time per engine step by model (one plain step "
+    "or one speculative propose+verify round)")
+gen_tokens_committed = _m.counter(
+    "mxtpu_gen_tokens_committed_total",
+    "Tokens committed to sequences by model and phase (prefill|decode) "
+    "— the numerator of tokens/sec")
+gen_spec_proposed = _m.counter(
+    "mxtpu_gen_spec_proposed_total",
+    "Draft tokens proposed to the target model by speculative rounds")
+gen_spec_accepted = _m.counter(
+    "mxtpu_gen_spec_accepted_total",
+    "Draft tokens accepted by target verification (accept-rate "
+    "numerator; denominator is gen_spec_proposed)")
+gen_kv_blocks_in_use = _m.gauge(
+    "mxtpu_gen_kv_blocks_in_use",
+    "Paged-KV pool blocks currently mapped into live slot block tables")
+gen_kv_blocks_free = _m.gauge(
+    "mxtpu_gen_kv_blocks_free",
+    "Paged-KV pool blocks on the free list (allocation headroom)")
+gen_kv_fragmentation = _m.gauge(
+    "mxtpu_gen_kv_fragmentation",
+    "Unused fraction of mapped paged-KV block capacity "
+    "(1 - filled_positions / (blocks_in_use * block_size)); high values "
+    "mean many ragged last blocks")
+
+
 # -- observability plane (tracing ring, flight, debugz, costs) --------
 telemetry_spans_dropped = _m.counter(
     "mxtpu_telemetry_spans_dropped_total",

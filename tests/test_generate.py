@@ -1,0 +1,693 @@
+"""generate/ suite: paged KV cache drop-in parity with the dense
+KVCache (lifecycle, error messages, eviction reuse, ragged blocks,
+truncate, pool exhaustion), flash-decode numerics (lax reference vs
+naive softmax, Pallas kernel in interpret mode, randomized
+shapes/dtypes), GPT full-forward vs incremental paged decode, engine
+invariants (prefill-chunk invariance, speculative-vs-plain greedy
+BIT-IDENTICAL pin, greedy-only guard), the serving gpt_decoder family
+end to end through ModelServer, the retire-path token-accounting pin,
+and the two-process zero-compile warm drill for the decode grid."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, serving, telemetry
+from incubator_mxnet_tpu.generate import (GenerateEngine, GPTPagedLM,
+                                          PagedKVCache,
+                                          export_gpt_for_serving)
+from incubator_mxnet_tpu.models.gpt import (GPTDecoder, gpt_config,
+                                            gpt_logits, gpt_param_shapes)
+from incubator_mxnet_tpu.ops.pallas import (paged_causal_attention,
+                                            paged_flash_decode)
+from incubator_mxnet_tpu.serving import kv_cache
+from incubator_mxnet_tpu.serving.decode import DecodeLoop, DecodeRequest
+from incubator_mxnet_tpu.telemetry import catalog as cat
+from incubator_mxnet_tpu.telemetry import metrics as _met
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    telemetry.enable()
+    _met.reset()
+    yield
+    _met.reset()
+    telemetry.disable()
+
+
+def _kv_spec(layers=1, H=2, D=4):
+    spec = {}
+    for i in range(layers):
+        spec["k%d" % i] = ("kv", (H, D))
+        spec["v%d" % i] = ("kv", (H, D))
+    return spec
+
+
+def _params(cfg, seed, scale=0.05):
+    rng = np.random.RandomState(seed)
+    return {n: (rng.randn(*s) * scale).astype(np.float32)
+            for n, s in gpt_param_shapes(cfg).items()}
+
+
+_TCFG = gpt_config({"vocab_size": 29, "units": 24, "num_layers": 2,
+                    "num_heads": 2, "max_len": 64})
+_DCFG = gpt_config({"vocab_size": 29, "units": 12, "num_layers": 1,
+                    "num_heads": 2, "max_len": 64})
+
+
+@pytest.fixture(scope="module")
+def target_lm():
+    return GPTPagedLM(_params(_TCFG, 7), _TCFG)
+
+
+@pytest.fixture(scope="module")
+def draft_lm():
+    return GPTPagedLM(_params(_DCFG, 8), _DCFG)
+
+
+# ------------------------------------------------------- paged KV cache
+def test_paged_kv_is_dropin_for_dense_surface():
+    """Same op sequence against KVCache and PagedKVCache: identical
+    alloc order, lengths, prefix contents, and state round trips."""
+    spec = {"h": ("state", (3,)), "k0": ("kv", (2, 4)), "v0": ("kv", (2, 4))}
+    dense = kv_cache.KVCache(3, spec, max_len=10)
+    paged = PagedKVCache(3, spec, max_len=10, block_size=4)
+    rng = np.random.RandomState(0)
+    for step in range(7):
+        if step == 0:
+            assert dense.alloc() == paged.alloc() == 0
+            assert dense.alloc() == paged.alloc() == 1
+        if step == 3:
+            dense.free(0)
+            paged.free(0)
+            assert dense.alloc() == paged.alloc() == 0   # LIFO reuse
+        for slot in (0, 1):
+            k = rng.randn(2, 4).astype(np.float32)
+            v = rng.randn(2, 4).astype(np.float32)
+            for c in (dense, paged):
+                c.append("k0", slot, k)
+                c.append("v0", slot, v)
+                c.advance(slot)
+        h = rng.randn(3).astype(np.float32)
+        dense.set_state("h", 1, h)
+        paged.set_state("h", 1, h)
+    for slot in (0, 1):
+        assert int(dense.lengths[slot]) == int(paged.lengths[slot])
+        for name in ("k0", "v0"):
+            np.testing.assert_array_equal(dense.prefix(name, slot),
+                                          paged.prefix(name, slot))
+    np.testing.assert_array_equal(dense.state("h", 1), paged.state("h", 1))
+    assert dense.in_use == paged.in_use == 2
+
+
+def test_paged_kv_guards_match_dense_errors():
+    paged = PagedKVCache(1, _kv_spec(), max_len=4, block_size=4)
+    with pytest.raises(ValueError, match="not live"):
+        paged.append("k0", 0, np.zeros((2, 4)))
+    with pytest.raises(ValueError, match="not live"):
+        paged.free(99)
+    slot = paged.alloc()
+    assert paged.alloc() is None                    # grid full
+    with pytest.raises(KeyError):
+        paged.append("nope", slot, 0)
+    for _ in range(4):
+        paged.append("k0", slot, np.zeros((2, 4)))
+        paged.append("v0", slot, np.zeros((2, 4)))
+        paged.advance(slot)
+    with pytest.raises(ValueError, match=r"slot 0 is full \(max_len=4\)"):
+        paged.append("k0", slot, np.zeros((2, 4)))
+    mixed = PagedKVCache(1, {"h": ("state", (2,)), "k0": ("kv", (2, 4))},
+                         max_len=4)
+    s = mixed.alloc()
+    with pytest.raises(ValueError, match="not state"):
+        mixed.set_state("k0", s, np.zeros((2, 4)))
+    with pytest.raises(ValueError, match="not kv"):
+        mixed.append("h", s, np.zeros(2))
+    with pytest.raises(ValueError, match="not kv"):
+        mixed.prefix("h", s)
+    with pytest.raises(ValueError, match="not kv"):
+        mixed.pool("h")
+
+
+def test_paged_kv_ragged_last_block_and_single_block():
+    paged = PagedKVCache(2, _kv_spec(), max_len=12, block_size=4)
+    slot = paged.alloc()
+    for i in range(6):                              # 1.5 blocks
+        paged.append("k0", slot, np.full((2, 4), i, np.float32))
+        paged.append("v0", slot, np.full((2, 4), -i, np.float32))
+        paged.advance(slot)
+    assert len(paged.table(slot)) == 2              # ragged last block
+    got = paged.prefix("k0", slot)
+    assert got.shape == (6, 2, 4)
+    np.testing.assert_array_equal(got[:, 0, 0], np.arange(6))
+    single = paged.alloc()
+    paged.append("k0", single, np.ones((2, 4)))
+    paged.append("v0", single, np.ones((2, 4)))
+    paged.advance(single)
+    assert len(paged.table(single)) == 1
+    assert paged.prefix("k0", single).shape == (1, 2, 4)
+    # ragged waste is what fragmentation measures: 7 filled / 12 mapped
+    assert paged.fragmentation() == pytest.approx(1.0 - 7.0 / 12.0)
+
+
+def test_paged_kv_eviction_reuse_zeroes_blocks():
+    """A freed slot's blocks go back to the pool; when another slot maps
+    them the reused block is zeroed across ALL kv entries, so a partial
+    fill can't expose the previous sequence's tail."""
+    paged = PagedKVCache(2, _kv_spec(), max_len=8, block_size=4,
+                         num_blocks=2)
+    a = paged.alloc()
+    for _ in range(8):
+        paged.append("k0", a, np.full((2, 4), 9.0))
+        paged.append("v0", a, np.full((2, 4), 9.0))
+        paged.advance(a)
+    blocks_a = paged.table(a)
+    assert paged.blocks_free == 0
+    paged.free(a)
+    assert paged.blocks_free == 2
+    b = paged.alloc()
+    paged.append("k0", b, np.ones((2, 4)))
+    paged.append("v0", b, np.ones((2, 4)))
+    paged.advance(b)
+    assert paged.table(b)[0] in blocks_a            # block reuse
+    pool = paged.pool("k0")
+    assert (pool[paged.table(b)[0], 1:] == 0).all()  # stale tail zeroed
+    assert (pool[paged.table(b)[0], 0] == 1).all()
+
+
+def test_paged_kv_pool_exhaustion_and_truncate():
+    paged = PagedKVCache(2, _kv_spec(), max_len=8, block_size=2,
+                         num_blocks=3)
+    a, b = paged.alloc(), paged.alloc()
+    for _ in range(4):                  # a maps 2 blocks
+        paged.append("k0", a, np.zeros((2, 4)))
+        paged.append("v0", a, np.zeros((2, 4)))
+        paged.advance(a)
+    paged.append("k0", b, np.zeros((2, 4)))
+    paged.append("v0", b, np.zeros((2, 4)))
+    paged.advance(b)                    # b maps the 3rd — pool full
+    assert paged.blocks_free == 0
+    # b can still use its ragged block's second position...
+    paged.append("k0", b, np.zeros((2, 4)))
+    paged.append("v0", b, np.zeros((2, 4)))
+    paged.advance(b)
+    # ...but crossing into a 2nd block needs the pool
+    with pytest.raises(ValueError, match="pool exhausted"):
+        paged.append("k0", b, np.zeros((2, 4)))
+    # truncating a to one block frees its suffix block for b
+    paged.truncate(a, 2)
+    assert int(paged.lengths[a]) == 2 and paged.blocks_free == 1
+    paged.append("k0", b, np.zeros((2, 4)))
+    paged.append("v0", b, np.zeros((2, 4)))
+    paged.advance(b)
+    assert int(paged.lengths[b]) == 3
+    # truncate past current length is a no-op
+    paged.truncate(a, 99)
+    assert int(paged.lengths[a]) == 2
+    with pytest.raises(ValueError, match=">= 0"):
+        paged.truncate(a, -1)
+
+
+def test_paged_kv_tables_array_and_gauges():
+    paged = PagedKVCache(3, _kv_spec(), max_len=8, block_size=2,
+                         name="gauged")
+    slot = paged.alloc()
+    for _ in range(3):
+        paged.append("k0", slot, np.zeros((2, 4)))
+        paged.append("v0", slot, np.zeros((2, 4)))
+        paged.advance(slot)
+    tables = paged.tables_array()
+    assert tables.shape == (3, 4) and tables.dtype == np.int32
+    np.testing.assert_array_equal(tables[slot, :2], paged.table(slot))
+    assert (tables[slot, 2:] == 0).all()            # padded with block 0
+    sub = paged.tables_array([slot])
+    assert sub.shape == (1, 4)
+    assert cat.gen_kv_blocks_in_use.value(name="gauged") == 2
+    assert cat.gen_kv_blocks_free.value(name="gauged") == 10
+    assert cat.gen_kv_fragmentation.value(name="gauged") \
+        == pytest.approx(1.0 - 3.0 / 4.0)
+
+
+# ------------------------------------------------------ flash decode op
+def _fill_pool(rng, S, lengths, bs, mb, H, D, dtype=np.float32):
+    """A paged pool + block tables with `lengths[s]` live positions."""
+    nb = S * mb
+    kp = rng.randn(nb, bs, H, D).astype(dtype)
+    vp = rng.randn(nb, bs, H, D).astype(dtype)
+    tables = np.zeros((S, mb), np.int32)
+    for s in range(S):
+        tables[s] = np.arange(s * mb, (s + 1) * mb)
+    return kp, vp, tables
+
+
+def _naive_past(q, kp, vp, tables, lengths, scale):
+    """Dense softmax oracle for the past term."""
+    S, C, H, D = q.shape
+    bs = kp.shape[1]
+    out = np.zeros((S, C, H, D), np.float32)
+    for s in range(S):
+        P = int(lengths[s])
+        if P == 0:
+            continue
+        k = kp[tables[s]].reshape(-1, H, D)[:P].astype(np.float32)
+        v = vp[tables[s]].reshape(-1, H, D)[:P].astype(np.float32)
+        sc = np.einsum("chd,phd->chp", q[s].astype(np.float32), k) * scale
+        w = np.exp(sc - sc.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        out[s] = np.einsum("chp,phd->chd", w, v)
+    return out
+
+
+@pytest.mark.parametrize("seed,S,H,D,bs,mb", [
+    (0, 3, 2, 8, 4, 4), (1, 1, 1, 16, 8, 2), (2, 4, 3, 8, 16, 3)])
+def test_flash_decode_lax_matches_naive_softmax(seed, S, H, D, bs, mb):
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(0, bs * mb + 1, S).astype(np.int32)
+    lengths[0] = 0                                   # always one dead row
+    q = rng.randn(S, 1, H, D).astype(np.float32)
+    kp, vp, tables = _fill_pool(rng, S, lengths, bs, mb, H, D)
+    scale = 1.0 / np.sqrt(D)
+    o, m, l = paged_flash_decode(jnp.asarray(q), jnp.asarray(kp),
+                                 jnp.asarray(vp), tables, lengths,
+                                 use_kernel=False)
+    ref = _naive_past(q, kp, vp, tables, lengths, scale)
+    np.testing.assert_allclose(np.asarray(o), ref, atol=1e-5)
+    assert (np.asarray(o)[0] == 0).all()             # dead row exact zero
+    assert float(np.asarray(l)[0, 0, 0]) == 0.0
+
+
+@pytest.mark.parametrize("seed,S,H,D,bs,mb,dtype", [
+    (3, 2, 2, 8, 8, 2, np.float32),
+    (4, 3, 1, 16, 4, 4, np.float32),
+    (5, 2, 2, 8, 8, 2, jnp.bfloat16)])
+def test_flash_decode_kernel_interpret_matches_lax(seed, S, H, D, bs, mb,
+                                                   dtype):
+    """The Pallas kernel (interpret mode — the CPU tier-1 path) agrees
+    with the lax reference on o, m, and l, including a dead row."""
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(0, bs * mb + 1, S).astype(np.int32)
+    lengths[-1] = 0
+    q = jnp.asarray(rng.randn(S, 1, H, D), dtype)
+    kp = jnp.asarray(rng.randn(S * mb, bs, H, D), dtype)
+    vp = jnp.asarray(rng.randn(S * mb, bs, H, D), dtype)
+    tables = np.zeros((S, mb), np.int32)
+    for s in range(S):
+        tables[s] = np.arange(s * mb, (s + 1) * mb)
+    o_ref, m_ref, l_ref = paged_flash_decode(q, kp, vp, tables, lengths,
+                                             use_kernel=False)
+    o_k, m_k, l_k = paged_flash_decode(q, kp, vp, tables, lengths,
+                                       use_kernel=True, interpret=True)
+    tol = 3e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(o_k, np.float32),
+                               np.asarray(o_ref, np.float32), atol=tol)
+    np.testing.assert_allclose(np.asarray(m_k), np.asarray(m_ref),
+                               atol=tol)
+    np.testing.assert_allclose(np.asarray(l_k), np.asarray(l_ref),
+                               rtol=2e-2 if dtype == jnp.bfloat16
+                               else 1e-5, atol=1e-6)
+    assert (np.asarray(o_k, np.float32)[-1] == 0).all()
+
+
+@pytest.mark.parametrize("seed,C,past", [(0, 1, 5), (1, 4, 0),
+                                         (2, 3, 7), (3, 8, 11)])
+def test_paged_causal_attention_matches_dense_reference(seed, C, past):
+    """Past-plus-chunk merge == dense causal softmax over the
+    concatenated sequence, including the empty-past edge."""
+    S, H, D, bs, mb = 2, 2, 8, 4, 4
+    rng = np.random.RandomState(seed)
+    lengths = np.full(S, past, np.int32)
+    q = rng.randn(S, C, H, D).astype(np.float32)
+    k_new = rng.randn(S, C, H, D).astype(np.float32)
+    v_new = rng.randn(S, C, H, D).astype(np.float32)
+    kp, vp, tables = _fill_pool(rng, S, lengths, bs, mb, H, D)
+    scale = 1.0 / np.sqrt(D)
+    out = np.asarray(paged_causal_attention(
+        jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+        jnp.asarray(kp), jnp.asarray(vp), tables, lengths,
+        use_kernel=False))
+    for s in range(S):
+        k_all = np.concatenate(
+            [kp[tables[s]].reshape(-1, H, D)[:past], k_new[s]], 0)
+        v_all = np.concatenate(
+            [vp[tables[s]].reshape(-1, H, D)[:past], v_new[s]], 0)
+        for c in range(C):
+            n = past + c + 1
+            sc = np.einsum("hd,phd->hp", q[s, c],
+                           k_all[:n].astype(np.float32)) * scale
+            w = np.exp(sc - sc.max(-1, keepdims=True))
+            w /= w.sum(-1, keepdims=True)
+            ref = np.einsum("hp,phd->hd", w, v_all[:n])
+            np.testing.assert_allclose(out[s, c], ref, atol=1e-5)
+
+
+# ------------------------------------------------------------ gpt model
+def test_gpt_full_forward_matches_incremental_paged_decode(target_lm):
+    """Feeding a sequence token by token through the paged path yields
+    the same next-token logits as the full dense causal forward."""
+    tokens = [3, 5, 7, 2, 11, 1, 4, 9]
+    full = np.asarray(gpt_logits(target_lm.params, _TCFG,
+                                 jnp.asarray([tokens], jnp.int32)))[0]
+    cache = target_lm.make_cache(1, max_len=32)
+    eng = GenerateEngine(target_lm, cache)
+    slot = cache.alloc()
+    inc = []
+    for t in tokens:
+        logits = eng._step(target_lm, cache, [slot],
+                           np.asarray([[t]], np.int32))
+        inc.append(logits[0])
+    np.testing.assert_allclose(np.asarray(inc), full, atol=1e-4)
+    cache.free(slot)
+
+
+def test_gpt_decoder_block_registers_flat_params():
+    m = GPTDecoder(prefix="tp_", vocab_size=11, units=8, num_layers=1,
+                   num_heads=2, max_len=16)
+    m.initialize(mx.init.Normal(0.05))
+    out = m(nd.array(np.zeros((2, 3), np.int32)))
+    assert out.shape == (2, 3, 11)
+    names = set(m._collect_params_with_prefix())
+    assert names == set(gpt_param_shapes(m.config))
+
+
+def test_gpt_moe_config_shapes_and_forward():
+    cfg = gpt_config({"vocab_size": 13, "units": 8, "num_layers": 1,
+                      "num_heads": 2, "max_len": 16, "moe_experts": 2})
+    shapes = gpt_param_shapes(cfg)
+    assert shapes["h0_gate_weight"] == (8, 2)
+    assert shapes["h0_expert_w1"] == (2, 8, 32)
+    assert "h0_fc_w" not in shapes
+    params = {n: jnp.asarray(np.random.RandomState(0).randn(*s) * 0.05,
+                             jnp.float32) for n, s in shapes.items()}
+    out = np.asarray(gpt_logits(params, cfg,
+                                jnp.asarray([[1, 2, 3]], jnp.int32)))
+    assert out.shape == (1, 3, 13) and np.isfinite(out).all()
+
+
+# --------------------------------------------------------------- engine
+def test_engine_prefill_chunk_invariance(target_lm):
+    """Chunk width is an execution detail: any chunking of the prompt
+    commits identical K/V, so greedy output can't depend on it."""
+    prompts = [[3, 5, 7, 2, 11, 1, 4, 9, 8, 6, 2], [9, 8]]
+    outs = []
+    for chunk in (1, 3, 32):
+        eng = GenerateEngine(target_lm, target_lm.make_cache(4, max_len=64),
+                             prefill_chunk=chunk)
+        outs.append(eng.generate(prompts, max_new_tokens=8))
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_engine_speculative_bit_identical_to_plain_greedy(target_lm,
+                                                          draft_lm):
+    """THE speculation pin: same tokens as plain greedy, token for
+    token — including at exact cache capacity, where the verify width
+    shrinks rather than overflowing the paged pool."""
+    prompts = [[3, 5, 7, 2, 11, 1, 4], [9, 8]]
+    plain = GenerateEngine(
+        target_lm, target_lm.make_cache(4, max_len=64)).generate(
+            prompts, max_new_tokens=12)
+    spec = GenerateEngine(
+        target_lm, target_lm.make_cache(4, max_len=64), draft=draft_lm,
+        draft_cache=draft_lm.make_cache(4, max_len=64), spec_k=3)
+    assert spec.generate(prompts, max_new_tokens=12) == plain
+    st = spec.last_stats
+    assert st["proposed"] > 0 and st["decode_tokens"] == 24
+    assert cat.gen_spec_proposed.value(model="gpt") == st["proposed"]
+    assert cat.gen_spec_accepted.value(model="gpt") == st["accepted"]
+    # prompt 7 + new 12 == max_len 19: the last verify must narrow
+    tight = GenerateEngine(
+        target_lm, target_lm.make_cache(2, max_len=19), draft=draft_lm,
+        draft_cache=draft_lm.make_cache(2, max_len=19), spec_k=3)
+    assert tight.generate(prompts, max_new_tokens=12) == plain
+
+
+def test_engine_self_speculation_accepts_every_proposal(target_lm):
+    """Draft == target: every draft token matches the target argmax, so
+    the accept-rate pins at 1.0 — the counters' sanity anchor."""
+    eng = GenerateEngine(
+        target_lm, target_lm.make_cache(2, max_len=64), draft=target_lm,
+        draft_cache=target_lm.make_cache(2, max_len=64), spec_k=4)
+    plain = GenerateEngine(
+        target_lm, target_lm.make_cache(2, max_len=64)).generate(
+            [[3, 5, 7]], max_new_tokens=10)
+    assert eng.generate([[3, 5, 7]], max_new_tokens=10) == plain
+    st = eng.last_stats
+    assert st["proposed"] > 0 and st["accepted"] == st["proposed"]
+
+
+def test_engine_guards(target_lm, draft_lm):
+    with pytest.raises(ValueError, match="greedy-only"):
+        GenerateEngine(target_lm, target_lm.make_cache(2),
+                       draft=draft_lm,
+                       draft_cache=draft_lm.make_cache(2),
+                       temperature=0.7)
+    with pytest.raises(ValueError, match="come together"):
+        GenerateEngine(target_lm, target_lm.make_cache(2), draft=draft_lm)
+    eng = GenerateEngine(target_lm, target_lm.make_cache(2, max_len=8))
+    with pytest.raises(ValueError, match="exceeds cache max_len"):
+        eng.generate([[1, 2, 3]], max_new_tokens=6)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.generate([[]], max_new_tokens=2)
+    # every slot freed even after the raise above
+    assert eng.cache.in_use == 0
+
+
+def test_engine_eos_and_slot_release(target_lm):
+    eng = GenerateEngine(target_lm, target_lm.make_cache(2, max_len=64))
+    out = eng.generate([[3, 5, 7]], max_new_tokens=10)[0]
+    eos = out[2]
+    got = eng.generate([[3, 5, 7]], max_new_tokens=10, eos_id=eos)[0]
+    assert got == out[:out.index(eos) + 1] and got[-1] == eos
+    assert eng.cache.in_use == 0
+    # telemetry: committed decode tokens account every generated token
+    assert cat.gen_tokens_committed.value(model="gpt", phase="decode") \
+        == len(out) + len(got)
+
+
+# ------------------------------------------- serving: accounting + loop
+def _counting_step(vocab=10):
+    def step(tokens, cache, active):
+        logits = np.zeros((tokens.shape[0], vocab), np.float32)
+        for slot in range(tokens.shape[0]):
+            if active[slot]:
+                logits[slot, (int(tokens[slot]) + 1) % vocab] = 1.0
+        return logits
+    return step
+
+
+def test_decode_loop_runs_unchanged_on_paged_cache():
+    """The DecodeLoop acceptance: PagedKVCache slots in behind the
+    dense cache's surface with no loop changes."""
+    cache = PagedKVCache(2, {"h": ("state", (1,))}, max_len=64)
+    loop = DecodeLoop("lm", _counting_step(), cache, pad_token=0)
+    loop.start()
+    try:
+        r = loop.submit(DecodeRequest("lm", [3, 4], max_new_tokens=4))
+        np.testing.assert_array_equal(r.wait(10.0)["tokens"], [5, 6, 7, 8])
+        r2 = loop.submit(DecodeRequest("lm", [7], max_new_tokens=5,
+                                       eos_id=9))
+        np.testing.assert_array_equal(r2.wait(10.0)["tokens"], [8, 9])
+    finally:
+        loop.stop()
+    assert cache.in_use == 0 and cache.blocks_in_use == 0
+
+
+def test_retire_path_counts_the_final_step_token():
+    """The round-14 bugfix pin: per-step token accounting runs in the
+    retire pass AFTER consume, so the buzzer token of a retiring
+    sequence is counted. prompt P, max_new N => exactly P-1 prefill +
+    N decode tokens, the last of which lands on the retiring step."""
+    cache = PagedKVCache(1, {"h": ("state", (1,))}, max_len=64)
+    loop = DecodeLoop("acct", _counting_step(), cache, pad_token=0)
+    loop.start()
+    try:
+        r = loop.submit(DecodeRequest("acct", [1, 2, 3], max_new_tokens=4))
+        assert r.wait(10.0)["tokens"].size == 4
+    finally:
+        loop.stop()
+    assert cat.gen_tokens_committed.value(model="acct",
+                                          phase="decode") == 4
+    assert cat.gen_tokens_committed.value(model="acct",
+                                          phase="prefill") == 2
+    # grid steps: P-1 prefill-feeds + N decode steps = 6
+    assert cat.serving_decode_steps.value(model="acct") == 6
+
+
+def test_decode_loop_family_prefill_fn_is_used_and_counted():
+    """With a family prefill_fn the prompt prefix commits at admission
+    (chunked) and only the LAST prompt token goes through the grid."""
+    calls = []
+
+    def prefill(slot, tokens, cache):
+        calls.append((slot, list(map(int, tokens))))
+        for _ in tokens:
+            cache.advance(slot)     # commit positions like the family
+
+    cache = PagedKVCache(1, {"h": ("state", (1,))}, max_len=64)
+    loop = DecodeLoop("pf", _counting_step(), cache, pad_token=0,
+                      prefill_fn=prefill, prefill_chunk=8)
+    loop.start()
+    try:
+        r = loop.submit(DecodeRequest("pf", [1, 2, 3, 4], max_new_tokens=3))
+        np.testing.assert_array_equal(r.wait(10.0)["tokens"], [5, 6, 7])
+    finally:
+        loop.stop()
+    assert calls == [(0, [1, 2, 3])]                # prefix only
+    assert cat.gen_tokens_committed.value(model="pf", phase="prefill") == 3
+    assert cat.gen_tokens_committed.value(model="pf", phase="decode") == 3
+    assert cat.serving_decode_steps.value(model="pf") == 3  # no prompt steps
+    assert cat.gen_prefill_seconds.count(model="pf") == 1
+
+
+def test_decode_loop_prefill_failure_fails_request_not_loop():
+    def broken(slot, tokens, cache):
+        raise RuntimeError("prefill exploded")
+
+    cache = PagedKVCache(1, {"h": ("state", (1,))}, max_len=64)
+    loop = DecodeLoop("pfx", _counting_step(), cache, pad_token=0,
+                      prefill_fn=broken, prefill_chunk=8)
+    loop.start()
+    try:
+        bad = loop.submit(DecodeRequest("pfx", [1, 2], max_new_tokens=2))
+        with pytest.raises(RuntimeError, match="prefill exploded"):
+            bad.wait(10.0)
+        # single-token prompts skip prefill: the loop still serves
+        ok = loop.submit(DecodeRequest("pfx", [5], max_new_tokens=2))
+        np.testing.assert_array_equal(ok.wait(10.0)["tokens"], [6, 7])
+    finally:
+        loop.stop()
+    assert cache.in_use == 0
+
+
+# ------------------------------------------------- serving: gpt family
+def _tiny_gpt(prefix="sgpt_", **over):
+    cfg = dict(vocab_size=37, units=16, num_layers=1, num_heads=2,
+               max_len=64)
+    cfg.update(over)
+    m = GPTDecoder(prefix=prefix, **cfg)
+    m.initialize(mx.init.Normal(0.05))
+    m(nd.array(np.zeros((1, 4), np.int32)))
+    return m, cfg
+
+
+def test_gpt_family_serves_and_matches_engine_greedy(tmp_path):
+    model, cfg = _tiny_gpt()
+    draft, dcfg = _tiny_gpt(prefix="sgptd_", units=8)
+    ckpt = str(tmp_path / "gpt_serve")
+    export_gpt_for_serving(ckpt, cfg, model, draft=draft)
+    srv = serving.ModelServer()
+    srv.load("gpt", directory=ckpt, slots=2, cache_len=64)
+    srv.start()
+    try:
+        client = serving.ServingClient(srv.addr)
+        prompt = np.array([3, 5, 7, 2, 11, 1, 4], np.int32)
+        toks = client.decode("gpt", prompt, max_new_tokens=8)
+        assert toks.shape == (8,)
+        one = client.decode("gpt", np.array([5], np.int32),
+                            max_new_tokens=3)
+        assert one.shape == (3,)
+        # the loop's chunked prefill committed exactly the prompt
+        # prefix (the 1-token prompt has no prefix)
+        assert cat.gen_tokens_committed.value(
+            model="gpt", phase="prefill") == prompt.size - 1
+        params = {k: np.asarray(v.data()._data)
+                  for k, v in model._collect_params_with_prefix().items()}
+        lm = GPTPagedLM(params, cfg)
+        eng = GenerateEngine(lm, lm.make_cache(2, max_len=64))
+        ref = eng.generate([prompt.tolist()], max_new_tokens=8)[0]
+        assert toks.tolist() == ref
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_export_gpt_requires_draft_config(tmp_path):
+    model, cfg = _tiny_gpt()
+
+    class NoConfig:
+        def _collect_params_with_prefix(self):
+            return {}
+    with pytest.raises(ValueError, match="draft model carries no config"):
+        export_gpt_for_serving(str(tmp_path / "x"), cfg, model,
+                               draft=NoConfig())
+
+
+_WARM_GPT_CHILD = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, sys.argv[3])
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.serving import loader as L
+from incubator_mxnet_tpu.telemetry import catalog as cat
+
+telemetry.enable()
+cat.install_jax_compile_hook()
+served = L.load_served_model(sys.argv[1], quantize=False)
+assert served.decode_programs, "warm child bound no decode programs"
+cache = served.make_cache(2, 64)
+slot = cache.alloc()
+base = cat.compile_events()
+served.prefill_fn(slot, np.array([3, 5, 7, 2, 11, 1], np.int32), cache)
+toks = np.zeros(2, np.int32)
+toks[slot] = 4
+out = []
+active = np.array([True, False])
+for _ in range(4):
+    logits = served.step_fn(toks, cache, active)
+    nxt = int(np.argmax(logits[slot]))
+    out.append(nxt)
+    toks[slot] = nxt
+events = cat.compile_events() - base
+print(json.dumps({"tag": "warm_child", "events": events, "tokens": out}))
+"""
+
+
+def test_warm_gpt_serving_two_process_drill(tmp_path, monkeypatch):
+    """The round-14 acceptance drill: a restarted replica that binds
+    the gpt decode-grid executables (decode step + prefill chunk) from
+    the checkpoint serves its first generative request with ZERO
+    backend_compile events — and the same tokens."""
+    cat.install_jax_compile_hook()
+    cache_dir = str(tmp_path / "ccache")
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", cache_dir)
+    monkeypatch.setenv("MXTPU_SERVE_CACHE_LEN", "64")
+    from incubator_mxnet_tpu.serving import loader as L
+    model, cfg = _tiny_gpt(prefix="wgpt_")
+    ckpt = str(tmp_path / "serve")
+    export_gpt_for_serving(ckpt, cfg, model)
+    served = L.load_served_model(ckpt, quantize=False)
+    cache = served.make_cache(2, 64)
+    slot = cache.alloc()
+    served.prefill_fn(slot, np.array([3, 5, 7, 2, 11, 1], np.int32), cache)
+    toks = np.zeros(2, np.int32)
+    toks[slot] = 4
+    ref = []
+    active = np.array([True, False])
+    for _ in range(4):
+        logits = served.step_fn(toks, cache, active)
+        nxt = int(np.argmax(logits[slot]))
+        ref.append(nxt)
+        toks[slot] = nxt
+    wu = served.extra_warmup(2)
+    assert not wu["failed"], wu
+    L.attach_executables(ckpt, served.export_executables())
+    env = dict(os.environ)
+    env.pop("MXTPU_COMPILE_CACHE_DIR", None)     # executables only
+    env["MXTPU_SERVE_CACHE_LEN"] = "64"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _WARM_GPT_CHILD, ckpt, "-", repo],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = next(json.loads(ln) for ln in proc.stdout.splitlines()
+               if ln.strip().startswith("{") and "warm_child" in ln)
+    assert rec["events"] == 0, \
+        "warm replica compiled %d time(s)" % rec["events"]
+    assert rec["tokens"] == ref

@@ -63,6 +63,15 @@ def lower_is_better(rec):
     return bool(rec.get("lower_is_better"))
 
 
+def baselines(old, new):
+    """Gate-worthy metrics appearing for the FIRST time in the newer
+    round (e.g. llm_decode's debut). They can't be diffed yet, but they
+    must not vanish silently either: name them so the reader knows the
+    round established a baseline that gates from the next round on."""
+    return [m for m in sorted(set(new) - set(old))
+            if comparable(new[m]) or lower_is_better(new[m])]
+
+
 def diff(old, new, threshold):
     """[(metric, kind, old, new, ratio, regressed)] over shared rows."""
     rows = []
@@ -109,11 +118,20 @@ def main(argv=None):
     old = load_round(old_path)
     new = load_round(new_path)
     rows = diff(old, new, args.threshold)
+    fresh = baselines(old, new)
 
     print("bench_diff: %s -> %s (gate: -%.0f%%)"
           % (os.path.basename(old_path), os.path.basename(new_path),
              args.threshold * 100))
+    for metric in fresh:
+        print("  %-9s %-52s %27.2f  baseline established — gated "
+              "from next round" % ("new", metric, new[metric]["value"]))
     if not rows:
+        if fresh:
+            print("bench_diff: ok (no shared metrics yet — %d new "
+                  "baseline%s)" % (len(fresh), "" if len(fresh) == 1
+                                   else "s"))
+            return 0
         print("no shared throughput metrics between the two rounds")
         return 2
     failed = False

@@ -5,8 +5,9 @@ Walks the scheduler's membership view via telemetry.aggregate.scrape()
 once per interval and renders per-member rates: kvstore push bytes/s,
 rpc retries, compile seconds, guardian skips, membership epoch, and —
 for model servers passed with --serving — QPS, p99 latency, batch
-occupancy, and shed counts. Counters are turned into rates by diffing
-consecutive scrapes.
+occupancy, shed counts, and (for generative families) committed
+tokens/sec plus the speculative-decode accept-rate. Counters are
+turned into rates by diffing consecutive scrapes.
 
 With --stream (or MXTPU_STREAM_ADDR) the frame adds an input-plane
 rollup — records/s, shard reassignments, quarantined shards, fetch-wait
@@ -98,15 +99,19 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
                         r.get(key + "/push_bytes", 0.0),
                         r.get(key + "/retries", 0.0), compile_s, skips))
 
-    # serving rollup (per model): QPS / p99 / occupancy / shed
+    # serving rollup (per model): QPS / p99 / occupancy / shed, plus
+    # the generative-engine columns — TOK/s (rate of committed decode+
+    # prefill tokens) and ACC% (speculation accept-rate) — which stay
+    # "-" for encoder-only models that never bump the gen_* counters
     req = reg.get("mxtpu_serving_requests_total") or {}
     models = sorted({seg.split("model=", 1)[1].split(",")[0]
                      for seg in (req.get("series") or {})
                      if "model=" in seg})
     if models:
         lines.append("")
-        lines.append("%-16s %8s %9s %10s %7s"
-                     % ("MODEL", "QPS", "p99 ms", "OCCUPANCY", "SHED"))
+        lines.append("%-16s %8s %9s %10s %7s %9s %6s"
+                     % ("MODEL", "QPS", "p99 ms", "OCCUPANCY", "SHED",
+                        "TOK/s", "ACC%"))
         lat = reg.get("mxtpu_serving_request_seconds") or {}
         occ = reg.get("mxtpu_serving_batch_occupancy") or {}
         for model in models:
@@ -127,11 +132,28 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
                         and sval.get("count"):
                     occ_mean = sval["sum"] / sval["count"]
             shed = _series_sum(reg, "mxtpu_serving_shed_total", where=sel)
-            lines.append("%-16s %8.1f %9s %10s %7.0f"
+            toks = _series_sum(reg, "mxtpu_gen_tokens_committed_total",
+                               where=sel)
+            tok_key = "serve/%s/tokens" % model
+            tok_rate = None
+            if toks:
+                totals[tok_key] = toks
+                tok_rate = _rates({tok_key: prev_totals.get(tok_key,
+                                                            0.0)},
+                                  {tok_key: toks}, elapsed)[tok_key]
+            proposed = _series_sum(reg, "mxtpu_gen_spec_proposed_total",
+                                   where=sel)
+            accepted = _series_sum(reg, "mxtpu_gen_spec_accepted_total",
+                                   where=sel)
+            acc = 100.0 * accepted / proposed if proposed else None
+            lines.append("%-16s %8.1f %9s %10s %7.0f %9s %6s"
                          % (model, qps,
                             "%.1f" % (p99 * 1e3) if p99 is not None else "-",
                             "%.1f" % occ_mean if occ_mean is not None
-                            else "-", shed))
+                            else "-", shed,
+                            "%.0f" % tok_rate if tok_rate is not None
+                            else "-",
+                            "%.1f" % acc if acc is not None else "-"))
 
     # stream rollup: input-plane throughput + failure accounting
     served = _series_sum(reg, "mxtpu_stream_batches_served_total")

@@ -4,8 +4,11 @@ traffic.
 
 Drives compilecache.warmup.warmup_serving against a serving checkpoint
 directory: every (row count x sequence bucket) encode signature of the
-bucket grid plus the decode slot program, all built through the
-persistent compile cache (MXTPU_COMPILE_CACHE_DIR). With --attach the
+bucket grid plus the decode slot program — and, for generative
+families like gpt_decoder, the full decode program grid (slot step,
+chunked prefill, draft verify) via the family's extra_warmup hook —
+all built through the persistent compile cache
+(MXTPU_COMPILE_CACHE_DIR). With --attach the
 serialized executables are also written back into the checkpoint's
 ``executables`` section, so replicas on machines that never shared this
 cache directory still skip XLA compilation on load.
