@@ -76,12 +76,34 @@ def _train_rate(tr, data, label, batch, steps, chunk_default=10):
     return _timed_rate(run, batch * n_chunks * chunk)
 
 
+_PLATFORM = None
+
+
+def _platform_info():
+    """Cached {platform, device_kind} stamp carried by every metric
+    line: a round recorded on CPU must never be throughput-gated
+    against a TPU round (tools/bench_diff.py warn-skips
+    cross-platform adjacent pairs instead of failing them)."""
+    global _PLATFORM
+    if _PLATFORM is None:
+        try:
+            import jax
+            d = jax.devices()[0]
+            _PLATFORM = {"platform": str(d.platform),
+                         "device_kind": str(getattr(d, "device_kind",
+                                                    d.platform))}
+        except Exception:   # noqa: BLE001 — the row must land unstamped
+            _PLATFORM = {"platform": "unknown", "device_kind": "unknown"}
+    return _PLATFORM
+
+
 def _emit(metric, unit, stats, baseline=None, baseline_desc=None, **extra):
     """One JSON line per metric: median value + repeat/spread fields, and
     an explicit statement of WHAT vs_baseline divides by (r4 weak #6:
     unit-tagged denominators, no silent apples-to-oranges)."""
     line = {"metric": metric, "value": round(stats["value"], 2),
             "unit": unit}
+    line.update(_platform_info())
     if baseline:
         line["vs_baseline"] = round(stats["value"] / baseline, 2)
         if baseline_desc:
@@ -1481,6 +1503,258 @@ def _emit_telemetry_summary():
     print(json.dumps(line))
 
 
+# --------------------------------------------------------------------------
+# MFU A/B (r15): overlap + fused optimizer, on vs off, SAME config in the
+# SAME round — the acceptance rows for the comm/compute-overlap +
+# fused-multi-tensor-optimizer work. BENCH_MODEL=mfu_ab.
+# --------------------------------------------------------------------------
+
+def _mfu_ab_fused_arm(enabled, steps, width, depth):
+    """One fused-optimizer arm: the EAGER gluon.Trainer update path on a
+    deep narrow MLP — many small params, so the per-param path pays one
+    jitted dispatch per parameter per step while the fused path folds
+    each dtype-homogeneous group into a single packed launch. (The
+    traced ShardedTrainer only engages the fused launch on TPU, where
+    it is really one Pallas launch — the eager path is where the fold
+    pays on every backend.)"""
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.telemetry import catalog as cat
+    prev = os.environ.get("MXTPU_FUSED_OPTIM")
+    os.environ["MXTPU_FUSED_OPTIM"] = "1" if enabled else "0"
+    try:
+        np.random.seed(0)
+        net = gluon.nn.HybridSequential(prefix="abf%d_" % int(enabled))
+        with net.name_scope():
+            for _ in range(depth):
+                net.add(gluon.nn.Dense(width, activation="relu",
+                                       in_units=width))
+            net.add(gluon.nn.Dense(8, in_units=width))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        B = 32
+        X = nd.array(np.random.rand(B, width).astype(np.float32))
+        y = nd.array(np.random.randint(0, 8, (B,)).astype(np.int32))
+        params = list(net.collect_params().values())
+
+        def one_step():
+            with autograd.record():
+                loss = loss_fn(net(X), y).mean()
+            loss.backward()
+            tr.step(B)
+
+        def window():
+            for _ in range(steps):
+                one_step()
+            for p in params:        # drain async dispatch honestly
+                np.asarray(p.data()._data)
+
+        one_step()                  # warm the per-op jit caches
+        c0 = float(cat.optim_fused_launches.value())
+        stats = _timed_rate(window, B * steps)
+        launches = float(cat.optim_fused_launches.value()) - c0
+        return stats, launches
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_FUSED_OPTIM", None)
+        else:
+            os.environ["MXTPU_FUSED_OPTIM"] = prev
+
+
+def _mfu_ab_ps_worker(rank, steps, width, depth, queue):
+    """Spawned dist_sync worker for the overlap A/B: times a steady-state
+    step window (after a kv-init warmup step) and ships back steps/sec
+    plus the trainer_overlap_pct gauge. MXTPU_PS_BUCKET_MB and the cpu
+    platform pin ride the environment set by the parent before spawn."""
+    try:
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu import autograd, gluon, nd, telemetry
+        telemetry.enable()
+        np.random.seed(0)
+        net = gluon.nn.HybridSequential(prefix="abps_")
+        with net.name_scope():
+            for _ in range(depth):
+                net.add(gluon.nn.Dense(width, activation="relu",
+                                       in_units=width))
+            net.add(gluon.nn.Dense(8, in_units=width))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.01, "momentum": 0.9},
+                           kvstore="dist_sync")
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        rng = np.random.RandomState(100 + rank)
+        X = nd.array(rng.rand(8, width).astype(np.float32))
+        y = nd.array(rng.randint(0, 8, (8,)).astype(np.int32))
+        params = list(net.collect_params().values())
+
+        def one_step():
+            with autograd.record():
+                loss = loss_fn(net(X), y).mean()
+            loss.backward()
+            tr.step(8)
+            return loss
+
+        one_step()                  # warmup: kv init + first sync round
+        for p in params:
+            p.data()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = one_step()
+        for p in params:            # drain: deferred pulls land INSIDE
+            p.data()                # the timed window
+        final = float(np.asarray(loss._data))
+        dt = time.perf_counter() - t0
+        from incubator_mxnet_tpu.telemetry import catalog as cat
+        pct = float(cat.trainer_overlap_pct.value())
+        tr._kvstore.barrier()
+        tr._kvstore.close()
+        queue.put((rank, {"steps_per_sec": steps / dt, "overlap_pct": pct,
+                          "bucketed": tr._bucketed, "final_loss": final}))
+    except Exception as e:   # noqa: BLE001 — report, don't hang the bench
+        import traceback
+        queue.put((rank, "ERROR: %s\n%s" % (e, traceback.format_exc())))
+
+
+def _mfu_ab_ps_drill(bucket_mb, steps, width, depth, n_workers=2):
+    """Run one overlap arm: scheduler + 1 server + n_workers dist_sync
+    processes on loopback, all pinned to cpu (the overlap pipeline is
+    host/RPC-side; workers must not fight over an accelerator). Returns
+    {"steps_per_sec", "overlap_pct", "final_loss"} averaged over ranks."""
+    import multiprocessing
+    import socket
+    from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
+                                                         run_server,
+                                                         SchedulerClient)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n_workers), "DMLC_NUM_SERVER": "1",
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+        "MXTPU_PS_RETRY_WINDOW": "60",
+        "MXTPU_PS_HEARTBEAT_INTERVAL": "1",
+        "MXTPU_PS_BUCKET_MB": bucket_mb,
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    try:
+        sched = ctx.Process(target=run_scheduler,
+                            args=(port, n_workers, 1), daemon=True)
+        sched.start()
+        procs.append(sched)
+        time.sleep(0.3)
+        server = ctx.Process(target=run_server,
+                             args=(("127.0.0.1", port), n_workers),
+                             daemon=True)
+        server.start()
+        procs.append(server)
+        queue = ctx.Queue()
+        for r in range(n_workers):
+            w = ctx.Process(target=_mfu_ab_ps_worker,
+                            args=(r, steps, width, depth, queue),
+                            daemon=True)
+            w.start()
+            procs.append(w)
+        results = {}
+        for _ in range(n_workers):
+            rank, res = queue.get(timeout=600)
+            assert not isinstance(res, str), res
+            results[rank] = res
+        SchedulerClient(("127.0.0.1", port)).shutdown()
+        n = float(len(results))
+        return {"steps_per_sec": sum(r["steps_per_sec"]
+                                     for r in results.values()) / n,
+                "overlap_pct": sum(r["overlap_pct"]
+                                   for r in results.values()) / n,
+                "final_loss": results[0]["final_loss"]}
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def bench_mfu_ab():
+    """BENCH_MODEL=mfu_ab: same-config A/B rows, toggled by env only.
+
+    Two pairs: fused-optimizer on/off through the ShardedTrainer
+    _train_rate window, and PS-overlap on/off over a REAL two-process
+    dist_sync group on loopback, with the trainer_overlap_pct gauge read
+    inside the workers. Deltas ride the 'on' rows. The two-worker sync
+    fold is bit-deterministic, so the arms must agree on the final loss
+    — asserted here, the same pin tests/test_ps_overlap.py holds.
+    The fused pair runs the eager update path, where the fold saves one
+    jitted dispatch per parameter per step on EVERY backend; the rows
+    exist so every round records the SAME A/B and same-platform
+    adjacent rounds stay comparable."""
+    # default shape is LAUNCH-bound (many tiny params), the regime the
+    # fused path exists for — at 256-wide layers the update compute
+    # drowns the dispatch savings on a CPU box and the A/B reads ~0
+    steps = int(os.environ.get("BENCH_AB_STEPS", "20"))
+    width = int(os.environ.get("BENCH_AB_WIDTH", "64"))
+    depth = int(os.environ.get("BENCH_AB_DEPTH", "48"))
+    on, fl_on = _mfu_ab_fused_arm(True, steps, width, depth)
+    off, fl_off = _mfu_ab_fused_arm(False, steps, width, depth)
+    delta = 100.0 * (on["value"] - off["value"]) / off["value"]
+    _emit("mfu_ab_fused_on_samples_per_sec",
+          "samples/sec, eager fused multi-tensor adam, %d-layer x %d MLP"
+          % (depth, width), on,
+          fused_launches=fl_on, delta_vs_off_pct=round(delta, 1))
+    _emit("mfu_ab_fused_off_samples_per_sec",
+          "samples/sec, eager per-param adam (MXTPU_FUSED_OPTIM=0), "
+          "same config", off, fused_launches=fl_off)
+
+    ps_steps = int(os.environ.get("BENCH_AB_PS_STEPS", "20"))
+    ps_width = int(os.environ.get("BENCH_AB_PS_WIDTH", "512"))
+    ps_depth = int(os.environ.get("BENCH_AB_PS_DEPTH", "6"))
+    if ps_steps <= 0:      # fused-only probe runs
+        return
+    bucket = os.environ.get("MXTPU_PS_BUCKET_MB", "4")
+    # interleave the arms so each (on, off) pair shares box conditions,
+    # then take the median per arm — a fresh process group per drill is
+    # too coarse for the single-window timing the other rows use
+    n_rep = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    ons, offs = [], []
+    for _ in range(n_rep):
+        ons.append(_mfu_ab_ps_drill(bucket, ps_steps, ps_width, ps_depth))
+        offs.append(_mfu_ab_ps_drill("0", ps_steps, ps_width, ps_depth))
+    assert ons[0]["final_loss"] == offs[0]["final_loss"], \
+        "overlap changed the trajectory: %r vs %r" % (
+            ons[0]["final_loss"], offs[0]["final_loss"])
+
+    def _stats(drills):
+        rates = sorted(d["steps_per_sec"] for d in drills)
+        n = len(rates)
+        med = rates[n // 2] if n % 2 else 0.5 * (rates[n // 2 - 1]
+                                                 + rates[n // 2])
+        return {"value": med, "repeats": n, "min": rates[0],
+                "max": rates[-1],
+                "spread_pct": round(100.0 * (rates[-1] - rates[0]) / med,
+                                    1)}
+
+    s_on, s_off = _stats(ons), _stats(offs)
+    ps_delta = 100.0 * (s_on["value"] - s_off["value"]) / s_off["value"]
+    pct = sorted(d["overlap_pct"] for d in ons)[len(ons) // 2]
+    _emit("mfu_ab_ps_overlap_on_steps_per_sec",
+          "steps/sec/worker, 2-worker dist_sync, bucket %s MB, "
+          "%d-layer x %d MLP" % (bucket, ps_depth, ps_width),
+          s_on, overlap_pct=round(pct, 1),
+          delta_vs_off_pct=round(ps_delta, 1))
+    _emit("mfu_ab_ps_overlap_off_steps_per_sec",
+          "steps/sec/worker, serial per-key push/pull "
+          "(MXTPU_PS_BUCKET_MB=0), same config", s_off)
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
@@ -1522,6 +1796,8 @@ def _dispatch(model, batch, steps, dtype):
         return bench_consistency()
     if model == "cold_start":
         return bench_cold_start()
+    if model == "mfu_ab":
+        return bench_mfu_ab()
     if model == "zoo_scaling":
         return bench_zoo_scaling(int(os.environ.get("BENCH_STEPS", "30")),
                                  dtype)

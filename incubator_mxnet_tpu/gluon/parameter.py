@@ -47,6 +47,11 @@ class Parameter:
         self._grad_stype = grad_stype
         self._data = None          # NDArray
         self._deferred_init = None  # (initializer, default_init)
+        # deferred-pull fence: Trainer's bucketed push_pull parks a
+        # per-key wait here; data() fires it so the NEXT forward blocks
+        # only when (and per-parameter, only as long as) the updated
+        # weights are still on the wire
+        self._pull_wait = None
 
     # ------------------------------------------------------------------ meta
     @property
@@ -134,6 +139,10 @@ class Parameter:
 
     # ------------------------------------------------------------------ data
     def data(self, ctx=None):
+        w = self._pull_wait
+        if w is not None:
+            self._pull_wait = None
+            w()
         if self._data is None:
             if self._deferred_init is not None:
                 raise DeferredInitializationError(

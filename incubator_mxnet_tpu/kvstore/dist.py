@@ -69,6 +69,66 @@ def create_dist(name):
     return KVStoreDist(name, sync_mode=sync_mode)
 
 
+class _PushPullHandle:
+    """Deferred-pull fence for one bucketed push_pull step.
+
+    The caller overlaps the pull wait with next-step host work and
+    blocks as late as possible: `wait_key(k)` fences one parameter
+    (gluon Parameter.data hooks it), `wait()` drains everything.
+    Completion writes the trainer_overlap_pct gauge: the fraction of
+    the step's comm window NOT spent blocking the caller."""
+
+    def __init__(self, t0):
+        self._t0 = t0
+        self._futs = {}              # key -> pull future
+        self._last_done = t0         # wall time the last pull landed
+        self._exposed = 0.0          # seconds the caller actually blocked
+        self._closed = False
+
+    def _add(self, key, fut):
+        self._futs[key] = fut
+        fut.add_done_callback(self._mark_done)
+
+    def _mark_done(self, _fut):
+        t = time.time()
+        if t > self._last_done:
+            self._last_done = t
+
+    def wait_key(self, key):
+        """Block until `key`'s pull has landed (and re-raise its error)."""
+        f = self._futs.get(key)
+        if f is None:
+            return
+        if not f.done():
+            t = time.time()
+            f.result()
+            self._exposed += time.time() - t
+        else:
+            f.result()
+
+    def wait(self):
+        """Drain every deferred pull; first error wins. Records the
+        overlap gauge once — exposed blocking time over the total comm
+        window (submit to last pull landing)."""
+        t = time.time()
+        pending = [f for f in self._futs.values() if not f.done()]
+        err = None
+        for f in self._futs.values():
+            try:
+                f.result()
+            except Exception as e:  # mxlint: disable=broad-except — first error re-raised below
+                err = err or e
+        if pending:
+            self._exposed += time.time() - t
+        if not self._closed:
+            self._closed = True
+            total = max(self._last_done - self._t0, 1e-9)
+            pct = 100.0 * min(1.0, max(0.0, 1.0 - self._exposed / total))
+            _cat.trainer_overlap_pct.set(pct)
+        if err is not None:
+            raise err
+
+
 class KVStoreDist(KVStore):
     def __init__(self, name="dist_sync", sync_mode=True):
         super().__init__(name)
@@ -118,6 +178,15 @@ class KVStoreDist(KVStore):
         self._pending = {}       # key -> [futures]
         self._chain = {}         # key -> last submitted future (ordering)
         self._pending_lock = threading.Lock()
+        # bucketed comm/compute overlap (push_pull): byte cap per bucket,
+        # read once — the env knob is a launch decision, not a per-step one
+        try:
+            self._bucket_bytes = int(float(
+                os.environ.get("MXTPU_PS_BUCKET_MB", "4") or 0) * (1 << 20))
+        except ValueError:
+            self._bucket_bytes = 4 << 20
+        self._pull_io = None     # lazy: only bucketed steps pay the threads
+        self._pp_handle = None   # previous step's deferred-pull fence
         if self._elastic:
             # membership-change notifications arrive on heartbeat replies
             self._sched.on_epoch = lambda _ep: self._refresh_membership()
@@ -163,9 +232,21 @@ class KVStoreDist(KVStore):
         """Last membership epoch observed from the scheduler."""
         return self._epoch
 
+    def overlap_enabled(self):
+        """True when push_pull runs the bucketed overlap pipeline
+        (MXTPU_PS_BUCKET_MB > 0 and async sends on)."""
+        return self._bucket_bytes > 0 and self._io is not None
+
     def barrier(self, timeout=600):
+        self._drain_pulls()
         self._flush()
         self._sched.barrier("worker", timeout=timeout)
+
+    def _drain_pulls(self):
+        """Settle the previous push_pull's deferred pulls (if any)."""
+        h, self._pp_handle = self._pp_handle, None
+        if h is not None:
+            h.wait()
 
     # -- elastic membership --------------------------------------------------
     def _refresh_membership(self):
@@ -281,6 +362,37 @@ class KVStoreDist(KVStore):
             fut = self._io.submit(run)
             self._chain[key] = fut
             self._pending.setdefault(key, []).append(fut)
+
+    def _submit_multi(self, keys, fn):
+        """Queue ONE send that carries pushes for several keys (a
+        push_multi bucket): it chains behind every contained key's
+        previous future and becomes the new chain tail for all of them,
+        so per-key ordering holds exactly as with _submit."""
+        with self._pending_lock:
+            prevs = [p for p in (self._chain.get(k) for k in keys)
+                     if p is not None]
+
+            def run(_prevs=prevs):
+                for p in _prevs:
+                    try:
+                        p.result()
+                    except Exception as e:  # mxlint: disable=broad-except
+                        # predecessor failures surface at _flush (its
+                        # future is registered there too); here we only
+                        # preserve ordering — see _submit
+                        _log.debug("kvstore push_multi chain: predecessor "
+                                   "failed (%s: %s); error will surface "
+                                   "at flush", type(e).__name__, e)
+                d = _fp.failpoint("kv.push.delay")
+                if d:
+                    import time
+                    time.sleep(float(d))
+                return fn()
+
+            fut = self._io.submit(run)
+            for k in keys:
+                self._chain[k] = fut
+                self._pending.setdefault(k, []).append(fut)
 
     def _refresh_conn(self, conn):
         """Between retries: re-resolve this server's address from the
@@ -489,6 +601,45 @@ class KVStoreDist(KVStore):
             self._push_round[part_key] = \
                 self._push_round.get(part_key, 0) + 1
 
+    def _encode_push_part(self, pk, part):
+        """Wire (meta, payload) for ONE dense part-key push — shared by
+        push() and the bucketed push_pull() so both paths are
+        byte-identical on the wire. Runs on the CALLER thread: the sync
+        round stamp (and top-k error-feedback state) must follow program
+        order, not I/O-thread scheduling."""
+        compressed = self._compression is not None
+        if compressed and self._compression.type == "topk":
+            # sparse wire form: int32 flat indices + f32 values of
+            # the top-k error-fed residual entries; the server
+            # scatters them dense before aggregating
+            import jax.numpy as jnp
+            idx, vals = self._compression.sparsify(
+                pk, jnp.asarray(part, jnp.float32))
+            meta = {"op": "push", "key": pk,
+                    "shape": list(part.shape), "dtype": "float32",
+                    "compressed": "topk", "nnz": int(idx.size),
+                    "rank": self._rank}
+            payload = (np.ascontiguousarray(idx, np.int32).tobytes()
+                       + np.ascontiguousarray(vals,
+                                              np.float32).tobytes())
+        elif compressed:
+            import jax.numpy as jnp
+            q = self._compression.compress(pk, jnp.asarray(part))
+            packed = np.asarray(self._compression.pack(q),
+                                dtype=np.int32)
+            meta = {"op": "push", "key": pk,
+                    "shape": list(part.shape), "dtype": "float32",
+                    "compressed": True, "rank": self._rank}
+            payload = packed.tobytes()
+        else:
+            meta = {"op": "push", "key": pk,
+                    "shape": list(part.shape), "dtype": str(part.dtype),
+                    "rank": self._rank}
+            payload = np.ascontiguousarray(part).tobytes()
+        if self._sync_mode:
+            meta["round"] = self._round_stamp(pk)
+        return meta, payload
+
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
@@ -510,42 +661,12 @@ class KVStoreDist(KVStore):
             arr = np.asarray(acc, dtype=np.float32)
         else:
             arr = np.asarray(vals[0]._data, dtype=np.float32)
-        compressed = self._compression is not None
         with _tr.span("kv.push", key=str(key)):
             _cat.kvstore_pushes.inc(key=str(key))
             for sid, lo, hi in self._shards_for(key, arr.shape):
                 part = arr[lo:hi] if arr.ndim else arr
                 pk = self._part_key(key, lo)
-                if compressed and self._compression.type == "topk":
-                    # sparse wire form: int32 flat indices + f32 values of
-                    # the top-k error-fed residual entries; the server
-                    # scatters them dense before aggregating
-                    import jax.numpy as jnp
-                    idx, vals = self._compression.sparsify(
-                        pk, jnp.asarray(part, jnp.float32))
-                    meta = {"op": "push", "key": pk,
-                            "shape": list(part.shape), "dtype": "float32",
-                            "compressed": "topk", "nnz": int(idx.size),
-                            "rank": self._rank}
-                    payload = (np.ascontiguousarray(idx, np.int32).tobytes()
-                               + np.ascontiguousarray(vals,
-                                                      np.float32).tobytes())
-                elif compressed:
-                    import jax.numpy as jnp
-                    q = self._compression.compress(pk, jnp.asarray(part))
-                    packed = np.asarray(self._compression.pack(q),
-                                        dtype=np.int32)
-                    meta = {"op": "push", "key": pk,
-                            "shape": list(part.shape), "dtype": "float32",
-                            "compressed": True, "rank": self._rank}
-                    payload = packed.tobytes()
-                else:
-                    meta = {"op": "push", "key": pk,
-                            "shape": list(part.shape), "dtype": str(part.dtype),
-                            "rank": self._rank}
-                    payload = np.ascontiguousarray(part).tobytes()
-                if self._sync_mode:
-                    meta["round"] = self._round_stamp(pk)
+                meta, payload = self._encode_push_part(pk, part)
                 # stamp trace ids HERE, on the caller thread: async sends
                 # run on I/O threads where the span context is gone
                 _tr.inject(meta)
@@ -585,6 +706,103 @@ class KVStoreDist(KVStore):
                 conn = self._servers[sid]
                 self._submit(key, lambda c=conn, m=meta, p=payload:
                              self._checked_call(c, m, p))
+
+    def push_pull(self, keys, values, outs=None, priority=0):
+        """Bucketed, overlapped push of many dense keys with deferred
+        pulls — the PS-path comm/compute overlap pipeline.
+
+        The caller supplies keys in BACKWARD-COMPLETION (reverse-layer)
+        order so the first bucket can leave while later gradients are
+        still materializing. The pipeline:
+
+        1. starts the device->host copy of EVERY gradient up front (jax
+           async dispatch) — bucket i+1's copy rides under bucket i's
+           top-k compression and send;
+        2. cuts the stream into MXTPU_PS_BUCKET_MB-capped buckets and
+           folds each bucket's per-part-key pushes into ONE push_multi
+           RPC per server — each sub-push carries the same round stamp
+           it would on the per-key path (stamped here, on the caller
+           thread, in program order), so server aggregation is
+           bit-for-bit unchanged and many small keys cost one RPC;
+        3. queues each key's pull behind its own push chain on a
+           separate lane and returns a _PushPullHandle — the caller
+           overlaps the pull wait with next-step host work and fences
+           per parameter (wait_key) or at the next step (wait).
+
+        With MXTPU_PS_BUCKET_MB=0 (or synchronous sends) this is the
+        plain push-then-pull loop: one predicate check, zero pipeline
+        overhead, nothing deferred."""
+        if outs is None:
+            outs = [None] * len(keys)
+        if self._bucket_bytes <= 0 or self._io is None:
+            h = _PushPullHandle(time.time())
+            h._closed = True         # nothing deferred: no overlap gauge
+            for k, v in zip(keys, values):
+                self.push(k, v, priority)
+            for k, o in zip(keys, outs):
+                if o is not None:
+                    self.pull(k, out=o, priority=priority)
+            return h
+        prev, self._pp_handle = self._pp_handle, None
+        if prev is not None:
+            # the previous step's deferred pulls close their rounds before
+            # this step stamps new ones — program order for _push_round
+            prev.wait()
+        if self._pull_io is None:
+            self._pull_io = ThreadPoolExecutor(
+                max_workers=max(2, len(self._servers)))
+        for v in values:
+            start = getattr(v._data, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        # size-capped buckets in the caller's order (f32 wire bytes)
+        buckets, cur, cur_b = [], [], 0
+        for item in zip(keys, values, outs):
+            cur.append(item)
+            cur_b += int(np.prod(item[1].shape) if item[1].shape else 1) * 4
+            if cur_b >= self._bucket_bytes:
+                buckets.append(cur)
+                cur, cur_b = [], 0
+        if cur:
+            buckets.append(cur)
+        h = _PushPullHandle(time.time())
+        with _tr.span("kv.push_pull", keys=len(keys),
+                      buckets=len(buckets)):
+            for bucket in buckets:
+                bkeys = [k for k, _, _ in bucket]
+                per_sid = {}         # sid -> (sub metas, lens, chunks)
+                for k, v, _o in bucket:
+                    # np.asarray completes the in-flight async copy
+                    arr = np.asarray(v._data, dtype=np.float32)
+                    _cat.kvstore_pushes.inc(key=str(k))
+                    for sid, lo, hi in self._shards_for(k, arr.shape):
+                        part = arr[lo:hi] if arr.ndim else arr
+                        meta, payload = self._encode_push_part(
+                            self._part_key(k, lo), part)
+                        subs, lens, chunks = per_sid.setdefault(
+                            sid, ([], [], []))
+                        subs.append(meta)
+                        lens.append(len(payload))
+                        chunks.append(payload)
+                for sid, (subs, lens, chunks) in sorted(per_sid.items()):
+                    meta = {"op": "push_multi", "subs": subs,
+                            "lens": lens, "rank": self._rank}
+                    payload = b"".join(chunks)
+                    _tr.inject(meta)     # caller thread — see push()
+                    _cat.kvstore_push_bytes.inc(len(payload),
+                                                server=str(sid))
+                    conn = self._servers[sid]
+                    self._submit_multi(
+                        bkeys, lambda c=conn, m=meta, p=payload:
+                        self._checked_call(c, m, p))
+                for k, _v, o in bucket:
+                    if o is not None:
+                        # pull() itself flushes k's push chain first, so
+                        # the pull lane orders correctly behind the sends
+                        h._add(k, self._pull_io.submit(
+                            self.pull, k, o, priority))
+        self._pp_handle = h
+        return h
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -711,12 +929,15 @@ class KVStoreDist(KVStore):
 
     def close(self):
         try:
+            self._drain_pulls()
             self._flush()
         finally:
             _fl.record("worker.bye", rank=self._rank)
             self._sched.bye("worker", self._rank)
             if self._io is not None:
                 self._io.shutdown(wait=True)
+            if self._pull_io is not None:
+                self._pull_io.shutdown(wait=True)
             for conn in self._servers:
                 conn.close()
             introspect = getattr(self, "_introspect", None)

@@ -656,7 +656,7 @@ class _ServerSnapshot:
 
 # ops that change server state and therefore participate in snapshotting
 # and must be stamped idempotent by clients
-_MUTATING_OPS = frozenset(["init", "push", "set_optimizer",
+_MUTATING_OPS = frozenset(["init", "push", "push_multi", "set_optimizer",
                            "set_optimizer_spec", "set_compression",
                            "command"])
 
@@ -829,6 +829,93 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             arr = _decode(meta, payload)
         return rows, arr
 
+    def _push_locked(meta, payload):
+        """One push applied under state.cv — the shared body of the
+        `push` op and the bucketed `push_multi` op (which holds the lock
+        across its whole bucket so the membership gate decides once for
+        every sub-push). The caller has already checked membership."""
+        key = meta["key"]
+        rank = meta.get("rank")
+        if key not in state.store:
+            return {"error": "push(%r) before init" % key}, b""
+        full_shape = tuple(state.store[key].shape)
+        if state.sync_mode:
+            # the push RESPONSE never waits for the other workers
+            # (reference: the server acks the recv and the engine
+            # dependency graph sequences ApplyUpdates; a blocking
+            # push couples the workers' key orders and deadlocks
+            # when sends race) — aggregation completes when the
+            # open round has every quorum contribution, and PULL
+            # waits for it
+            if rank is None:
+                # a synthetic rank could collide with a real one and
+                # stall (or early-complete) the round — reject, the
+                # worker's _checked_call surfaces this immediately
+                return {"error": "sync push(%r) without a rank"
+                                 % key}, b""
+            gen = state.push_gen.get(key, 0)
+            r = meta.get("round")
+            r = gen if r is None else int(r)
+            if r < gen:
+                # the worker stamped this before it observed the
+                # round completing (it hasn't pulled since) — fold
+                # into the OPEN round. Safe: a wire retry whose
+                # original apply is durable never reaches here (the
+                # dedup cache replays it, and the dedup entry rides
+                # the same snapshot as the apply), so this is a NEW
+                # logical push joining the current round. Stamps
+                # AHEAD of gen (r > gen) buffer instead: after a
+                # restore they must never merge into the restored
+                # stale round (the PR 1 race).
+                r = gen
+            rows, arr = _decode_push_payload(meta, payload,
+                                             full_shape)
+            by_round = state.rounds.setdefault(key, {})
+            ent = by_round.get(r)
+            if ent is None:
+                ent = [None, set()]
+                by_round[r] = ent
+            acc = ent[0]
+            if acc is None:
+                acc = np.zeros(full_shape, np.float32)
+            if rows is not None:
+                # row-sparse push: scatter-add only the sent rows
+                # (reference: kvstore_dist.h row-sparse recv)
+                np.add.at(acc, np.asarray(rows, np.int64),
+                          arr.astype(np.float32))
+            else:
+                acc = acc + arr.astype(np.float32)
+            ent[0] = acc
+            ent[1].add(rank)
+            _cascade_locked(key)
+        else:
+            rows, arr = _decode_push_payload(meta, payload,
+                                             full_shape)
+            if rows is not None:
+                g = np.zeros(full_shape, np.float32)
+                np.add.at(g, np.asarray(rows, np.int64),
+                          arr.astype(np.float32))
+                apply_update(key, g)
+            else:
+                apply_update(key, arr.astype(np.float32))
+        return {"ok": True}, b""
+
+    def _push_membership_gate(rank):
+        """stale_epoch gate shared by push and push_multi; None = pass."""
+        if state.members is not None and rank is not None \
+                and rank not in state.members:
+            # the pusher is not in OUR epoch's membership: either we
+            # are behind (it just joined — refresh fixes it) or the
+            # pusher was evicted (it must refresh and rejoin)
+            _refresh_members()
+            if rank not in (state.members or ()):
+                return {"error": "stale_epoch: rank %s is not in "
+                                 "membership epoch %d" % (rank,
+                                                          state.epoch),
+                        "stale_epoch": True,
+                        "_epoch": state.epoch}, b""
+        return None
+
     def _handle(meta, payload):
         op = meta["op"]
         if op == "init":
@@ -844,84 +931,44 @@ def run_server(scheduler_addr, num_workers, sync_mode=True, ready_event=None,
             d = _fp.failpoint("server.push.delay")
             if d:
                 time.sleep(float(d))
-            key = meta["key"]
-            rank = meta.get("rank")
-            if state.members is not None and rank is not None \
-                    and rank not in state.members:
-                # the pusher is not in OUR epoch's membership: either we
-                # are behind (it just joined — refresh fixes it) or the
-                # pusher was evicted (it must refresh and rejoin)
-                _refresh_members()
-                if rank not in (state.members or ()):
-                    return {"error": "stale_epoch: rank %s is not in "
-                                     "membership epoch %d" % (rank,
-                                                              state.epoch),
-                            "stale_epoch": True,
-                            "_epoch": state.epoch}, b""
+            stale = _push_membership_gate(meta.get("rank"))
+            if stale is not None:
+                return stale
             with state.cv:
-                if key not in state.store:
-                    return {"error": "push(%r) before init" % key}, b""
-                full_shape = tuple(state.store[key].shape)
-                if state.sync_mode:
-                    # the push RESPONSE never waits for the other workers
-                    # (reference: the server acks the recv and the engine
-                    # dependency graph sequences ApplyUpdates; a blocking
-                    # push couples the workers' key orders and deadlocks
-                    # when sends race) — aggregation completes when the
-                    # open round has every quorum contribution, and PULL
-                    # waits for it
-                    if rank is None:
-                        # a synthetic rank could collide with a real one and
-                        # stall (or early-complete) the round — reject, the
-                        # worker's _checked_call surfaces this immediately
-                        return {"error": "sync push(%r) without a rank"
-                                         % key}, b""
-                    gen = state.push_gen.get(key, 0)
-                    r = meta.get("round")
-                    r = gen if r is None else int(r)
-                    if r < gen:
-                        # the worker stamped this before it observed the
-                        # round completing (it hasn't pulled since) — fold
-                        # into the OPEN round. Safe: a wire retry whose
-                        # original apply is durable never reaches here (the
-                        # dedup cache replays it, and the dedup entry rides
-                        # the same snapshot as the apply), so this is a NEW
-                        # logical push joining the current round. Stamps
-                        # AHEAD of gen (r > gen) buffer instead: after a
-                        # restore they must never merge into the restored
-                        # stale round (the PR 1 race).
-                        r = gen
-                    rows, arr = _decode_push_payload(meta, payload,
-                                                     full_shape)
-                    by_round = state.rounds.setdefault(key, {})
-                    ent = by_round.get(r)
-                    if ent is None:
-                        ent = [None, set()]
-                        by_round[r] = ent
-                    acc = ent[0]
-                    if acc is None:
-                        acc = np.zeros(full_shape, np.float32)
-                    if rows is not None:
-                        # row-sparse push: scatter-add only the sent rows
-                        # (reference: kvstore_dist.h row-sparse recv)
-                        np.add.at(acc, np.asarray(rows, np.int64),
-                                  arr.astype(np.float32))
-                    else:
-                        acc = acc + arr.astype(np.float32)
-                    ent[0] = acc
-                    ent[1].add(rank)
-                    _cascade_locked(key)
-                else:
-                    rows, arr = _decode_push_payload(meta, payload,
-                                                     full_shape)
-                    if rows is not None:
-                        g = np.zeros(full_shape, np.float32)
-                        np.add.at(g, np.asarray(rows, np.int64),
-                                  arr.astype(np.float32))
-                        apply_update(key, g)
-                    else:
-                        apply_update(key, arr.astype(np.float32))
-            return {"ok": True}, b""
+                return _push_locked(meta, payload)
+        if op == "push_multi":
+            # bucketed worker push: the sub-pushes of one (bucket, server)
+            # pair folded into a single RPC (kvstore_dist.py push_pull).
+            # The membership gate runs ONCE before anything applies and the
+            # lock is held across the whole bucket, so stale_epoch is
+            # all-or-nothing — a refreshed resend (fresh dedup seq) can
+            # never double-apply a half-landed bucket. Each sub-push then
+            # rides the EXACT single-key body, so round aggregation and
+            # snapshot semantics are bit-for-bit the per-key path's.
+            d = _fp.failpoint("server.push.delay")
+            if d:
+                time.sleep(float(d))
+            rank = meta.get("rank")
+            stale = _push_membership_gate(rank)
+            if stale is not None:
+                return stale
+            subs = meta.get("subs") or []
+            lens = meta.get("lens") or []
+            if len(subs) != len(lens):
+                return {"error": "push_multi: %d sub-metas but %d "
+                                 "payload lengths" % (len(subs),
+                                                      len(lens))}, b""
+            with state.cv:
+                off = 0
+                for sm, n in zip(subs, lens):
+                    n = int(n)
+                    sub = dict(sm)
+                    sub.setdefault("rank", rank)
+                    out = _push_locked(sub, payload[off:off + n])
+                    off += n
+                    if isinstance(out[0], dict) and out[0].get("error"):
+                        return out
+            return {"ok": True, "n": len(subs)}, b""
         if op == "pull":
             key = meta["key"]
             with state.cv:

@@ -70,12 +70,21 @@ class MultiHeadAttention(HybridBlock):
         # Crossover re-measured on v5e after the r2 kernel tuning (bf16 MXU
         # feeds + 1024-blocks): flash fwd+bwd beats XLA dense attention from
         # T=2048 up (6.3 vs 20.5 ms at 2048; 9.1 vs 252 ms at 8192, bf16
-        # B=1 H=8 D=64) and is within noise below that, where per-call
-        # overhead dominates. Switch where the win is measurable.
-        # MXTPU_DISABLE_FLASH=1 forces the einsum path (A/B benchmarking).
+        # B=1 H=8 D=64). Below that the O(T) memory saving still lets the
+        # step avoid the T^2 scores materialization, and the MFU round's
+        # kernel keeps parity from T=512 up — so the threshold is
+        # env-tunable (MXTPU_FLASH_MIN_T, default 512) rather than pinned
+        # at the pure-latency crossover; the T % 128 tiling contract is
+        # NOT tunable. MXTPU_DISABLE_FLASH=1 forces the einsum path (A/B
+        # benchmarking).
+        try:
+            min_t = int(_os.environ.get("MXTPU_FLASH_MIN_T", "512"))
+        except ValueError:
+            min_t = 512
         if (in_trace and self.dropout._rate == 0
                 and _os.environ.get("MXTPU_DISABLE_FLASH", "0") != "1"
-                and T >= 2048 and T % 128 == 0 and flash_attention_available()):
+                and T >= min_t and T % 128 == 0
+                and flash_attention_available()):
             return flash_attention(q, k, v, scale=1.0 / math.sqrt(D),
                                    kv_mask=mask)
         scores = F.batch_dot(q, k, transpose_b=True) * (1.0 / math.sqrt(D))

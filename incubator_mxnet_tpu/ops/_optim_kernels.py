@@ -4,6 +4,8 @@ Reference parity: the fused update kernels of src/operator/optimizer_op.cc.
 Shared by the Optimizer classes and the registered optimizer update ops.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -174,6 +176,70 @@ def _sgld_update(w, g, lr, wd, noise, rescale, clip):
     return w - lr / 2 * g + jnp.sqrt(lr) * noise
 
 
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor update seam (ops/pallas/fused_optim.py). The caller
+# flattens a dtype-homogeneous group of parameters into ONE buffer per
+# operand role and the whole group updates as a single launch. When Pallas
+# is unavailable (and interpret isn't forced) the fallback applies the
+# per-parameter kernel above once to the packed buffer — elementwise, hence
+# bit-identical to the per-parameter loop over the same values.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _multi_sgd_mom_update(ws, gs, moms, lr, wd, momentum, rescale, clip,
+                          interpret=False):
+    from .pallas import fused_optim as _fo
+    wflat, metas = _fo.flatten_group(ws)
+    gflat, _ = _fo.flatten_group(gs)
+    mflat, _ = _fo.flatten_group(moms)
+    if interpret or _fo.fused_optim_available():
+        nw, nm = _fo.fused_sgd_mom_flat(wflat, gflat, mflat, lr, wd,
+                                        momentum, rescale, clip,
+                                        interpret=interpret)
+    else:
+        nw, nm = _sgd_mom_update(wflat, gflat, mflat, lr, wd, momentum,
+                                 rescale, clip)
+    return _fo.split_group(nw, metas), _fo.split_group(nm, metas)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _multi_adam_update(ws, gs, ms, vs, lr, wd, b1, b2, eps, t, rescale,
+                       clip, interpret=False):
+    from .pallas import fused_optim as _fo
+    wflat, metas = _fo.flatten_group(ws)
+    gflat, _ = _fo.flatten_group(gs)
+    mflat, _ = _fo.flatten_group(ms)
+    vflat, _ = _fo.flatten_group(vs)
+    if interpret or _fo.fused_optim_available():
+        nw, nm, nv = _fo.fused_adam_flat(wflat, gflat, mflat, vflat, lr, wd,
+                                         b1, b2, eps, t, rescale, clip,
+                                         interpret=interpret)
+    else:
+        nw, nm, nv = _adam_update(wflat, gflat, mflat, vflat, lr, wd, b1,
+                                  b2, eps, t, rescale, clip)
+    return (_fo.split_group(nw, metas), _fo.split_group(nm, metas),
+            _fo.split_group(nv, metas))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _multi_adamw_update(ws, gs, ms, vs, lr, wd, eta, b1, b2, eps, t,
+                        rescale, clip, interpret=False):
+    from .pallas import fused_optim as _fo
+    wflat, metas = _fo.flatten_group(ws)
+    gflat, _ = _fo.flatten_group(gs)
+    mflat, _ = _fo.flatten_group(ms)
+    vflat, _ = _fo.flatten_group(vs)
+    if interpret or _fo.fused_optim_available():
+        nw, nm, nv = _fo.fused_adamw_flat(wflat, gflat, mflat, vflat, lr,
+                                          wd, eta, b1, b2, eps, t, rescale,
+                                          clip, interpret=interpret)
+    else:
+        nw, nm, nv = _adamw_update(wflat, gflat, mflat, vflat, lr, wd, eta,
+                                   b1, b2, eps, t, rescale, clip)
+    return (_fo.split_group(nw, metas), _fo.split_group(nm, metas),
+            _fo.split_group(nv, metas))
 
 
 # ---------------------------------------------------------------------------

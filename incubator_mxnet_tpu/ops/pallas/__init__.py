@@ -8,3 +8,6 @@ from .flash_decode import (paged_flash_decode, paged_causal_attention,
                            flash_decode_available)
 from .fused_norm import (fused_layer_norm, fused_softmax,
                          fused_norm_available)
+from .fused_optim import (FUSED_OPTIMIZERS, fused_adam_flat,
+                          fused_adamw_flat, fused_optim_available,
+                          fused_optim_enabled, fused_sgd_mom_flat)

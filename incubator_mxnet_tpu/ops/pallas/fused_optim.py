@@ -1,0 +1,297 @@
+"""Pallas TPU kernels: fused multi-tensor optimizer updates.
+
+Reference parity: the fused update kernels of src/operator/optimizer_op.cc
+apply one parameter per launch; a ResNet-50 step therefore pays ~160 tiny
+kernel dispatches just to apply SGD. Here the caller flattens every
+(weight, grad, state...) tree of one dtype into a single 1-D buffer and the
+whole update runs as ONE Pallas launch: each program owns a (block_r, 128)
+tile held in VMEM, the hyper-parameters ride SMEM, and weight/state inputs
+are aliased to the outputs so the update is in-place in HBM.
+
+Three flavors are fused — SGD-momentum, Adam, and AdamW — matching the
+``_sgd_mom_update`` / ``_adam_update`` / ``_adamw_update`` kernels in
+``ops/_optim_kernels.py`` bit-for-bit (the scalar arithmetic stays in
+float32 and is cast to the buffer dtype exactly where jax weak-type
+promotion would cast it in the per-parameter kernels). The lazy/sparse
+update kernels stay on the per-parameter path.
+
+Dispatch lives behind the ``_optim_kernels`` seam (``_multi_*`` wrappers):
+real Pallas on TPU, interpret mode for CPU tier-1 tests, and a lax fallback
+(the per-parameter kernel applied once to the packed flat buffer) anywhere
+else. ``MXTPU_FUSED_OPTIM=0`` disables the fused path entirely.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover — mxlint: disable=broad-except (pallas/TPU availability probe: any import or lowering failure means fall back to the XLA path)
+    _PALLAS_OK = False
+
+
+def fused_optim_available():
+    return _PALLAS_OK and jax.default_backend() == "tpu"
+
+
+def fused_optim_enabled():
+    """One env lookup: the whole fused path costs one predicate when off."""
+    return os.environ.get("MXTPU_FUSED_OPTIM", "1") != "0"
+
+
+#: optimizer names (optimizer/optimizer.py registry) with a fused path.
+FUSED_OPTIMIZERS = ("sgd", "adam", "adamw")
+
+_LANE = 128
+# Pad the packed buffer to a multiple of 16 sublanes so the (block_r, 128)
+# tiles satisfy the minimum tile for BOTH f32 (8, 128) and bf16 (16, 128).
+_PAD_TO = 16 * _LANE
+
+
+# ---------------------------------------------------------------------------
+# pack / unpack
+# ---------------------------------------------------------------------------
+
+def flatten_group(arrs):
+    """Concat ravelled same-dtype ``arrs`` -> (flat_1d, metas) where metas
+    reverses the packing via :func:`split_group`."""
+    metas = [(a.shape, int(a.size)) for a in arrs]
+    if len(arrs) == 1:
+        return arrs[0].reshape(-1), metas
+    return jnp.concatenate([a.reshape(-1) for a in arrs]), metas
+
+
+def split_group(flat, metas):
+    """Inverse of :func:`flatten_group`."""
+    out, off = [], 0
+    for shape, size in metas:
+        out.append(jax.lax.slice(flat, (off,), (off + size,)).reshape(shape))
+        off += size
+    return out
+
+
+def _to_tiles(flat):
+    """Zero-pad the 1-D buffer and reshape to (R, 128) Pallas tiles."""
+    n = flat.shape[0]
+    pad = (-n) % _PAD_TO
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(-1, _LANE)
+
+
+def _row_block(n_rows):
+    """Largest row-block from the ladder that tiles n_rows (n_rows is a
+    multiple of 16 by construction; 512 rows x 128 lanes x 4 B = 256 KiB per
+    buffer keeps the worst case — Adam's 7 buffers — well inside VMEM)."""
+    for cand in (512, 256, 128, 64, 32, 16):
+        if n_rows % cand == 0:
+            return cand
+    return 16
+
+
+# ---------------------------------------------------------------------------
+# kernels — scalar math in f32, cast to the buffer dtype exactly where the
+# per-parameter kernels' weak-type promotion would (bit-parity contract).
+# ---------------------------------------------------------------------------
+
+def _sgd_mom_kernel(s_ref, w_ref, m_ref, g_ref, ow_ref, om_ref):
+    dt = w_ref.dtype
+    lr, wd, momentum = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2]
+    rescale, clip = s_ref[0, 5], s_ref[0, 6]
+    w, g, mom = w_ref[...], g_ref[...], m_ref[...]
+    g = g * rescale.astype(dt)
+    g = jnp.where(clip > 0, jnp.clip(g, -clip.astype(dt), clip.astype(dt)), g)
+    mom = momentum.astype(dt) * mom - lr.astype(dt) * (g + wd.astype(dt) * w)
+    ow_ref[...] = w + mom
+    om_ref[...] = mom
+
+
+def _adam_kernel(s_ref, t_ref, w_ref, m_ref, v_ref, g_ref,
+                 ow_ref, om_ref, ov_ref):
+    dt = w_ref.dtype
+    lr, wd, b1, b2 = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3]
+    eps, rescale, clip = s_ref[0, 4], s_ref[0, 5], s_ref[0, 6]
+    t = t_ref[0, 0]
+    one = jnp.float32(1)
+    w, g, m, v = w_ref[...], g_ref[...], m_ref[...], v_ref[...]
+    g = g * rescale.astype(dt)
+    g = jnp.where(clip > 0, jnp.clip(g, -clip.astype(dt), clip.astype(dt)), g)
+    g = g + wd.astype(dt) * w
+    m = b1.astype(dt) * m + (one - b1).astype(dt) * g
+    v = b2.astype(dt) * v + (one - b2).astype(dt) * g * g
+    coef = lr * jnp.sqrt(one - b2 ** t) / (one - b1 ** t)
+    ow_ref[...] = w - coef.astype(dt) * m / (jnp.sqrt(v) + eps.astype(dt))
+    om_ref[...] = m
+    ov_ref[...] = v
+
+
+def _adamw_kernel(s_ref, t_ref, w_ref, m_ref, v_ref, g_ref,
+                  ow_ref, om_ref, ov_ref):
+    dt = w_ref.dtype
+    lr, wd, b1, b2 = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3]
+    eps, rescale, clip, eta = (s_ref[0, 4], s_ref[0, 5], s_ref[0, 6],
+                               s_ref[0, 7])
+    t = t_ref[0, 0]
+    one = jnp.float32(1)
+    w, g, m, v = w_ref[...], g_ref[...], m_ref[...], v_ref[...]
+    g = g * rescale.astype(dt)
+    g = jnp.where(clip > 0, jnp.clip(g, -clip.astype(dt), clip.astype(dt)), g)
+    m = b1.astype(dt) * m + (one - b1).astype(dt) * g
+    v = b2.astype(dt) * v + (one - b2).astype(dt) * g * g
+    mhat = m / (one - b1 ** t).astype(dt)
+    vhat = v / (one - b2 ** t).astype(dt)
+    ow_ref[...] = w - eta.astype(dt) * (
+        lr.astype(dt) * mhat / (jnp.sqrt(vhat) + eps.astype(dt))
+        + wd.astype(dt) * w)
+    om_ref[...] = m
+    ov_ref[...] = v
+
+
+# ---------------------------------------------------------------------------
+# launch plumbing
+# ---------------------------------------------------------------------------
+
+def _launch(kernel, scalars, t, bufs, n_out, interpret):
+    """One pallas_call over the packed (R, 128) buffers. ``bufs[:n_out]``
+    are aliased to the outputs (in-place update in HBM) on the real-TPU
+    path; weight/state buffers must therefore come first."""
+    tiles = [_to_tiles(b) for b in bufs]
+    R = tiles[0].shape[0]
+    block_r = _row_block(R)
+    tile_spec = pl.BlockSpec((block_r, _LANE), lambda i: (i, 0))
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    inputs = [scalars]
+    in_specs = [smem_spec]
+    if t is not None:
+        inputs.append(t)
+        in_specs.append(smem_spec)
+    n_scalar = len(inputs)
+    inputs += tiles
+    in_specs += [tile_spec] * len(tiles)
+    dt = bufs[0].dtype
+    aliases = {}
+    if not interpret:
+        # w/m(/v) inputs sit right after the scalar operands and map 1:1
+        # onto the outputs; g (never aliased) is passed last.
+        aliases = {n_scalar + j: j for j in range(n_out)}
+    outs = pl.pallas_call(
+        kernel,
+        grid=(R // block_r,),
+        in_specs=in_specs,
+        out_specs=tuple([tile_spec] * n_out),
+        out_shape=tuple(jax.ShapeDtypeStruct((R, _LANE), dt)
+                        for _ in range(n_out)),
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*inputs)
+    n = bufs[0].shape[0]
+    return tuple(o.reshape(-1)[:n] for o in outs)
+
+
+def _scalars(*vals):
+    return jnp.asarray([vals], jnp.float32)
+
+
+def fused_sgd_mom_flat(w, g, mom, lr, wd, momentum, rescale, clip,
+                       interpret=False):
+    """One-launch SGD-momentum over packed 1-D buffers -> (w, mom)."""
+    s = _scalars(lr, wd, momentum, 0.0, 0.0, rescale, clip, 0.0)
+    return _launch(_sgd_mom_kernel, s, None, [w, mom, g], 2, interpret)
+
+
+def fused_adam_flat(w, g, m, v, lr, wd, b1, b2, eps, t, rescale, clip,
+                    interpret=False):
+    """One-launch Adam over packed 1-D buffers -> (w, m, v)."""
+    s = _scalars(lr, wd, b1, b2, eps, rescale, clip, 0.0)
+    tf = jnp.asarray(t, jnp.float32).reshape(1, 1)
+    return _launch(_adam_kernel, s, tf, [w, m, v, g], 3, interpret)
+
+
+def fused_adamw_flat(w, g, m, v, lr, wd, eta, b1, b2, eps, t, rescale, clip,
+                     interpret=False):
+    """One-launch AdamW over packed 1-D buffers -> (w, m, v)."""
+    s = _scalars(lr, wd, b1, b2, eps, rescale, clip, eta)
+    tf = jnp.asarray(t, jnp.float32).reshape(1, 1)
+    return _launch(_adamw_kernel, s, tf, [w, m, v, g], 3, interpret)
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainer flavor — parallel/trainer.py's _apply_opt_fp math (no
+# rescale/clip prologue; Adam in the mhat/vhat formulation; AdamW couples
+# the decay as `upd + lr*wd*w`). The scalar slot `lrwd` carries lr*wd
+# precomputed in python (f64) so the single f64->f32 rounding matches the
+# per-param `lr * wd * p` evaluation order.
+# ---------------------------------------------------------------------------
+
+def _trainer_adam_kernel(s_ref, t_ref, w_ref, m_ref, v_ref, g_ref,
+                         ow_ref, om_ref, ov_ref, *, adamw):
+    dt = w_ref.dtype
+    lr, wd, b1, b2 = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3]
+    eps, lrwd = s_ref[0, 4], s_ref[0, 5]
+    t = t_ref[0, 0]
+    one = jnp.float32(1)
+    w, g, m, v = w_ref[...], g_ref[...], m_ref[...], v_ref[...]
+    if not adamw:
+        g = g + wd.astype(dt) * w
+    m = b1.astype(dt) * m + (one - b1).astype(dt) * g
+    v = b2.astype(dt) * v + (one - b2).astype(dt) * g * g
+    mhat = m / (one - b1 ** t).astype(dt)
+    vhat = v / (one - b2 ** t).astype(dt)
+    upd = lr.astype(dt) * mhat / (jnp.sqrt(vhat) + eps.astype(dt))
+    if adamw:
+        upd = upd + lrwd.astype(dt) * w
+    ow_ref[...] = w - upd
+    om_ref[...] = m
+    ov_ref[...] = v
+
+
+def multi_trainer_sgd_mom(ws, gs, moms, lr, wd, momentum, interpret=False):
+    """Fused multi-tensor SGD-momentum in the trainer's _apply_opt_fp
+    formulation; python-float hyperparams. Returns (new_ws, new_moms)."""
+    wflat, metas = flatten_group(ws)
+    gflat, _ = flatten_group(gs)
+    mflat, _ = flatten_group(moms)
+    if interpret or fused_optim_available():
+        # the per-param math is the kernel's with rescale=1, clip off
+        # (both prologue ops are bitwise no-ops at those values)
+        s = _scalars(lr, wd, momentum, 0.0, 0.0, 1.0, -1.0, 0.0)
+        nw, nm = _launch(_sgd_mom_kernel, s, None, [wflat, mflat, gflat],
+                         2, interpret)
+    else:
+        nm = momentum * mflat - lr * (gflat + wd * wflat)
+        nw = wflat + nm
+    return split_group(nw, metas), split_group(nm, metas)
+
+
+def multi_trainer_adam(ws, gs, ms, vs, lr, wd, b1, b2, eps, t, adamw=False,
+                       interpret=False):
+    """Fused multi-tensor Adam/AdamW in the trainer's _apply_opt_fp
+    formulation; python-float hyperparams, traced scalar t. Returns
+    (new_ws, new_ms, new_vs)."""
+    wflat, metas = flatten_group(ws)
+    gflat, _ = flatten_group(gs)
+    mflat, _ = flatten_group(ms)
+    vflat, _ = flatten_group(vs)
+    if interpret or fused_optim_available():
+        s = _scalars(lr, wd, b1, b2, eps, lr * wd, 0.0, 0.0)
+        tf = jnp.asarray(t, jnp.float32).reshape(1, 1)
+        kern = functools.partial(_trainer_adam_kernel, adamw=adamw)
+        nw, nm, nv = _launch(kern, s, tf, [wflat, mflat, vflat, gflat], 3,
+                             interpret)
+    else:
+        g = gflat if adamw else gflat + wd * wflat
+        nm = b1 * mflat + (1 - b1) * g
+        nv = b2 * vflat + (1 - b2) * g * g
+        mhat = nm / (1 - b1 ** t)
+        vhat = nv / (1 - b2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        if adamw:
+            upd = upd + lr * wd * wflat
+        nw = wflat - upd
+    return split_group(nw, metas), split_group(nm, metas), split_group(
+        nv, metas)

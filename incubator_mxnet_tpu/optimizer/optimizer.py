@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ndarray import NDArray
-from ..ops._optim_kernels import (_sgd_update, _sgd_mom_update, _nag_update, _adam_update, _adamw_update, _adagrad_update, _rmsprop_update, _rmspropalex_update, _adadelta_update, _adamax_update, _nadam_update, _ftrl_update, _signsgd_update, _signum_update, _ftml_update, _sgld_update, _sgd_lazy_update, _sgd_mom_lazy_update, _adam_lazy_update, _adagrad_lazy_update, _pad_sparse)  # noqa: F401
+from ..ops._optim_kernels import (_sgd_update, _sgd_mom_update, _nag_update, _adam_update, _adamw_update, _adagrad_update, _rmsprop_update, _rmspropalex_update, _adadelta_update, _adamax_update, _nadam_update, _ftrl_update, _signsgd_update, _signum_update, _ftml_update, _sgld_update, _sgd_lazy_update, _sgd_mom_lazy_update, _adam_lazy_update, _adagrad_lazy_update, _pad_sparse, _multi_sgd_mom_update, _multi_adam_update, _multi_adamw_update)  # noqa: F401
 
 __all__ = ["Optimizer", "register", "create", "Updater", "get_updater"]
 
@@ -70,6 +70,31 @@ class Optimizer:
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError
+
+    def update_multi(self, indices, weights, grads, states):
+        """Apply one batch of updates. Base: the per-param loop. Fused
+        optimizers (SGD-momentum, Adam, AdamW) override this to pack
+        dtype-homogeneous dense fp32 groups into ONE multi-tensor launch
+        (ops/pallas/fused_optim.py); sparse/lazy and multi-precision
+        params always keep the per-param path. Returns the number of
+        fused launches (0 here) for the optim_fused_launches counter."""
+        for i, w, g, st in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, st)
+        return 0
+
+    def _fusable(self, weight, grad, state):
+        """Param eligible for the fused multi-tensor path: dense grad,
+        fp32 weight (the fused kernels pin bit-parity against the
+        per-param kernels under fp32 strong-typed scalars), plain (non
+        multi-precision) state."""
+        from ..ndarray.sparse import BaseSparseNDArray
+        from ..ops.pallas.fused_optim import fused_optim_enabled
+        return (fused_optim_enabled()
+                and not isinstance(grad, BaseSparseNDArray)
+                and state is not None
+                and not (self.multi_precision
+                         and weight.dtype in (jnp.float16, jnp.bfloat16))
+                and weight._data.dtype == jnp.float32)
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
@@ -177,6 +202,31 @@ class SGD(Optimizer):
                 jnp.float32(wd), jnp.float32(self.momentum),
                 jnp.float32(self.rescale_grad), _c(self.clip_gradient))
 
+    def update_multi(self, indices, weights, grads, states):
+        """Fused multi-tensor SGD-momentum: dense fp32 params grouped by
+        (lr, wd) update as ONE launch per group; everything else (sparse,
+        multi-precision, momentum=0) stays per-param."""
+        groups, rest = {}, []
+        for i, w, g, st in zip(indices, weights, grads, states):
+            if self.momentum == 0.0 or not self._fusable(w, g, st):
+                rest.append((i, w, g, st))
+                continue
+            self._update_count(i)
+            groups.setdefault((self._get_lr(i), self._get_wd(i)),
+                              []).append((w, g, st))
+        for (lr, wd), items in groups.items():
+            nws, nms = _multi_sgd_mom_update(
+                [w._data for w, _, _ in items],
+                [g._data for _, g, _ in items],
+                [s._data for _, _, s in items],
+                jnp.float32(lr), jnp.float32(wd), jnp.float32(self.momentum),
+                jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+            for (w, _, s), nw, nm in zip(items, nws, nms):
+                w._data, s._data = nw, nm
+        for i, w, g, st in rest:
+            self.update_multi_precision(i, w, g, st)
+        return len(groups)
+
 
 @jax.jit
 def _lars_sgd_mom_update(w, g, mom, lr, wd, momentum, rescale, clip):
@@ -224,6 +274,9 @@ class LBSGD(SGD):
                            (1 - 1.0 / self.batch_scale) * frac * frac)
             # 'sqrt'/none: keep base lr
         return lr
+
+    # LARS rates are per-layer norm-dependent — no fused multi-tensor path
+    update_multi = Optimizer.update_multi
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -289,6 +342,35 @@ class Adam(Optimizer):
             jnp.float32(self.epsilon), jnp.float32(t),
             jnp.float32(self.rescale_grad), _c(self.clip_gradient))
 
+    def update_multi(self, indices, weights, grads, states):
+        """Fused multi-tensor Adam: dense fp32 params grouped by
+        (lr, wd, t) update as ONE launch per group; lazy/sparse grads
+        keep the per-param row-touching path."""
+        groups, rest = {}, []
+        for i, w, g, st in zip(indices, weights, grads, states):
+            if not self._fusable(w, g, st):
+                rest.append((i, w, g, st))
+                continue
+            self._update_count(i)
+            t = self._index_update_count[i]
+            groups.setdefault((self._get_lr(i), self._get_wd(i), t),
+                              []).append((w, g, st))
+        for (lr, wd, t), items in groups.items():
+            nws, nms, nvs = _multi_adam_update(
+                [w._data for w, _, _ in items],
+                [g._data for _, g, _ in items],
+                [st[0]._data for _, _, st in items],
+                [st[1]._data for _, _, st in items],
+                jnp.float32(lr), jnp.float32(wd), jnp.float32(self.beta1),
+                jnp.float32(self.beta2), jnp.float32(self.epsilon),
+                jnp.float32(t), jnp.float32(self.rescale_grad),
+                _c(self.clip_gradient))
+            for (w, _, st), nw, nm, nv in zip(items, nws, nms, nvs):
+                w._data, st[0]._data, st[1]._data = nw, nm, nv
+        for i, w, g, st in rest:
+            self.update_multi_precision(i, w, g, st)
+        return len(groups)
+
 
 @register
 class AdamW(Optimizer):
@@ -313,6 +395,34 @@ class AdamW(Optimizer):
             jnp.float32(self.eta), jnp.float32(self.beta1),
             jnp.float32(self.beta2), jnp.float32(self.epsilon), jnp.float32(t),
             jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+
+    def update_multi(self, indices, weights, grads, states):
+        """Fused multi-tensor AdamW: dense fp32 params grouped by
+        (lr, wd, t), one launch per group."""
+        groups, rest = {}, []
+        for i, w, g, st in zip(indices, weights, grads, states):
+            if not self._fusable(w, g, st):
+                rest.append((i, w, g, st))
+                continue
+            self._update_count(i)
+            t = self._index_update_count[i]
+            groups.setdefault((self._get_lr(i), self._get_wd(i), t),
+                              []).append((w, g, st))
+        for (lr, wd, t), items in groups.items():
+            nws, nms, nvs = _multi_adamw_update(
+                [w._data for w, _, _ in items],
+                [g._data for _, g, _ in items],
+                [st[0]._data for _, _, st in items],
+                [st[1]._data for _, _, st in items],
+                jnp.float32(lr), jnp.float32(wd), jnp.float32(self.eta),
+                jnp.float32(self.beta1), jnp.float32(self.beta2),
+                jnp.float32(self.epsilon), jnp.float32(t),
+                jnp.float32(self.rescale_grad), _c(self.clip_gradient))
+            for (w, _, st), nw, nm, nv in zip(items, nws, nms, nvs):
+                w._data, st[0]._data, st[1]._data = nw, nm, nv
+        for i, w, g, st in rest:
+            self.update_multi_precision(i, w, g, st)
+        return len(groups)
 
 
 @register
@@ -574,6 +684,20 @@ class Updater:
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def update_multi(self, indices, grads, weights):
+        """Batched form of __call__: hand the whole step's params to the
+        optimizer at once so fused optimizers collapse them into one
+        multi-tensor launch per group (per-param loop otherwise)."""
+        for i, w in zip(indices, weights):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+        launches = self.optimizer.update_multi(
+            indices, weights, grads, [self.states[i] for i in indices])
+        if launches:
+            from ..telemetry import catalog as _cat
+            _cat.optim_fused_launches.inc(launches)
 
     def get_states(self, dump_optimizer=False):
         import pickle

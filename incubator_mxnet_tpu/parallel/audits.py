@@ -6,7 +6,7 @@ the driver executes is byte-for-byte the audit the tests pin.
 """
 
 __all__ = ["three_axis_pipeline_audit", "four_axis_ring_pipeline_audit",
-           "moe_pipeline_audit"]
+           "moe_pipeline_audit", "donation_layout_audit"]
 
 
 def _xent_loss(out, lab):
@@ -271,3 +271,87 @@ def moe_pipeline_audit(devices):
         ("ep vs constraint-off loss mismatch inside pp", loss_ep, loss_off)
     assert np.isfinite(float(jax.device_get(tr_ep.step(xm, ym))))
     return counts_ep
+
+def donation_layout_audit(tr, data, label):
+    """Donation/layout audit of the COMPILED donating train step.
+
+    Walks the executable training actually runs (donation ON —
+    ``audit_step``'s no-donation twin cannot see aliasing) and reports
+    which donated input buffers the compiler aliased to an output
+    (in-place update, copy elided) and which it REFUSED — every refusal
+    is a full extra HBM copy of that leaf per step. Then runs ONE real
+    ``tr.step`` counting device->host fetches: the plain-step contract
+    is ZERO (the loss comes back as an async device scalar; only
+    step_guarded pays one fused stats read) — any fetch here is a
+    hidden pipeline bubble in the step loop.
+
+    Returns a dict: donated_leaves, donation_intended (lowering-level
+    ``tf.aliasing_output`` marks), aliased, unaliased, donated_bytes,
+    unaliased_bytes, unaliased_names (worst offenders, when the leaf
+    order is attributable), host_syncs_per_step, collectives. Never
+    asserts — tools/diagnose.py renders it, tests pin the invariants.
+    MUTATES trainer state by one optimizer step (the real step is what
+    makes the host-sync count honest)."""
+    import re
+    import jax
+    from .collectives import collective_counts
+
+    datas, labels = tr._prep_batch(data, label)
+    key = jax.random.PRNGKey(0)
+    fn = tr._build(len(datas))          # the donating jit, as trained
+    args = tr._exe_args(datas, labels, key)
+    lowered = fn.lower(*args)
+    intended = lowered.as_text().count("tf.aliasing_output")
+    hlo = lowered.compile().as_text()
+    header = next((ln for ln in hlo.splitlines()
+                   if "input_output_alias=" in ln), "")
+    aliased_idx = {int(i) for i in
+                   re.findall(r"\((\d+),\s*\{\}", header)}
+    aliased = header.count("-alias)")
+
+    donated = list(jax.tree_util.tree_leaves(tuple(args[:3])))
+    names = []                          # leaf attribution (flatten order:
+    pv, av, opt = args[0], args[1], args[2]   # sorted dict keys)
+    for n in sorted(pv):
+        names.append("param:%s" % n)
+    for n in sorted(av):
+        names.append("aux:%s" % n)
+    for n in sorted(opt):
+        for j in range(len(opt[n])):
+            names.append("opt:%s[%d]" % (n, j))
+    attributable = len(names) == len(donated)
+    nbytes = [int(getattr(l, "size", 0))
+              * int(getattr(getattr(l, "dtype", None), "itemsize", 0) or 0)
+              for l in donated]
+    unaliased_names, unaliased_bytes = [], 0
+    if attributable:
+        missed = [(nbytes[i], names[i]) for i in range(len(donated))
+                  if i not in aliased_idx]
+        missed.sort(reverse=True)
+        unaliased_bytes = sum(b for b, _ in missed)
+        unaliased_names = [n for _, n in missed[:16]]
+
+    counter = {"n": 0}
+    orig_get = jax.device_get
+
+    def _counting_get(x):
+        counter["n"] += 1
+        return orig_get(x)
+
+    jax.device_get = _counting_get
+    try:
+        tr.step(data, label)            # one REAL donating step
+    finally:
+        jax.device_get = orig_get
+
+    return {
+        "donated_leaves": len(donated),
+        "donation_intended": intended,
+        "aliased": aliased,
+        "unaliased": max(0, len(donated) - aliased),
+        "donated_bytes": sum(nbytes),
+        "unaliased_bytes": unaliased_bytes,
+        "unaliased_names": unaliased_names,
+        "host_syncs_per_step": counter["n"],
+        "collectives": collective_counts(hlo),
+    }

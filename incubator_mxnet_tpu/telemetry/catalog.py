@@ -90,6 +90,15 @@ trainer_step_seconds = _m.histogram(
 trainer_samples = _m.counter(
     "mxtpu_trainer_samples_total",
     "Leading-dim samples consumed by step/step_scan (tokens/sec numerator)")
+trainer_overlap_pct = _m.gauge(
+    "mxtpu_trainer_overlap_pct",
+    "Percent of PS gradient-sync time hidden behind compute/compression "
+    "by the bucketed push_pull pipeline (100 = fully overlapped, 0 = "
+    "serial); written by kvstore/dist.py each bucketed step")
+optim_fused_launches = _m.counter(
+    "mxtpu_optim_fused_launches_total",
+    "Fused multi-tensor optimizer launches (one per dtype/hyperparam "
+    "group per step) that replaced a per-param update loop")
 jit_compiles = _m.counter(
     "mxtpu_jit_compiles_total",
     "XLA backend_compile events observed via jax.monitoring, by where "

@@ -139,3 +139,35 @@ def test_disabled_compile_cache_is_one_env_check(monkeypatch):
     assert ccstore.default_store() is None
     assert ccstore.statusz_entry() == {"enabled": False}
     assert calls == []
+
+
+def test_disabled_fused_optim_is_one_env_check(monkeypatch):
+    """Fused optimizer off (MXTPU_FUSED_OPTIM=0): the eligibility gate
+    reduces to one env-dict lookup, and update_multi reports zero fused
+    launches while still applying the per-param updates."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd, optimizer as opt
+    from incubator_mxnet_tpu.ops.pallas.fused_optim import (
+        fused_optim_enabled)
+    monkeypatch.setenv("MXTPU_FUSED_OPTIM", "0")
+    assert fused_optim_enabled() is False
+    assert _per_call(fused_optim_enabled) < MAX_SECONDS_PER_CALL
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    w = nd.array(np.ones((4, 3), np.float32))
+    g = nd.array(np.full((4, 3), 0.5, np.float32))
+    st = o.create_state(0, w)
+    assert o.update_multi([0], [w], [g], [st]) == 0
+    assert (np.asarray(w._data) != 1.0).all()   # update still applied
+
+
+def test_disabled_ps_overlap_is_one_flag_check():
+    """Overlap pipeline off (MXTPU_PS_BUCKET_MB=0): the gate the Trainer
+    reads at kv init is two attribute checks — the cap is parsed ONCE at
+    store construction, never per step, and the off path allocates
+    nothing."""
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    kv = KVStoreDist.__new__(KVStoreDist)   # predicate needs no connection
+    kv._bucket_bytes = 0
+    kv._io = None
+    assert kv.overlap_enabled() is False
+    assert _per_call(kv.overlap_enabled) < MAX_SECONDS_PER_CALL

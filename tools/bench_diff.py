@@ -14,6 +14,12 @@ Preflight health rows (tunnel_preflight_*) are diagnostics, not
 benchmarks — dispatch RTT is lower-is-better and tunnel-condition
 dependent — so they are reported but never gated on.
 
+Every metric line since round 6 carries a `platform`/`device_kind`
+stamp. The regression gate only arms when BOTH rounds carry the SAME
+platform; a cross-platform pair (or one predating the stamp) prints
+its rows for reference and warn-skips with exit 0 — a CPU round vs a
+TPU round is not a regression signal in either direction.
+
     python tools/bench_diff.py                 # newest vs previous, repo root
     python tools/bench_diff.py --dir . --threshold 0.05
     python tools/bench_diff.py --old BENCH_r03.json --new BENCH_r05.json
@@ -44,6 +50,18 @@ def load_round(path):
             continue
         out[rec["metric"]] = rec
     return out
+
+
+def round_platform(recs):
+    """The round's recorded platform stamp ('cpu', 'tpu', ...) or None
+    for rounds predating the stamp. Rounds are single-process runs so a
+    mixed stamp is never expected; if it happens, the joined set makes
+    the mismatch visible instead of hiding behind one element."""
+    plats = {str(r["platform"]) for r in recs.values()
+             if r.get("platform")}
+    if not plats:
+        return None
+    return plats.pop() if len(plats) == 1 else "+".join(sorted(plats))
 
 
 def comparable(rec):
@@ -123,6 +141,18 @@ def main(argv=None):
     print("bench_diff: %s -> %s (gate: -%.0f%%)"
           % (os.path.basename(old_path), os.path.basename(new_path),
              args.threshold * 100))
+    # cross-platform guard: a CPU round vs a TPU round is not a
+    # regression signal in either direction, so the gate only arms when
+    # BOTH rounds carry the same platform stamp. Mismatched (or
+    # pre-stamp unstamped) pairs still print their rows for the reader,
+    # but warn-skip with exit 0 instead of failing.
+    po, pn = round_platform(old), round_platform(new)
+    gate_armed = po is not None and po == pn
+    if not gate_armed:
+        print("  WARNING: platform stamps %r -> %r differ or are "
+              "missing — rows shown for reference, regression gate "
+              "SKIPPED (cross-platform rates are not comparable)"
+              % (po, pn))
     for metric in fresh:
         print("  %-9s %-52s %27.2f  baseline established — gated "
               "from next round" % ("new", metric, new[metric]["value"]))
@@ -136,6 +166,7 @@ def main(argv=None):
         return 2
     failed = False
     for metric, kind, o, n, ratio, regressed in rows:
+        regressed = regressed and gate_armed
         flag = "REGRESSED" if regressed else "ok"
         print("  %-9s %-52s %12.2f -> %12.2f  %+6.1f%%  %s"
               % (kind, metric, o, n, (ratio - 1.0) * 100, flag))
