@@ -31,7 +31,8 @@ import numpy as np
 from ..compilecache import aot as _aot
 from ..compilecache import store as _ccstore
 from ..models.gpt import gpt_config, gpt_forward_paged, gpt_param_shapes
-from ..serving.loader import ServedModel, serving_family
+from ..serving.loader import (GenerationMismatchError, ServedModel,
+                              serving_family)
 from ..utils.checkpoint import CheckpointManager
 from .paged_kv import PagedKVCache
 
@@ -134,6 +135,44 @@ class _PagedProgramSet:
             self._jit = jax.jit(self._pure())
         return self._jit([tokens, lengths, tables] + list(kps)
                          + list(vps), self.pvals)
+
+    def stage_swap(self, params):
+        """Validate an incoming param dict against this set's avals and
+        return the replacement value list — nothing is mutated here, so
+        a mismatch on the draft set can't leave the target half-swapped.
+        Raises GenerationMismatchError on missing params or shape/dtype
+        drift (the swap would retrace the bound executables)."""
+        import jax.numpy as jnp
+        missing = [n for n in self.pnames if n not in params]
+        if missing:
+            raise GenerationMismatchError(
+                "incoming generation is missing gpt params (%s): %s"
+                % (self.tag, ", ".join(missing[:8])))
+        vals, drift = [], []
+        for n, cur in zip(self.pnames, self.pvals):
+            arr = params[n]
+            # checkpoint restores hand back NDArrays; unwrap before the
+            # aval check (np.asarray on one yields an object scalar)
+            arr = arr.asnumpy() if hasattr(arr, "asnumpy") \
+                else np.asarray(arr)
+            if tuple(arr.shape) != tuple(cur.shape) \
+                    or np.dtype(arr.dtype) != np.dtype(cur.dtype):
+                drift.append("%s: %s%s -> %s%s"
+                             % (n, np.dtype(cur.dtype), tuple(cur.shape),
+                                arr.dtype, arr.shape))
+                continue
+            vals.append(jnp.asarray(arr))
+        if drift:
+            raise GenerationMismatchError(
+                "incoming generation's gpt avals drifted (%s): %s"
+                % (self.tag, "; ".join(drift[:8])))
+        return vals
+
+    def apply_swap(self, vals):
+        """Install staged values IN PLACE: ``pvals`` is the live list
+        the jit fallback passes per call, so mutating it (not rebinding)
+        swaps the eager path too."""
+        self.pvals[:] = vals
 
 
 @serving_family("gpt_decoder")
@@ -278,6 +317,29 @@ def _build_gpt_decoder(config, params, quantize):
             (built if factory(slots) is not None else failed).append(name)
         return {"built": built, "failed": failed}
 
+    def swap(params):
+        """Live weight push for the paged family: params-only, cache
+        untouched — the paged K/V pools and block tables are inputs to
+        the programs, not captured state, so in-flight sessions that
+        survive the server's drain keep their committed prefix and the
+        next step simply reads the new weights. Both param sets are
+        validated BEFORE either is touched (an aval drift on the draft
+        must not leave the target half-swapped); the program walk
+        rewrites each BlockProgram's own param list (BlockProgram copies
+        it at build time) as well as the sets' jit-fallback lists."""
+        staged = [(target, target.stage_swap(params))]
+        if draft is not None:
+            staged.append((draft, draft.stage_swap(
+                {k[len(_DRAFT_PREFIX):]: v for k, v in params.items()
+                 if k.startswith(_DRAFT_PREFIX)})))
+        for pset, vals in staged:
+            pset.apply_swap(vals)
+        for name, prog in decode_programs.items():
+            if prog is None:
+                continue
+            pset = draft if name.startswith("gptdraft/") else target
+            prog.param_vals[:] = pset.pvals
+
     served = ServedModel("gpt_decoder", config, step_fn=step,
                          make_cache=make_cache, pad_token=0,
                          quantized=False,
@@ -285,19 +347,23 @@ def _build_gpt_decoder(config, params, quantize):
                          program_binder=bind,
                          decode_programs=decode_programs,
                          prefill_fn=prefill,
-                         prefill_chunk=prefill_chunk)
+                         prefill_chunk=prefill_chunk,
+                         params_swapper=swap)
     served.extra_warmup = extra_warmup
     served.draft_program_factory = draft_program_for
     return served
 
 
 def export_gpt_for_serving(directory, config, model, draft=None,
-                           executables=None):
+                           executables=None, generation=None):
     """Write a gpt_decoder serving checkpoint: the target decoder's
     params (flat local names), optionally a draft model's params under
     ``draft/`` with its config under ``config["draft"]``, plus the
     family stanza — same atomic checkpoint machinery as
-    ``export_for_serving``, extended for the two-model layout."""
+    ``export_for_serving``, extended for the two-model layout. Like
+    every serving export this publishes a new GENERATION (monotonic,
+    pointer re-pointed atomically, older generations retained)."""
+    from ..serving.loader import generation_steps, publish_generation
     params = {k: v.data() for k, v
               in model._collect_params_with_prefix().items()}
     config = dict(config)
@@ -311,7 +377,18 @@ def export_gpt_for_serving(directory, config, model, draft=None,
                              "config['draft'] explicitly")
     mgr = CheckpointManager(directory, keep=None, async_save=False,
                             prefix="serve")
-    mgr.save(0, params, extra={"serving": {"family": "gpt_decoder",
-                                           "config": config}},
+    gens = generation_steps(directory)
+    if generation is None:
+        generation = max(gens, default=-1) + 1
+    elif gens and int(generation) <= max(gens):
+        raise ValueError("generation numbers are monotonic: %d is not "
+                         "newer than the retained max %d"
+                         % (int(generation), max(gens)))
+    step = mgr.latest_step()
+    step = 0 if step is None else step + 1
+    mgr.save(step, params, extra={"serving": {"family": "gpt_decoder",
+                                              "config": config},
+                                  "generation": int(generation)},
              executables=executables)
+    publish_generation(directory, generation, step)
     return directory

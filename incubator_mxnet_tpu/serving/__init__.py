@@ -18,11 +18,14 @@ Latency/throughput instruments (p50/p99, QPS, batch occupancy) live in
 telemetry/catalog.py under the `serving_*` names.
 """
 
-from .client import DeadlineExceeded, ServingClient, ServingError
+from .client import (DeadlineExceeded, Draining, ServingClient,
+                     ServingError)
 from .decode import DecodeLoop, DecodeRequest
 from .kv_cache import KVCache
-from .loader import (SERVING_FAMILIES, ServedModel, export_for_serving,
-                     load_served_model, serving_family)
+from .loader import (SERVING_FAMILIES, GenerationMismatchError,
+                     ServedModel, export_for_serving, generation_steps,
+                     load_generation_params, load_served_model,
+                     publish_generation, read_generation, serving_family)
 from .quant import Int8Dense, int8_serving_enabled
 from .scheduler import (ContinuousBatcher, Request, ShedError, bucket_for,
                         default_buckets, pad_to_bucket)
@@ -30,9 +33,11 @@ from .server import ModelServer
 
 __all__ = [
     "ContinuousBatcher", "DeadlineExceeded", "DecodeLoop", "DecodeRequest",
-    "Int8Dense", "KVCache", "ModelServer", "Request", "SERVING_FAMILIES",
-    "ServedModel", "ServingClient", "ServingError", "ShedError",
-    "bucket_for", "default_buckets", "export_for_serving",
-    "int8_serving_enabled", "load_served_model", "pad_to_bucket",
+    "Draining", "GenerationMismatchError", "Int8Dense", "KVCache",
+    "ModelServer", "Request", "SERVING_FAMILIES", "ServedModel",
+    "ServingClient", "ServingError", "ShedError", "bucket_for",
+    "default_buckets", "export_for_serving", "generation_steps",
+    "int8_serving_enabled", "load_generation_params", "load_served_model",
+    "pad_to_bucket", "publish_generation", "read_generation",
     "serving_family",
 ]

@@ -10,7 +10,19 @@ shed by the scheduler if the batch can't make it, and surfaced here as
 a `DeadlineExceeded` carrying the stage that dropped it. One Connection
 serializes its calls — run one client per concurrent request stream
 (that is what the server's continuous batcher coalesces).
+
+Rolling deploys are transparent: a model mid-drain sheds with the
+RETRIABLE ``DRAINING`` status, and ``infer``/``decode`` retry it — by
+rotating to the next replica when the client was built with several
+addresses, or after a short backoff with one (the drain window is a
+quiesce plus one in-place weight copy). The retry budget respects
+``deadline_ms``; knobs are MXTPU_DEPLOY_RETRY_MAX /
+MXTPU_DEPLOY_RETRY_BACKOFF_MS, read ONCE at construction so the
+request hot path adds no env lookups.
 """
+
+import os
+import time
 
 import numpy as np
 
@@ -18,7 +30,8 @@ from ..kvstore.rpc import Connection
 from .scheduler import ShedError
 from .wire import pack_arrays, unpack_arrays
 
-__all__ = ["ServingClient", "ServingError", "DeadlineExceeded"]
+__all__ = ["ServingClient", "ServingError", "DeadlineExceeded",
+           "Draining"]
 
 
 class ServingError(RuntimeError):
@@ -31,12 +44,52 @@ class DeadlineExceeded(ServingError):
         self.stage = stage
 
 
+class Draining(ServingError):
+    """The model is draining for a live weight swap — a RETRIABLE
+    condition (``infer``/``decode`` retry it automatically; this only
+    escapes when the retry budget or the deadline ran out)."""
+
+    stage = "draining"
+
+
+def _normalize_addrs(addr):
+    def one(a):
+        if isinstance(a, str):
+            host, _, port = a.rpartition(":")
+            return (host or "127.0.0.1", int(port))
+        return (str(a[0]), int(a[1]))
+    if isinstance(addr, str):
+        return [one(addr)]
+    addr = list(addr)
+    if len(addr) == 2 and isinstance(addr[0], str) \
+            and isinstance(addr[1], (int, np.integer)):
+        return [one(addr)]      # a single ("host", port) pair
+    return [one(a) for a in addr]
+
+
 class ServingClient:
-    def __init__(self, addr, timeout=120.0):
-        self._conn = Connection(addr, timeout=timeout)
+    """``addr`` is one replica — ``("host", port)`` or ``"host:port"``
+    — or a LIST of replicas; calls go to the current replica and a
+    DRAINING shed rotates to the next one."""
+
+    def __init__(self, addr, timeout=120.0, retry_draining=None,
+                 retry_backoff_ms=None):
+        self._addrs = _normalize_addrs(addr)
+        self._timeout = float(timeout)
+        self._conns = {}
+        self._cur = 0
+        self._retries = int(
+            retry_draining if retry_draining is not None
+            else os.environ.get("MXTPU_DEPLOY_RETRY_MAX", "40") or 40)
+        self._backoff = float(
+            retry_backoff_ms if retry_backoff_ms is not None
+            else os.environ.get("MXTPU_DEPLOY_RETRY_BACKOFF_MS",
+                                "100") or 100) / 1e3
 
     def close(self):
-        self._conn.close()
+        for conn in self._conns.values():
+            conn.close()
+        self._conns = {}
 
     def __enter__(self):
         return self
@@ -45,16 +98,53 @@ class ServingClient:
         self.close()
 
     # ---------------------------------------------------------------- rpc
+    def _connection(self):
+        conn = self._conns.get(self._cur)
+        if conn is None:
+            conn = self._conns[self._cur] = Connection(
+                self._addrs[self._cur], timeout=self._timeout)
+        return conn
+
     def _call(self, meta, payload=b"", deadline_ms=None):
         if deadline_ms is not None:
             meta["_deadline_ms"] = float(deadline_ms)
-        rmeta, rpayload = self._conn.call(meta, payload)
+        rmeta, rpayload = self._connection().call(meta, payload)
+        if rmeta.get("draining"):
+            raise Draining(rmeta.get("error", "model is draining"))
         if rmeta.get("shed") or rmeta.get("deadline_exceeded"):
             raise DeadlineExceeded(rmeta.get("error", "request shed"),
                                    stage=rmeta.get("shed", "rpc"))
         if rmeta.get("error"):
             raise ServingError(rmeta["error"])
         return rmeta, rpayload
+
+    def _call_retrying(self, meta, payload=b"", deadline_ms=None):
+        """_call, transparently retrying DRAINING sheds: next replica
+        when there is one (plus a backoff once a full rotation came up
+        dry), backoff-then-same-replica otherwise. The deadline budget
+        shrinks across attempts; exhausting it (or the retry cap)
+        re-raises the last Draining."""
+        start = time.monotonic()
+        for attempt in range(self._retries + 1):
+            budget = deadline_ms
+            if deadline_ms is not None:
+                budget = deadline_ms - (time.monotonic() - start) * 1e3
+                if budget <= 0 and attempt:
+                    raise DeadlineExceeded(
+                        "deadline exhausted while the model was draining",
+                        stage="draining")
+            try:
+                return self._call(dict(meta), payload, deadline_ms=budget)
+            except Draining:
+                if attempt >= self._retries:
+                    raise
+                if len(self._addrs) > 1:
+                    self._cur = (self._cur + 1) % len(self._addrs)
+                    if (attempt + 1) % len(self._addrs) == 0:
+                        time.sleep(self._backoff)
+                else:
+                    time.sleep(self._backoff)
+        raise Draining("retry budget exhausted")    # pragma: no cover
 
     # ---------------------------------------------------------------- ops
     def ping(self):
@@ -78,7 +168,7 @@ class ServingClient:
         """One-shot forward on `model`. arrays: name -> (rows, ...) array,
         all with the same leading dim. Returns name -> array."""
         manifest, payload = pack_arrays(arrays)
-        meta, rpayload = self._call(
+        meta, rpayload = self._call_retrying(
             {"op": "serve.infer", "model": model, "arrays": manifest},
             payload, deadline_ms=deadline_ms)
         return unpack_arrays(meta["arrays"], rpayload)
@@ -93,8 +183,46 @@ class ServingClient:
                "max_new_tokens": int(max_new_tokens)}
         if eos_id is not None:
             req["eos_id"] = int(eos_id)
-        meta, rpayload = self._call(req, payload, deadline_ms=deadline_ms)
+        meta, rpayload = self._call_retrying(req, payload,
+                                             deadline_ms=deadline_ms)
         return unpack_arrays(meta["arrays"], rpayload)["tokens"]
+
+    # ------------------------------------------------------ deploy plane
+    def deploy(self, model, generation=None, directory=None):
+        """Drain->swap->re-admit `model` on the CURRENT replica (the
+        rollout coordinator runs one client per replica). Defaults:
+        the generation pointer of the directory the replica loaded
+        from."""
+        req = {"op": "serve.deploy", "model": model}
+        if generation is not None:
+            req["generation"] = int(generation)
+        if directory is not None:
+            req["directory"] = directory
+        meta, _ = self._call(req)
+        return meta
+
+    def drain(self, model, timeout=None):
+        req = {"op": "serve.drain", "model": model}
+        if timeout is not None:
+            req["timeout"] = float(timeout)
+        meta, _ = self._call(req)
+        return meta
+
+    def admit(self, model):
+        meta, _ = self._call({"op": "serve.admit", "model": model})
+        return meta
+
+    def generation(self, model=None):
+        """{model: {"generation", "draining"}} for the current replica,
+        or just `model`'s entry when named."""
+        meta, _ = self._call({"op": "serve.generation"})
+        gens = meta["generations"]
+        if model is None:
+            return gens
+        if model not in gens:
+            raise ServingError("model %r is not loaded (have: %s)"
+                               % (model, sorted(gens)))
+        return gens[model]
 
 
 # re-exported so callers can catch scheduler sheds without importing it

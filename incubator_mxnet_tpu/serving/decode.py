@@ -114,6 +114,8 @@ class DecodeLoop:
         self._pending = collections.deque()
         self._active = {}               # slot -> _Seq
         self._stopping = False
+        self._draining = False
+        self._in_step = False           # a step_fn call is running now
         self._steps = 0
         self._ewma_step = None
         self._thread = threading.Thread(
@@ -137,6 +139,10 @@ class DecodeLoop:
             if self._stopping:
                 req.fail(RuntimeError("decode loop %r is stopped"
                                       % self.name))
+                return req
+            if self._draining:
+                self._shed(req, "draining",
+                           "model is draining for a weight swap; retry")
                 return req
             self._pending.append(req)
             self._cond.notify_all()
@@ -168,6 +174,53 @@ class DecodeLoop:
                 self._cache.free(slot)
             self._active.clear()
 
+    # ------------------------------------------------------ drain/re-admit
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout=30.0):
+        """Fence the decode plane for a weight swap. New submits shed
+        with the RETRIABLE "draining" stage; queued-but-unslotted
+        requests are shed immediately (their retry re-prefills against
+        the new weights); ACTIVE sequences get `timeout` seconds to
+        finish naturally. Stragglers past the deadline are fenced —
+        shed "draining", slots freed on the loop's next retire pass —
+        so the session is re-prefillable on retry and the swap never
+        lands mid-step. Returns True when the grid is empty and no step
+        is in flight; False means a step is STILL running — do not
+        swap."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            self._draining = True
+            while self._pending:
+                self._shed(self._pending.popleft(), "draining",
+                           "drained before admission; retry")
+            self._cond.notify_all()
+            while self._active or self._in_step:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(min(left, 0.05))
+            for seq in list(self._active.values()):
+                self._shed(seq.req, "draining",
+                           "fenced at the drain deadline; the session "
+                           "re-prefills on retry")
+            # fenced sequences retire (slots freed) on the loop's next
+            # pass; give the in-flight step one more window to land
+            while self._active or self._in_step:
+                left = deadline + float(timeout) - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def admit(self):
+        """Re-open admission after a drain()."""
+        with self._cond:
+            self._draining = False
+            self._cond.notify_all()
+
     def reset_service_estimates(self):
         """Forget the EWMA step time (see ContinuousBatcher's twin —
         compile-skewed early samples would join-shed deadlined work)."""
@@ -178,6 +231,7 @@ class DecodeLoop:
         with self._cond:
             return {"pending": len(self._pending),
                     "active": len(self._active),
+                    "draining": self._draining,
                     "steps": self._steps,
                     "step_ewma_s": self._ewma_step}
 
@@ -197,6 +251,8 @@ class DecodeLoop:
         Families with a ``prefill_fn`` get their prompt prefix committed
         here, chunked, so the step grid only ever feeds the LAST prompt
         token (chunked prefill replaces one-token-per-step prefill)."""
+        if self._draining:      # no new sessions join mid-drain
+            return
         now = time.monotonic()
         est = self._ewma_step or 0.0
         while self._pending and self._cache.in_use < self._cache.slots:
@@ -248,6 +304,8 @@ class DecodeLoop:
                     return
                 self._admit_locked()
                 active = dict(self._active)
+                if active:
+                    self._in_step = True
             if not active:
                 continue
             tokens = np.full(slots, self._pad, np.int32)
@@ -268,6 +326,8 @@ class DecodeLoop:
                                                       status="error")
                         self._cache.free(slot)
                     self._active.clear()
+                    self._in_step = False
+                    self._cond.notify_all()
                 continue
             dt = time.perf_counter() - t0
             self._ewma_step = dt if self._ewma_step is None else \
@@ -316,6 +376,8 @@ class DecodeLoop:
                     del self._active[slot]
                 _cat.serving_decode_slots.set(len(self._active),
                                               model=self.name)
+                self._in_step = False
+                self._cond.notify_all()     # wake a waiting drain()
             if step_decode_tokens:
                 _cat.gen_tokens_committed.inc(
                     step_decode_tokens, model=self.name, phase="decode")

@@ -21,7 +21,9 @@ for autoregressive ones (driven by decode.DecodeLoop). Two built-ins:
   (serving.quant.Int8Dense) when quantization is on.
 """
 
+import json
 import logging
+import os
 
 import numpy as np
 
@@ -34,11 +36,23 @@ from .kv_cache import KVCache
 from .quant import Int8Dense, int8_serving_enabled
 
 __all__ = ["ServedModel", "serving_family", "export_for_serving",
-           "load_served_model", "attach_executables", "SERVING_FAMILIES"]
+           "load_served_model", "attach_executables", "SERVING_FAMILIES",
+           "GenerationMismatchError", "GENERATION_POINTER",
+           "publish_generation", "read_generation", "generation_steps",
+           "load_generation_params"]
 
 log = logging.getLogger(__name__)
 
 SERVING_FAMILIES = {}
+
+GENERATION_POINTER = "GENERATION.json"
+
+
+class GenerationMismatchError(ValueError):
+    """A live weight swap was refused: the incoming generation's params
+    don't match the avals the bound AOT executables were compiled for
+    (missing params, or shape/dtype drift). Swapping them in would
+    silently retrace/recompile — the deploy must re-export instead."""
 
 
 def serving_family(name):
@@ -69,7 +83,7 @@ class ServedModel:
                  program_factory=None, decode_program_factory=None,
                  program_binder=None, warmup_signatures=None,
                  programs=None, decode_programs=None, prefill_fn=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, params_swapper=None):
         if encode_fn is None and step_fn is None:
             raise ValueError("a ServedModel needs encode_fn, step_fn, "
                              "or both")
@@ -95,6 +109,8 @@ class ServedModel:
         self.programs = programs if programs is not None else {}
         self.decode_programs = (decode_programs
                                 if decode_programs is not None else {})
+        self.params_swapper = params_swapper
+        self.generation = 0
 
     @property
     def has_encode(self):
@@ -138,6 +154,22 @@ class ServedModel:
                              prog.name, type(e).__name__, e)
         return out
 
+    def swap_params(self, params, generation):
+        """Replace the served weights IN PLACE with `params` — the live
+        weight push. The family swapper validates the incoming avals
+        first (GenerationMismatchError on any drift — the current
+        weights keep serving) and then rewrites the param lists every
+        bound AOT executable reads at call time, so the swap reuses the
+        compiled programs: zero retraces, zero recompiles. The caller
+        (ModelServer.deploy) owns the scheduling contract — the model
+        must be drained, never mid-batch."""
+        if self.params_swapper is None:
+            raise RuntimeError("serving family %r does not support live "
+                               "param swap" % self.family)
+        self.params_swapper(params)
+        self.generation = int(generation)
+        return self
+
     def bind_executable(self, name, blob):
         """Rebind one serialized executable from a checkpoint onto this
         model's params. Returns True when bound; a stale or foreign blob
@@ -154,25 +186,134 @@ class ServedModel:
             return False
 
 
+# ----------------------------------------------------------- generations
+def _serve_mgr(directory, keep=None):
+    return CheckpointManager(directory, keep=keep, async_save=False,
+                             prefix="serve")
+
+
+def read_generation(directory):
+    """The published generation pointer ({"generation", "step", "time"})
+    or None when the directory has never published one."""
+    return _serve_mgr(directory).read_pointer(GENERATION_POINTER)
+
+
+def publish_generation(directory, generation, step):
+    """Atomically (re)point the directory's generation pointer — the
+    rename-aside publish discipline, so replicas polling the pointer see
+    the old generation or the new one, never a torn file. Forward
+    publishes come from ``export_for_serving``; a rollback re-points to
+    an older generation that is still retained on disk."""
+    import time as _time
+    return _serve_mgr(directory).publish_pointer(
+        GENERATION_POINTER, {"generation": int(generation),
+                             "step": int(step), "time": _time.time()})
+
+
+def generation_steps(directory):
+    """{generation: step} for every retained serving checkpoint that
+    carries a generation number (newest step wins when a generation was
+    re-published, e.g. by ``attach_executables``)."""
+    mgr = _serve_mgr(directory)
+    out = {}
+    for s in mgr.steps():
+        try:
+            with open(os.path.join(directory, "serve-%08d" % s,
+                                   "meta.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if meta.get("generation") is not None:
+            out[int(meta["generation"])] = int(s)
+    return out
+
+
+def load_generation_params(directory, generation=None):
+    """Params + meta of one retained generation (default: the pointer's)
+    WITHOUT rebuilding the family — the swap payload for
+    ``ServedModel.swap_params``. Raises FileNotFoundError when the
+    generation is not retained on disk."""
+    mgr = _serve_mgr(directory)
+    if generation is None:
+        ptr = read_generation(directory)
+        if not ptr:
+            raise FileNotFoundError("no generation pointer under %r"
+                                    % directory)
+        generation = ptr["generation"]
+    generation = int(generation)
+    gens = generation_steps(directory)
+    if generation not in gens:
+        raise FileNotFoundError(
+            "generation %d is not retained under %r (have: %s)"
+            % (generation, directory, sorted(gens)))
+    _step, params, _trainer, meta = mgr.restore(gens[generation])
+    return params, meta
+
+
+def check_generation_avals(current, new, context=""):
+    """Validate an incoming param dict against the live one: every
+    current param must be present in `new` with the same shape and
+    dtype. Raises GenerationMismatchError naming the drift; extras in
+    `new` are ignored (forward-compatible checkpoints)."""
+    where = " (%s)" % context if context else ""
+    missing = sorted(set(current) - set(new))
+    if missing:
+        raise GenerationMismatchError(
+            "incoming generation is missing params%s: %s"
+            % (where, ", ".join(missing[:8])))
+    drift = []
+    for name in sorted(current):
+        cur, inc = current[name], new[name]
+        cs, cd = tuple(cur.shape), np.dtype(cur.dtype)
+        ns = tuple(getattr(inc, "shape", np.shape(inc)))
+        nd_ = np.dtype(getattr(inc, "dtype", None)
+                       or np.asarray(inc).dtype)
+        if cs != ns or cd != nd_:
+            drift.append("%s: %s%s -> %s%s" % (name, cd, cs, nd_, ns))
+    if drift:
+        raise GenerationMismatchError(
+            "incoming generation's avals drifted%s — the bound "
+            "executables would retrace: %s" % (where,
+                                               "; ".join(drift[:8])))
+
+
 # ------------------------------------------------------------ export/load
 def export_for_serving(directory, family, config, model,
-                       executables=None):
+                       executables=None, generation=None):
     """Write a serving checkpoint: the model's params (hierarchical
     `_collect_params_with_prefix` names — prefix-independent, so the
     server rebuilds under any name scope) plus the family/config stanza.
     ``executables`` ({name: blob}) rides along as the checkpoint's AOT
     ``executables`` section so replicas skip XLA compilation on load.
-    """
+
+    Every export is a GENERATION: the checkpoint meta carries a
+    monotonically increasing generation number (default: previous
+    max + 1; an explicit ``generation`` must advance it) and the
+    directory's generation pointer is atomically re-published to it.
+    Older generations stay retained on disk, so a rollout coordinator
+    can roll a fleet back without a re-export."""
     if family not in SERVING_FAMILIES:
         raise ValueError("unknown serving family %r (registered: %s)"
                          % (family, sorted(SERVING_FAMILIES)))
     params = {k: v.data() for k, v
               in model._collect_params_with_prefix().items()}
-    mgr = CheckpointManager(directory, keep=None, async_save=False,
-                            prefix="serve")
-    mgr.save(0, params, extra={"serving": {"family": family,
-                                           "config": dict(config)}},
+    mgr = _serve_mgr(directory)
+    gens = generation_steps(directory)
+    if generation is None:
+        generation = max(gens, default=-1) + 1
+    else:
+        generation = int(generation)
+        if gens and generation <= max(gens):
+            raise ValueError(
+                "generation numbers are monotonic: %d is not newer than "
+                "the retained max %d" % (generation, max(gens)))
+    step = mgr.latest_step()
+    step = 0 if step is None else step + 1
+    mgr.save(step, params, extra={"serving": {"family": family,
+                                              "config": dict(config)},
+                                  "generation": generation},
              executables=executables)
+    publish_generation(directory, generation, step)
     return directory
 
 
@@ -187,17 +328,43 @@ def attach_executables(directory, blobs):
     mgr = CheckpointManager(directory, keep=2, async_save=False,
                             prefix="serve")
     step, params, _trainer, meta = mgr.restore()
-    extra = {"serving": meta["serving"]} if "serving" in meta else None
-    mgr.save(int(step) + 1, params, extra=extra, executables=blobs)
+    extra = {"serving": meta["serving"]} if "serving" in meta else {}
+    if meta.get("generation") is not None:
+        # same weights, same generation, warmer checkpoint: the
+        # re-publish keeps the generation number and re-points the
+        # pointer at the new step
+        extra["generation"] = int(meta["generation"])
+    mgr.save(int(step) + 1, params, extra=extra or None,
+             executables=blobs)
+    ptr = read_generation(directory)
+    if ptr is not None and meta.get("generation") is not None \
+            and int(ptr.get("generation", -1)) == int(meta["generation"]):
+        publish_generation(directory, meta["generation"], int(step) + 1)
     return directory
 
 
-def load_served_model(directory, quantize=None):
-    """Restore the newest serving checkpoint in `directory` and build
-    its family. ``quantize=None`` follows MXTPU_SERVE_INT8."""
-    mgr = CheckpointManager(directory, keep=None, async_save=False,
-                            prefix="serve")
-    _step, params, _trainer, meta = mgr.restore()
+def load_served_model(directory, quantize=None, generation=None):
+    """Restore a serving checkpoint in `directory` and build its
+    family. ``quantize=None`` follows MXTPU_SERVE_INT8. By default the
+    directory's generation pointer picks the checkpoint (newest step
+    when no pointer was ever published); an explicit ``generation``
+    loads that retained generation. The built model carries its
+    generation number (``served.generation``)."""
+    mgr = _serve_mgr(directory)
+    step = None
+    if generation is not None:
+        gens = generation_steps(directory)
+        if int(generation) not in gens:
+            raise FileNotFoundError(
+                "generation %d is not retained under %r (have: %s)"
+                % (int(generation), directory, sorted(gens)))
+        step = gens[int(generation)]
+    else:
+        ptr = read_generation(directory)
+        if ptr is not None:
+            gens = generation_steps(directory)
+            step = gens.get(int(ptr.get("generation", -1)))
+    _step, params, _trainer, meta = mgr.restore(step)
     info = meta.get("serving")
     if not isinstance(info, dict) or "family" not in info:
         raise ValueError("checkpoint under %r has no serving stanza — "
@@ -216,8 +383,9 @@ def load_served_model(directory, quantize=None):
         quantize = int8_serving_enabled()
     served = builder(dict(info.get("config") or {}), params,
                      bool(quantize))
+    served.generation = int(meta.get("generation") or 0)
     try:
-        blobs = mgr.load_executables()
+        blobs = mgr.load_executables(_step)
     except Exception as e:  # noqa: BLE001 — an unreadable executables
         # section degrades to compile-on-demand, never blocks serving
         log.warning("serving: cannot read executables section under %r "
@@ -242,6 +410,31 @@ def _set_params(model, params):
                       % ", ".join(missing[:8]))
     for name, p in targets.items():
         p.set_data(nd.array(params[name]))
+
+
+def _gluon_swapper(model, program_dicts, after=None):
+    """Build a ``params_swapper`` for a gluon-backed family: validate
+    the incoming avals against the live params (all-or-nothing — any
+    drift raises before a single weight moves), copy the new weights
+    into the model (the eager path), then rewrite every built
+    BlockProgram's ``param_vals`` list in place — the programs pass
+    their params at call time, so the bound executables are reused
+    verbatim. `after` runs post-swap for family-private derived state
+    (e.g. the lstm int8 head re-quantize)."""
+    def swap(params):
+        targets = model._collect_params_with_prefix()
+        check_generation_avals(
+            {n: p.data() for n, p in targets.items()}, params)
+        for name, p in targets.items():
+            p.set_data(nd.array(params[name]))
+        _pnames, pvals = _aot._block_param_state(model)
+        for progs in program_dicts:
+            for key, prog in progs.items():
+                if prog is not None:
+                    prog.param_vals[:] = pvals
+        if after is not None:
+            after()
+    return swap
 
 
 # ------------------------------------------------------- builtin families
@@ -336,7 +529,8 @@ def _build_bert_encoder(config, params, quantize):
     return ServedModel("bert_encoder", config, encode_fn=encode,
                        quantized=False, program_factory=program_for,
                        program_binder=bind, programs=programs,
-                       warmup_signatures=[("token_ids",)])
+                       warmup_signatures=[("token_ids",)],
+                       params_swapper=_gluon_swapper(model, [programs]))
 
 
 @serving_family("lstm_lm")
@@ -439,9 +633,23 @@ def _build_lstm_lm(config, params, quantize):
             cache.data[name][:] = st.asnumpy().transpose(1, 0, 2)
         return out
 
+    def _requantize_head():
+        # the int8 head is derived state quantized FROM the decoder
+        # weights — a weight swap must re-quantize it or the vocab
+        # projection would keep serving the old generation
+        nonlocal int8_head
+        if int8_head is not None:
+            w = model.decoder.weight.data().asnumpy()
+            b = (model.decoder.bias.data().asnumpy()
+                 if model.decoder.bias is not None else None)
+            int8_head = Int8Dense(w, b)
+
     return ServedModel("lstm_lm", config, step_fn=step,
                        make_cache=make_cache, pad_token=0,
                        quantized=bool(quantize),
                        decode_program_factory=decode_program_for,
                        program_binder=bind,
-                       decode_programs=decode_programs)
+                       decode_programs=decode_programs,
+                       params_swapper=_gluon_swapper(
+                           model, [decode_programs],
+                           after=_requantize_head))

@@ -46,8 +46,11 @@ _req_ids = itertools.count(1)
 
 
 class ShedError(RuntimeError):
-    """Request was shed (deadline unmeetable or queue overloaded), not
-    served. `stage` says where: queue | join | overload | decode."""
+    """Request was shed (deadline unmeetable, queue overloaded, or the
+    model is draining for a deploy), not served. `stage` says where:
+    queue | join | overload | decode | draining. The "draining" stage
+    is RETRIABLE — the model re-admits seconds later (or another
+    replica serves); ServingClient retries it transparently."""
 
     def __init__(self, stage, detail=""):
         super().__init__("shed at %s%s" % (stage, ": " + detail
@@ -203,6 +206,8 @@ class ContinuousBatcher:
         self._pending = 0
         self._ewma = {}                 # bucket -> smoothed service secs
         self._stopping = False
+        self._draining = False
+        self._in_flight = False         # a forward is running right now
         self._batches = 0
         self._thread = threading.Thread(
             target=self._run, name="serve-batch-%s" % name, daemon=True)
@@ -232,6 +237,10 @@ class ContinuousBatcher:
         with self._cond:
             if self._stopping:
                 req.fail(RuntimeError("batcher %r is stopped" % self.name))
+                return req
+            if self._draining:
+                self._shed(req, "draining",
+                           "model is draining for a weight swap; retry")
                 return req
             if self._pending >= self._depth:
                 self._shed(req, "overload",
@@ -266,6 +275,49 @@ class ContinuousBatcher:
                         RuntimeError("batcher %r stopped" % self.name))
             self._pending = 0
 
+    # ------------------------------------------------------ drain/re-admit
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout=30.0):
+        """Fence admission for a live weight swap: new submits shed with
+        the RETRIABLE "draining" stage, already-queued requests are
+        served out, and the call blocks until nothing is queued and no
+        forward is in flight — a swap must never land mid-batch. Past
+        `timeout` seconds the still-queued requests are shed (draining,
+        so clients retry them) and only the in-flight forward is waited
+        for (one more `timeout` window). Returns True when quiesced;
+        False means a forward is STILL running — do not swap."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()     # worker skips the join window
+            while self._pending or self._in_flight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(min(left, 0.05))
+            if self._pending:
+                for q in self._queues.values():
+                    while q:
+                        self._shed(q.popleft(), "draining",
+                                   "not served before the drain "
+                                   "deadline; retry")
+                self._pending = 0
+            while self._in_flight:
+                left = deadline + float(timeout) - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def admit(self):
+        """Re-open admission after a drain()."""
+        with self._cond:
+            self._draining = False
+            self._cond.notify_all()
+
     def reset_service_estimates(self):
         """Forget EWMA service times. Early samples carry XLA compile
         seconds; callers that warm the compile cache first (bench, warm
@@ -278,6 +330,7 @@ class ContinuousBatcher:
             return {
                 "pending": self._pending,
                 "batches": self._batches,
+                "draining": self._draining,
                 "per_bucket": {b: len(q) for b, q in self._queues.items()
                                if q},
                 "service_ewma_s": dict(self._ewma),
@@ -338,12 +391,14 @@ class ContinuousBatcher:
                 bucket = self._pick_bucket_locked()
                 if bucket is None:      # raced with another drain
                     continue
-                if self._max_wait > 0:
+                if self._max_wait > 0 and not self._draining:
                     # join window: give late arrivals a bounded chance to
                     # coalesce, anchored to the oldest queued arrival so
-                    # the window never restarts as new requests land
+                    # the window never restarts as new requests land.
+                    # A drain skips it — nothing new is admitted, so
+                    # waiting only stretches the deploy outage.
                     until = self._queues[bucket][0].arrival + self._max_wait
-                    while (not self._stopping
+                    while (not self._stopping and not self._draining
                            and self._rows_queued_locked(bucket)
                            < self._max_batch
                            and time.monotonic() < until):
@@ -355,8 +410,16 @@ class ContinuousBatcher:
                         continue
                     bucket = refreshed
                 taken, rows = self._take_locked(bucket)
+                if taken:
+                    self._in_flight = True
+                    self._cond.notify_all()
             if taken:
-                self._serve_batch(bucket, taken, rows)
+                try:
+                    self._serve_batch(bucket, taken, rows)
+                finally:
+                    with self._cond:
+                        self._in_flight = False
+                        self._cond.notify_all()
 
     def _serve_batch(self, bucket, taken, rows):
         now = time.monotonic()

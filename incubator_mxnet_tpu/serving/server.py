@@ -45,11 +45,18 @@ __all__ = ["ModelServer"]
 class _Tenant:
     """One loaded model: its ServedModel + running scheduler(s)."""
 
-    def __init__(self, name, served, batcher, decode_loop):
+    def __init__(self, name, served, batcher, decode_loop,
+                 directory=None):
         self.name = name
         self.served = served
         self.batcher = batcher
         self.decode_loop = decode_loop
+        self.directory = directory      # deploy source for serve.deploy
+
+    @property
+    def draining(self):
+        return any(s is not None and s.draining
+                   for s in (self.batcher, self.decode_loop))
 
     def stop(self):
         if self.batcher is not None:
@@ -62,6 +69,10 @@ class ModelServer:
     def __init__(self, host="127.0.0.1", port=0, telemetry=True):
         if telemetry:
             _met.enable()
+            # compile accounting is part of the serving product surface:
+            # the deploy drill asserts a weight swap costs ZERO compiles
+            # by reading mxtpu_jit_compiles_total over serve.metrics
+            _cat.install_jax_compile_hook()
         self._models = {}
         self._lock = threading.Lock()
         self._timeout = float(os.environ.get("MXTPU_SERVE_TIMEOUT", "60"))
@@ -75,6 +86,7 @@ class ModelServer:
         if _dbz.start_from_env(role="serving") is not None:
             _dbz.set_status("serve_addr", "%s:%s" % self.addr)
             _dbz.set_status("models", lambda: sorted(self._models))
+            _dbz.set_status("generations", self.generations)
             _dbz.set_status("compile_cache", _ccstore.statusz_entry)
         return self
 
@@ -90,14 +102,18 @@ class ModelServer:
     # -------------------------------------------------------------- models
     def load(self, name, directory=None, served=None, quantize=None,
              max_batch=None, max_wait_ms=None, buckets=None, slots=None,
-             cache_len=None):
+             cache_len=None, generation=None):
         """Load a model under `name` from a serving checkpoint directory
         (or an already-built ServedModel) and start its schedulers.
-        Unnamed knobs fall back to the MXTPU_SERVE_* env defaults."""
+        Unnamed knobs fall back to the MXTPU_SERVE_* env defaults.
+        `generation` pins a retained generation instead of the
+        directory's GENERATION.json pointer (rollout drills start a
+        fleet on a known-old generation this way)."""
         if (directory is None) == (served is None):
             raise ValueError("pass exactly one of directory/served")
         if served is None:
-            served = load_served_model(directory, quantize=quantize)
+            served = load_served_model(directory, quantize=quantize,
+                                       generation=generation)
         elif not isinstance(served, ServedModel):
             raise TypeError("served must be a loader.ServedModel")
         batcher = decode_loop = None
@@ -118,13 +134,15 @@ class ModelServer:
                 prefill_fn=getattr(served, "prefill_fn", None),
                 prefill_chunk=getattr(served, "prefill_chunk",
                                       None)).start()
-        tenant = _Tenant(name, served, batcher, decode_loop)
+        tenant = _Tenant(name, served, batcher, decode_loop,
+                         directory=directory)
         with self._lock:
             if name in self._models:
                 tenant.stop()
                 raise ValueError("model %r is already loaded" % name)
             self._models[name] = tenant
             _cat.serving_models.set(len(self._models))
+        _cat.serving_generation.set(int(served.generation), model=name)
         return self
 
     def unload(self, name):
@@ -154,6 +172,100 @@ class ModelServer:
                            % (name, sorted(self._models)))
         return t
 
+    # ----------------------------------------------------- live deploys
+    @staticmethod
+    def _drain_timeout():
+        return float(os.environ.get("MXTPU_DEPLOY_DRAIN_TIMEOUT_S",
+                                    "30"))
+
+    def drain(self, name, timeout=None):
+        """Fence `name` for a swap: new requests shed retriable
+        DRAINING, in-flight work finishes (bounded). True = quiesced."""
+        t = self._tenant(name)
+        timeout = self._drain_timeout() if timeout is None \
+            else float(timeout)
+        _fl.record("deploy.drain", model=name,
+                   generation=t.served.generation)
+        ok = True
+        if t.batcher is not None:
+            ok = t.batcher.drain(timeout) and ok
+        if t.decode_loop is not None:
+            ok = t.decode_loop.drain(timeout) and ok
+        return ok
+
+    def admit(self, name):
+        """Re-open admission on `name` after a drain."""
+        t = self._tenant(name)
+        if t.batcher is not None:
+            t.batcher.admit()
+        if t.decode_loop is not None:
+            t.decode_loop.admit()
+        _fl.record("deploy.admit", model=name,
+                   generation=t.served.generation)
+
+    def generations(self):
+        """{model: {"generation", "draining"}} — what serve.generation
+        returns and the rollout coordinator reads."""
+        with self._lock:
+            tenants = list(self._models.items())
+        return {name: {"generation": int(t.served.generation),
+                       "draining": t.draining}
+                for name, t in tenants}
+
+    def deploy(self, name, generation=None, directory=None):
+        """Live weight push: load the target generation's params, drain
+        the model (never swap mid-batch), swap in place against the
+        bound executables, re-admit. ``generation=None`` follows the
+        directory's generation pointer; ``directory=None`` uses the
+        directory the model was loaded from. Deploying the generation
+        already live is a no-op. Any failure re-admits the OLD weights
+        — a broken deploy degrades to 'nothing happened'."""
+        from .loader import load_generation_params, read_generation
+        t = self._tenant(name)
+        directory = directory or t.directory
+        if directory is None:
+            raise ValueError("model %r was not loaded from a directory; "
+                             "pass an explicit deploy directory" % name)
+        if generation is None:
+            ptr = read_generation(directory)
+            if not ptr:
+                raise ValueError("no generation pointer under %r"
+                                 % directory)
+            generation = ptr["generation"]
+        generation, prev = int(generation), int(t.served.generation)
+        if generation == prev:
+            return {"ok": True, "model": name, "generation": generation,
+                    "previous": prev, "noop": True}
+        # the params land on host BEFORE the drain so the admission
+        # outage is just quiesce + one in-place device copy
+        params, _meta = load_generation_params(directory, generation)
+        t0 = time.perf_counter()
+        _cat.deploy_inflight.set(1)
+        _fl.record("deploy.start", model=name, generation=generation,
+                   previous=prev)
+        try:
+            if not self.drain(name):
+                raise RuntimeError(
+                    "model %r did not quiesce within the drain deadline; "
+                    "swap aborted" % name)
+            t.served.swap_params(params, generation)
+            _fl.record("deploy.swap", model=name, generation=generation,
+                       previous=prev)
+            _cat.serving_generation.set(generation, model=name)
+            _cat.deploy_swaps.inc(model=name, outcome="ok")
+        except BaseException:
+            _cat.deploy_swaps.inc(model=name, outcome="error")
+            _fl.record("deploy.abort", model=name, generation=generation,
+                       previous=prev)
+            raise
+        finally:
+            self.admit(name)
+            _cat.deploy_inflight.set(0)
+            _cat.deploy_seconds.observe(time.perf_counter() - t0,
+                                        model=name)
+        return {"ok": True, "model": name, "generation": generation,
+                "previous": prev}
+
     # ------------------------------------------------------------- handler
     def _handle(self, meta, payload):
         op = meta.get("op", "")
@@ -178,6 +290,20 @@ class ModelServer:
             return self._decode(meta, payload)
         if op == "serve.stats":
             return {"stats": self._stats()}, b""
+        if op == "serve.generation":
+            return {"generations": self.generations()}, b""
+        if op == "serve.drain":
+            drained = self.drain(meta.get("model", ""),
+                                 timeout=meta.get("timeout"))
+            return {"ok": True, "model": meta.get("model", ""),
+                    "drained": drained}, b""
+        if op == "serve.admit":
+            self.admit(meta.get("model", ""))
+            return {"ok": True, "model": meta.get("model", "")}, b""
+        if op == "serve.deploy":
+            return self.deploy(meta.get("model", ""),
+                               generation=meta.get("generation"),
+                               directory=meta.get("directory")), b""
         if op == "serve.metrics":
             if meta.get("format") == "json":
                 return {"format": "json"}, \
@@ -211,8 +337,7 @@ class ModelServer:
             result = req.wait(timeout)
         except ShedError as e:
             _fl.record("serving.shed", model=name, stage=e.stage)
-            return {"error": str(e), "shed": e.stage,
-                    "deadline_exceeded": e.stage != "overload"}, b""
+            return self._shed_reply(e), b""
         except TimeoutError as e:
             # Nobody will read a late reply: cancel so the schedulers
             # drop the request instead of holding its queue entry or
@@ -225,10 +350,19 @@ class ModelServer:
                 result = req.wait(0)
             except ShedError as e2:
                 _fl.record("serving.shed", model=name, stage=e2.stage)
-                return {"error": str(e2), "shed": e2.stage,
-                        "deadline_exceeded": e2.stage != "overload"}, b""
+                return self._shed_reply(e2), b""
         manifest, out_payload = pack_arrays(result)
         return {"ok": True, "arrays": manifest}, out_payload
+
+    @staticmethod
+    def _shed_reply(e):
+        """Wire shape of a shed: "draining" is a RETRIABLE status (the
+        client retries another replica / after backoff), overload is
+        load-shedding, everything else is a deadline story."""
+        return {"error": str(e), "shed": e.stage,
+                "draining": e.stage == "draining",
+                "deadline_exceeded": e.stage not in ("overload",
+                                                     "draining")}
 
     def _infer(self, meta, payload):
         name = meta.get("model", "")
@@ -263,7 +397,8 @@ class ModelServer:
             tenants = list(self._models.items())
         out = {}
         for name, t in tenants:
-            ent = {"family": t.served.family}
+            ent = {"family": t.served.family,
+                   "generation": int(t.served.generation)}
             if t.batcher is not None:
                 ent["batch"] = t.batcher.stats()
             if t.decode_loop is not None:

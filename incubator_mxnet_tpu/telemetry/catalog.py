@@ -239,6 +239,21 @@ serving_decode_slots = _m.gauge(
     "KV-cache slots currently held by live decode sequences, by model")
 serving_models = _m.gauge(
     "mxtpu_serving_models_loaded", "Models currently loaded in the server")
+serving_generation = _m.gauge(
+    "mxtpu_serving_generation",
+    "Checkpoint generation currently live in this server, by model — "
+    "the rollout coordinator and the deploy_generation_skew rule read "
+    "this to see replicas agree after a rolling weight push")
+deploy_inflight = _m.gauge(
+    "mxtpu_deploy_inflight",
+    "1 while a drain->swap->re-admit deploy is running on this server")
+deploy_swaps = _m.counter(
+    "mxtpu_deploy_swaps_total",
+    "Live weight swaps attempted, by model and outcome (ok|error)")
+deploy_seconds = _m.histogram(
+    "mxtpu_deploy_seconds",
+    "Wall time of one live deploy (drain through re-admit), by model — "
+    "the admission outage a rolling weight push costs per replica")
 
 
 # -- generative engine (generate/) -----------------------------------
@@ -417,6 +432,14 @@ def default_health_rules():
         {"type": "threshold", "name": "membership_epoch_stale",
          "metric": "mxtpu_membership_epoch", "source": "latest",
          "agg": "spread", "warn": 1.0, "fire_for": 3},
+        # Replicas disagreeing on the served generation for longer than
+        # the bake window: a rollout stalled mid-walk or half rolled
+        # back. Transient spread during a healthy walk is expected —
+        # fire_for rides it out.
+        {"type": "threshold", "name": "deploy_generation_skew",
+         "metric": "mxtpu_serving_generation", "source": "latest",
+         "agg": "spread", "warn": 1.0,
+         "fire_for": int(_f("MXTPU_HEALTH_GENERATION_SKEW_FOR", 3))},
         # Liveness + stragglers.
         {"type": "absence", "name": "member_absent",
          "for_seconds": _f("MXTPU_HEALTH_ABSENCE_SECONDS", 15.0)},

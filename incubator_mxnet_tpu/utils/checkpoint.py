@@ -251,6 +251,32 @@ class CheckpointManager:
         for s in self.steps()[:-self._keep]:
             shutil.rmtree(self._path(s), ignore_errors=True)
 
+    # ----------------------------------------------------------- pointers
+    def publish_pointer(self, name, value):
+        """Atomically publish a small JSON document ``name`` in the
+        checkpoint directory — write-to-temp, fsync, rename, the same
+        discipline as the checkpoint dirs themselves, so a reader sees
+        either the old document or the new one, never a torn write.
+        The serving plane uses this for its generation pointer."""
+        final = os.path.join(self._dir, name)
+        tmp = "%s%s.%d" % (final, _TMP_SUFFIX, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        return final
+
+    def read_pointer(self, name):
+        """Read a document published by ``publish_pointer``; None when
+        absent or unreadable (a foreign/garbage file must not crash the
+        loader — callers fall back to directory-scan defaults)."""
+        try:
+            with open(os.path.join(self._dir, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def wait(self):
         """Join any in-flight async write; re-raise its error."""
         t = self._thread

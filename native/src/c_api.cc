@@ -94,7 +94,7 @@ def nd_save(fname, handles, keys):
 def nd_load(fname):
     data = _nd.load(fname)
     if isinstance(data, dict):
-        keys = sorted(data.keys())
+        keys = list(data.keys())  # save-file insertion order, not sorted
         return [_put(data[k]) for k in keys], keys
     return [_put(a) for a in data], ["" for _ in data]
 
@@ -429,6 +429,7 @@ int MXTPUNDArrayCreateFromData(const int *shape, int ndim, int dtype,
 }
 
 int MXTPUNDArraySyncCopyToCPU(void *h, void *data, size_t nbytes) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(l)", handle_id(h));
   PyObject *res = helper_call("nd_to_bytes", args);
@@ -448,6 +449,7 @@ int MXTPUNDArraySyncCopyToCPU(void *h, void *data, size_t nbytes) {
 }
 
 int MXTPUNDArrayGetShape(void *h, int *out_ndim, int *shape_out) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(l)", handle_id(h));
   PyObject *res = helper_call("nd_shape", args);
@@ -467,6 +469,7 @@ int MXTPUNDArrayGetShape(void *h, int *out_ndim, int *shape_out) {
 }
 
 int MXTPUNDArrayGetDType(void *h, int *out_dtype) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(l)", handle_id(h));
   PyObject *res = helper_call("nd_dtype", args);
@@ -481,6 +484,7 @@ int MXTPUNDArrayFree(void *h) { return free_handle(h); }
 
 int MXTPUNDArraySave(const char *fname, int num, void **handles,
                      const char **keys) {
+  ensure_python();
   GIL gil;
   PyObject *ids = id_list(handles, num);
   PyObject *pykeys = keys ? str_list(keys, num) : PyList_New(0);
@@ -552,6 +556,7 @@ int MXTPUSymbolCreateFromFile(const char *path, void **out) {
 }
 
 int MXTPUSymbolSaveToJSON(void *h, const char **out_json) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(l)", handle_id(h));
   PyObject *res = helper_call("symbol_to_json", args);
@@ -565,6 +570,7 @@ int MXTPUSymbolSaveToJSON(void *h, const char **out_json) {
 
 static int symbol_list(void *h, const char *which, int *out_size,
                        const char ***out_names) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(ls)", handle_id(h), which);
   PyObject *res = helper_call("symbol_list", args);
@@ -594,6 +600,7 @@ int MXTPUExecutorBindEX(void *sym, int num_args, const char **arg_names,
                         void **arg_handles, int num_aux,
                         const char **aux_names, void **aux_handles,
                         const char *grad_req, void **out) {
+  ensure_python();
   GIL gil;
   PyObject *names = str_list(arg_names, num_args);
   PyObject *ids = id_list(arg_handles, num_args);
@@ -623,6 +630,7 @@ int MXTPUExecutorBind(void *sym, int num_args, const char **arg_names,
 }
 
 int MXTPUExecutorForward(void *h, int is_train) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(li)", handle_id(h), is_train);
   PyObject *res = helper_call("executor_forward", args);
@@ -633,6 +641,7 @@ int MXTPUExecutorForward(void *h, int is_train) {
 }
 
 int MXTPUExecutorOutputs(void *h, int *out_size, void ***out_handles) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(l)", handle_id(h));
   PyObject *res = helper_call("executor_outputs", args);
@@ -644,6 +653,7 @@ int MXTPUExecutorOutputs(void *h, int *out_size, void ***out_handles) {
 }
 
 int MXTPUExecutorBackward(void *h, void **head_grads, int num_grads) {
+  ensure_python();
   GIL gil;
   PyObject *ids = head_grads ? id_list(head_grads, num_grads)
                              : PyList_New(0);
@@ -657,6 +667,7 @@ int MXTPUExecutorBackward(void *h, void **head_grads, int num_grads) {
 }
 
 int MXTPUExecutorArgGrad(void *h, const char *arg_name, void **out) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(ls)", handle_id(h), arg_name);
   PyObject *res = helper_call("executor_arg_grad", args);
@@ -675,6 +686,7 @@ int MXTPUKVStoreCreate(const char *type, void **out) {
 
 static int kv_call3(const char *fn, void *h, int num, const char **keys,
                     void **handles, int priority, bool with_priority) {
+  ensure_python();
   GIL gil;
   PyObject *pykeys = str_list(keys, num);
   PyObject *ids = id_list(handles, num);
@@ -715,6 +727,7 @@ static int kv_attr(void *h, const char *which, PyObject **out) {
 }
 
 int MXTPUKVStoreGetType(void *h, const char **out_type) {
+  ensure_python();
   GIL gil;
   PyObject *res = nullptr;
   if (kv_attr(h, "type", &res) != 0) return -1;
@@ -725,6 +738,7 @@ int MXTPUKVStoreGetType(void *h, const char **out_type) {
 }
 
 int MXTPUKVStoreGetRank(void *h, int *out_rank) {
+  ensure_python();
   GIL gil;
   PyObject *res = nullptr;
   if (kv_attr(h, "rank", &res) != 0) return -1;
@@ -734,6 +748,7 @@ int MXTPUKVStoreGetRank(void *h, int *out_rank) {
 }
 
 int MXTPUKVStoreGetGroupSize(void *h, int *out_size) {
+  ensure_python();
   GIL gil;
   PyObject *res = nullptr;
   if (kv_attr(h, "num_workers", &res) != 0) return -1;
@@ -778,6 +793,7 @@ int MXTPUDataIterCreate(const char *name, int num_params, const char **keys,
 }
 
 static int io_simple(const char *fn, void *h, int *out_int) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(l)", handle_id(h));
   PyObject *res = helper_call(fn, args);
@@ -797,6 +813,7 @@ int MXTPUDataIterNext(void *h, int *out_has_next) {
 }
 
 static int io_array(const char *fn, void *h, void **out) {
+  ensure_python();
   GIL gil;
   PyObject *args = Py_BuildValue("(l)", handle_id(h));
   PyObject *res = helper_call(fn, args);

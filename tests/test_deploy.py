@@ -1,0 +1,670 @@
+"""Round-16 acceptance: zero-downtime live weight push.
+
+Unit tier — generation-addressed checkpoints (monotonic pointer,
+rollback retention), in-place `swap_params` that reuses the bound
+executables (zero recompiles; aval drift raises instead of silently
+retracing), the drain/re-admit state machine on both schedulers
+(DRAINING sheds are RETRIABLE), and the rollout coordinator's
+promote/rollback decision table against fake replicas.
+
+Acceptance drill — two real server processes, a concurrent client
+storm, three canary-gated generation swaps plus one injected-bad
+generation whose gate PAGEs (the ``rollout.gate.page`` failpoint):
+every request settles, the bad generation rolls back fleet-wide, the
+final fleet serves the newest good generation, and no swap costs a
+single XLA compile (asserted over serve.metrics against a post-warmup
+baseline). The deploy transitions are visible in the flight-recorder
+JSONL each replica dumps on exit.
+"""
+
+import json
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, serving, telemetry
+from incubator_mxnet_tpu.models.bert import BERTModel
+from incubator_mxnet_tpu.serving import kv_cache, scheduler
+from incubator_mxnet_tpu.serving.decode import DecodeLoop, DecodeRequest
+from incubator_mxnet_tpu.telemetry import catalog as cat
+from incubator_mxnet_tpu.utils import failpoints
+from tools import rollout
+
+BERT_CFG = dict(vocab_size=40, units=8, hidden_size=16, num_layers=1,
+                num_heads=2, max_length=32)
+
+
+def _bert(prefix="dp_"):
+    m = BERTModel(prefix=prefix, dropout=0.0, **BERT_CFG)
+    m.initialize(mx.init.Normal(0.02))
+    m(nd.array(np.zeros((1, 4), np.int32)))
+    return m
+
+
+def _scale_params(model, factor):
+    for _n, p in model._collect_params_with_prefix().items():
+        p.set_data(nd.array(np.asarray(p.data()._data) * factor))
+
+
+def _export_generations(directory, model, n):
+    """Export generations 0..n-1, each with distinct weights."""
+    for g in range(n):
+        if g:
+            _scale_params(model, 1.1)
+        serving.export_for_serving(directory, "bert_encoder", BERT_CFG,
+                                   model)
+
+
+def _ids(rows=1, length=6, seed=0):
+    return np.random.RandomState(seed).randint(
+        1, BERT_CFG["vocab_size"], (rows, length)).astype(np.int32)
+
+
+# ===================================================== generation pointer
+def test_generation_pointer_publish_and_monotonic(tmp_path):
+    d = str(tmp_path)
+    m = _bert()
+    assert serving.read_generation(d) is None
+    serving.export_for_serving(d, "bert_encoder", BERT_CFG, m)
+    ptr = serving.read_generation(d)
+    assert ptr["generation"] == 0 and ptr["step"] == 0
+    serving.export_for_serving(d, "bert_encoder", BERT_CFG, m)
+    assert serving.read_generation(d)["generation"] == 1
+    assert serving.generation_steps(d) == {0: 0, 1: 1}
+    # generation numbers only move forward
+    with pytest.raises(ValueError, match="monotonic"):
+        serving.export_for_serving(d, "bert_encoder", BERT_CFG, m,
+                                   generation=1)
+    serving.export_for_serving(d, "bert_encoder", BERT_CFG, m,
+                               generation=5)
+    assert serving.read_generation(d)["generation"] == 5
+    assert serving.generation_steps(d)[5] == 2
+
+
+def test_rollback_retention_and_pointer_repoint(tmp_path):
+    d = str(tmp_path)
+    m = _bert()
+    _export_generations(d, m, 3)
+    # every generation stays on disk — rollback material
+    params0, meta0 = serving.load_generation_params(d, 0)
+    assert meta0.get("generation") == 0 and params0
+    with pytest.raises(FileNotFoundError, match="not retained"):
+        serving.load_generation_params(d, 99)
+    # a rollback is just re-pointing the pointer at a retained gen
+    serving.publish_generation(d, 1, serving.generation_steps(d)[1])
+    served = serving.load_served_model(d)
+    assert served.generation == 1
+    # pointer default == explicit generation
+    p_ptr, _ = serving.load_generation_params(d)
+    p_exp, _ = serving.load_generation_params(d, 1)
+    for k in p_exp:
+        np.testing.assert_array_equal(np.asarray(p_ptr[k]),
+                                      np.asarray(p_exp[k]))
+
+
+# ======================================================== in-place swaps
+def test_swap_params_reuses_bound_executables_zero_compiles(tmp_path):
+    telemetry.enable()
+    cat.install_jax_compile_hook()
+    d = str(tmp_path)
+    m = _bert()
+    _export_generations(d, m, 2)
+    served = serving.load_served_model(d)
+    assert served.generation == 1
+    ids = _ids()
+    out1 = np.asarray(served.encode_fn({"token_ids": ids}, 8)["pooled"])
+    base = cat.compile_events()
+    params0, _ = serving.load_generation_params(d, 0)
+    served.swap_params(params0, 0)
+    assert served.generation == 0
+    out0 = np.asarray(served.encode_fn({"token_ids": ids}, 8)["pooled"])
+    assert not np.allclose(out0, out1)      # the weights really moved
+    params1, _ = serving.load_generation_params(d, 1)
+    served.swap_params(params1, 1)
+    out1b = np.asarray(served.encode_fn({"token_ids": ids}, 8)["pooled"])
+    np.testing.assert_allclose(out1b, out1, rtol=1e-4, atol=1e-5)
+    # two round-trip swaps, ZERO backend_compile events
+    assert cat.compile_events() == base
+
+
+def test_swap_params_aval_drift_raises_and_keeps_weights(tmp_path):
+    d = str(tmp_path)
+    m = _bert()
+    _export_generations(d, m, 2)
+    served = serving.load_served_model(d)
+    ids = _ids()
+    out1 = np.asarray(served.encode_fn({"token_ids": ids}, 8)["pooled"])
+    params0, _ = serving.load_generation_params(d, 0)
+    bad_shape = dict(params0)
+    k = sorted(bad_shape)[0]
+    bad_shape[k] = np.zeros((3, 3), np.float32)
+    with pytest.raises(serving.GenerationMismatchError, match="drifted"):
+        served.swap_params(bad_shape, 2)
+    missing = dict(params0)
+    missing.pop(k)
+    with pytest.raises(serving.GenerationMismatchError, match="missing"):
+        served.swap_params(missing, 2)
+    # failed swaps are all-or-nothing: generation and weights untouched
+    assert served.generation == 1
+    out_check = np.asarray(served.encode_fn({"token_ids": ids},
+                                            8)["pooled"])
+    np.testing.assert_array_equal(out_check, out1)
+
+
+def test_gpt_swap_keeps_sessions_and_programs(tmp_path):
+    from incubator_mxnet_tpu.generate import export_gpt_for_serving
+    from incubator_mxnet_tpu.models.gpt import GPTDecoder
+    telemetry.enable()
+    cat.install_jax_compile_hook()
+    cfg = dict(vocab_size=37, units=16, num_layers=1, num_heads=2,
+               max_len=64)
+    m = GPTDecoder(prefix="dpg_", **cfg)
+    m.initialize(mx.init.Normal(0.05))
+    m(nd.array(np.zeros((1, 4), np.int32)))
+    d = str(tmp_path)
+    export_gpt_for_serving(d, cfg, m)                   # generation 0
+    _scale_params(m, 1.2)
+    export_gpt_for_serving(d, cfg, m)                   # generation 1
+    served = serving.load_served_model(d, quantize=False, generation=0)
+    assert served.generation == 0
+    cache = served.make_cache(2, 64)
+    slot = cache.alloc()
+    served.prefill_fn(slot, np.array([3, 5, 7, 2, 11], np.int32), cache)
+    toks = np.zeros(2, np.int32)
+    toks[slot] = 4
+    active = np.array([slot == i for i in range(2)])
+    served.step_fn(toks, cache, active)                 # warm decode
+    base = cat.compile_events()
+    params1, _ = serving.load_generation_params(d, 1)
+    served.swap_params(params1, 1)
+    assert served.generation == 1
+    # the in-flight paged-KV session SURVIVES a params-only swap: the
+    # same cache keeps stepping against the new weights, zero compiles
+    logits = np.asarray(served.step_fn(toks, cache, active))
+    assert logits.shape[0] == 2
+    assert cat.compile_events() == base
+    # aval drift is refused before anything moves
+    bad = {k: np.zeros((2, 2), np.float32) for k in params1}
+    with pytest.raises(serving.GenerationMismatchError):
+        served.swap_params(bad, 2)
+    assert served.generation == 1
+
+
+# ================================================== drain state machine
+def test_batcher_drain_serves_out_then_sheds_retriable():
+    release = threading.Event()
+
+    def slow(batch, bucket):
+        release.wait(10)
+        return {"y": batch["x"] * 2}
+
+    b = scheduler.ContinuousBatcher("m", slow, max_batch=2, buckets=(4,),
+                                    max_wait_ms=0)
+    b.start()
+    try:
+        inflight = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4), np.float32)}))
+        time.sleep(0.2)                 # worker is blocked in forward
+        queued = b.submit(scheduler.Request(
+            "m", {"x": np.ones((1, 4), np.float32)}))
+        done = {}
+        t = threading.Thread(
+            target=lambda: done.setdefault("ok", b.drain(timeout=10.0)))
+        t.start()
+        time.sleep(0.15)
+        assert b.draining
+        # new work sheds with the RETRIABLE draining stage...
+        shed = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4), np.float32)}))
+        with pytest.raises(serving.ShedError) as ei:
+            shed.wait(1.0)
+        assert ei.value.stage == "draining"
+        release.set()
+        t.join(10)
+        assert done["ok"] is True
+        # ...but in-flight AND already-queued work was served, not shed
+        np.testing.assert_array_equal(inflight.wait(5.0)["y"],
+                                      np.zeros((1, 4)))
+        np.testing.assert_array_equal(queued.wait(5.0)["y"],
+                                      np.full((1, 4), 2.0))
+        assert b.stats()["draining"] is True
+        b.admit()
+        assert b.draining is False
+        ok = b.submit(scheduler.Request(
+            "m", {"x": np.ones((1, 4), np.float32)}))
+        assert ok.wait(5.0)["y"].shape == (1, 4)
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_batcher_drain_deadline_sheds_leftover_queue():
+    release = threading.Event()
+
+    def slow(batch, bucket):
+        release.wait(10)
+        return {"y": batch["x"]}
+
+    b = scheduler.ContinuousBatcher("m", slow, max_batch=1, buckets=(4,),
+                                    max_wait_ms=0)
+    b.start()
+    try:
+        first = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4), np.float32)}))
+        time.sleep(0.2)
+        stuck = b.submit(scheduler.Request(
+            "m", {"x": np.zeros((1, 4), np.float32)}))
+        # forward never returns within the drain window: the queued
+        # request is shed RETRIABLE at the deadline, and drain reports
+        # the truth — a forward is still running, DO NOT swap
+        assert b.drain(timeout=0.3) is False
+        with pytest.raises(serving.ShedError) as ei:
+            stuck.wait(1.0)
+        assert ei.value.stage == "draining"
+        release.set()
+        first.wait(5.0)                 # the in-flight one still lands
+    finally:
+        release.set()
+        b.stop()
+
+
+def _counting_step(vocab=10, delay=0.0):
+    def step(tokens, cache, active):
+        if delay:
+            time.sleep(delay)
+        logits = np.zeros((tokens.shape[0], vocab), np.float32)
+        for slot in range(tokens.shape[0]):
+            if active[slot]:
+                cache.data["h"][slot] += 1
+                logits[slot, (int(tokens[slot]) + 1) % vocab] = 1.0
+        return logits
+    return step
+
+
+def _toy_cache(slots=2, max_len=64):
+    return kv_cache.KVCache(slots, {"h": ("state", (1,))},
+                            max_len=max_len)
+
+
+def test_decode_drain_fences_active_sessions_retriable():
+    cache = _toy_cache(slots=1)
+    loop = DecodeLoop("lm", _counting_step(delay=0.05), cache,
+                      pad_token=0)
+    loop.start()
+    try:
+        long = loop.submit(DecodeRequest("lm", [1], max_new_tokens=60))
+        time.sleep(0.3)                 # admitted, mid-generation
+        pend = loop.submit(DecodeRequest("lm", [2], max_new_tokens=2))
+        assert loop.drain(timeout=0.4) is True
+        # queued-but-unslotted: shed immediately (re-prefills on retry)
+        with pytest.raises(serving.ShedError) as e1:
+            pend.wait(1.0)
+        assert e1.value.stage == "draining"
+        # active straggler: fenced at the deadline, slot freed
+        with pytest.raises(serving.ShedError) as e2:
+            long.wait(5.0)
+        assert e2.value.stage == "draining"
+        deadline = time.monotonic() + 5.0
+        while cache.in_use and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert cache.in_use == 0
+        # draining refuses new admissions, re-admit restores service
+        shed = loop.submit(DecodeRequest("lm", [3], max_new_tokens=2))
+        with pytest.raises(serving.ShedError) as e3:
+            shed.wait(1.0)
+        assert e3.value.stage == "draining"
+        loop.admit()
+        ok = loop.submit(DecodeRequest("lm", [3], max_new_tokens=2))
+        np.testing.assert_array_equal(ok.wait(10.0)["tokens"], [4, 5])
+    finally:
+        loop.stop()
+
+
+def test_decode_drain_waits_for_natural_finish():
+    loop = DecodeLoop("lm", _counting_step(delay=0.01), _toy_cache(),
+                      pad_token=0)
+    loop.start()
+    try:
+        r = loop.submit(DecodeRequest("lm", [1], max_new_tokens=4))
+        time.sleep(0.05)
+        assert loop.drain(timeout=10.0) is True
+        # finished naturally inside the drain window — delivered intact
+        np.testing.assert_array_equal(r.wait(5.0)["tokens"],
+                                      [2, 3, 4, 5])
+    finally:
+        loop.stop()
+
+
+# ============================================== rollout decision table
+class _FakeReplicaState:
+    def __init__(self, generation=0, fail_deploy=False):
+        self.generation = generation
+        self.fail_deploy = fail_deploy
+        self.deploys = []
+
+
+class _FakeClient:
+    def __init__(self, state):
+        self._s = state
+        self.closed = False
+
+    def generation(self, model):
+        return {"generation": self._s.generation, "draining": False}
+
+    def deploy(self, model, generation=None, directory=None):
+        if self._s.fail_deploy:
+            raise RuntimeError("injected deploy failure")
+        prev = self._s.generation
+        if int(generation) == prev:     # mirrors the server's early noop
+            return {"ok": True, "model": model, "generation": prev,
+                    "previous": prev, "noop": True}
+        self._s.generation = int(generation)
+        self._s.deploys.append(int(generation))
+        return {"ok": True, "model": model, "generation": int(generation),
+                "previous": prev}
+
+    def close(self):
+        self.closed = True
+
+
+def _fleet(states):
+    addrs = ["10.0.0.%d:70" % i for i in range(1, len(states) + 1)]
+    by_addr = dict(zip(addrs, states))
+    return addrs, (lambda addr: _FakeClient(by_addr[addr]))
+
+
+def test_rollout_promotes_canary_first():
+    states = [_FakeReplicaState(0) for _ in range(3)]
+    addrs, factory = _fleet(states)
+    gates = []
+    summary = rollout.run_rollout(
+        addrs, "m", generation=2, bake_s=0,
+        gate=lambda r: gates.append(r) or 0, client_factory=factory)
+    assert summary["status"] == "promoted"
+    assert [s.generation for s in states] == [2, 2, 2]
+    assert gates == addrs               # every replica gated, canary first
+    assert [e["action"] for e in summary["walk"]] == ["deploy"] * 3
+    assert summary["walk"][0]["canary"] is True
+    assert all(not e.get("canary") for e in summary["walk"][1:])
+
+
+def test_rollout_gate_page_rolls_back_swapped_replicas_in_reverse():
+    # middle replica already at the target: a noop swap must NOT be
+    # "rolled back" to the target it already had before the rollout
+    states = [_FakeReplicaState(0), _FakeReplicaState(2),
+              _FakeReplicaState(0)]
+    addrs, factory = _fleet(states)
+    summary = rollout.run_rollout(
+        addrs, "m", generation=2, bake_s=0,
+        gate=lambda r: 2 if r == addrs[2] else 0, client_factory=factory)
+    assert summary["status"] == "rolled_back"
+    assert "gate exit 2" in summary["reason"]
+    # the fleet is back exactly where it started
+    assert [s.generation for s in states] == [0, 2, 0]
+    rollbacks = [e for e in summary["walk"] if e["action"] == "rollback"]
+    # reverse order: the paging replica unwinds first, the canary last
+    assert [e["replica"] for e in rollbacks] == [addrs[2], addrs[0]]
+    assert [e["generation"] for e in rollbacks] == [0, 0]
+    assert states[1].deploys == []      # noop replica untouched both ways
+
+
+def test_rollout_error_mid_walk_rolls_back_and_reports():
+    states = [_FakeReplicaState(0), _FakeReplicaState(0, fail_deploy=True)]
+    addrs, factory = _fleet(states)
+    summary = rollout.run_rollout(addrs, "m", generation=1, bake_s=0,
+                                  gate=lambda r: 0,
+                                  client_factory=factory)
+    assert summary["status"] == "error"
+    assert "injected deploy failure" in summary["error"]
+    assert states[0].generation == 0    # canary rolled back
+    assert states[0].deploys == [1, 0]
+
+
+def test_rollout_exit_codes():
+    states = [_FakeReplicaState(0)]
+    addrs, factory = _fleet(states)
+    assert rollout.run_rollout(addrs, "m", generation=1, bake_s=0,
+                               gate=lambda r: 0,
+                               client_factory=factory)["status"] \
+        == "promoted"
+    with pytest.raises(ValueError, match="at least one"):
+        rollout.run_rollout([], "m", generation=1)
+
+
+def test_gate_failpoint_pages_without_touching_the_fleet():
+    with failpoints.active("rollout.gate.page"):
+        assert rollout.run_healthcheck("127.0.0.1:1") == 2
+
+
+# ==================================================== client retry plane
+def test_client_rotates_replicas_on_draining(monkeypatch):
+    c = serving.ServingClient(["a:1", "b:2"], retry_draining=5,
+                              retry_backoff_ms=1)
+    calls = []
+
+    def fake_call(meta, payload=b"", deadline_ms=None):
+        calls.append(c._cur)
+        if len(calls) < 3:
+            raise serving.Draining("mid-swap")
+        return {"ok": True}, b""
+
+    monkeypatch.setattr(c, "_call", fake_call)
+    meta, _ = c._call_retrying({"op": "serve.infer"})
+    assert meta["ok"]
+    assert calls == [0, 1, 0]           # rotated through the replicas
+
+
+def test_client_draining_retry_respects_deadline(monkeypatch):
+    c = serving.ServingClient("a:1", retry_draining=10 ** 6,
+                              retry_backoff_ms=20)
+
+    def always_draining(meta, payload=b"", deadline_ms=None):
+        raise serving.Draining("mid-swap")
+
+    monkeypatch.setattr(c, "_call", always_draining)
+    t0 = time.monotonic()
+    with pytest.raises(serving.DeadlineExceeded) as ei:
+        c._call_retrying({"op": "serve.infer"}, deadline_ms=150)
+    assert ei.value.stage == "draining"
+    assert time.monotonic() - t0 < 5.0  # bounded by the deadline, not
+    #                                     the (huge) retry cap
+
+
+def test_client_single_replica_backs_off_then_recovers(monkeypatch):
+    c = serving.ServingClient(("a", 1), retry_draining=5,
+                              retry_backoff_ms=1)
+    attempts = []
+
+    def fake_call(meta, payload=b"", deadline_ms=None):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise serving.Draining("mid-swap")
+        return {"ok": True}, b""
+
+    monkeypatch.setattr(c, "_call", fake_call)
+    meta, _ = c._call_retrying({"op": "serve.infer"})
+    assert meta["ok"] and len(attempts) == 3
+
+
+# ============================================ in-process serve.deploy op
+def test_server_deploy_swap_rollback_and_noop(tmp_path):
+    d = str(tmp_path)
+    m = _bert()
+    _export_generations(d, m, 2)
+    srv = serving.ModelServer()
+    srv.load("bert", directory=d, generation=0, max_wait_ms=0,
+             buckets=(8,))
+    srv.start()
+    try:
+        assert srv.generations()["bert"] == {"generation": 0,
+                                             "draining": False}
+        r = srv.deploy("bert")          # follows the pointer (gen 1)
+        assert r["generation"] == 1 and r["previous"] == 0 \
+            and not r.get("noop")
+        assert srv.deploy("bert", generation=1)["noop"] is True
+        back = srv.deploy("bert", generation=0)     # rollback direction
+        assert back["generation"] == 0 and back["previous"] == 1
+        # a missing generation fails BEFORE the drain: service untouched
+        with pytest.raises(FileNotFoundError):
+            srv.deploy("bert", generation=42)
+        assert srv.generations()["bert"] == {"generation": 0,
+                                             "draining": False}
+        assert cat.serving_generation.value(model="bert") == 0
+        assert cat.deploy_swaps.value(model="bert", outcome="ok") >= 2
+    finally:
+        srv.stop()
+
+
+# ===================================================== acceptance drill
+def _replica_proc(ckpt_dir, q, stop_evt, flight_path):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from incubator_mxnet_tpu import serving as srv_mod
+    from incubator_mxnet_tpu.telemetry import flight
+    try:
+        flight.enable()
+        srv = srv_mod.ModelServer()
+        srv.load("bert", directory=ckpt_dir, generation=0,
+                 max_wait_ms=20, buckets=(8,))
+        srv.start()
+        q.put(("ok", list(srv.addr)))
+        stop_evt.wait(300)
+        srv.stop()
+        flight.dump(flight_path, reason="drill exit")
+    except Exception as e:  # surface failures to the test
+        import traceback
+        q.put(("error", "%s\n%s" % (e, traceback.format_exc())))
+
+
+def _compile_total(addr):
+    c = serving.ServingClient(addr, timeout=30)
+    try:
+        prom = c.metrics("prom")
+    finally:
+        c.close()
+    total = 0.0
+    for line in prom.splitlines():
+        if line.startswith("mxtpu_jit_compiles_total"):
+            total += float(line.rsplit(None, 1)[-1])
+    return total
+
+
+def test_live_weight_push_no_drop_drill(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    model = _bert(prefix="drill_")
+    _export_generations(ckpt, model, 5)             # generations 0..4
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    stop_evt = ctx.Event()
+    flights = [str(tmp_path / ("flight%d.jsonl" % i)) for i in range(2)]
+    procs = [ctx.Process(target=_replica_proc,
+                         args=(ckpt, q, stop_evt, flights[i]))
+             for i in range(2)]
+    for p in procs:
+        p.start()
+    addrs = []
+    for _ in procs:
+        status, info = q.get(timeout=180)
+        assert status == "ok", info
+        addrs.append(tuple(info))
+
+    stop = threading.Event()
+    errors, count_lock, counts = [], threading.Lock(), {"ok": 0}
+    try:
+        # warm every replica to steady state (row shapes 1/2/4 cover
+        # every pow2 the 3-thread storm can coalesce), then take the
+        # per-replica compile baseline the swaps must not move
+        for a in addrs:
+            c = serving.ServingClient(a, timeout=60)
+            for rows in (1, 2, 4):
+                c.infer("bert", {"token_ids": _ids(rows=rows)})
+            c.close()
+        base = {a: _compile_total(a) for a in addrs}
+
+        def storm(seed):
+            c = serving.ServingClient(list(addrs), timeout=60)
+            rng = np.random.RandomState(seed)
+            n = 0
+            try:
+                while not stop.is_set():
+                    ids = rng.randint(
+                        1, BERT_CFG["vocab_size"], (1, 6)).astype(np.int32)
+                    out = c.infer("bert", {"token_ids": ids},
+                                  deadline_ms=30000)
+                    assert out["pooled"].shape == (1, BERT_CFG["units"])
+                    n += 1
+            except Exception as e:  # noqa: BLE001 — assert on main thread
+                errors.append(repr(e))
+            finally:
+                with count_lock:
+                    counts["ok"] += n
+                c.close()
+
+        threads = [threading.Thread(target=storm, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)                 # storm in full swing
+
+        gate = lambda r: rollout.run_healthcheck(  # noqa: E731
+            r, samples=1, interval=0.05)
+        # --- three good generation swaps under live traffic ------------
+        for g in (1, 2, 3):
+            summary = rollout.run_rollout(list(addrs), "bert",
+                                          generation=g, bake_s=0.2,
+                                          gate=gate)
+            assert summary["status"] == "promoted", summary
+        for a in addrs:
+            c = serving.ServingClient(a, timeout=30)
+            assert c.generation("bert")["generation"] == 3
+            c.close()
+
+        # --- injected-bad generation: canary gate PAGEs ----------------
+        with failpoints.active("rollout.gate.page"):
+            summary = rollout.run_rollout(list(addrs), "bert",
+                                          generation=4, bake_s=0.05,
+                                          gate=gate)
+        assert summary["status"] == "rolled_back", summary
+        for a in addrs:                 # fleet-wide: newest GOOD gen
+            c = serving.ServingClient(a, timeout=30)
+            assert c.generation("bert")["generation"] == 3
+            prom = c.metrics("prom")
+            assert "mxtpu_serving_generation" in prom
+            c.close()
+
+        stop.set()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors       # EVERY request settled: no drops
+        assert counts["ok"] > 0
+        # --- no swap cost a single XLA compile -------------------------
+        for a in addrs:
+            assert _compile_total(a) == base[a]
+    finally:
+        stop.set()
+        stop_evt.set()
+        for p in procs:
+            p.join(30)
+            if p.is_alive():
+                p.terminate()
+
+    # --- the transitions are in the flight JSONL -----------------------
+    events = []
+    for f in flights:
+        with open(f) as fh:
+            events += [json.loads(line) for line in fh if line.strip()]
+    deploys = [e for e in events
+               if str(e.get("event", "")).startswith("deploy.")]
+    swaps = [e["attrs"] for e in deploys if e["event"] == "deploy.swap"]
+    gens_swapped = {s["generation"] for s in swaps}
+    assert {1, 2, 3}.issubset(gens_swapped)
+    assert 4 in gens_swapped            # the canary briefly ran the bad gen
+    assert any(s["generation"] == 3 and s["previous"] == 4
+               for s in swaps)          # ...and was rolled off it
+    assert any(e["event"] == "deploy.drain" for e in deploys)
+    assert any(e["event"] == "deploy.admit" for e in deploys)

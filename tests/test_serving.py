@@ -646,7 +646,7 @@ def test_client_sends_relative_deadline_budget():
             return {"ok": True, "models": []}, b""
 
     c = ServingClient.__new__(ServingClient)
-    c._conn = _FakeConn()
+    c._conns, c._cur = {0: _FakeConn()}, 0
     c._call({"op": "serve.ping"}, deadline_ms=250)
     assert sent["_deadline_ms"] == 250.0
     assert "_deadline" not in sent

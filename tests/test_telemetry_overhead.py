@@ -171,3 +171,22 @@ def test_disabled_ps_overlap_is_one_flag_check():
     kv._io = None
     assert kv.overlap_enabled() is False
     assert _per_call(kv.overlap_enabled) < MAX_SECONDS_PER_CALL
+
+
+def test_disabled_deploy_instruments_are_cheap_and_record_nothing():
+    """The deploy plane's instruments (generation gauge, swap counter,
+    in-flight gauge) sit on the serving hot path's neighbors — disabled
+    they must reduce to the same one-predicate check as every other
+    instrument, and record nothing."""
+    telemetry.disable()
+    from incubator_mxnet_tpu.telemetry import catalog
+    assert _per_call(
+        lambda: catalog.serving_generation.set(3, model="m")) \
+        < MAX_SECONDS_PER_CALL
+    assert _per_call(lambda: catalog.deploy_inflight.set(1)) \
+        < MAX_SECONDS_PER_CALL
+    assert _per_call(
+        lambda: catalog.deploy_swaps.inc(model="m", outcome="ok")) \
+        < MAX_SECONDS_PER_CALL
+    assert catalog.serving_generation.value(model="m") == 0
+    assert catalog.deploy_swaps.value(model="m", outcome="ok") == 0

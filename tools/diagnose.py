@@ -161,6 +161,40 @@ def main():
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
             print("server       : %s unreachable (%s)" % (addr, e))
 
+    section("Deployment")
+    # live weight-push view: per-replica serving generation and drain
+    # state (MXTPU_SERVE_ADDR takes a comma-separated replica list), and
+    # whether the fleet agrees — skew here means a rollout stalled or a
+    # replica was left behind
+    addrs = [a.strip() for a in
+             os.environ.get("MXTPU_SERVE_ADDR", "").split(",") if a.strip()]
+    if not addrs:
+        print("(no server configured — set MXTPU_SERVE_ADDR=host:port"
+              "[,host:port...])")
+    else:
+        by_model = {}
+        for a in addrs:
+            try:
+                host, port = a.rsplit(":", 1)
+                from incubator_mxnet_tpu.serving import ServingClient
+                c = ServingClient((host, int(port)), timeout=3.0)
+                try:
+                    for name, ent in sorted(c.generation().items()):
+                        print("  - %s %s: generation=%s%s"
+                              % (a, name, ent.get("generation"),
+                                 " DRAINING" if ent.get("draining")
+                                 else ""))
+                        by_model.setdefault(name, set()).add(
+                            ent.get("generation"))
+                finally:
+                    c.close()
+            except Exception as e:  # noqa: BLE001
+                print("  - %s unreachable (%s)" % (a, e))
+        for name, gens in sorted(by_model.items()):
+            if len(gens) > 1:
+                print("  !! generation skew on %r: %s — rollout stalled?"
+                      % (name, sorted(gens)))
+
     section("Compile Cache")
     # persistent compile cache: config + entry inventory of the
     # MXTPU_COMPILE_CACHE_DIR this process would use

@@ -96,14 +96,33 @@ def main():
         # multi-process SPMD: every process runs the SAME command and
         # joins one jax.distributed group; multihost.initialize() picks
         # these up (reference analogue: the horovod/NCCL path)
-        port = _free_port()
-        procs = []
-        for i in range(args.num_workers):
+        def mesh_env(port, i):
             env = dict(os.environ)
             env.update({"MXTPU_COORDINATOR": "127.0.0.1:%d" % port,
                         "MXTPU_NUM_PROCS": str(args.num_workers),
                         "MXTPU_PROC_ID": str(i)})
-            procs.append(subprocess.Popen(args.command, env=env))
+            return env
+
+        # the free-port probe is pick-then-rebind: another process can
+        # grab the port between close() and rank 0's coordinator bind.
+        # Rank 0 fails fast on a taken port, so spawn IT first, watch it
+        # briefly, and retry on a fresh port until one sticks (an exit 0
+        # inside the window is a very fast successful rank, not a bind
+        # failure — fall through and spawn the rest)
+        for _attempt in range(10):
+            port = _free_port()
+            rank0 = subprocess.Popen(args.command, env=mesh_env(port, 0))
+            deadline = time.time() + 0.75
+            while time.time() < deadline and rank0.poll() is None:
+                time.sleep(0.05)
+            if rank0.poll() is None or rank0.returncode == 0:
+                break       # coordinator bound (or rank already done)
+        else:
+            sys.exit("mesh coordinator failed to bind after 10 attempts")
+        procs = [rank0]
+        for i in range(1, args.num_workers):
+            procs.append(subprocess.Popen(args.command,
+                                          env=mesh_env(port, i)))
 
         def mesh_terminate(*_a):
             for p in procs:
@@ -219,7 +238,18 @@ def main():
                 else:
                     code = max(code, rc, 1)
                     failed = True       # respawn budget spent: tear down
-        if not workers or all(w.poll() is not None for w in workers):
+        if not workers:
+            break
+        if not args.elastic and any(w.poll() is not None for w in workers):
+            # non-elastic: ANY worker exit — even code 0 — ends the job.
+            # In dist_sync the survivors would block forever in barriers
+            # against the departed rank; waiting for ALL of them hangs
+            # the launcher behind that deadlock. Break to the teardown:
+            # _drain gives the rest a grace window to finish on their
+            # own, then terminates stragglers and propagates the max
+            # SELF-exit code (terminated ranks are victims, not failures)
+            break
+        if all(w.poll() is not None for w in workers):
             break
         dead_infra = [p for p in infra if p.poll() is not None]
         if dead_infra:

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""rollout — canary-gated rolling weight push across serving replicas.
+
+Walks the replica list ONE AT A TIME (the first replica is the canary):
+each replica drains, swaps to the target generation in place (zero
+recompiles — the bound executables are reused), re-admits, bakes for
+``MXTPU_DEPLOY_BAKE_S`` seconds under live traffic, then faces
+``tools/healthcheck.py`` as the promotion gate. A gate PAGE (exit 2)
+triggers an AUTOMATIC ROLLBACK: every already-swapped replica is
+re-pointed, in reverse order, at the generation it was serving before
+the rollout (old generations are retained on disk — rollback is just
+another in-place swap). The fleet therefore ends a failed rollout
+exactly where it started, with zero dropped requests either way.
+
+Exit codes — CI and the ROADMAP's deploy loops branch on these:
+
+    0   every replica promoted to the target generation
+    1   rollout error (RPC failure, bad arguments); rollback attempted
+    2   canary gate paged; fleet rolled back to the previous generation
+
+    python tools/rollout.py --serving h:p1 --serving h:p2 --model bert
+    python tools/rollout.py --serving h:p --model gpt --generation 7
+    MXTPU_DEPLOY_BAKE_S=10 python tools/rollout.py ... --directory /ckpt
+
+Knobs (all overridable by flags): MXTPU_DEPLOY_BAKE_S (bake seconds
+between swap and gate, default 2), MXTPU_DEPLOY_GATE_SAMPLES /
+MXTPU_DEPLOY_GATE_INTERVAL (healthcheck scrape count/spacing, default
+2 / 1.0). The ``rollout.gate.page`` failpoint forces the gate to PAGE
+without touching the fleet — the acceptance drill uses it to prove the
+rollback path.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_mxnet_tpu.serving import ServingClient  # noqa: E402
+from incubator_mxnet_tpu.telemetry import flight as _fl  # noqa: E402
+from incubator_mxnet_tpu.utils import failpoints  # noqa: E402
+
+EXIT_PROMOTED, EXIT_ERROR, EXIT_ROLLED_BACK = 0, 1, 2
+
+
+def _env_f(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+def run_healthcheck(replica, samples=None, interval=None, rules=None):
+    """The canary gate: tools/healthcheck.py against `replica`, returning
+    its exit code (0 promote, 2 PAGE, 3 unscrapeable — treated as PAGE
+    by the caller: an unobservable canary must not be promoted).
+
+    The ``rollout.gate.page`` failpoint short-circuits to a PAGE so
+    drills can prove the rollback path without hurting a real fleet."""
+    if failpoints.failpoint("rollout.gate.page"):
+        return 2
+    from tools import healthcheck
+    argv = ["--serving", _fmt(replica),
+            "--samples", str(int(samples if samples is not None else
+                                 _env_f("MXTPU_DEPLOY_GATE_SAMPLES", 2))),
+            "--interval", str(float(interval if interval is not None else
+                                    _env_f("MXTPU_DEPLOY_GATE_INTERVAL",
+                                           1.0)))]
+    if rules:
+        argv += ["--rules", rules]
+    return healthcheck.main(argv)
+
+
+def _fmt(addr):
+    return addr if isinstance(addr, str) else "%s:%s" % tuple(addr)
+
+
+def run_rollout(replicas, model, generation=None, directory=None,
+                bake_s=None, gate=None, client_factory=None):
+    """Deploy `generation` of `model` across `replicas` canary-first.
+
+    Returns a summary dict with ``status`` promoted|rolled_back|error,
+    the per-replica walk, and the generations involved. `gate` is a
+    callable(replica)->exit_code (default: `run_healthcheck`);
+    `client_factory` builds a ServingClient per replica (tests inject
+    fakes through both)."""
+    if not replicas:
+        raise ValueError("rollout needs at least one --serving replica")
+    bake_s = float(bake_s if bake_s is not None
+                   else _env_f("MXTPU_DEPLOY_BAKE_S", 2.0))
+    gate = gate or run_healthcheck
+    client_factory = client_factory or (lambda addr: ServingClient(addr))
+
+    summary = {"model": model, "replicas": [_fmt(r) for r in replicas],
+               "target": generation, "walk": [], "status": "promoted"}
+    _fl.record("deploy.rollout.start", model=model, target=generation,
+               replicas=len(replicas))
+    clients, swapped = {}, []   # swapped: [(index, previous_generation)]
+
+    def client(i):
+        if i not in clients:
+            clients[i] = client_factory(replicas[i])
+        return clients[i]
+
+    def rollback(reason):
+        summary["status"] = "rolled_back"
+        summary["reason"] = reason
+        _fl.record("deploy.rollout.rollback", model=model, reason=reason,
+                   swapped=len(swapped))
+        for i, prev in reversed(swapped):
+            entry = {"replica": _fmt(replicas[i]), "action": "rollback",
+                     "generation": prev}
+            try:
+                client(i).deploy(model, generation=prev,
+                                 directory=directory)
+            except Exception as exc:     # keep unwinding the rest
+                entry["error"] = str(exc)
+                summary["status"] = "error"
+            summary["walk"].append(entry)
+
+    try:
+        for i, replica in enumerate(replicas):
+            c = client(i)
+            prev = int(c.generation(model)["generation"])
+            result = c.deploy(model, generation=generation,
+                              directory=directory)
+            target = int(result["generation"])
+            entry = {"replica": _fmt(replica), "action": "deploy",
+                     "generation": target, "previous": prev,
+                     "canary": i == 0}
+            summary["walk"].append(entry)
+            summary["target"] = target
+            if not result.get("noop"):
+                swapped.append((i, prev))
+            if bake_s > 0:
+                time.sleep(bake_s)
+            rc = gate(replica)
+            entry["gate"] = int(rc)
+            if rc != 0:
+                rollback("gate exit %d on %s" % (rc, _fmt(replica)))
+                return summary
+        _fl.record("deploy.rollout.promote", model=model,
+                   generation=summary["target"], replicas=len(replicas))
+        return summary
+    except Exception as exc:
+        summary["error"] = str(exc)
+        rollback("rollout error: %s" % exc)
+        summary["status"] = "error"
+        return summary
+    finally:
+        for c in clients.values():
+            try:
+                c.close()
+            except Exception:  # mxlint: disable=broad-except — teardown of a possibly-dead replica's socket must not mask the rollout outcome
+                pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--serving", action="append", required=True,
+                    help="model-server host:port (repeatable; the first "
+                         "one is the canary)")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--generation", type=int, default=None,
+                    help="target generation (default: the directory's "
+                         "GENERATION.json pointer, read by each replica)")
+    ap.add_argument("--directory", default=None,
+                    help="checkpoint directory override (default: the "
+                         "directory each replica loaded the model from)")
+    ap.add_argument("--bake", type=float, default=None,
+                    help="seconds of live traffic between swap and gate "
+                         "(default MXTPU_DEPLOY_BAKE_S or 2)")
+    ap.add_argument("--gate-samples", type=int, default=None)
+    ap.add_argument("--gate-interval", type=float, default=None)
+    ap.add_argument("--rules", default=None,
+                    help="JSON health-rule file for the gate")
+    args = ap.parse_args(argv)
+
+    gate = lambda replica: run_healthcheck(  # noqa: E731
+        replica, samples=args.gate_samples, interval=args.gate_interval,
+        rules=args.rules)
+    summary = run_rollout(args.serving, args.model,
+                          generation=args.generation,
+                          directory=args.directory, bake_s=args.bake,
+                          gate=gate)
+    print(json.dumps(summary, indent=2, default=str))
+    return {"promoted": EXIT_PROMOTED,
+            "rolled_back": EXIT_ROLLED_BACK}.get(summary["status"],
+                                                 EXIT_ERROR)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
