@@ -16,8 +16,8 @@ host transfer of the final loss (float(...)), which cannot complete before
 every queued step has executed on device.
 
 BENCH_MODEL selects a single benchmark: resnet50 | bert | bert_long |
-resnet50_pipe | lstm | ssd | serving_bert | llm_decode | stream_input
-| ... (see _dispatch). bert runs REAL BERT-base pretraining — BERTForPretrain
+resnet50_pipe | lstm | ssd | serving_bert | llm_decode | load_storm
+| stream_input | ... (see _dispatch). bert runs REAL BERT-base pretraining — BERTForPretrain
 with the full MLM objective (gather-first masked-position decode through
 the 768x30522 vocab projection, loss on the 15% masked slots) plus the
 NSP head, per the reference pretraining recipe.
@@ -1214,6 +1214,113 @@ def bench_llm_decode():
         chips=chips, model="gpt_%dx%d" % (units, layers))
 
 
+def bench_load_storm():
+    """BENCH_MODEL=load_storm: the trace-driven load-storm harness
+    (tools/loadstorm.py) replayed against an in-process TWO-replica
+    gpt_decoder fleet — heavy-tailed lognormal prompt lengths, a
+    diurnal rate curve, one flash-crowd burst, closed-loop clients
+    walking a seeded schedule. Two gated JSON lines: goodput
+    (load_storm_goodput_rps, "req/sec" so bench_diff gates it
+    higher-better like every /sec row) and client p99
+    (load_storm_client_p99_ms, lower_is_better — a latency regression
+    trips the gate even when goodput holds). Head sampling is on for
+    the storm, so the line also proves the journey plumbing: it carries
+    the count of stitched slow-trace timelines the report recovered
+    from the fleet's /tracez rings.
+
+    Knobs: BENCH_STORM_SECONDS (8), BENCH_STORM_RPS (12),
+    BENCH_STORM_CLIENTS (6), BENCH_STORM_SEED (7), BENCH_STORM_SAMPLE
+    (0.25 head-sampling probability during the storm)."""
+    import tempfile
+    from incubator_mxnet_tpu import init as mxinit
+    from incubator_mxnet_tpu import nd, serving
+    from incubator_mxnet_tpu.generate import export_gpt_for_serving
+    from incubator_mxnet_tpu.models.gpt import GPTDecoder
+    from incubator_mxnet_tpu.telemetry import tracing
+    from tools import loadstorm
+
+    seconds = float(os.environ.get("BENCH_STORM_SECONDS", "8"))
+    rps = float(os.environ.get("BENCH_STORM_RPS", "12"))
+    clients = int(os.environ.get("BENCH_STORM_CLIENTS", "6"))
+    seed = int(os.environ.get("BENCH_STORM_SEED", "7"))
+    sample = float(os.environ.get("BENCH_STORM_SAMPLE", "0.25"))
+
+    cfg = dict(vocab_size=64, units=32, num_layers=2, num_heads=2,
+               max_len=128)
+    model = GPTDecoder(prefix="bench_storm_", **cfg)
+    model.initialize(mxinit.Normal(0.05))
+    model(nd.array(np.zeros((1, 4), np.int32)))
+    ckpt = tempfile.mkdtemp(prefix="bench_storm_")
+    export_gpt_for_serving(ckpt, cfg, model)
+    replicas = []
+    for _ in range(2):
+        srv = serving.ModelServer()
+        srv.load("gpt", directory=ckpt, slots=4, cache_len=cfg["max_len"])
+        srv.start()
+        replicas.append(srv)
+    addrs = [srv.addr for srv in replicas]
+
+    prev_rate = tracing.sample_rate()
+    try:
+        # warm every decode grid per replica (prefill chunks + step)
+        # so the storm measures steady-state, not XLA compile
+        for srv in replicas:
+            c = serving.ServingClient(srv.addr)
+            for n in (4, 24, 56):
+                c.decode("gpt", (np.arange(n, dtype=np.int32) % 62) + 1,
+                         max_new_tokens=4)
+            c.close()
+            srv.reset_service_estimates("gpt")
+        # the warm waves observed compile-laden latencies; clear the
+        # stage histograms so the report's percentiles are storm-only
+        # (replicas are in-process — one shared registry)
+        from incubator_mxnet_tpu.telemetry import catalog as _tcat
+        for inst in (_tcat.serving_queue_seconds,
+                     _tcat.serving_request_seconds,
+                     _tcat.serving_ttft_seconds,
+                     _tcat.serving_tpot_seconds,
+                     _tcat.gen_prefill_seconds):
+            inst.clear()
+        tracing.set_sample_rate(sample)
+        spec = loadstorm.default_spec(
+            seed=seed, duration_s=seconds, base_rps=rps, clients=clients)
+        # generative traffic only: no encode model in this fleet
+        spec["tenants"] = [t for t in spec["tenants"]
+                           if t["kind"] != "encode"]
+        spec["slow_traces"] = 1
+        report = loadstorm.run_storm(addrs, spec)
+    finally:
+        tracing.set_sample_rate(prev_rate)
+        for srv in replicas:
+            srv.stop()
+
+    goodput = report["goodput_rps"] or 0.0
+    stats = {"value": goodput, "repeats": 1, "min": goodput,
+             "max": goodput, "spread_pct": None}
+    cl = report["client_latency_ms"]
+    ttft_series = report["stages"].get("ttft") or {}
+    ttft_p99 = (next(iter(ttft_series.values()))["p99_ms"]
+                if ttft_series else None)
+    tpot_series = report["stages"].get("tpot") or {}
+    tpot_p99 = (next(iter(tpot_series.values()))["p99_ms"]
+                if tpot_series else None)
+    _emit("load_storm_goodput_rps", "req/sec", stats,
+          shed_pct=report["shed_pct"], p50_ms=cl["p50"],
+          tokens=report["tokens_generated"],
+          requests=report["requests"]["total"],
+          replicas=len(replicas), clients=clients, seed=seed,
+          seconds=seconds, rps=rps,
+          model="gpt_%dx%d" % (cfg["units"], cfg["num_layers"]))
+    p99 = cl["p99"] or 0.0
+    s99 = {"value": p99, "repeats": 1, "min": p99, "max": p99,
+           "spread_pct": None}
+    return _emit("load_storm_client_p99_ms", "ms", s99,
+                 lower_is_better=True, slo_ms=spec["slo_ms"],
+                 ttft_p99_ms=ttft_p99, tpot_p99_ms=tpot_p99,
+                 slow_traces=len(report["slow_traces"]),
+                 model="gpt_%dx%d" % (cfg["units"], cfg["num_layers"]))
+
+
 def bench_stream():
     import shutil
     import tempfile
@@ -1788,6 +1895,8 @@ def _dispatch(model, batch, steps, dtype):
         return bench_serving()
     if model == "llm_decode":
         return bench_llm_decode()
+    if model == "load_storm":
+        return bench_load_storm()
     if model == "stream_input":
         return bench_stream()
     if model == "ssd":

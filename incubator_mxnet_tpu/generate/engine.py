@@ -30,6 +30,7 @@ import time
 import numpy as np
 
 from ..telemetry import catalog as _cat
+from ..telemetry import tracing as _tr
 from .paged_kv import PagedKVCache
 
 __all__ = ["GenerateEngine", "GPTPagedLM"]
@@ -215,13 +216,16 @@ class GenerateEngine:
             t0 = time.monotonic()
             for s in seqs:
                 t_seq = time.monotonic()
-                if len(s["ctx"]) > 1:
-                    self._prefill(self.model, self.cache, s["slot"],
-                                  s["ctx"][:-1])
-                    if self.draft is not None:
-                        self._prefill(self.draft, self.draft_cache,
-                                      s["dslot"], s["ctx"][:-1])
-                    stats["prefill_tokens"] += len(s["ctx"]) - 1
+                with _tr.span("gen.prefill", model=self.name,
+                              slot=s["slot"],
+                              tokens=max(len(s["ctx"]) - 1, 0)):
+                    if len(s["ctx"]) > 1:
+                        self._prefill(self.model, self.cache, s["slot"],
+                                      s["ctx"][:-1])
+                        if self.draft is not None:
+                            self._prefill(self.draft, self.draft_cache,
+                                          s["dslot"], s["ctx"][:-1])
+                        stats["prefill_tokens"] += len(s["ctx"]) - 1
                 _cat.gen_prefill_seconds.observe(
                     time.monotonic() - t_seq, model=self.name)
             stats["prefill_seconds"] = time.monotonic() - t0
@@ -257,16 +261,22 @@ class GenerateEngine:
             if not live:
                 return
             t0 = time.monotonic()
-            tokens = np.asarray([[s["ctx"][-1]] for s in live], np.int32)
-            logits = self._step(self.model, self.cache,
-                                [s["slot"] for s in live], tokens)
-            for row, s in enumerate(live):
-                tok = self._sample(logits[row])
-                s["ctx"].append(tok)
-                s["out"].append(tok)
-                stats["decode_tokens"] += 1
-                if tok == eos_id or len(s["out"]) >= max_new_tokens:
-                    s["done"] = True
+            with _tr.span("gen.decode_step", model=self.name,
+                          rows=len(live)) as sp:
+                tokens = np.asarray([[s["ctx"][-1]] for s in live],
+                                    np.int32)
+                logits = self._step(self.model, self.cache,
+                                    [s["slot"] for s in live], tokens)
+                committed = 0
+                for row, s in enumerate(live):
+                    tok = self._sample(logits[row])
+                    s["ctx"].append(tok)
+                    s["out"].append(tok)
+                    stats["decode_tokens"] += 1
+                    committed += 1
+                    if tok == eos_id or len(s["out"]) >= max_new_tokens:
+                        s["done"] = True
+                sp.set_attr("tokens_committed", committed)
             _cat.gen_decode_seconds.observe(time.monotonic() - t0,
                                             model=self.name)
 
@@ -340,5 +350,15 @@ class GenerateEngine:
                     break
             self.cache.truncate(slot, len(ctx) - 1)
             self.draft_cache.truncate(dslot, len(ctx) - 1)
-            _cat.gen_decode_seconds.observe(time.monotonic() - t0,
-                                            model=self.name)
+            dt = time.monotonic() - t0
+            _cat.gen_decode_seconds.observe(dt, model=self.name)
+            cur = _tr.current()
+            if cur is not None:
+                # one span per propose+verify round, carrying the spec
+                # accounting the journey timeline reports
+                t1w = time.time()
+                _tr.record_span(
+                    "gen.decode_step", cur.trace_id,
+                    parent_id=cur.span_id, t0=t1w - dt, t1=t1w,
+                    sampled=cur.sampled, model=self.name, speculative=True,
+                    proposed=k, accepted=a, tokens_committed=len(commit))

@@ -27,6 +27,7 @@ import time
 import numpy as np
 
 from ..kvstore.rpc import Connection
+from ..telemetry import tracing as _tr
 from .scheduler import ShedError
 from .wire import pack_arrays, unpack_arrays
 
@@ -78,6 +79,10 @@ class ServingClient:
         self._timeout = float(timeout)
         self._conns = {}
         self._cur = 0
+        #: trace id of the most recent infer/decode call IF it was head-
+        #: sampled (MXTPU_TRACE_SAMPLE), else None — load generators read
+        #: this to pair a latency sample with its /tracez timeline.
+        self.last_trace_id = None
         self._retries = int(
             retry_draining if retry_draining is not None
             else os.environ.get("MXTPU_DEPLOY_RETRY_MAX", "40") or 40)
@@ -168,9 +173,11 @@ class ServingClient:
         """One-shot forward on `model`. arrays: name -> (rows, ...) array,
         all with the same leading dim. Returns name -> array."""
         manifest, payload = pack_arrays(arrays)
-        meta, rpayload = self._call_retrying(
-            {"op": "serve.infer", "model": model, "arrays": manifest},
-            payload, deadline_ms=deadline_ms)
+        with _tr.request_span("client.infer", model=model) as sp:
+            self.last_trace_id = sp.trace_id if sp.sampled else None
+            meta, rpayload = self._call_retrying(
+                {"op": "serve.infer", "model": model, "arrays": manifest},
+                payload, deadline_ms=deadline_ms)
         return unpack_arrays(meta["arrays"], rpayload)
 
     def decode(self, model, prompt, max_new_tokens=16, eos_id=None,
@@ -183,9 +190,24 @@ class ServingClient:
                "max_new_tokens": int(max_new_tokens)}
         if eos_id is not None:
             req["eos_id"] = int(eos_id)
-        meta, rpayload = self._call_retrying(req, payload,
-                                             deadline_ms=deadline_ms)
+        with _tr.request_span("client.decode", model=model,
+                              prompt_tokens=int(prompt.size)) as sp:
+            self.last_trace_id = sp.trace_id if sp.sampled else None
+            meta, rpayload = self._call_retrying(req, payload,
+                                                 deadline_ms=deadline_ms)
         return unpack_arrays(meta["arrays"], rpayload)["tokens"]
+
+    def tracez(self, trace_id=None, limit=None):
+        """Recent sampled spans on the current replica; with `trace_id`,
+        the stitched timeline dict for that one trace (see
+        telemetry.tracing.build_timeline)."""
+        req = {"op": "serve.tracez"}
+        if trace_id is not None:
+            req["trace_id"] = trace_id
+        if limit is not None:
+            req["limit"] = int(limit)
+        meta, _ = self._call(req)
+        return meta["timeline"] if trace_id is not None else meta["spans"]
 
     # ------------------------------------------------------ deploy plane
     def deploy(self, model, generation=None, directory=None):
@@ -198,7 +220,9 @@ class ServingClient:
             req["generation"] = int(generation)
         if directory is not None:
             req["directory"] = directory
-        meta, _ = self._call(req)
+        with _tr.request_span("client.deploy", model=model) as sp:
+            self.last_trace_id = sp.trace_id if sp.sampled else None
+            meta, _ = self._call(req)
         return meta
 
     def drain(self, model, timeout=None):

@@ -38,6 +38,8 @@ import time
 import numpy as np
 
 from ..telemetry import catalog as _cat
+from ..telemetry import flight as _fl
+from ..telemetry import tracing as _tr
 from .scheduler import Request
 
 __all__ = ["DecodeRequest", "DecodeLoop"]
@@ -68,6 +70,8 @@ class _Seq:
         self.req = req
         self.fed = 0
         self.generated = []
+        self.last_tok = None    # monotonic time of last committed token
+        #                         (TTFT on the first, TPOT gaps after)
 
     def next_input(self):
         if self.fed < self.req.prompt.size:
@@ -152,6 +156,17 @@ class DecodeLoop:
         if req.shed(stage, detail):     # no double-count if already done
             _cat.serving_shed.inc(model=self.name, stage=stage)
             _cat.serving_requests.inc(model=self.name, status="shed")
+            attrs = {"model": self.name, "stage": stage,
+                     "request_id": req.id}
+            if req.trace:
+                attrs["trace_id"] = req.trace[0]
+                t1 = time.time()
+                _tr.record_span(
+                    "serve.shed", req.trace[0], parent_id=req.trace[1],
+                    t0=t1 - (time.monotonic() - req.arrival), t1=t1,
+                    sampled=True, model=self.name, stage=stage,
+                    request_id=req.id, detail=detail)
+            _fl.record("serving.shed", **attrs)
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -271,6 +286,18 @@ class DecodeLoop:
                 return
             self._pending.popleft()
             seq = _Seq(req)
+            _cat.serving_queue_seconds.observe(
+                time.monotonic() - req.arrival, model=self.name,
+                exemplar=req.trace[0] if req.trace else None)
+            t_adm = None
+            if req.trace:
+                # retroactive queue span: arrival -> slot grant
+                t_adm = time.time()
+                _tr.record_span(
+                    "serve.queue", req.trace[0], parent_id=req.trace[1],
+                    t0=t_adm - (time.monotonic() - req.arrival),
+                    t1=t_adm, sampled=True, model=self.name,
+                    request_id=req.id)
             if self._prefill_fn is not None and req.prompt.size > 1:
                 t0 = time.perf_counter()
                 try:
@@ -284,12 +311,29 @@ class DecodeLoop:
                     continue
                 dt = time.perf_counter() - t0
                 seq.fed = req.prompt.size - 1
-                _cat.gen_prefill_seconds.observe(dt, model=self.name)
+                _cat.gen_prefill_seconds.observe(
+                    dt, model=self.name,
+                    exemplar=req.trace[0] if req.trace else None)
                 _cat.serving_forward_seconds.observe(
                     dt, model=self.name, bucket="prefill")
                 _cat.gen_tokens_committed.inc(
                     req.prompt.size - 1, model=self.name,
                     phase="prefill")
+                if req.trace:
+                    t1 = time.time()
+                    _tr.record_span(
+                        "decode.prefill", req.trace[0],
+                        parent_id=req.trace[1], t0=t1 - dt, t1=t1,
+                        sampled=True, model=self.name, request_id=req.id,
+                        prefill_tokens=int(req.prompt.size - 1),
+                        chunk=self._prefill_chunk, slot=slot)
+            if req.trace:
+                # join span: slot grant -> active in the step grid
+                # (chunked prefill, when it ran, sits inside this window)
+                _tr.record_span(
+                    "serve.join", req.trace[0], parent_id=req.trace[1],
+                    t0=t_adm, t1=time.time(), sampled=True,
+                    model=self.name, request_id=req.id, slot=slot)
             self._active[slot] = seq
         _cat.serving_decode_slots.set(len(self._active), model=self.name)
 
@@ -345,33 +389,65 @@ class DecodeLoop:
             # before retirement skipped the buzzer token)
             step_decode_tokens = 0
             step_prefill_tokens = 0
+            t_wall = None               # epoch stamp, taken lazily once
             with self._cond:
                 for slot, seq in list(self._active.items()):
                     before = len(seq.generated)
                     seq.consume(logits[slot])
-                    if len(seq.generated) > before:
+                    new_tok = len(seq.generated) > before
+                    if new_tok:
                         step_decode_tokens += 1
+                        ex = seq.req.trace[0] if seq.req.trace else None
+                        if before == 0:
+                            _cat.serving_ttft_seconds.observe(
+                                now - seq.req.arrival, model=self.name,
+                                exemplar=ex)
+                        elif seq.last_tok is not None:
+                            _cat.serving_tpot_seconds.observe(
+                                now - seq.last_tok, model=self.name,
+                                exemplar=ex)
+                        seq.last_tok = now
                     else:
                         step_prefill_tokens += 1
+                    if seq.req.trace:
+                        if t_wall is None:
+                            t_wall = time.time()
+                        _tr.record_span(
+                            "decode.step", seq.req.trace[0],
+                            parent_id=seq.req.trace[1], t0=t_wall - dt,
+                            t1=t_wall, sampled=True, model=self.name,
+                            request_id=seq.req.id, slot=slot,
+                            tokens_committed=int(new_tok),
+                            generated=len(seq.generated))
                     if seq.req.done:    # cancelled mid-flight: release
-                        pass
+                        reason = "cancelled"
                     elif seq.finished:
                         # finished beats the deadline check: this step's
                         # compute already paid for the final token, so a
                         # sequence that completed at the buzzer is
                         # delivered, not shed
+                        reason = "ok"
                         if seq.req.complete({"tokens": np.asarray(
                                 seq.generated, np.int32)}):
                             _cat.serving_requests.inc(model=self.name,
                                                       status="ok")
                             _cat.serving_request_seconds.observe(
-                                now - seq.req.arrival, model=self.name)
+                                now - seq.req.arrival, model=self.name,
+                                exemplar=seq.req.trace[0]
+                                if seq.req.trace else None)
                     elif seq.req.deadline is not None \
                             and now > seq.req.deadline:
+                        reason = "deadline"
                         self._shed(seq.req, "decode",
                                    "deadline passed mid-generation")
                     else:
                         continue
+                    attrs = {"model": self.name, "reason": reason,
+                             "request_id": seq.req.id, "slot": slot,
+                             "generated": len(seq.generated)}
+                    if seq.req.trace:
+                        attrs["trace_id"] = seq.req.trace[0]
+                    _fl.record("serving.retire", **attrs)
                     self._cache.free(slot)
                     del self._active[slot]
                 _cat.serving_decode_slots.set(len(self._active),

@@ -37,7 +37,9 @@ import numpy as np
 
 from ..telemetry import catalog as _cat
 from ..telemetry import costs as _costs
+from ..telemetry import flight as _fl
 from ..telemetry import metrics as _met
+from ..telemetry import tracing as _tr
 
 __all__ = ["Request", "ContinuousBatcher", "ShedError", "bucket_for",
            "default_buckets", "pad_batch_rows", "pad_to_bucket"]
@@ -128,6 +130,14 @@ class Request:
             for k, a in self.arrays.items()))
         self.deadline = deadline
         self.arrival = time.monotonic()
+        # request-journey tracing: constructed inside the server's rpc
+        # span (Server._serve_conn wraps the handler in from_meta), so
+        # current() is that span. Only HEAD-SAMPLED requests carry their
+        # (trace_id, parent span_id) — the off path is one call + one
+        # attribute check.
+        sp = _tr.current()
+        self.trace = (sp.trace_id, sp.span_id) \
+            if sp is not None and sp.sampled else None
         self._done = threading.Event()
         self._settle = threading.Lock()
         self.result = None          # dict name -> np.ndarray on success
@@ -255,6 +265,19 @@ class ContinuousBatcher:
         if req.shed(stage, detail):     # no double-count if already done
             _cat.serving_shed.inc(model=self.name, stage=stage)
             _cat.serving_requests.inc(model=self.name, status="shed")
+            # flight event carries the request id (and trace id when
+            # sampled) so /flightz entries join against /tracez
+            attrs = {"model": self.name, "stage": stage,
+                     "request_id": req.id}
+            if req.trace:
+                attrs["trace_id"] = req.trace[0]
+                t1 = time.time()
+                _tr.record_span(
+                    "serve.shed", req.trace[0], parent_id=req.trace[1],
+                    t0=t1 - (time.monotonic() - req.arrival), t1=t1,
+                    sampled=True, model=self.name, stage=stage,
+                    request_id=req.id, detail=detail)
+            _fl.record("serving.shed", **attrs)
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -391,6 +414,7 @@ class ContinuousBatcher:
                 bucket = self._pick_bucket_locked()
                 if bucket is None:      # raced with another drain
                     continue
+                t_pick = time.monotonic()   # queue-wait / join-wait split
                 if self._max_wait > 0 and not self._draining:
                     # join window: give late arrivals a bounded chance to
                     # coalesce, anchored to the oldest queued arrival so
@@ -415,13 +439,13 @@ class ContinuousBatcher:
                     self._cond.notify_all()
             if taken:
                 try:
-                    self._serve_batch(bucket, taken, rows)
+                    self._serve_batch(bucket, taken, rows, t_pick)
                 finally:
                     with self._cond:
                         self._in_flight = False
                         self._cond.notify_all()
 
-    def _serve_batch(self, bucket, taken, rows):
+    def _serve_batch(self, bucket, taken, rows, t_pick=None):
         now = time.monotonic()
         est = self._estimate(bucket)
         live = []
@@ -437,9 +461,25 @@ class ContinuousBatcher:
         if not live:
             return
         rows = sum(r.rows for r in live)
+        wall_off = time.time() - now    # monotonic -> epoch, once
         for r in live:
-            _cat.serving_queue_seconds.observe(now - r.arrival,
-                                               model=self.name)
+            _cat.serving_queue_seconds.observe(
+                now - r.arrival, model=self.name,
+                exemplar=r.trace[0] if r.trace else None)
+            if r.trace:
+                # retroactive journey spans: queue (arrival -> bucket
+                # pick) and join (pick -> serve; the coalescing window)
+                joined = now if t_pick is None else max(r.arrival, t_pick)
+                _tr.record_span(
+                    "serve.queue", r.trace[0], parent_id=r.trace[1],
+                    t0=r.arrival + wall_off, t1=joined + wall_off,
+                    sampled=True, model=self.name, request_id=r.id,
+                    bucket=bucket)
+                if joined < now:
+                    _tr.record_span(
+                        "serve.join", r.trace[0], parent_id=r.trace[1],
+                        t0=joined + wall_off, t1=now + wall_off,
+                        sampled=True, model=self.name, request_id=r.id)
         _cat.serving_batch_occupancy.observe(rows, model=self.name)
 
         # pad-or-pack: each request to the bucket edge, rows stacked,
@@ -488,6 +528,14 @@ class ContinuousBatcher:
                 0.7 * prev + 0.3 * dt
         _cat.serving_forward_seconds.observe(dt, model=self.name,
                                              bucket=str(bucket))
+        t_done = time.time()
+        for r in live:
+            if r.trace:
+                _tr.record_span(
+                    "serve.forward", r.trace[0], parent_id=r.trace[1],
+                    t0=t_done - dt, t1=t_done, sampled=True,
+                    model=self.name, request_id=r.id, bucket=bucket,
+                    batch_rows=rows)
         if _met._state["enabled"]:
             # hardware-truth accounting for the serving forward: tokens
             # consumed per second always; MFU when the cost was captured
@@ -506,4 +554,5 @@ class ContinuousBatcher:
             if r.complete(res):
                 _cat.serving_requests.inc(model=self.name, status="ok")
                 _cat.serving_request_seconds.observe(
-                    time.monotonic() - r.arrival, model=self.name)
+                    time.monotonic() - r.arrival, model=self.name,
+                    exemplar=r.trace[0] if r.trace else None)

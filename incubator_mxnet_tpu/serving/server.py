@@ -34,6 +34,7 @@ from ..telemetry import debugz as _dbz
 from ..telemetry import export as _texport
 from ..telemetry import flight as _fl
 from ..telemetry import metrics as _met
+from ..telemetry import tracing as _tr
 from .decode import DecodeLoop, DecodeRequest
 from .loader import ServedModel, load_served_model
 from .scheduler import ContinuousBatcher, Request, ShedError
@@ -186,11 +187,14 @@ class ModelServer:
             else float(timeout)
         _fl.record("deploy.drain", model=name,
                    generation=t.served.generation)
-        ok = True
-        if t.batcher is not None:
-            ok = t.batcher.drain(timeout) and ok
-        if t.decode_loop is not None:
-            ok = t.decode_loop.drain(timeout) and ok
+        # rides the caller's trace when the drain RPC was sampled, so a
+        # deploy's admission outage shows up on the request timeline
+        with _tr.span("deploy.drain", model=name):
+            ok = True
+            if t.batcher is not None:
+                ok = t.batcher.drain(timeout) and ok
+            if t.decode_loop is not None:
+                ok = t.decode_loop.drain(timeout) and ok
         return ok
 
     def admit(self, name):
@@ -310,6 +314,17 @@ class ModelServer:
                     _texport.render_json().encode("utf-8")
             return {"format": "prom"}, \
                 _texport.render_prometheus().encode("utf-8")
+        if op == "serve.tracez":
+            # journey lookup: a trace_id returns THIS replica's stitched
+            # timeline for it (exemplars and flight events carry the
+            # ids to ask with); bare, the most recent sampled spans
+            tid = meta.get("trace_id")
+            if tid is not None:
+                return {"trace_id": tid, "timeline":
+                        _tr.build_timeline(_tr.recent_spans(),
+                                           trace_id=tid)}, b""
+            n = int(meta.get("limit", 256))
+            return {"spans": _tr.recent_spans(n)}, b""
         raise ValueError("unknown serving op %r" % op)
 
     @staticmethod
@@ -336,7 +351,8 @@ class ModelServer:
         try:
             result = req.wait(timeout)
         except ShedError as e:
-            _fl.record("serving.shed", model=name, stage=e.stage)
+            # the scheduler's _shed already put the flight event on the
+            # ring (with request id + trace id) — no second record here
             return self._shed_reply(e), b""
         except TimeoutError as e:
             # Nobody will read a late reply: cancel so the schedulers
@@ -349,7 +365,6 @@ class ModelServer:
             try:
                 result = req.wait(0)
             except ShedError as e2:
-                _fl.record("serving.shed", model=name, stage=e2.stage)
                 return self._shed_reply(e2), b""
         manifest, out_payload = pack_arrays(result)
         return {"ok": True, "arrays": manifest}, out_payload

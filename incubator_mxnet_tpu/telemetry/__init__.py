@@ -49,7 +49,8 @@ from .metrics import (enable, disable, enabled, counter, gauge, histogram,
 from .export import (render_prometheus, render_json, flush, start_flusher,
                      stop_flusher)
 from .tracing import (span, current, inject, extract, from_meta,
-                      merge_traces, recent_spans)
+                      merge_traces, recent_spans, request_span,
+                      record_span, build_timeline, render_timeline)
 
 __all__ = ["metrics", "tracing", "export", "catalog",
            "flight", "debugz", "costs", "aggregate", "history", "health",
@@ -58,4 +59,5 @@ __all__ = ["metrics", "tracing", "export", "catalog",
            "render_prometheus", "render_json", "flush", "start_flusher",
            "stop_flusher",
            "span", "current", "inject", "extract", "from_meta",
-           "merge_traces", "recent_spans"]
+           "merge_traces", "recent_spans", "request_span", "record_span",
+           "build_timeline", "render_timeline"]

@@ -12,7 +12,11 @@ export for the whole fleet from one place.
 
 Unreachable members are reported per-member (``ok: False`` + error),
 never raised: a scrape during an elastic shrink must still show the
-survivors.  kvstore imports happen inside functions so importing
+survivors.  The per-member fetch timeout (``MXTPU_SCRAPE_TIMEOUT_S``,
+default 5s, or the explicit ``timeout=``) bounds how long ONE hung
+member — accepting connections but never answering — can stall the
+walk; past it the member counts as ``scrape_errors{member=}`` exactly
+like a dead one.  kvstore imports happen inside functions so importing
 ``telemetry`` stays light.
 """
 
@@ -20,7 +24,17 @@ import json
 import os
 
 __all__ = ["scrape", "merge", "fetch_member", "scheduler_addr",
-           "hist_quantile"]
+           "hist_quantile", "scrape_timeout"]
+
+
+def scrape_timeout():
+    """Per-member fetch timeout in seconds (MXTPU_SCRAPE_TIMEOUT_S,
+    default 5)."""
+    try:
+        t = float(os.environ.get("MXTPU_SCRAPE_TIMEOUT_S", "") or 5.0)
+    except ValueError:
+        return 5.0
+    return t if t > 0 else 5.0
 
 
 def scheduler_addr():
@@ -39,10 +53,13 @@ def _addr(spec):
     return (host or "127.0.0.1", int(port))
 
 
-def fetch_member(addr, role="server", timeout=5.0):
+def fetch_member(addr, role="server", timeout=None):
     """One member's registry snapshot (the render_json dict), raises on
-    unreachable/invalid."""
+    unreachable/invalid (including a member that accepts but never
+    answers within the timeout — default ``scrape_timeout()``)."""
     from ..kvstore.rpc import request
+    if timeout is None:
+        timeout = scrape_timeout()
     if role == "serving":
         meta, payload = request(tuple(addr), {"op": "serve.metrics",
                                               "format": "json"},
@@ -81,7 +98,7 @@ def merge(snapshots):
     return merged
 
 
-def scrape(scheduler=None, serving=None, stream=None, timeout=5.0):
+def scrape(scheduler=None, serving=None, stream=None, timeout=None):
     """Scrape the whole fleet reachable from one scheduler.
 
     Returns ``{"epoch", "quorum", "members": [...], "registry": ...}``
@@ -96,6 +113,8 @@ def scrape(scheduler=None, serving=None, stream=None, timeout=5.0):
     ``stream.members`` and scraped as ``stream-worker`` members.
     """
     from ..kvstore.rpc import request
+    if timeout is None:
+        timeout = scrape_timeout()
     sched = _addr(scheduler)
     try:
         meta, _ = request(sched, {"op": "membership"}, timeout=timeout)

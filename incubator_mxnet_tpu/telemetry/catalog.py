@@ -228,6 +228,20 @@ serving_batch_occupancy = _m.histogram(
 serving_forward_seconds = _m.histogram(
     "mxtpu_serving_forward_seconds",
     "Forward/decode step wall time by model and shape bucket")
+serving_ttft_seconds = _m.histogram(
+    "mxtpu_serving_ttft_seconds",
+    "Time-to-first-token by model: arrival to first committed decode "
+    "token. Dominated by queue wait + prefill, so the edges run finer "
+    "than the default latency buckets at the low end and stop at 30s",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+serving_tpot_seconds = _m.histogram(
+    "mxtpu_serving_tpot_seconds",
+    "Time-per-output-token by model: inter-token gap for tokens after "
+    "the first. One decode step is sub-millisecond on small models, so "
+    "the edges extend down to 50us where the defaults would saturate",
+    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+             0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
 serving_shed = _m.counter(
     "mxtpu_serving_shed_total",
     "Requests shed by model and stage (queue|join|overload|decode)")

@@ -10,7 +10,9 @@ debugging — no dependencies, daemon threads only, loopback by default:
     /statusz    role, rank, pid, uptime, argv, registered status
                 entries (membership epoch, loaded models, ...) and jax
                 devices when jax is already imported
-    /tracez     recent finished spans (tracing's bounded ring)
+    /tracez     recent finished spans (tracing's bounded ring);
+                ``?trace_id=`` returns that trace's stitched journey
+                timeline (``&format=text`` renders the tree)
     /threadz    all-thread stack dump (watchdog.format_thread_stacks)
     /flightz    flight-recorder ring contents
     /alertz     health-plane verdict + rule config (JSON;
@@ -122,9 +124,28 @@ class _Handler(BaseHTTPRequestHandler):
                 ctype = "application/json"
             elif path == "/tracez":
                 from . import tracing
-                body = json.dumps({"spans": tracing.recent_spans()},
-                                  indent=2, default=str)
-                ctype = "application/json"
+                query = self.path.partition("?")[2]
+                params = dict(p.split("=", 1) for p in query.split("&")
+                              if "=" in p)
+                tid = params.get("trace_id")
+                if tid:
+                    # journey lookup: the stitched timeline for one
+                    # trace id (exemplars in /metrics.json and flight
+                    # events in /flightz carry the ids to ask with)
+                    tl = tracing.build_timeline(tracing.recent_spans(),
+                                                trace_id=tid)
+                    if "format=text" in query:
+                        body = tracing.render_timeline(tl) + "\n"
+                        ctype = "text/plain; charset=utf-8"
+                    else:
+                        body = json.dumps({"trace_id": tid,
+                                           "timeline": tl},
+                                          indent=2, default=str)
+                        ctype = "application/json"
+                else:
+                    body = json.dumps({"spans": tracing.recent_spans()},
+                                      indent=2, default=str)
+                    ctype = "application/json"
             elif path == "/threadz":
                 from ..resilience.watchdog import format_thread_stacks
                 body, ctype = format_thread_stacks(), "text/plain; charset=utf-8"

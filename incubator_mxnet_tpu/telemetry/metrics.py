@@ -15,6 +15,7 @@ telemetry is off.  tests/test_telemetry_overhead.py gates this.
 
 import os
 import threading
+import time
 
 __all__ = ["enable", "disable", "enabled", "counter", "gauge", "histogram",
            "snapshot", "reset", "Counter", "Gauge", "Histogram",
@@ -129,9 +130,19 @@ class Gauge(_Instrument):
 class Histogram(_Instrument):
     """Cumulative-bucket histogram (Prometheus semantics).
 
-    Each series is ``[count, sum, per-bucket counts]`` where bucket i
-    counts observations <= buckets[i]; the implicit +Inf bucket is the
-    total count.
+    Each series is ``[count, sum, per-bucket counts, exemplars]`` where
+    bucket i counts observations <= buckets[i]; the implicit +Inf
+    bucket is the total count. Bucket edges are configurable
+    per-instrument at registration (``buckets=``) — decode-step and
+    TTFT latencies saturate the default edges, so the catalog picks
+    per-instrument ranges.
+
+    Exemplars (OpenMetrics flavor): ``observe(v, exemplar=trace_id)``
+    remembers the most recent trace id that landed in each bucket, so a
+    degraded p99 links straight to a concrete sampled request's
+    timeline (/tracez?trace_id=). Stored per series, surfaced through
+    ``exemplars()`` and the JSON snapshot; the Prometheus text render
+    is unchanged.
     """
 
     kind = "histogram"
@@ -140,21 +151,44 @@ class Histogram(_Instrument):
         super().__init__(name, help)
         self.buckets = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
         if not _state["enabled"]:
             return
         key = _label_key(labels)
         with self._lock:
             st = self._series.get(key)
             if st is None:
-                st = [0, 0.0, [0] * len(self.buckets)]
+                st = [0, 0.0, [0] * len(self.buckets), None]
                 self._series[key] = st
             st[0] += 1
             st[1] += value
             counts = st[2]
+            idx = len(self.buckets)         # the implicit +Inf bucket
             for i, edge in enumerate(self.buckets):
                 if value <= edge:
                     counts[i] += 1
+                    idx = min(idx, i)
+            if exemplar is not None:
+                if st[3] is None:
+                    st[3] = {}
+                st[3][idx] = {"trace_id": exemplar, "value": value,
+                              "ts": time.time()}
+
+    def exemplars(self, **labels):
+        """{bucket-edge (str, "+Inf" for the overflow bucket):
+        {"trace_id", "value", "ts"}} for one series — the newest
+        exemplar recorded per bucket."""
+        key = _label_key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None or len(st) < 4 or not st[3]:
+                return {}
+            return {self._edge_name(i): dict(ex)
+                    for i, ex in st[3].items()}
+
+    def _edge_name(self, idx):
+        return "+Inf" if idx >= len(self.buckets) \
+            else str(self.buckets[idx])
 
     def count(self, **labels):
         key = _label_key(labels)
@@ -198,6 +232,15 @@ class Histogram(_Instrument):
             return {k: [v[0], v[1], list(v[2])]
                     for k, v in self._series.items()}
 
+    def snapshot_exemplars(self):
+        """{label-tuple: {bucket-edge: exemplar dict}} — only series
+        that actually carry exemplars appear."""
+        with self._lock:
+            return {k: {self._edge_name(i): dict(ex)
+                        for i, ex in v[3].items()}
+                    for k, v in self._series.items()
+                    if len(v) > 3 and v[3]}
+
 
 def _get(cls, name, help, **kwargs):
     with _registry_lock:
@@ -207,6 +250,13 @@ def _get(cls, name, help, **kwargs):
                 raise ValueError(
                     "metric %r already registered as %s, not %s"
                     % (name, inst.kind, cls.kind))
+            want = kwargs.get("buckets")
+            if want is not None and tuple(sorted(want)) != getattr(
+                    inst, "buckets", tuple(sorted(want))):
+                raise ValueError(
+                    "histogram %r already registered with buckets %r; "
+                    "re-registration asked for %r"
+                    % (name, inst.buckets, tuple(sorted(want))))
             return inst
         inst = cls(name, help, **kwargs)
         _registry[name] = inst
@@ -249,12 +299,16 @@ def snapshot():
     out = {}
     for inst in instruments():
         series = {}
+        exemplars = (inst.snapshot_exemplars()
+                     if inst.kind == "histogram" else {})
         for key, val in inst.snapshot().items():
             skey = ",".join("%s=%s" % kv for kv in key)
             if inst.kind == "histogram":
                 series[skey] = {"count": val[0], "sum": val[1],
                                 "buckets": dict(zip(
                                     [str(b) for b in inst.buckets], val[2]))}
+                if key in exemplars:
+                    series[skey]["exemplars"] = exemplars[key]
             else:
                 series[skey] = val
         out[inst.name] = {"kind": inst.kind, "help": inst.help,

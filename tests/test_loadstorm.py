@@ -1,0 +1,201 @@
+"""tools/loadstorm.py: the trace-driven load-storm harness.
+
+- the schedule is a pure function of the spec (same seed => identical
+  replay, the property that makes storm results comparable);
+- the rate curve composes diurnal breathing with flash-crowd bursts;
+- prompt lengths are heavy-tailed but clipped to the spec bounds;
+- a real storm against a TWO-replica in-process gpt fleet yields the
+  SLO report: per-stage percentiles from the fleet-merged histograms
+  (queue / request / TTFT / TPOT / prefill), shed%, goodput, and at
+  least one slow sampled journey stitched from the replicas' /tracez
+  rings;
+- the aggregate scrape's per-member timeout (MXTPU_SCRAPE_TIMEOUT_S)
+  bounds a hung member instead of stalling the walk.
+"""
+
+import math
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, serving, telemetry
+from incubator_mxnet_tpu.generate import export_gpt_for_serving
+from incubator_mxnet_tpu.models.gpt import GPTDecoder
+from incubator_mxnet_tpu.telemetry import aggregate
+from incubator_mxnet_tpu.telemetry import catalog as cat
+from incubator_mxnet_tpu.telemetry import tracing
+from tools import loadstorm
+
+GPT_CFG = dict(vocab_size=64, units=16, num_layers=1, num_heads=2,
+               max_len=96)
+
+
+# ------------------------------------------------------------ schedule
+def test_schedule_is_deterministic_per_seed():
+    spec = loadstorm.default_spec(duration_s=10.0, base_rps=30.0)
+    a = loadstorm.build_schedule(spec)
+    b = loadstorm.build_schedule(spec)
+    assert a == b and len(a) > 50
+    c = loadstorm.build_schedule(dict(spec, seed=8))
+    assert c != a
+
+
+def test_rate_curve_diurnal_and_burst():
+    spec = loadstorm.default_spec(
+        base_rps=10.0, duration_s=100.0,
+        diurnal={"amplitude": 0.5, "period_s": 100.0},
+        bursts=[{"at_frac": 0.5, "duration_frac": 0.1, "mult": 4.0}])
+    assert loadstorm.rate_at(spec, 0.0) == pytest.approx(10.0)
+    assert loadstorm.rate_at(spec, 25.0) == pytest.approx(15.0)  # peak
+    # inside the burst window the diurnal value is multiplied
+    t_burst = 55.0
+    base = 10.0 * (1 + 0.5 * math.sin(2 * math.pi * t_burst / 100.0))
+    assert loadstorm.rate_at(spec, t_burst) == pytest.approx(4.0 * base)
+    assert loadstorm.rate_at(spec, 75.0) == pytest.approx(5.0)   # trough
+
+
+def test_prompt_lengths_are_heavy_tailed_but_clipped():
+    spec = loadstorm.default_spec(duration_s=30.0, base_rps=40.0)
+    sched = loadstorm.build_schedule(spec)
+    lens = [e["prompt_len"] for e in sched if e["kind"] != "encode"]
+    assert lens and min(lens) >= 1
+    caps = {t["name"]: t["prompt_len"]["max"] for t in spec["tenants"]
+            if t["kind"] != "encode"}
+    for e in sched:
+        if e["kind"] != "encode":
+            assert e["prompt_len"] <= caps[e["tenant"]]
+    # heavy tail: the max draw dwarfs the median
+    assert max(lens) >= 4 * sorted(lens)[len(lens) // 2]
+    # every tenant in the mix actually fires
+    assert {e["tenant"] for e in sched} == \
+        {t["name"] for t in spec["tenants"]}
+
+
+# ---------------------------------------------------------- the storm
+@pytest.fixture
+def gpt_fleet(tmp_path):
+    """Two in-process replicas serving one exported gpt checkpoint."""
+    prev_rate = tracing.sample_rate()
+    telemetry.enable()
+    tracing.set_sample_rate(1.0)
+    tracing.clear_spans()
+    for inst in (cat.serving_ttft_seconds, cat.serving_tpot_seconds,
+                 cat.serving_queue_seconds, cat.serving_request_seconds,
+                 cat.gen_prefill_seconds):
+        inst.clear()
+    model = GPTDecoder(prefix="ls_", **GPT_CFG)
+    model.initialize(mx.init.Normal(0.05))
+    model(nd.array(np.zeros((1, 4), np.int32)))
+    ckpt = str(tmp_path / "gpt")
+    export_gpt_for_serving(ckpt, GPT_CFG, model)
+    replicas = []
+    for _ in range(2):
+        srv = serving.ModelServer()
+        srv.load("gpt", directory=ckpt, slots=4,
+                 cache_len=GPT_CFG["max_len"])
+        srv.start()
+        replicas.append(srv)
+    yield replicas
+    for srv in replicas:
+        srv.stop()
+    tracing.set_sample_rate(prev_rate)
+    telemetry.disable()
+
+
+def test_storm_against_two_replicas_emits_the_slo_report(gpt_fleet):
+    spec = loadstorm.default_spec(
+        duration_s=4.0, base_rps=6.0, clients=3, slo_ms=30000.0,
+        bursts=[{"at_frac": 0.5, "duration_frac": 0.2, "mult": 2.0}])
+    spec["tenants"] = [dict(t, model="gpt", max_new=4,
+                            prompt_len=dict(t["prompt_len"], max=24))
+                       for t in spec["tenants"] if t["kind"] != "encode"]
+    spec["slow_traces"] = 2
+    addrs = [srv.addr for srv in gpt_fleet]
+    report = loadstorm.run_storm(addrs, spec, timeout=60.0)
+
+    req = report["requests"]
+    assert req["total"] == req["scheduled"] > 0
+    assert req["ok"] > 0 and report["goodput_rps"] > 0
+    assert report["tokens_generated"] >= 4 * req["ok"] - req["ok"]
+    assert report["client_latency_ms"]["p50"] is not None
+    assert report["client_latency_ms"]["p999"] is not None
+
+    # per-stage percentiles come from the fleet-merged histograms —
+    # the generative stages must all be present and ordered sanely
+    for stage in ("queue", "request", "ttft", "tpot", "prefill"):
+        assert stage in report["stages"], sorted(report["stages"])
+        for ent in report["stages"][stage].values():
+            assert ent["count"] > 0
+            assert ent["p50_ms"] <= ent["p99_ms"] <= ent["p999_ms"]
+
+    # both decode tenants show up with their own latency split
+    assert set(report["tenants"]) == {"chat", "summarize"}
+
+    # >= 1 slow sampled journey, stitched: the timeline text names the
+    # server-side stages, proving the spans came from the fleet rings
+    assert report["slow_traces"], "sampled storm must stitch journeys"
+    slow = report["slow_traces"][0]
+    assert slow["trace_id"] and slow["spans"] >= 3
+    assert "client.decode" in slow["text"]
+    assert "decode.step" in slow["text"]
+
+    # the human render never crashes and carries the headline numbers
+    text = loadstorm.render_report(report)
+    assert "goodput" in text and "slowest sampled journeys" in text
+
+
+# ------------------------------------------- scrape-timeout satellite
+def test_scrape_timeout_bounds_a_hung_member(monkeypatch):
+    """A member that accepts and never answers counts as a scrape error
+    within MXTPU_SCRAPE_TIMEOUT_S — the walk survives and says so."""
+    telemetry.enable()
+    try:
+        hung = socket.socket()
+        hung.bind(("127.0.0.1", 0))
+        hung.listen(4)
+        conns = []
+
+        def sink():
+            while True:
+                try:
+                    c, _ = hung.accept()
+                except OSError:
+                    return
+                conns.append(c)          # hold open, never reply
+
+        t = threading.Thread(target=sink, daemon=True)
+        t.start()
+        monkeypatch.setenv("MXTPU_SCRAPE_TIMEOUT_S", "0.4")
+        assert aggregate.scrape_timeout() == pytest.approx(0.4)
+        addr = "127.0.0.1:%d" % hung.getsockname()[1]
+        t0 = time.monotonic()
+        # no scheduler either: serving-only scrapes tolerate that
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", "1")
+        scrape = aggregate.scrape(serving=[addr])
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, "hung member stalled the scrape"
+        member = next(m for m in scrape["members"]
+                      if m["role"] == "serving")
+        assert member["ok"] is False
+        errs = scrape["registry"]["mxtpu_scrape_errors_total"]["series"]
+        assert errs.get("member=serving:0") == 1
+        hung.close()
+        for c in conns:
+            c.close()
+    finally:
+        telemetry.disable()
+
+
+def test_scrape_timeout_default_and_invalid(monkeypatch):
+    monkeypatch.delenv("MXTPU_SCRAPE_TIMEOUT_S", raising=False)
+    assert aggregate.scrape_timeout() == 5.0
+    monkeypatch.setenv("MXTPU_SCRAPE_TIMEOUT_S", "not-a-number")
+    assert aggregate.scrape_timeout() == 5.0
+    monkeypatch.setenv("MXTPU_SCRAPE_TIMEOUT_S", "-2")
+    assert aggregate.scrape_timeout() == 5.0
+    monkeypatch.setenv("MXTPU_SCRAPE_TIMEOUT_S", "1.5")
+    assert aggregate.scrape_timeout() == 1.5

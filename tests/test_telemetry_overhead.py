@@ -48,6 +48,27 @@ def test_idle_span_is_shared_noop():
     assert _per_call(lambda: telemetry.span("x")) < MAX_SECONDS_PER_CALL
 
 
+def test_sampling_off_request_span_is_cheap_shared_noop():
+    """Head sampling off (MXTPU_TRACE_SAMPLE=0): request_span() is one
+    rate lookup + compare returning the shared null span — no id
+    generation, no allocation, nothing retained. This is the cost every
+    serving request pays when tracing is disabled."""
+    telemetry.disable()
+    prev = tracing.sample_rate()
+    tracing.set_sample_rate(0.0)
+    try:
+        tracing.clear_spans()
+        sp = tracing.request_span("client.infer")
+        assert sp is tracing.NULL_SPAN
+        with sp:
+            pass                       # the null span context is free too
+        assert _per_call(lambda: tracing.request_span("client.infer")) \
+            < MAX_SECONDS_PER_CALL
+        assert tracing.recent_spans() == []
+    finally:
+        tracing.set_sample_rate(prev)
+
+
 def test_enabled_flag_is_single_predicate():
     """The gate the hot paths check is one dict lookup."""
     telemetry.disable()

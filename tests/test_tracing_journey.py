@@ -1,0 +1,327 @@
+"""Round-17 acceptance: request-journey tracing.
+
+Pins the observability contract end to end:
+
+- head sampling (MXTPU_TRACE_SAMPLE) decides at the trace HEAD and the
+  decision rides the rpc meta — downstream hops never re-flip;
+- retroactive record_span() writes the queue/join regions schedulers
+  only know after the fact;
+- build_timeline() is tolerant by construction (duplicate span ids,
+  orphan parent ids, empty input) and merge_traces() of chrome dumps
+  dedups shipped spans;
+- latency histograms carry per-bucket exemplars (a recent sampled
+  trace id) and per-instrument bucket edges conflict loudly;
+- ONE trace id stitches client → batcher → decode loop with queue /
+  join / prefill / decode-step spans, and TTFT / per-token TPOT
+  derived from the spans alone match the histogram observations —
+  in-process first, then the two-process drill over the wire.
+"""
+
+import json
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, serving, telemetry
+from incubator_mxnet_tpu.generate import export_gpt_for_serving
+from incubator_mxnet_tpu.models.gpt import GPTDecoder
+from incubator_mxnet_tpu.telemetry import catalog as cat
+from incubator_mxnet_tpu.telemetry import metrics as tm
+from incubator_mxnet_tpu.telemetry import tracing
+
+GPT_CFG = dict(vocab_size=37, units=16, num_layers=1, num_heads=2,
+               max_len=64)
+
+
+@pytest.fixture
+def sampled_telemetry():
+    """Telemetry on, every request head-sampled, clean rings."""
+    prev_rate = tracing.sample_rate()
+    telemetry.enable()
+    tracing.set_sample_rate(1.0)
+    tracing.clear_spans()
+    for inst in (cat.serving_ttft_seconds, cat.serving_tpot_seconds,
+                 cat.serving_queue_seconds, cat.serving_request_seconds,
+                 cat.gen_prefill_seconds):
+        inst.clear()
+    yield
+    tracing.set_sample_rate(prev_rate)
+    telemetry.disable()
+
+
+# ------------------------------------------------------------ sampling
+def test_sample_rate_clamped_and_zero_is_null_span():
+    prev = tracing.sample_rate()
+    try:
+        assert tracing.set_sample_rate(7.0) == 1.0
+        assert tracing.set_sample_rate(-3.0) == 0.0
+        assert tracing.request_span("client.infer") is tracing.NULL_SPAN
+        tracing.set_sample_rate(1.0)
+        sp = tracing.request_span("client.infer", model="m")
+        assert sp.sampled and sp.trace_id and sp.parent_id is None
+    finally:
+        tracing.set_sample_rate(prev)
+
+
+def test_sampled_flag_rides_the_rpc_meta():
+    prev = tracing.sample_rate()
+    tracing.set_sample_rate(1.0)
+    try:
+        with tracing.request_span("client.infer") as sp:
+            meta = tracing.inject({"op": "serve.infer"})
+        assert meta[tracing.TRACE_KEY] == sp.trace_id
+        assert meta[tracing.PARENT_KEY] == sp.span_id
+        assert meta[tracing.SAMPLED_KEY] == 1
+        child = tracing.from_meta("rpc.serve.infer", meta)
+        assert child.sampled is True
+        assert child.trace_id == sp.trace_id
+        assert child.parent_id == sp.span_id
+    finally:
+        tracing.set_sample_rate(prev)
+
+
+def test_unsampled_root_does_not_stamp_sampled():
+    with tracing.span("client.infer"):     # plain span: active, unsampled
+        meta = tracing.inject({})
+    assert tracing.SAMPLED_KEY not in meta
+    assert tracing.from_meta("rpc.x", {}) is tracing.NULL_SPAN
+
+
+def test_record_span_retroactive_lands_in_the_ring():
+    tracing.clear_spans()
+    t1 = time.time()
+    rec = tracing.record_span("serve.queue", "tid123", parent_id="p1",
+                              t0=t1 - 0.25, t1=t1, sampled=True,
+                              model="m")
+    assert rec["trace_id"] == "tid123" and rec["parent_id"] == "p1"
+    assert abs(rec["dur_us"] - 250_000) < 1_000
+    got = tracing.spans_for_trace("tid123")
+    assert [s["name"] for s in got] == ["serve.queue"]
+    assert got[0]["model"] == "m" and got[0]["sampled"] is True
+
+
+# ----------------------------------------------------------- timelines
+def test_build_timeline_empty_input():
+    tl = tracing.build_timeline([])
+    assert tl["spans"] == [] and tl["roots"] == []
+    assert tl["start_us"] is None and tl["duration_us"] == 0.0
+
+
+def test_build_timeline_duplicate_span_ids_collapse():
+    s = {"name": "a", "trace_id": "t", "span_id": "s1", "ts_us": 0.0,
+         "dur_us": 10.0}
+    tl = tracing.build_timeline([s, dict(s), dict(s, name="shadow")])
+    assert len(tl["spans"]) == 1 and tl["spans"][0]["name"] == "a"
+    assert len(tl["roots"]) == 1
+
+
+def test_build_timeline_orphan_parent_becomes_root():
+    spans = [
+        {"name": "root", "trace_id": "t", "span_id": "r", "ts_us": 0.0,
+         "dur_us": 100.0},
+        {"name": "child", "trace_id": "t", "span_id": "c",
+         "parent_id": "r", "ts_us": 10.0, "dur_us": 20.0},
+        {"name": "orphan", "trace_id": "t", "span_id": "o",
+         "parent_id": "missing", "ts_us": 30.0, "dur_us": 5.0},
+    ]
+    tl = tracing.build_timeline(spans, trace_id="t")
+    assert sorted(n["name"] for n in tl["roots"]) == ["orphan", "root"]
+    root = next(n for n in tl["roots"] if n["name"] == "root")
+    assert [c["name"] for c in root["children"]] == ["child"]
+    # the render never crashes on the orphan and names every span
+    text = tracing.render_timeline(tl)
+    for name in ("root", "child", "orphan"):
+        assert name in text
+
+
+def test_merge_traces_empty_inputs_and_span_dedup(tmp_path):
+    out = str(tmp_path / "merged.json")
+    assert tracing.merge_traces([], out) == []
+    assert json.load(open(out))["traceEvents"] == []
+
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    pc = tmp_path / "c.json"
+    ev = {"name": "serve.queue", "ph": "X", "ts": 1, "dur": 2,
+          "args": {"span_id": "dup"}}
+    pa.write_text(json.dumps({"traceEvents": [ev, {"name": "other",
+                                                   "ph": "X", "ts": 5}]}))
+    pb.write_text(json.dumps({"traceEvents": [dict(ev)]}))   # same span
+    pc.write_text(json.dumps({"not_a_trace": True}))         # no events
+    merged = tracing.merge_traces([str(pa), str(pb), str(pc)], out)
+    assert sorted(e["name"] for e in merged) == ["other", "serve.queue"]
+    # per-input pid separation survives the merge
+    assert {e["pid"] for e in merged} == {0}
+
+
+# ----------------------------------------------------------- exemplars
+def test_histogram_exemplars_per_bucket_and_snapshot():
+    telemetry.enable()
+    try:
+        h = tm.histogram("journey_ex_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="tid_fast", model="m")
+        h.observe(0.5, model="m")                  # no exemplar: kept
+        h.observe(42.0, exemplar="tid_slow", model="m")
+        ex = h.exemplars(model="m")
+        assert ex["0.1"]["trace_id"] == "tid_fast"
+        assert ex["+Inf"]["trace_id"] == "tid_slow"
+        assert ex["+Inf"]["value"] == 42.0
+        snap = tm.snapshot()["journey_ex_seconds"]["series"]["model=m"]
+        assert snap["count"] == 3
+        assert snap["exemplars"]["0.1"]["trace_id"] == "tid_fast"
+    finally:
+        telemetry.disable()
+
+
+def test_histogram_bucket_conflict_raises_and_same_buckets_reuse():
+    h = tm.histogram("journey_buckets_seconds", buckets=(0.5, 2.0))
+    assert tm.histogram("journey_buckets_seconds") is h
+    assert tm.histogram("journey_buckets_seconds",
+                        buckets=(2.0, 0.5)) is h    # order-insensitive
+    with pytest.raises(ValueError, match="bucket"):
+        tm.histogram("journey_buckets_seconds", buckets=(0.1, 9.0))
+
+
+# ------------------------------------------------- in-process journey
+def _tiny_gpt_ckpt(directory):
+    model = GPTDecoder(prefix="tj_", **GPT_CFG)
+    model.initialize(mx.init.Normal(0.05))
+    model(nd.array(np.zeros((1, 4), np.int32)))
+    export_gpt_for_serving(directory, GPT_CFG, model)
+
+
+def _journey(spans, trace_id):
+    by_name = {}
+    for s in spans:
+        if s.get("trace_id") == trace_id:
+            by_name.setdefault(s["name"], []).append(s)
+    return by_name
+
+
+def test_one_trace_spans_queue_join_prefill_decode_and_matches_histograms(
+        tmp_path, sampled_telemetry):
+    """THE acceptance drill, in-process: one sampled decode request
+    leaves a single trace id whose spans alone yield TTFT and TPOT —
+    and those numbers agree with the serving_ttft/tpot histograms."""
+    ckpt = str(tmp_path / "gpt")
+    _tiny_gpt_ckpt(ckpt)
+    srv = serving.ModelServer()
+    srv.load("gpt", directory=ckpt, slots=2, cache_len=64)
+    srv.start()
+    client = serving.ServingClient(srv.addr)
+    try:
+        prompt = np.array([3, 5, 7, 2, 11, 1], np.int32)
+        out = client.decode("gpt", prompt, max_new_tokens=4)
+        assert out.shape == (4,)
+        tid = client.last_trace_id
+        assert tid, "head-sampled request must expose its trace id"
+
+        spans = tracing.spans_for_trace(tid)
+        names = _journey(spans, tid)
+        for required in ("client.decode", "serve.queue", "serve.join",
+                         "decode.prefill", "decode.step"):
+            assert required in names, (required, sorted(names))
+        assert len(names["decode.step"]) == 4
+        committed = sum(s.get("tokens_committed", 0)
+                        for s in names["decode.step"])
+        assert committed == 4
+        assert names["decode.prefill"][0]["prefill_tokens"] == \
+            prompt.size - 1
+
+        # every span of the journey is one stitched tree under the
+        # client root — no second root, no foreign trace ids
+        tl = tracing.build_timeline(spans, trace_id=tid)
+        assert [r["name"] for r in tl["roots"]] == ["client.decode"]
+        assert {s["trace_id"] for s in tl["spans"]} == {tid}
+
+        # TTFT from spans alone: queue start (arrival) -> first
+        # decode.step end; must match the histogram observation
+        steps = sorted(names["decode.step"], key=lambda s: s["ts_us"])
+        arrival_us = names["serve.queue"][0]["ts_us"]
+        ttft_span = (steps[0]["ts_us"] + steps[0]["dur_us"]
+                     - arrival_us) / 1e6
+        assert cat.serving_ttft_seconds.count(model="gpt") == 1
+        ttft_hist = cat.serving_ttft_seconds.sum(model="gpt")
+        assert abs(ttft_span - ttft_hist) < 0.2, (ttft_span, ttft_hist)
+
+        # TPOT from spans alone: mean inter-step gap vs histogram mean
+        n_gaps = cat.serving_tpot_seconds.count(model="gpt")
+        assert n_gaps == 3                         # 4 tokens -> 3 gaps
+        tpot_hist = cat.serving_tpot_seconds.sum(model="gpt") / n_gaps
+        ends = [s["ts_us"] + s["dur_us"] for s in steps]
+        tpot_span = (ends[-1] - ends[0]) / 3 / 1e6
+        assert abs(tpot_span - tpot_hist) < 0.1, (tpot_span, tpot_hist)
+
+        # the TTFT exemplar points back at this journey
+        ex = cat.serving_ttft_seconds.exemplars(model="gpt")
+        assert any(e["trace_id"] == tid for e in ex.values())
+    finally:
+        client.close()
+        srv.stop()
+
+
+# ------------------------------------------------- two-process drill
+def _gpt_server_proc(ckpt_dir, q, stop_evt):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from incubator_mxnet_tpu import serving as sv
+    from incubator_mxnet_tpu import telemetry as tel
+    try:
+        tel.enable()
+        _tiny_gpt_ckpt(ckpt_dir)
+        srv = sv.ModelServer()
+        srv.load("gpt", directory=ckpt_dir, slots=2, cache_len=64)
+        srv.start()
+        q.put(("ok", list(srv.addr)))
+        stop_evt.wait(120)
+        srv.stop()
+    except Exception as e:  # noqa: BLE001 — surface to the test
+        import traceback
+        q.put(("error", "%s\n%s" % (e, traceback.format_exc())))
+
+
+def test_two_process_drill_one_stitched_trace_over_the_wire(
+        tmp_path, sampled_telemetry):
+    """Client here, fleet there: the sampled decision propagates over
+    rpc, the server keeps the journey in its /tracez ring, and client
+    + fetched spans stitch into ONE timeline under the client root."""
+    ctx = mp.get_context("spawn")
+    q, stop_evt = ctx.Queue(), ctx.Event()
+    proc = ctx.Process(target=_gpt_server_proc,
+                       args=(str(tmp_path / "gpt"), q, stop_evt))
+    proc.start()
+    try:
+        status, info = q.get(timeout=120)
+        if status != "ok":
+            pytest.fail("server process failed to start:\n%s" % info)
+        client = serving.ServingClient(tuple(info), timeout=60.0)
+        try:
+            out = client.decode("gpt", np.array([3, 5, 7, 2], np.int32),
+                                max_new_tokens=3)
+            assert out.shape == (3,)
+            tid = client.last_trace_id
+            assert tid
+
+            fetched = client.tracez(trace_id=tid)
+            spans = list(fetched["spans"]) + tracing.spans_for_trace(tid)
+            tl = tracing.build_timeline(spans, trace_id=tid)
+            assert [r["name"] for r in tl["roots"]] == ["client.decode"]
+            assert {s["trace_id"] for s in tl["spans"]} == {tid}
+            got = {s["name"] for s in tl["spans"]}
+            for required in ("client.decode", "serve.queue",
+                             "decode.prefill", "decode.step"):
+                assert required in got, (required, sorted(got))
+            # server-side spans really came over the wire, not from
+            # this process's ring
+            assert any(s["name"] == "decode.step"
+                       for s in fetched["spans"])
+        finally:
+            client.close()
+    finally:
+        stop_evt.set()
+        proc.join(20)
+        if proc.is_alive():
+            proc.terminate()

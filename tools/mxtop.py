@@ -5,9 +5,12 @@ Walks the scheduler's membership view via telemetry.aggregate.scrape()
 once per interval and renders per-member rates: kvstore push bytes/s,
 rpc retries, compile seconds, guardian skips, membership epoch, and —
 for model servers passed with --serving — QPS, p99 latency, batch
-occupancy, shed counts, and (for generative families) committed
-tokens/sec plus the speculative-decode accept-rate. Counters are
-turned into rates by diffing consecutive scrapes.
+occupancy, shed counts, the generative LATENCY column set (TTFT
+p50/p99 and per-token TPOT p99 in ms, from the fleet-merged
+mxtpu_serving_ttft_seconds / mxtpu_serving_tpot_seconds histograms),
+and (for generative families) committed tokens/sec plus the
+speculative-decode accept-rate. Counters are turned into rates by
+diffing consecutive scrapes.
 
 With --stream (or MXTPU_STREAM_ADDR) the frame adds an input-plane
 rollup — records/s, shard reassignments, quarantined shards, fetch-wait
@@ -18,6 +21,25 @@ recordio resync/quarantine counters.
     python tools/mxtop.py --scheduler host:port --serving host:port
     python tools/mxtop.py --stream host:port   # + data-plane rollup
     python tools/mxtop.py --once               # one frame, no clearing
+    python tools/mxtop.py --once --json        # raw scrape, see below
+
+--once --json prints the raw scrape dict instead of the rendered frame,
+the stable machine interface scripts should parse:
+
+    {"epoch": int | null,            # PS membership epoch
+     "quorum": bool | null,
+     "members": [{"role": str, "rank": int|str, "addr": "host:port",
+                  "ok": bool, "error": str (only when not ok)}],
+     "registry": {metric_name: {"kind": "counter"|"gauge"|"histogram",
+                                "help": str,
+                                "series": {labels: value}}}}
+
+Every series key is prefixed "role=...,rank=..." (the member it came
+from) followed by the instrument's own labels. Counter/gauge values are
+numbers; histogram values are {"count", "sum", "buckets": {edge:
+cumulative_count}} and, when a head-sampled request landed in a bucket,
+"exemplars": {edge: {"trace_id", "value", "ts"}} — that trace_id keys
+straight into the member's /tracez?trace_id= journey lookup.
 """
 
 import argparse
@@ -49,6 +71,24 @@ def _series_sum(registry, name, where=None):
 
 def _member_key(role, rank):
     return "role=%s,rank=%s" % (role, rank)
+
+
+def _merged_quantile(registry, name, where, q):
+    """Quantile over ONE logical histogram merged across every member's
+    matching series (bucket-wise sum — replicas of a model each carry
+    their own series in the role/rank-prefixed registry)."""
+    merged = {"count": 0, "sum": 0.0, "buckets": {}}
+    for skey, sval in ((registry.get(name) or {}).get("series")
+                       or {}).items():
+        if where not in skey or not isinstance(sval, dict):
+            continue
+        merged["count"] += sval.get("count", 0)
+        merged["sum"] += sval.get("sum", 0.0)
+        for edge, c in (sval.get("buckets") or {}).items():
+            merged["buckets"][edge] = merged["buckets"].get(edge, 0) + c
+    if not merged["count"]:
+        return None
+    return aggregate.hist_quantile(merged, q)
 
 
 def _rates(prev, cur, elapsed):
@@ -109,10 +149,9 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
                      if "model=" in seg})
     if models:
         lines.append("")
-        lines.append("%-16s %8s %9s %10s %7s %9s %6s"
-                     % ("MODEL", "QPS", "p99 ms", "OCCUPANCY", "SHED",
-                        "TOK/s", "ACC%"))
-        lat = reg.get("mxtpu_serving_request_seconds") or {}
+        lines.append("%-16s %8s %9s %8s %8s %8s %10s %7s %9s %6s"
+                     % ("MODEL", "QPS", "p99 ms", "TTFT50", "TTFT99",
+                        "TPOT99", "OCCUPANCY", "SHED", "TOK/s", "ACC%"))
         occ = reg.get("mxtpu_serving_batch_occupancy") or {}
         for model in models:
             sel = "model=%s" % model
@@ -123,10 +162,17 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
                           prev_totals.get("serve/%s/ok" % model, 0.0)},
                          {("serve/%s/ok" % model): ok},
                          elapsed)["serve/%s/ok" % model]
-            p99 = occ_mean = None
-            for skey, sval in (lat.get("series") or {}).items():
-                if sel in skey:
-                    p99 = aggregate.hist_quantile(sval, 0.99)
+            p99 = _merged_quantile(reg, "mxtpu_serving_request_seconds",
+                                   sel, 0.99)
+            # generative LATENCY set: time-to-first-token and per-token
+            # gap, merged across replicas; "-" for encoder-only models
+            ttft50 = _merged_quantile(reg, "mxtpu_serving_ttft_seconds",
+                                      sel, 0.5)
+            ttft99 = _merged_quantile(reg, "mxtpu_serving_ttft_seconds",
+                                      sel, 0.99)
+            tpot99 = _merged_quantile(reg, "mxtpu_serving_tpot_seconds",
+                                      sel, 0.99)
+            occ_mean = None
             for skey, sval in (occ.get("series") or {}).items():
                 if sel in skey and isinstance(sval, dict) \
                         and sval.get("count"):
@@ -146,9 +192,12 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
             accepted = _series_sum(reg, "mxtpu_gen_spec_accepted_total",
                                    where=sel)
             acc = 100.0 * accepted / proposed if proposed else None
-            lines.append("%-16s %8.1f %9s %10s %7.0f %9s %6s"
-                         % (model, qps,
-                            "%.1f" % (p99 * 1e3) if p99 is not None else "-",
+
+            def _ms(v):
+                return "%.1f" % (v * 1e3) if v is not None else "-"
+            lines.append("%-16s %8.1f %9s %8s %8s %8s %10s %7.0f %9s %6s"
+                         % (model, qps, _ms(p99),
+                            _ms(ttft50), _ms(ttft99), _ms(tpot99),
                             "%.1f" % occ_mean if occ_mean is not None
                             else "-", shed,
                             "%.0f" % tok_rate if tok_rate is not None
@@ -228,7 +277,8 @@ def main(argv=None):
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit")
     ap.add_argument("--json", action="store_true",
-                    help="with --once: print the raw scrape as JSON")
+                    help="with --once: print the raw scrape as JSON "
+                         "(stable schema — see the module docstring)")
     args = ap.parse_args(argv)
 
     prev_totals, prev_ts = {}, None
