@@ -5,7 +5,11 @@ unknown ops, duplicate/dangling arguments, unresolvable shapes/dtypes,
 float64 on TPU, MXU tiling diagnostics — over the existing ``_topo`` /
 ``_infer_walk`` machinery and return ``Finding`` records. Level 2 (the
 AST linter over the framework's own Python) lives in ``tools/mxlint.py``
-and shares the same ``Finding`` type and suppression model.
+and shares the same ``Finding`` type and suppression model. Level 3
+(``concurrency.py``) is the interprocedural concurrency pass over the
+whole package: lock-order cycles, locks held across blocking
+operations, bare writes to guarded state, orphan daemon threads — the
+static half of fleetlock (the runtime half is ``telemetry/lockdep.py``).
 
 See docs/ANALYSIS.md for the rule catalog, suppression syntax
 (``__lint_disable__`` node attr / ``# mxlint: disable=...`` comments), and
@@ -13,10 +17,15 @@ how to add a rule.
 """
 
 from .core import (Finding, Pass, GraphContext, graph_rule, GRAPH_RULES,
-                   SEVERITIES, analyze, analyze_json, format_findings)
+                   SEVERITIES, analyze, analyze_json, format_findings,
+                   parse_suppressions)
 from . import graph_rules  # noqa: F401 — populate GRAPH_RULES
 from .graph_rules import MXU_OPS, min_tile
+from .concurrency import (CONCURRENCY_RULES, analyze_sources,
+                          analyze_package, class_bare_writes)
 
 __all__ = ["Finding", "Pass", "GraphContext", "graph_rule", "GRAPH_RULES",
            "SEVERITIES", "analyze", "analyze_json", "format_findings",
-           "MXU_OPS", "min_tile"]
+           "parse_suppressions", "MXU_OPS", "min_tile",
+           "CONCURRENCY_RULES", "analyze_sources", "analyze_package",
+           "class_bare_writes"]

@@ -1,0 +1,1140 @@
+"""Cross-module concurrency analysis (level 3 of graphlint): the static
+prong of fleetlock.
+
+The fleet is a deeply threaded system — rpc client/server threads, the
+batcher/decode worker loops, drain/swap state machines, stream workers,
+the watchdog, the telemetry flusher — sharing locks across dozens of
+modules.  This pass is the moral equivalent of Linux lockdep run at
+review time instead of runtime:
+
+- **ownership inference**: every ``threading.Lock/RLock/Condition`` a
+  class (or module) owns, and every method that acquires it — via
+  ``with self._lock:``, ``self._lock.acquire()``, or a ``Condition``
+  wrapping it.  ``tools/mxlint.py``'s ``lock-discipline`` rule consumes
+  the same inference (``class_bare_writes``) so the two levels cannot
+  disagree about what counts as a guarded class.
+- **lock-order-cycle**: the cross-class lock-acquisition graph — an
+  edge A→B whenever B is acquired (directly, or transitively through a
+  resolvable ``self.x.method()`` / module-call chain) while A is held —
+  reported as graph cycles with every acquisition site blamed.
+- **lock-held-blocking**: a lock held across an operation that can
+  block indefinitely — rpc send/recv, socket ops, ``queue.get/put``
+  without timeout, ``time.sleep``, ``block_until_ready`` / host syncs,
+  subprocess waits, unbounded joins — directly or through a resolvable
+  call chain.  ``Condition.wait`` is exempt for its *own* lock (wait
+  releases it) but still blocks any *other* lock held.
+- **orphan-daemon-thread**: a daemon thread started with no join or
+  retained handle — invisible shutdown-ordering hazards.
+
+The interprocedural half is deliberately best-effort: call edges are
+resolved through ``self.method()``, typed ``self.attr.method()`` (the
+attr was assigned ``SomeClass(...)``), bare/module-qualified calls and
+package-relative imports.  Unresolvable receivers fall back to a small
+name-based registry of known-blocking methods (``.call`` /
+``.call_idempotent`` — the rpc fabric).  False positives are expected
+to be annotated, not silenced: ``# mxlint: disable=<rule> — <why>``.
+
+Run via ``tools/mxlint.py`` (package gate), ``analyze_package()``
+(diagnose.py / tests), or per-rule through ``--rules``.  The runtime
+prong — the lockdep witness that checks the same two invariants on the
+live fleet — is ``telemetry/lockdep.py``.
+"""
+
+import ast
+import os
+
+from .core import Finding, parse_suppressions
+
+__all__ = ["CONCURRENCY_RULES", "ConcurrencyRule", "analyze_sources",
+           "analyze_package", "class_bare_writes", "lock_attrs_of_class",
+           "LOCK_CTORS"]
+
+# shared with tools/mxlint.py's lock-discipline rule: what constructs a
+# lock.  Condition is a lock owner too — ``with self._cond:`` guards
+# state exactly like ``with self._lock:`` (PR 2's private heuristic
+# missed it, leaving the batcher/decode classes unchecked).
+LOCK_CTORS = ("Lock", "RLock", "Condition")
+
+_BOUND_KWS = ("timeout",)
+
+# attribute-call names that block regardless of receiver type: socket
+# primitives and the rpc fabric's connection calls (connections ride in
+# dicts/lists, untypeable statically)
+_BLOCKING_ATTR_CALLS = {
+    "sendall": "socket sendall",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "recvfrom": "socket recv",
+    "accept": "socket accept",
+    "makefile": "socket makefile",
+    "call": "rpc call",
+    "call_idempotent": "rpc call",
+    "communicate": "subprocess communicate",
+    "block_until_ready": "device sync",
+    "asnumpy": "device->host sync",
+    "asscalar": "device->host sync",
+}
+
+# module-qualified calls that block
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "subprocess.run": "subprocess wait",
+    "subprocess.call": "subprocess wait",
+    "subprocess.check_call": "subprocess wait",
+    "subprocess.check_output": "subprocess wait",
+    "socket.create_connection": "socket connect",
+    "jax.device_put": "device transfer",
+    "jax.device_get": "device transfer",
+}
+
+# bare function names that block (resolved through imports when
+# possible; these names are distinctive enough to stand alone)
+_BLOCKING_NAMES = {
+    "send_msg": "rpc send",
+    "recv_msg": "rpc recv",
+}
+
+
+def _last_name(fn):
+    """Trailing identifier of a call target: Name id or Attribute attr."""
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node):
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _ctor_kind(value):
+    """'lock'/'rlock'/'condition' when ``value`` constructs one."""
+    if not isinstance(value, ast.Call):
+        return None
+    last = _last_name(value.func)
+    if last == "Lock":
+        return "lock"
+    if last == "RLock":
+        return "rlock"
+    if last == "Condition":
+        return "condition"
+    return None
+
+
+def _kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _has_finite_timeout(call):
+    """True when the call carries a bounding timeout/block argument."""
+    for kwname in _BOUND_KWS:
+        v = _kwarg(call, kwname)
+        if v is not None and not (isinstance(v, ast.Constant)
+                                  and v.value is None):
+            return True
+    bl = _kwarg(call, "block")
+    if bl is not None and isinstance(bl, ast.Constant) and bl.value is False:
+        return True
+    return False
+
+
+class _LockInfo:
+    __slots__ = ("attr", "kind", "line", "cond_of")
+
+    def __init__(self, attr, kind, line, cond_of=None):
+        self.attr = attr
+        self.kind = kind
+        self.line = line
+        self.cond_of = cond_of    # Condition(self.X) aliases lock attr X
+
+
+class _ThreadInfo:
+    __slots__ = ("attr", "node", "daemon", "started", "joined")
+
+    def __init__(self, attr, node):
+        self.attr = attr
+        self.node = node
+        self.daemon = False
+        self.started = None       # the .start() call node
+        self.joined = False
+
+
+class _FuncInfo:
+    __slots__ = ("name", "qual", "node", "module", "cls",
+                 "acquires", "calls", "prims", "nested")
+
+    def __init__(self, name, qual, node, module, cls):
+        self.name = name
+        self.qual = qual
+        self.node = node
+        self.module = module
+        self.cls = cls
+        self.acquires = []        # (lock_id, node, held tuple)
+        self.calls = []           # (ref, node, held tuple)
+        self.prims = []           # (desc, node, held tuple, exempt lock_id)
+        self.nested = []
+
+
+class _ClassInfo:
+    __slots__ = ("name", "node", "module", "locks", "attr_types",
+                 "threads", "methods")
+
+    def __init__(self, name, node, module):
+        self.name = name
+        self.node = node
+        self.module = module
+        self.locks = {}           # attr -> _LockInfo
+        self.attr_types = {}      # attr -> ("class", classname) | ("queue",)
+                                  #         | ("event",) | ("socket",)
+        self.threads = {}         # attr -> _ThreadInfo
+        self.methods = {}         # name -> _FuncInfo
+
+
+class _ModuleInfo:
+    __slots__ = ("name", "path", "tree", "imports", "locks", "functions",
+                 "classes", "src")
+
+    def __init__(self, name, path, tree, src):
+        self.name = name
+        self.path = path
+        self.tree = tree
+        self.src = src
+        self.imports = {}         # local alias -> ("module", name) |
+                                  #                ("symbol", modname, sym)
+        self.locks = {}           # module-level name -> _LockInfo
+        self.functions = {}       # name -> _FuncInfo
+        self.classes = {}         # name -> _ClassInfo
+
+
+def _fmt_lock(lock_id):
+    mod, cls, attr = lock_id
+    own = "%s.%s" % (cls, attr) if cls else attr
+    return "%s:%s" % (mod, own)
+
+
+class Program:
+    """The whole-package model: modules, classes, lock inventory, and
+    the per-function acquire/call/blocking event streams the rules walk."""
+
+    def __init__(self):
+        self.modules = {}         # module name -> _ModuleInfo
+        self._mod_by_tail = {}    # last path component -> [module names]
+        self._may_block = {}
+        self._may_acquire = {}
+
+    # -- construction ----------------------------------------------------
+    def add_source(self, path, src, module_name=None):
+        if module_name is None:
+            base = os.path.basename(path)
+            module_name = base[:-3] if base.endswith(".py") else base
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return None     # mxlint's syntax-error finding owns this
+        mod = _ModuleInfo(module_name, path, tree, src)
+        self.modules[module_name] = mod
+        self._mod_by_tail.setdefault(
+            module_name.rsplit(".", 1)[-1], []).append(module_name)
+        return mod
+
+    def build(self):
+        for mod in self.modules.values():
+            self._collect_module(mod)
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                for fi in cls.methods.values():
+                    self._scan_function(fi)
+            for fi in mod.functions.values():
+                self._scan_function(fi)
+
+    # -- module / class collection ---------------------------------------
+    def _collect_module(self, mod):
+        for st in mod.tree.body:
+            if isinstance(st, ast.Import):
+                for al in st.names:
+                    mod.imports[al.asname or al.name.split(".")[0]] = \
+                        ("module", al.name)
+            elif isinstance(st, ast.ImportFrom):
+                src = st.module or ""
+                for al in st.names:
+                    local = al.asname or al.name
+                    # ``from . import rpc`` -> rpc is a module alias
+                    if self._resolve_module(al.name) is not None:
+                        mod.imports[local] = ("module", al.name)
+                    else:
+                        mod.imports[local] = ("symbol", src, al.name)
+            elif isinstance(st, ast.Assign):
+                kind = _ctor_kind(st.value)
+                if kind:
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            cond_of = None
+                            if kind == "condition" and st.value.args and \
+                                    isinstance(st.value.args[0], ast.Name):
+                                cond_of = st.value.args[0].id
+                            mod.locks[t.id] = _LockInfo(
+                                t.id, kind, st.lineno, cond_of)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[st.name] = _FuncInfo(
+                    st.name, st.name, st, mod, None)
+            elif isinstance(st, ast.ClassDef):
+                ci = _ClassInfo(st.name, st, mod)
+                mod.classes[st.name] = ci
+                self._collect_class(ci)
+
+    def _collect_class(self, ci):
+        for st in ci.node.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[st.name] = _FuncInfo(
+                    st.name, "%s.%s" % (ci.name, st.name), st,
+                    ci.module, ci)
+        # phase 1: attribute inference over every method body
+        for m in ci.methods.values():
+            for n in ast.walk(m.node):
+                if isinstance(n, ast.Assign):
+                    self._infer_attr_assign(ci, n)
+        # phase 2: thread start/join detection — including joins through
+        # a local alias (``t = self._thread; t.join()``, the idiom when
+        # the attr is cleared after the join)
+        for m in ci.methods.values():
+            aliases = {}          # local name -> thread attr
+            for n in ast.walk(m.node):
+                if isinstance(n, ast.Assign):
+                    a = _self_attr(n.value)
+                    if a in ci.threads:
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                aliases[t.id] = a
+            for n in ast.walk(m.node):
+                if not (isinstance(n, ast.Call) and
+                        isinstance(n.func, ast.Attribute)):
+                    continue
+                recv = n.func.value
+                a = _self_attr(recv)
+                if a is None and isinstance(recv, ast.Name):
+                    a = aliases.get(recv.id)
+                if a in ci.threads:
+                    if n.func.attr == "start":
+                        ci.threads[a].started = n
+                    elif n.func.attr == "join":
+                        ci.threads[a].joined = True
+
+    def _infer_attr_assign(self, ci, n):
+        attr = None
+        for t in n.targets:
+            a = _self_attr(t)
+            if a:
+                attr = a
+        if attr is None:
+            # ``self.X.daemon = True``
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    a = _self_attr(t.value)
+                    if a and a in ci.threads and \
+                            isinstance(n.value, ast.Constant) and \
+                            n.value.value is True:
+                        ci.threads[a].daemon = True
+            return
+        kind = _ctor_kind(n.value)
+        if kind:
+            cond_of = None
+            if kind == "condition" and isinstance(n.value, ast.Call) and \
+                    n.value.args:
+                cond_of = _self_attr(n.value.args[0])
+            ci.locks[attr] = _LockInfo(attr, kind, n.lineno, cond_of)
+            return
+        if not isinstance(n.value, ast.Call):
+            return
+        last = _last_name(n.value.func)
+        if last == "Thread":
+            ti = _ThreadInfo(attr, n)
+            d = _kwarg(n.value, "daemon")
+            if isinstance(d, ast.Constant) and d.value is True:
+                ti.daemon = True
+            ci.threads[attr] = ti
+        elif last in ("Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"):
+            ci.attr_types[attr] = ("queue",)
+        elif last == "Event":
+            ci.attr_types[attr] = ("event",)
+        elif last in ("socket", "create_connection"):
+            ci.attr_types[attr] = ("socket",)
+        elif last is not None and last[:1].isupper():
+            ci.attr_types[attr] = ("class", last)
+
+    # -- lock identity ---------------------------------------------------
+    def _canon_lock(self, mod, cls, attr):
+        """Canonical lock id; a Condition wrapping another owned lock
+        collapses onto the wrapped lock (they serialize identically)."""
+        seen = set()
+        while True:
+            if cls is not None:
+                info = cls.locks.get(attr)
+            else:
+                info = mod.locks.get(attr)
+            if info is None or info.cond_of is None or \
+                    info.cond_of in seen:
+                break
+            seen.add(attr)
+            attr = info.cond_of
+        return (mod.name, cls.name if cls is not None else None, attr)
+
+    def _lock_of_expr(self, expr, fi):
+        """lock_id for ``self._lock`` / module ``_lock`` context exprs."""
+        a = _self_attr(expr)
+        if a is not None and fi.cls is not None and a in fi.cls.locks:
+            return self._canon_lock(fi.module, fi.cls, a)
+        if isinstance(expr, ast.Name) and expr.id in fi.module.locks:
+            return self._canon_lock(fi.module, None, expr.id)
+        return None
+
+    def _lock_kind(self, lock_id):
+        mod = self.modules.get(lock_id[0])
+        if mod is None:
+            return "lock"
+        if lock_id[1] is not None:
+            cls = mod.classes.get(lock_id[1])
+            info = cls.locks.get(lock_id[2]) if cls else None
+        else:
+            info = mod.locks.get(lock_id[2])
+        return info.kind if info else "lock"
+
+    # -- per-function event scan -----------------------------------------
+    def _scan_function(self, fi):
+        self._scan_body(fi.node.body, (), fi)
+
+    def _scan_body(self, stmts, held, fi):
+        manual = []               # (lock_id, node) held via .acquire()
+        for st in stmts:
+            cur = held + tuple(m[0] for m in manual)
+            acq = self._acquire_release_stmt(st, fi)
+            if acq is not None:
+                lock_id, mode, node = acq
+                if mode == "acquire":
+                    fi.acquires.append((lock_id, node, cur))
+                    manual.append((lock_id, node))
+                else:
+                    manual = [m for m in manual if m[0] != lock_id]
+                continue
+            self._scan_stmt(st, cur, fi)
+
+    def _acquire_release_stmt(self, st, fi):
+        """(lock_id, 'acquire'|'release', node) for a statement that is
+        exactly ``<lock>.acquire()`` / ``<lock>.release()``."""
+        if not (isinstance(st, ast.Expr) and isinstance(st.value, ast.Call)):
+            return None
+        call = st.value
+        if not isinstance(call.func, ast.Attribute) or \
+                call.func.attr not in ("acquire", "release"):
+            return None
+        lock_id = self._lock_of_expr(call.func.value, fi)
+        if lock_id is None:
+            return None
+        return (lock_id, call.func.attr, call)
+
+    def _scan_stmt(self, st, held, fi):
+        if isinstance(st, ast.With):
+            inner = list(held)
+            lock_items = False
+            for item in st.items:
+                lid = None
+                if not isinstance(item.context_expr, ast.Call):
+                    lid = self._lock_of_expr(item.context_expr, fi)
+                if lid is not None:
+                    fi.acquires.append((lid, item.context_expr,
+                                        tuple(inner)))
+                    inner.append(lid)
+                    lock_items = True
+                else:
+                    self._scan_calls(item.context_expr, tuple(inner), fi)
+            self._scan_body(st.body, tuple(inner), fi)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _FuncInfo(st.name, "%s.<locals>.%s" % (fi.qual, st.name),
+                            st, fi.module, fi.cls)
+            fi.nested.append(sub)
+            # a nested def (thread target / callback) starts with no lock
+            self._scan_body(st.body, (), sub)
+            return
+        if isinstance(st, ast.Try):
+            self._scan_body(st.body, held, fi)
+            for h in st.handlers:
+                self._scan_body(h.body, held, fi)
+            self._scan_body(st.orelse, held, fi)
+            self._scan_body(st.finalbody, held, fi)
+            return
+        if isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            for expr in ast.iter_child_nodes(st):
+                if not isinstance(expr, (ast.stmt, list)):
+                    self._scan_calls(expr, held, fi)
+            self._scan_body(st.body, held, fi)
+            self._scan_body(st.orelse, held, fi)
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        self._scan_calls(st, held, fi)
+
+    def _scan_calls(self, node, held, fi):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._classify_call(n, held, fi)
+
+    def _classify_call(self, call, held, fi):
+        fn = call.func
+        dotted = _dotted(fn)
+        cls = fi.cls
+
+        # lock methods reached as expressions (``if self._lock.acquire():``)
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in ("acquire", "release", "locked"):
+            lid = self._lock_of_expr(fn.value, fi)
+            if lid is not None:
+                if fn.attr == "acquire":
+                    fi.acquires.append((lid, call, held))
+                return
+
+        # Condition wait/notify on an owned lock: wait releases its own
+        # lock — blocking only for the *other* held locks
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in ("wait", "wait_for"):
+            lid = self._lock_of_expr(fn.value, fi)
+            if lid is not None and self._lock_kind(lid) == "condition" or \
+                    (lid is not None and self._is_condition_attr(fn.value,
+                                                                 fi)):
+                if fn.attr == "wait_for" or not _has_finite_timeout(call) \
+                        and not call.args:
+                    fi.prims.append(("Condition.wait", call, held, lid))
+                elif not _has_finite_timeout(call) and call.args:
+                    # wait(timeout_expr): bounded
+                    pass
+                return
+
+        # primitive blocking calls
+        desc = None
+        exempt = None
+        if dotted in _BLOCKING_DOTTED:
+            desc = _BLOCKING_DOTTED[dotted]
+        elif isinstance(fn, ast.Name) and fn.id in _BLOCKING_NAMES:
+            desc = _BLOCKING_NAMES[fn.id]
+        elif isinstance(fn, ast.Attribute) and \
+                fn.attr in _BLOCKING_NAMES:
+            desc = _BLOCKING_NAMES[fn.attr]
+        elif isinstance(fn, ast.Attribute) and \
+                fn.attr in _BLOCKING_ATTR_CALLS:
+            desc = _BLOCKING_ATTR_CALLS[fn.attr]
+        elif isinstance(fn, ast.Attribute) and fn.attr in ("get", "put"):
+            a = _self_attr(fn.value)
+            if cls is not None and a is not None and \
+                    cls.attr_types.get(a) == ("queue",) and \
+                    not _has_finite_timeout(call):
+                desc = "queue.%s without timeout" % fn.attr
+        elif isinstance(fn, ast.Attribute) and fn.attr == "wait":
+            # Event.wait()/unknown .wait() without a bounding timeout
+            if not call.args and not _has_finite_timeout(call):
+                a = _self_attr(fn.value)
+                t = cls.attr_types.get(a) if (cls and a) else None
+                if t == ("event",) or t is None and a is not None:
+                    desc = "unbounded wait"
+        elif isinstance(fn, ast.Attribute) and fn.attr == "join":
+            if not call.args and not _has_finite_timeout(call):
+                # str.join always takes an argument; zero-arg join blocks
+                desc = "unbounded join"
+        if desc is not None:
+            fi.prims.append((desc, call, held, exempt))
+            return
+
+        # call-graph edges
+        ref = self._call_ref(fn, fi)
+        if ref is not None:
+            fi.calls.append((ref, call, held))
+
+    def _is_condition_attr(self, expr, fi):
+        a = _self_attr(expr)
+        if a is not None and fi.cls is not None:
+            info = fi.cls.locks.get(a)
+            return info is not None and info.kind == "condition"
+        if isinstance(expr, ast.Name):
+            info = fi.module.locks.get(expr.id)
+            return info is not None and info.kind == "condition"
+        return False
+
+    def _call_ref(self, fn, fi):
+        if isinstance(fn, ast.Name):
+            return ("local", fn.id)
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                return ("self_method", fn.attr)
+            a = _self_attr(recv)
+            if a is not None:
+                return ("attr_method", a, fn.attr)
+            if isinstance(recv, ast.Name):
+                return ("dotted", recv.id, fn.attr)
+        return None
+
+    # -- call resolution ---------------------------------------------------
+    def _resolve_module(self, name):
+        """Best-effort module lookup by trailing dotted components."""
+        if name in self.modules:
+            return self.modules[name]
+        tail = name.rsplit(".", 1)[-1]
+        cands = self._mod_by_tail.get(tail, ())
+        for c in cands:
+            if c == name or c.endswith("." + name):
+                return self.modules[c]
+        if len(cands) == 1:
+            return self.modules[cands[0]]
+        return None
+
+    def _resolve_call(self, ref, fi):
+        """ref -> list of target _FuncInfo (possibly empty)."""
+        kind = ref[0]
+        mod = fi.module
+        if kind == "self_method":
+            if fi.cls is not None and ref[1] in fi.cls.methods:
+                return [fi.cls.methods[ref[1]]]
+            return []
+        if kind == "attr_method":
+            if fi.cls is None:
+                return []
+            t = fi.cls.attr_types.get(ref[1])
+            if t is not None and t[0] == "class":
+                target_cls = self._find_class(t[1], mod)
+                if target_cls is not None and ref[2] in target_cls.methods:
+                    return [target_cls.methods[ref[2]]]
+            return []
+        if kind == "local":
+            name = ref[1]
+            if name in mod.functions:
+                return [mod.functions[name]]
+            imp = mod.imports.get(name)
+            if imp is not None and imp[0] == "symbol":
+                m = self._resolve_module(imp[1]) if imp[1] else None
+                if m is not None and imp[2] in m.functions:
+                    return [m.functions[imp[2]]]
+                # symbol imported from an unmodeled module
+                for m2 in self.modules.values():
+                    if name in m2.functions and (
+                            imp[1] == "" or
+                            m2.name.rsplit(".", 1)[-1] ==
+                            imp[1].rsplit(".", 1)[-1]):
+                        return [m2.functions[name]]
+            return []
+        if kind == "dotted":
+            alias, attr = ref[1], ref[2]
+            imp = mod.imports.get(alias)
+            if imp is not None and imp[0] == "module":
+                m = self._resolve_module(imp[1])
+                if m is not None:
+                    if attr in m.functions:
+                        return [m.functions[attr]]
+            return []
+        return []
+
+    def _find_class(self, name, mod):
+        if name in mod.classes:
+            return mod.classes[name]
+        imp = mod.imports.get(name)
+        if imp is not None and imp[0] == "symbol":
+            m = self._resolve_module(imp[1]) if imp[1] else None
+            if m is not None and name in m.classes:
+                return m.classes[name]
+        for m2 in self.modules.values():
+            if name in m2.classes:
+                return m2.classes[name]
+        return None
+
+    # -- transitive summaries ----------------------------------------------
+    def _all_funcs(self):
+        for mod in self.modules.values():
+            stack = list(mod.functions.values())
+            for cls in mod.classes.values():
+                stack.extend(cls.methods.values())
+            while stack:
+                fi = stack.pop()
+                yield fi
+                stack.extend(fi.nested)
+
+    def may_block(self, fi, _depth=0, _seen=None):
+        """[(desc, site 'path:line', exempt lock_id, via)] — blocking
+        operations reachable from ``fi`` with NO lock-release in between
+        (nested defs don't run at call time and are excluded)."""
+        key = id(fi)
+        if key in self._may_block:
+            return self._may_block[key]
+        if _seen is None:
+            _seen = set()
+        if key in _seen or _depth > 6:
+            return []
+        _seen.add(key)
+        out = []
+        for desc, node, _held, exempt in fi.prims:
+            out.append((desc, "%s:%d" % (fi.module.path, node.lineno),
+                        exempt, fi.qual))
+        for ref, node, _held in fi.calls:
+            for tgt in self._resolve_call(ref, fi):
+                for desc, site, exempt, via in self.may_block(
+                        tgt, _depth + 1, _seen):
+                    out.append((desc, site, exempt, via))
+                    if len(out) >= 8:
+                        break
+        self._may_block[key] = out[:8]
+        return self._may_block[key]
+
+    def may_acquire(self, fi, _depth=0, _seen=None):
+        """[(lock_id, site 'path:line', via qualname)] reachable from fi."""
+        key = id(fi)
+        if key in self._may_acquire:
+            return self._may_acquire[key]
+        if _seen is None:
+            _seen = set()
+        if key in _seen or _depth > 6:
+            return []
+        _seen.add(key)
+        out = []
+        for lock_id, node, _held in fi.acquires:
+            out.append((lock_id, "%s:%d" % (fi.module.path, node.lineno),
+                        fi.qual))
+        for ref, node, _held in fi.calls:
+            for tgt in self._resolve_call(ref, fi):
+                for lock_id, site, via in self.may_acquire(
+                        tgt, _depth + 1, _seen):
+                    out.append((lock_id, site, via))
+        # dedupe by lock id, keep first site
+        seen_ids, uniq = set(), []
+        for lock_id, site, via in out:
+            if lock_id not in seen_ids:
+                seen_ids.add(lock_id)
+                uniq.append((lock_id, site, via))
+        self._may_acquire[key] = uniq[:16]
+        return self._may_acquire[key]
+
+    # -- rule drivers --------------------------------------------------------
+    def lock_order_edges(self):
+        """{(a, b): (path, line, detail)} — b acquired while a held."""
+        edges = {}
+
+        def add(a, b, path, line, detail):
+            if a == b:
+                return
+            edges.setdefault((a, b), (path, line, detail))
+
+        for fi in self._all_funcs():
+            for lock_id, node, held in fi.acquires:
+                for h in held:
+                    add(h, lock_id, fi.module.path, node.lineno,
+                        "%s acquires %s while holding %s"
+                        % (fi.qual, _fmt_lock(lock_id), _fmt_lock(h)))
+            for ref, node, held in fi.calls:
+                if not held:
+                    continue
+                for tgt in self._resolve_call(ref, fi):
+                    for lock_id, site, via in self.may_acquire(tgt):
+                        for h in held:
+                            add(h, lock_id, fi.module.path, node.lineno,
+                                "%s calls %s which acquires %s at %s "
+                                "while holding %s"
+                                % (fi.qual, via, _fmt_lock(lock_id),
+                                   site, _fmt_lock(h)))
+        return edges
+
+    def find_cycles(self):
+        """Simple cycles in the lock-order graph as edge lists."""
+        edges = self.lock_order_edges()
+        graph = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+
+        cycles = []
+        seen_cycles = set()
+
+        def dfs(start, cur, path):
+            for nxt in sorted(graph.get(cur, ()), key=str):
+                if nxt == start and len(path) >= 1:
+                    cyc = path + [(cur, nxt)]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(cyc)
+                elif all(nxt != e[0] for e in path) and nxt != cur and \
+                        len(path) < 6:
+                    dfs(start, nxt, path + [(cur, nxt)])
+
+        for a in sorted(graph, key=str):
+            dfs(a, a, [])
+        # canonicalize: each cycle reported once, not once per rotation
+        uniq, seen = [], set()
+        for cyc in cycles:
+            key = frozenset(cyc)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(cyc)
+        return uniq, edges
+
+    def self_deadlocks(self):
+        """Non-reentrant lock re-acquired while already held (directly
+        or through a resolvable call chain)."""
+        out = []
+        for fi in self._all_funcs():
+            for lock_id, node, held in fi.acquires:
+                if lock_id in held and self._lock_kind(lock_id) == "lock":
+                    out.append((lock_id, fi, node.lineno,
+                                "%s re-acquires non-reentrant %s it "
+                                "already holds" % (fi.qual,
+                                                   _fmt_lock(lock_id))))
+            for ref, node, held in fi.calls:
+                if not held:
+                    continue
+                for tgt in self._resolve_call(ref, fi):
+                    if tgt.name.endswith("_locked"):
+                        continue  # caller-holds-the-lock convention
+                    for lock_id, site, via in self.may_acquire(tgt):
+                        if lock_id in held and \
+                                self._lock_kind(lock_id) == "lock":
+                            out.append((
+                                lock_id, fi, node.lineno,
+                                "%s calls %s which re-acquires "
+                                "non-reentrant %s (acquired at %s) "
+                                "already held here"
+                                % (fi.qual, via, _fmt_lock(lock_id), site)))
+        return out
+
+    def held_across_blocking(self):
+        """[(fi, line, lock_id, desc, via)] — lock held across a
+        blocking operation."""
+        out = []
+        for fi in self._all_funcs():
+            for desc, node, held, exempt in fi.prims:
+                for h in held:
+                    if h == exempt:
+                        continue
+                    out.append((fi, node.lineno, h, desc, fi.qual))
+            for ref, node, held in fi.calls:
+                if not held:
+                    continue
+                for tgt in self._resolve_call(ref, fi):
+                    for desc, site, exempt, via in self.may_block(tgt):
+                        for h in held:
+                            if h == exempt:
+                                continue
+                            out.append((fi, node.lineno, h,
+                                        "%s (in %s at %s)"
+                                        % (desc, via, site), via))
+        return out
+
+    def orphan_daemon_threads(self):
+        """[(cls, thread_info)] — daemon threads started with no join."""
+        out = []
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                for ti in cls.threads.values():
+                    if ti.daemon and ti.started is not None and \
+                            not ti.joined:
+                        out.append((cls, ti))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# shared ownership inference for tools/mxlint.py's lock-discipline rule
+# ---------------------------------------------------------------------------
+
+def lock_attrs_of_class(cls_node):
+    """{attr: kind} for every lock a class constructs onto ``self`` —
+    the single source of truth for "is this a guarded class" shared by
+    lock-discipline and the concurrency pass."""
+    out = {}
+    for n in ast.walk(cls_node):
+        if isinstance(n, ast.Assign):
+            kind = _ctor_kind(n.value)
+            if kind:
+                for t in n.targets:
+                    a = _self_attr(t)
+                    if a:
+                        out[a] = kind
+    return out
+
+
+def _stored_attrs(node):
+    """(attr, stmt) for every ``self.X`` store under ``node``."""
+    for n in ast.walk(node):
+        tgts = []
+        if isinstance(n, ast.Assign):
+            tgts = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            tgts = [n.target]
+        for t in tgts:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            a = _self_attr(base)
+            if a:
+                yield a, n
+
+
+def _guard_regions(fn, locks):
+    """With-blocks over an owned lock, plus spans bracketed by
+    ``self.X.acquire()`` ... ``self.X.release()`` at the same depth."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.With):
+            for item in n.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call):
+                    continue
+                if _self_attr(ce) in locks:
+                    yield n
+                    break
+    # acquire()/release() bracketed statements (flat scan per body)
+    for n in ast.walk(fn):
+        body = getattr(n, "body", None)
+        if not isinstance(body, list):
+            continue
+        holding = False
+        for st in body:
+            is_acq = is_rel = False
+            if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call) \
+                    and isinstance(st.value.func, ast.Attribute) and \
+                    _self_attr(st.value.func.value) in locks:
+                is_acq = st.value.func.attr == "acquire"
+                is_rel = st.value.func.attr == "release"
+            if is_acq:
+                holding = True
+            elif is_rel:
+                holding = False
+            elif holding:
+                yield st
+
+
+def class_bare_writes(cls_node, path, rule_id="lock-discipline",
+                      severity="warning"):
+    """The bare-write (RacerD-style lock-protection inference) check for
+    one class: attributes stored under a guard in some method but stored
+    bare in another.  Powered by the shared ownership inference — used
+    by both mxlint's lock-discipline rule and the concurrency pass."""
+    locks = lock_attrs_of_class(cls_node)
+    if not locks:
+        return
+    methods = [m for m in cls_node.body
+               if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    guarded = set()
+    guarded_nodes = set()
+    for m in methods:
+        for region in _guard_regions(m, locks):
+            for a, stmt in _stored_attrs(region):
+                if a not in locks:
+                    guarded.add(a)
+                guarded_nodes.add(id(stmt))
+    if not guarded:
+        return
+    for m in methods:
+        if m.name == "__init__" or m.name.endswith("_locked"):
+            # construction is single-threaded; the `_locked` suffix is
+            # this codebase's caller-holds-the-lock convention
+            continue
+        for a, stmt in _stored_attrs(m):
+            if a in guarded and id(stmt) not in guarded_nodes:
+                yield Finding(
+                    rule_id, severity, None,
+                    "self.%s is guarded by %s elsewhere in %r but "
+                    "mutated here outside the guard; racy under the "
+                    "threads that made the lock necessary" % (
+                        a, "/".join("self.%s" % l for l in sorted(locks)),
+                        cls_node.name),
+                    path=path, line=stmt.lineno)
+
+
+# ---------------------------------------------------------------------------
+# rule catalog (metadata; the analysis itself is Program above)
+# ---------------------------------------------------------------------------
+
+CONCURRENCY_RULES = {}
+
+
+def concurrency_rule(cls):
+    if not cls.id:
+        raise ValueError("concurrency rule needs an id")
+    if cls.id in CONCURRENCY_RULES:
+        raise ValueError("duplicate concurrency rule id %r" % cls.id)
+    CONCURRENCY_RULES[cls.id] = cls
+    return cls
+
+
+class ConcurrencyRule:
+    """Catalog entry for one interprocedural rule.  Unlike per-file
+    SourceRules these need the whole Program; ``emit(program)`` yields
+    findings for every file at once."""
+
+    id = None
+    severity = "warning"
+    description = ""
+    interprocedural = True
+
+    def emit(self, program):
+        raise NotImplementedError
+
+
+@concurrency_rule
+class LockOrderCycle(ConcurrencyRule):
+    id = "lock-order-cycle"
+    severity = "error"
+    description = ("two locks are acquired in opposite orders on "
+                   "different paths (ABBA) — a latent deadlock; every "
+                   "acquisition site in the cycle is blamed")
+
+    def emit(self, program):
+        cycles, edges = program.find_cycles()
+        for cyc in cycles:
+            sites = []
+            for (a, b) in cyc:
+                path, line, detail = edges[(a, b)]
+                sites.append("%s:%d (%s)" % (path, line, detail))
+            order = " -> ".join(_fmt_lock(e[0]) for e in cyc)
+            order += " -> " + _fmt_lock(cyc[0][0])
+            first = min(((edges[e][0], edges[e][1]) for e in cyc))
+            yield Finding(
+                self.id, self.severity, None,
+                "lock-order cycle %s; acquisition sites: %s — threads "
+                "taking these paths concurrently deadlock"
+                % (order, "; ".join(sorted(sites))),
+                path=first[0], line=first[1])
+        for lock_id, fi, line, detail in program.self_deadlocks():
+            yield Finding(
+                self.id, self.severity, None,
+                "%s — non-reentrant self-deadlock" % detail,
+                path=fi.module.path, line=line)
+
+
+@concurrency_rule
+class LockHeldBlocking(ConcurrencyRule):
+    id = "lock-held-blocking"
+    severity = "warning"
+    description = ("a lock is held across an operation that can block "
+                   "indefinitely (rpc/socket I/O, unbounded queue or "
+                   "wait/join, time.sleep, device sync, subprocess) — "
+                   "every other thread needing the lock stalls behind "
+                   "the slow operation")
+
+    def emit(self, program):
+        seen = set()
+        for fi, line, lock_id, desc, _via in \
+                program.held_across_blocking():
+            key = (fi.module.path, line, lock_id, desc.split(" (")[0])
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Finding(
+                self.id, self.severity, None,
+                "%s holds %s across blocking %s; the lock serializes "
+                "every peer behind this I/O — release it first or "
+                "bound the wait" % (fi.qual, _fmt_lock(lock_id), desc),
+                path=fi.module.path, line=line)
+
+
+@concurrency_rule
+class OrphanDaemonThread(ConcurrencyRule):
+    id = "orphan-daemon-thread"
+    severity = "warning"
+    description = ("a daemon thread is started but never joined and has "
+                   "no shutdown path — it dies mid-operation at "
+                   "interpreter exit (truncated writes, lost telemetry)")
+
+    def emit(self, program):
+        for cls, ti in program.orphan_daemon_threads():
+            node = ti.started if ti.started is not None else ti.node
+            yield Finding(
+                self.id, self.severity, None,
+                "daemon thread self.%s of %r is started but never "
+                "joined; give it a shutdown path (join on stop/close, "
+                "or an Event the loop honors) or annotate why exit-time "
+                "death is safe" % (ti.attr, cls.name),
+                path=cls.module.path, line=node.lineno)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def _module_name_for(path, root=None):
+    """Dotted module name for a file — relative to ``root`` when given,
+    else the full dotted path (keeps colliding basenames like
+    ``__init__.py`` distinct across directories)."""
+    p = os.path.abspath(path)
+    if root:
+        rel = os.path.relpath(p, os.path.abspath(root))
+        if not rel.startswith(".."):
+            p = rel
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.replace(os.sep, ".").split(".") if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1] or ["__init__"]
+    return ".".join(parts)
+
+
+def build_program(sources, root=None):
+    """``sources``: iterable of (path, src).  Returns the built Program."""
+    prog = Program()
+    for path, src in sources:
+        prog.add_source(path, src, _module_name_for(path, root))
+    prog.build()
+    return prog
+
+
+def analyze_sources(sources, rules=None, root=None):
+    """Run the concurrency rule catalog over a set of sources.
+    ``rules``: iterable of rule ids (default: all).  Returns Findings
+    sorted by (path, line, rule)."""
+    prog = build_program(sources, root=root)
+    selected = (CONCURRENCY_RULES.values() if rules is None
+                else [CONCURRENCY_RULES[r] for r in rules])
+    findings = []
+    for cls in selected:
+        findings.extend(cls().emit(prog))
+    findings.sort(key=lambda f: (f.path or "", f.line or 0, f.rule_id,
+                                 f.message))
+    return findings
+
+
+def analyze_package(root, rules=None):
+    """Walk a package directory and run the full concurrency pass —
+    the form diagnose.py and the CI gate use.  Suppression comments are
+    honored (same syntax as mxlint)."""
+    sources = []
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for f in sorted(files):
+            if f.endswith(".py"):
+                p = os.path.join(dirpath, f)
+                with open(p, encoding="utf-8") as fh:
+                    sources.append((p, fh.read()))
+    findings = analyze_sources(
+        sources, rules=rules,
+        root=os.path.dirname(os.path.abspath(root)))
+    by_path = {p: parse_suppressions(s) for p, s in sources}
+    out = []
+    for f in findings:
+        per_line, file_wide = by_path.get(f.path, ({}, set()))
+        if f.rule_id in file_wide:
+            continue
+        dis = per_line.get(f.line, ())
+        if f.rule_id in dis or "all" in dis:
+            continue
+        out.append(f)
+    return out

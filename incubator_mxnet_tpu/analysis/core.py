@@ -11,8 +11,11 @@ This module stays import-light on purpose (no jax at module level): the
 AST linter shares ``Finding`` without paying for an accelerator runtime.
 """
 
+import re
+
 __all__ = ["Finding", "Pass", "GraphContext", "graph_rule", "GRAPH_RULES",
-           "SEVERITIES", "analyze", "analyze_json", "format_findings"]
+           "SEVERITIES", "analyze", "analyze_json", "format_findings",
+           "parse_suppressions"]
 
 # severity ranks double as the sort order of reports: hard bind-time
 # failures first, perf diagnostics last
@@ -71,6 +74,53 @@ class Finding:
 
 def _severity_rank(sev):
     return SEVERITIES.index(sev)
+
+
+# ---------------------------------------------------------------------------
+# source-comment suppressions — one parser shared by every source-level
+# consumer (tools/mxlint.py per-file rules AND the package-wide
+# concurrency pass), so a ``# mxlint: disable=`` comment means the same
+# thing to both.  The directive may share a comment with other markers,
+# e.g. ``# pragma: no cover — mxlint: disable=broad-except (reason)``.
+# ---------------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(r"#.*?mxlint:\s*disable=([A-Za-z0-9_,\-]+)")
+_DISABLE_FILE_RE = re.compile(
+    r"#.*?mxlint:\s*disable-file=([A-Za-z0-9_,\-]+)")
+_NOQA_BLE_RE = re.compile(r"#\s*noqa:.*\bBLE001\b")
+
+
+def parse_suppressions(src):
+    """(per-line {lineno: set(rule ids)}, file-wide set).
+
+    A directive on a code line mutes that line. A directive on a
+    standalone comment line carries forward to the next code line, so a
+    long justification can sit above the statement it excuses.
+    ``# noqa: BLE001`` is honored as equivalent to disabling
+    broad-except.
+    """
+    per_line, file_wide, pending = {}, set(), set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        rules = set()
+        m = _DISABLE_RE.search(line)
+        if m:
+            rules.update(
+                x.strip() for x in m.group(1).split(",") if x.strip())
+        m = _DISABLE_FILE_RE.search(line)
+        if m:
+            file_wide.update(
+                x.strip() for x in m.group(1).split(",") if x.strip())
+        if _NOQA_BLE_RE.search(line):
+            rules.add("broad-except")
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            pending |= rules
+        elif stripped:
+            rules |= pending
+            pending = set()
+        if rules:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_wide
 
 
 def format_findings(findings):

@@ -430,6 +430,12 @@ class SchedulerClient:
 
     def stop_heartbeats(self):
         self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None:
+            # bounded: the loop wakes from _hb_stop.wait immediately; the
+            # worst case is one in-flight heartbeat call (timeout=10)
+            t.join(timeout=12)
+            self._hb_thread = None
 
     def bye(self, role, rank):
         """Clean deregistration (stops liveness accounting for this node;
@@ -646,6 +652,12 @@ class _ServerSnapshot:
 
     def stop(self, mut_lock):
         self._stop.set()
+        t = self._ticker
+        if t is not None:
+            # bounded: the loop wakes from _stop.wait immediately; the
+            # worst case is one in-flight snapshot under mut_lock
+            t.join(timeout=10)
+            self._ticker = None
         if self._dirty.is_set():
             with mut_lock:
                 try:

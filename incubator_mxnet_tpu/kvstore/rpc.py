@@ -28,6 +28,7 @@ import uuid
 from ..resilience import watchdog as _wd
 from ..telemetry import catalog as _cat
 from ..telemetry import flight as _fl
+from ..telemetry import lockdep as _ld
 from ..telemetry import metrics as _met
 from ..telemetry import tracing as _tr
 from ..utils import failpoints as _fp
@@ -66,7 +67,8 @@ def _budget_expired(ms):
 
 def send_msg(sock, obj, payload=b""):
     """obj: JSON-serializable metadata dict; payload: raw bytes."""
-    meta = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    _ld.check_blocking("rpc.send")     # lockdep chokepoint (one predicate
+    meta = json.dumps(obj, separators=(",", ":")).encode("utf-8")  # when off)
     frame = _HDR.pack(len(meta)) + _HDR.pack(len(payload)) + meta + payload
     sock.sendall(frame)
     _cat.rpc_bytes_sent.inc(len(frame))
@@ -77,6 +79,7 @@ def recv_msg(sock):
     boundary. A peer dying MID-frame (partial header, truncated meta or
     payload) raises ProtocolError — the connection is unusable, but the
     caller decides whether that kills anything beyond this socket."""
+    _ld.check_blocking("rpc.recv")     # lockdep chokepoint
     hdr = _recv_exact(sock, 8)
     if hdr is None:
         return None, None
@@ -138,7 +141,10 @@ class Connection:
         self._addr = tuple(addr)
         self._timeout = timeout
         self._sock = None
-        self._lock = threading.Lock()
+        # the runtime twin of the static `lock-held-blocking` suppression
+        # in _call: this lock's PURPOSE is to serialize the blocking
+        # request/response exchange, so the lockdep witness exempts it
+        self._lock = _ld.allow_blocking(threading.Lock())
         # idempotency identity: servers dedup retried requests by
         # (client token, seq). The token survives reconnects — a resend
         # after a dropped socket must dedup against the original apply.
@@ -216,22 +222,35 @@ class Connection:
         return out
 
     def _call(self, obj, payload=b"", timeout=None):
+        # Holding self._lock across connect/send/recv below is the wire
+        # protocol, not an accident: one connection carries exactly one
+        # outstanding request/response pair, and the per-connection lock
+        # IS that serialization (interleaved frames from two threads
+        # would corrupt the framing). Slow-wire stalls are bounded by
+        # the caller's `timeout` socket deadline, and callers that want
+        # parallelism open more connections (one per thread).
         with self._lock:
             try:
+                # mxlint: disable=lock-held-blocking — connect under the
+                # connection's own serialization lock (see above)
                 self._ensure()
+                # mxlint: disable=lock-held-blocking — failpoint-injected
+                # delay models a slow wire INSIDE the serialized window
                 if _fp.failpoint("rpc.send.drop"):
                     # request lost BEFORE hitting the wire: never applied
                     self._close_locked()
                     raise OSError("failpoint: rpc.send.drop")
                 if timeout is not None:
                     self._sock.settimeout(timeout)
-                send_msg(self._sock, obj, payload)
+                send_msg(self._sock, obj, payload)  # mxlint: disable=lock-held-blocking — the request half of the serialized exchange
+                # mxlint: disable=lock-held-blocking — failpoint delay,
+                # same as the send-side injection
                 if _fp.failpoint("rpc.recv.drop"):
                     # reply lost AFTER the request hit the wire: the server
                     # applies it, this client never sees the ack
                     self._close_locked()
                     raise OSError("failpoint: rpc.recv.drop")
-                meta, data = recv_msg(self._sock)
+                meta, data = recv_msg(self._sock)  # mxlint: disable=lock-held-blocking — the response half of the serialized exchange
             except (OSError, ProtocolError):
                 # NO automatic resend here: the request may already have
                 # been applied server-side (a raw push/register is not
@@ -490,6 +509,11 @@ class Server:
             self._srv.close()
         except OSError:
             pass
+        t = self._thread
+        if t is not None and t.is_alive():
+            # bounded: the accept loop polls _stop every 0.5s and exits
+            # on the closed listener either way
+            t.join(timeout=5)
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:

@@ -46,6 +46,11 @@ def get_lib():
         path = os.environ.get("MXTPU_LIBRARY_PATH") or _LIB_PATH
         try:
             if path == _LIB_PATH and not os.path.exists(_LIB_PATH):
+                # mxlint: disable=lock-held-blocking — double-checked
+                # one-time build: the lock exists precisely so exactly
+                # one caller runs make while every other caller blocks
+                # until the library exists; releasing it would fork
+                # concurrent builds into the same output file
                 _build()
             lib = ctypes.CDLL(path)
             _declare(lib)

@@ -374,9 +374,14 @@ class DecodeLoop:
                     self._cond.notify_all()
                 continue
             dt = time.perf_counter() - t0
-            self._ewma_step = dt if self._ewma_step is None else \
-                0.7 * self._ewma_step + 0.3 * dt
-            self._steps += 1
+            with self._cond:
+                # the EWMA read-modify-write must sit under the cond:
+                # reset_service_estimates()/stats() touch it from other
+                # threads, and a bare update here could resurrect a
+                # just-reset estimate
+                self._ewma_step = dt if self._ewma_step is None else \
+                    0.7 * self._ewma_step + 0.3 * dt
+                self._steps += 1
             _cat.serving_decode_steps.inc(model=self.name)
             _cat.serving_batch_occupancy.observe(len(active),
                                                  model=self.name)

@@ -33,8 +33,9 @@ adds both:
 See docs/OBSERVABILITY.md for the metric catalog and span semantics.
 """
 
-from . import metrics
-from . import tracing
+from . import lockdep   # FIRST: MXTPU_LOCKDEP=1 must patch the lock
+from . import metrics   # constructors before sibling modules (and the
+from . import tracing   # rest of the framework) create their locks
 from . import export
 from . import catalog
 from . import flight
@@ -52,8 +53,8 @@ from .tracing import (span, current, inject, extract, from_meta,
                       merge_traces, recent_spans, request_span,
                       record_span, build_timeline, render_timeline)
 
-__all__ = ["metrics", "tracing", "export", "catalog",
-           "flight", "debugz", "costs", "aggregate", "history", "health",
+__all__ = ["metrics", "tracing", "export", "catalog", "flight",
+           "debugz", "costs", "aggregate", "history", "health", "lockdep",
            "enable", "disable", "enabled", "counter", "gauge", "histogram",
            "snapshot", "reset",
            "render_prometheus", "render_json", "flush", "start_flusher",

@@ -314,6 +314,11 @@ flight_events = _m.counter(
 debugz_requests = _m.counter(
     "mxtpu_debugz_requests_total",
     "Debugz HTTP requests served, by path and status")
+lockdep_violations = _m.counter(
+    "mxtpu_lockdep_violations_total",
+    "Runtime lockdep witness violations by kind (order = lock-order "
+    "cycle observed across threads, blocking = lock held across a "
+    "blocking operation); see telemetry/lockdep.py")
 model_flops_per_exec = _m.gauge(
     "mxtpu_model_flops_per_executable",
     "Static XLA cost-analysis FLOPs for one run of the named executable")

@@ -69,6 +69,8 @@ def status_dict():
     out["telemetry_enabled"] = _m.enabled()
     from . import health as _health
     out["health"] = _health.statusz_entry()
+    from . import lockdep as _lockdep
+    out["lockdep"] = _lockdep.statusz_entry()
     with _lock:
         entries = list(_status.items())
     for key, value in entries:

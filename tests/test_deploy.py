@@ -524,7 +524,15 @@ def _replica_proc(ckpt_dir, q, stop_evt, flight_path):
     import jax
     jax.config.update("jax_platforms", "cpu")
     from incubator_mxnet_tpu import serving as srv_mod
-    from incubator_mxnet_tpu.telemetry import flight
+    from incubator_mxnet_tpu.telemetry import flight, lockdep
+    # the lockdep witness rides along in every replica: the rollout's
+    # drain/swap/admit machinery is the lock-heaviest path in serving and
+    # the parent asserts the witness saw zero violations on teardown.
+    # Explicit install(): the spawn child imports this test module (and
+    # with it the framework) while unpickling the Process target, so the
+    # MXTPU_LOCKDEP env hook has already been evaluated by the time this
+    # function runs — but every ModelServer lock is created after here.
+    lockdep.install()
     try:
         flight.enable()
         srv = srv_mod.ModelServer()
@@ -535,6 +543,7 @@ def _replica_proc(ckpt_dir, q, stop_evt, flight_path):
         stop_evt.wait(300)
         srv.stop()
         flight.dump(flight_path, reason="drill exit")
+        q.put(("lockdep", lockdep.report()))
     except Exception as e:  # surface failures to the test
         import traceback
         q.put(("error", "%s\n%s" % (e, traceback.format_exc())))
@@ -645,6 +654,15 @@ def test_live_weight_push_no_drop_drill(tmp_path):
         # --- no swap cost a single XLA compile -------------------------
         for a in addrs:
             assert _compile_total(a) == base[a]
+
+        # --- the lockdep witness rode the whole drill: zero violations -
+        stop_evt.set()
+        for _ in procs:
+            kind, rep = q.get(timeout=60)
+            assert kind == "lockdep", rep
+            assert rep.get("enabled"), rep
+            assert rep["violations"] == [], \
+                "lockdep violations in replica:\n%s" % rep
     finally:
         stop.set()
         stop_evt.set()

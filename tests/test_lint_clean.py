@@ -88,3 +88,33 @@ def test_diagnose_embeds_lint_section():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "Lint (graphlint)" in r.stdout
     assert "mxlint       : clean" in r.stdout
+
+
+def test_package_concurrency_pass_zero_unsuppressed():
+    """Level 3 of the gate, in-process: the whole-package interprocedural
+    concurrency pass (lock-order cycles, locks held across blocking ops,
+    orphan daemon threads) has zero unsuppressed findings."""
+    from incubator_mxnet_tpu.analysis import analyze_package
+    findings = analyze_package(PKG)
+    assert not findings, \
+        "concurrency findings in the package:\n" + _fmt(findings)
+
+
+def test_mxlint_cli_concurrency_rule_subset():
+    """--rules with only the concurrency ids runs just the
+    interprocedural pass; unknown ids are a usage error, not silence."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", PKG, "--rules",
+         "lock-order-cycle,lock-held-blocking,orphan-daemon-thread",
+         "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout) == []
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", PKG, "--rules",
+         "no-such-rule"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode != 0
+    assert "unknown rule" in r.stderr

@@ -1,0 +1,218 @@
+"""Runtime lockdep witness (telemetry.lockdep).
+
+The dynamic half of the concurrency pass: ``MXTPU_LOCKDEP=1`` patches
+the lock constructors and watches every acquisition at runtime.  These
+tests seed the two violation families in a toy two-lock class — an
+ABBA inversion witnessed ACROSS TIME (two threads run sequentially;
+the persisted order graph still catches the inversion, no real
+deadlock needed) and a lock held across ``time.sleep`` — and assert
+the full reporting surface: violation record, both-sides stack report,
+``lockdep.violation`` flight event, ``mxtpu_lockdep_violations_total``
+counter, /statusz entry, and the ``MXTPU_LOCKDEP_FATAL=1`` hard-fail.
+
+Timing-free by design (flakiness-checked): nothing races — thread 1
+finishes before thread 2 starts.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from incubator_mxnet_tpu import telemetry as tel
+from incubator_mxnet_tpu.telemetry import catalog as cat
+from incubator_mxnet_tpu.telemetry import flight, lockdep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Pair:
+    """Two locks, opposite nesting orders, and a sleep under a lock —
+    the witness's seeded prey.  Instantiated only while the witness is
+    installed, so both locks are proxies."""
+
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def ab(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def ba(self):
+        with self.b:
+            with self.a:
+                pass
+
+    def slow(self):
+        with self.a:
+            time.sleep(0.01)
+
+
+@pytest.fixture
+def witness():
+    tel.reset()
+    tel.enable()
+    flight.clear()
+    flight.enable()
+    lockdep.install()
+    lockdep.reset()
+    try:
+        yield
+    finally:
+        lockdep.uninstall()
+        lockdep.reset()
+        flight.disable()
+        flight.clear()
+        tel.disable()
+        tel.reset()
+
+
+def _run_in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def test_abba_inversion_witnessed_across_time(witness):
+    p = Pair()
+    _run_in_thread(p.ab)          # thread 1: a -> b, runs to completion
+    _run_in_thread(p.ba)          # thread 2 (later): b -> a — inversion
+    order = [v for v in lockdep.violations() if v["kind"] == "order"]
+    assert len(order) == 1
+    v = order[0]
+    assert len(v["cycle"]) == 2 and len(v["locks"]) == 2
+    # both sides of the cycle carry the holder AND acquirer stacks
+    assert len(v["sides"]) == 2
+    for side in v["sides"].values():
+        assert side["holder_stack"] and side["acquirer_stack"]
+    rep = lockdep.format_violation(v)
+    assert "holder stack" in rep and "acquirer stack" in rep
+    assert "test_lockdep.py" in rep          # frames point at this file
+    # counter and flight event fired exactly once
+    assert cat.lockdep_violations.value(kind="order") == 1
+    evs = [e for e in flight.events() if e["event"] == "lockdep.violation"]
+    assert len(evs) == 1 and evs[0]["attrs"]["kind"] == "order"
+    # statusz and the drills' report() form agree
+    entry = lockdep.statusz_entry()
+    assert entry["enabled"] and entry["violations"] == 1
+    assert len(lockdep.report()["violations"]) == 1
+
+
+def test_abba_deduped_on_repeat(witness):
+    p = Pair()
+    for _ in range(3):
+        _run_in_thread(p.ab)
+        _run_in_thread(p.ba)
+    assert len([v for v in lockdep.violations()
+                if v["kind"] == "order"]) == 1
+
+
+def test_consistent_order_is_clean(witness):
+    p = Pair()
+    for _ in range(3):
+        _run_in_thread(p.ab)      # always a -> b: a DAG, no violation
+    assert lockdep.violations() == []
+    assert lockdep.report()["edges"] >= 1    # ...but the edge was seen
+
+
+def test_lock_held_across_sleep_witnessed(witness):
+    p = Pair()
+    _run_in_thread(p.slow)
+    blocking = [v for v in lockdep.violations() if v["kind"] == "blocking"]
+    assert len(blocking) == 1
+    v = blocking[0]
+    assert v["desc"] == "time.sleep" and len(v["locks"]) == 1
+    assert v["blocking_stack"]               # where it blocked...
+    assert list(v["holder_stacks"].values())[0]   # ...and who held what
+    rep = lockdep.format_violation(v)
+    assert "time.sleep" in rep and "test_lockdep.py" in rep
+    assert cat.lockdep_violations.value(kind="blocking") == 1
+    evs = [e for e in flight.events() if e["event"] == "lockdep.violation"]
+    assert len(evs) == 1 and evs[0]["attrs"]["kind"] == "blocking"
+
+
+def test_allow_blocking_exemption(witness):
+    lock = lockdep.allow_blocking(threading.Lock())
+
+    def hold_and_sleep():
+        with lock:
+            time.sleep(0.01)
+
+    _run_in_thread(hold_and_sleep)
+    assert lockdep.violations() == []
+
+
+def test_rlock_reentrancy_not_a_violation(witness):
+    rl = threading.RLock()
+
+    def nest():
+        with rl:
+            with rl:
+                pass
+
+    _run_in_thread(nest)
+    assert lockdep.violations() == []
+
+
+def test_disabled_path_is_inert():
+    """The off path other tests (and prod) ride: raw locks, constant
+    statusz stub, check_blocking a no-op."""
+    assert not lockdep.installed()
+    assert lockdep.statusz_entry() == {"enabled": False}
+    assert lockdep.report() == {"enabled": False}
+    lockdep.check_blocking("rpc.send")       # must not touch telemetry
+    lock = threading.Lock()
+    assert not isinstance(lock, lockdep._ProxyBase)
+    assert lockdep.allow_blocking(lock) is lock   # no-op on raw locks
+
+
+def test_fatal_mode_env_driven():
+    """MXTPU_LOCKDEP_FATAL=1 in a fresh process: the env hook installs
+    the witness at telemetry import and the seeded inversion raises
+    RuntimeError with the both-sides report in the message."""
+    code = textwrap.dedent("""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def ab(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def ba(self):
+                with self.b:
+                    with self.a:
+                        pass
+
+        from incubator_mxnet_tpu.telemetry import lockdep
+        assert lockdep.installed()
+        p = Pair()
+        t = threading.Thread(target=p.ab)
+        t.start()
+        t.join(10)
+        try:
+            p.ba()
+        except RuntimeError as e:
+            assert "lockdep violation" in str(e), e
+            assert "holder stack" in str(e), e
+            print("FATAL-RAISED")
+        else:
+            print("NO-RAISE")
+    """)
+    env = dict(os.environ, MXTPU_LOCKDEP="1", MXTPU_LOCKDEP_FATAL="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FATAL-RAISED" in r.stdout, r.stdout + r.stderr

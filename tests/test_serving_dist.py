@@ -21,11 +21,18 @@ BERT_CFG = dict(vocab_size=40, units=8, hidden_size=16, num_layers=1,
 
 
 def _server_proc(ckpt_dir, q, stop_evt):
+    import os
+    # every drill run doubles as a race hunt: the lockdep witness
+    # watches the server's lock orderings for the whole session and the
+    # fixture asserts zero violations on teardown (env must be set
+    # BEFORE the framework import patches nothing)
+    os.environ["MXTPU_LOCKDEP"] = "1"
     import jax
     jax.config.update("jax_platforms", "cpu")
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, serving
     from incubator_mxnet_tpu.models.bert import BERTModel
+    from incubator_mxnet_tpu.telemetry import lockdep
     try:
         model = BERTModel(prefix="sd_", dropout=0.0, **BERT_CFG)
         model.initialize(mx.init.Normal(0.02))
@@ -41,6 +48,7 @@ def _server_proc(ckpt_dir, q, stop_evt):
         q.put(("ok", list(srv.addr)))
         stop_evt.wait(120)
         srv.stop()
+        q.put(("lockdep", lockdep.report()))
     except Exception as e:  # surface failures to the test
         import traceback
         q.put(("error", "%s\n%s" % (e, traceback.format_exc())))
@@ -61,9 +69,18 @@ def served(tmp_path_factory):
         pytest.fail("server process failed to start:\n%s" % info)
     yield tuple(info)
     stop_evt.set()
-    proc.join(20)
-    if proc.is_alive():
-        proc.terminate()
+    try:
+        kind, report = q.get(timeout=30)
+        assert kind == "lockdep", report
+        assert report.get("enabled"), report
+        # the witness ran for the server's whole life; any inversion or
+        # lock-held-across-blocking it saw is a real bug in the fleet
+        assert report["violations"] == [], \
+            "lockdep violations in server process:\n%s" % report
+    finally:
+        proc.join(20)
+        if proc.is_alive():
+            proc.terminate()
 
 
 def _client(addr):

@@ -211,3 +211,19 @@ def test_disabled_deploy_instruments_are_cheap_and_record_nothing():
         < MAX_SECONDS_PER_CALL
     assert catalog.serving_generation.value(model="m") == 0
     assert catalog.deploy_swaps.value(model="m", outcome="ok") == 0
+
+
+def test_disabled_lockdep_is_one_env_check():
+    """Lockdep witness off (the default): check_blocking — which sits on
+    the rpc send/recv hot path — is one dict lookup, lock construction
+    is untouched, and the statusz entry is a constant stub."""
+    import threading
+    from incubator_mxnet_tpu.telemetry import lockdep
+    assert lockdep.installed() is False
+    assert _per_call(lambda: lockdep.check_blocking("rpc.send")) \
+        < MAX_SECONDS_PER_CALL
+    assert lockdep.statusz_entry() == {"enabled": False}
+    assert lockdep.report() == {"enabled": False}
+    assert threading.Lock is lockdep._ORIG_LOCK
+    assert threading.RLock is lockdep._ORIG_RLOCK
+    assert lockdep.violations() == []

@@ -84,6 +84,48 @@ def main():
     except Exception as e:  # noqa: BLE001 — diagnostics must not crash
         print("mxlint failed:", e)
 
+    section("Concurrency")
+    # the two-pronged lock story: the interprocedural static pass over
+    # the package (lock-order cycles, locks held across blocking ops,
+    # orphan daemon threads) plus the live lockdep witness state when
+    # embedded in a running job with MXTPU_LOCKDEP=1
+    try:
+        from incubator_mxnet_tpu.analysis import analyze_package
+        from incubator_mxnet_tpu.analysis.concurrency import (
+            CONCURRENCY_RULES, build_program)
+        pkg = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "incubator_mxnet_tpu")
+        sources = []
+        for root_, dirs, files in os.walk(pkg):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    p = os.path.join(root_, fn)
+                    with open(p, encoding="utf-8") as fh:
+                        sources.append((p, fh.read()))
+        prog = build_program(sources,
+                             root=os.path.dirname(os.path.abspath(pkg)))
+        n_locks = sum(len(c.locks) for m in prog.modules.values()
+                      for c in m.classes.values())
+        n_threads = sum(len(c.threads) for m in prog.modules.values()
+                        for c in m.classes.values())
+        print("rules        :", ", ".join(sorted(CONCURRENCY_RULES)))
+        print("inventory    : %d lock-owning attrs, %d thread attrs, "
+              "%d order edges" % (n_locks, n_threads,
+                                  len(prog.lock_order_edges())))
+        findings = analyze_package(pkg)
+        print("static pass  :", "clean" if not findings
+              else "%d finding(s)" % len(findings))
+        for f in findings[:20]:
+            print("  -", f.format())
+        from incubator_mxnet_tpu.telemetry import lockdep
+        print("lockdep      :", lockdep.statusz_entry())
+        for v in lockdep.violations()[:3]:
+            print(lockdep.format_violation(v))
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("concurrency analysis failed:", e)
+
     section("Telemetry")
     # live metrics snapshot: in-process state when diagnose runs embedded
     # (post-mortem in a failing job), plus the exporter configuration

@@ -4,10 +4,13 @@
 Framework-specific rules over the repo's own Python source: broad
 ``except Exception`` swallows, mutable default arguments, impurity inside
 ``hybrid_forward``/jit-traced functions, host syncs inside training-step
-loops, and lock-discipline races in classes that own a ``threading.Lock``.
+loops, and lock-discipline races in classes that own a lock.
 Shares the ``Finding`` type with the graph analyzer
 (``incubator_mxnet_tpu.analysis``); ``.json`` arguments are routed to the
-graph analyzer, so one CLI lints both levels.
+graph analyzer, and the interprocedural concurrency rules
+(``analysis/concurrency.py`` — lock-order cycles, locks held across
+blocking ops, orphan daemon threads; level 3 of graphlint) run over the
+whole argument set at once, so one CLI lints all three levels.
 
 Usage:
     python -m tools.mxlint <paths...> [--json] [--rules id,id]
@@ -31,7 +34,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from incubator_mxnet_tpu.analysis.core import (  # noqa: E402
-    Finding, SEVERITIES, format_findings)
+    Finding, SEVERITIES, format_findings, parse_suppressions)
+from incubator_mxnet_tpu.analysis import concurrency as _conc  # noqa: E402
 
 __all__ = ["SourceRule", "SOURCE_RULES", "source_rule", "lint_source",
            "lint_paths", "main"]
@@ -285,135 +289,61 @@ class HostSyncLoop(SourceRule):
 class LockDiscipline(SourceRule):
     id = "lock-discipline"
     severity = "warning"
-    description = ("attribute guarded by self._lock elsewhere is "
-                   "mutated outside `with self._lock`")
-
-    _LOCK_CTORS = frozenset(("Lock", "RLock"))
-
-    def _lock_attrs(self, cls):
-        out = set()
-        for n in _walk(cls):
-            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
-                fn = n.value.func
-                last = fn.attr if isinstance(fn, ast.Attribute) else \
-                    (fn.id if isinstance(fn, ast.Name) else None)
-                if last in self._LOCK_CTORS:
-                    for t in n.targets:
-                        a = _self_attr(t)
-                        if a:
-                            out.add(a)
-        return out
-
-    def _stored_attrs(self, node):
-        """self attributes written by Assign/AugAssign/Subscript-store
-        anywhere under ``node`` (attribute-SET driven, reads don't count),
-        as (attr_name, ast_node) pairs."""
-        for n in _walk(node):
-            tgts = []
-            if isinstance(n, ast.Assign):
-                tgts = n.targets
-            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
-                tgts = [n.target]
-            for t in tgts:
-                base = t
-                while isinstance(base, ast.Subscript):
-                    base = base.value
-                a = _self_attr(base)
-                if a:
-                    yield a, n
-
-    def _with_lock_regions(self, fn, locks):
-        for n in _walk(fn):
-            if isinstance(n, ast.With):
-                for item in n.items:
-                    ce = item.context_expr
-                    if isinstance(ce, ast.Call):
-                        continue   # a call result is some other manager;
-                        # only a bare ``with self._lock:`` counts
-                    if _self_attr(ce) in locks:
-                        yield n
-                        break
+    description = ("attribute guarded by an owned lock (Lock/RLock/"
+                   "Condition, via `with` or acquire()) elsewhere is "
+                   "mutated outside the guard")
 
     def check(self, tree, path):
+        # the lock-ownership inference and guarded-region extraction are
+        # shared with the concurrency pass (analysis/concurrency.py) so
+        # the two levels cannot disagree about what a guarded class is
         for cls in (n for n in _walk(tree) if isinstance(n, ast.ClassDef)):
-            locks = self._lock_attrs(cls)
-            if not locks:
-                continue
-            methods = [m for m in cls.body
-                       if isinstance(m, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef))]
-            guarded = set()
-            guarded_nodes = set()   # id of stores inside with-lock regions
-            for m in methods:
-                for w in self._with_lock_regions(m, locks):
-                    for a, stmt in self._stored_attrs(w):
-                        if a not in locks:
-                            guarded.add(a)
-                        guarded_nodes.add(id(stmt))
-            if not guarded:
-                continue
-            for m in methods:
-                if m.name == "__init__" or m.name.endswith("_locked"):
-                    # construction is single-threaded; the `_locked` suffix
-                    # is this codebase's caller-holds-the-lock convention
-                    continue
-                for a, stmt in self._stored_attrs(m):
-                    if a in guarded and id(stmt) not in guarded_nodes:
-                        yield self.finding(
-                            path, stmt, "self.%s is guarded by %s "
-                            "elsewhere in %r but mutated here outside "
-                            "`with`; racy under the threads that made the "
-                            "lock necessary" % (
-                                a, "/".join("self.%s" % l
-                                            for l in sorted(locks)),
-                                cls.name))
+            for f in _conc.class_bare_writes(cls, path, rule_id=self.id,
+                                             severity=self.severity):
+                yield f
 
 
 # ---------------------------------------------------------------------------
 # suppression + drivers
 # ---------------------------------------------------------------------------
 
-# the directive may share a comment with other markers, e.g.
-# ``# pragma: no cover — mxlint: disable=broad-except (reason)``
-_DISABLE_RE = re.compile(r"#.*?mxlint:\s*disable=([A-Za-z0-9_,\-]+)")
-_DISABLE_FILE_RE = re.compile(
-    r"#.*?mxlint:\s*disable-file=([A-Za-z0-9_,\-]+)")
-_NOQA_BLE_RE = re.compile(r"#\s*noqa:.*\bBLE001\b")
+# one parser for the whole subsystem — lives next to Finding so the
+# package-wide concurrency pass honors the same comments
+_suppressions = parse_suppressions
 
 
-def _suppressions(src):
-    """(per-line {lineno: set(rule ids)}, file-wide set).
-
-    A directive on a code line mutes that line. A directive on a
-    standalone comment line carries forward to the next code line, so a
-    long justification can sit above the statement it excuses.
-    """
-    per_line, file_wide, pending = {}, set(), set()
-    for i, line in enumerate(src.splitlines(), start=1):
-        rules = set()
-        m = _DISABLE_RE.search(line)
-        if m:
-            rules.update(
-                x.strip() for x in m.group(1).split(",") if x.strip())
-        m = _DISABLE_FILE_RE.search(line)
-        if m:
-            file_wide.update(
-                x.strip() for x in m.group(1).split(",") if x.strip())
-        if _NOQA_BLE_RE.search(line):
-            rules.add("broad-except")
-        stripped = line.strip()
-        if stripped.startswith("#"):
-            pending |= rules
-        elif stripped:
-            rules |= pending
-            pending = set()
-        if rules:
-            per_line.setdefault(i, set()).update(rules)
-    return per_line, file_wide
+def _split_rules(rules):
+    """Partition a rule-id selection into (per-file AST ids,
+    interprocedural concurrency ids); None means 'all' for both."""
+    if rules is None:
+        return None, None
+    src_rules, conc_rules = [], []
+    for r in rules:
+        if r in SOURCE_RULES:
+            src_rules.append(r)
+        elif r in _conc.CONCURRENCY_RULES:
+            conc_rules.append(r)
+        else:
+            raise KeyError("unknown rule %r (have: %s)" % (
+                r, ", ".join(sorted(set(SOURCE_RULES)
+                                    | set(_conc.CONCURRENCY_RULES)))))
+    return src_rules, conc_rules
 
 
-def lint_source(src, path="<string>", rules=None):
-    """Lint one Python source string; returns surviving Findings."""
+def _filter_suppressed(findings, per_line, file_wide):
+    for f in findings:
+        if f.rule_id in file_wide:
+            continue
+        line_dis = per_line.get(f.line, ())
+        if f.rule_id in line_dis or "all" in line_dis:
+            continue
+        yield f
+
+
+def lint_source(src, path="<string>", rules=None, interprocedural=True):
+    """Lint one Python source string; returns surviving Findings.
+    ``interprocedural=False`` skips the whole-program concurrency rules
+    (lint_paths runs them once over the full file set instead)."""
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
@@ -421,17 +351,17 @@ def lint_source(src, path="<string>", rules=None):
                         "cannot parse: %s" % e, path=path,
                         line=e.lineno or 1)]
     per_line, file_wide = _suppressions(src)
-    selected = (SOURCE_RULES.values() if rules is None
-                else [SOURCE_RULES[r] for r in rules])
+    src_rules, conc_rules = _split_rules(rules)
+    selected = (SOURCE_RULES.values() if src_rules is None
+                else [SOURCE_RULES[r] for r in src_rules])
     findings = []
     for cls in selected:
-        for f in cls().check(tree, path):
-            if f.rule_id in file_wide:
-                continue
-            line_dis = per_line.get(f.line, ())
-            if f.rule_id in line_dis or "all" in line_dis:
-                continue
-            findings.append(f)
+        findings.extend(_filter_suppressed(cls().check(tree, path),
+                                           per_line, file_wide))
+    if interprocedural and (conc_rules is None or conc_rules):
+        findings.extend(_filter_suppressed(
+            _conc.analyze_sources([(path, src)], rules=conc_rules),
+            per_line, file_wide))
     findings.sort(key=lambda f: (f.line or 0, f.rule_id))
     return findings
 
@@ -449,10 +379,13 @@ def _iter_py_files(path):
 
 
 def lint_paths(paths, rules=None):
-    """Lint files/trees. ``.py`` goes through the AST rules; ``.json`` is
-    handed to the graph analyzer (``analysis.analyze_json``) so serialized
-    symbol graphs ride the same gate."""
+    """Lint files/trees. ``.py`` goes through the AST rules plus ONE
+    whole-program concurrency analysis over every collected file (so
+    cross-module lock-order cycles resolve); ``.json`` is handed to the
+    graph analyzer (``analysis.analyze_json``) so serialized symbol
+    graphs ride the same gate."""
     findings = []
+    py_sources = []
     for p in paths:
         if p.endswith(".json") and os.path.isfile(p):
             from incubator_mxnet_tpu.analysis import GRAPH_RULES, analyze_json
@@ -468,7 +401,20 @@ def lint_paths(paths, rules=None):
             continue
         for fpath in _iter_py_files(p):
             with open(fpath, encoding="utf-8") as fh:
-                findings.extend(lint_source(fh.read(), fpath, rules=rules))
+                src = fh.read()
+            py_sources.append((fpath, src))
+            findings.extend(lint_source(src, fpath, rules=rules,
+                                        interprocedural=False))
+    _, conc_rules = _split_rules(rules)
+    if py_sources and (conc_rules is None or conc_rules):
+        sup = {p: _suppressions(s) for p, s in py_sources}
+        conc = []
+        for f in _conc.analyze_sources(py_sources, rules=conc_rules,
+                                       root=os.getcwd()):
+            per_line, file_wide = sup.get(f.path, ({}, set()))
+            conc.extend(_filter_suppressed([f], per_line, file_wide))
+        conc.sort(key=lambda f: (f.path or "", f.line or 0, f.rule_id))
+        findings.extend(conc)
     return findings
 
 
@@ -486,10 +432,11 @@ def main(argv=None):
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
-        unknown = [r for r in rules if r not in SOURCE_RULES]
+        known = set(SOURCE_RULES) | set(_conc.CONCURRENCY_RULES)
+        unknown = [r for r in rules if r not in known]
         if unknown:
             ap.error("unknown rule(s): %s (have: %s)"
-                     % (", ".join(unknown), ", ".join(sorted(SOURCE_RULES))))
+                     % (", ".join(unknown), ", ".join(sorted(known))))
     findings = lint_paths(args.paths, rules=rules)
     if args.json:
         print(json.dumps([f.to_dict() for f in findings], indent=2))
