@@ -16,8 +16,8 @@ host transfer of the final loss (float(...)), which cannot complete before
 every queued step has executed on device.
 
 BENCH_MODEL selects a single benchmark: resnet50 | bert | bert_long |
-resnet50_pipe | lstm | ssd | serving_bert | llm_decode | load_storm
-| stream_input | ... (see _dispatch). bert runs REAL BERT-base pretraining — BERTForPretrain
+resnet50_pipe | lstm | ssd | serving_bert | llm_decode | llm_capacity
+| load_storm | stream_input | ... (see _dispatch). bert runs REAL BERT-base pretraining — BERTForPretrain
 with the full MLM objective (gather-first masked-position decode through
 the 768x30522 vocab projection, loss on the 15% masked slots) plus the
 NSP head, per the reference pretraining recipe.
@@ -1214,6 +1214,114 @@ def bench_llm_decode():
         chips=chips, model="gpt_%dx%d" % (units, layers))
 
 
+def bench_llm_capacity():
+    """BENCH_MODEL=llm_capacity: KV-capacity ceiling — how many
+    concurrent decode sessions fit before the paged-KV block pool sheds.
+    The pool is deliberately OVERSUBSCRIBED (num_blocks = oversub x the
+    full-capacity grid), then session waves n = 1, 2, ... each run a
+    full generate() through the engine until a wave dies with
+    ``KVPoolExhausted``; capacity is the last wave that completed. The
+    gated metric is ``concurrent_sessions_per_chip``
+    (``higher_is_better``: a paging/eviction improvement should RAISE
+    it; a KV-layout regression that fattens blocks lowers it and trips
+    tools/bench_diff.py). The run also exercises the memz plane end to
+    end: the exhaustion increments mxtpu_gen_kv_pool_exhausted_total
+    and fires the oom.kv_pool flight event.
+
+    Knobs: BENCH_CAP_SLOTS (8), BENCH_CAP_OVERSUB (0.5; fraction of
+    full block capacity the pool actually gets), BENCH_CAP_PROMPT (32),
+    BENCH_CAP_NEW (32), and the model-size BENCH_LLM_LAYERS/HEADS/
+    UNITS/VOCAB knobs shared with llm_decode."""
+    import jax
+    from incubator_mxnet_tpu.generate import GenerateEngine, GPTPagedLM
+    from incubator_mxnet_tpu.generate.paged_kv import KVPoolExhausted
+    from incubator_mxnet_tpu.models.gpt import gpt_config, gpt_param_shapes
+
+    layers = int(os.environ.get("BENCH_LLM_LAYERS", "4"))
+    heads = int(os.environ.get("BENCH_LLM_HEADS", "4"))
+    units = int(os.environ.get("BENCH_LLM_UNITS", "256"))
+    vocab = int(os.environ.get("BENCH_LLM_VOCAB", "512"))
+    prompt_len = int(os.environ.get("BENCH_CAP_PROMPT", "32"))
+    new_tokens = int(os.environ.get("BENCH_CAP_NEW", "32"))
+    slots = int(os.environ.get("BENCH_CAP_SLOTS", "8"))
+    oversub = float(os.environ.get("BENCH_CAP_OVERSUB", "0.5"))
+    max_len = prompt_len + new_tokens
+
+    cfg = gpt_config(dict(vocab_size=vocab, units=units,
+                          num_layers=layers, num_heads=heads,
+                          max_len=max_len))
+    rng = np.random.RandomState(0)
+    params = {n: (rng.randn(*s) * 0.02).astype(np.float32)
+              for n, s in gpt_param_shapes(cfg).items()}
+    target = GPTPagedLM(params, cfg)
+
+    probe = target.make_cache(slots, max_len=max_len)
+    full_blocks = probe.num_blocks          # full-capacity grid parity
+    block_size = probe.block_size
+    num_blocks = max(1, int(full_blocks * oversub))
+    cache = target.make_cache(slots, max_len=max_len,
+                              num_blocks=num_blocks, name="bench_cap")
+    engine = GenerateEngine(target, cache, spec_k=0)
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, vocab, prompt_len).tolist()
+               for _ in range(slots)]
+    blocks_per_session = -(-max_len // block_size)   # ceil
+
+    def ramp():
+        """Admit growing waves until the pool sheds; return the last
+        wave size that completed (0 = even one session doesn't fit)."""
+        cap, bound = 0, "slots"
+        for n in range(1, slots + 1):
+            try:
+                engine.generate(prompts[:n], max_new_tokens=new_tokens)
+            except KVPoolExhausted:
+                bound = "pool"
+                break
+            cap = n
+        return cap, bound
+
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    caps = []
+    bound = "slots"
+    for _ in range(repeats):
+        cap, b = ramp()
+        caps.append(cap)
+        if b == "pool":
+            bound = "pool"
+    caps.sort()
+    chips = max(1, jax.device_count())
+    per_chip = [c / chips for c in caps]
+    med = per_chip[repeats // 2] if repeats % 2 else \
+        0.5 * (per_chip[repeats // 2 - 1] + per_chip[repeats // 2])
+    stats = {"value": med, "repeats": repeats, "min": per_chip[0],
+             "max": per_chip[-1],
+             "spread_pct": round(100.0 * (per_chip[-1] - per_chip[0])
+                                 / med, 1) if med else None}
+    return _emit(
+        "concurrent_sessions_per_chip", "sessions/chip", stats,
+        higher_is_better=True,       # bench_diff gates non-/sec units
+                                     # only on this explicit flag
+        capacity_sessions=caps[repeats // 2], bound=bound,
+        slots=slots, num_blocks=num_blocks, full_blocks=full_blocks,
+        block_size=block_size, blocks_per_session=blocks_per_session,
+        oversubscription=oversub, prompt_len=prompt_len,
+        new_tokens=new_tokens, chips=chips,
+        pool_exhausted_total=_pool_exhausted_total(),
+        model="gpt_%dx%d" % (units, layers))
+
+
+def _pool_exhausted_total():
+    """Sum of the shed counter after the ramp — stamps the capacity row
+    with proof the measurement actually hit the pool wall (0 would mean
+    a slot-bound run)."""
+    from incubator_mxnet_tpu.telemetry import catalog as _cat
+    try:
+        return int(sum(_cat.gen_kv_pool_exhausted.snapshot().values()))
+    except Exception:   # noqa: BLE001 — a stamp, never a failure
+        return None
+
+
 def bench_load_storm():
     """BENCH_MODEL=load_storm: the trace-driven load-storm harness
     (tools/loadstorm.py) replayed against an in-process TWO-replica
@@ -1895,6 +2003,8 @@ def _dispatch(model, batch, steps, dtype):
         return bench_serving()
     if model == "llm_decode":
         return bench_llm_decode()
+    if model == "llm_capacity":
+        return bench_llm_capacity()
     if model == "load_storm":
         return bench_load_storm()
     if model == "stream_input":

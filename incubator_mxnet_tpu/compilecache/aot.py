@@ -37,11 +37,12 @@ import time
 
 from ..telemetry import catalog as _cat
 from ..telemetry import costs as _costs
+from ..telemetry import memz as _memz
 from . import store as _store
 
 __all__ = ["compile_key", "serialize_compiled", "deserialize_compiled",
            "cached_compile", "BlockProgram", "block_program",
-           "bind_block_program", "capture_cost"]
+           "bind_block_program", "capture_cost", "capture_memory"]
 
 log = logging.getLogger(__name__)
 
@@ -123,6 +124,7 @@ def cached_compile(lowered, name, where="other", mesh=None, donation=(),
     if st is None:
         with _cat.compiling(where):
             compiled = lowered.compile()
+        capture_memory(name, compiled)
         return (compiled, None) if want_blob else compiled
     key = compile_key(lowered, mesh=mesh, donation=donation,
                       extra=(name,) + tuple(extra))
@@ -131,6 +133,7 @@ def cached_compile(lowered, name, where="other", mesh=None, donation=(),
         payload, header = ent
         try:
             compiled = deserialize_compiled(payload)
+            capture_memory(name, compiled)
             return (compiled, payload) if want_blob else compiled
         except Exception as e:  # noqa: BLE001 — a stale/foreign entry
             # (jaxlib drift the key missed, partial backend support)
@@ -143,6 +146,7 @@ def cached_compile(lowered, name, where="other", mesh=None, donation=(),
     with _cat.compiling(where):
         compiled = lowered.compile()
     dt = time.perf_counter() - t0
+    capture_memory(name, compiled)
     try:
         blob = serialize_compiled(compiled)
     except Exception as e:  # noqa: BLE001 — backends without executable
@@ -266,9 +270,23 @@ def capture_cost(name, compiled, samples_per_exec=None):
     """Best-effort ``telemetry.costs`` capture off an already-compiled
     executable — the satellite fix for the MXTPU_COSTS double compile:
     callers hand in the SAME executable they will run."""
+    capture_memory(name, compiled)   # memz rides the same seam
     if not _costs.capture_enabled():
         return
     try:
         _costs.capture(name, compiled, samples_per_exec=samples_per_exec)
     except Exception:  # noqa: BLE001 — accounting must never fail the
         pass           # step (deserialized executables may lack costs)
+
+
+def capture_memory(name, compiled):
+    """Best-effort ``telemetry.memz`` footprint capture off an
+    already-compiled executable — every ``cached_compile`` return path
+    calls this, so trainer, serving and the gpt program grid each get a
+    footprint-table row from the SAME executable the step runs.  One
+    predicate check with the memz plane off."""
+    try:
+        _memz.capture_memory(name, compiled)
+    except Exception:  # noqa: BLE001 — accounting must never fail the
+        pass           # step (deserialized executables may lack
+                       # memory analysis on some backends)

@@ -33,8 +33,29 @@ import math
 import numpy as np
 
 from ..telemetry import catalog as _cat
+from ..telemetry import flight as _flight
+from ..telemetry import memz as _memz
 
-__all__ = ["PagedKVCache"]
+__all__ = ["PagedKVCache", "KVPoolExhausted"]
+
+
+class KVPoolExhausted(ValueError):
+    """An append found no free block in the paged pool.
+
+    Typed (rather than the bare ValueError it subclasses for backward
+    compatibility) so shed-on-pressure is distinguishable from a bug:
+    the serving loop catches this to shed the session as a capacity
+    event, anything else stays an error.  Carries the pool geometry the
+    handler needs to report without re-deriving it."""
+
+    def __init__(self, message, name=None, slot=None, block=None,
+                 num_blocks=None, block_size=None):
+        super().__init__(message)
+        self.name = name
+        self.slot = slot
+        self.block = block
+        self.num_blocks = num_blocks
+        self.block_size = block_size
 
 _KINDS = ("state", "kv")
 
@@ -76,7 +97,11 @@ class PagedKVCache:
                              % self.block_size)
         self.max_blocks_per_slot = max(
             1, math.ceil(self.max_len / self.block_size))
+        # MXTPU_GEN_NUM_BLOCKS oversubscribes every pool in the process
+        # (capacity drills, llm_capacity bench) without threading a
+        # num_blocks argument through make_cache/load signatures
         self.num_blocks = int(num_blocks or
+                              _env_int("MXTPU_GEN_NUM_BLOCKS", 0) or
                               self.slots * self.max_blocks_per_slot)
         self.name = name
         self.spec = {}
@@ -96,6 +121,9 @@ class PagedKVCache:
         self._live = set()
         self._free_blocks = list(range(self.num_blocks - 1, -1, -1))
         self._tables = {}          # slot -> [block ids], shared by kv entries
+        self._peak_blocks = 0
+        self._pressure_noted = False
+        _memz.register_kv_cache(self)
         self._note_blocks()
 
     # ------------------------------------------------------------- slots
@@ -161,10 +189,15 @@ class PagedKVCache:
         table = self._tables[slot]
         if bi == len(table):
             if not self._free_blocks:
-                raise ValueError(
+                _cat.gen_kv_pool_exhausted.inc(name=self.name)
+                _memz.on_pool_exhausted(self, slot=slot, block=bi)
+                raise KVPoolExhausted(
                     "paged KV pool exhausted (%d blocks of %d positions); "
                     "slot %d needs block %d"
-                    % (self.num_blocks, self.block_size, slot, bi))
+                    % (self.num_blocks, self.block_size, slot, bi),
+                    name=self.name, slot=slot, block=bi,
+                    num_blocks=self.num_blocks,
+                    block_size=self.block_size)
             block = self._free_blocks.pop()
             # zero the reused block across ALL kv entries so a partial
             # fill never exposes a previous sequence's tail
@@ -255,6 +288,24 @@ class PagedKVCache:
         return 1.0 - filled / float(mapped)
 
     def _note_blocks(self):
-        _cat.gen_kv_blocks_in_use.set(self.blocks_in_use, name=self.name)
-        _cat.gen_kv_blocks_free.set(self.blocks_free, name=self.name)
+        in_use = self.blocks_in_use
+        free = self.num_blocks - in_use
+        if in_use > self._peak_blocks:
+            self._peak_blocks = in_use
+        _cat.gen_kv_blocks_in_use.set(in_use, name=self.name)
+        _cat.gen_kv_blocks_free.set(free, name=self.name)
+        _cat.gen_kv_free_fraction.set(free / float(self.num_blocks),
+                                      name=self.name)
+        _cat.gen_kv_blocks_in_use_peak.set(self._peak_blocks,
+                                           name=self.name)
         _cat.gen_kv_fragmentation.set(self.fragmentation(), name=self.name)
+        _memz.note_kv(self)
+        # near-exhaustion flight event, edge-triggered so a pool parked
+        # at 95% doesn't spam the ring on every append
+        low = free < 0.1 * self.num_blocks
+        if low and not self._pressure_noted:
+            self._pressure_noted = True
+            _flight.record("gen.kv_pool_pressure", name=self.name,
+                           free=free, total=self.num_blocks)
+        elif not low and self._pressure_noted:
+            self._pressure_noted = False

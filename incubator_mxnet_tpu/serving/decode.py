@@ -45,6 +45,16 @@ from .scheduler import Request
 __all__ = ["DecodeRequest", "DecodeLoop"]
 
 
+def _is_capacity_error(e):
+    """KV pool exhaustion is pressure, not a bug: shed-on-pressure
+    (stage "capacity") keeps the client retrying against a less loaded
+    replica and feeds the kv_pool_pressure rule, while real step bugs
+    stay errors.  Imported lazily — generate -> serving.loader -> here
+    would otherwise cycle at import time."""
+    from ..generate.paged_kv import KVPoolExhausted
+    return isinstance(e, KVPoolExhausted)
+
+
 class DecodeRequest(Request):
     """Generate up to `max_new_tokens` after `prompt` (1-D int tokens);
     stops early at `eos_id`. Result: {"tokens": generated int32 array}.
@@ -304,7 +314,9 @@ class DecodeLoop:
                     self._prefill_fn(slot, req.prompt[:-1], self._cache)
                 except Exception as e:  # noqa: BLE001 — a broken
                     # prefill fails this request, not the serving loop
-                    if req.fail(e):
+                    if _is_capacity_error(e):
+                        self._shed(req, "capacity", str(e))
+                    elif req.fail(e):
                         _cat.serving_requests.inc(model=self.name,
                                                   status="error")
                     self._cache.free(slot)
@@ -362,10 +374,15 @@ class DecodeLoop:
                 logits = np.asarray(self._step_fn(tokens, self._cache,
                                                   mask))
             except Exception as e:  # noqa: BLE001 — a broken step fails
-                # the in-flight sequences, not the serving loop
+                # the in-flight sequences, not the serving loop; pool
+                # exhaustion mid-grid sheds the whole step's sessions as
+                # a capacity event (freeing their blocks IS the relief)
+                capacity = _is_capacity_error(e)
                 with self._cond:
                     for slot, seq in list(self._active.items()):
-                        if seq.req.fail(e):
+                        if capacity:
+                            self._shed(seq.req, "capacity", str(e))
+                        elif seq.req.fail(e):
                             _cat.serving_requests.inc(model=self.name,
                                                       status="error")
                         self._cache.free(slot)

@@ -27,8 +27,12 @@ adds both:
 - ``history``: bounded in-memory ring TSDB sampling the local registry
   and fleet scrapes (MXTPU_HISTORY_*), feeding health evaluation.
 - ``health``: declarative SLO rules (threshold / burn_rate / absence /
-  skew) with OK→WARN→PAGE hysteresis, surfaced via /alertz, /statusz,
-  mxtop and tools/healthcheck.py (MXTPU_HEALTH_*).
+  skew / kv_pool) with OK→WARN→PAGE hysteresis, surfaced via /alertz,
+  /statusz, mxtop and tools/healthcheck.py (MXTPU_HEALTH_*).
+- ``memz``: device-memory & KV-capacity plane — live HBM gauges with
+  watermarks, static per-program footprints off the aot compile seam,
+  the paged-KV block census, and OOM forensics dumped to
+  MXTPU_MEM_EXPORT (MXTPU_MEMZ=1, /memz debugz endpoint).
 
 See docs/OBSERVABILITY.md for the metric catalog and span semantics.
 """
@@ -44,6 +48,7 @@ from . import costs
 from . import aggregate
 from . import history
 from . import health
+from . import memz
 
 from .metrics import (enable, disable, enabled, counter, gauge, histogram,
                       snapshot, reset)
@@ -54,7 +59,8 @@ from .tracing import (span, current, inject, extract, from_meta,
                       record_span, build_timeline, render_timeline)
 
 __all__ = ["metrics", "tracing", "export", "catalog", "flight",
-           "debugz", "costs", "aggregate", "history", "health", "lockdep",
+           "debugz", "costs", "aggregate", "history", "health", "memz",
+           "lockdep",
            "enable", "disable", "enabled", "counter", "gauge", "histogram",
            "snapshot", "reset",
            "render_prometheus", "render_json", "flush", "start_flusher",

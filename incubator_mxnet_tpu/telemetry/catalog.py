@@ -244,7 +244,9 @@ serving_tpot_seconds = _m.histogram(
              0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
 serving_shed = _m.counter(
     "mxtpu_serving_shed_total",
-    "Requests shed by model and stage (queue|join|overload|decode)")
+    "Requests shed by model and stage (queue|join|overload|decode|"
+    "draining|capacity) — capacity = the paged KV pool was exhausted, "
+    "shed-on-pressure rather than a bug")
 serving_decode_steps = _m.counter(
     "mxtpu_serving_decode_steps_total",
     "Autoregressive decode steps executed by model")
@@ -301,6 +303,20 @@ gen_kv_fragmentation = _m.gauge(
     "Unused fraction of mapped paged-KV block capacity "
     "(1 - filled_positions / (blocks_in_use * block_size)); high values "
     "mean many ragged last blocks")
+gen_kv_free_fraction = _m.gauge(
+    "mxtpu_gen_kv_free_fraction",
+    "Free fraction of the paged-KV pool (blocks_free / num_blocks) — "
+    "the kv_pool_pressure WARN signal and the autoscaler's headroom "
+    "input, by pool name")
+gen_kv_blocks_in_use_peak = _m.gauge(
+    "mxtpu_gen_kv_blocks_in_use_peak",
+    "Pool-lifetime high watermark of mapped paged-KV blocks, by pool "
+    "name — how close this pool has ever come to exhaustion")
+gen_kv_pool_exhausted = _m.counter(
+    "mxtpu_gen_kv_pool_exhausted_total",
+    "KVPoolExhausted raises (an append found no free block), by pool "
+    "name — the kv_pool_pressure PAGE signal; shed-on-pressure is this "
+    "counter moving, a bug is this counter moving with free blocks left")
 
 
 # -- observability plane (tracing ring, flight, debugz, costs) --------
@@ -337,6 +353,45 @@ model_flops_utilization = _m.gauge(
 model_tokens_per_sec = _m.gauge(
     "mxtpu_model_tokens_per_sec",
     "Samples/tokens consumed per second by the named executable")
+
+
+# -- device-memory plane (telemetry/memz.py) -------------------------
+mem_device_bytes_in_use = _m.gauge(
+    "mxtpu_mem_device_bytes_in_use",
+    "Device memory currently allocated, by device — from the runtime "
+    "allocator (device.memory_stats) or the live_arrays fallback on "
+    "backends without one")
+mem_device_bytes_limit = _m.gauge(
+    "mxtpu_mem_device_bytes_limit",
+    "Device memory capacity visible to the allocator, by device (HBM "
+    "bytes on TPU/GPU; absent on CPU)")
+mem_device_peak_bytes = _m.gauge(
+    "mxtpu_mem_device_peak_bytes",
+    "Allocator-reported peak bytes in use since process start, by device")
+mem_hbm_used_fraction = _m.gauge(
+    "mxtpu_mem_hbm_used_fraction",
+    "bytes_in_use / bytes_limit, by device — the mxtop HBM%% column "
+    "and the first thing to look at before an OOM")
+mem_host_rss_bytes = _m.gauge(
+    "mxtpu_mem_host_rss_bytes",
+    "Host-process resident set size (the Python side of the memory "
+    "story: numpy staging buffers, executables, the framework itself)")
+mem_watermark_bytes = _m.gauge(
+    "mxtpu_mem_watermark_bytes",
+    "Process-lifetime memory high watermark, by scope "
+    "(device:<name> | host_rss)")
+mem_program_bytes = _m.gauge(
+    "mxtpu_mem_program_bytes",
+    "Static per-program memory footprint from compiled.memory_analysis, "
+    "by program name and kind (argument|output|temp|generated_code|"
+    "total) — captured at the aot.cached_compile seam on the SAME "
+    "executable the step runs")
+oom_events = _m.counter(
+    "mxtpu_oom_events_total",
+    "Out-of-memory observations by kind (kv_pool = paged pool "
+    "exhausted, resource_exhausted = XLA RESOURCE_EXHAUSTED) — each "
+    "one left an oom.* flight event and, with MXTPU_MEM_EXPORT set, "
+    "a post-mortem dump")
 
 
 # -- persistent compile cache (compilecache/) ------------------------
@@ -446,6 +501,14 @@ def default_health_rules():
          "metric": "mxtpu_serving_batch_occupancy:p99", "source": "latest",
          "warn": _f("MXTPU_HEALTH_OCCUPANCY_WARN", 0.9) *
                  _f("MXTPU_SERVE_MAX_BATCH", 8)},
+        # KV-block economy: WARN while any paged pool sustains low free
+        # blocks (the autoscaler's scale-up signal), PAGE when appends
+        # are actually dying of exhaustion (sessions are being shed).
+        {"type": "kv_pool", "name": "kv_pool_pressure",
+         "free_warn": _f("MXTPU_HEALTH_KV_POOL_FREE_WARN", 0.10),
+         "exhausted_page": _f("MXTPU_HEALTH_KV_POOL_EXHAUSTED_PAGE", 3.0),
+         "window": fast,
+         "fire_for": int(_f("MXTPU_HEALTH_KV_POOL_FOR", 2))},
         # Fleet consistency: ranks disagreeing on the membership epoch
         # means someone is acting on a stale view.
         {"type": "threshold", "name": "membership_epoch_stale",

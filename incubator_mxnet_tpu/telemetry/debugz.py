@@ -17,6 +17,10 @@ debugging — no dependencies, daemon threads only, loopback by default:
     /flightz    flight-recorder ring contents
     /alertz     health-plane verdict + rule config (JSON;
                 ``?format=text`` renders the human screen)
+    /memz       device-memory plane: per-device HBM, host RSS,
+                watermarks, per-program footprints and the paged-KV
+                block census (JSON; ``?format=text`` renders the
+                human screen)
 
 Opt-in via ``MXTPU_DEBUGZ_PORT`` (0 = auto-bind a free port; the bound
 address is printed to stderr) — ``start_from_env()`` is a no-op when
@@ -71,6 +75,8 @@ def status_dict():
     out["health"] = _health.statusz_entry()
     from . import lockdep as _lockdep
     out["lockdep"] = _lockdep.statusz_entry()
+    from . import memz as _memz
+    out["memz"] = _memz.statusz_entry()
     with _lock:
         entries = list(_status.items())
     for key, value in entries:
@@ -84,6 +90,16 @@ def status_dict():
             out["jax_devices"] = [str(d) for d in jx.devices()]
         except Exception:  # mxlint: disable=broad-except — statusz must render even when the backend is mid-teardown
             pass
+        # fleet-capacity identity (platform/kind/count + HBM bytes per
+        # device): aggregate.scrape and the autoscaler read capacity
+        # from here instead of a side channel
+        from . import memz as _memz
+        try:
+            ident = _memz.device_identity()
+            if ident is not None:
+                out["device_identity"] = ident
+        except Exception:  # mxlint: disable=broad-except — statusz must render even when the backend is mid-teardown
+            pass
     return out
 
 
@@ -91,7 +107,7 @@ def _index():
     lines = ["mxtpu debugz (role=%s rank=%s pid=%d)" %
              (_state["role"], _state["rank"], os.getpid()), ""]
     lines += ["/metrics", "/metrics.json", "/statusz", "/tracez",
-              "/threadz", "/flightz", "/alertz", ""]
+              "/threadz", "/flightz", "/alertz", "/memz", ""]
     return "\n".join(lines)
 
 
@@ -165,6 +181,16 @@ class _Handler(BaseHTTPRequestHandler):
                     ctype = "text/plain; charset=utf-8"
                 else:
                     body = json.dumps(health.alertz_dict(), indent=2,
+                                      default=str)
+                    ctype = "application/json"
+            elif path == "/memz":
+                from . import memz
+                query = self.path.partition("?")[2]
+                if "format=text" in query:
+                    body = memz.render_text()
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    body = json.dumps(memz.memz_dict(), indent=2,
                                       default=str)
                     ctype = "application/json"
             else:

@@ -154,6 +154,11 @@ def _atexit_flush():
         _flight.dump()              # MXTPU_FLIGHT_EXPORT
     except OSError:
         pass
+    try:
+        from . import memz as _memz
+        _memz.dump(reason="atexit")  # MXTPU_MEM_EXPORT
+    except OSError:
+        pass
 
 
 atexit.register(_atexit_flush)

@@ -29,6 +29,10 @@ Rule types:
     Cross-rank straggler: any rank whose per-rank series value (e.g.
     a step-time p99) exceeds the fleet median by ``warn_factor`` /
     ``page_factor``.
+``kv_pool``
+    Paged-KV pool pressure: WARN while any pool's free fraction sits
+    below ``free_warn``, PAGE when the exhaustion counter burned
+    ``exhausted_page`` raises inside the window.
 
 Transitions pass through per-rule hysteresis (``fire_for`` consecutive
 breaching evaluations to raise, ``clear_for`` to lower) and are
@@ -49,7 +53,8 @@ import time
 from . import history as _history
 
 __all__ = ["OK", "WARN", "PAGE", "Rule", "ThresholdRule", "BurnRateRule",
-           "AbsenceRule", "SkewRule", "make_rule", "HealthEvaluator",
+           "AbsenceRule", "SkewRule", "KVPoolPressureRule", "make_rule",
+           "HealthEvaluator",
            "install", "uninstall", "evaluator", "enabled", "tick",
            "verdict", "statusz_entry", "alertz_dict", "render_text",
            "start_loop", "stop_loop"]
@@ -314,8 +319,63 @@ class SkewRule(Rule):
         return OK, factor, detail
 
 
+class KVPoolPressureRule(Rule):
+    """Paged-KV pool capacity: WARN while any pool sustains a free
+    fraction below ``free_warn`` (headroom is gone — the autoscaler's
+    scale-up signal), PAGE when ``exhausted_page`` or more appends died
+    of pool exhaustion inside the window (sessions are being shed NOW).
+    Two signals, one rule: the same pressure at two severities."""
+
+    type = "kv_pool"
+
+    def __init__(self, name, free_metric="mxtpu_gen_kv_free_fraction",
+                 exhausted_metric="mxtpu_gen_kv_pool_exhausted_total",
+                 key="", free_warn=0.10, exhausted_page=3.0,
+                 window=300.0, **kw):
+        super().__init__(name, **kw)
+        self.free_metric = free_metric
+        self.exhausted_metric = exhausted_metric
+        self.key = key
+        self.free_warn = float(free_warn)
+        self.exhausted_page = float(exhausted_page)
+        self.window = float(window)
+
+    def _params(self):
+        return {"free_metric": self.free_metric,
+                "exhausted_metric": self.exhausted_metric,
+                "key": self.key, "free_warn": self.free_warn,
+                "exhausted_page": self.exhausted_page,
+                "window": self.window}
+
+    def raw_level(self, history, now):
+        burn, saw_burn = 0.0, False
+        for key in _match_keys(history, self.exhausted_metric, self.key):
+            inc = history.increase(self.exhausted_metric, key,
+                                   self.window, now)
+            if inc is not None:
+                burn += inc
+                saw_burn = True
+        frees = []
+        for key in _match_keys(history, self.free_metric, self.key):
+            v = history.latest(self.free_metric, key)
+            if v is not None:
+                frees.append(v)
+        min_free = min(frees) if frees else None
+        if min_free is None and not saw_burn:
+            return OK, None, {"reason": "no data"}
+        detail = {"min_free_fraction": min_free,
+                  "exhausted_increase": burn if saw_burn else None,
+                  "pools": len(frees)}
+        if saw_burn and burn >= self.exhausted_page:
+            return PAGE, burn, detail
+        if min_free is not None and min_free < self.free_warn:
+            return WARN, min_free, detail
+        return OK, min_free, detail
+
+
 _RULE_TYPES = {"threshold": ThresholdRule, "burn_rate": BurnRateRule,
-               "absence": AbsenceRule, "skew": SkewRule}
+               "absence": AbsenceRule, "skew": SkewRule,
+               "kv_pool": KVPoolPressureRule}
 
 
 def make_rule(spec):

@@ -259,6 +259,8 @@ def sample_local():
     One predicate check when the plane is disabled."""
     if not _state["enabled"]:
         return None
+    from . import memz as _memz
+    _memz.sample()    # memory gauges/watermarks ride the same cadence
     hist = default()
     hist.record_registry()
     from . import catalog as _cat

@@ -1,0 +1,538 @@
+"""memz — the device-memory & KV-capacity observability plane.
+
+- device_stats: memory_stats() is None on the CPU backend, so the
+  live_arrays fallback must attribute real buffer bytes per device
+- capture_memory via the compilecache/aot seam: exactly ONE footprint
+  entry per program name (re-capture replaces), from the SAME
+  executable the step runs
+- watermarks: process-lifetime peaks only ever advance
+- /memz debugz endpoint (JSON + ?format=text) and the /statusz
+  device-identity + memz sections
+- KVPoolExhausted: typed (ValueError-compatible) with pool geometry
+  attrs; exhaustion bumps mxtpu_gen_kv_pool_exhausted_total and leaves
+  oom.kv_pool in the flight ring; near-exhaustion (<10% free) fires
+  the gen.kv_pool_pressure edge event
+- OOM post-mortem: record_oom writes an atomic, parseable JSON dump
+  (ranked live buffers, program footprints, KV census, watermarks)
+- KVPoolPressureRule: OK with headroom, WARN on sustained low free
+  fraction, PAGE on an exhaustion burn inside the window
+- two-process acceptance drill: an oversubscribed gpt-spec pool driven
+  to exhaustion walks kv_pool_pressure OK→WARN→PAGE in /alertz, leaves
+  the oom.kv_pool flight event and a readable MXTPU_MEM_EXPORT
+  post-mortem, and tools/healthcheck.py exits 2
+"""
+
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx  # noqa: F401 — forces the cpu mesh env
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.generate.paged_kv import (KVPoolExhausted,
+                                                   PagedKVCache)
+from incubator_mxnet_tpu.telemetry import (catalog, debugz, flight,
+                                           health, history, memz)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPEC = {"k0": ("kv", (2, 4)), "v0": ("kv", (2, 4))}
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    """memz/flight/health hold module state: leave every test with the
+    planes off and empty."""
+    yield
+    memz.reset()
+    memz.disable()
+    flight.clear()
+    flight.disable()
+    telemetry.disable()
+    health.uninstall()
+    history.stop_sampler()
+    history.reset()
+    history.disable()
+    history._state["default"] = None
+
+
+def _fill(cache, slot, upto):
+    """Commit positions until ``lengths[slot] == upto`` (engine-style:
+    every kv entry written, then advance)."""
+    while int(cache.lengths[slot]) < upto:
+        for name, (_kind, shape, dtype) in cache.spec.items():
+            cache.append(name, slot, np.zeros(shape, dtype))
+        cache.advance(slot)
+
+
+# ------------------------------------------------------- live accounting
+
+def test_device_stats_cpu_fallback_counts_live_arrays():
+    import jax.numpy as jnp
+    arr = jnp.ones((256, 256), jnp.float32)       # 256KiB held live
+    stats = memz.device_stats()
+    assert stats, "jax is imported — stats must not be empty"
+    assert all(s["source"] == "live_arrays" for s in stats)
+    assert sum(s["bytes_in_use"] for s in stats) >= arr.nbytes
+    assert all(s["platform"] == "cpu" for s in stats)
+    del arr
+
+
+def test_host_memory_reports_rss_and_peak():
+    h = memz.host_memory()
+    assert h["rss_bytes"] > 0
+    assert h["peak_rss_bytes"] >= h["rss_bytes"] * 0.5
+
+
+def test_device_identity_names_the_cpu_fleet():
+    ident = memz.device_identity()
+    assert ident is not None
+    assert ident["platform"] == "cpu"
+    assert ident["device_count"] >= 1
+
+
+def test_watermarks_only_advance():
+    memz.enable()
+    memz.sample()
+    first = memz.watermarks()
+    assert first.get("host_rss") and first["host_rss"] > 0
+    memz._note_watermark("host_rss", 1.0)          # lower: must not regress
+    assert memz.watermarks()["host_rss"] == first["host_rss"]
+    memz.sample()
+    after = memz.watermarks()
+    assert all(after[k] >= v for k, v in first.items())
+
+
+# ------------------------------------------- static program footprints
+
+def test_capture_memory_pins_one_entry_per_program():
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.compilecache import aot
+    memz.enable()
+    telemetry.enable()
+    lowered = jax.jit(lambda x: (x * 2.0).sum()).lower(
+        jnp.ones((64, 64), jnp.float32))
+    assert "MXTPU_COMPILE_CACHE_DIR" not in os.environ
+    compiled = aot.cached_compile(lowered, name="memz_probe")
+    assert compiled is not None
+    ent = memz.programs("memz_probe")
+    assert ent is not None and ent["total_bytes"] is not None
+    assert ent["argument_bytes"] is not None
+    # re-capture replaces: still exactly one entry for the name
+    aot.cached_compile(lowered, name="memz_probe")
+    assert list(memz.programs()) == ["memz_probe"]
+    # and the footprint is exported as a gauge
+    assert catalog.mem_program_bytes.value(
+        name="memz_probe", kind="total") == ent["total_bytes"]
+
+
+def test_capture_memory_disabled_records_nothing():
+    memz.disable()
+    memz.capture_memory("ghost", compiled=object())
+    assert memz.programs() == {}
+
+
+# ------------------------------------------------------ KV-block economy
+
+def test_kv_pool_exhausted_is_typed_and_instrumented():
+    telemetry.enable()
+    flight.enable()
+    memz.enable()
+    cache = PagedKVCache(2, SPEC, max_len=32, block_size=4,
+                         num_blocks=3, name="tiny")
+    slot = cache.alloc()
+    with pytest.raises(ValueError) as ei:          # backward-compat type
+        _fill(cache, slot, 32)
+    e = ei.value
+    assert isinstance(e, KVPoolExhausted)
+    assert e.name == "tiny" and e.slot == slot
+    assert e.num_blocks == 3 and e.block_size == 4
+    assert e.block == 3                            # first unmappable block
+    assert catalog.gen_kv_pool_exhausted.value(name="tiny") == 1
+    events = [ev["event"] for ev in flight.events()]
+    assert "gen.kv_pool_pressure" in events        # <10% free edge event
+    assert "oom.kv_pool" in events
+    oom = [ev for ev in flight.events() if ev["event"] == "oom.kv_pool"][0]
+    assert oom["attrs"]["pool"] == "tiny"
+    assert catalog.oom_events.value(kind="kv_pool") == 1
+
+
+def test_kv_census_and_gauges_track_the_pool():
+    telemetry.enable()
+    memz.enable()
+    cache = PagedKVCache(2, SPEC, max_len=32, block_size=4,
+                         num_blocks=8, name="census")
+    s0 = cache.alloc()
+    _fill(cache, s0, 8)                            # 2 blocks
+    census = [p for p in memz.kv_census() if p["name"] == "census"]
+    assert len(census) == 1
+    p = census[0]
+    assert p["blocks_in_use"] == 2 and p["blocks_free"] == 6
+    assert p["free_fraction"] == pytest.approx(0.75)
+    assert p["slots_in_use"] == 1 and p["slots"] == 2
+    assert p["per_slot"] == [{"slot": s0, "length": 8, "blocks": 2}]
+    assert catalog.gen_kv_free_fraction.value(name="census") == \
+        pytest.approx(0.75)
+    cache.free(s0)
+    assert catalog.gen_kv_free_fraction.value(name="census") == 1.0
+    assert catalog.gen_kv_blocks_in_use_peak.value(name="census") == 2
+    # the kv watermark rode along (block count, not bytes)
+    assert memz.watermarks().get("kv:census") == 2
+
+
+def test_env_num_blocks_oversubscribes_every_pool(monkeypatch):
+    monkeypatch.setenv("MXTPU_GEN_NUM_BLOCKS", "5")
+    cache = PagedKVCache(4, SPEC, max_len=64, block_size=4, name="env")
+    assert cache.num_blocks == 5                   # not 4*16 parity
+
+
+# ----------------------------------------------------------- OOM dumps
+
+def test_oom_post_mortem_roundtrip(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+    path = str(tmp_path / "oom.json")
+    monkeypatch.setenv("MXTPU_MEM_EXPORT", path)
+    memz.enable()
+    flight.enable()
+    arr = jnp.ones((128, 128), jnp.float32)
+    cache = PagedKVCache(1, SPEC, max_len=16, block_size=4,
+                         num_blocks=2, name="pm")
+    slot = cache.alloc()
+    _fill(cache, slot, 6)
+    memz.record_oom("kv_pool", pool="pm", throttle=False)
+    assert os.path.exists(path)
+    pm = json.load(open(path))
+    assert pm["reason"] == "oom.kv_pool" and pm["pid"] == os.getpid()
+    assert pm["live_buffers"]["count"] >= 1
+    assert any(r["nbytes"] >= arr.nbytes
+               for r in pm["live_buffers"]["top"])
+    pools = {p["name"]: p for p in pm["kv"]}
+    assert pools["pm"]["blocks_in_use"] == 2
+    assert "kv:pm" in pm["watermarks"]
+    del arr
+
+
+def test_dump_is_a_noop_without_export_path(monkeypatch):
+    monkeypatch.delenv("MXTPU_MEM_EXPORT", raising=False)
+    memz.enable()
+    assert memz.dump(reason="nothing") is None
+
+
+# ------------------------------------------------------- debugz surface
+
+def test_memz_endpoint_and_statusz_identity():
+    telemetry.enable()
+    memz.enable()
+    memz.sample()
+    srv = debugz.start(0)
+    try:
+        port = srv.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d%s" % (port, path),
+                    timeout=10) as r:
+                assert r.status == 200
+                return r.read().decode("utf-8")
+
+        d = json.loads(get("/memz"))
+        assert d["enabled"] is True
+        assert d["devices"] and d["host"]["rss_bytes"] > 0
+        text = get("/memz?format=text")
+        assert text.startswith("memz: enabled")
+        assert "host rss=" in text
+        status = json.loads(get("/statusz"))
+        assert status["memz"]["enabled"] is True
+        ident = status["device_identity"]
+        assert ident["platform"] == "cpu" and ident["device_count"] >= 1
+        assert "/memz" in get("/")
+    finally:
+        debugz.stop()
+
+
+# ----------------------------------------------------- health rule unit
+
+def test_kv_pool_pressure_rule_walks_ok_warn_page():
+    telemetry.enable()
+    free = catalog.gen_kv_free_fraction
+    burn = catalog.gen_kv_pool_exhausted
+    hist = history.MetricHistory()
+    rule = health.make_rule({"type": "kv_pool", "name": "kvp",
+                             "key": "name=kvprule", "free_warn": 0.10,
+                             "exhausted_page": 3.0, "window": 20.0})
+    free.set(0.5, name="kvprule")
+    hist.record_registry(ts=100.0)
+    assert rule.raw_level(hist, 101.0)[0] == health.OK
+    free.set(0.05, name="kvprule")                       # headroom gone
+    burn.inc(name="kvprule")
+    hist.record_registry(ts=110.0)
+    lvl, _val, detail = rule.raw_level(hist, 111.0)
+    assert lvl == health.WARN
+    assert detail["min_free_fraction"] == pytest.approx(0.05)
+    burn.inc(4, name="kvprule")                          # 4 more in-window
+    hist.record_registry(ts=120.0)
+    lvl, _val, detail = rule.raw_level(hist, 121.0)
+    assert lvl == health.PAGE
+    assert detail["exhausted_increase"] >= 3.0
+
+    specs = [r["name"] for r in catalog.default_health_rules()]
+    assert "kv_pool_pressure" in specs
+
+
+# -------------------------------------- two-process acceptance drill
+
+def _memz_drill_worker():
+    os.environ["MXTPU_DEBUGZ_PORT"] = "0"
+    os.environ["MXTPU_MEM_EXPORT"] = os.path.join(
+        os.environ["MXTPU_DRILL_TMP"], "oom_post_mortem.json")
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.kvstore.dist import KVStoreDist
+    telemetry.enable()
+    flight.enable()
+    memz.enable()
+    memz.install_oom_hooks()
+    health.install()        # default pack, env-compressed windows
+
+    kv = KVStoreDist("dist_sync")
+    kv.init("w", nd.ones((4,)))
+    _KV.append(kv)
+
+    # oversubscribed gpt-spec pool: 2 slots x 16 blocks of demand, 20
+    # blocks of supply — slot0 parks on 9, slot1's growth is the drill
+    from incubator_mxnet_tpu.generate.engine import GPTPagedLM
+    from incubator_mxnet_tpu.models.gpt import (gpt_config,
+                                                gpt_param_shapes)
+    cfg = gpt_config(dict(vocab_size=64, units=16, num_layers=1,
+                          num_heads=2, max_len=64))
+    rng = np.random.RandomState(0)
+    params = {n: (rng.randn(*s) * 0.02).astype(np.float32)
+              for n, s in gpt_param_shapes(cfg).items()}
+    lm = GPTPagedLM(params, cfg)
+    cache = lm.make_cache(2, max_len=64, block_size=4, num_blocks=20,
+                          name="drill")
+    _KV.append(cache)
+
+    levels = []
+
+    def tick():
+        memz.sample()
+        v = health.tick()
+        levels.append(v["rules"]["kv_pool_pressure"]["level"])
+
+    s0 = cache.alloc()
+    _fill(cache, s0, 8)                  # 2/20 blocks: plenty of headroom
+    for _ in range(5):                   # clean phase -> OK
+        tick()
+        time.sleep(0.2)
+
+    _fill(cache, s0, 36)                 # 9 blocks
+    s1 = cache.alloc()
+    _fill(cache, s1, 40)                 # +10 -> 19/20 used, free 0.05
+    for _ in range(3):                   # sustained low free -> WARN
+        tick()
+        time.sleep(0.2)
+
+    def exhaust():
+        try:
+            _fill(cache, s1, 64)         # needs block 11: always raises
+        except KVPoolExhausted:
+            pass
+
+    deadline = time.time() + 45          # burn phase -> PAGE
+    while time.time() < deadline:
+        exhaust()
+        exhaust()
+        tick()
+        if levels[-1] == health.PAGE:
+            break
+        time.sleep(0.2)
+
+    port = debugz.port()
+
+    def get(path):
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (port, path), timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    alertz = json.loads(get("/alertz"))
+    alertz_text = get("/alertz?format=text")
+    statusz = json.loads(get("/statusz"))
+    memz_page = json.loads(get("/memz"))
+    flight_path = os.path.join(os.environ["MXTPU_DRILL_TMP"],
+                               "flight.jsonl")
+    flight.dump(flight_path, reason="drill")
+    return {"levels": levels, "alertz": alertz,
+            "alertz_text": alertz_text, "statusz": statusz,
+            "memz": memz_page, "flight_path": flight_path,
+            "export_path": os.environ["MXTPU_MEM_EXPORT"]}
+
+
+_KV = []
+
+
+def _memz_drill_worker_proc(queue, ctrl):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        res = _memz_drill_worker()
+    except Exception as e:  # surface failures to the test
+        import traceback
+        queue.put("ERROR: %s\n%s" % (e, traceback.format_exc()))
+        return
+    queue.put(res)
+    # stay alive, still burning exhaustion, so the parent's healthcheck
+    # scrapes a live member with a hot kv_pool_pressure rule
+    cache = _KV[1]
+    end = time.time() + 180
+    while time.time() < end:
+        try:
+            ctrl.get_nowait()
+            return
+        except Exception:  # noqa: BLE001 — queue.Empty
+            pass
+        try:
+            _fill(cache, max(cache._live), 64)
+        except (KVPoolExhausted, ValueError):
+            pass
+        try:
+            health.tick()
+        except Exception:  # noqa: BLE001 — dying fleet mid-teardown
+            pass
+        time.sleep(0.1)
+
+
+def _run_tool(script, *args):
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    env.pop("MXTPU_MEM_EXPORT", None)   # tools must not overwrite the
+    return subprocess.run(                # worker's post-mortem at exit
+        [sys.executable, os.path.join(ROOT, "tools", script)] + list(args),
+        capture_output=True, text=True, env=env, timeout=120)
+
+
+def test_memz_drill_kv_exhaustion_pages_and_dumps(tmp_path):
+    """Acceptance drill (two OS processes + scheduler/server): an
+    oversubscribed gpt-spec paged pool driven to exhaustion walks
+    kv_pool_pressure OK→WARN→PAGE in /alertz (JSON + text), leaves the
+    oom.kv_pool flight event and a readable MXTPU_MEM_EXPORT
+    post-mortem, shows up in a parent-side mxtop frame, and makes
+    tools/healthcheck.py exit 2."""
+    from incubator_mxnet_tpu.kvstore.dist_server import (run_scheduler,
+                                                         run_server,
+                                                         SchedulerClient)
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    drill_env = {
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "JAX_PLATFORM_NAME": "cpu", "JAX_PLATFORMS": "cpu",
+        "MXTPU_METRICS": "1",
+        # compress the SRE windows so the drill fits in seconds; one
+        # raw PAGE evaluation is enough to fire
+        "MXTPU_HEALTH_FAST_WINDOW": "4", "MXTPU_HEALTH_SLOW_WINDOW": "8",
+        "MXTPU_HEALTH_KV_POOL_FOR": "1",
+        "MXTPU_DRILL_TMP": str(tmp_path),
+    }
+    os.environ.update(drill_env)
+    ctx = mp.get_context("spawn")
+    procs = []
+    w = None
+    try:
+        sched = ctx.Process(target=run_scheduler, args=(port, 1, 1),
+                            daemon=True)
+        sched.start()
+        procs.append(sched)
+        time.sleep(0.3)
+        srv = ctx.Process(target=run_server,
+                          args=(("127.0.0.1", port), 1), daemon=True)
+        srv.start()
+        procs.append(srv)
+        queue, ctrl = ctx.Queue(), ctx.Queue()
+        w = ctx.Process(target=_memz_drill_worker_proc,
+                        args=(queue, ctrl), daemon=True)
+        w.start()
+        res = queue.get(timeout=150)
+        assert not (isinstance(res, str) and res.startswith("ERROR")), res
+
+        # (1) the pressure rule walked OK -> WARN -> PAGE, in order
+        levels = res["levels"]
+        assert levels[0] == health.OK
+        assert health.WARN in levels and health.PAGE in levels
+        assert levels.index(health.OK) < levels.index(health.WARN) \
+            < levels.index(health.PAGE)
+        assert levels[-1] == health.PAGE
+
+        # ... visible in /alertz JSON + text and the statusz section
+        verdict = res["alertz"]["verdict"]
+        assert verdict["level"] == health.PAGE and verdict["ok"] is False
+        assert any(e["rule"] == "kv_pool_pressure"
+                   for e in verdict["firing"])
+        assert "[PAGE] kv_pool_pressure" in res["alertz_text"]
+        assert res["statusz"]["health"]["level"] == health.PAGE
+        assert "kv_pool_pressure" in res["statusz"]["health"]["firing"]
+
+        # ... the statusz identity + memz sections (satellite surfaces)
+        ident = res["statusz"]["device_identity"]
+        assert ident["platform"] == "cpu" and ident["device_count"] >= 1
+        assert res["statusz"]["memz"]["enabled"] is True
+        assert res["statusz"]["memz"]["pools"] >= 1
+
+        # ... the /memz census shows the exhausted drill pool
+        pools = {p["name"]: p for p in res["memz"]["kv"]}
+        assert pools["drill"]["blocks_free"] <= 1
+        assert pools["drill"]["num_blocks"] == 20
+        assert res["memz"]["watermarks"].get("kv:drill", 0) >= 19
+
+        # ... and the flight ring has the forensics trail
+        lines = [json.loads(l) for l in
+                 open(res["flight_path"]).read().splitlines()]
+        events = [e["event"] for e in lines]
+        assert "gen.kv_pool_pressure" in events    # near-exhaustion edge
+        oom = [e for e in lines if e["event"] == "oom.kv_pool"]
+        assert oom and oom[0]["attrs"]["pool"] == "drill"
+        fired = [(e["attrs"]["rule"], e["attrs"]["level"]) for e in lines
+                 if e["event"] == "health.firing"]
+        assert ("kv_pool_pressure", health.PAGE) in fired
+
+        # (2) the OOM post-mortem landed where MXTPU_MEM_EXPORT points
+        pm = json.load(open(res["export_path"]))
+        assert pm["reason"] == "oom.kv_pool"
+        pm_pools = {p["name"]: p for p in pm["kv"]}
+        assert pm_pools["drill"]["blocks_free"] <= 1
+        assert "kv:drill" in pm["watermarks"]
+        assert "live_buffers" in pm and "host" in pm
+
+        # (3) a parent-side mxtop frame renders the MEM columns and the
+        # firing rule (the worker is still burning)
+        top = _run_tool("mxtop.py", "--once", "--interval", "2")
+        assert top.returncode == 0, top.stderr[-2000:]
+        assert "KVFREE" in top.stdout and "HBM%" in top.stdout
+        assert "kv_pool_pressure" in top.stdout, top.stdout
+
+        # (4) healthcheck sees the burning fleet and exits 2
+        hc = _run_tool("healthcheck.py", "--samples", "2",
+                       "--interval", "1")
+        assert hc.returncode == 2, (hc.stdout[-2000:], hc.stderr[-2000:])
+        out = json.loads(hc.stdout)
+        assert out["level"] == health.PAGE
+        assert any(e["rule"] == "kv_pool_pressure" for e in out["firing"])
+    finally:
+        for k in drill_env:
+            os.environ.pop(k, None)
+        try:
+            SchedulerClient(("127.0.0.1", port)).shutdown()
+        except OSError:
+            pass
+        if w is not None:
+            w.kill()
+        for p in procs:
+            p.terminate()

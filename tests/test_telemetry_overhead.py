@@ -227,3 +227,45 @@ def test_disabled_lockdep_is_one_env_check():
     assert threading.Lock is lockdep._ORIG_LOCK
     assert threading.RLock is lockdep._ORIG_RLOCK
     assert lockdep.violations() == []
+
+
+def test_disabled_memz_is_one_predicate(monkeypatch):
+    """Memz plane off (MXTPU_MEMZ unset): sample(), note_kv() and
+    capture_memory() — the three hooks on the history-daemon / decode /
+    compile hot paths — each reduce to one predicate check: no device
+    queries, no jax import, no filesystem, nothing captured."""
+    import builtins
+    from incubator_mxnet_tpu.telemetry import memz
+    was = memz.enabled()
+    memz.disable()
+    try:
+        assert memz.enabled() is False
+        assert memz.statusz_entry() == {"enabled": False}
+        assert _per_call(memz.sample) < MAX_SECONDS_PER_CALL
+        assert _per_call(lambda: memz.note_kv(None)) \
+            < MAX_SECONDS_PER_CALL
+        assert _per_call(lambda: memz.capture_memory("p", compiled=None)) \
+            < MAX_SECONDS_PER_CALL
+        # the off path must touch neither the backend nor the disk
+        real_import = builtins.__import__
+
+        def _no_jax(name, *a, **k):
+            assert name != "jax", "disabled memz imported jax"
+            return real_import(name, *a, **k)
+        monkeypatch.setattr(builtins, "__import__", _no_jax)
+        monkeypatch.setattr(memz.os.path, "exists",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                AssertionError("disabled memz hit the "
+                                               "filesystem")))
+        monkeypatch.setattr(builtins, "open",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                AssertionError("disabled memz opened a "
+                                               "file")))
+        memz.sample()
+        memz.note_kv(None)
+        memz.capture_memory("p", compiled=object())
+        assert memz.programs() == {}
+    finally:
+        monkeypatch.undo()
+        if was:
+            memz.enable()

@@ -66,10 +66,14 @@ def round_platform(recs):
 
 def comparable(rec):
     """Gate-worthy throughput row: higher-is-better per-second units,
-    excluding the preflight health probes."""
+    excluding the preflight health probes. Non-rate capacity rows
+    (e.g. llm_capacity's concurrent_sessions_per_chip, unit
+    "sessions/chip") opt in with an explicit ``higher_is_better``
+    flag on the record."""
     if rec["metric"].startswith("tunnel_preflight"):
         return False
-    return "/sec" in str(rec.get("unit", ""))
+    return ("/sec" in str(rec.get("unit", ""))
+            or bool(rec.get("higher_is_better")))
 
 
 def lower_is_better(rec):

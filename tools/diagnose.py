@@ -146,6 +146,52 @@ def main():
     except Exception as e:  # noqa: BLE001 — diagnostics must not crash
         print("telemetry unavailable:", e)
 
+    section("Memory")
+    # memz plane: live device HBM + host RSS read on demand (works even
+    # with the plane off — only the sampled watermarks/programs need
+    # MXTPU_MEMZ=1 in the examined process), per-program static
+    # footprints from the compile seam, and the paged-KV block census
+    try:
+        from incubator_mxnet_tpu.telemetry import memz as _memz
+        print("enabled      :", _memz.enabled(),
+              "(export: %s)" % (_memz.export_path() or "unset"))
+        for d in _memz.device_stats()[:8]:
+            lim = d.get("bytes_limit")
+            print("  - %s: in_use=%.1f MB%s peak=%.1f MB [%s]"
+                  % (d["device"], d["bytes_in_use"] / 1e6,
+                     " limit=%.1f MB" % (lim / 1e6) if lim else "",
+                     (d.get("peak_bytes_in_use") or 0) / 1e6,
+                     d["source"]))
+        host = _memz.host_memory()
+        print("host rss     : %.1f MB (peak %.1f MB)"
+              % (host["rss_bytes"] / 1e6, host["peak_rss_bytes"] / 1e6))
+        marks = _memz.memz_dict().get("watermarks") or {}
+        if marks:
+            print("watermarks   :", ", ".join(
+                "%s=%.0f" % (k, v) for k, v in sorted(marks.items())))
+        progs = _memz.programs()
+        if progs:
+            print("programs     : %d captured" % len(progs))
+            for name, row in sorted(
+                    progs.items(),
+                    key=lambda kv: -(kv[1].get("total_bytes") or 0))[:10]:
+                print("  - %-32s total=%.2f MB (args=%.2f out=%.2f "
+                      "temp=%.2f)"
+                      % (name, (row.get("total_bytes") or 0) / 1e6,
+                         (row.get("argument_bytes") or 0) / 1e6,
+                         (row.get("output_bytes") or 0) / 1e6,
+                         (row.get("temp_bytes") or 0) / 1e6))
+        for pool in _memz.kv_census():
+            print("  kv pool %-12s: %d/%d blocks used (peak %d, "
+                  "free %.0f%%, frag %.2f), %d/%d slots"
+                  % (pool["name"], pool["blocks_in_use"],
+                     pool["num_blocks"], pool["blocks_in_use_peak"],
+                     100.0 * pool["free_fraction"],
+                     pool["fragmentation"], pool["slots_in_use"],
+                     pool["slots"]))
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("memz unavailable:", e)
+
     section("Health")
     # health plane: in-process evaluator state when embedded in a live
     # job; with a reachable scheduler, a one-shot fleet verdict via

@@ -3,7 +3,11 @@
 
 Walks the scheduler's membership view via telemetry.aggregate.scrape()
 once per interval and renders per-member rates: kvstore push bytes/s,
-rpc retries, compile seconds, guardian skips, membership epoch, and —
+rpc retries, compile seconds, guardian skips, membership epoch, the
+memz MEM column set (HBM% = worst device fill from
+mxtpu_mem_hbm_used_fraction, KVFREE = tightest paged-KV pool free
+fraction, FRAG = worst pool fragmentation; "-" while the memz plane is
+off), and —
 for model servers passed with --serving — QPS, p99 latency, batch
 occupancy, shed counts, the generative LATENCY column set (TTFT
 p50/p99 and per-token TPOT p99 in ms, from the fleet-merged
@@ -73,6 +77,16 @@ def _member_key(role, rank):
     return "role=%s,rank=%s" % (role, rank)
 
 
+def _series_agg(registry, name, where, agg):
+    """min/max over a gauge instrument's series values matching the
+    label-substring filter; None when the member exports no series
+    (memz plane off, or no paged pools live)."""
+    vals = [v for k, v in ((registry.get(name) or {}).get("series")
+                           or {}).items()
+            if (not where or where in k) and not isinstance(v, dict)]
+    return agg(vals) if vals else None
+
+
 def _merged_quantile(registry, name, where, q):
     """Quantile over ONE logical histogram merged across every member's
     matching series (bucket-wise sum — replicas of a model each carry
@@ -112,9 +126,9 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
                     scrape["quorum"], len(scrape["members"]),
                     sum(1 for m in scrape["members"] if m["ok"])))
     lines.append("-" * 78)
-    lines.append("%-10s %-5s %-21s %12s %8s %9s %7s"
+    lines.append("%-10s %-5s %-21s %12s %8s %9s %7s %6s %7s %6s"
                  % ("ROLE", "RANK", "ADDR", "PUSH B/s", "RETRY/s",
-                    "COMPILE s", "SKIPS"))
+                    "COMPILE s", "SKIPS", "HBM%", "KVFREE", "FRAG"))
 
     totals = {}
     for m in scrape["members"]:
@@ -134,10 +148,19 @@ def frame(scheduler, serving, prev_totals, prev_ts, stream=None,
             reg, "mxtpu_guard_skipped_steps_total", where=key)
         r = _rates({k: prev_totals.get(k, 0.0) for k in totals},
                    totals, elapsed)
-        lines.append("%-10s %-5s %-21s %12.0f %8.2f %9.1f %7.0f"
+        # MEM column set (memz plane): worst device HBM fill, tightest
+        # paged-KV pool, worst pool fragmentation — "-" when the member
+        # runs with MXTPU_MEMZ off or owns no paged pools
+        hbm = _series_agg(reg, "mxtpu_mem_hbm_used_fraction", key, max)
+        kvfree = _series_agg(reg, "mxtpu_gen_kv_free_fraction", key, min)
+        frag = _series_agg(reg, "mxtpu_gen_kv_fragmentation", key, max)
+        lines.append("%-10s %-5s %-21s %12.0f %8.2f %9.1f %7.0f %6s %7s %6s"
                      % (m["role"], m["rank"], m["addr"],
                         r.get(key + "/push_bytes", 0.0),
-                        r.get(key + "/retries", 0.0), compile_s, skips))
+                        r.get(key + "/retries", 0.0), compile_s, skips,
+                        "%.0f" % (100.0 * hbm) if hbm is not None else "-",
+                        "%.2f" % kvfree if kvfree is not None else "-",
+                        "%.2f" % frag if frag is not None else "-"))
 
     # serving rollup (per model): QPS / p99 / occupancy / shed, plus
     # the generative-engine columns — TOK/s (rate of committed decode+
